@@ -1,0 +1,65 @@
+"""paddle_tpu: a TPU-native deep-learning framework with PaddlePaddle's
+capabilities (see SURVEY.md for the blueprint; reference mounted at
+/root/reference). The compute path is JAX/XLA/Pallas; the API surface
+mirrors ``paddle``'s eager + distributed semantics.
+"""
+from __future__ import annotations
+
+# Core substrate first (flags/dtypes), then Tensor, then ops which register
+# kernels, then method monkey-patching (reference-style late binding).
+from .core import flags as _flags_mod
+from .core.flags import get_flags, set_flags
+from .core.dtype import (bfloat16, bool_, complex64, complex128, float16,
+                         float32, float64, float8_e4m3fn, float8_e5m2,
+                         get_default_dtype, iinfo, int8, int16, int32, int64,
+                         finfo, set_default_dtype, uint8, uint16, uint32,
+                         uint64, convert_dtype)
+from .core.rng import seed, get_rng_state, set_rng_state
+from .tensor import Parameter, Tensor, to_tensor
+from .ops import *  # noqa: F401,F403 — creation/math/manipulation surface
+from .ops import creation as _creation, manipulation as _manipulation, math as _math
+from . import tensor_methods as _tensor_methods  # noqa: F401 (patches Tensor)
+from .autograd import no_grad, enable_grad, is_grad_enabled, set_grad_enabled, grad
+from . import autograd  # noqa: F401
+from . import nn  # noqa: F401
+from . import optimizer  # noqa: F401
+from . import io  # noqa: F401
+from . import amp  # noqa: F401
+from . import vision  # noqa: F401
+from . import jit  # noqa: F401
+from . import device  # noqa: F401
+from . import framework  # noqa: F401
+from .framework.io import load, save
+from . import metric  # noqa: F401
+from . import distributed  # noqa: F401
+
+# paddle-API aliases
+bool = bool_  # noqa: A001
+
+__version__ = "0.1.0"
+
+
+def is_compiled_with_cuda() -> bool:
+    return False
+
+
+def is_compiled_with_xpu() -> bool:
+    return False
+
+
+def is_compiled_with_tpu() -> bool:
+    return True
+
+
+def in_dynamic_mode() -> bool:
+    return True
+
+
+def disable_static(place=None):
+    pass
+
+
+def enable_static():
+    raise NotImplementedError(
+        "paddle_tpu is dynamic-first; use paddle_tpu.jit.to_static for "
+        "whole-graph XLA compilation")

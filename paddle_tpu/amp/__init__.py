@@ -1,0 +1,220 @@
+"""Automatic mixed precision.
+
+(reference: python/paddle/amp/auto_cast.py:856 auto_cast,
+amp/grad_scaler.py:41,619 GradScaler; AMP insertion point in generated
+eager code eager_gen.py:515. Here the insertion point is the dispatch
+chokepoint core/dispatch.py::_amp_hook.)
+
+TPU notes: bf16 is the native fast dtype (MXU) and needs NO loss scaling;
+GradScaler keeps the fp16 semantics for API parity but becomes a no-op
+pass-through when enable=False or dtype=bfloat16 with use_dynamic=False.
+"""
+from __future__ import annotations
+
+import contextlib
+from typing import Optional, Set
+
+import jax
+import numpy as np
+import jax.numpy as jnp
+
+from ..core import dispatch as _dispatch
+from ..core.dtype import convert_dtype
+from ..tensor import Tensor
+
+__all__ = ["auto_cast", "amp_guard", "decorate", "GradScaler",
+           "white_list", "black_list"]
+
+# ops that benefit from low precision (MXU-bound)
+WHITE_LIST: Set[str] = {
+    "matmul", "linear", "conv2d", "conv1d", "conv2d_transpose", "bmm",
+    "fused_gemm_epilogue", "einsum_op", "flash_attention",
+    "scaled_dot_product_attention", "addmm",
+}
+# ops that must stay fp32 (numerically sensitive)
+BLACK_LIST: Set[str] = {
+    "softmax_with_cross_entropy", "cross_entropy_loss", "log_softmax",
+    "exp", "log", "logsumexp", "pow", "square", "sum", "mean",
+    "layer_norm", "rms_norm", "batch_norm", "group_norm", "instance_norm",
+    "norm", "cumsum",
+}
+
+
+def white_list():
+    return set(WHITE_LIST)
+
+
+def black_list():
+    return set(BLACK_LIST)
+
+
+class _AmpState:
+    enabled = False
+    dtype = jnp.bfloat16
+    level = "O1"
+    custom_white = set()
+    custom_black = set()
+
+
+_state = _AmpState()
+
+
+def _amp_hook(op_name, conv_args, conv_kwargs):
+    if not _state.enabled:
+        return conv_args, conv_kwargs
+    white = (WHITE_LIST | _state.custom_white) - _state.custom_black
+    if op_name not in white:
+        return conv_args, conv_kwargs
+    target = _state.dtype
+
+    def cast(v):
+        if isinstance(v, (jax.Array, jax.core.Tracer)) and \
+                v.dtype == jnp.float32:
+            return v.astype(target)
+        return v
+
+    return [cast(a) for a in conv_args], {k: cast(v)
+                                          for k, v in conv_kwargs.items()}
+
+
+@contextlib.contextmanager
+def auto_cast(enable: bool = True, custom_white_list=None,
+              custom_black_list=None, level: str = "O1", dtype="bfloat16",
+              use_promote: bool = True):
+    prev = (_state.enabled, _state.dtype, _state.level,
+            _state.custom_white, _state.custom_black)
+    _state.enabled = enable
+    _state.dtype = convert_dtype(dtype)
+    _state.level = level
+    _state.custom_white = set(custom_white_list or ())
+    _state.custom_black = set(custom_black_list or ())
+    _dispatch._amp_hook = _amp_hook if enable else None
+    try:
+        yield
+    finally:
+        (_state.enabled, _state.dtype, _state.level,
+         _state.custom_white, _state.custom_black) = prev
+        _dispatch._amp_hook = _amp_hook if _state.enabled else None
+
+
+amp_guard = auto_cast
+
+
+def decorate(models, optimizers=None, level: str = "O2", dtype="bfloat16",
+             master_weight=None, save_dtype=None):
+    """O2: cast model params to the low dtype (keeping master fp32 weights
+    in the optimizer when multi_precision)."""
+    single = not isinstance(models, (list, tuple))
+    model_list = [models] if single else list(models)
+    if level == "O2":
+        for m in model_list:
+            m.to(dtype=dtype)
+        if optimizers is not None:
+            opts = [optimizers] if not isinstance(optimizers, (list, tuple)) \
+                else list(optimizers)
+            for o in opts:
+                o._multi_precision = True
+    if optimizers is None:
+        return models if single else model_list
+    return (models if single else model_list), optimizers
+
+
+class GradScaler:
+    """(reference: python/paddle/amp/grad_scaler.py:619 — dynamic loss
+    scaling with found_inf sync; hybrid-parallel variant syncs found_inf
+    across groups.)"""
+
+    def __init__(self, enable: bool = True, init_loss_scaling: float = 2.0**15,
+                 incr_ratio: float = 2.0, decr_ratio: float = 0.5,
+                 incr_every_n_steps: int = 1000,
+                 decr_every_n_nan_or_inf: int = 2, use_dynamic_loss_scaling=True):
+        self._enable = enable
+        self._scale = float(init_loss_scaling)
+        self._incr_ratio = incr_ratio
+        self._decr_ratio = decr_ratio
+        self._incr_every = incr_every_n_steps
+        self._decr_every = decr_every_n_nan_or_inf
+        self._dynamic = use_dynamic_loss_scaling
+        self._good_steps = 0
+        self._bad_steps = 0
+        self._found_inf = False
+
+    def scale(self, var: Tensor) -> Tensor:
+        if not self._enable:
+            return var
+        from ..ops import math as M
+
+        return M.scale(var, scale=self._scale)
+
+    def unscale_(self, optimizer):
+        if not self._enable:
+            return
+        inv = 1.0 / self._scale
+        found = False
+        for p in (optimizer._parameter_list or []):
+            if p is not None and p.grad is not None:
+                g = p.grad._value * inv
+                p.grad._value = g
+        self._found_inf = self._check_found_inf(optimizer)
+
+    def _check_found_inf(self, optimizer) -> bool:
+        total = None
+        for p in (optimizer._parameter_list or []):
+            if p is not None and p.grad is not None:
+                s = jnp.sum(jnp.abs(p.grad._value.astype(jnp.float32)))
+                total = s if total is None else total + s
+        if total is None:
+            return False
+        return not bool(jnp.isfinite(total))
+
+    def step(self, optimizer):
+        if not self._enable:
+            optimizer.step()
+            return
+        self.unscale_(optimizer)
+        if not self._found_inf:
+            optimizer.step()
+        self.update()
+
+    def minimize(self, optimizer, scaled_loss):
+        self.step(optimizer)
+        optimizer.clear_grad()
+
+    def update(self):
+        if not (self._enable and self._dynamic):
+            return
+        if self._found_inf:
+            self._bad_steps += 1
+            self._good_steps = 0
+            if self._bad_steps >= self._decr_every:
+                self._scale = max(self._scale * self._decr_ratio, 1.0)
+                self._bad_steps = 0
+        else:
+            self._good_steps += 1
+            self._bad_steps = 0
+            if self._good_steps >= self._incr_every:
+                self._scale *= self._incr_ratio
+                self._good_steps = 0
+        self._found_inf = False
+
+    def is_enable(self) -> bool:
+        return self._enable
+
+    def is_use_dynamic_loss_scaling(self) -> bool:
+        return self._dynamic
+
+    def get_loss_scaling(self) -> float:
+        return self._scale
+
+    def set_init_loss_scaling(self, v: float):
+        self._scale = float(v)
+
+    def state_dict(self):
+        return {"scale": self._scale, "incr_ratio": self._incr_ratio,
+                "decr_ratio": self._decr_ratio,
+                "good_steps": self._good_steps, "bad_steps": self._bad_steps}
+
+    def load_state_dict(self, state):
+        self._scale = state.get("scale", self._scale)
+        self._good_steps = state.get("good_steps", 0)
+        self._bad_steps = state.get("bad_steps", 0)

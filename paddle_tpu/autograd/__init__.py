@@ -1,0 +1,32 @@
+"""Autograd public API (paddle.autograd analog)."""
+from .engine import (backward, enable_grad, is_grad_enabled, no_grad,
+                     set_grad_enabled)
+from .pylayer import PyLayer, PyLayerContext
+
+__all__ = ["backward", "no_grad", "enable_grad", "is_grad_enabled",
+           "set_grad_enabled", "PyLayer", "PyLayerContext", "grad"]
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph=False,
+         create_graph=False, allow_unused=False):
+    """paddle.grad — compute grads of outputs wrt inputs without touching
+    .grad of other leaves is NOT replicated exactly: we snapshot and restore
+    leaf grads, which matches observable semantics for the common cases."""
+    if not isinstance(outputs, (list, tuple)):
+        outputs = [outputs]
+    if not isinstance(inputs, (list, tuple)):
+        inputs = [inputs]
+    saved = [t.grad for t in inputs]
+    for t in inputs:
+        t.grad = None
+    backward(list(outputs), grad_outputs if isinstance(grad_outputs, (list, tuple))
+             else ([grad_outputs] * len(outputs) if grad_outputs is not None else None),
+             retain_graph=retain_graph)
+    grads = [t.grad for t in inputs]
+    for t, s in zip(inputs, saved):
+        t.grad = s
+    if not allow_unused:
+        for g, t in zip(grads, inputs):
+            if g is None:
+                raise RuntimeError("a gradient is unused; pass allow_unused=True")
+    return grads

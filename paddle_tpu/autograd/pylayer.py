@@ -1,0 +1,78 @@
+"""PyLayer: user-defined forward/backward (paddle.autograd.PyLayer).
+
+(reference: python/paddle/autograd/py_layer.py — used heavily by the
+fleet parallel layers, e.g. mp_ops._c_identity and the sequence-parallel
+Scatter/Gather PyLayers.)
+"""
+from __future__ import annotations
+
+from typing import Any
+
+from . import engine
+from ..tensor import Tensor
+
+__all__ = ["PyLayer", "PyLayerContext"]
+
+
+class PyLayerContext:
+    def __init__(self):
+        self._saved = ()
+        self.materialize_grads = True
+
+    def save_for_backward(self, *tensors):
+        self._saved = tensors
+
+    @property
+    def saved_tensor(self):
+        return self._saved
+
+    def saved_tensors(self):
+        return self._saved
+
+
+class PyLayerMeta(type):
+    def __call__(cls, *args, **kwargs):
+        raise RuntimeError(f"call {cls.__name__}.apply(...), not the class")
+
+
+class PyLayer(metaclass=PyLayerMeta):
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *grads):
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        ctx = PyLayerContext()
+        in_tensors = [a for a in args if isinstance(a, Tensor)] + [
+            v for v in kwargs.values() if isinstance(v, Tensor)]
+        requires_grad = engine.is_grad_enabled() and any(
+            not t.stop_gradient for t in in_tensors)
+        with engine.no_grad():
+            outs = cls.forward(ctx, *args, **kwargs)
+        multi = isinstance(outs, (tuple, list))
+        out_list = list(outs) if multi else [outs]
+        out_tensors = [o if isinstance(o, Tensor) else Tensor(o) for o in out_list]
+        if requires_grad:
+            for t in out_tensors:
+                t.stop_gradient = False
+
+            def backward_fn(*gout_values):
+                gouts = tuple(Tensor(g, stop_gradient=True) for g in gout_values)
+                with engine.no_grad():
+                    gins = cls.backward(ctx, *gouts)
+                if not isinstance(gins, (tuple, list)):
+                    gins = (gins,)
+                out = []
+                for g in gins:
+                    out.append(g._value if isinstance(g, Tensor) else g)
+                return tuple(out)
+
+            engine.record_custom(
+                cls.__name__, backward_fn, in_tensors, out_tensors,
+                tuple(t._value for t in out_tensors)
+                if multi else out_tensors[0]._value)
+        return tuple(out_tensors) if multi else out_tensors[0]

@@ -1,0 +1,7 @@
+"""Core substrate: flags, errors, dtypes, op registry, dispatch, RNG.
+
+Analog of the reference's L0/L1 layers (paddle/common + phi core); see
+SURVEY.md §1. TPU-first: kernels are JAX functions, executables are cached
+XLA programs, memory/streams belong to XLA/PJRT.
+"""
+from . import dispatch, dtype, enforce, flags, registry, rng  # noqa: F401

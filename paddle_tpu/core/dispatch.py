@@ -1,0 +1,94 @@
+"""Tensor-level op dispatch: the analog of the reference's generated
+``<op>_ad_func`` eager functions.
+
+(reference: paddle/fluid/eager/auto_code_generator/generator/eager_gen.py:251
+— each generated ad_func unwraps tensors, selects+runs the PHI kernel, then
+constructs the GradNode. Here one generic ``apply`` plays that role for all
+ops; the per-op public functions are built by the ``def_op`` decorator, and
+AMP auto-cast hooks in at this chokepoint like eager_gen.py:515 does.)
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable
+
+import jax.numpy as jnp
+
+from .registry import OpCall, OpDef, is_tensor_like, register_grad, register_op, run_op
+from ..autograd import engine
+
+__all__ = ["apply", "def_op", "def_grad"]
+
+# Set by paddle_tpu.amp to intercept op inputs for auto-cast; takes
+# (op_name, tensor_values) -> tensor_values.
+_amp_hook = None
+
+
+def apply(opdef: OpDef, args, kwargs):
+    from ..tensor import Tensor
+
+    conv_args = []
+    in_tensors = []  # aligned with OpCall.in_values order (positional, then sorted kwargs)
+    kw_tensors = []
+    for a in args:
+        if isinstance(a, Tensor):
+            in_tensors.append(a)
+            conv_args.append(a._value)
+        else:
+            if is_tensor_like(a):
+                in_tensors.append(None)
+            conv_args.append(a)
+    conv_kwargs = {}
+    for k in sorted(kwargs):
+        v = kwargs[k]
+        if isinstance(v, Tensor):
+            kw_tensors.append(v)
+            conv_kwargs[k] = v._value
+        else:
+            if is_tensor_like(v):
+                kw_tensors.append(None)
+            conv_kwargs[k] = v
+    in_tensors.extend(kw_tensors)
+
+    if _amp_hook is not None:
+        conv_args, conv_kwargs = _amp_hook(opdef.name, conv_args, conv_kwargs)
+
+    call = OpCall(opdef, conv_args, conv_kwargs)
+    requires_grad = opdef.differentiable and engine.is_grad_enabled() and any(
+        t is not None and not t.stop_gradient for t in in_tensors
+    )
+    outs = run_op(call)
+
+    multi = isinstance(outs, tuple)
+    out_list = list(outs) if multi else [outs]
+    out_tensors = [Tensor(o, stop_gradient=not requires_grad) for o in out_list]
+    if requires_grad:
+        engine.record_op(call, in_tensors, out_tensors, outs)
+    return tuple(out_tensors) if multi else out_tensors[0]
+
+
+def def_op(name: str, differentiable: bool = True) -> Callable:
+    """Register a jax kernel and return the public Tensor-level function."""
+
+    def deco(fn):
+        opdef = register_op(name, fn, differentiable)
+
+        @functools.wraps(fn)
+        def public(*args, **kwargs):
+            return apply(opdef, args, kwargs)
+
+        public.opdef = opdef
+        public.raw = fn
+        return public
+
+    return deco
+
+
+def def_grad(name: str) -> Callable:
+    """Register an explicit grad kernel for op ``name``."""
+
+    def deco(fn):
+        register_grad(name, fn)
+        return fn
+
+    return deco

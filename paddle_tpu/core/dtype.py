@@ -1,0 +1,92 @@
+"""Data types and dtype utilities.
+
+Analog of the reference's phi::DataType (paddle/phi/common/data_type.h)
+and python-side ``paddle.float32`` etc. We standardise on numpy/jnp dtype
+objects as the canonical representation — idiomatic for JAX — while
+accepting the reference's string names everywhere.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+import ml_dtypes  # ships with jax
+
+__all__ = [
+    "float16", "bfloat16", "float32", "float64",
+    "int8", "int16", "int32", "int64",
+    "uint8", "uint16", "uint32", "uint64",
+    "bool_", "complex64", "complex128", "float8_e4m3fn", "float8_e5m2",
+    "convert_dtype", "get_default_dtype", "set_default_dtype",
+    "is_floating_dtype", "is_integer_dtype", "finfo", "iinfo",
+]
+
+float16 = jnp.dtype(jnp.float16)
+bfloat16 = jnp.dtype(jnp.bfloat16)
+float32 = jnp.dtype(jnp.float32)
+float64 = jnp.dtype(jnp.float64)
+int8 = jnp.dtype(jnp.int8)
+int16 = jnp.dtype(jnp.int16)
+int32 = jnp.dtype(jnp.int32)
+int64 = jnp.dtype(jnp.int64)
+uint8 = jnp.dtype(jnp.uint8)
+uint16 = jnp.dtype(jnp.uint16)
+uint32 = jnp.dtype(jnp.uint32)
+uint64 = jnp.dtype(jnp.uint64)
+bool_ = jnp.dtype(jnp.bool_)
+complex64 = jnp.dtype(jnp.complex64)
+complex128 = jnp.dtype(jnp.complex128)
+float8_e4m3fn = jnp.dtype(ml_dtypes.float8_e4m3fn)
+float8_e5m2 = jnp.dtype(ml_dtypes.float8_e5m2)
+
+_ALIASES = {
+    "float16": float16, "fp16": float16, "half": float16,
+    "bfloat16": bfloat16, "bf16": bfloat16,
+    "float32": float32, "fp32": float32, "float": float32,
+    "float64": float64, "fp64": float64, "double": float64,
+    "int8": int8, "int16": int16, "int32": int32, "int64": int64,
+    "uint8": uint8, "uint16": uint16, "uint32": uint32, "uint64": uint64,
+    "bool": bool_, "complex64": complex64, "complex128": complex128,
+    "float8_e4m3fn": float8_e4m3fn, "float8_e5m2": float8_e5m2,
+}
+
+_default_dtype = float32
+
+
+def convert_dtype(dtype) -> np.dtype:
+    """Canonicalise a dtype spec (string / np / jnp / paddle-style name)."""
+    if dtype is None:
+        return _default_dtype
+    if isinstance(dtype, str):
+        name = dtype.split(".")[-1].lower()  # accept "paddle.float32"
+        if name not in _ALIASES:
+            raise ValueError(f"unsupported dtype string: {dtype!r}")
+        return _ALIASES[name]
+    return jnp.dtype(dtype)
+
+
+def get_default_dtype() -> np.dtype:
+    return _default_dtype
+
+
+def set_default_dtype(dtype) -> None:
+    global _default_dtype
+    dtype = convert_dtype(dtype)
+    if dtype not in (float16, bfloat16, float32, float64):
+        raise TypeError(f"default dtype must be floating, got {dtype}")
+    _default_dtype = dtype
+
+
+def is_floating_dtype(dtype) -> bool:
+    return jnp.issubdtype(convert_dtype(dtype), jnp.floating)
+
+
+def is_integer_dtype(dtype) -> bool:
+    return jnp.issubdtype(convert_dtype(dtype), jnp.integer)
+
+
+def finfo(dtype):
+    return jnp.finfo(convert_dtype(dtype))
+
+
+def iinfo(dtype):
+    return jnp.iinfo(convert_dtype(dtype))

@@ -1,0 +1,106 @@
+"""Error types and enforce helpers.
+
+TPU-native analog of the reference's enforce layer
+(reference: paddle/common/enforce.h, paddle/phi/core/enforce.h,
+python surface ``paddle.base.core.EnforceNotMet`` and typed errors).
+
+The reference's macros capture C++ stack traces; here Python tracebacks
+serve that role, so the value we keep is the *typed error taxonomy* that
+user code and tests match against.
+"""
+from __future__ import annotations
+
+__all__ = [
+    "EnforceNotMet",
+    "InvalidArgumentError",
+    "NotFoundError",
+    "OutOfRangeError",
+    "AlreadyExistsError",
+    "PermissionDeniedError",
+    "ResourceExhaustedError",
+    "PreconditionNotMetError",
+    "UnimplementedError",
+    "UnavailableError",
+    "ExecutionTimeoutError",
+    "FatalError",
+    "enforce",
+    "enforce_eq",
+    "enforce_gt",
+    "enforce_ge",
+    "enforce_not_none",
+]
+
+
+class EnforceNotMet(RuntimeError):
+    """Base error, analog of paddle's EnforceNotMet."""
+
+
+class InvalidArgumentError(EnforceNotMet, ValueError):
+    pass
+
+
+class NotFoundError(EnforceNotMet, KeyError):
+    pass
+
+
+class OutOfRangeError(EnforceNotMet, IndexError):
+    pass
+
+
+class AlreadyExistsError(EnforceNotMet):
+    pass
+
+
+class PermissionDeniedError(EnforceNotMet):
+    pass
+
+
+class ResourceExhaustedError(EnforceNotMet, MemoryError):
+    pass
+
+
+class PreconditionNotMetError(EnforceNotMet):
+    pass
+
+
+class UnimplementedError(EnforceNotMet, NotImplementedError):
+    pass
+
+
+class UnavailableError(EnforceNotMet):
+    pass
+
+
+class ExecutionTimeoutError(EnforceNotMet, TimeoutError):
+    pass
+
+
+class FatalError(EnforceNotMet):
+    pass
+
+
+def enforce(cond: bool, msg: str, err: type = PreconditionNotMetError) -> None:
+    """Analog of PADDLE_ENFORCE(cond, msg)."""
+    if not cond:
+        raise err(msg)
+
+
+def enforce_eq(a, b, msg: str = "", err: type = InvalidArgumentError) -> None:
+    if a != b:
+        raise err(f"expected {a!r} == {b!r}. {msg}")
+
+
+def enforce_gt(a, b, msg: str = "", err: type = InvalidArgumentError) -> None:
+    if not a > b:
+        raise err(f"expected {a!r} > {b!r}. {msg}")
+
+
+def enforce_ge(a, b, msg: str = "", err: type = InvalidArgumentError) -> None:
+    if not a >= b:
+        raise err(f"expected {a!r} >= {b!r}. {msg}")
+
+
+def enforce_not_none(value, name: str = "value"):
+    if value is None:
+        raise NotFoundError(f"{name} should not be None")
+    return value

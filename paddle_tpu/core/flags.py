@@ -1,0 +1,108 @@
+"""Global FLAGS registry with environment override.
+
+TPU-native re-design of the reference's gflags-compatible flag system
+(reference: paddle/common/flags.h:373 ``PHI_DEFINE_EXPORTED_*``,
+paddle/common/flags.cc ~139 flag definitions, exported to Python as
+``paddle.set_flags`` / ``paddle.get_flags``).
+
+Flags are process-global, typed, and overridable via ``FLAGS_<name>``
+environment variables at definition time (matching the reference's
+``PHI_DEFINE_EXPORTED_*`` env-export semantics).
+"""
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, Dict, Iterable, List, Union
+
+__all__ = ["define_flag", "set_flags", "get_flags", "flag_defined"]
+
+_lock = threading.Lock()
+
+
+class _Flag:
+    __slots__ = ("name", "value", "default", "dtype", "doc")
+
+    def __init__(self, name: str, default: Any, doc: str):
+        self.name = name
+        self.default = default
+        self.dtype = type(default)
+        self.doc = doc
+        self.value = self._from_env(default)
+
+    def _from_env(self, default: Any) -> Any:
+        env = os.environ.get("FLAGS_" + self.name)
+        if env is None:
+            return default
+        return _coerce(env, self.dtype)
+
+
+def _coerce(value: Any, dtype: type) -> Any:
+    if dtype is bool:
+        if isinstance(value, bool):
+            return value
+        if isinstance(value, str):
+            return value.lower() in ("1", "true", "yes", "on")
+        return bool(value)
+    return dtype(value)
+
+
+_REGISTRY: Dict[str, _Flag] = {}
+
+
+def define_flag(name: str, default: Any, doc: str = "") -> None:
+    """Define a global flag (analog of PHI_DEFINE_EXPORTED_* macros)."""
+    with _lock:
+        if name in _REGISTRY:
+            raise ValueError(f"flag '{name}' already defined")
+        _REGISTRY[name] = _Flag(name, default, doc)
+
+
+def flag_defined(name: str) -> bool:
+    return name in _REGISTRY
+
+
+def set_flags(flags: Dict[str, Any]) -> None:
+    """Set flag values at runtime (analog of paddle.set_flags).
+
+    Accepts both bare names and ``FLAGS_``-prefixed names.
+    """
+    with _lock:
+        for key, value in flags.items():
+            name = key[6:] if key.startswith("FLAGS_") else key
+            flag = _REGISTRY.get(name)
+            if flag is None:
+                raise ValueError(f"unknown flag '{key}'")
+            flag.value = _coerce(value, flag.dtype)
+
+
+def get_flags(flags: Union[str, Iterable[str]]) -> Dict[str, Any]:
+    """Read flag values (analog of paddle.get_flags)."""
+    if isinstance(flags, str):
+        flags = [flags]
+    out: Dict[str, Any] = {}
+    for key in flags:
+        name = key[6:] if key.startswith("FLAGS_") else key
+        flag = _REGISTRY.get(name)
+        if flag is None:
+            raise ValueError(f"unknown flag '{key}'")
+        out[key] = flag.value
+    return out
+
+
+def _get(name: str, default: Any = None) -> Any:
+    flag = _REGISTRY.get(name)
+    return flag.value if flag is not None else default
+
+
+# ---------------------------------------------------------------------------
+# Core framework flags (subset of the reference's 139, TPU-relevant ones).
+# ---------------------------------------------------------------------------
+define_flag("check_nan_inf", False, "Scan outputs of every eager op for NaN/Inf.")
+define_flag("benchmark", False, "Block on each eager op for timing accuracy.")
+define_flag("eager_op_jit_cache", True, "Cache per-op jitted executables keyed by op+attrs.")
+define_flag("use_pallas_kernels", True, "Use Pallas TPU kernels for fused hot ops when available.")
+define_flag("allocator_strategy", "xla", "Memory management owner: always XLA on TPU.")
+define_flag("collective_timeout_s", 1800.0, "Watchdog timeout for in-flight collectives.")
+define_flag("enable_async_trace", False, "Enable collective watchdog tracing.")
+define_flag("tpu_matmul_precision", "default", "Default lax matmul precision (default|high|highest).")

@@ -1,0 +1,100 @@
+"""Global RNG state built on JAX functional keys.
+
+Analog of the reference's global generator (paddle.seed → phi generators)
+plus the hybrid-parallel RNG state tracker
+(reference: python/paddle/distributed/fleet/layers/mpu/random.py:34,99
+``RNGStatesTracker`` — named RNG states so TP ranks drop out identically
+where required and differently where required).
+
+The state holds a jax PRNG key. Random ops split the key per call. When a
+traced seed tensor is pushed (``fork_traced``), all keys derive from a
+traced value, so randomness threads correctly through jitted train steps
+instead of baking into the compiled graph.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Dict
+
+import jax
+
+__all__ = ["seed", "get_key", "get_rng_state", "set_rng_state",
+           "RNGStatesTracker", "get_rng_tracker", "fork_traced"]
+
+_state = threading.local()
+
+
+def _key():
+    if not hasattr(_state, "key"):
+        _state.key = jax.random.key(0)
+    return _state.key
+
+
+def seed(s: int) -> None:
+    """Set the global seed (paddle.seed)."""
+    _state.key = jax.random.key(s)
+
+
+def get_key():
+    """Split one subkey off the global state."""
+    k = _key()
+    _state.key, sub = jax.random.split(k)
+    return sub
+
+
+def get_rng_state():
+    return _key()
+
+
+def set_rng_state(key) -> None:
+    _state.key = key
+
+
+@contextlib.contextmanager
+def fork_traced(seed_tensor):
+    """Temporarily derive all randomness from a traced seed (for jitted steps)."""
+    from ..tensor import Tensor
+
+    if isinstance(seed_tensor, Tensor):
+        seed_tensor = seed_tensor._value
+    prev = _key()
+    _state.key = jax.random.key(seed_tensor.reshape(()).astype("uint32"))
+    try:
+        yield
+    finally:
+        _state.key = prev
+
+
+class RNGStatesTracker:
+    """Named RNG states (mpu/random.py analog) for TP-consistent dropout."""
+
+    def __init__(self):
+        self.states_: Dict[str, object] = {}
+
+    def add(self, name: str, s: int) -> None:
+        if name in self.states_:
+            raise ValueError(f"rng state '{name}' already exists")
+        self.states_[name] = jax.random.key(s)
+
+    def reset(self) -> None:
+        self.states_ = {}
+
+    @contextlib.contextmanager
+    def rng_state(self, name: str = "global_seed"):
+        if name not in self.states_:
+            raise ValueError(f"rng state '{name}' not added")
+        prev = _key()
+        _state.key = self.states_[name]
+        try:
+            yield
+        finally:
+            self.states_[name] = _state.key
+            _state.key = prev
+
+
+_tracker = RNGStatesTracker()
+
+
+def get_rng_tracker() -> RNGStatesTracker:
+    return _tracker

@@ -1,0 +1,90 @@
+"""Device management (reference: python/paddle/device/__init__.py:191
+set_device incl. custom devices; CUDA streams API).
+
+On TPU, XLA/PJRT owns streams and memory; this module exposes the same
+query surface over jax.devices().
+"""
+from __future__ import annotations
+
+from typing import List
+
+import jax
+
+__all__ = ["set_device", "get_device", "get_all_devices", "device_count",
+           "is_compiled_with_cuda", "synchronize", "Stream", "Event",
+           "current_stream"]
+
+_current = None
+
+
+def set_device(device: str):
+    """Accepts "tpu", "tpu:N", "cpu" — device placement is owned by XLA;
+    this records the preference used by to_tensor placement."""
+    global _current
+    _current = device
+    return device
+
+
+def get_device() -> str:
+    if _current is not None:
+        return _current
+    d = jax.devices()[0]
+    return f"{d.platform}:{d.id}"
+
+
+def get_all_devices() -> List[str]:
+    return [f"{d.platform}:{d.id}" for d in jax.devices()]
+
+
+def device_count() -> int:
+    return len(jax.devices())
+
+
+def is_compiled_with_cuda() -> bool:
+    return False
+
+
+def synchronize(device=None):
+    """Block until all queued work completes (effectful_barrier analog)."""
+    import jax.numpy as jnp
+
+    jnp.zeros(()).block_until_ready()
+
+
+class Stream:
+    """API-parity stub: XLA owns stream scheduling on TPU; kept so code
+    written against paddle.device.Stream imports (reference:
+    python/paddle/device/__init__.py Stream)."""
+
+    def __init__(self, device=None, priority=2):
+        self.device = device
+
+    def synchronize(self):
+        synchronize()
+
+    def wait_stream(self, stream):
+        pass
+
+    def record_event(self, event=None):
+        return event or Event()
+
+    def wait_event(self, event):
+        pass
+
+
+class Event:
+    def __init__(self, device=None, enable_timing=False, blocking=False):
+        pass
+
+    def record(self, stream=None):
+        pass
+
+    def synchronize(self):
+        synchronize()
+
+    def query(self) -> bool:
+        return True
+
+
+def current_stream(device=None) -> Stream:
+    return Stream(device)

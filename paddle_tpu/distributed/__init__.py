@@ -1,0 +1,24 @@
+"""Distributed training (paddle.distributed analog).
+
+TPU-native design (see SURVEY.md §2.3/§2.4/§7): the mesh-and-collectives
+layer replaces ProcessGroupNCCL — communication lowers to XLA collectives
+over ICI via jax.shard_map axis names; the Fleet hybrid-parallel surface
+(topology, TP layers, sharding, PP, MoE) is preserved on top.
+"""
+from . import collective  # noqa: F401
+from .collective import (  # noqa: F401
+    all_gather, all_reduce, all_to_all, barrier, broadcast, get_group,
+    get_rank, get_world_size, in_spmd_region, init_parallel_env, irecv,
+    isend, new_group, recv, reduce, reduce_scatter, scatter, send,
+    spmd_region, ReduceOp, Group, ProcessGroup, split_group)
+from . import fleet  # noqa: F401
+from .parallel import DataParallel, ParallelEnv  # noqa: F401
+
+__all__ = [
+    "all_gather", "all_reduce", "all_to_all", "barrier", "broadcast",
+    "get_group", "get_rank", "get_world_size", "init_parallel_env",
+    "new_group", "recv", "reduce", "reduce_scatter", "scatter", "send",
+    "isend", "irecv", "ReduceOp", "Group", "ProcessGroup", "fleet",
+    "DataParallel", "ParallelEnv", "spmd_region", "in_spmd_region",
+    "split_group",
+]

@@ -1,0 +1,471 @@
+"""Collective communication over the TPU mesh.
+
+TPU-native replacement for the reference's ProcessGroup stack
+(reference: paddle/fluid/distributed/collective/process_group.h:47 virtual
+AllReduce/AllGather/AllToAll/...; process_group_nccl.cc NCCL rings;
+phi/core/distributed/nccl_comm_context.h:40 per-ring comm contexts;
+python surface python/paddle/distributed/communication/).
+
+Design: a ``Group`` is backed by one or more *mesh axis names* of a
+``jax.sharding.Mesh`` instead of an NCCL communicator. Inside an SPMD
+region (the training step traced under ``jax.shard_map`` — entered via
+``spmd_region``/the Fleet engine), each collective lowers to the XLA
+collective HLO (psum/all_gather/ppermute/all_to_all) on those axes,
+riding ICI. Outside an SPMD region with world_size==1 the collectives
+are identities, matching the reference's single-card behavior.
+
+The "channel id"/ring-id bookkeeping of NCCL disappears: XLA assigns
+channel ids at compile time from the axis structure.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+import jax.numpy as jnp
+from jax import lax
+
+from ..autograd import engine as _engine
+from ..core.dispatch import def_op
+from ..core.enforce import PreconditionNotMetError, enforce
+from ..tensor import Tensor
+
+__all__ = [
+    "ReduceOp", "Group", "ProcessGroup", "init_parallel_env", "new_group",
+    "get_group", "get_rank", "get_world_size", "all_reduce", "all_gather",
+    "all_gather_object", "all_to_all", "reduce_scatter", "broadcast",
+    "reduce", "scatter", "send", "recv", "isend", "irecv", "barrier",
+    "spmd_region", "in_spmd_region", "split_group", "stream",
+    "all_reduce_mean_value", "wait", "ppermute", "axis_index",
+]
+
+
+class ReduceOp:
+    SUM = 0
+    MAX = 1
+    MIN = 2
+    PROD = 3
+    AVG = 4
+
+
+class Group:
+    """A communication group = a set of mesh axis names.
+
+    ``nranks`` is the product of the axis sizes. ``rank`` is only
+    meaningful inside an SPMD region where it is a *traced* value
+    (lax.axis_index) — Python-level code must branch with lax.cond/where,
+    never `if rank == k:` (XLA semantics; see SURVEY.md §7 hard parts).
+    """
+
+    _next_gid = 0
+
+    def __init__(self, axis_names: Tuple[str, ...], nranks: int,
+                 name: str = "", pg=None):
+        self.axis_names = tuple(axis_names)
+        self.nranks = nranks
+        self.name = name or "+".join(axis_names) or "world"
+        self.id = Group._next_gid
+        Group._next_gid += 1
+        self.process_group = pg
+
+    @property
+    def world_size(self) -> int:
+        return self.nranks
+
+    @property
+    def rank(self):
+        if in_spmd_region() and self.axis_names:
+            return axis_index(self.axis_names)
+        return 0
+
+    def get_group_rank(self, global_rank):
+        return global_rank
+
+    def __repr__(self):
+        return f"Group(axes={self.axis_names}, nranks={self.nranks})"
+
+
+# ProcessGroup alias keeps the reference's C++-facing name alive for users.
+ProcessGroup = Group
+
+
+class _World:
+    def __init__(self):
+        self.mesh: Optional[jax.sharding.Mesh] = None
+        self.groups: Dict[int, Group] = {}
+        self.default_group: Optional[Group] = None
+        self.initialized = False
+        self.rank = 0
+        self.world_size = 1
+
+
+_world = _World()
+_spmd = threading.local()
+
+
+def _mesh_devices(n: Optional[int] = None):
+    devs = jax.devices()
+    return devs if n is None else devs[:n]
+
+
+def init_parallel_env(mesh: Optional[jax.sharding.Mesh] = None,
+                      strategy=None) -> Group:
+    """(reference: python/paddle/distributed/parallel.py:943 — TCPStore
+    rendezvous + ProcessGroupNCCL creation. TPU-native: the PJRT client
+    already knows every chip; multi-host rendezvous happens in
+    jax.distributed.initialize via the launch module. Here we build the
+    world mesh and the default group.)"""
+    if _world.initialized and mesh is None:
+        return _world.default_group
+    if mesh is None:
+        devs = np.array(_mesh_devices())
+        mesh = jax.sharding.Mesh(devs, ("world",))
+    _world.mesh = mesh
+    _world.world_size = int(np.prod([mesh.shape[a] for a in mesh.axis_names]))
+    _world.rank = _process_rank()
+    g = Group(tuple(mesh.axis_names), _world.world_size, name="world")
+    _world.default_group = g
+    _world.groups[0] = g
+    _world.initialized = True
+    return g
+
+
+def _process_rank() -> int:
+    try:
+        return jax.process_index()
+    except Exception:
+        return 0
+
+
+def is_initialized() -> bool:
+    return _world.initialized
+
+
+def get_world_mesh() -> Optional[jax.sharding.Mesh]:
+    return _world.mesh
+
+
+def get_rank(group: Optional[Group] = None):
+    if in_spmd_region():
+        g = group or _world.default_group
+        if g is not None and g.axis_names:
+            return axis_index(g.axis_names)
+    return _world.rank
+
+
+def get_world_size(group: Optional[Group] = None) -> int:
+    if group is not None:
+        return group.nranks
+    return _world.world_size
+
+
+def get_group(gid: int = 0) -> Group:
+    return _world.groups.get(gid, _world.default_group)
+
+
+def new_group(ranks=None, backend=None, timeout=None,
+              axis_names: Optional[Sequence[str]] = None,
+              nranks: Optional[int] = None, name: str = "") -> Group:
+    """Create a subgroup. TPU-native: subgroups are mesh axes; ``ranks``
+    lists are accepted for API parity (the topology layer translates rank
+    lists into axes when building the hybrid mesh)."""
+    if axis_names is not None:
+        mesh = _world.mesh
+        n = nranks or int(np.prod([mesh.shape[a] for a in axis_names])) \
+            if mesh is not None else (nranks or 1)
+        g = Group(tuple(axis_names), n, name=name)
+    else:
+        n = len(ranks) if ranks else _world.world_size
+        g = Group((), n, name=name or f"ranks{ranks}")
+        g._ranks = list(ranks) if ranks else list(range(n))
+    _world.groups[g.id] = g
+    return g
+
+
+def split_group(parent: Group, every: int) -> Group:
+    raise NotImplementedError
+
+
+# ---------------------------------------------------------------------------
+# SPMD region context
+# ---------------------------------------------------------------------------
+
+
+@contextlib.contextmanager
+def spmd_region(mesh: Optional[jax.sharding.Mesh] = None):
+    """Marks that the code is being traced inside jax.shard_map, so
+    collectives emit XLA collective ops with axis names."""
+    prev = getattr(_spmd, "depth", 0)
+    _spmd.depth = prev + 1
+    try:
+        yield
+    finally:
+        _spmd.depth = prev
+
+
+def in_spmd_region() -> bool:
+    return getattr(_spmd, "depth", 0) > 0
+
+
+def axis_index(axis_names: Tuple[str, ...]):
+    """Linearised rank within the (possibly multi-axis) group."""
+    idx = lax.axis_index(axis_names[0])
+    for a in axis_names[1:]:
+        idx = idx * lax.axis_size(a) + lax.axis_index(a)
+    return idx
+
+
+# ---------------------------------------------------------------------------
+# Collective kernels (registered ops so autograd records them; analog of
+# phi collective kernels phi/kernels/gpu/all_reduce_kernel.cu etc.)
+# ---------------------------------------------------------------------------
+
+
+def _psum_like(x, op: int, axes):
+    if op == ReduceOp.SUM:
+        return lax.psum(x, axes)
+    if op == ReduceOp.MAX:
+        return lax.pmax(x, axes)
+    if op == ReduceOp.MIN:
+        return lax.pmin(x, axes)
+    if op == ReduceOp.AVG:
+        return lax.pmean(x, axes)
+    if op == ReduceOp.PROD:
+        return jnp.exp(lax.psum(jnp.log(x), axes))
+    raise ValueError(f"bad reduce op {op}")
+
+
+@def_op("c_allreduce")
+def _c_allreduce(x, op=0, axes=()):
+    return _psum_like(x, op, axes)
+
+
+@def_op("c_allgather")
+def _c_allgather(x, axes=(), axis=0):
+    return lax.all_gather(x, axes, axis=axis, tiled=True)
+
+
+@def_op("c_reducescatter")
+def _c_reducescatter(x, axes=(), axis=0):
+    return lax.psum_scatter(x, axes, scatter_dimension=axis, tiled=True)
+
+
+@def_op("c_alltoall")
+def _c_alltoall(x, axes=(), split_axis=0, concat_axis=0):
+    return lax.all_to_all(x, axes, split_axis=split_axis,
+                          concat_axis=concat_axis, tiled=True)
+
+
+@def_op("c_broadcast")
+def _c_broadcast(x, axes=(), src=0):
+    # broadcast = select src's value on every member
+    idx = axis_index(axes)
+    masked = jnp.where(idx == src, x, jnp.zeros_like(x))
+    return lax.psum(masked, axes)
+
+
+@def_op("c_ppermute")
+def _c_ppermute(x, axes=(), perm=()):
+    return lax.ppermute(x, axes[0] if len(axes) == 1 else axes,
+                        perm=[tuple(p) for p in perm])
+
+
+# ---------------------------------------------------------------------------
+# Public API (python/paddle/distributed/communication parity)
+# ---------------------------------------------------------------------------
+
+
+def _group_axes(group: Optional[Group]):
+    g = group or _world.default_group
+    if g is None or not g.axis_names:
+        return None
+    return g.axis_names
+
+
+def _noop(tensor):
+    return tensor
+
+
+def all_reduce(tensor: Tensor, op: int = ReduceOp.SUM,
+               group: Optional[Group] = None, sync_op: bool = True):
+    axes = _group_axes(group)
+    if not in_spmd_region() or axes is None:
+        return tensor  # world of 1 (or outside SPMD): identity
+    out = _c_allreduce(tensor, op=op, axes=axes)
+    tensor._value = out._value
+    tensor._grad_node = out._grad_node
+    tensor._out_idx = out._out_idx
+    tensor.stop_gradient = out.stop_gradient
+    return tensor
+
+
+def all_reduce_mean_value(tensor: Tensor, group: Optional[Group] = None):
+    axes = _group_axes(group)
+    if not in_spmd_region() or axes is None:
+        return tensor
+    return _c_allreduce(tensor, op=ReduceOp.AVG, axes=axes)
+
+
+def all_gather(tensor_list: Optional[List], tensor: Tensor = None,
+               group: Optional[Group] = None, sync_op: bool = True, axis=0):
+    """paddle signature: all_gather(tensor_list, tensor). Returns stacked
+    result; also fills tensor_list if given."""
+    if tensor is None:
+        tensor, tensor_list = tensor_list, None
+    axes = _group_axes(group)
+    if not in_spmd_region() or axes is None:
+        if tensor_list is not None:
+            tensor_list.append(tensor)
+        return tensor
+    out = _c_allgather(tensor, axes=axes, axis=axis)
+    if tensor_list is not None:
+        n = (group or _world.default_group).nranks
+        from ..ops.manipulation import split as _split
+
+        tensor_list.extend(_split(out, n, axis=0))
+    return out
+
+
+def reduce_scatter(tensor: Tensor, tensor_or_tensor_list=None, op=ReduceOp.SUM,
+                   group: Optional[Group] = None, sync_op=True, axis=0):
+    axes = _group_axes(group)
+    src = tensor_or_tensor_list if tensor_or_tensor_list is not None else tensor
+    if isinstance(src, (list, tuple)):
+        from ..ops.manipulation import concat as _concat
+
+        src = _concat(list(src), axis=axis)
+    if not in_spmd_region() or axes is None:
+        return src
+    return _c_reducescatter(src, axes=axes, axis=axis)
+
+
+def all_to_all(out_tensor_list, in_tensor_list=None,
+               group: Optional[Group] = None, sync_op: bool = True):
+    """List-form paddle API; also accepts a single stacked tensor."""
+    single = not isinstance(out_tensor_list, list) or in_tensor_list is None
+    if in_tensor_list is None:
+        x = out_tensor_list
+    else:
+        from ..ops.manipulation import concat as _concat
+
+        x = _concat(list(in_tensor_list), axis=0) if isinstance(
+            in_tensor_list, (list, tuple)) else in_tensor_list
+    axes = _group_axes(group)
+    if in_spmd_region() and axes is not None:
+        out = _c_alltoall(x, axes=axes, split_axis=0, concat_axis=0)
+    else:
+        out = x
+    if isinstance(out_tensor_list, list) and in_tensor_list is not None:
+        n = (group or _world.default_group).nranks
+        from ..ops.manipulation import split as _split
+
+        out_tensor_list.clear()
+        out_tensor_list.extend(_split(out, n, axis=0))
+    return out
+
+
+def alltoall(in_tensor_list, out_tensor_list=None, group=None, sync_op=True):
+    return all_to_all(out_tensor_list if out_tensor_list is not None
+                      else in_tensor_list,
+                      in_tensor_list if out_tensor_list is not None else None,
+                      group=group, sync_op=sync_op)
+
+
+def broadcast(tensor: Tensor, src: int = 0, group: Optional[Group] = None,
+              sync_op: bool = True):
+    axes = _group_axes(group)
+    if not in_spmd_region() or axes is None:
+        return tensor
+    out = _c_broadcast(tensor, axes=axes, src=int(src))
+    tensor._value = out._value
+    tensor._grad_node = out._grad_node
+    tensor._out_idx = out._out_idx
+    return tensor
+
+
+def reduce(tensor: Tensor, dst: int = 0, op=ReduceOp.SUM,
+           group: Optional[Group] = None, sync_op: bool = True):
+    # SPMD model has no single-destination buffers; reduce == allreduce
+    # with non-dst members free to ignore (XLA DCE removes unused copies).
+    return all_reduce(tensor, op=op, group=group)
+
+
+def scatter(tensor: Tensor, tensor_list=None, src: int = 0,
+            group: Optional[Group] = None, sync_op: bool = True):
+    axes = _group_axes(group)
+    if not in_spmd_region() or axes is None:
+        if tensor_list:
+            tensor._value = tensor_list[0]._value
+        return tensor
+    from ..ops.manipulation import concat as _concat, split as _split
+
+    stacked = _concat(list(tensor_list), axis=0) if tensor_list else tensor
+    stacked = _c_broadcast(stacked, axes=axes, src=int(src))
+    n = (group or _world.default_group).nranks
+    idx = axis_index(axes)
+    chunk = stacked.shape[0] // n
+    out = _dynamic_chunk(stacked, idx, chunk=chunk)
+    tensor._value = out._value
+    return tensor
+
+
+@def_op("c_dynamic_chunk")
+def _dynamic_chunk(x, idx, chunk=1):
+    return lax.dynamic_slice_in_dim(x, idx * chunk, chunk, axis=0)
+
+
+def ppermute(tensor: Tensor, perm: List[Tuple[int, int]],
+             group: Optional[Group] = None):
+    """Collective-permute: the TPU-native p2p primitive (ICI neighbor
+    exchange). This is what pipeline send/recv lowers to (reference
+    analog: fleet pp_utils/p2p_communication.py over NCCL send/recv)."""
+    axes = _group_axes(group)
+    if not in_spmd_region() or axes is None:
+        return tensor
+    return _c_ppermute(tensor, axes=axes, perm=tuple(tuple(p) for p in perm))
+
+
+def send(tensor: Tensor, dst: int = 0, group: Optional[Group] = None,
+         sync_op: bool = True):
+    raise PreconditionNotMetError(
+        "point-to-point send/recv are expressed as ppermute pairs in the "
+        "SPMD model; use paddle_tpu.distributed.ppermute or the pipeline "
+        "p2p helpers (fleet.meta_parallel.pp_utils)")
+
+
+recv = send
+isend = send
+irecv = send
+
+
+def barrier(group: Optional[Group] = None):
+    if not in_spmd_region():
+        # host-level barrier: all queued device work done
+        jnp.zeros(()).block_until_ready()
+        return
+    return None
+
+
+def wait(tensor, group=None, use_calc_stream=True):
+    return tensor
+
+
+def all_gather_object(object_list, obj, group=None):
+    object_list.append(obj)
+    return object_list
+
+
+class stream:
+    """paddle.distributed.stream.* parity namespace (the reference exposes
+    stream-variant collectives; on TPU XLA owns streams so these are the
+    same ops)."""
+
+    all_reduce = staticmethod(all_reduce)
+    all_gather = staticmethod(all_gather)
+    reduce_scatter = staticmethod(reduce_scatter)
+    alltoall = staticmethod(alltoall)
+    broadcast = staticmethod(broadcast)
+    reduce = staticmethod(reduce)

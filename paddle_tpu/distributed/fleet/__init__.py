@@ -1,0 +1,84 @@
+"""Fleet: the hybrid-parallel user API.
+
+(reference: python/paddle/distributed/fleet/fleet.py:167 fleet.init →
+_init_hybrid_parallel_env at fleet.py:603; model.py:32 distributed_model;
+HybridParallelOptimizer in meta_optimizers/dygraph_optimizer/.)
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from .base.distributed_strategy import DistributedStrategy
+from .base.topology import CommunicateTopology, HybridCommunicateGroup
+
+__all__ = ["init", "DistributedStrategy", "HybridCommunicateGroup",
+           "CommunicateTopology", "get_hybrid_communicate_group",
+           "distributed_model", "distributed_optimizer", "fleet"]
+
+_fleet_state = {"initialized": False, "hcg": None, "strategy": None}
+
+
+def init(role_maker=None, is_collective: bool = True,
+         strategy: Optional[DistributedStrategy] = None, log_level="INFO"):
+    """fleet.init analog: builds the hybrid mesh + HCG from
+    strategy.hybrid_configs (reference fleet.py:603
+    _init_hybrid_parallel_env)."""
+    strategy = strategy or DistributedStrategy()
+    hc = strategy.hybrid_configs
+    hcg = HybridCommunicateGroup(
+        dp_degree=hc.get("dp_degree", 1), mp_degree=hc.get("mp_degree", 1),
+        pp_degree=hc.get("pp_degree", 1),
+        sharding_degree=hc.get("sharding_degree", 1),
+        sep_degree=hc.get("sep_degree", 1),
+        order=list(hc.get("order", ["dp", "pp", "sharding", "sep", "mp"])))
+    _fleet_state["initialized"] = True
+    _fleet_state["hcg"] = hcg
+    _fleet_state["strategy"] = strategy
+    return hcg
+
+
+def get_hybrid_communicate_group() -> Optional[HybridCommunicateGroup]:
+    return _fleet_state["hcg"]
+
+
+def get_strategy() -> Optional[DistributedStrategy]:
+    return _fleet_state["strategy"]
+
+
+def is_initialized() -> bool:
+    return _fleet_state["initialized"]
+
+
+def distributed_model(model):
+    """(reference: fleet/model.py:32,132-160 — wraps by active strategy:
+    pure-dp → DataParallel; pp → PipelineParallel; tp → TensorParallel.)"""
+    from .meta_parallel import wrap_distributed_model
+
+    return wrap_distributed_model(model, _fleet_state["hcg"],
+                                  _fleet_state["strategy"])
+
+
+def distributed_optimizer(optimizer, strategy=None):
+    from .meta_optimizers import HybridParallelOptimizer
+
+    return HybridParallelOptimizer(optimizer, _fleet_state["hcg"],
+                                   strategy or _fleet_state["strategy"])
+
+
+class _FleetNamespace:
+    """Allows `from paddle_tpu.distributed import fleet; fleet.init(...)`
+    plus attribute-style access used by reference code."""
+
+    init = staticmethod(init)
+    distributed_model = staticmethod(distributed_model)
+    distributed_optimizer = staticmethod(distributed_optimizer)
+    get_hybrid_communicate_group = staticmethod(get_hybrid_communicate_group)
+
+    @property
+    def worker_num(self):
+        from .. import collective as C
+
+        return C.get_world_size()
+
+
+fleet = _FleetNamespace()

@@ -1,0 +1,66 @@
+"""DistributedStrategy (reference:
+python/paddle/distributed/fleet/base/distributed_strategy.py — protobuf-
+backed config; hybrid_configs at :1808. Plain-python here, same keys.)
+"""
+from __future__ import annotations
+
+from typing import Any, Dict
+
+__all__ = ["DistributedStrategy"]
+
+_HYBRID_DEFAULTS = {
+    "dp_degree": 1,
+    "mp_degree": 1,
+    "pp_degree": 1,
+    "sharding_degree": 1,
+    "sep_degree": 1,
+    "order": ["dp", "pp", "sharding", "sep", "mp"],
+    "mp_configs": {},
+    "pp_configs": {},
+}
+
+
+class _SubConfig(dict):
+    __getattr__ = dict.get
+
+    def __setattr__(self, k, v):
+        self[k] = v
+
+
+class DistributedStrategy:
+    def __init__(self):
+        self._hybrid_configs: Dict[str, Any] = dict(_HYBRID_DEFAULTS)
+        self.pipeline_configs: Dict[str, Any] = {
+            "micro_batch_size": 1, "accumulate_steps": 1}
+        self.amp = False
+        self.amp_configs: Dict[str, Any] = {}
+        self.recompute = False
+        self.recompute_configs: Dict[str, Any] = {}
+        self.sharding = False
+        self.sharding_configs: Dict[str, Any] = {}
+        self.gradient_merge = False
+        self.gradient_merge_configs: Dict[str, Any] = {}
+        self.lamb = False
+        self.dgc = False
+        self.heter_ccl_mode = False
+        self.find_unused_parameters = False
+        self.tensor_parallel = False
+        self.tensor_parallel_configs: Dict[str, Any] = {}
+        self.gradient_scale_configs: Dict[str, Any] = {"scale_strategy": "avg"}
+
+    @property
+    def hybrid_configs(self):
+        return self._hybrid_configs
+
+    @hybrid_configs.setter
+    def hybrid_configs(self, configs: Dict[str, Any]):
+        for k, v in configs.items():
+            if k in ("mp_configs", "pp_configs") and isinstance(v, dict):
+                merged = _SubConfig(self._hybrid_configs.get(k, {}))
+                merged.update(v)
+                self._hybrid_configs[k] = merged
+            else:
+                self._hybrid_configs[k] = v
+
+    def __repr__(self):
+        return f"DistributedStrategy(hybrid={self._hybrid_configs})"

@@ -1,0 +1,179 @@
+"""Hybrid-parallel topology over the TPU mesh.
+
+(reference: python/paddle/distributed/fleet/base/topology.py:178
+CommunicateTopology + HybridCommunicateGroup, axis order
+["dp", "pp", "sharding", "sep", "mp"], per-axis comm groups created via
+paddle.distributed.new_group at topology.py:208-233.)
+
+TPU-native: the topology IS a jax.sharding.Mesh whose named axes are the
+parallelism dimensions. Each comm "group" is just the axis name —
+collectives on it lower to XLA collectives over ICI. Axis order maps the
+innermost (fastest-varying, physically-adjacent chips) axis to 'mp',
+exactly like the reference puts mp innermost for NVLink locality.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+import jax
+
+from ... import collective as C
+
+__all__ = ["CommunicateTopology", "HybridCommunicateGroup"]
+
+_DEFAULT_ORDER = ["dp", "pp", "sharding", "sep", "mp"]
+
+
+class CommunicateTopology:
+    def __init__(self, hybrid_group_names: List[str] = None,
+                 dims: List[int] = None, order: List[str] = None):
+        self._parallel_names = hybrid_group_names or _DEFAULT_ORDER
+        self._dims = dims or [1] * len(self._parallel_names)
+        self._order = order or self._parallel_names
+
+    def get_hybrid_group_names(self):
+        return self._parallel_names
+
+    def get_dim(self, axis_name: str) -> int:
+        return self._dims[self._parallel_names.index(axis_name)]
+
+    get_dim_size = get_dim
+
+    def world_size(self) -> int:
+        return int(np.prod(self._dims))
+
+
+class HybridCommunicateGroup:
+    def __init__(self, topology: Optional[CommunicateTopology] = None,
+                 dp_degree: int = 1, mp_degree: int = 1, pp_degree: int = 1,
+                 sharding_degree: int = 1, sep_degree: int = 1,
+                 order: Optional[List[str]] = None,
+                 devices: Optional[list] = None):
+        if topology is not None:
+            degrees = {n: topology.get_dim(n)
+                       for n in topology.get_hybrid_group_names()}
+            dp_degree = degrees.get("dp", 1)
+            mp_degree = degrees.get("mp", 1)
+            pp_degree = degrees.get("pp", 1)
+            sharding_degree = degrees.get("sharding", 1)
+            sep_degree = degrees.get("sep", 1)
+        self._dp_degree = dp_degree
+        self._mp_degree = mp_degree
+        self._pp_degree = pp_degree
+        self._sharding_degree = sharding_degree
+        self._sep_degree = sep_degree
+        self._order = order or _DEFAULT_ORDER
+        self._topo = topology or CommunicateTopology(
+            self._order, [self._degree_of(n) for n in self._order])
+
+        total = (dp_degree * mp_degree * pp_degree * sharding_degree *
+                 sep_degree)
+        devs = devices if devices is not None else jax.devices()
+        if total > len(devs):
+            raise ValueError(
+                f"hybrid degrees product {total} > visible devices "
+                f"{len(devs)}")
+        shape = tuple(self._degree_of(n) for n in self._order)
+        mesh_devs = np.array(devs[:total]).reshape(shape)
+        self.mesh = jax.sharding.Mesh(mesh_devs, tuple(self._order))
+        C.init_parallel_env(self.mesh)
+
+        self._groups: Dict[str, C.Group] = {}
+        for name in self._order:
+            self._groups[name] = C.new_group(
+                axis_names=(name,), nranks=self._degree_of(name), name=name)
+        # dp+sharding fused group for grad sync in sharding mode
+        self._groups["dp_sharding"] = C.new_group(
+            axis_names=("dp", "sharding"),
+            nranks=dp_degree * sharding_degree, name="dp_sharding")
+        self._groups["world"] = C.get_group(0)
+
+    def _degree_of(self, name: str) -> int:
+        return {"dp": self._dp_degree, "mp": self._mp_degree,
+                "pp": self._pp_degree, "sharding": self._sharding_degree,
+                "sep": self._sep_degree}[name]
+
+    # -- degrees (reference API parity) ---------------------------------
+    def get_data_parallel_world_size(self):
+        return self._dp_degree
+
+    def get_model_parallel_world_size(self):
+        return self._mp_degree
+
+    def get_pipe_parallel_world_size(self):
+        return self._pp_degree
+
+    def get_sharding_parallel_world_size(self):
+        return self._sharding_degree
+
+    def get_sep_parallel_world_size(self):
+        return self._sep_degree
+
+    # -- ranks: traced inside SPMD region -------------------------------
+    def get_data_parallel_rank(self):
+        return self._axis_rank("dp")
+
+    def get_model_parallel_rank(self):
+        return self._axis_rank("mp")
+
+    def get_stage_id(self):
+        return self._axis_rank("pp")
+
+    def get_sharding_parallel_rank(self):
+        return self._axis_rank("sharding")
+
+    def get_sep_parallel_rank(self):
+        return self._axis_rank("sep")
+
+    def _axis_rank(self, name):
+        if C.in_spmd_region():
+            from jax import lax
+
+            return lax.axis_index(name)
+        return 0
+
+    # -- groups ---------------------------------------------------------
+    def get_data_parallel_group(self):
+        return self._groups["dp"]
+
+    def get_model_parallel_group(self):
+        return self._groups["mp"]
+
+    def get_pipe_parallel_group(self):
+        return self._groups["pp"]
+
+    def get_sharding_parallel_group(self):
+        return self._groups["sharding"]
+
+    def get_sep_parallel_group(self):
+        return self._groups["sep"]
+
+    def get_check_parallel_group(self, *a):
+        return self._groups["world"]
+
+    def get_data_parallel_group_src_rank(self):
+        return 0
+
+    def get_model_parallel_group_src_rank(self):
+        return 0
+
+    # -- pipeline helpers ------------------------------------------------
+    def is_first_stage(self):
+        return self.get_stage_id() == 0 if not C.in_spmd_region() else None
+
+    def is_last_stage(self):
+        return (self.get_stage_id() == self._pp_degree - 1
+                if not C.in_spmd_region() else None)
+
+    @property
+    def topology(self):
+        return self._topo
+
+    def get_rank_from_stage(self, stage_id, **kwargs):
+        return stage_id
+
+    def __repr__(self):
+        return (f"HCG(dp={self._dp_degree}, pp={self._pp_degree}, "
+                f"sharding={self._sharding_degree}, sep={self._sep_degree}, "
+                f"mp={self._mp_degree}, order={self._order})")

@@ -1,0 +1,19 @@
+"""Framework utilities: ParamAttr, save/load, random seeds.
+
+(reference: python/paddle/framework/*)
+"""
+from . import io  # noqa: F401
+from .param_attr import ParamAttr  # noqa: F401
+from ..core.rng import seed  # noqa: F401
+
+
+def get_default_dtype():
+    from ..core.dtype import get_default_dtype as g
+
+    return g()
+
+
+def set_default_dtype(d):
+    from ..core.dtype import set_default_dtype as s
+
+    return s(d)

@@ -1,0 +1,62 @@
+"""paddle.save / paddle.load analog.
+
+(reference: python/paddle/framework/io.py:721,960 — pickle-protocol
+serialization of state_dicts with tensors converted to ndarray.)
+"""
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Any
+
+import numpy as np
+
+from ..tensor import Tensor
+
+__all__ = ["save", "load"]
+
+_PROTOCOL = 4
+
+
+def _to_serializable(obj: Any) -> Any:
+    if isinstance(obj, Tensor):
+        return {"__paddle_tpu_tensor__": True,
+                "data": np.asarray(obj._value),
+                "stop_gradient": obj.stop_gradient,
+                "name": obj.name}
+    if isinstance(obj, dict):
+        return {k: _to_serializable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = type(obj)
+        return t(_to_serializable(v) for v in obj)
+    return obj
+
+
+def _from_serializable(obj: Any, return_numpy: bool = False) -> Any:
+    if isinstance(obj, dict):
+        if obj.get("__paddle_tpu_tensor__"):
+            if return_numpy:
+                return obj["data"]
+            t = Tensor(__import__("jax.numpy", fromlist=["asarray"]).asarray(
+                obj["data"]), stop_gradient=obj.get("stop_gradient", True))
+            t.name = obj.get("name", "")
+            return t
+        return {k: _from_serializable(v, return_numpy) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = type(obj)
+        return t(_from_serializable(v, return_numpy) for v in obj)
+    return obj
+
+
+def save(obj: Any, path: str, protocol: int = _PROTOCOL, **configs) -> None:
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(_to_serializable(obj), f, protocol=protocol)
+
+
+def load(path: str, return_numpy: bool = False, **configs) -> Any:
+    with open(path, "rb") as f:
+        obj = pickle.load(f)
+    return _from_serializable(obj, return_numpy=return_numpy)

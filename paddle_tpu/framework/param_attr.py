@@ -1,0 +1,33 @@
+"""ParamAttr (paddle.ParamAttr analog).
+
+(reference: python/paddle/base/param_attr.py — bundles name/initializer/
+learning_rate/regularizer/trainable for create_parameter.)
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+__all__ = ["ParamAttr"]
+
+
+class ParamAttr:
+    def __init__(self, name: Optional[str] = None, initializer=None,
+                 learning_rate: float = 1.0, regularizer=None,
+                 trainable: bool = True, do_model_average: bool = True,
+                 need_clip: bool = True):
+        self.name = name
+        self.initializer = initializer
+        self.learning_rate = learning_rate
+        self.regularizer = regularizer
+        self.trainable = trainable
+        self.do_model_average = do_model_average
+        self.need_clip = need_clip
+
+    @staticmethod
+    def _to_attr(arg):
+        if arg is None or isinstance(arg, ParamAttr) or arg is False:
+            return arg
+        if isinstance(arg, str):
+            return ParamAttr(name=arg)
+        # assume initializer
+        return ParamAttr(initializer=arg)

@@ -1,0 +1,172 @@
+"""JIT: whole-graph XLA compilation of eager code.
+
+(reference: python/paddle/jit/ — dy2static AST transpiler + SOT bytecode
+JIT at jit/sot/; api.py:135 ``to_static``. The TPU-native replacement is
+radically simpler: because every eager op is a traceable JAX call and the
+autograd tape records through Tracers, ``to_static`` just wraps the
+function in jax.jit — forward, backward(), and optimizer.step() all trace
+into ONE fused XLA program. No bytecode analysis needed; Python control
+flow is handled by tracing per input-shape like SOT's guard system,
+falling back to retrace on new shapes.)
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Optional
+
+import jax
+import numpy as np
+import jax.numpy as jnp
+
+from ..tensor import Parameter, Tensor
+from ..nn.layer import Layer
+
+__all__ = ["to_static", "not_to_static", "TracedStep", "save", "load"]
+
+
+def _unwrap(x):
+    return x._value if isinstance(x, Tensor) else x
+
+
+def _is_arraylike(x):
+    return isinstance(x, (Tensor, jax.Array, np.ndarray))
+
+
+class TracedStep:
+    """Compile an eager train/eval step into a single XLA executable.
+
+    The wrapped function may freely mutate Parameters (optimizer updates,
+    BN running stats): all Tensors reachable from ``trackables`` are treated
+    as implicit state — passed in as traced inputs and their new values
+    returned as traced outputs, then written back. This is the
+    donate-buffers functional fixpoint the reference gets from its static
+    graph Program, achieved here without one.
+    """
+
+    def __init__(self, fn: Callable, trackables=None, donate_state: bool = True):
+        self._fn = fn
+        self._trackables = trackables or []
+        self._donate = donate_state
+        self._compiled = {}
+        self._state_tensors: Optional[list] = None
+
+    def _collect_state(self):
+        tensors = []
+        seen = set()
+
+        def add(t):
+            if isinstance(t, Tensor) and id(t) not in seen:
+                seen.add(id(t))
+                tensors.append(t)
+
+        for obj in self._trackables:
+            if isinstance(obj, Layer):
+                for _, p in obj.named_parameters():
+                    add(p)
+                for _, b in obj.named_buffers():
+                    add(b)
+            elif isinstance(obj, Tensor):
+                add(obj)
+            elif hasattr(obj, "_parameter_list"):  # Optimizer
+                opt = obj
+                for p in (opt._parameter_list or []):
+                    add(p)
+        return tensors
+
+    def __call__(self, *args, **kwargs):
+        from ..core import rng
+
+        if self._state_tensors is None:
+            self._state_tensors = self._collect_state()
+        state_tensors = self._state_tensors
+
+        # optimizer states live outside tensors; snapshot via closure below
+        opts = [o for o in self._trackables if hasattr(o, "_states")]
+
+        def pure_step(state_values, opt_states, rng_seed, arg_values):
+            # install traced values into the real objects, run, harvest
+            old = [t._value for t in state_tensors]
+            old_states = [dict(o._states) for o in opts]
+            for t, v in zip(state_tensors, state_values):
+                t._value = v
+            for o, s in zip(opts, opt_states):
+                o._states = dict(s)
+            try:
+                with rng.fork_traced(rng_seed):
+                    wrapped = jax.tree_util.tree_map(
+                        lambda x: Tensor(x) if isinstance(
+                            x, (jax.Array, jax.core.Tracer)) else x,
+                        arg_values)
+                    out = self._fn(*wrapped[0], **wrapped[1])
+                new_state = [t._value for t in state_tensors]
+                new_opt_states = [dict(o._states) for o in opts]
+                out_vals = jax.tree_util.tree_map(
+                    _unwrap, out, is_leaf=lambda x: isinstance(x, Tensor))
+                return out_vals, new_state, new_opt_states
+            finally:
+                for t, v in zip(state_tensors, old):
+                    t._value = v
+                for o, s in zip(opts, old_states):
+                    o._states = s
+
+        key = "default"
+        if key not in self._compiled:
+            self._compiled[key] = jax.jit(pure_step)
+        arg_values = jax.tree_util.tree_map(
+            _unwrap, (args, kwargs), is_leaf=lambda x: isinstance(x, Tensor))
+        state_values = [t._value for t in state_tensors]
+        opt_states = [dict(o._states) for o in opts]
+        seed = rng.get_key()
+        seed32 = jax.random.randint(seed, (), 0, 2**31 - 1, jnp.int32).astype(
+            jnp.uint32)
+        out_vals, new_state, new_opt_states = self._compiled[key](
+            state_values, opt_states, seed32, arg_values)
+        for t, v in zip(state_tensors, new_state):
+            t._value = v
+        for o, s in zip(opts, new_opt_states):
+            o._states = s
+        return jax.tree_util.tree_map(
+            lambda v: Tensor(v) if isinstance(v, jax.Array) else v, out_vals)
+
+
+def to_static(function=None, input_spec=None, build_strategy=None,
+              backend=None, trackables=None, **kwargs):
+    """paddle.jit.to_static analog: returns a compiled callable.
+
+    For a Layer, wraps its forward (inference-style). For a function that
+    mutates state (train step), pass ``trackables=[model, optimizer]`` so
+    state threading is handled (see TracedStep).
+    """
+
+    def deco(fn):
+        if isinstance(fn, Layer):
+            layer = fn
+            inner_forward = layer.forward
+            step = TracedStep(lambda *a, **k: inner_forward(*a, **k),
+                              trackables=[layer] + list(trackables or []))
+            layer._traced_call = step
+            layer.forward = step  # instance attr shadows the method
+            return layer
+        return TracedStep(fn, trackables=trackables)
+
+    if function is not None:
+        return deco(function)
+    return deco
+
+
+def not_to_static(fn):
+    return fn
+
+
+def save(layer, path, input_spec=None, **configs):
+    """Persist a Layer's state (TranslatedLayer-style save: state only; the
+    program is re-traced at load — XLA recompiles from the same python)."""
+    from ..framework.io import save as fsave
+
+    fsave(layer.state_dict(), path + ".pdparams")
+
+
+def load(path, **configs):
+    from ..framework.io import load as fload
+
+    return fload(path + ".pdparams")

@@ -1,0 +1,149 @@
+"""Activation layers (reference: python/paddle/nn/layer/activation.py)."""
+from __future__ import annotations
+
+from ..framework.param_attr import ParamAttr
+from . import functional as F
+from . import initializer as I
+from .layer import Layer
+
+__all__ = ["ReLU", "ReLU6", "LeakyReLU", "ELU", "SELU", "CELU", "GELU",
+           "Silu", "Swish", "Mish", "Sigmoid", "Hardsigmoid", "Hardswish",
+           "Hardtanh", "Softplus", "Softsign", "Tanhshrink", "Hardshrink",
+           "Softshrink", "PReLU", "Softmax", "LogSoftmax", "Tanh", "GLU"]
+
+
+def _simple(fn_name, **fixed):
+    class _Act(Layer):
+        def __init__(self, name=None):
+            super().__init__()
+
+        def forward(self, x):
+            return getattr(F, fn_name)(x, **fixed)
+
+    return _Act
+
+
+ReLU = type("ReLU", (_simple("relu"),), {})
+ReLU6 = type("ReLU6", (_simple("relu6"),), {})
+SELU = type("SELU", (_simple("selu"),), {})
+Silu = type("Silu", (_simple("silu"),), {})
+Swish = type("Swish", (_simple("swish"),), {})
+Mish = type("Mish", (_simple("mish"),), {})
+Sigmoid = type("Sigmoid", (_simple("sigmoid"),), {})
+Hardsigmoid = type("Hardsigmoid", (_simple("hardsigmoid"),), {})
+Hardswish = type("Hardswish", (_simple("hardswish"),), {})
+Softsign = type("Softsign", (_simple("softsign"),), {})
+Tanhshrink = type("Tanhshrink", (_simple("tanhshrink"),), {})
+Tanh = type("Tanh", (_simple("tanh"),), {})
+
+
+class LeakyReLU(Layer):
+    def __init__(self, negative_slope=0.01, name=None):
+        super().__init__()
+        self.negative_slope = negative_slope
+
+    def forward(self, x):
+        return F.leaky_relu(x, negative_slope=float(self.negative_slope))
+
+
+class ELU(Layer):
+    def __init__(self, alpha=1.0, name=None):
+        super().__init__()
+        self.alpha = alpha
+
+    def forward(self, x):
+        return F.elu(x, alpha=float(self.alpha))
+
+
+class CELU(Layer):
+    def __init__(self, alpha=1.0, name=None):
+        super().__init__()
+        self.alpha = alpha
+
+    def forward(self, x):
+        return F.celu(x, alpha=float(self.alpha))
+
+
+class GELU(Layer):
+    def __init__(self, approximate=False, name=None):
+        super().__init__()
+        self.approximate = approximate
+
+    def forward(self, x):
+        return F.gelu(x, approximate=bool(self.approximate))
+
+
+class Hardtanh(Layer):
+    def __init__(self, min=-1.0, max=1.0, name=None):
+        super().__init__()
+        self.min, self.max = min, max
+
+    def forward(self, x):
+        return F.hardtanh(x, min=float(self.min), max=float(self.max))
+
+
+class Softplus(Layer):
+    def __init__(self, beta=1.0, threshold=20.0, name=None):
+        super().__init__()
+        self.beta, self.threshold = beta, threshold
+
+    def forward(self, x):
+        return F.softplus(x, beta=float(self.beta),
+                          threshold=float(self.threshold))
+
+
+class Hardshrink(Layer):
+    def __init__(self, threshold=0.5, name=None):
+        super().__init__()
+        self.threshold = threshold
+
+    def forward(self, x):
+        return F.hardshrink(x, threshold=float(self.threshold))
+
+
+class Softshrink(Layer):
+    def __init__(self, threshold=0.5, name=None):
+        super().__init__()
+        self.threshold = threshold
+
+    def forward(self, x):
+        return F.softshrink(x, threshold=float(self.threshold))
+
+
+class PReLU(Layer):
+    def __init__(self, num_parameters=1, init=0.25, weight_attr=None,
+                 data_format="NCHW", name=None):
+        super().__init__()
+        self.weight = self.create_parameter(
+            (num_parameters,), attr=ParamAttr._to_attr(weight_attr),
+            default_initializer=I.Constant(init))
+
+    def forward(self, x):
+        return F.prelu(x, self.weight)
+
+
+class Softmax(Layer):
+    def __init__(self, axis=-1, name=None):
+        super().__init__()
+        self.axis = axis
+
+    def forward(self, x):
+        return F.softmax(x, axis=int(self.axis))
+
+
+class LogSoftmax(Layer):
+    def __init__(self, axis=-1, name=None):
+        super().__init__()
+        self.axis = axis
+
+    def forward(self, x):
+        return F.log_softmax(x, axis=int(self.axis))
+
+
+class GLU(Layer):
+    def __init__(self, axis=-1, name=None):
+        super().__init__()
+        self.axis = axis
+
+    def forward(self, x):
+        return F.glu(x, axis=int(self.axis))

@@ -1,0 +1,57 @@
+"""Gradient clipping (reference: python/paddle/nn/clip.py).
+
+ClipGradByGlobalNorm matches the reference semantics (global norm across
+all grads, scale if above max). The actual arithmetic runs inside the
+optimizer's fused jitted update when possible.
+"""
+from __future__ import annotations
+
+from typing import List, Tuple
+
+import jax.numpy as jnp
+
+__all__ = ["ClipGradByGlobalNorm", "ClipGradByNorm", "ClipGradByValue"]
+
+
+class ClipGradBase:
+    def apply_values(self, grads: List):
+        """Operate on raw jax arrays (called inside jitted update)."""
+        raise NotImplementedError
+
+
+class ClipGradByGlobalNorm(ClipGradBase):
+    def __init__(self, clip_norm: float, group_name: str = "default_group",
+                 auto_skip_clip: bool = False):
+        self.clip_norm = float(clip_norm)
+
+    def apply_values(self, grads):
+        sq = sum(jnp.sum(jnp.square(g.astype(jnp.float32))) for g in grads)
+        global_norm = jnp.sqrt(sq)
+        scale = jnp.minimum(self.clip_norm / jnp.maximum(global_norm, 1e-6),
+                            1.0)
+        return [(g * scale).astype(g.dtype) for g in grads], global_norm
+
+    def __repr__(self):
+        return f"ClipGradByGlobalNorm(clip_norm={self.clip_norm})"
+
+
+class ClipGradByNorm(ClipGradBase):
+    def __init__(self, clip_norm: float):
+        self.clip_norm = float(clip_norm)
+
+    def apply_values(self, grads):
+        out = []
+        for g in grads:
+            n = jnp.sqrt(jnp.sum(jnp.square(g.astype(jnp.float32))))
+            scale = jnp.minimum(self.clip_norm / jnp.maximum(n, 1e-6), 1.0)
+            out.append((g * scale).astype(g.dtype))
+        return out, None
+
+
+class ClipGradByValue(ClipGradBase):
+    def __init__(self, max: float, min: float = None):
+        self.max = float(max)
+        self.min = float(min) if min is not None else -self.max
+
+    def apply_values(self, grads):
+        return [jnp.clip(g, self.min, self.max) for g in grads], None

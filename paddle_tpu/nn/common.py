@@ -1,0 +1,126 @@
+"""Common layers: Linear, Embedding, Dropout, etc.
+
+(reference: python/paddle/nn/layer/common.py)
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from ..framework.param_attr import ParamAttr
+from ..tensor import Parameter
+from . import functional as F
+from . import initializer as I
+from .layer import Layer
+
+__all__ = ["Linear", "Embedding", "Dropout", "Dropout2D", "Flatten",
+           "Identity", "Upsample", "UpsamplingBilinear2D", "PixelShuffle"]
+
+
+class Linear(Layer):
+    def __init__(self, in_features: int, out_features: int, weight_attr=None,
+                 bias_attr=None, name=None):
+        super().__init__()
+        self.in_features = in_features
+        self.out_features = out_features
+        self.weight = self.create_parameter(
+            (in_features, out_features), attr=ParamAttr._to_attr(weight_attr))
+        self.bias = self.create_parameter(
+            (out_features,), attr=ParamAttr._to_attr(bias_attr), is_bias=True)
+
+    def forward(self, x):
+        return F.linear(x, self.weight, self.bias)
+
+    def extra_repr(self):
+        return f"in_features={self.in_features}, out_features={self.out_features}"
+
+
+class Embedding(Layer):
+    def __init__(self, num_embeddings: int, embedding_dim: int,
+                 padding_idx: Optional[int] = None, sparse: bool = False,
+                 weight_attr=None, name=None):
+        super().__init__()
+        self._num_embeddings = num_embeddings
+        self._embedding_dim = embedding_dim
+        self._padding_idx = (padding_idx if padding_idx is None or padding_idx >= 0
+                             else num_embeddings + padding_idx)
+        attr = ParamAttr._to_attr(weight_attr)
+        self.weight = self.create_parameter(
+            (num_embeddings, embedding_dim), attr=attr,
+            default_initializer=None if (attr and attr.initializer) else I.Normal(0.0, 1.0))
+        if self._padding_idx is not None:
+            self.weight._value = self.weight._value.at[self._padding_idx].set(0.0)
+
+    def forward(self, x):
+        return F.embedding(x, self.weight, padding_idx=self._padding_idx)
+
+    def extra_repr(self):
+        return f"{self._num_embeddings}, {self._embedding_dim}"
+
+
+class Dropout(Layer):
+    def __init__(self, p: float = 0.5, axis=None, mode: str = "upscale_in_train",
+                 name=None):
+        super().__init__()
+        self.p = p
+        self.mode = mode
+
+    def forward(self, x):
+        return F.dropout(x, p=self.p, training=self.training, mode=self.mode)
+
+    def extra_repr(self):
+        return f"p={self.p}"
+
+
+class Dropout2D(Dropout):
+    pass
+
+
+class Flatten(Layer):
+    def __init__(self, start_axis: int = 1, stop_axis: int = -1):
+        super().__init__()
+        self.start_axis = start_axis
+        self.stop_axis = stop_axis
+
+    def forward(self, x):
+        from ..ops.manipulation import flatten
+
+        return flatten(x, start_axis=self.start_axis, stop_axis=self.stop_axis)
+
+
+class Identity(Layer):
+    def __init__(self, *args, **kwargs):
+        super().__init__()
+
+    def forward(self, x):
+        return x
+
+
+class Upsample(Layer):
+    def __init__(self, size=None, scale_factor=None, mode="nearest",
+                 align_corners=False, data_format="NCHW", name=None):
+        super().__init__()
+        self.size = size
+        self.scale_factor = scale_factor
+        self.mode = mode
+        self.align_corners = align_corners
+        self.data_format = data_format
+
+    def forward(self, x):
+        return F.interpolate(x, size=tuple(self.size) if self.size else None,
+                             scale_factor=self.scale_factor, mode=self.mode,
+                             align_corners=self.align_corners,
+                             data_format=self.data_format)
+
+
+class UpsamplingBilinear2D(Upsample):
+    def __init__(self, size=None, scale_factor=None, data_format="NCHW", name=None):
+        super().__init__(size, scale_factor, "bilinear", True, data_format)
+
+
+class PixelShuffle(Layer):
+    def __init__(self, upscale_factor: int, data_format="NCHW", name=None):
+        super().__init__()
+        self.upscale_factor = upscale_factor
+
+    def forward(self, x):
+        return F.pixel_shuffle(x, upscale_factor=self.upscale_factor)

@@ -1,0 +1,119 @@
+"""Functional NN API (paddle.nn.functional analog).
+
+Mostly re-exports the registered ops; stateful bits (dropout keys) are
+resolved here so the underlying kernels stay pure.
+(reference: python/paddle/nn/functional/*, incl. flash_attention.py:147.)
+"""
+from __future__ import annotations
+
+from ..core import rng
+from ..ops import nn_ops as _ops
+from ..ops.nn_ops import (  # noqa: F401
+    relu, relu6, leaky_relu, elu, selu, celu, gelu, silu, swish, mish,
+    sigmoid, hardsigmoid, hardswish, hardtanh, softplus, softsign,
+    tanhshrink, hardshrink, softshrink, prelu, glu, softmax, log_softmax,
+    linear, fused_gemm_epilogue,
+    conv1d, conv2d, conv2d_transpose,
+    max_pool2d, avg_pool2d, adaptive_avg_pool2d, adaptive_max_pool2d,
+    interpolate, unfold,
+    layer_norm, rms_norm, group_norm, instance_norm, batch_norm,
+    fused_layer_norm_residual,
+    softmax_with_cross_entropy, mse_loss, l1_loss, smooth_l1_loss, nll_loss,
+    binary_cross_entropy, binary_cross_entropy_with_logits, kl_div,
+    cosine_similarity, label_smooth, temporal_shift, pixel_shuffle,
+    fused_rope,
+)
+from ..ops.manipulation import one_hot, pad  # noqa: F401
+from ..ops.math import tanh  # noqa: F401
+
+__all__ = [n for n in dir() if not n.startswith("_")]
+
+
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train",
+            name=None):
+    if not training or p == 0.0:
+        return x
+    return _ops.dropout(x, rng.get_key(), p=float(p), training=True, mode=mode)
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
+    return dropout(x, p=p, training=training)
+
+
+def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    return _ops.embedding(x, weight, padding_idx=padding_idx)
+
+
+def cross_entropy(input, label, weight=None, ignore_index=-100,
+                  reduction="mean", soft_label=False, axis=-1,
+                  label_smoothing=0.0, use_softmax=True, name=None):
+    return _ops.cross_entropy_loss(
+        input, label, weight=weight, soft_label=bool(soft_label),
+        ignore_index=int(ignore_index), reduction=reduction, axis=int(axis),
+        label_smoothing=float(label_smoothing))
+
+
+def scaled_dot_product_attention(query, key, value, attn_mask=None,
+                                 dropout_p=0.0, is_causal=False,
+                                 training=True, name=None):
+    return _ops.scaled_dot_product_attention(
+        query, key, value, attn_mask=attn_mask,
+        dropout_p=float(dropout_p) if training else 0.0,
+        is_causal=bool(is_causal))
+
+
+def flash_attention(query, key, value, dropout=0.0, causal=False,
+                    return_softmax=False, fixed_seed_offset=None, rng_name="",
+                    training=True, name=None):
+    """paddle.nn.functional.flash_attention.flash_attention parity
+    (reference: python/paddle/nn/functional/flash_attention.py:147).
+    Layout [batch, seqlen, num_heads, head_dim]. On TPU this routes to the
+    Pallas flash kernel; XLA fallback otherwise."""
+    from ..ops import attention as _attn
+
+    out = _attn.flash_attention(query, key, value, causal=bool(causal),
+                                dropout=float(dropout) if training else 0.0)
+    if return_softmax:
+        return out, None
+    return out, None
+
+
+def flash_attn_unpadded(*args, **kwargs):
+    raise NotImplementedError("varlen flash attention lands with the Pallas "
+                              "paged-attention kernel")
+
+
+def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
+    from ..ops import math as _m
+
+    norm = _m.norm(x, p=float(p), axis=axis, keepdim=True)
+    return x / _m.clip(norm, min=epsilon)
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):
+    from ..ops import math as _m
+
+    return 0.0 - label * _m.log(input + epsilon) - (
+        1.0 - label) * _m.log(1.0 - input + epsilon)
+
+
+def square_error_cost(input, label):
+    return (input - label) * (input - label)
+
+
+def sequence_mask(lengths, maxlen=None, dtype="int64"):
+    import jax.numpy as jnp
+
+    from ..core.dispatch import def_op
+    return _sequence_mask(lengths, maxlen=maxlen, dtype=str(dtype))
+
+
+from ..core.dispatch import def_op as _def_op
+import jax.numpy as _jnp
+
+
+@_def_op("sequence_mask", differentiable=False)
+def _sequence_mask(lengths, maxlen=None, dtype="int64"):
+    m = maxlen if maxlen is not None else int(lengths.max())
+    ar = _jnp.arange(m)
+    return (ar[None, :] < lengths[:, None]).astype(_jnp.dtype(dtype) if dtype != "int64" else _jnp.int64)

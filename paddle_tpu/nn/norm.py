@@ -1,0 +1,225 @@
+"""Normalisation layers (reference: python/paddle/nn/layer/norm.py).
+
+BatchNorm keeps running stats as buffers updated functionally — under a
+traced train step the new stats come out as traced values and are written
+back to the buffer tensors (value-swap), so the whole step still compiles
+to one XLA program.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..framework.param_attr import ParamAttr
+from ..tensor import Tensor
+from . import functional as F
+from . import initializer as I
+from .layer import Layer
+
+__all__ = ["BatchNorm1D", "BatchNorm2D", "BatchNorm3D", "BatchNorm",
+           "LayerNorm", "RMSNorm", "GroupNorm", "InstanceNorm2D",
+           "SyncBatchNorm", "LocalResponseNorm"]
+
+
+class _BatchNormBase(Layer):
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-5,
+                 weight_attr=None, bias_attr=None, data_format="NCHW",
+                 use_global_stats=None, name=None):
+        super().__init__()
+        self._num_features = num_features
+        self._momentum = momentum
+        self._epsilon = epsilon
+        self._data_format = data_format
+        self._use_global_stats = use_global_stats
+        self.weight = self.create_parameter(
+            (num_features,), attr=ParamAttr._to_attr(weight_attr),
+            default_initializer=I.Constant(1.0))
+        self.bias = self.create_parameter(
+            (num_features,), attr=ParamAttr._to_attr(bias_attr), is_bias=True)
+        self.register_buffer("_mean", Tensor(jnp.zeros((num_features,),
+                                                       self._dtype)))
+        self.register_buffer("_variance", Tensor(jnp.ones((num_features,),
+                                                          self._dtype)))
+
+    def forward(self, x):
+        training = self.training and not self._use_global_stats
+        out, new_mean, new_var = F.batch_norm(
+            x, self._mean, self._variance, self.weight, self.bias,
+            training=bool(training), momentum=float(self._momentum),
+            epsilon=float(self._epsilon), data_format=self._data_format)
+        if training:
+            self._mean._value = new_mean._value
+            self._variance._value = new_var._value
+        return out
+
+    def extra_repr(self):
+        return f"num_features={self._num_features}, momentum={self._momentum}"
+
+
+class BatchNorm(_BatchNormBase):
+    pass
+
+
+class BatchNorm1D(_BatchNormBase):
+    pass
+
+
+class BatchNorm2D(_BatchNormBase):
+    pass
+
+
+class BatchNorm3D(_BatchNormBase):
+    pass
+
+
+class SyncBatchNorm(_BatchNormBase):
+    """Cross-replica BN: batch stats all-reduced over the data-parallel
+    group (reference: python/paddle/nn/layer/norm.py SyncBatchNorm backed by
+    sync_batch_norm CUDA kernel; here stats ride XLA psum when inside an
+    SPMD region)."""
+
+    def forward(self, x):
+        if not self.training:
+            return super().forward(x)
+        from ..distributed import collective as C
+
+        if not C.in_spmd_region():
+            return super().forward(x)
+        axes = (0, 2, 3) if x.ndim == 4 else ((0,) if x.ndim == 2 else (0, 2))
+        from ..ops import math as M
+
+        mean = M.mean(x, axis=axes)
+        meansq = M.mean(x * x, axis=axes)
+        mean = C.all_reduce_mean_value(mean)
+        meansq = C.all_reduce_mean_value(meansq)
+        var = meansq - mean * mean
+        inv = (var + self._epsilon) ** -0.5
+        shape = (1, -1) + (1,) * (x.ndim - 2)
+        out = (x - mean.reshape(shape)) * inv.reshape(shape)
+        if self.weight is not None:
+            out = out * self.weight.reshape(shape)
+        if self.bias is not None:
+            out = out + self.bias.reshape(shape)
+        self._mean._value = (self._momentum * self._mean._value
+                             + (1 - self._momentum) * mean._value)
+        self._variance._value = (self._momentum * self._variance._value
+                                 + (1 - self._momentum) * var._value)
+        return out
+
+    @classmethod
+    def convert_sync_batchnorm(cls, layer):
+        out = layer
+        if isinstance(layer, _BatchNormBase) and not isinstance(layer, SyncBatchNorm):
+            out = SyncBatchNorm(layer._num_features, layer._momentum,
+                                layer._epsilon, data_format=layer._data_format)
+            out.weight = layer.weight
+            out.bias = layer.bias
+            out._mean = layer._mean
+            out._variance = layer._variance
+        for name, sub in list(layer._sub_layers.items()):
+            out.add_sublayer(name, cls.convert_sync_batchnorm(sub))
+        return out
+
+
+class LayerNorm(Layer):
+    def __init__(self, normalized_shape, epsilon=1e-5, weight_attr=None,
+                 bias_attr=None, name=None):
+        super().__init__()
+        if isinstance(normalized_shape, int):
+            normalized_shape = (normalized_shape,)
+        self._normalized_shape = tuple(normalized_shape)
+        self._epsilon = epsilon
+        self.weight = (self.create_parameter(
+            self._normalized_shape, attr=ParamAttr._to_attr(weight_attr),
+            default_initializer=I.Constant(1.0))
+            if weight_attr is not False else None)
+        self.bias = (self.create_parameter(
+            self._normalized_shape, attr=ParamAttr._to_attr(bias_attr),
+            is_bias=True) if bias_attr is not False else None)
+
+    def forward(self, x):
+        begin = x.ndim - len(self._normalized_shape)
+        return F.layer_norm(x, self.weight, self.bias,
+                            epsilon=float(self._epsilon), begin_norm_axis=begin)
+
+    def extra_repr(self):
+        return f"normalized_shape={self._normalized_shape}"
+
+
+class RMSNorm(Layer):
+    """(reference kernel: phi/kernels/gpu/rms_norm_kernel.cu; used by the
+    Llama family via paddle.incubate.nn.functional.fused_rms_norm)."""
+
+    def __init__(self, normalized_shape, epsilon=1e-6, weight_attr=None,
+                 name=None):
+        super().__init__()
+        if isinstance(normalized_shape, int):
+            normalized_shape = (normalized_shape,)
+        self._normalized_shape = tuple(normalized_shape)
+        self._epsilon = epsilon
+        self.weight = self.create_parameter(
+            self._normalized_shape, attr=ParamAttr._to_attr(weight_attr),
+            default_initializer=I.Constant(1.0))
+
+    def forward(self, x):
+        begin = x.ndim - len(self._normalized_shape)
+        return F.rms_norm(x, self.weight, epsilon=float(self._epsilon),
+                          begin_norm_axis=begin)
+
+
+class GroupNorm(Layer):
+    def __init__(self, num_groups, num_channels, epsilon=1e-5,
+                 weight_attr=None, bias_attr=None, data_format="NCHW",
+                 name=None):
+        super().__init__()
+        self._num_groups = num_groups
+        self._epsilon = epsilon
+        self.weight = self.create_parameter(
+            (num_channels,), attr=ParamAttr._to_attr(weight_attr),
+            default_initializer=I.Constant(1.0))
+        self.bias = self.create_parameter(
+            (num_channels,), attr=ParamAttr._to_attr(bias_attr), is_bias=True)
+
+    def forward(self, x):
+        return F.group_norm(x, self.weight, self.bias,
+                            epsilon=float(self._epsilon),
+                            groups=self._num_groups)
+
+
+class InstanceNorm2D(Layer):
+    def __init__(self, num_features, epsilon=1e-5, momentum=0.9,
+                 weight_attr=None, bias_attr=None, data_format="NCHW",
+                 name=None):
+        super().__init__()
+        self._epsilon = epsilon
+        self.scale = self.create_parameter(
+            (num_features,), attr=ParamAttr._to_attr(weight_attr),
+            default_initializer=I.Constant(1.0))
+        self.bias = self.create_parameter(
+            (num_features,), attr=ParamAttr._to_attr(bias_attr), is_bias=True)
+
+    def forward(self, x):
+        return F.instance_norm(x, self.scale, self.bias,
+                               epsilon=float(self._epsilon))
+
+
+class LocalResponseNorm(Layer):
+    def __init__(self, size, alpha=1e-4, beta=0.75, k=1.0,
+                 data_format="NCHW", name=None):
+        super().__init__()
+        self.size, self.alpha, self.beta, self.k = size, alpha, beta, k
+
+    def forward(self, x):
+        from ..ops import math as M
+        from ..ops import nn_ops as N
+        import jax
+
+        sq = x * x
+        # average over a channel window
+        pad = self.size // 2
+        val = N.avg_pool2d(
+            sq.transpose(perm=(0, 2, 1, 3)) if x.ndim == 4 else sq,
+            kernel_size=(self.size, 1), stride=1, padding=(pad, 0),
+            exclusive=False)
+        if x.ndim == 4:
+            val = val.transpose(perm=(0, 2, 1, 3))
+        return x / (self.k + self.alpha * val * self.size) ** self.beta

@@ -1,0 +1,41 @@
+"""Attention ops: flash attention with Pallas TPU kernel + XLA fallback.
+
+(reference: phi/kernels/gpu/flash_attn_kernel.cu — dynloaded flashattn v2
+lib; YAML ops.yaml:1030 with spmd_rule FlashAttInferSpmd. Here the TPU
+path is a Pallas kernel (ops/pallas/flash_attention.py) and the portable
+path is plain XLA, selected at trace time by backend.)
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core import flags
+from ..core.dispatch import def_op
+from .nn_ops import scaled_dot_product_attention as _sdpa_public
+
+_sdpa_raw = _sdpa_public.raw
+
+
+def _use_pallas(q) -> bool:
+    if not flags._get("use_pallas_kernels", True):
+        return False
+    try:
+        return "tpu" in str(jax.devices()[0].platform).lower() or \
+               "axon" in str(jax.devices()[0].platform).lower()
+    except Exception:
+        return False
+
+
+@def_op("flash_attention")
+def flash_attention(q, k, v, causal=False, dropout=0.0):
+    """Layout [batch, seqlen, num_heads, head_dim]."""
+    if _use_pallas(q):
+        try:
+            from .pallas.flash_attention import flash_attention_fwd
+
+            return flash_attention_fwd(q, k, v, causal=causal)
+        except Exception:
+            pass
+    return _sdpa_raw(q, k, v, attn_mask=None, dropout_p=dropout,
+                     is_causal=causal)

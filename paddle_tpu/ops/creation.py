@@ -1,0 +1,206 @@
+"""Tensor creation operators.
+
+(reference: python/paddle/tensor/creation.py and random.py; phi kernels
+full_kernel/gaussian_kernel/uniform_kernel etc.)
+"""
+from __future__ import annotations
+
+import jax
+import numpy as np
+import jax.numpy as jnp
+
+from ..core import rng
+from ..core.dispatch import def_op
+from ..core.dtype import convert_dtype, get_default_dtype
+from ..tensor import Tensor, to_tensor
+
+# -- deterministic creation -------------------------------------------------
+
+
+@def_op("zeros", differentiable=False)
+def _zeros(shape=(), dtype="float32"):
+    return jnp.zeros(shape, dtype=convert_dtype(dtype))
+
+
+def zeros(shape, dtype=None, name=None):
+    return _zeros(shape=tuple(shape), dtype=str(convert_dtype(dtype or get_default_dtype())))
+
+
+@def_op("ones", differentiable=False)
+def _ones(shape=(), dtype="float32"):
+    return jnp.ones(shape, dtype=convert_dtype(dtype))
+
+
+def ones(shape, dtype=None, name=None):
+    return _ones(shape=tuple(shape), dtype=str(convert_dtype(dtype or get_default_dtype())))
+
+
+@def_op("full", differentiable=False)
+def _full(shape=(), fill_value=0.0, dtype="float32"):
+    return jnp.full(shape, fill_value, dtype=convert_dtype(dtype))
+
+
+def full(shape, fill_value, dtype=None, name=None):
+    if dtype is None:
+        dtype = get_default_dtype() if isinstance(fill_value, float) else (
+            "bool" if isinstance(fill_value, bool) else "int64")
+    if isinstance(fill_value, Tensor):
+        fill_value = fill_value.item()
+    return _full(shape=tuple(shape), fill_value=fill_value, dtype=str(convert_dtype(dtype)))
+
+
+@def_op("zeros_like")
+def zeros_like(x, dtype=None):
+    return jnp.zeros_like(x, dtype=convert_dtype(dtype) if dtype else None)
+
+
+@def_op("ones_like")
+def ones_like(x, dtype=None):
+    return jnp.ones_like(x, dtype=convert_dtype(dtype) if dtype else None)
+
+
+@def_op("full_like")
+def full_like(x, fill_value=0.0, dtype=None):
+    return jnp.full_like(x, fill_value, dtype=convert_dtype(dtype) if dtype else None)
+
+
+@def_op("arange", differentiable=False)
+def _arange(start=0, end=None, step=1, dtype="int64"):
+    return jnp.arange(start, end, step, dtype=convert_dtype(dtype))
+
+
+def arange(start=0, end=None, step=1, dtype=None, name=None):
+    if end is None:
+        start, end = 0, start
+    if dtype is None:
+        dtype = ("float32" if any(isinstance(v, float) for v in (start, end, step))
+                 else "int64")
+    return _arange(start=start, end=end, step=step, dtype=str(convert_dtype(dtype)))
+
+
+@def_op("linspace", differentiable=False)
+def _linspace(start=0.0, stop=1.0, num=100, dtype="float32"):
+    return jnp.linspace(start, stop, num, dtype=convert_dtype(dtype))
+
+
+def linspace(start, stop, num, dtype=None, name=None):
+    return _linspace(start=float(start), stop=float(stop), num=int(num),
+                     dtype=str(convert_dtype(dtype or get_default_dtype())))
+
+
+@def_op("eye", differentiable=False)
+def _eye(num_rows=1, num_columns=None, dtype="float32"):
+    return jnp.eye(num_rows, num_columns, dtype=convert_dtype(dtype))
+
+
+def eye(num_rows, num_columns=None, dtype=None, name=None):
+    return _eye(num_rows=int(num_rows),
+                num_columns=int(num_columns) if num_columns else None,
+                dtype=str(convert_dtype(dtype or get_default_dtype())))
+
+
+def empty(shape, dtype=None, name=None):
+    return zeros(shape, dtype=dtype)
+
+
+def empty_like(x, dtype=None, name=None):
+    return zeros_like(x, dtype=dtype)
+
+
+@def_op("meshgrid_op")
+def _meshgrid(*xs, indexing="ij"):
+    return tuple(jnp.meshgrid(*xs, indexing=indexing))
+
+
+def meshgrid(*args, **kwargs):
+    if len(args) == 1 and isinstance(args[0], (list, tuple)):
+        args = args[0]
+    return list(_meshgrid(*args))
+
+
+def diagflat(x, offset=0, name=None):
+    from . import manipulation
+    return manipulation.diag(x) if False else to_tensor(
+        jnp.diagflat(x._value if isinstance(x, Tensor) else x, k=offset))
+
+
+# -- random creation --------------------------------------------------------
+# Random ops take the PRNG key as a tensor input so replay (generic vjp)
+# and jitted steps are deterministic given the key.
+
+
+@def_op("uniform_random", differentiable=False)
+def _uniform(key, shape=(), dtype="float32", min=-1.0, max=1.0):
+    return jax.random.uniform(key, shape, dtype=convert_dtype(dtype),
+                              minval=min, maxval=max)
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None):
+    return _uniform(rng.get_key(), shape=tuple(shape),
+                    dtype=str(convert_dtype(dtype or get_default_dtype())),
+                    min=float(min), max=float(max))
+
+
+def rand(shape, dtype=None, name=None):
+    return uniform(shape, dtype=dtype, min=0.0, max=1.0)
+
+
+@def_op("gaussian_random", differentiable=False)
+def _gaussian(key, shape=(), dtype="float32", mean=0.0, std=1.0):
+    return mean + std * jax.random.normal(key, shape, dtype=convert_dtype(dtype))
+
+
+def normal(mean=0.0, std=1.0, shape=None, name=None):
+    return _gaussian(rng.get_key(), shape=tuple(shape or ()), mean=float(mean),
+                     std=float(std), dtype=str(get_default_dtype()))
+
+
+def randn(shape, dtype=None, name=None):
+    return _gaussian(rng.get_key(), shape=tuple(shape),
+                     dtype=str(convert_dtype(dtype or get_default_dtype())))
+
+
+@def_op("randint_op", differentiable=False)
+def _randint(key, low=0, high=None, shape=(), dtype="int64"):
+    return jax.random.randint(key, shape, low, high, dtype=convert_dtype(dtype))
+
+
+def randint(low=0, high=None, shape=(1,), dtype=None, name=None):
+    if high is None:
+        low, high = 0, low
+    return _randint(rng.get_key(), low=int(low), high=int(high),
+                    shape=tuple(shape), dtype=str(convert_dtype(dtype or "int64")))
+
+
+@def_op("randperm_op", differentiable=False)
+def _randperm(key, n=1, dtype="int64"):
+    return jax.random.permutation(key, n).astype(convert_dtype(dtype))
+
+
+def randperm(n, dtype="int64", name=None):
+    return _randperm(rng.get_key(), n=int(n), dtype=str(convert_dtype(dtype)))
+
+
+@def_op("bernoulli_op", differentiable=False)
+def _bernoulli(x, key):
+    return jax.random.bernoulli(key, x).astype(x.dtype)
+
+
+def bernoulli(x, name=None):
+    return _bernoulli(x, rng.get_key())
+
+
+@def_op("multinomial_op", differentiable=False)
+def _multinomial(x, key, num_samples=1, replacement=False):
+    logits = jnp.log(jnp.clip(x, 1e-30, None))
+    if replacement:
+        return jax.random.categorical(
+            key, logits, axis=-1, shape=x.shape[:-1] + (num_samples,)
+        ).astype(jnp.int64)
+    return jax.random.choice(key, x.shape[-1], (num_samples,), replace=False,
+                             p=x / jnp.sum(x)).astype(jnp.int64)
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None):
+    return _multinomial(x, rng.get_key(), num_samples=int(num_samples),
+                        replacement=bool(replacement))

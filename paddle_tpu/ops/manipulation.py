@@ -1,0 +1,407 @@
+"""Shape/layout manipulation operators.
+
+(reference: python/paddle/tensor/manipulation.py; view kernels in
+paddle/phi/kernels/stride/ — on TPU all "views" are value-semantic XLA
+ops that the compiler folds into layouts, so no stride machinery needed.)
+"""
+from __future__ import annotations
+
+import builtins
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.dispatch import def_op
+
+_pyslice = builtins.slice
+
+# ---------------------------------------------------------------------------
+
+
+@def_op("reshape")
+def reshape(x, shape=()):
+    return jnp.reshape(x, shape)
+
+
+@def_op("transpose")
+def transpose(x, perm=None):
+    return jnp.transpose(x, axes=perm)
+
+
+@def_op("swapaxes")
+def swapaxes(x, axis1=0, axis2=1):
+    return jnp.swapaxes(x, axis1, axis2)
+
+
+@def_op("moveaxis")
+def moveaxis(x, source=0, destination=0):
+    return jnp.moveaxis(x, source, destination)
+
+
+@def_op("concat_op")
+def _concat(*xs, axis=0):
+    return jnp.concatenate(xs, axis=axis)
+
+
+def concat(x, axis=0, name=None):
+    """paddle.concat — takes a list/tuple of tensors."""
+    if isinstance(axis, (list, tuple)):
+        raise TypeError("axis must be int")
+    return _concat(*x, axis=int(axis))
+
+
+@def_op("stack_op")
+def _stack(*xs, axis=0):
+    return jnp.stack(xs, axis=axis)
+
+
+def stack(x, axis=0, name=None):
+    return _stack(*x, axis=int(axis))
+
+
+@def_op("split_op")
+def _split(x, num_or_sections=1, axis=0):
+    if isinstance(num_or_sections, int):
+        outs = jnp.split(x, num_or_sections, axis=axis)
+    else:
+        # sections may contain one -1 (inferred), paddle-style
+        sections = list(num_or_sections)
+        if -1 in sections:
+            known = sum(s for s in sections if s != -1)
+            sections[sections.index(-1)] = x.shape[axis] - known
+        idx = []
+        acc = 0
+        for s in sections[:-1]:
+            acc += s
+            idx.append(acc)
+        outs = jnp.split(x, idx, axis=axis)
+    return tuple(outs)
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    if isinstance(num_or_sections, (list, tuple)):
+        num_or_sections = tuple(int(s) for s in num_or_sections)
+    return list(_split(x, num_or_sections=num_or_sections, axis=int(axis)))
+
+
+def chunk(x, chunks, axis=0, name=None):
+    return split(x, chunks, axis=axis)
+
+
+@def_op("unstack_op")
+def _unstack(x, axis=0, num=None):
+    n = num if num is not None else x.shape[axis]
+    return tuple(jnp.squeeze(s, axis=axis) for s in jnp.split(x, n, axis=axis))
+
+
+def unstack(x, axis=0, num=None):
+    return list(_unstack(x, axis=axis, num=num))
+
+
+@def_op("squeeze")
+def squeeze(x, axis=None):
+    if axis is None:
+        return jnp.squeeze(x)
+    if isinstance(axis, int):
+        axis = (axis,)
+    axis = tuple(a for a in axis if x.shape[a] == 1)
+    if not axis:
+        return x
+    return jnp.squeeze(x, axis=axis)
+
+
+@def_op("unsqueeze")
+def unsqueeze(x, axis=0):
+    return jnp.expand_dims(x, axis)
+
+
+@def_op("flatten_op")
+def flatten(x, start_axis=0, stop_axis=-1):
+    ndim = x.ndim
+    if ndim == 0:
+        return x.reshape(1)
+    start = start_axis % ndim
+    stop = stop_axis % ndim
+    new_shape = x.shape[:start] + (-1,) + x.shape[stop + 1:]
+    return x.reshape(new_shape)
+
+
+@def_op("expand")
+def expand(x, shape=()):
+    shape = tuple(
+        x.shape[i - (len(shape) - x.ndim)] if s in (-1,) else s
+        for i, s in enumerate(shape)
+    )
+    return jnp.broadcast_to(x, shape)
+
+
+@def_op("expand_as")
+def expand_as(x, y):
+    return jnp.broadcast_to(x, y.shape)
+
+
+@def_op("broadcast_to")
+def broadcast_to(x, shape=()):
+    return jnp.broadcast_to(x, shape)
+
+
+@def_op("tile")
+def tile(x, repeat_times=()):
+    return jnp.tile(x, repeat_times)
+
+
+@def_op("repeat_interleave")
+def repeat_interleave(x, repeats=1, axis=None):
+    return jnp.repeat(x, repeats, axis=axis)
+
+
+@def_op("roll")
+def roll(x, shifts=0, axis=None):
+    return jnp.roll(x, shifts, axis=axis)
+
+
+@def_op("flip")
+def flip(x, axis=None):
+    return jnp.flip(x, axis=axis)
+
+
+@def_op("rot90")
+def rot90(x, k=1, axes=(0, 1)):
+    return jnp.rot90(x, k=k, axes=axes)
+
+
+@def_op("cast")
+def cast(x, dtype="float32"):
+    from ..core.dtype import convert_dtype
+
+    return x.astype(convert_dtype(dtype))
+
+
+@def_op("assign")
+def assign(x):
+    return jnp.asarray(x) + 0  # force a copy-op so autograd sees identity
+
+
+@def_op("slice_op")
+def slice_op(x, axes=(), starts=(), ends=()):
+    idx = [_pyslice(None)] * x.ndim
+    for ax, st, en in zip(axes, starts, ends):
+        idx[ax] = _pyslice(st, en)
+    return x[tuple(idx)]
+
+
+def slice(x, axes, starts, ends):
+    return slice_op(x, axes=tuple(axes), starts=tuple(int(s) for s in starts),
+                    ends=tuple(int(e) for e in ends))
+
+
+@def_op("strided_slice")
+def strided_slice(x, axes=(), starts=(), ends=(), strides=()):
+    idx = [_pyslice(None)] * x.ndim
+    for ax, st, en, sd in zip(axes, starts, ends, strides):
+        idx[ax] = _pyslice(st, en, sd)
+    return x[tuple(idx)]
+
+
+@def_op("getitem")
+def _getitem(x, *index_tensors, index_spec=()):
+    idx = _decode_index(index_spec, list(index_tensors))
+    return x[idx]
+
+
+def _encode_index(item, tensors):
+    """Encode an indexing expression into a hashable spec + tensor list."""
+    from ..tensor import Tensor
+    import numpy as np
+
+    if isinstance(item, tuple):
+        return ("tuple", tuple(_encode_index(i, tensors) for i in item))
+    if isinstance(item, Tensor):
+        tensors.append(item)
+        return ("t",)
+    if isinstance(item, (jnp.ndarray, np.ndarray)):
+        tensors.append(item)
+        return ("t",)
+    if isinstance(item, _pyslice):
+        return ("slice", item.start, item.stop, item.step)
+    if item is None:
+        return ("none",)
+    if item is Ellipsis:
+        return ("ellipsis",)
+    if isinstance(item, (list,)):
+        return ("list", tuple(item))
+    if isinstance(item, (int, bool)):
+        return ("const", item)
+    raise TypeError(f"unsupported index: {item!r}")
+
+
+def _decode_index(spec, tensors):
+    kind = spec[0]
+    if kind == "tuple":
+        return tuple(_decode_index(s, tensors) for s in spec[1])
+    if kind == "t":
+        return tensors.pop(0)
+    if kind == "slice":
+        return _pyslice(spec[1], spec[2], spec[3])
+    if kind == "none":
+        return None
+    if kind == "ellipsis":
+        return Ellipsis
+    if kind == "list":
+        return jnp.asarray(spec[1])
+    if kind == "const":
+        return spec[1]
+    raise TypeError(f"bad index spec {spec}")
+
+
+def getitem(x, item):
+    tensors = []
+    spec = _encode_index(item, tensors)
+    return _getitem(x, *tensors, index_spec=spec)
+
+
+@def_op("gather")
+def gather(x, index, axis=0):
+    index = index.reshape(-1) if index.ndim > 1 else index
+    return jnp.take(x, index, axis=axis)
+
+
+@def_op("gather_nd")
+def gather_nd(x, index):
+    return x[tuple(jnp.moveaxis(index, -1, 0))]
+
+
+@def_op("take_along_axis")
+def take_along_axis(x, indices, axis=0, broadcast=True):
+    return jnp.take_along_axis(x, indices, axis=axis)
+
+
+@def_op("put_along_axis")
+def put_along_axis(x, indices, values, axis=0, reduce="assign"):
+    if reduce == "assign":
+        return jnp.put_along_axis(x, indices, values, axis=axis, inplace=False)
+    if reduce in ("add", "mul", "multiply"):
+        # scatter via explicit index grids (jnp.put_along_axis lacks modes)
+        idx = jnp.indices(indices.shape, sparse=False)
+        index_tuple = tuple(
+            indices if d == (axis % x.ndim) else idx[d] for d in range(x.ndim)
+        )
+        values = jnp.broadcast_to(values, indices.shape)
+        if reduce == "add":
+            return x.at[index_tuple].add(values)
+        return x.at[index_tuple].multiply(values)
+    raise NotImplementedError(f"put_along_axis reduce={reduce}")
+
+
+@def_op("index_select")
+def index_select(x, index, axis=0):
+    return jnp.take(x, index, axis=axis)
+
+
+@def_op("index_sample")
+def index_sample(x, index):
+    return jnp.take_along_axis(x, index, axis=1)
+
+
+@def_op("scatter")
+def scatter(x, index, updates, overwrite=True):
+    if overwrite:
+        return x.at[index].set(updates)
+    return x.at[index].add(updates)
+
+
+@def_op("scatter_nd_add")
+def scatter_nd_add(x, index, updates):
+    return x.at[tuple(jnp.moveaxis(index, -1, 0))].add(updates)
+
+
+@def_op("masked_fill")
+def masked_fill(x, mask, value):
+    return jnp.where(mask, value, x)
+
+
+@def_op("pad")
+def pad(x, pad=(), mode="constant", value=0.0, data_format="NCHW"):
+    pad = tuple(pad)
+    if len(pad) == 2 * x.ndim:
+        width = [(pad[2 * i], pad[2 * i + 1]) for i in range(x.ndim)]
+    else:
+        # paddle convention: pads apply to the last len(pad)//2 dims,
+        # ordered from the last dim backward in (before, after) pairs.
+        n = len(pad) // 2
+        # paddle convention: pairs are ordered from the LAST dim backward
+        # ([left,right,top,bottom] pads W then H on NCHW), torch-style.
+        if data_format in ("NCHW", "NCL", "NCDHW") and n == x.ndim - 2:
+            width = [(0, 0), (0, 0)] + [
+                (pad[2 * (n - 1 - i)], pad[2 * (n - 1 - i) + 1])
+                for i in range(n)
+            ]
+        else:
+            width = [(0, 0)] * (x.ndim - n) + [
+                (pad[2 * i], pad[2 * i + 1]) for i in range(n)
+            ]
+    mode_map = {"constant": "constant", "reflect": "reflect",
+                "replicate": "edge", "circular": "wrap"}
+    kwargs = {"constant_values": value} if mode == "constant" else {}
+    return jnp.pad(x, width, mode=mode_map[mode], **kwargs)
+
+
+@def_op("tril")
+def tril(x, diagonal=0):
+    return jnp.tril(x, k=diagonal)
+
+
+@def_op("triu")
+def triu(x, diagonal=0):
+    return jnp.triu(x, k=diagonal)
+
+
+@def_op("diag_embed")
+def diag_embed(x, offset=0, dim1=-2, dim2=-1):
+    n = x.shape[-1]
+    m = n + builtins.abs(offset)
+    out = jnp.zeros(x.shape[:-1] + (m, m), x.dtype)
+    rows = jnp.arange(n) + (0 if offset >= 0 else -offset)
+    cols = jnp.arange(n) + (offset if offset >= 0 else 0)
+    out = out.at[..., rows, cols].set(x)
+    if (dim1 % out.ndim, dim2 % out.ndim) != (out.ndim - 2, out.ndim - 1):
+        out = jnp.moveaxis(out, (-2, -1), (dim1, dim2))
+    return out
+
+
+@def_op("unbind_op")
+def _unbind(x, axis=0):
+    return tuple(jnp.squeeze(s, axis=axis)
+                 for s in jnp.split(x, x.shape[axis], axis=axis))
+
+
+def unbind(x, axis=0):
+    return list(_unbind(x, axis=axis))
+
+
+@def_op("one_hot", differentiable=False)
+def one_hot(x, num_classes=-1):
+    return jax.nn.one_hot(x, num_classes, dtype=jnp.float32)
+
+
+@def_op("unique", differentiable=False)
+def unique(x, return_index=False, return_inverse=False, return_counts=False, axis=None):
+    # NOTE: dynamic-shape; eager-only.
+    import numpy as np
+
+    res = np.unique(np.asarray(x), return_index=return_index,
+                    return_inverse=return_inverse, return_counts=return_counts,
+                    axis=axis)
+    if isinstance(res, tuple):
+        return tuple(jnp.asarray(r) for r in res)
+    return jnp.asarray(res)
+
+
+@def_op("as_strided")
+def numel_op(x):
+    return jnp.asarray(x.size, dtype=jnp.int64)
+
+
+def numel(x):
+    return numel_op(x)

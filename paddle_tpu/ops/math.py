@@ -1,0 +1,655 @@
+"""Math operators (elementwise, reductions, linalg, comparison).
+
+TPU-native kernel set covering the reference's math surface
+(reference: python/paddle/tensor/math.py, tensor/linalg.py:176 matmul,
+phi/kernels/{cpu,gpu}/elementwise_*).  Every kernel is a pure jnp/lax
+function registered via def_op; dispatch + autograd live in
+core/dispatch.py / autograd/engine.py.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.dispatch import def_op
+
+# ---------------------------------------------------------------------------
+# Elementwise binary
+# ---------------------------------------------------------------------------
+
+
+@def_op("add")
+def add(x, y):
+    return jnp.add(x, y)
+
+
+@def_op("subtract")
+def subtract(x, y):
+    return jnp.subtract(x, y)
+
+
+@def_op("multiply")
+def multiply(x, y):
+    return jnp.multiply(x, y)
+
+
+@def_op("divide")
+def divide(x, y):
+    return jnp.divide(x, y)
+
+
+@def_op("floor_divide")
+def floor_divide(x, y):
+    return jnp.floor_divide(x, y)
+
+
+@def_op("remainder")
+def remainder(x, y):
+    return jnp.remainder(x, y)
+
+
+mod = remainder
+
+
+@def_op("pow")
+def pow(x, y):
+    return jnp.power(x, y)
+
+
+@def_op("maximum")
+def maximum(x, y):
+    return jnp.maximum(x, y)
+
+
+@def_op("minimum")
+def minimum(x, y):
+    return jnp.minimum(x, y)
+
+
+@def_op("fmax")
+def fmax(x, y):
+    return jnp.fmax(x, y)
+
+
+@def_op("fmin")
+def fmin(x, y):
+    return jnp.fmin(x, y)
+
+
+@def_op("atan2")
+def atan2(x, y):
+    return jnp.arctan2(x, y)
+
+
+@def_op("hypot")
+def hypot(x, y):
+    return jnp.hypot(x, y)
+
+
+# ---------------------------------------------------------------------------
+# Elementwise unary
+# ---------------------------------------------------------------------------
+
+
+@def_op("exp")
+def exp(x):
+    return jnp.exp(x)
+
+
+@def_op("expm1")
+def expm1(x):
+    return jnp.expm1(x)
+
+
+@def_op("log")
+def log(x):
+    return jnp.log(x)
+
+
+@def_op("log2")
+def log2(x):
+    return jnp.log2(x)
+
+
+@def_op("log10")
+def log10(x):
+    return jnp.log10(x)
+
+
+@def_op("log1p")
+def log1p(x):
+    return jnp.log1p(x)
+
+
+@def_op("sqrt")
+def sqrt(x):
+    return jnp.sqrt(x)
+
+
+@def_op("rsqrt")
+def rsqrt(x):
+    return lax.rsqrt(x)
+
+
+@def_op("abs")
+def abs(x):
+    return jnp.abs(x)
+
+
+@def_op("neg")
+def neg(x):
+    return jnp.negative(x)
+
+
+@def_op("sign")
+def sign(x):
+    return jnp.sign(x)
+
+
+@def_op("reciprocal")
+def reciprocal(x):
+    return jnp.reciprocal(x)
+
+
+@def_op("square")
+def square(x):
+    return jnp.square(x)
+
+
+@def_op("sin")
+def sin(x):
+    return jnp.sin(x)
+
+
+@def_op("cos")
+def cos(x):
+    return jnp.cos(x)
+
+
+@def_op("tan")
+def tan(x):
+    return jnp.tan(x)
+
+
+@def_op("asin")
+def asin(x):
+    return jnp.arcsin(x)
+
+
+@def_op("acos")
+def acos(x):
+    return jnp.arccos(x)
+
+
+@def_op("atan")
+def atan(x):
+    return jnp.arctan(x)
+
+
+@def_op("sinh")
+def sinh(x):
+    return jnp.sinh(x)
+
+
+@def_op("cosh")
+def cosh(x):
+    return jnp.cosh(x)
+
+
+@def_op("tanh")
+def tanh(x):
+    return jnp.tanh(x)
+
+
+@def_op("asinh")
+def asinh(x):
+    return jnp.arcsinh(x)
+
+
+@def_op("acosh")
+def acosh(x):
+    return jnp.arccosh(x)
+
+
+@def_op("atanh")
+def atanh(x):
+    return jnp.arctanh(x)
+
+
+@def_op("erf")
+def erf(x):
+    return lax.erf(x)
+
+
+@def_op("erfinv")
+def erfinv(x):
+    return lax.erf_inv(x)
+
+
+@def_op("floor", differentiable=False)
+def floor(x):
+    return jnp.floor(x)
+
+
+@def_op("ceil", differentiable=False)
+def ceil(x):
+    return jnp.ceil(x)
+
+
+@def_op("round", differentiable=False)
+def round(x):
+    return jnp.round(x)
+
+
+@def_op("trunc", differentiable=False)
+def trunc(x):
+    return jnp.trunc(x)
+
+
+@def_op("frac")
+def frac(x):
+    return x - jnp.trunc(x)
+
+
+@def_op("digamma")
+def digamma(x):
+    return lax.digamma(x)
+
+
+@def_op("lgamma")
+def lgamma(x):
+    return lax.lgamma(x)
+
+
+@def_op("clip")
+def clip(x, min=None, max=None):
+    return jnp.clip(x, min, max)
+
+
+@def_op("scale")
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True):
+    if bias_after_scale:
+        return x * scale + bias
+    return (x + bias) * scale
+
+
+@def_op("lerp")
+def lerp(x, y, weight):
+    return x + weight * (y - x)
+
+
+@def_op("logit")
+def logit(x, eps=None):
+    if eps is not None:
+        x = jnp.clip(x, eps, 1.0 - eps)
+    return jnp.log(x / (1.0 - x))
+
+
+@def_op("nan_to_num")
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None):
+    return jnp.nan_to_num(x, nan=nan, posinf=posinf, neginf=neginf)
+
+
+# ---------------------------------------------------------------------------
+# Reductions
+# ---------------------------------------------------------------------------
+
+
+@def_op("sum")
+def sum(x, axis=None, dtype=None, keepdim=False):
+    return jnp.sum(x, axis=axis, dtype=dtype, keepdims=keepdim)
+
+
+@def_op("mean")
+def mean(x, axis=None, keepdim=False):
+    return jnp.mean(x, axis=axis, keepdims=keepdim)
+
+
+@def_op("max")
+def max(x, axis=None, keepdim=False):
+    return jnp.max(x, axis=axis, keepdims=keepdim)
+
+
+@def_op("min")
+def min(x, axis=None, keepdim=False):
+    return jnp.min(x, axis=axis, keepdims=keepdim)
+
+
+@def_op("prod")
+def prod(x, axis=None, keepdim=False, dtype=None):
+    return jnp.prod(x, axis=axis, keepdims=keepdim, dtype=dtype)
+
+
+@def_op("logsumexp")
+def logsumexp(x, axis=None, keepdim=False):
+    return jax.scipy.special.logsumexp(x, axis=axis, keepdims=keepdim)
+
+
+@def_op("std")
+def std(x, axis=None, unbiased=True, keepdim=False):
+    return jnp.std(x, axis=axis, ddof=1 if unbiased else 0, keepdims=keepdim)
+
+
+@def_op("var")
+def var(x, axis=None, unbiased=True, keepdim=False):
+    return jnp.var(x, axis=axis, ddof=1 if unbiased else 0, keepdims=keepdim)
+
+
+@def_op("median")
+def median(x, axis=None, keepdim=False):
+    return jnp.median(x, axis=axis, keepdims=keepdim)
+
+
+@def_op("all", differentiable=False)
+def all(x, axis=None, keepdim=False):
+    return jnp.all(x, axis=axis, keepdims=keepdim)
+
+
+@def_op("any", differentiable=False)
+def any(x, axis=None, keepdim=False):
+    return jnp.any(x, axis=axis, keepdims=keepdim)
+
+
+@def_op("amax")
+def amax(x, axis=None, keepdim=False):
+    return jnp.amax(x, axis=axis, keepdims=keepdim)
+
+
+@def_op("amin")
+def amin(x, axis=None, keepdim=False):
+    return jnp.amin(x, axis=axis, keepdims=keepdim)
+
+
+@def_op("cumsum")
+def cumsum(x, axis=None):
+    if axis is None:
+        return jnp.cumsum(x.reshape(-1))
+    return jnp.cumsum(x, axis=axis)
+
+
+@def_op("cumprod")
+def cumprod(x, dim=None):
+    if dim is None:
+        return jnp.cumprod(x.reshape(-1))
+    return jnp.cumprod(x, axis=dim)
+
+
+@def_op("cummax", differentiable=False)
+def cummax(x, axis=-1):
+    return lax.cummax(x, axis=axis)
+
+
+# ---------------------------------------------------------------------------
+# Linear algebra
+# ---------------------------------------------------------------------------
+
+
+@def_op("matmul")
+def matmul(x, y, transpose_x=False, transpose_y=False):
+    """Batched matmul (reference: python/paddle/tensor/linalg.py:176).
+
+    Lowers straight to dot_general so XLA tiles it on the MXU; transposes
+    fold into the contraction dims instead of materialising.
+    """
+    if x.ndim == 1 and y.ndim == 1:
+        return jnp.dot(x, y)
+    if transpose_x and x.ndim >= 2:
+        x = jnp.swapaxes(x, -1, -2)
+    if transpose_y and y.ndim >= 2:
+        y = jnp.swapaxes(y, -1, -2)
+    return jnp.matmul(x, y)
+
+
+@def_op("dot")
+def dot(x, y):
+    if x.ndim == 2:
+        return jnp.sum(x * y, axis=-1)
+    return jnp.dot(x, y)
+
+
+@def_op("outer")
+def outer(x, y):
+    return jnp.outer(x, y)
+
+
+@def_op("inner")
+def inner(x, y):
+    return jnp.inner(x, y)
+
+
+@def_op("cross")
+def cross(x, y, axis=9):
+    return jnp.cross(x, y, axis=axis if axis != 9 else -1)
+
+
+@def_op("norm")
+def norm(x, p="fro", axis=None, keepdim=False):
+    if p == "fro":
+        if axis is None:
+            return jnp.sqrt(jnp.sum(jnp.square(x)))
+        return jnp.linalg.norm(x, ord="fro" if isinstance(axis, (tuple, list)) else None,
+                               axis=axis, keepdims=keepdim)
+    if p == float("inf") or p == "inf":
+        return jnp.linalg.norm(x, ord=jnp.inf, axis=axis, keepdims=keepdim)
+    if axis is None:
+        x = x.reshape(-1)
+        axis = 0
+    return jnp.linalg.norm(x, ord=p, axis=axis, keepdims=keepdim)
+
+
+@def_op("t")
+def t(x):
+    return x.T
+
+
+@def_op("trace_op")
+def trace(x, offset=0, axis1=0, axis2=1):
+    return jnp.trace(x, offset=offset, axis1=axis1, axis2=axis2)
+
+
+@def_op("diag")
+def diag(x, offset=0):
+    return jnp.diag(x, k=offset)
+
+
+@def_op("kron")
+def kron(x, y):
+    return jnp.kron(x, y)
+
+
+@def_op("bmm")
+def bmm(x, y):
+    return jnp.matmul(x, y)
+
+
+@def_op("addmm")
+def addmm(input, x, y, beta=1.0, alpha=1.0):
+    return beta * input + alpha * jnp.matmul(x, y)
+
+
+@def_op("einsum_op")
+def _einsum(*operands, equation=""):
+    return jnp.einsum(equation, *operands)
+
+
+def einsum(equation, *operands):
+    return _einsum(*operands, equation=equation)
+
+
+@def_op("multiply_no_broadcast")
+def mv(x, vec):
+    return jnp.matmul(x, vec)
+
+
+# ---------------------------------------------------------------------------
+# Comparison / logical (non-differentiable)
+# ---------------------------------------------------------------------------
+
+
+@def_op("equal", differentiable=False)
+def equal(x, y):
+    return jnp.equal(x, y)
+
+
+@def_op("not_equal", differentiable=False)
+def not_equal(x, y):
+    return jnp.not_equal(x, y)
+
+
+@def_op("less_than", differentiable=False)
+def less_than(x, y):
+    return jnp.less(x, y)
+
+
+@def_op("less_equal", differentiable=False)
+def less_equal(x, y):
+    return jnp.less_equal(x, y)
+
+
+@def_op("greater_than", differentiable=False)
+def greater_than(x, y):
+    return jnp.greater(x, y)
+
+
+@def_op("greater_equal", differentiable=False)
+def greater_equal(x, y):
+    return jnp.greater_equal(x, y)
+
+
+@def_op("logical_and", differentiable=False)
+def logical_and(x, y):
+    return jnp.logical_and(x, y)
+
+
+@def_op("logical_or", differentiable=False)
+def logical_or(x, y):
+    return jnp.logical_or(x, y)
+
+
+@def_op("logical_not", differentiable=False)
+def logical_not(x):
+    return jnp.logical_not(x)
+
+
+@def_op("logical_xor", differentiable=False)
+def logical_xor(x, y):
+    return jnp.logical_xor(x, y)
+
+
+@def_op("bitwise_and", differentiable=False)
+def bitwise_and(x, y):
+    return jnp.bitwise_and(x, y)
+
+
+@def_op("bitwise_or", differentiable=False)
+def bitwise_or(x, y):
+    return jnp.bitwise_or(x, y)
+
+
+@def_op("bitwise_xor", differentiable=False)
+def bitwise_xor(x, y):
+    return jnp.bitwise_xor(x, y)
+
+
+@def_op("bitwise_not", differentiable=False)
+def bitwise_not(x):
+    return jnp.bitwise_not(x)
+
+
+@def_op("isnan", differentiable=False)
+def isnan(x):
+    return jnp.isnan(x)
+
+
+@def_op("isinf", differentiable=False)
+def isinf(x):
+    return jnp.isinf(x)
+
+
+@def_op("isfinite", differentiable=False)
+def isfinite(x):
+    return jnp.isfinite(x)
+
+
+@def_op("isclose", differentiable=False)
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False):
+    return jnp.isclose(x, y, rtol=rtol, atol=atol, equal_nan=equal_nan)
+
+
+@def_op("allclose", differentiable=False)
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False):
+    return jnp.allclose(x, y, rtol=rtol, atol=atol, equal_nan=equal_nan)
+
+
+# ---------------------------------------------------------------------------
+# Index/search ops
+# ---------------------------------------------------------------------------
+
+
+@def_op("argmax", differentiable=False)
+def argmax(x, axis=None, keepdim=False, dtype="int64"):
+    out = jnp.argmax(x, axis=axis, keepdims=keepdim)
+    return out.astype(jnp.dtype(dtype))
+
+
+@def_op("argmin", differentiable=False)
+def argmin(x, axis=None, keepdim=False, dtype="int64"):
+    out = jnp.argmin(x, axis=axis, keepdims=keepdim)
+    return out.astype(jnp.dtype(dtype))
+
+
+@def_op("argsort", differentiable=False)
+def argsort(x, axis=-1, descending=False):
+    out = jnp.argsort(x, axis=axis, descending=descending)
+    return out.astype(jnp.int64)
+
+
+@def_op("sort")
+def sort(x, axis=-1, descending=False):
+    return jnp.sort(x, axis=axis, descending=descending)
+
+
+@def_op("topk")
+def topk(x, k, axis=-1, largest=True, sorted=True):
+    if axis != -1 and axis != x.ndim - 1:
+        x_m = jnp.moveaxis(x, axis, -1)
+    else:
+        x_m = x
+    if largest:
+        vals, idx = lax.top_k(x_m, k)
+    else:
+        vals, idx = lax.top_k(-x_m, k)
+        vals = -vals
+    if axis != -1 and axis != x.ndim - 1:
+        vals = jnp.moveaxis(vals, -1, axis)
+        idx = jnp.moveaxis(idx, -1, axis)
+    return vals, idx.astype(jnp.int64)
+
+
+@def_op("where")
+def where(condition, x, y):
+    return jnp.where(condition, x, y)
+
+
+@def_op("nonzero", differentiable=False)
+def nonzero(x, as_tuple=False):
+    # NOTE: dynamic-shape op; eager-only (not traceable under jit).
+    import numpy as np
+
+    arr = np.asarray(x)
+    nz = np.nonzero(arr)
+    if as_tuple:
+        return tuple(jnp.asarray(n) for n in nz)
+    return jnp.stack([jnp.asarray(n) for n in nz], axis=1).astype(jnp.int64)
+
+
+@def_op("searchsorted", differentiable=False)
+def searchsorted(sorted_sequence, values, out_int32=False, right=False):
+    out = jnp.searchsorted(sorted_sequence, values, side="right" if right else "left")
+    return out.astype(jnp.int32 if out_int32 else jnp.int64)
+
+
+@def_op("bincount", differentiable=False)
+def bincount(x, weights=None, minlength=0):
+    return jnp.bincount(x, weights=weights, minlength=minlength)

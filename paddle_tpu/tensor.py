@@ -1,0 +1,217 @@
+"""The eager Tensor type.
+
+TPU-native analog of the reference's ``paddle.Tensor``
+(reference: paddle/phi/api/include/tensor.h:82 paddle::Tensor value type;
+autograd metadata paddle/fluid/eager/autograd_meta.h:61; python methods
+monkey-patched by paddle/fluid/pybind/eager_method.cc).
+
+A Tensor wraps an immutable ``jax.Array`` (or a Tracer under jit) plus
+autograd metadata (``stop_gradient``, ``grad``, producer GradNode). All
+math/manipulation methods are monkey-patched in ``tensor_methods.py`` —
+the same late-binding strategy the reference uses for its pybind Tensor.
+"""
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import numpy as np
+import jax.numpy as jnp
+
+from .core.dtype import convert_dtype, get_default_dtype
+
+__all__ = ["Tensor", "Parameter", "to_tensor"]
+
+
+class Tensor:
+    __slots__ = (
+        "_value", "stop_gradient", "grad", "_grad_node", "_out_idx",
+        "name", "persistable", "trainable", "_grad_hooks", "dist_attr",
+        "__weakref__", "__dict__",
+    )
+
+    def __init__(self, value, stop_gradient: bool = True, name: Optional[str] = None):
+        if isinstance(value, Tensor):
+            value = value._value
+        self._value = value
+        self.stop_gradient = stop_gradient
+        self.grad: Optional["Tensor"] = None
+        self._grad_node = None
+        self._out_idx = 0
+        self.name = name or ""
+        self.persistable = False
+        self.trainable = not stop_gradient
+        self._grad_hooks = None
+        self.dist_attr = None
+
+    # -- structural properties ------------------------------------------
+    @property
+    def shape(self):
+        return list(self._value.shape)
+
+    @property
+    def dtype(self):
+        return jnp.dtype(self._value.dtype)
+
+    @property
+    def ndim(self) -> int:
+        return self._value.ndim
+
+    @property
+    def size(self) -> int:
+        return int(np.prod(self._value.shape)) if self._value.shape else 1
+
+    @property
+    def is_leaf(self) -> bool:
+        return self._grad_node is None
+
+    @property
+    def place(self):
+        try:
+            return next(iter(self._value.devices()))
+        except Exception:
+            return "traced"
+
+    # -- interop --------------------------------------------------------
+    def numpy(self) -> np.ndarray:
+        return np.asarray(self._value)
+
+    def __array__(self, dtype=None):
+        arr = self.numpy()
+        return arr.astype(dtype) if dtype is not None else arr
+
+    def __jax_array__(self):
+        return self._value
+
+    def item(self):
+        return self._value.item()
+
+    def tolist(self):
+        return self.numpy().tolist()
+
+    def __len__(self) -> int:
+        if self.ndim == 0:
+            raise TypeError("len() of a 0-d tensor")
+        return self._value.shape[0]
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    def __bool__(self):
+        return bool(self._value)
+
+    def __int__(self):
+        return int(self._value)
+
+    def __float__(self):
+        return float(self._value)
+
+    def __hash__(self):
+        return id(self)
+
+    # -- autograd -------------------------------------------------------
+    def backward(self, grad_tensor: Optional["Tensor"] = None,
+                 retain_graph: bool = False) -> None:
+        from .autograd import engine
+        engine.backward([self], [grad_tensor], retain_graph=retain_graph)
+
+    def clear_grad(self) -> None:
+        self.grad = None
+
+    clear_gradient = clear_grad
+
+    def detach(self) -> "Tensor":
+        t = Tensor(self._value, stop_gradient=True, name=self.name)
+        return t
+
+    def detach_(self) -> "Tensor":
+        self._grad_node = None
+        self.stop_gradient = True
+        return self
+
+    def clone(self) -> "Tensor":
+        from .ops import manipulation
+        return manipulation.assign(self)
+
+    def register_hook(self, hook):
+        """Register a grad hook fired when this leaf's grad accumulates."""
+        if self._grad_hooks is None:
+            self._grad_hooks = []
+        self._grad_hooks.append(hook)
+
+        class _Removable:
+            def __init__(self, hooks, h):
+                self._hooks, self._h = hooks, h
+
+            def remove(self):
+                if self._h in self._hooks:
+                    self._hooks.remove(self._h)
+
+        return _Removable(self._grad_hooks, hook)
+
+    # -- value mutation (functional under the hood) ---------------------
+    def copy_(self, other: "Tensor") -> "Tensor":
+        self._value = other._value if isinstance(other, Tensor) else jnp.asarray(other)
+        return self
+
+    def set_value(self, value) -> None:
+        if isinstance(value, Tensor):
+            value = value._value
+        self._value = jnp.asarray(value, dtype=self._value.dtype)
+
+    def fill_(self, v) -> "Tensor":
+        self._value = jnp.full_like(self._value, v)
+        return self
+
+    def zero_(self) -> "Tensor":
+        self._value = jnp.zeros_like(self._value)
+        return self
+
+    def _replace_value(self, value) -> None:
+        """Internal: swap the backing array (optimizer updates)."""
+        self._value = value
+
+    def __setitem__(self, idx, value) -> None:
+        if isinstance(value, Tensor):
+            value = value._value
+        if isinstance(idx, Tensor):
+            idx = idx._value
+        if isinstance(idx, tuple):
+            idx = tuple(i._value if isinstance(i, Tensor) else i for i in idx)
+        self._value = self._value.at[idx].set(value)
+
+    def __repr__(self):
+        grad_info = "" if self.stop_gradient else ", stop_gradient=False"
+        return (f"Tensor(shape={self.shape}, dtype={self.dtype}{grad_info},\n"
+                f"       {self._value})")
+
+
+class Parameter(Tensor):
+    """Trainable tensor (analog of paddle's EagerParamBase)."""
+
+    def __init__(self, value, name: Optional[str] = None, trainable: bool = True):
+        super().__init__(value, stop_gradient=not trainable, name=name)
+        self.persistable = True
+        self.trainable = trainable
+
+    def __repr__(self):
+        return "Parameter containing:\n" + super().__repr__()
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient: bool = True) -> Tensor:
+    """Create a Tensor from python/numpy data (paddle.to_tensor)."""
+    if isinstance(data, Tensor):
+        value = data._value
+        if dtype is not None:
+            value = value.astype(convert_dtype(dtype))
+        return Tensor(value, stop_gradient=stop_gradient)
+    if dtype is None:
+        if isinstance(data, (bool, np.bool_)):
+            pass  # bool stays bool
+        elif isinstance(data, (float,)):
+            dtype = get_default_dtype()
+        elif isinstance(data, np.ndarray) and data.dtype == np.float64:
+            dtype = get_default_dtype()
+    value = jnp.asarray(data, dtype=convert_dtype(dtype) if dtype is not None else None)
+    return Tensor(value, stop_gradient=stop_gradient)

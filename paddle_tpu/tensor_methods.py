@@ -1,0 +1,110 @@
+"""Monkey-patch math/manipulation methods onto Tensor.
+
+The reference binds Tensor methods in C++ (pybind eager_method.cc) and
+monkey-patches the rest from python (python/paddle/base/dygraph/math_op_patch.py).
+We use the same late-binding strategy to break the Tensor <-> ops cycle.
+"""
+from __future__ import annotations
+
+from .core.dtype import convert_dtype
+from .tensor import Tensor
+from .ops import creation, manipulation, math, nn_ops
+
+
+def _patch():
+    T = Tensor
+
+    # -- arithmetic dunders --------------------------------------------
+    T.__add__ = lambda s, o: math.add(s, o)
+    T.__radd__ = lambda s, o: math.add(s, o)
+    T.__sub__ = lambda s, o: math.subtract(s, o)
+    T.__rsub__ = lambda s, o: math.subtract(o, s) if isinstance(o, Tensor) \
+        else math.scale(math.subtract(s, o), scale=-1.0)
+    T.__mul__ = lambda s, o: math.multiply(s, o)
+    T.__rmul__ = lambda s, o: math.multiply(s, o)
+    T.__truediv__ = lambda s, o: math.divide(s, o)
+    T.__rtruediv__ = lambda s, o: math.divide(o, s) if isinstance(o, Tensor) \
+        else math.multiply(math.reciprocal(s), o)
+    T.__floordiv__ = lambda s, o: math.floor_divide(s, o)
+    T.__mod__ = lambda s, o: math.remainder(s, o)
+    T.__pow__ = lambda s, o: math.pow(s, o)
+    T.__rpow__ = lambda s, o: math.pow(creation.full_like(s, o), s)
+    T.__neg__ = lambda s: math.neg(s)
+    T.__abs__ = lambda s: math.abs(s)
+    T.__matmul__ = lambda s, o: math.matmul(s, o)
+    T.__invert__ = lambda s: math.logical_not(s)
+
+    # -- comparisons (assigned post-class-creation so __hash__ survives)
+    T.__eq__ = lambda s, o: math.equal(s, o)
+    T.__ne__ = lambda s, o: math.not_equal(s, o)
+    T.__lt__ = lambda s, o: math.less_than(s, o)
+    T.__le__ = lambda s, o: math.less_equal(s, o)
+    T.__gt__ = lambda s, o: math.greater_than(s, o)
+    T.__ge__ = lambda s, o: math.greater_equal(s, o)
+
+    # -- indexing ------------------------------------------------------
+    T.__getitem__ = lambda s, item: manipulation.getitem(s, item)
+
+    # -- named methods: ops functions double as methods (self = 1st arg)
+    for name in [
+        "add", "subtract", "multiply", "divide", "pow", "maximum", "minimum",
+        "remainder", "floor_divide",
+        "exp", "log", "log2", "log10", "log1p", "sqrt", "rsqrt", "abs",
+        "sign", "reciprocal", "square", "sin", "cos", "tan", "tanh", "erf",
+        "floor", "ceil", "round", "trunc", "clip", "scale", "neg", "lerp",
+        "sum", "mean", "max", "min", "prod", "logsumexp", "std", "var",
+        "all", "any", "cumsum", "cumprod",
+        "matmul", "dot", "t", "norm", "bmm",
+        "equal", "not_equal", "less_than", "less_equal", "greater_than",
+        "greater_equal", "logical_and", "logical_or", "logical_not",
+        "isnan", "isinf", "isfinite", "isclose", "allclose",
+        "argmax", "argmin", "argsort", "sort", "topk", "where", "nonzero",
+    ]:
+        setattr(T, name, getattr(math, name))
+
+    for name in [
+        "reshape", "transpose", "squeeze", "unsqueeze", "expand",
+        "expand_as", "broadcast_to", "tile", "roll", "flip",
+        "gather", "gather_nd", "index_select", "scatter", "split", "chunk",
+        "unbind", "tril", "triu", "take_along_axis", "put_along_axis",
+        "masked_fill", "repeat_interleave", "numel", "unstack",
+    ]:
+        setattr(T, name, getattr(manipulation, name))
+
+    T.flatten = lambda s, start_axis=0, stop_axis=-1: manipulation.flatten(
+        s, start_axis=start_axis, stop_axis=stop_axis)
+    T.astype = lambda s, dtype: manipulation.cast(s, dtype=convert_dtype(dtype))
+    T.cast = T.astype
+    T.dim = lambda s: s.ndim
+    T.rank = lambda s: s.ndim
+    T.zeros_like = lambda s: creation.zeros_like(s)
+    T.ones_like = lambda s: creation.ones_like(s)
+    T.softmax = lambda s, axis=-1: nn_ops.softmax(s, axis=axis)
+    T.mm = lambda s, o: math.matmul(s, o)
+    T.T = property(lambda s: manipulation.transpose(
+        s, perm=tuple(range(s.ndim))[::-1]))
+
+    # -- in-place variants (functional under the hood) -----------------
+    def _make_inplace(fn):
+        def method(self, *args, **kwargs):
+            out = fn(self, *args, **kwargs)
+            self._value = out._value
+            self._grad_node = out._grad_node
+            self._out_idx = out._out_idx
+            if not out.stop_gradient:
+                self.stop_gradient = False
+            return self
+        return method
+
+    for name, fn in [
+        ("add_", math.add), ("subtract_", math.subtract),
+        ("multiply_", math.multiply), ("divide_", math.divide),
+        ("scale_", math.scale), ("clip_", math.clip),
+        ("exp_", math.exp), ("sqrt_", math.sqrt),
+        ("reshape_", manipulation.reshape), ("squeeze_", manipulation.squeeze),
+        ("unsqueeze_", manipulation.unsqueeze),
+    ]:
+        setattr(T, name, _make_inplace(fn))
+
+
+_patch()

@@ -1,0 +1,2 @@
+"""Vision: models/datasets/transforms (reference: python/paddle/vision/)."""
+from . import models  # noqa: F401

@@ -1,0 +1,5 @@
+from .resnet import (ResNet, resnet18, resnet34, resnet50, resnet101,
+                     resnet152)  # noqa: F401
+
+__all__ = ["ResNet", "resnet18", "resnet34", "resnet50", "resnet101",
+           "resnet152"]

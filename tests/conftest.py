@@ -1,0 +1,20 @@
+"""Test harness: force the XLA CPU backend with 8 virtual devices so the
+multi-chip sharding paths are exercised without TPU hardware (the
+reference's fake_cpu_device / gloo-backend strategy, SURVEY.md §4).
+
+NOTE: the environment's sitecustomize force-selects the 'axon' TPU
+platform via jax.config, so setting JAX_PLATFORMS alone is not enough —
+we must update jax.config before any backend initialises.
+"""
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

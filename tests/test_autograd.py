@@ -1,0 +1,150 @@
+"""Autograd engine semantics (reference: test/legacy_test autograd tests +
+fluid/eager/backward.cc behaviors)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.autograd import PyLayer
+
+
+def t(x, sg=False):
+    return paddle.to_tensor(np.asarray(x, dtype=np.float32), stop_gradient=sg)
+
+
+class TestBackward:
+    def test_simple_chain(self):
+        x = t([2.0])
+        y = x * x + 3.0 * x
+        y.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [7.0])
+
+    def test_grad_accumulation(self):
+        x = t([1.0, 2.0])
+        (x * 2.0).sum().backward()
+        (x * 3.0).sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [5.0, 5.0])
+
+    def test_shared_input(self):
+        x = t([3.0])
+        y = x * x  # both edges to same leaf
+        y.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [6.0])
+
+    def test_diamond(self):
+        x = t([2.0])
+        a = x * 2.0
+        b = x * 3.0
+        (a * b).backward()  # d/dx 6x^2 = 12x
+        np.testing.assert_allclose(x.grad.numpy(), [24.0])
+
+    def test_stop_gradient(self):
+        x = t([1.0])
+        y = t([2.0], sg=True)
+        (x * y).backward()
+        np.testing.assert_allclose(x.grad.numpy(), [2.0])
+        assert y.grad is None
+
+    def test_no_grad_context(self):
+        x = t([1.0])
+        with paddle.no_grad():
+            y = x * 5.0
+        assert y.stop_gradient
+        assert y._grad_node is None
+
+    def test_detach(self):
+        x = t([1.0])
+        y = (x * 2.0).detach()
+        z = y * 3.0
+        z.backward()
+        assert x.grad is None
+
+    def test_backward_with_grad_tensor(self):
+        x = t([1.0, 2.0])
+        y = x * 2.0
+        y.backward(paddle.to_tensor(np.array([0.5, 2.0], np.float32)))
+        np.testing.assert_allclose(x.grad.numpy(), [1.0, 4.0])
+
+    def test_multi_output_op(self):
+        x = t(np.arange(6.0).reshape(2, 3))
+        a, b = paddle.split(x, 2, axis=0)
+        (a.sum() * 2.0 + b.sum() * 3.0).backward()
+        np.testing.assert_allclose(
+            x.grad.numpy(), [[2, 2, 2], [3, 3, 3]])
+
+    def test_retain_graph(self):
+        x = t([2.0])
+        y = x * x
+        y.backward(retain_graph=True)
+        y.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [8.0])
+
+    def test_paddle_grad_api(self):
+        x = t([3.0])
+        y = x * x
+        (gx,) = paddle.grad(y, x)
+        np.testing.assert_allclose(gx.numpy(), [6.0])
+        assert x.grad is None  # paddle.grad does not pollute .grad
+
+    def test_leaf_hook(self):
+        x = t([1.0])
+        seen = []
+        x.register_hook(lambda g: seen.append(g.numpy().copy()))
+        (x * 4.0).backward()
+        assert len(seen) == 1
+        np.testing.assert_allclose(seen[0], [4.0])
+
+    def test_hook_modifies_grad(self):
+        x = t([1.0])
+        x.register_hook(lambda g: g * 2.0)
+        (x * 3.0).backward()
+        np.testing.assert_allclose(x.grad.numpy(), [6.0])
+
+
+class TestPyLayer:
+    def test_custom_fwd_bwd(self):
+        class Double(PyLayer):
+            @staticmethod
+            def forward(ctx, x):
+                ctx.save_for_backward(x)
+                return x * 2.0
+
+            @staticmethod
+            def backward(ctx, gout):
+                (x,) = ctx.saved_tensor
+                return gout * 2.0
+
+        x = t([1.5])
+        y = Double.apply(x)
+        np.testing.assert_allclose(y.numpy(), [3.0])
+        y.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [2.0])
+
+    def test_multi_io(self):
+        class MulAdd(PyLayer):
+            @staticmethod
+            def forward(ctx, a, b):
+                ctx.save_for_backward(a, b)
+                return a * b, a + b
+
+            @staticmethod
+            def backward(ctx, g1, g2):
+                a, b = ctx.saved_tensor
+                return g1 * b + g2, g1 * a + g2
+
+        a, b = t([2.0]), t([3.0])
+        p, s = MulAdd.apply(a, b)
+        (p + s).backward()
+        np.testing.assert_allclose(a.grad.numpy(), [4.0])
+        np.testing.assert_allclose(b.grad.numpy(), [3.0])
+
+
+class TestInplace:
+    def test_add_(self):
+        x = t([1.0])
+        x.add_(t([2.0], sg=True))
+        np.testing.assert_allclose(x.numpy(), [3.0])
+
+    def test_setitem(self):
+        x = paddle.to_tensor(np.zeros((3, 3), np.float32))
+        x[1, 1] = 5.0
+        assert x.numpy()[1, 1] == 5.0

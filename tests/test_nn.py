@@ -1,0 +1,135 @@
+"""Layer system tests."""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+
+
+def r(*shape):
+    return np.random.RandomState(0).rand(*shape).astype(np.float32)
+
+
+class TestLayerBase:
+    def test_parameters_and_naming(self):
+        m = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+        names = [n for n, _ in m.named_parameters()]
+        assert names == ["0.weight", "0.bias", "2.weight", "2.bias"]
+        assert len(m.parameters()) == 4
+
+    def test_state_dict_roundtrip(self):
+        m1 = nn.Linear(3, 3)
+        m2 = nn.Linear(3, 3)
+        m2.set_state_dict(m1.state_dict())
+        np.testing.assert_array_equal(m1.weight.numpy(), m2.weight.numpy())
+
+    def test_train_eval_dropout(self):
+        m = nn.Dropout(0.5)
+        x = paddle.to_tensor(r(100))
+        m.eval()
+        np.testing.assert_array_equal(m(x).numpy(), x.numpy())
+        m.train()
+        out = m(x).numpy()
+        assert (out == 0).any()
+
+    def test_buffers_in_state_dict(self):
+        bn = nn.BatchNorm2D(4)
+        sd = bn.state_dict()
+        assert "_mean" in sd and "_variance" in sd
+
+    def test_forward_hooks(self):
+        m = nn.Linear(2, 2)
+        calls = []
+        h = m.register_forward_post_hook(
+            lambda layer, inp, out: calls.append(1))
+        m(paddle.to_tensor(r(1, 2)))
+        assert calls == [1]
+        h.remove()
+        m(paddle.to_tensor(r(1, 2)))
+        assert calls == [1]
+
+    def test_to_dtype(self):
+        m = nn.Linear(2, 2).to(dtype="bfloat16")
+        assert str(m.weight.dtype) == "bfloat16"
+
+    def test_apply_and_sublayers(self):
+        m = nn.Sequential(nn.Linear(2, 2), nn.Sequential(nn.Linear(2, 2)))
+        assert len(m.sublayers()) == 3
+
+
+class TestLayers:
+    def test_linear_shape(self):
+        m = nn.Linear(5, 7)
+        out = m(paddle.to_tensor(r(2, 3, 5)))
+        assert out.shape == [2, 3, 7]
+
+    def test_conv_bn_pool(self):
+        m = nn.Sequential(
+            nn.Conv2D(3, 8, 3, padding=1), nn.BatchNorm2D(8), nn.ReLU(),
+            nn.MaxPool2D(2))
+        out = m(paddle.to_tensor(r(2, 3, 8, 8)))
+        assert out.shape == [2, 8, 4, 4]
+
+    def test_batchnorm_stats_update(self):
+        bn = nn.BatchNorm2D(2, momentum=0.5)
+        x = paddle.to_tensor(np.random.randn(4, 2, 3, 3).astype(np.float32) + 5)
+        bn.train()
+        bn(x)
+        assert abs(float(bn._mean.numpy().mean()) - 2.5) < 1.0  # moved toward 5*0.5
+        bn.eval()
+        m0 = bn._mean.numpy().copy()
+        bn(x)
+        np.testing.assert_array_equal(bn._mean.numpy(), m0)
+
+    def test_layernorm_rmsnorm(self):
+        ln = nn.LayerNorm(8)
+        rms = nn.RMSNorm(8)
+        x = paddle.to_tensor(np.random.randn(2, 4, 8).astype(np.float32))
+        assert ln(x).shape == [2, 4, 8]
+        assert rms(x).shape == [2, 4, 8]
+
+    def test_embedding_padding(self):
+        emb = nn.Embedding(10, 4, padding_idx=0)
+        out = emb(paddle.to_tensor(np.array([[0, 1]])))
+        assert np.all(out.numpy()[0, 0] == 0)
+
+    def test_mha_self_attention(self):
+        mha = nn.MultiHeadAttention(16, 4)
+        x = paddle.to_tensor(np.random.randn(2, 5, 16).astype(np.float32))
+        out = mha(x)
+        assert out.shape == [2, 5, 16]
+
+    def test_mha_cache_decode(self):
+        mha = nn.MultiHeadAttention(16, 4)
+        mha.eval()
+        x = paddle.to_tensor(np.random.randn(1, 1, 16).astype(np.float32))
+        cache = mha.gen_cache(x)
+        out, cache = mha(x, x, x, None, cache)
+        assert cache.k.shape[1] == 1
+        out, cache = mha(x, x, x, None, cache)
+        assert cache.k.shape[1] == 2
+
+    def test_transformer_encoder(self):
+        layer = nn.TransformerEncoderLayer(16, 4, 32)
+        enc = nn.TransformerEncoder(layer, 2)
+        x = paddle.to_tensor(np.random.randn(2, 6, 16).astype(np.float32))
+        assert enc(x).shape == [2, 6, 16]
+
+    def test_loss_layers(self):
+        ce = nn.CrossEntropyLoss()
+        loss = ce(paddle.to_tensor(np.random.randn(4, 5).astype(np.float32)),
+                  paddle.to_tensor(np.array([0, 1, 2, 3])))
+        assert loss.shape == []
+        mse = nn.MSELoss()
+        out = mse(paddle.to_tensor(r(3)), paddle.to_tensor(r(3)))
+        assert float(out.numpy()) >= 0
+
+
+class TestSaveLoad:
+    def test_save_load_roundtrip(self, tmp_path):
+        m = nn.Linear(4, 4)
+        path = str(tmp_path / "model.pdparams")
+        paddle.save(m.state_dict(), path)
+        loaded = paddle.load(path)
+        m2 = nn.Linear(4, 4)
+        m2.set_state_dict(loaded)
+        np.testing.assert_array_equal(m.weight.numpy(), m2.weight.numpy())

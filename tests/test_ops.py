@@ -1,0 +1,295 @@
+"""Op numerics vs numpy references (OpTest pattern, SURVEY.md §4)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+
+from utils import check_grad, check_output
+
+rng = np.random.RandomState(7)
+
+
+def r(*shape):
+    return rng.rand(*shape).astype(np.float32)
+
+
+def rn(*shape):
+    return rng.randn(*shape).astype(np.float32)
+
+
+class TestElementwise:
+    def test_add(self):
+        check_output(paddle.add, np.add, [r(3, 4), r(3, 4)])
+
+    def test_broadcast_add(self):
+        check_output(paddle.add, np.add, [r(3, 4), r(4)])
+
+    def test_subtract(self):
+        check_output(paddle.subtract, np.subtract, [r(3, 4), r(3, 4)])
+
+    def test_multiply(self):
+        check_output(paddle.multiply, np.multiply, [r(3, 4), r(3, 4)])
+
+    def test_divide(self):
+        check_output(paddle.divide, np.divide, [r(3, 4), r(3, 4) + 0.5])
+
+    def test_pow(self):
+        check_output(paddle.pow, np.power, [r(3, 4) + 0.1, r(3, 4)])
+
+    def test_maximum(self):
+        check_output(paddle.maximum, np.maximum, [rn(3, 4), rn(3, 4)])
+
+    def test_exp_log_sqrt(self):
+        check_output(paddle.exp, np.exp, [rn(5)])
+        check_output(paddle.log, np.log, [r(5) + 0.1])
+        check_output(paddle.sqrt, np.sqrt, [r(5) + 0.1])
+
+    def test_trig(self):
+        check_output(paddle.sin, np.sin, [rn(5)])
+        check_output(paddle.cos, np.cos, [rn(5)])
+        check_output(paddle.tanh, np.tanh, [rn(5)])
+
+    def test_clip(self):
+        x = rn(4, 4)
+        out = paddle.clip(paddle.to_tensor(x), min=-0.5, max=0.5)
+        np.testing.assert_allclose(out.numpy(), np.clip(x, -0.5, 0.5))
+
+    def test_scalar_ops(self):
+        x = paddle.to_tensor(r(3, 3))
+        np.testing.assert_allclose((x + 1.0).numpy(), x.numpy() + 1.0)
+        np.testing.assert_allclose((2.0 * x).numpy(), 2.0 * x.numpy())
+        np.testing.assert_allclose((1.0 - x).numpy(), 1.0 - x.numpy(),
+                                   rtol=1e-6)
+
+
+class TestReduction:
+    def test_sum(self):
+        check_output(paddle.sum, lambda x, **k: np.sum(x), [r(3, 4)])
+        x = r(3, 4, 5)
+        out = paddle.sum(paddle.to_tensor(x), axis=1, keepdim=True)
+        np.testing.assert_allclose(out.numpy(), x.sum(1, keepdims=True),
+                                   rtol=1e-6)
+
+    def test_mean_max_min(self):
+        x = rn(3, 4)
+        np.testing.assert_allclose(paddle.mean(paddle.to_tensor(x)).numpy(),
+                                   x.mean(), rtol=1e-6)
+        np.testing.assert_allclose(
+            paddle.max(paddle.to_tensor(x), axis=1).numpy(), x.max(1))
+        np.testing.assert_allclose(
+            paddle.min(paddle.to_tensor(x), axis=0).numpy(), x.min(0))
+
+    def test_cumsum(self):
+        x = rn(3, 4)
+        np.testing.assert_allclose(
+            paddle.cumsum(paddle.to_tensor(x), axis=1).numpy(),
+            np.cumsum(x, 1), rtol=1e-6)
+
+    def test_argmax_topk(self):
+        x = rn(4, 6)
+        np.testing.assert_array_equal(
+            paddle.argmax(paddle.to_tensor(x), axis=1).numpy(),
+            np.argmax(x, 1))
+        vals, idx = paddle.topk(paddle.to_tensor(x), 3, axis=-1)
+        ref = np.sort(x, axis=-1)[:, ::-1][:, :3]
+        np.testing.assert_allclose(vals.numpy(), ref, rtol=1e-6)
+
+
+class TestMatmul:
+    def test_2d(self):
+        check_output(paddle.matmul, lambda a, b, **k: a @ b, [r(3, 4), r(4, 5)])
+
+    def test_batched(self):
+        check_output(paddle.matmul, lambda a, b, **k: a @ b,
+                     [r(2, 3, 4), r(2, 4, 5)], rtol=1e-4)
+
+    def test_transpose_flags(self):
+        a, b = r(4, 3), r(4, 5)
+        out = paddle.matmul(paddle.to_tensor(a), paddle.to_tensor(b),
+                            transpose_x=True)
+        np.testing.assert_allclose(out.numpy(), a.T @ b, rtol=1e-5)
+
+    def test_einsum(self):
+        a, b = r(2, 3, 4), r(2, 4, 5)
+        out = paddle.einsum("bij,bjk->bik", paddle.to_tensor(a),
+                            paddle.to_tensor(b))
+        np.testing.assert_allclose(out.numpy(), np.einsum("bij,bjk->bik", a, b),
+                                   rtol=1e-5)
+
+
+class TestManipulation:
+    def test_reshape_transpose(self):
+        x = r(2, 3, 4)
+        t = paddle.to_tensor(x)
+        np.testing.assert_array_equal(
+            t.reshape([6, 4]).numpy(), x.reshape(6, 4))
+        np.testing.assert_array_equal(
+            t.transpose([2, 0, 1]).numpy(), x.transpose(2, 0, 1))
+
+    def test_concat_split_stack(self):
+        a, b = r(2, 3), r(2, 3)
+        cat = paddle.concat([paddle.to_tensor(a), paddle.to_tensor(b)], axis=0)
+        np.testing.assert_array_equal(cat.numpy(), np.concatenate([a, b], 0))
+        parts = paddle.split(cat, 2, axis=0)
+        np.testing.assert_array_equal(parts[0].numpy(), a)
+        st = paddle.stack([paddle.to_tensor(a), paddle.to_tensor(b)], axis=0)
+        np.testing.assert_array_equal(st.numpy(), np.stack([a, b], 0))
+
+    def test_squeeze_unsqueeze_tile(self):
+        x = r(2, 1, 3)
+        t = paddle.to_tensor(x)
+        np.testing.assert_array_equal(t.squeeze(1).numpy(), x.squeeze(1))
+        np.testing.assert_array_equal(
+            t.unsqueeze(0).numpy(), x[None])
+        np.testing.assert_array_equal(
+            paddle.tile(t, [2, 1, 1]).numpy(), np.tile(x, (2, 1, 1)))
+
+    def test_gather_indexing(self):
+        x = r(5, 4)
+        idx = np.array([0, 2, 4])
+        t = paddle.to_tensor(x)
+        np.testing.assert_array_equal(
+            paddle.gather(t, paddle.to_tensor(idx), axis=0).numpy(), x[idx])
+        np.testing.assert_array_equal(t[1:3, ::2].numpy(), x[1:3, ::2])
+        np.testing.assert_array_equal(t[paddle.to_tensor(idx)].numpy(), x[idx])
+
+    def test_where_tril(self):
+        x, y = rn(3, 3), rn(3, 3)
+        cond = x > 0
+        out = paddle.where(paddle.to_tensor(cond), paddle.to_tensor(x),
+                           paddle.to_tensor(y))
+        np.testing.assert_array_equal(out.numpy(), np.where(cond, x, y))
+        np.testing.assert_array_equal(
+            paddle.tril(paddle.to_tensor(x)).numpy(), np.tril(x))
+
+    def test_cast(self):
+        x = r(3, 3)
+        t = paddle.to_tensor(x).astype("float16")
+        assert str(t.dtype) == "float16"
+
+    def test_pad(self):
+        x = r(2, 3)
+        out = paddle.nn.functional.pad(paddle.to_tensor(x), [1, 2],
+                                       mode="constant", value=0.0)
+        np.testing.assert_array_equal(out.numpy(),
+                                      np.pad(x, [(0, 0), (1, 2)]))
+
+
+class TestNNOps:
+    def test_softmax(self):
+        x = rn(3, 5)
+        out = F.softmax(paddle.to_tensor(x), axis=-1)
+        e = np.exp(x - x.max(-1, keepdims=True))
+        np.testing.assert_allclose(out.numpy(), e / e.sum(-1, keepdims=True),
+                                   rtol=1e-5)
+
+    def test_relu_gelu_silu(self):
+        x = rn(4, 4)
+        np.testing.assert_allclose(F.relu(paddle.to_tensor(x)).numpy(),
+                                   np.maximum(x, 0))
+        g = F.gelu(paddle.to_tensor(x)).numpy()
+        from scipy.special import erf as serf  # scipy ships with image
+        ref = 0.5 * x * (1 + serf(x / np.sqrt(2)))
+        np.testing.assert_allclose(g, ref, rtol=1e-4, atol=1e-5)
+
+    def test_layer_norm(self):
+        x = rn(2, 3, 8)
+        w, b = r(8), r(8)
+        out = F.layer_norm(paddle.to_tensor(x), paddle.to_tensor(w),
+                           paddle.to_tensor(b), epsilon=1e-5)
+        mu = x.mean(-1, keepdims=True)
+        var = x.var(-1, keepdims=True)
+        ref = (x - mu) / np.sqrt(var + 1e-5) * w + b
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-4, atol=1e-5)
+
+    def test_rms_norm(self):
+        x = rn(2, 8)
+        w = r(8)
+        out = F.rms_norm(paddle.to_tensor(x), paddle.to_tensor(w))
+        ref = x / np.sqrt((x ** 2).mean(-1, keepdims=True) + 1e-6) * w
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-4, atol=1e-5)
+
+    def test_cross_entropy(self):
+        logits = rn(4, 7)
+        label = np.array([1, 3, 0, 6])
+        loss = F.cross_entropy(paddle.to_tensor(logits),
+                               paddle.to_tensor(label))
+        lse = np.log(np.exp(logits).sum(-1))
+        ref = (lse - logits[np.arange(4), label]).mean()
+        np.testing.assert_allclose(float(loss.numpy()), ref, rtol=1e-5)
+
+    def test_conv2d(self):
+        x = rn(1, 2, 5, 5)
+        w = rn(3, 2, 3, 3)
+        out = F.conv2d(paddle.to_tensor(x), paddle.to_tensor(w), padding=1)
+        assert out.shape == [1, 3, 5, 5]
+        # centre value check vs manual correlation
+        ref = sum((x[0, c, 1:4, 1:4] * w[0, c]).sum() for c in range(2))
+        np.testing.assert_allclose(out.numpy()[0, 0, 2, 2], ref, rtol=1e-4)
+
+    def test_max_avg_pool(self):
+        x = rn(1, 1, 4, 4)
+        mp = F.max_pool2d(paddle.to_tensor(x), kernel_size=2)
+        np.testing.assert_allclose(
+            mp.numpy()[0, 0],
+            x[0, 0].reshape(2, 2, 2, 2).transpose(0, 2, 1, 3).reshape(
+                2, 2, 4).max(-1))
+        ap = F.avg_pool2d(paddle.to_tensor(x), kernel_size=2)
+        np.testing.assert_allclose(
+            ap.numpy()[0, 0],
+            x[0, 0].reshape(2, 2, 2, 2).transpose(0, 2, 1, 3).reshape(
+                2, 2, 4).mean(-1), rtol=1e-6)
+
+    def test_embedding(self):
+        w = rn(10, 4)
+        ids = np.array([[1, 2], [3, 4]])
+        out = F.embedding(paddle.to_tensor(ids), paddle.to_tensor(w))
+        np.testing.assert_array_equal(out.numpy(), w[ids])
+
+    def test_attention_causal(self):
+        q = rn(2, 4, 2, 8)
+        out = F.scaled_dot_product_attention(
+            paddle.to_tensor(q), paddle.to_tensor(q), paddle.to_tensor(q),
+            is_causal=True)
+        assert out.shape == [2, 4, 2, 8]
+        # first position attends only to itself -> equals v[0]
+        np.testing.assert_allclose(out.numpy()[:, 0], q[:, 0], rtol=1e-5)
+
+
+class TestGrads:
+    def test_elementwise_grads(self):
+        check_grad(paddle.multiply, [rn(3, 3), rn(3, 3)])
+        check_grad(paddle.divide, [rn(3, 3), r(3, 3) + 0.5])
+        check_grad(paddle.tanh, [rn(3, 3)])
+
+    def test_matmul_grad(self):
+        check_grad(paddle.matmul, [rn(3, 4), rn(4, 2)])
+
+    def test_softmax_grad(self):
+        check_grad(lambda x: F.softmax(x, axis=-1), [rn(3, 5)])
+
+    def test_layernorm_grad(self):
+        check_grad(lambda x, w, b: F.layer_norm(x, w, b), [rn(2, 6), r(6), r(6)])
+
+    def test_conv_grad(self):
+        check_grad(lambda x, w: F.conv2d(x, w, padding=1),
+                   [rn(1, 2, 4, 4), rn(2, 2, 3, 3)])
+
+    def test_embedding_grad(self):
+        w = rn(6, 3)
+        ids = np.array([0, 2, 2, 5])
+        wt = paddle.to_tensor(w, stop_gradient=False)
+        out = F.embedding(paddle.to_tensor(ids), wt)
+        out.sum().backward()
+        ref = np.zeros_like(w)
+        for i in ids:
+            ref[i] += 1.0
+        np.testing.assert_allclose(wt.grad.numpy(), ref)
+
+    def test_cross_entropy_grad(self):
+        check_grad(lambda x: F.cross_entropy(x, paddle.to_tensor(
+            np.array([1, 0, 2]))), [rn(3, 4)], rtol=2e-2)
+
+    def test_broadcast_grad(self):
+        check_grad(paddle.add, [rn(3, 4), rn(4)])

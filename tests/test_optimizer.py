@@ -1,0 +1,94 @@
+"""Optimizer correctness + convergence tests."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+
+
+def _fit(opt_cls, steps=60, **kw):
+    np.random.seed(0)
+    paddle.seed(0)
+    w_true = np.array([[2.0], [-3.0]], np.float32)
+    x = np.random.randn(64, 2).astype(np.float32)
+    y = x @ w_true
+    model = nn.Linear(2, 1)
+    opt = opt_cls(parameters=model.parameters(), **kw)
+    loss_val = None
+    for _ in range(steps):
+        pred = model(paddle.to_tensor(x))
+        loss = nn.functional.mse_loss(pred, paddle.to_tensor(y))
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        loss_val = float(loss.numpy())
+    return loss_val
+
+
+class TestConvergence:
+    def test_sgd(self):
+        assert _fit(optimizer.SGD, learning_rate=0.1) < 1e-2
+
+    def test_momentum(self):
+        assert _fit(optimizer.Momentum, learning_rate=0.05) < 1e-2
+
+    def test_adam(self):
+        assert _fit(optimizer.Adam, steps=150, learning_rate=0.1) < 1e-2
+
+    def test_adamw(self):
+        assert _fit(optimizer.AdamW, steps=150, learning_rate=0.1,
+                    weight_decay=0.001) < 1e-2
+
+
+class TestSemantics:
+    def test_adam_matches_reference_formula(self):
+        p0 = np.array([1.0], np.float32)
+        g = np.array([0.5], np.float32)
+        p = paddle.Parameter(paddle.to_tensor(p0)._value)
+        p.grad = paddle.to_tensor(g)
+        opt = optimizer.Adam(learning_rate=0.1, parameters=[p])
+        opt.step()
+        m = 0.1 * g
+        v = 0.001 * g * g
+        mhat = m / 0.1
+        vhat = v / 0.001
+        ref = p0 - 0.1 * mhat / (np.sqrt(vhat) + 1e-8)
+        np.testing.assert_allclose(p.numpy(), ref, rtol=1e-5)
+
+    def test_grad_clip_global_norm(self):
+        p = paddle.Parameter(paddle.to_tensor(np.zeros(4, np.float32))._value)
+        p.grad = paddle.to_tensor(np.full(4, 10.0, np.float32))
+        opt = optimizer.SGD(learning_rate=1.0, parameters=[p],
+                            grad_clip=nn.ClipGradByGlobalNorm(1.0))
+        opt.step()
+        # grad norm 20 -> clipped to 1 -> each component 0.5
+        np.testing.assert_allclose(p.numpy(), -np.full(4, 0.5), rtol=1e-5)
+
+    def test_lr_scheduler(self):
+        sched = optimizer.lr.StepDecay(0.1, step_size=2, gamma=0.5)
+        p = paddle.Parameter(paddle.to_tensor(np.zeros(1, np.float32))._value)
+        opt = optimizer.SGD(learning_rate=sched, parameters=[p])
+        assert abs(opt.get_lr() - 0.1) < 1e-9
+        sched.step(); sched.step()
+        assert abs(opt.get_lr() - 0.05) < 1e-9
+
+    def test_state_dict_roundtrip(self):
+        p = paddle.Parameter(paddle.to_tensor(np.ones(3, np.float32))._value)
+        p.name = "p"
+        p.grad = paddle.to_tensor(np.ones(3, np.float32))
+        opt = optimizer.Adam(learning_rate=0.1, parameters=[p])
+        opt.step()
+        sd = opt.state_dict()
+        opt2 = optimizer.Adam(learning_rate=0.1, parameters=[p])
+        opt2.set_state_dict(sd)
+        assert opt2._step_count == 1
+        np.testing.assert_allclose(
+            np.asarray(opt2._states[id(p)]["moment1"]),
+            np.asarray(opt._states[id(p)]["moment1"]))
+
+    def test_clear_grad(self):
+        p = paddle.Parameter(paddle.to_tensor(np.ones(1, np.float32))._value)
+        p.grad = paddle.to_tensor(np.ones(1, np.float32))
+        opt = optimizer.SGD(learning_rate=0.1, parameters=[p])
+        opt.clear_grad()
+        assert p.grad is None

@@ -1,0 +1,55 @@
+"""Shared test helpers: the OpTest pattern (reference:
+test/legacy_test/op_test.py:420 — numpy-reference forward check
+(check_output :2765) + numeric-differentiation grad check (check_grad
+:2975))."""
+import numpy as np
+
+import paddle_tpu as paddle
+
+
+def check_output(fn, np_fn, arrays, rtol=1e-5, atol=1e-6, **kwargs):
+    """Run op on Tensors and compare against a numpy reference."""
+    tensors = [paddle.to_tensor(a) for a in arrays]
+    out = fn(*tensors, **kwargs)
+    ref = np_fn(*arrays, **kwargs)
+    if isinstance(out, (list, tuple)):
+        for o, r in zip(out, ref):
+            np.testing.assert_allclose(o.numpy(), r, rtol=rtol, atol=atol)
+    else:
+        np.testing.assert_allclose(out.numpy(), ref, rtol=rtol, atol=atol)
+    return out
+
+
+def check_grad(fn, arrays, eps=1e-3, rtol=1e-2, atol=1e-3, **kwargs):
+    """Numeric gradient check of sum(fn(*args)) wrt each float input."""
+    tensors = [paddle.to_tensor(a, stop_gradient=False) for a in arrays]
+    out = fn(*tensors, **kwargs)
+    loss = out.sum() if not isinstance(out, (list, tuple)) else sum(
+        o.sum() for o in out)
+    loss.backward()
+
+    for i, a in enumerate(arrays):
+        if not np.issubdtype(np.asarray(a).dtype, np.floating):
+            continue
+        a = np.asarray(a, dtype=np.float64)
+        num_grad = np.zeros_like(a)
+        it = np.nditer(a, flags=["multi_index"])
+        while not it.finished:
+            idx = it.multi_index
+            ap = a.copy(); ap[idx] += eps
+            am = a.copy(); am[idx] -= eps
+
+            def run(val):
+                args = [paddle.to_tensor(np.asarray(
+                    val if j == i else arrays[j], dtype=np.float32))
+                    for j in range(len(arrays))]
+                o = fn(*args, **kwargs)
+                if isinstance(o, (list, tuple)):
+                    return float(sum(x.sum() for x in o).numpy())
+                return float(o.sum().numpy())
+
+            num_grad[idx] = (run(ap) - run(am)) / (2 * eps)
+            it.iternext()
+        got = tensors[i].grad.numpy()
+        np.testing.assert_allclose(got, num_grad, rtol=rtol, atol=atol,
+                                   err_msg=f"grad mismatch on input {i}")
