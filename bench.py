@@ -1,0 +1,92 @@
+"""Benchmark: GPT-125M training throughput on one chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+MFU = 6 * params * tokens_per_sec / peak_flops; vs_baseline is measured
+MFU over the north-star 45% target (BASELINE.md — the reference publishes
+no absolute numbers, so the target is the baseline).
+"""
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+# Peak dense bf16/f32 FLOPs per chip by TPU generation (public specs).
+_PEAK = {
+    "v4": 275e12, "v5e": 197e12, "v5p": 459e12, "v6e": 918e12,
+}
+
+
+def _peak_flops(device) -> float:
+    kind = str(getattr(device, "device_kind", "")).lower()
+    for k, v in _PEAK.items():
+        if k in kind:
+            return v
+    if "tpu" in str(getattr(device, "platform", "")).lower():
+        return 459e12  # assume v5p
+    return 0.0  # CPU: MFU not meaningful
+
+
+def main():
+    import jax
+
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed import fleet
+    from paddle_tpu.distributed.engine import ParallelEngine
+    from paddle_tpu.models import GPTConfig, GPTForCausalLM, \
+        GPTPretrainingCriterion
+
+    dev = jax.devices()[0]
+    on_tpu = "tpu" in str(dev.platform).lower() or _peak_flops(dev) > 0
+
+    if on_tpu:
+        cfg = GPTConfig(vocab_size=50304, hidden_size=768, num_layers=12,
+                        num_heads=12, max_position_embeddings=1024,
+                        dtype="bfloat16")
+        B, S, steps = 8, 1024, 5
+    else:  # CPU smoke config so bench runs anywhere
+        cfg = GPTConfig(vocab_size=1024, hidden_size=128, num_layers=2,
+                        num_heads=4, max_position_embeddings=128)
+        B, S, steps = 4, 64, 2
+
+    paddle.seed(0)
+    model = GPTForCausalLM(cfg)  # cfg.dtype='bfloat16' casts params on TPU
+    crit = GPTPretrainingCriterion(cfg)
+    opt = paddle.optimizer.AdamW(learning_rate=1e-4,
+                                 parameters=model.parameters())
+
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 1, "mp_degree": 1}
+    hcg = fleet.init(is_collective=True, strategy=strategy)
+    eng = ParallelEngine(model, opt, hcg.mesh)
+    step = eng.train_step(lambda m, b: crit(m(b["x"]), b["y"]))
+
+    r = np.random.RandomState(0)
+    ids = r.randint(0, cfg.vocab_size, (B, S + 1))
+    batch = {"x": paddle.to_tensor(ids[:, :-1]),
+             "y": paddle.to_tensor(ids[:, 1:])}
+
+    loss = step(batch)  # compile + warmup
+    float(loss)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = step(batch)
+    float(loss)
+    dt = time.perf_counter() - t0
+
+    tok_s = B * S * steps / dt
+    n_params = cfg.num_params()
+    peak = _peak_flops(dev)
+    mfu = (6.0 * n_params * tok_s / peak) if peak else 0.0
+    print(json.dumps({
+        "metric": "gpt125m_train_tokens_per_sec_per_chip" if on_tpu
+        else "gpt_smoke_train_tokens_per_sec",
+        "value": round(tok_s, 2),
+        "unit": "tokens/s",
+        "vs_baseline": round(mfu / 0.45, 4) if peak else 0.0,
+    }))
+
+
+if __name__ == "__main__":
+    main()

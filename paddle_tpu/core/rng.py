@@ -58,38 +58,100 @@ def fork_traced(seed_tensor):
 
     if isinstance(seed_tensor, Tensor):
         seed_tensor = seed_tensor._value
+    seed_val = seed_tensor.reshape(()).astype("uint32")
     prev = _key()
-    _state.key = jax.random.key(seed_tensor.reshape(()).astype("uint32"))
+    prev_traced = getattr(_state, "traced_seed", None)
+    _state.key = jax.random.key(seed_val)
+    _state.traced_seed = seed_val
     try:
         yield
     finally:
         _state.key = prev
+        _state.traced_seed = prev_traced
+
+
+def traced_seed():
+    """The traced per-step seed, when inside fork_traced (else None).
+    RNG state trackers fold this in so dropout masks differ per step
+    inside a compiled train step instead of baking into the graph."""
+    return getattr(_state, "traced_seed", None)
+
+
+LOCAL_SEED = "local_seed"
+GLOBAL_SEED = "global_seed"
 
 
 class RNGStatesTracker:
-    """Named RNG states (mpu/random.py analog) for TP-consistent dropout."""
+    """Named RNG states for hybrid-parallel dropout
+    (reference: fleet/layers/mpu/random.py:34 RNGStatesTracker — CUDA RNG
+    states so dropout inside TP regions differs per mp rank
+    ('local_seed') while dropout outside is identical across mp ranks
+    ('global_seed')).
+
+    TPU-native: states are jax PRNG keys. Inside a compiled step
+    (fork_traced active) keys fold in the traced per-step seed — so masks
+    vary per step without retracing — plus a per-entry counter so
+    distinct dropout sites draw distinct streams; 'local_seed'
+    additionally folds the mp axis_index so each mp rank gets an
+    independent stream for mp-sharded tensors.
+    """
 
     def __init__(self):
         self.states_: Dict[str, object] = {}
+        self.seeds_ = set()
+        self._entry_counter = 0
 
     def add(self, name: str, s: int) -> None:
+        if s in self.seeds_:
+            raise ValueError(f"seed {s} already exists")
         if name in self.states_:
             raise ValueError(f"rng state '{name}' already exists")
+        self.seeds_.add(s)
         self.states_[name] = jax.random.key(s)
 
     def reset(self) -> None:
         self.states_ = {}
+        self.seeds_ = set()
+        self._entry_counter = 0
+
+    def get_states_tracker(self):
+        return dict(self.states_)
+
+    def set_states_tracker(self, states):
+        self.states_ = dict(states)
 
     @contextlib.contextmanager
-    def rng_state(self, name: str = "global_seed"):
+    def rng_state(self, name: str = GLOBAL_SEED):
         if name not in self.states_:
             raise ValueError(f"rng state '{name}' not added")
+        key = self.states_[name]
+        folded = False
+        ts = traced_seed()
+        if ts is not None:  # inside a compiled step: vary per step & site
+            key = jax.random.fold_in(key, ts)
+            key = jax.random.fold_in(key, self._entry_counter)
+            self._entry_counter += 1
+            folded = True
+        if name == LOCAL_SEED:
+            from ..distributed import collective as _C
+
+            if _C.in_spmd_region():
+                from jax import lax
+
+                try:
+                    key = jax.random.fold_in(key, lax.axis_index("mp"))
+                    folded = True
+                except NameError:
+                    pass
         prev = _key()
-        _state.key = self.states_[name]
+        _state.key = key
         try:
             yield
         finally:
-            self.states_[name] = _state.key
+            if not folded:
+                # eager: persist the advanced key; traced: deliberately
+                # discard (a tracer must never escape into host state)
+                self.states_[name] = _state.key
             _state.key = prev
 
 

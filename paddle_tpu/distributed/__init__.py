@@ -12,6 +12,7 @@ from .collective import (  # noqa: F401
     isend, new_group, recv, reduce, reduce_scatter, scatter, send,
     spmd_region, ReduceOp, Group, ProcessGroup, split_group)
 from . import fleet  # noqa: F401
+from .engine import ParallelEngine, bind_params, shard_module_params  # noqa: F401
 from .parallel import DataParallel, ParallelEnv  # noqa: F401
 
 __all__ = [

@@ -1,0 +1,309 @@
+"""ParallelEngine: compiles an eager model + optimizer into ONE sharded
+XLA train step over the hybrid mesh.
+
+This is the TPU-native replacement for the reference's per-op dispatch
+inside `fleet.distributed_model` training loops (reference call stack:
+SURVEY.md §3.3 — Python-driven 1F1B + eager NCCL ops). Instead of
+host-dispatching thousands of ops per step, the engine traces the whole
+forward + tape-backward + fused optimizer update under
+``jax.shard_map`` over the ``HybridCommunicateGroup`` mesh, so:
+
+- every mp/dp/sharding/pp collective lowers to an XLA collective on ICI,
+- XLA fuses/overlaps compute and comm (the reference does this by hand
+  with comm streams + hooks, reducer.cc / sharding overlap),
+- parameters live as global ``jax.Array``s physically sharded per their
+  ``dist_attr`` PartitionSpec (set by the mpu/sharded layers), and the
+  step donates them (buffer aliasing → ZeRO-style memory behavior).
+
+The eager tape (autograd/engine.py) records on tracers, so
+``loss.backward()`` inside the traced step emits the backward into the
+same XLA program — the mechanism the reference approximates with
+jit.to_static + PIR interpreter (SURVEY.md §3.4).
+"""
+from __future__ import annotations
+
+import contextlib
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import jax
+import numpy as np
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from . import collective as C
+from ..autograd import engine as _ad
+from ..core import rng as _rng
+from ..tensor import Tensor
+
+try:
+    from jax import shard_map as _shard_map_mod  # jax >= 0.8
+
+    def _shard_map(f, mesh, in_specs, out_specs, check_rep=False):
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=check_rep)
+except Exception:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map as _sm
+
+    def _shard_map(f, mesh, in_specs, out_specs, check_rep=False):
+        return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                   check_rep=check_rep)
+
+__all__ = ["ParallelEngine", "bind_params", "param_spec", "shard_module_params"]
+
+_DATA_AXES = ("dp", "sharding")
+
+
+def param_spec(p) -> P:
+    """The PartitionSpec a tensor is sharded with (replicated default)."""
+    da = getattr(p, "dist_attr", None)
+    return da if isinstance(da, P) else P()
+
+
+@contextlib.contextmanager
+def bind_params(params: Sequence, values: Sequence):
+    """Temporarily swap each Parameter's backing array (functional call).
+
+    The analog of functorch-style functional_call; lets one model object
+    serve both the eager path and the traced SPMD step.
+    """
+    saved = [p._value for p in params]
+    saved_nodes = [(p._grad_node, p.grad) for p in params]
+    try:
+        for p, v in zip(params, values):
+            p._value = v
+            p._grad_node = None
+            p.grad = None
+        yield
+    finally:
+        for p, v, (n, g) in zip(params, saved, saved_nodes):
+            p._value = v
+            p._grad_node = n
+            p.grad = g
+
+
+def _mesh_data_axes(mesh: Mesh):
+    return tuple(a for a in _DATA_AXES
+                 if a in mesh.axis_names and mesh.shape[a] > 1)
+
+
+def shard_module_params(model, mesh: Mesh):
+    """Physically shard every parameter per its dist_attr (global arrays)."""
+    for p in model.parameters():
+        sh = NamedSharding(mesh, param_spec(p))
+        p._value = jax.device_put(p._value, sh)
+    return model
+
+
+class ParallelEngine:
+    """Compile model+optimizer into a donated, sharded train step.
+
+    Usage::
+
+        hcg = fleet.init(strategy)           # builds the hybrid mesh
+        eng = ParallelEngine(model, opt, hcg.mesh)
+        step = eng.train_step(lambda model, batch:
+                              loss_fn(model(batch["x"]), batch["y"]))
+        loss = step({"x": xb, "y": yb})      # one XLA execution
+    """
+
+    def __init__(self, model, optimizer=None, mesh: Optional[Mesh] = None):
+        self.model = model
+        self.optimizer = optimizer
+        if mesh is None:
+            from . import fleet as _fleet
+
+            hcg = _fleet.get_hybrid_communicate_group()
+            mesh = hcg.mesh if hcg is not None else C.get_world_mesh()
+        if mesh is None:
+            C.init_parallel_env()
+            mesh = C.get_world_mesh()
+        self.mesh = mesh
+        self.params: List = list(model.parameters())
+        self.trainable: List = [p for p in self.params if p.trainable]
+        self._seed = 0
+        self._compiled: Dict[Any, Callable] = {}
+        shard_module_params(model, mesh)
+
+    # -- optimizer state management -------------------------------------
+    def _ensure_opt_states(self):
+        opt = self.optimizer
+        shapes = opt._state_shapes()
+        states = []
+        for p in self.trainable:
+            st = opt._param_state(p, shapes)
+            sh = NamedSharding(self.mesh, param_spec(p))
+            st = {k: jax.device_put(v, sh) if v.shape == tuple(p._value.shape)
+                  else v for k, v in st.items()}
+            opt._states[id(p)] = st
+            states.append(st)
+            mw = opt._master_weights.get(id(p))
+            if mw is not None:
+                opt._master_weights[id(p)] = jax.device_put(mw, sh)
+        return states
+
+    # -- the compiled step ----------------------------------------------
+    def train_step(self, fn: Callable, batch_specs=None,
+                   donate: bool = True):
+        """Build ``step(batch) -> loss`` running fwd+bwd+update as one
+        sharded XLA program. ``fn(model, batch)`` must return a scalar
+        loss Tensor."""
+        mesh = self.mesh
+        data_axes = _mesh_data_axes(mesh)
+        opt = self.optimizer
+        params, trainable = self.params, self.trainable
+        t_index = [i for i, p in enumerate(params) if p.trainable]
+
+        self._ensure_opt_states()
+        pspecs = tuple(param_spec(p) for p in params)
+        sspecs = tuple({k: param_spec(p) if v.shape == tuple(p._value.shape)
+                        else P() for k, v in opt._states[id(p)].items()}
+                       for p in trainable)
+
+        def _step(pvals, svals, mvals, batch, lr, stepc, seed):
+            with C.spmd_region():
+                if data_axes:
+                    # distinct RNG stream per data-parallel rank (mp/pp
+                    # ranks share a stream: replicated tensors must drop
+                    # identically; mp-sharded ones use 'local_seed')
+                    seed = seed * jnp.uint32(1000003) + \
+                        C.axis_index(data_axes).astype(jnp.uint32)
+                ctx = _rng.fork_traced(seed)
+                ctx.__enter__()
+                try:
+                    return _step_inner(pvals, svals, mvals, batch, lr, stepc)
+                finally:
+                    ctx.__exit__(None, None, None)
+
+        def _step_inner(pvals, svals, mvals, batch, lr, stepc):
+            with bind_params(params, pvals):
+                t_batch = jax.tree_util.tree_map(
+                    lambda v: Tensor(v, stop_gradient=True), batch)
+                loss = fn(self.model, t_batch)
+                loss.backward()
+                upd_in, grads = [], []
+                for i, p in zip(t_index, trainable):
+                    g = (p.grad._value if p.grad is not None
+                         else jnp.zeros_like(p._value))
+                    if data_axes:
+                        g = lax.pmean(g, data_axes)
+                    grads.append(g)
+                    upd_in.append(mvals[i] if mvals and i in mvals
+                                  else pvals[i])
+                new_p, new_s = opt._fused_update(
+                    tuple(upd_in), tuple(grads), tuple(svals), lr, stepc)
+                out_p = list(pvals)
+                out_m = dict(mvals) if mvals else {}
+                for i, p, nv in zip(t_index, trainable, new_p):
+                    if out_m and i in out_m:
+                        out_m[i] = nv
+                        out_p[i] = nv.astype(pvals[i].dtype)
+                    else:
+                        out_p[i] = nv
+                lv = loss._value
+                all_axes = tuple(a for a in mesh.axis_names
+                                 if mesh.shape[a] > 1)
+                if all_axes:
+                    lv = lax.pmean(lv, all_axes)
+            return lv, tuple(out_p), tuple(new_s), out_m
+
+        def make(batch_treedef, b_specs, mspecs):
+            def flat_step(pvals, svals, mvals, batch_leaves, lr, stepc, seed):
+                batch = jax.tree_util.tree_unflatten(batch_treedef,
+                                                     batch_leaves)
+                return _step(pvals, svals, mvals, batch, lr, stepc, seed)
+
+            in_specs = (pspecs, sspecs, mspecs, tuple(b_specs), P(), P(), P())
+            out_specs = (P(), pspecs, sspecs, mspecs)
+            sharded = _shard_map(flat_step, mesh, in_specs, out_specs)
+            return jax.jit(sharded,
+                           donate_argnums=(0, 1, 2) if donate else ())
+
+        def step(batch):
+            leaves, treedef = jax.tree_util.tree_flatten(
+                batch, is_leaf=lambda x: isinstance(x, Tensor))
+            leaf_vals = tuple(v._value if isinstance(v, Tensor) else
+                              jnp.asarray(v) for v in leaves)
+            if batch_specs is not None:
+                b_specs = tuple(batch_specs)
+            else:
+                b_specs = tuple(
+                    P(data_axes) if data_axes and v.ndim > 0 else P()
+                    for v in leaf_vals)
+            mvals = {i: opt._master_weights[id(p)]
+                     for i, p in zip(t_index, trainable)
+                     if id(p) in opt._master_weights}
+            mspecs = {i: param_spec(params[i]) for i in mvals}
+            key = (treedef, tuple((v.shape, str(v.dtype))
+                                  for v in leaf_vals), b_specs,
+                   tuple(sorted(mvals)))
+            if key not in self._compiled:
+                self._compiled[key] = make(treedef, b_specs, mspecs)
+            pvals = tuple(p._value for p in params)
+            svals = tuple(opt._states[id(p)] for p in trainable)
+            opt._step_count += 1
+            self._seed += 1
+            lr = jnp.asarray(opt.get_lr(), jnp.float32)
+            stepc = jnp.asarray(opt._step_count, jnp.int32)
+            seed = jnp.asarray(self._seed, jnp.uint32)
+            lv, new_p, new_s, new_m = self._compiled[key](
+                pvals, svals, mvals, leaf_vals, lr, stepc, seed)
+            for p, nv in zip(params, new_p):
+                p._value = nv
+            for p, ns in zip(trainable, new_s):
+                opt._states[id(p)] = ns
+            for i, nv in new_m.items():
+                opt._master_weights[id(params[i])] = nv
+            from ..optimizer.lr import LRScheduler
+
+            if isinstance(opt._lr, LRScheduler):
+                opt._lr.step()  # advance the schedule once per train step
+            return Tensor(lv, stop_gradient=True)
+
+        return step
+
+    # -- forward-only (eval / inference) --------------------------------
+    def eval_step(self, fn: Callable, batch_specs=None):
+        mesh = self.mesh
+        data_axes = _mesh_data_axes(mesh)
+        params = self.params
+        pspecs = tuple(param_spec(p) for p in params)
+        compiled: Dict[Any, Callable] = {}
+
+        def make(treedef, b_specs, out_spec):
+            def flat_fwd(pvals, batch_leaves):
+                with C.spmd_region(), bind_params(params, pvals), \
+                        _ad.no_grad():
+                    batch = jax.tree_util.tree_unflatten(treedef,
+                                                         batch_leaves)
+                    t_batch = jax.tree_util.tree_map(
+                        lambda v: Tensor(v, stop_gradient=True), batch)
+                    out = fn(self.model, t_batch)
+                    return (out._value if isinstance(out, Tensor) else
+                            jax.tree_util.tree_map(
+                                lambda t: t._value if isinstance(t, Tensor)
+                                else t, out))
+
+            sharded = _shard_map(flat_fwd, mesh,
+                                 (pspecs, tuple(b_specs)), out_spec)
+            return jax.jit(sharded)
+
+        def step(batch, out_spec=None):
+            leaves, treedef = jax.tree_util.tree_flatten(
+                batch, is_leaf=lambda x: isinstance(x, Tensor))
+            leaf_vals = tuple(v._value if isinstance(v, Tensor) else
+                              jnp.asarray(v) for v in leaves)
+            b_specs = (tuple(batch_specs) if batch_specs is not None else
+                       tuple(P(data_axes) if data_axes and v.ndim > 0
+                             else P() for v in leaf_vals))
+            ospec = out_spec if out_spec is not None else (
+                P(data_axes) if data_axes else P())
+            key = (treedef, tuple((v.shape, str(v.dtype))
+                                  for v in leaf_vals), b_specs, str(ospec))
+            if key not in compiled:
+                compiled[key] = make(treedef, b_specs, ospec)
+            out = compiled[key](tuple(p._value for p in params), leaf_vals)
+            return jax.tree_util.tree_map(
+                lambda v: Tensor(v, stop_gradient=True), out)
+
+        return step

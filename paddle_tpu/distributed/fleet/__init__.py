@@ -10,6 +10,7 @@ from typing import Optional
 
 from .base.distributed_strategy import DistributedStrategy
 from .base.topology import CommunicateTopology, HybridCommunicateGroup
+from . import layers  # noqa: F401
 
 __all__ = ["init", "DistributedStrategy", "HybridCommunicateGroup",
            "CommunicateTopology", "get_hybrid_communicate_group",
@@ -34,6 +35,12 @@ def init(role_maker=None, is_collective: bool = True,
     _fleet_state["initialized"] = True
     _fleet_state["hcg"] = hcg
     _fleet_state["strategy"] = strategy
+    # seed the hybrid RNG tracker (local/global dropout streams) once
+    from .layers.mpu.random import LOCAL_SEED, get_rng_state_tracker, \
+        model_parallel_random_seed
+
+    if LOCAL_SEED not in get_rng_state_tracker().states_:
+        model_parallel_random_seed(hc.get("mp_seed", 2024))
     return hcg
 
 

@@ -1,0 +1,12 @@
+from .mp_layers import (ColumnParallelLinear, ParallelCrossEntropy,
+                        RowParallelLinear, VocabParallelEmbedding,
+                        parallel_cross_entropy)
+from .mp_ops import _c_concat, _c_identity, _c_split, _mp_allreduce
+from .random import (RNGStatesTracker, get_rng_state_tracker,
+                     model_parallel_random_seed)
+
+__all__ = [
+    "ColumnParallelLinear", "RowParallelLinear", "VocabParallelEmbedding",
+    "ParallelCrossEntropy", "parallel_cross_entropy", "RNGStatesTracker",
+    "get_rng_state_tracker", "model_parallel_random_seed",
+]

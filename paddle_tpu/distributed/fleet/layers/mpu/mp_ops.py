@@ -1,0 +1,118 @@
+"""Tensor-parallel communication primitives.
+
+TPU-native re-design of the reference's mp_ops
+(reference: python/paddle/distributed/fleet/layers/mpu/mp_ops.py:83-285 —
+_c_identity/_c_concat/_c_split/_mp_allreduce built on NCCL rings).
+
+Here each primitive is a PyLayer-style custom-grad node whose forward /
+backward are XLA collectives over the 'mp' mesh axis (psum/all_gather on
+ICI). Outside an SPMD region (mp degree 1, or plain eager single chip)
+every primitive is the identity, matching the reference's single-card
+behavior.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+from jax import lax
+
+from .... import collective as C
+from .....autograd import engine as _engine
+from .....tensor import Tensor
+
+__all__ = ["_c_identity", "_c_concat", "_c_split", "_mp_allreduce",
+           "mp_axes", "mp_active"]
+
+
+def mp_axes(group: Optional[C.Group] = None):
+    g = group
+    if g is None:
+        from .... import fleet as _fleet
+
+        hcg = _fleet.get_hybrid_communicate_group()
+        if hcg is not None:
+            g = hcg.get_model_parallel_group()
+    if g is None or not g.axis_names or g.nranks <= 1:
+        return None
+    return g.axis_names
+
+
+def mp_active(group: Optional[C.Group] = None) -> bool:
+    return C.in_spmd_region() and mp_axes(group) is not None
+
+
+def _custom(name, fwd_value, backward_fn, x: Tensor) -> Tensor:
+    out = Tensor(fwd_value, stop_gradient=x.stop_gradient)
+    if _engine.is_grad_enabled() and not x.stop_gradient:
+        out.stop_gradient = False
+        _engine.record_custom(name, backward_fn, [x], [out], fwd_value)
+    return out
+
+
+def _c_identity(x: Tensor, group: Optional[C.Group] = None) -> Tensor:
+    """Forward identity; backward allreduces the grad over mp.
+
+    Used at the input of ColumnParallelLinear (reference mp_ops.py:83).
+    """
+    if not mp_active(group):
+        return x
+    axes = mp_axes(group)
+
+    def bwd(g):
+        return (lax.psum(g, axes),)
+
+    return _custom("c_identity", x._value, bwd, x)
+
+
+def _mp_allreduce(x: Tensor, group: Optional[C.Group] = None,
+                  op=None) -> Tensor:
+    """Forward allreduce over mp; backward identity.
+
+    Used at the output of RowParallelLinear (reference mp_ops.py:248
+    mp_allreduce_sum).
+    """
+    if not mp_active(group):
+        return x
+    axes = mp_axes(group)
+
+    def bwd(g):
+        return (g,)
+
+    return _custom("mp_allreduce", lax.psum(x._value, axes), bwd, x)
+
+
+def _c_concat(x: Tensor, group: Optional[C.Group] = None) -> Tensor:
+    """Forward all-gather along the last dim; backward takes the local
+    slice (reference mp_ops.py:171 _c_concat on the column output)."""
+    if not mp_active(group):
+        return x
+    axes = mp_axes(group)
+    local = x._value.shape[-1]
+
+    def bwd(g):
+        idx = C.axis_index(axes)
+        return (lax.dynamic_slice_in_dim(g, idx * local, local, axis=-1),)
+
+    return _custom("c_concat", lax.all_gather(x._value, axes, axis=x._value.ndim - 1,
+                                              tiled=True), bwd, x)
+
+
+def _c_split(x: Tensor, group: Optional[C.Group] = None) -> Tensor:
+    """Forward takes this rank's slice of the last dim; backward
+    all-gathers (reference mp_ops.py:212 _c_split)."""
+    if not mp_active(group):
+        return x
+    axes = mp_axes(group)
+    n = 1
+    for a in axes:
+        n *= lax.axis_size(a)
+    full = x._value.shape[-1]
+    local = full // n
+    idx = C.axis_index(axes)
+    value = lax.dynamic_slice_in_dim(x._value, idx * local, local, axis=-1)
+
+    def bwd(g):
+        return (lax.all_gather(g, axes, axis=g.ndim - 1, tiled=True),)
+
+    return _custom("c_split", value, bwd, x)

@@ -1,0 +1,41 @@
+"""Hybrid-parallel RNG state tracking — fleet-facing surface.
+
+(reference: python/paddle/distributed/fleet/layers/mpu/random.py:34,99 —
+``RNGStatesTracker`` / ``get_rng_state_tracker`` / seed setup.)
+
+The tracker implementation lives in core/rng.py (one singleton shared by
+the whole framework); this module provides the fleet-named accessors and
+the seed-derivation convention.
+"""
+from __future__ import annotations
+
+from .....core import rng as _rng
+from .....core.rng import GLOBAL_SEED, LOCAL_SEED, RNGStatesTracker
+
+__all__ = ["RNGStatesTracker", "get_rng_state_tracker",
+           "model_parallel_random_seed", "local_dropout_key",
+           "LOCAL_SEED", "GLOBAL_SEED"]
+
+
+def get_rng_state_tracker() -> RNGStatesTracker:
+    return _rng.get_rng_tracker()
+
+
+def model_parallel_random_seed(seed: int = 0) -> None:
+    """(reference mp random.py:99) — derive distinct local/global seeds."""
+    tracker = get_rng_state_tracker()
+    tracker.reset()
+    _rng.seed(seed)
+    tracker.add(GLOBAL_SEED, seed)
+    tracker.add(LOCAL_SEED, seed + 2718)
+
+
+def local_dropout_key():
+    """A PRNG key from the 'local_seed' stream (distinct per mp rank for
+    mp-sharded tensors); falls back to the global stream when the tracker
+    has not been seeded."""
+    tracker = get_rng_state_tracker()
+    if LOCAL_SEED in tracker.states_:
+        with tracker.rng_state(LOCAL_SEED):
+            return _rng.get_key()
+    return _rng.get_key()

@@ -1,0 +1,288 @@
+"""GPT model family — the flagship hybrid-parallel training model.
+
+The reference framework trains GPT-3-style models through Fleet
+HybridParallel (SURVEY.md §3.3 north-star call stack; TP layers
+reference python/paddle/distributed/fleet/layers/mpu/mp_layers.py, fused
+transformer reference python/paddle/incubate/nn/layer/fused_transformer.py).
+This module builds the same architecture TPU-first:
+
+- attention/MLP projections are ColumnParallelLinear / RowParallelLinear
+  (mp-sharded weights as global jax.Arrays),
+- attention core is the flash_attention op (Pallas kernel on TPU),
+- the LM head ties the vocab-parallel embedding and the loss is the
+  vocab-parallel softmax cross-entropy, so the full-vocab logits tensor
+  never materializes unsharded,
+- decoder blocks are homogeneous, so the pipeline engine can stack their
+  params along a leading 'pp' stage axis (see meta_parallel/pp_utils).
+
+Configs mirror the GPT-3 ladder used by BASELINE.md (125M/350M/1.3B/13B).
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Optional
+
+from .. import ops
+from ..nn import functional as F
+from ..nn.layer import Layer
+from ..nn.container import LayerList
+from ..nn.common import Dropout, Embedding
+from ..nn.norm import LayerNorm
+from ..framework.param_attr import ParamAttr
+from ..nn import initializer as I
+from ..ops.attention import flash_attention
+from ..distributed.fleet.layers.mpu import (ColumnParallelLinear,
+                                            RowParallelLinear,
+                                            VocabParallelEmbedding,
+                                            parallel_cross_entropy)
+from ..distributed.fleet.layers.mpu.mp_ops import (_c_identity, mp_active,
+                                                   mp_axes)
+from ..tensor import Tensor
+
+__all__ = ["GPTConfig", "GPTModel", "GPTForCausalLM",
+           "GPTPretrainingCriterion", "gpt_tiny", "gpt_125m", "gpt_350m",
+           "gpt_1p3b", "gpt_13b"]
+
+
+@dataclass
+class GPTConfig:
+    vocab_size: int = 50304
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    intermediate_size: int = 0          # 0 -> 4*hidden
+    max_position_embeddings: int = 1024
+    hidden_dropout: float = 0.0
+    attention_dropout: float = 0.0
+    initializer_range: float = 0.02
+    layer_norm_eps: float = 1e-5
+    use_flash_attention: bool = True
+    tie_word_embeddings: bool = True
+    dtype: str = "float32"
+
+    def __post_init__(self):
+        if not self.intermediate_size:
+            self.intermediate_size = 4 * self.hidden_size
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_heads
+
+    def num_params(self) -> int:
+        """Parameter count (embeddings included once when tied)."""
+        h, L, V = self.hidden_size, self.num_layers, self.vocab_size
+        per_layer = 4 * h * h + 4 * h + 2 * h * self.intermediate_size \
+            + self.intermediate_size + h + 4 * h
+        return V * h + self.max_position_embeddings * h + L * per_layer + 2 * h
+
+
+def _init_attr(std):
+    return ParamAttr(initializer=I.Normal(mean=0.0, std=std))
+
+
+class GPTAttention(Layer):
+    """Causal self-attention; qkv column-parallel, out row-parallel."""
+
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.config = config
+        self.num_heads = config.num_heads
+        self.head_dim = config.head_dim
+        std = config.initializer_range
+        self.qkv_proj = ColumnParallelLinear(
+            config.hidden_size, 3 * config.hidden_size,
+            weight_attr=_init_attr(std), gather_output=False)
+        self.out_proj = RowParallelLinear(
+            config.hidden_size, config.hidden_size,
+            weight_attr=_init_attr(std / math.sqrt(2 * config.num_layers)),
+            input_is_parallel=True)
+        self.dropout = Dropout(config.hidden_dropout)
+
+    def forward(self, x, cache=None):
+        B, S = x.shape[0], x.shape[1]
+        qkv = self.qkv_proj(x)                      # [B, S, 3*H_local]
+        n_local = qkv.shape[-1] // (3 * self.head_dim)
+        qkv = ops.reshape(qkv, (B, S, n_local, 3 * self.head_dim))
+        q, k, v = ops.split(qkv, 3, axis=-1)        # [B, S, n_local, D]
+        if cache is not None:
+            k = ops.concat([cache[0], k], axis=1)
+            v = ops.concat([cache[1], v], axis=1)
+            new_cache = (k, v)
+            out = flash_attention(q, k, v, causal=S > 1)
+        else:
+            new_cache = None
+            p = self.config.attention_dropout if self.training else 0.0
+            if p:
+                # attention probs are mp-sharded ([B,S,n_local,S]) — draw
+                # from the 'local_seed' stream so each mp rank masks its
+                # head-shard independently (Megatron RNG rule)
+                from ..distributed.fleet.layers.mpu.random import \
+                    local_dropout_key
+
+                out = flash_attention(q, k, v, causal=True, dropout=p,
+                                      dropout_key=local_dropout_key())
+            else:
+                out = flash_attention(q, k, v, causal=True)
+        out = ops.reshape(out, (B, S, n_local * self.head_dim))
+        out = self.out_proj(out)
+        out = self.dropout(out)
+        return (out, new_cache) if cache is not None else out
+
+
+class GPTMLP(Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        std = config.initializer_range
+        self.fc1 = ColumnParallelLinear(
+            config.hidden_size, config.intermediate_size,
+            weight_attr=_init_attr(std), gather_output=False)
+        self.fc2 = RowParallelLinear(
+            config.intermediate_size, config.hidden_size,
+            weight_attr=_init_attr(std / math.sqrt(2 * config.num_layers)),
+            input_is_parallel=True)
+        self.dropout = Dropout(config.hidden_dropout)
+
+    def forward(self, x):
+        return self.dropout(self.fc2(F.gelu(self.fc1(x), approximate=True)))
+
+
+class GPTDecoderLayer(Layer):
+    """Pre-LN decoder block; homogeneous across the stack (pipelineable)."""
+
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.ln1 = LayerNorm(config.hidden_size, epsilon=config.layer_norm_eps)
+        self.attn = GPTAttention(config)
+        self.ln2 = LayerNorm(config.hidden_size, epsilon=config.layer_norm_eps)
+        self.mlp = GPTMLP(config)
+
+    def forward(self, x, cache=None):
+        if cache is not None:
+            a, new_cache = self.attn(self.ln1(x), cache=cache)
+            x = x + a
+            x = x + self.mlp(self.ln2(x))
+            return x, new_cache
+        x = x + self.attn(self.ln1(x))
+        x = x + self.mlp(self.ln2(x))
+        return x
+
+
+class GPTEmbeddings(Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        std = config.initializer_range
+        self.word_embeddings = VocabParallelEmbedding(
+            config.vocab_size, config.hidden_size, weight_attr=_init_attr(std))
+        self.position_embeddings = Embedding(
+            config.max_position_embeddings, config.hidden_size,
+            weight_attr=_init_attr(std))
+        self.dropout = Dropout(config.hidden_dropout)
+
+    def forward(self, input_ids, position_offset=0):
+        S = input_ids.shape[1]
+        pos = ops.arange(position_offset, position_offset + S, dtype="int32")
+        x = self.word_embeddings(input_ids) + self.position_embeddings(pos)
+        return self.dropout(x)
+
+
+class GPTModel(Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.config = config
+        self.embeddings = GPTEmbeddings(config)
+        self.layers = LayerList([GPTDecoderLayer(config)
+                                 for _ in range(config.num_layers)])
+        self.final_ln = LayerNorm(config.hidden_size,
+                                  epsilon=config.layer_norm_eps)
+
+    def forward(self, input_ids, caches=None, position_offset=0):
+        x = self.embeddings(input_ids, position_offset)
+        if caches is not None:
+            new_caches = []
+            for layer, cache in zip(self.layers, caches):
+                x, nc = layer(x, cache=cache)
+                new_caches.append(nc)
+            return self.final_ln(x), new_caches
+        for layer in self.layers:
+            x = layer(x)
+        return self.final_ln(x)
+
+
+class GPTForCausalLM(Layer):
+    """GPT with a (tied) vocab-parallel LM head.
+
+    In mp mode the head produces LOCAL logits [B, S, V/mp]; pair it with
+    GPTPretrainingCriterion (vocab-parallel cross-entropy) so full logits
+    never materialize (the reference pairs ColumnParallelLinear lm_head
+    with ParallelCrossEntropy the same way).
+    """
+
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.config = config
+        self.gpt = GPTModel(config)
+        if not config.tie_word_embeddings:
+            self.lm_head = ColumnParallelLinear(
+                config.hidden_size, config.vocab_size,
+                weight_attr=_init_attr(config.initializer_range),
+                has_bias=False, gather_output=False)
+        if config.dtype not in ("float32", None):
+            self.astype(config.dtype)
+
+    def _logits(self, x):
+        if self.config.tie_word_embeddings:
+            w = self.gpt.embeddings.word_embeddings.weight  # [V(/mp), H]
+            if mp_active():
+                # identity fwd / mp-psum bwd: each rank's head produces a
+                # PARTIAL dL/dx (its vocab shard only); sum before the
+                # grad re-enters the replicated decoder (Megatron rule).
+                x = _c_identity(x)
+            return ops.matmul(x, w, transpose_y=True)       # local logits
+        return self.lm_head(x)
+
+    def forward(self, input_ids, caches=None, position_offset=0):
+        if caches is not None:
+            x, new_caches = self.gpt(input_ids, caches=caches,
+                                     position_offset=position_offset)
+            return self._logits(x), new_caches
+        return self._logits(self.gpt(input_ids))
+
+
+class GPTPretrainingCriterion(Layer):
+    """Shift-by-one LM loss over (possibly mp-local) logits."""
+
+    def __init__(self, config: Optional[GPTConfig] = None, mp_group=None):
+        super().__init__()
+        self._mp_group = mp_group
+
+    def forward(self, logits, labels, loss_mask=None):
+        loss = parallel_cross_entropy(logits, labels, self._mp_group)
+        loss = ops.squeeze(loss, axis=-1)
+        if loss_mask is not None:
+            m = ops.cast(loss_mask, str(loss.dtype))
+            return ops.sum(loss * m) / ops.clip(ops.sum(m), min=1.0)
+        return ops.mean(loss)
+
+
+def gpt_tiny(**kw) -> GPTConfig:
+    return GPTConfig(vocab_size=256, hidden_size=64, num_layers=2,
+                     num_heads=4, max_position_embeddings=128, **kw)
+
+
+def gpt_125m(**kw) -> GPTConfig:
+    return GPTConfig(hidden_size=768, num_layers=12, num_heads=12, **kw)
+
+
+def gpt_350m(**kw) -> GPTConfig:
+    return GPTConfig(hidden_size=1024, num_layers=24, num_heads=16, **kw)
+
+
+def gpt_1p3b(**kw) -> GPTConfig:
+    return GPTConfig(hidden_size=2048, num_layers=24, num_heads=16,
+                     max_position_embeddings=2048, **kw)
+
+
+def gpt_13b(**kw) -> GPTConfig:
+    return GPTConfig(hidden_size=5120, num_layers=40, num_heads=40,
+                     max_position_embeddings=2048, **kw)

@@ -56,10 +56,13 @@ def cross_entropy(input, label, weight=None, ignore_index=-100,
 def scaled_dot_product_attention(query, key, value, attn_mask=None,
                                  dropout_p=0.0, is_causal=False,
                                  training=True, name=None):
+    from ..core import rng as _rng
+
+    p = float(dropout_p) if training else 0.0
     return _ops.scaled_dot_product_attention(
-        query, key, value, attn_mask=attn_mask,
-        dropout_p=float(dropout_p) if training else 0.0,
-        is_causal=bool(is_causal))
+        query, key, value, attn_mask=attn_mask, dropout_p=p,
+        is_causal=bool(is_causal),
+        dropout_key=_rng.get_key() if p else None)
 
 
 def flash_attention(query, key, value, dropout=0.0, causal=False,
@@ -69,10 +72,13 @@ def flash_attention(query, key, value, dropout=0.0, causal=False,
     (reference: python/paddle/nn/functional/flash_attention.py:147).
     Layout [batch, seqlen, num_heads, head_dim]. On TPU this routes to the
     Pallas flash kernel; XLA fallback otherwise."""
+    from ..core import rng as _rng
     from ..ops import attention as _attn
 
+    p = float(dropout) if training else 0.0
     out = _attn.flash_attention(query, key, value, causal=bool(causal),
-                                dropout=float(dropout) if training else 0.0)
+                                dropout=p,
+                                dropout_key=_rng.get_key() if p else None)
     if return_softmax:
         return out, None
     return out, None

@@ -28,9 +28,9 @@ def _use_pallas(q) -> bool:
 
 
 @def_op("flash_attention")
-def flash_attention(q, k, v, causal=False, dropout=0.0):
+def flash_attention(q, k, v, causal=False, dropout=0.0, dropout_key=None):
     """Layout [batch, seqlen, num_heads, head_dim]."""
-    if _use_pallas(q):
+    if _use_pallas(q) and not dropout:
         try:
             from .pallas.flash_attention import flash_attention_fwd
 
@@ -38,4 +38,4 @@ def flash_attention(q, k, v, causal=False, dropout=0.0):
         except Exception:
             pass
     return _sdpa_raw(q, k, v, attn_mask=None, dropout_p=dropout,
-                     is_causal=causal)
+                     is_causal=causal, dropout_key=dropout_key)

@@ -653,7 +653,8 @@ def cosine_similarity(x1, x2, axis=1, eps=1e-8):
 
 @def_op("scaled_dot_product_attention")
 def scaled_dot_product_attention(q, k, v, attn_mask=None, dropout_p=0.0,
-                                 is_causal=False, scale=None):
+                                 is_causal=False, scale=None,
+                                 dropout_key=None):
     """Layout [batch, seqlen, num_heads, head_dim] (paddle flash_attention
     layout, nn/functional/flash_attention.py:147). XLA fallback path; the
     Pallas flash kernel registers over this on TPU."""
@@ -673,6 +674,16 @@ def scaled_dot_product_attention(q, k, v, attn_mask=None, dropout_p=0.0,
         else:
             scores = scores + attn_mask
     probs = jax.nn.softmax(scores, axis=-1)
+    if dropout_p:
+        if dropout_key is None:
+            raise ValueError(
+                "attention dropout requires an explicit dropout_key; call "
+                "through nn.functional.scaled_dot_product_attention / "
+                "flash_attention, which thread one from the global RNG")
+        keep = jax.random.bernoulli(dropout_key, 1.0 - dropout_p,
+                                    probs.shape)
+        probs = jnp.where(keep, probs / (1.0 - dropout_p),
+                          jnp.zeros((), probs.dtype))
     out = jnp.einsum("bhqk,bhkd->bhqd", probs, vf)
     return jnp.swapaxes(out, 1, 2).astype(q.dtype)
 
