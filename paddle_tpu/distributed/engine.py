@@ -175,6 +175,21 @@ class ParallelEngine:
                 finally:
                     ctx.__exit__(None, None, None)
 
+        # pipelined models mask grad ownership per pp stage; replicated
+        # params must then psum their grads over 'pp' (pp_layers docstring)
+        pp_axes = tuple(a for a in ("pp",)
+                        if getattr(self.model, "_pp_ownership", False)
+                        and a in mesh.axis_names and mesh.shape[a] > 1)
+
+        def _grad_axes(p):
+            spec_axes = set()
+            for ax in param_spec(p):
+                if isinstance(ax, (tuple, list)):
+                    spec_axes.update(ax)
+                elif ax is not None:
+                    spec_axes.add(ax)
+            return tuple(a for a in pp_axes if a not in spec_axes)
+
         def _step_inner(pvals, svals, mvals, batch, lr, stepc):
             with bind_params(params, pvals):
                 t_batch = jax.tree_util.tree_map(
@@ -187,6 +202,9 @@ class ParallelEngine:
                          else jnp.zeros_like(p._value))
                     if data_axes:
                         g = lax.pmean(g, data_axes)
+                    psum_axes = _grad_axes(p)
+                    if psum_axes:
+                        g = lax.psum(g, psum_axes)
                     grads.append(g)
                     upd_in.append(mvals[i] if mvals and i in mvals
                                   else pvals[i])

@@ -35,12 +35,18 @@ def init(role_maker=None, is_collective: bool = True,
     _fleet_state["initialized"] = True
     _fleet_state["hcg"] = hcg
     _fleet_state["strategy"] = strategy
-    # seed the hybrid RNG tracker (local/global dropout streams) once
-    from .layers.mpu.random import LOCAL_SEED, get_rng_state_tracker, \
-        model_parallel_random_seed
+    # seed the hybrid RNG tracker (local/global dropout streams) once —
+    # WITHOUT touching the global stream (paddle.seed set by the user
+    # before fleet.init must keep governing weight init)
+    from .layers.mpu.random import GLOBAL_SEED, LOCAL_SEED, \
+        get_rng_state_tracker
 
-    if LOCAL_SEED not in get_rng_state_tracker().states_:
-        model_parallel_random_seed(hc.get("mp_seed", 2024))
+    tracker = get_rng_state_tracker()
+    if LOCAL_SEED not in tracker.states_:
+        seed = hc.get("mp_seed", 2024)
+        if GLOBAL_SEED not in tracker.states_:
+            tracker.add(GLOBAL_SEED, seed)
+        tracker.add(LOCAL_SEED, seed + 2718)
     return hcg
 
 

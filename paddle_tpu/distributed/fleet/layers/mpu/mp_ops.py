@@ -9,11 +9,20 @@ backward are XLA collectives over the 'mp' mesh axis (psum/all_gather on
 ICI). Outside an SPMD region (mp degree 1, or plain eager single chip)
 every primitive is the identity, matching the reference's single-card
 behavior.
+
+Each primitive's value-level function carries a ``jax.custom_vjp`` rule
+identical to the tape rule, so model forwards differentiate correctly
+under BOTH the eager tape (`loss.backward()`) and pure function
+transforms (`jax.vjp` — used by the pipeline-parallel schedule and
+`jit.to_static`). Without the custom rule, shard_map's default psum
+transpose would not implement the Megatron identity/allreduce pairing.
 """
 from __future__ import annotations
 
+from functools import partial
 from typing import Optional
 
+import jax
 import jax.numpy as jnp
 from jax import lax
 
@@ -42,6 +51,63 @@ def mp_active(group: Optional[C.Group] = None) -> bool:
     return C.in_spmd_region() and mp_axes(group) is not None
 
 
+# -- value-level primitives with Megatron custom-vjp pairing -------------
+
+@partial(jax.custom_vjp, nondiff_argnums=(1,))
+def identity_psum_bwd(x, axes):
+    """Forward identity; backward psum over ``axes`` (f in Megatron)."""
+    return x
+
+
+identity_psum_bwd.defvjp(lambda x, axes: (x, None),
+                         lambda axes, _, g: (lax.psum(g, axes),))
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(1,))
+def psum_identity_bwd(x, axes):
+    """Forward psum over ``axes``; backward identity (g in Megatron)."""
+    return lax.psum(x, axes)
+
+
+psum_identity_bwd.defvjp(lambda x, axes: (lax.psum(x, axes), None),
+                         lambda axes, _, g: (g,))
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(1,))
+def allgather_slice_bwd(x, axes):
+    """Forward all-gather (tiled, last dim); backward local slice."""
+    return lax.all_gather(x, axes, axis=x.ndim - 1, tiled=True)
+
+
+def _ag_fwd(x, axes):
+    return allgather_slice_bwd(x, axes), x.shape[-1]
+
+
+def _ag_bwd(axes, local, g):
+    idx = C.axis_index(axes)
+    return (lax.dynamic_slice_in_dim(g, idx * local, local, axis=-1),)
+
+
+allgather_slice_bwd.defvjp(_ag_fwd, _ag_bwd)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(1,))
+def slice_allgather_bwd(x, axes):
+    """Forward this rank's last-dim slice; backward all-gather."""
+    n = 1
+    for a in axes:
+        n *= lax.axis_size(a)
+    local = x.shape[-1] // n
+    idx = C.axis_index(axes)
+    return lax.dynamic_slice_in_dim(x, idx * local, local, axis=-1)
+
+
+slice_allgather_bwd.defvjp(
+    lambda x, axes: (slice_allgather_bwd(x, axes), None),
+    lambda axes, _, g: (lax.all_gather(g, axes, axis=g.ndim - 1,
+                                       tiled=True),))
+
+
 def _custom(name, fwd_value, backward_fn, x: Tensor) -> Tensor:
     out = Tensor(fwd_value, stop_gradient=x.stop_gradient)
     if _engine.is_grad_enabled() and not x.stop_gradient:
@@ -62,7 +128,7 @@ def _c_identity(x: Tensor, group: Optional[C.Group] = None) -> Tensor:
     def bwd(g):
         return (lax.psum(g, axes),)
 
-    return _custom("c_identity", x._value, bwd, x)
+    return _custom("c_identity", identity_psum_bwd(x._value, axes), bwd, x)
 
 
 def _mp_allreduce(x: Tensor, group: Optional[C.Group] = None,
@@ -79,7 +145,7 @@ def _mp_allreduce(x: Tensor, group: Optional[C.Group] = None,
     def bwd(g):
         return (g,)
 
-    return _custom("mp_allreduce", lax.psum(x._value, axes), bwd, x)
+    return _custom("mp_allreduce", psum_identity_bwd(x._value, axes), bwd, x)
 
 
 def _c_concat(x: Tensor, group: Optional[C.Group] = None) -> Tensor:
@@ -94,8 +160,7 @@ def _c_concat(x: Tensor, group: Optional[C.Group] = None) -> Tensor:
         idx = C.axis_index(axes)
         return (lax.dynamic_slice_in_dim(g, idx * local, local, axis=-1),)
 
-    return _custom("c_concat", lax.all_gather(x._value, axes, axis=x._value.ndim - 1,
-                                              tiled=True), bwd, x)
+    return _custom("c_concat", allgather_slice_bwd(x._value, axes), bwd, x)
 
 
 def _c_split(x: Tensor, group: Optional[C.Group] = None) -> Tensor:
@@ -104,15 +169,8 @@ def _c_split(x: Tensor, group: Optional[C.Group] = None) -> Tensor:
     if not mp_active(group):
         return x
     axes = mp_axes(group)
-    n = 1
-    for a in axes:
-        n *= lax.axis_size(a)
-    full = x._value.shape[-1]
-    local = full // n
-    idx = C.axis_index(axes)
-    value = lax.dynamic_slice_in_dim(x._value, idx * local, local, axis=-1)
 
     def bwd(g):
         return (lax.all_gather(g, axes, axis=g.ndim - 1, tiled=True),)
 
-    return _custom("c_split", value, bwd, x)
+    return _custom("c_split", slice_allgather_bwd(x._value, axes), bwd, x)

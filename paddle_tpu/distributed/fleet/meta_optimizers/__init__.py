@@ -1,0 +1,17 @@
+"""meta_optimizers: optimizer wrappers for hybrid parallel training.
+
+(reference: python/paddle/distributed/fleet/meta_optimizers/
+dygraph_optimizer/hybrid_parallel_optimizer.py — HybridParallelOptimizer
+wraps the inner optimizer, syncs grads across groups and clips by
+hybrid-global norm; dygraph_sharding_optimizer.py — sharding stage 1.)
+
+TPU-native: gradient sync and sharded-state placement happen inside the
+compiled train step (ParallelEngine), so the wrapper's job is state
+partitioning policy + API surface. The engine unwraps ``_inner_opt``.
+"""
+from __future__ import annotations
+
+from .dygraph_optimizer import DygraphShardingOptimizer, \
+    HybridParallelOptimizer
+
+__all__ = ["HybridParallelOptimizer", "DygraphShardingOptimizer"]

@@ -1,0 +1,35 @@
+"""meta_parallel: the per-strategy model wrappers.
+
+(reference: python/paddle/distributed/fleet/meta_parallel/ — model.py:32
+``fleet.distributed_model`` picks the wrapper by active strategy:
+pure-dp → DataParallel, mp → TensorParallel, pp → PipelineParallel.)
+"""
+from __future__ import annotations
+
+from .parallel_layers import (LayerDesc, PipelineLayer, SegmentLayers,
+                              SharedLayerDesc)
+from .pipeline_parallel import PipelineParallel
+from .tensor_parallel import SegmentParallel, TensorParallel
+
+__all__ = ["LayerDesc", "SharedLayerDesc", "SegmentLayers", "PipelineLayer",
+           "PipelineParallel", "TensorParallel", "SegmentParallel",
+           "wrap_distributed_model"]
+
+
+def wrap_distributed_model(model, hcg, strategy):
+    """(reference fleet/model.py:132-160 decision ladder)"""
+    if hcg is None:
+        return model
+    if hcg.get_pipe_parallel_world_size() > 1 or isinstance(model,
+                                                            PipelineLayer):
+        return PipelineParallel(model, hcg, strategy)
+    if hcg.get_model_parallel_world_size() > 1:
+        return TensorParallel(model, hcg, strategy)
+    if hcg.get_sep_parallel_world_size() > 1:
+        return SegmentParallel(model, hcg, strategy)
+    from ...parallel import DataParallel
+
+    if hcg.get_data_parallel_world_size() > 1 or \
+            hcg.get_sharding_parallel_world_size() > 1:
+        return DataParallel(model)
+    return model

@@ -1,0 +1,4 @@
+from .pp_layers import (LayerDesc, PipelineLayer, SegmentLayers,
+                        SharedLayerDesc)
+
+__all__ = ["LayerDesc", "SharedLayerDesc", "SegmentLayers", "PipelineLayer"]

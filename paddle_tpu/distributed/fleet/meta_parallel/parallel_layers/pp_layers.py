@@ -1,0 +1,487 @@
+"""Pipeline-parallel model partitioning — TPU-native PipelineLayer.
+
+Reference surface: python/paddle/distributed/fleet/meta_parallel/
+parallel_layers/pp_layers.py — ``LayerDesc`` (:56), ``SharedLayerDesc``,
+``SegmentLayers`` (:92), ``PipelineLayer`` (:261). There, each pp rank
+builds ONLY its stage's layers and microbatches flow between ranks via
+NCCL p2p driven from Python (pp_utils/p2p_communication.py).
+
+TPU-native redesign: every rank traces the SAME program (SPMD). The
+homogeneous middle run of the layer list (the transformer blocks) is
+stored as *stacked* parameters with a leading layer axis sharded over the
+'pp' mesh axis — each pp rank physically holds L/pp layers. The schedule
+is a ``lax.scan`` over pipeline ticks with ``lax.ppermute`` rotating
+activations stage→stage+1 over the ICI ring (see pipeline schedule in
+``PipelineLayer._pipe_fn``); jax.vjp of that function IS the reverse
+pipeline, so backward scheduling needs no hand-written p2p. The prologue
+(embedding) and epilogue (final norm + head) run replicated on every pp
+rank; gradient ownership is masked so that exactly one pp rank produces
+each replicated-param grad and the engine psums them over 'pp'
+(tied word embeddings then work with no special casing — stage-0 and
+last-stage contributions sum, which is what the reference's
+SharedLayerDesc allreduce does by hand).
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import jax
+import numpy as np
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ..... import ops
+from .....autograd import engine as _engine
+from .....autograd.engine import no_grad
+from .....core import rng as _rng
+from .....core.enforce import enforce
+from .....nn.container import LayerList
+from .....nn.layer import Layer
+from .....tensor import Parameter, Tensor
+from .... import collective as C
+
+__all__ = ["LayerDesc", "SharedLayerDesc", "SegmentLayers", "PipelineLayer"]
+
+
+class LayerDesc:
+    """Deferred layer construction (reference pp_layers.py:56)."""
+
+    def __init__(self, layer_func, *inputs, **kwargs):
+        self.layer_func = layer_func
+        self.inputs = inputs
+        self.kwargs = kwargs
+        if not issubclass(layer_func, Layer) if isinstance(layer_func, type) \
+                else not callable(layer_func):
+            raise TypeError("layer_func must be a Layer subclass or callable")
+
+    def build_layer(self) -> Layer:
+        return self.layer_func(*self.inputs, **self.kwargs)
+
+    def __repr__(self):
+        return f"LayerDesc({getattr(self.layer_func, '__name__', self.layer_func)})"
+
+
+class SharedLayerDesc(LayerDesc):
+    """A layer whose parameters are shared across its occurrences
+    (reference pp_layers.py SharedLayerDesc — embedding/head weight
+    tying across first/last stage). Occurrences after the first reuse
+    the built instance; ``forward_func`` overrides how it is applied."""
+
+    def __init__(self, key, layer_func, forward_func=None,
+                 shared_weight_attr="weight", *inputs, **kwargs):
+        super().__init__(layer_func, *inputs, **kwargs)
+        self.layer_name = key
+        self.forward_func = forward_func
+        self.shared_weight_attr = shared_weight_attr
+
+
+class SegmentLayers:
+    """Partition N layers into num_parts stages (reference pp_layers.py:92).
+
+    method: "uniform" or "layer:<ClassName>" (cut so each stage starts at
+    an instance of the named class)."""
+
+    def __init__(self, layers_desc, num_parts, method="uniform",
+                 num_virtual_pipeline_stage=None):
+        self._layers_desc = layers_desc
+        self.method = method
+        self.num_parts = num_parts
+        self.num_items = len(layers_desc)
+        if num_virtual_pipeline_stage:
+            self.num_parts = num_parts * num_virtual_pipeline_stage
+        enforce(self.num_items >= self.num_parts,
+                "layer number should be greater than number of segments")
+
+    def do_segment(self) -> List[int]:
+        if self.method == "uniform":
+            return self.uniform(self.num_items, self.num_parts)
+        if self.method.startswith("layer:"):
+            cls_name = self.method.split(":", 1)[1]
+            weights = [0] * len(self._layers_desc)
+            for i, d in enumerate(self._layers_desc):
+                fn = d.layer_func if isinstance(d, LayerDesc) else type(d)
+                name = getattr(fn, "__name__", str(fn))
+                if name == cls_name:
+                    weights[i] = 1
+            idxs = [i for i, w in enumerate(weights) if w]
+            total = len(idxs)
+            enforce(total % self.num_parts == 0,
+                    f"the number of {cls_name} ({total}) must be divisible "
+                    f"by num stages ({self.num_parts})")
+            per = total // self.num_parts
+            return ([0] + [idxs[k * per] for k in range(1, self.num_parts)]
+                    + [self.num_items])
+        raise ValueError(f"unknown seg_method {self.method}")
+
+    @staticmethod
+    def uniform(num_items, num_parts) -> List[int]:
+        result = [0]
+        part = num_items // num_parts
+        extra = num_items % num_parts
+        for i in range(num_parts):
+            result.append(result[-1] + part + (1 if i < extra else 0))
+        return result
+
+
+class _FuncLayer(Layer):
+    """Wraps a bare callable desc entry as a (parameterless) Layer."""
+
+    def __init__(self, fn):
+        super().__init__()
+        self._fn = fn
+
+    def forward(self, *a, **k):
+        return self._fn(*a, **k)
+
+
+class _SharedApply(Layer):
+    """Later occurrence of a SharedLayerDesc: applies ``forward_func`` to
+    the shared instance (does NOT own the parameters)."""
+
+    def __init__(self, shared: Layer, forward_func):
+        super().__init__()
+        object.__setattr__(self, "_shared_ref", shared)  # not a sublayer
+        self._forward_func = forward_func
+
+    def forward(self, *a, **k):
+        if self._forward_func is not None:
+            return self._forward_func(self._shared_ref, *a, **k)
+        return self._shared_ref(*a, **k)
+
+
+def _bind(params: Sequence[Parameter], values):
+    """Functional bind (same contract as distributed.engine.bind_params)."""
+    from ....engine import bind_params
+
+    return bind_params(params, values)
+
+
+class PipelineLayer(Layer):
+    """Pipeline-partitioned model (reference pp_layers.py:261).
+
+    ``layers`` is a list of LayerDesc / SharedLayerDesc / Layer /
+    callables. The longest homogeneous run of LayerDescs (the decoder
+    blocks) becomes the pipelined middle; everything before/after is
+    prologue/epilogue, replicated over pp ranks.
+    """
+
+    def __init__(self, layers, num_stages: Optional[int] = None,
+                 topology=None, loss_fn=None, seg_method: str = "uniform",
+                 recompute_interval: int = 0, recompute_ctx=None,
+                 num_virtual_pipeline_stages: Optional[int] = None):
+        super().__init__()
+        from ... import fleet as _fleet_pkg  # noqa: F401 (cycle guard)
+
+        if num_stages is None:
+            hcg = self._hcg()
+            num_stages = (hcg.get_pipe_parallel_world_size()
+                          if hcg is not None else 1)
+        self._num_stages = int(num_stages)
+        self._vpp = int(num_virtual_pipeline_stages or 1)
+        self._loss_fn = loss_fn
+        self._recompute_interval = recompute_interval
+        self._seg_method = seg_method
+        self._num_microbatches = 1
+        self._descs = list(layers)
+        # pipelined models use grad-ownership masking: the engine must
+        # psum replicated-param grads over 'pp' (see module docstring)
+        self._pp_ownership = True
+
+        self._shared: Dict[str, Layer] = {}
+        built: List[Layer] = []
+        for d in self._descs:
+            built.append(self._build_one(d))
+
+        lo, hi = self._homogeneous_run(self._descs)
+        mid = built[lo:hi]
+        n_mid = len(mid)
+        total = self._num_stages * self._vpp
+        enforce(n_mid % total == 0 if total > 1 else True,
+                f"pipelined middle has {n_mid} layers, not divisible by "
+                f"pp degree x virtual stages = {total}")
+        self.prologue = LayerList(built[:lo])
+        self.epilogue = LayerList(built[hi:])
+        self._n_blocks = n_mid
+
+        # stack the middle blocks' params along a leading layer axis
+        template = mid[0] if mid else None
+        object.__setattr__(self, "_template", template)
+        self._t_params: List[Parameter] = []
+        self._s_params: List[Parameter] = []
+        if template is not None:
+            names = [n for n, _ in template.named_parameters()]
+            per_block = [dict(b.named_parameters()) for b in mid]
+            for n in names:
+                tp = per_block[0][n]
+                stacked = jnp.stack([pb[n]._value for pb in per_block])
+                sp = Parameter(stacked, trainable=tp.trainable)
+                base = getattr(tp, "dist_attr", None)
+                base = tuple(base) if isinstance(base, P) else \
+                    (None,) * tp.ndim
+                if total > 1:
+                    sp.dist_attr = P("pp", *base)
+                    sp.is_distributed = True
+                elif any(a is not None for a in base):
+                    sp.dist_attr = P(None, *base)
+                    sp.is_distributed = True
+                self.add_parameter("blocks__" + n.replace(".", "__"), sp)
+                self._t_params.append(tp)
+                self._s_params.append(sp)
+        # segment bookkeeping (reference parity: stage boundaries)
+        if mid:
+            self.segment_parts = SegmentLayers(
+                self._descs[lo:hi], self._num_stages, seg_method,
+                self._vpp if self._vpp > 1 else None).do_segment()
+        else:
+            self.segment_parts = [0]
+
+    # -- construction helpers -------------------------------------------
+    def _hcg(self):
+        from ... import fleet as _fleet
+
+        return _fleet.get_hybrid_communicate_group()
+
+    def _build_one(self, d) -> Layer:
+        if isinstance(d, SharedLayerDesc):
+            if d.layer_name in self._shared:
+                return _SharedApply(self._shared[d.layer_name],
+                                    d.forward_func)
+            inst = d.build_layer()
+            self._shared[d.layer_name] = inst
+            return inst
+        if isinstance(d, LayerDesc):
+            return d.build_layer()
+        if isinstance(d, Layer):
+            return d
+        if callable(d):
+            return _FuncLayer(d)
+        raise TypeError(f"cannot build pipeline entry {d!r}")
+
+    @staticmethod
+    def _homogeneous_run(descs) -> tuple:
+        """[lo, hi) of the longest run of plain LayerDescs with the same
+        layer_func — the pipelineable middle."""
+        best = (0, 0)
+        i = 0
+        n = len(descs)
+        while i < n:
+            d = descs[i]
+            if type(d) is LayerDesc:
+                j = i
+                while j < n and type(descs[j]) is LayerDesc and \
+                        descs[j].layer_func is d.layer_func:
+                    j += 1
+                if j - i > best[1] - best[0]:
+                    best = (i, j)
+                i = j
+            else:
+                i += 1
+        return best
+
+    # -- pure functions over stacked values ------------------------------
+    def _block_apply(self, row_vals, x_val):
+        """Apply the template block with its params bound to one stacked
+        row. Pure in (row_vals, x_val) given the ambient rng seed."""
+        with no_grad(), _bind(self._t_params, row_vals):
+            out = self._template(Tensor(x_val, stop_gradient=True))
+        if isinstance(out, tuple):
+            out = out[0]
+        return out._value
+
+    def _apply_rows(self, x_val, stacked_vals, n_rows):
+        """lax.scan over the stacked layer axis — program size stays O(1)
+        in depth (40-layer stacks compile as one block body)."""
+        if n_rows == 0:
+            return x_val
+        base_seed = _rng.traced_seed()
+        block = self._block_apply
+        if self._recompute_interval:
+            block = jax.checkpoint(block)
+
+        def body(x, xs):
+            row, ridx = xs
+            if base_seed is None:
+                return block(list(row), x), None
+            # distinct rng stream per layer row (dropout sites must not
+            # share masks across the scanned layers)
+            seed_j = base_seed * jnp.uint32(31) + ridx.astype(jnp.uint32)
+            with _rng.fork_traced(seed_j):
+                return block(list(row), x), None
+
+        xs = (tuple(stacked_vals), jnp.arange(n_rows))
+        out, _ = lax.scan(body, x_val, xs)
+        return out
+
+    def _pp_axes(self):
+        hcg = self._hcg()
+        if hcg is None:
+            return None
+        g = hcg.get_pipe_parallel_group()
+        if g is None or not g.axis_names or g.nranks <= 1:
+            return None
+        return g.axis_names
+
+    def _pipe_fn(self, M, base_seed, pp_axes):
+        """The pipeline schedule: microbatch rotation over the pp ring.
+
+        Returns pure fn(x, *stacked) -> last-stage outputs (valid rows
+        only on the last pp stage; zeros-masked elsewhere). GPipe-family
+        schedule: T = M + S - 1 ticks; at tick t, stage s computes
+        microbatch t - s; lax.ppermute rotates activations one stage
+        forward per tick on ICI. jax.vjp of this function yields the
+        reverse schedule (backward pipeline) automatically.
+        """
+        enforce(len(pp_axes) == 1, "pp must map to a single mesh axis")
+        axis = pp_axes[0]
+
+        def fn(x_val, *stacked_vals):
+            S = lax.axis_size(axis)
+            enforce(S == self._num_stages,
+                    f"model was built for {self._num_stages} pipeline "
+                    f"stages but the mesh '{axis}' axis has {S} — build "
+                    "the PipelineLayer after fleet.init (or pass "
+                    "num_stages)")
+            stage = lax.axis_index(axis)
+            B = x_val.shape[0]
+            enforce(B % M == 0, f"local batch {B} not divisible by "
+                    f"microbatches {M}")
+            mb = B // M
+            xm = x_val.reshape((M, mb) + x_val.shape[1:])
+            n_rows = stacked_vals[0].shape[0] if stacked_vals else 0
+            carry = jnp.zeros((mb,) + x_val.shape[1:], x_val.dtype)
+            out_buf = jnp.zeros_like(xm)
+            perm = [(i, (i + 1) % self._num_stages)
+                    for i in range(self._num_stages)]
+
+            def body(state, t):
+                carry, out_buf = state
+                x_mb = lax.dynamic_index_in_dim(
+                    xm, jnp.clip(t, 0, M - 1), 0, keepdims=False)
+                x_in = jnp.where(stage == 0, x_mb, carry)
+                # distinct rng stream per (tick, stage) so dropout masks
+                # differ across microbatches and stages
+                seed_t = (base_seed * jnp.uint32(1000003)
+                          + t.astype(jnp.uint32) * jnp.uint32(2654435761)
+                          + stage.astype(jnp.uint32))
+                with _rng.fork_traced(seed_t):
+                    y = self._apply_rows(x_in, stacked_vals, n_rows)
+                idx = jnp.clip(t - (S - 1), 0, M - 1)
+                write = (stage == S - 1) & (t >= S - 1)
+                cur = lax.dynamic_index_in_dim(out_buf, idx, 0,
+                                               keepdims=False)
+                out_buf = lax.dynamic_update_index_in_dim(
+                    out_buf, jnp.where(write, y, cur), idx, 0)
+                carry = lax.ppermute(y, axis, perm)
+                return (carry, out_buf), None
+
+            (carry, out_buf), _ = lax.scan(
+                body, (carry, out_buf), jnp.arange(M + S - 1))
+            return out_buf.reshape(x_val.shape)
+
+        return fn
+
+    # -- forward ---------------------------------------------------------
+    def _run_seq(self, layers, x):
+        for lyr in layers:
+            if isinstance(x, tuple):
+                x = lyr(*x)
+            else:
+                x = lyr(x)
+        return x
+
+    def _middle(self, x: Tensor) -> Tensor:
+        if self._n_blocks == 0:
+            return x
+        pp_axes = self._pp_axes() if C.in_spmd_region() else None
+        stacked = self._s_params
+        svals = [p._value for p in stacked]
+        seed = _rng.traced_seed()
+        if seed is None:
+            seed = jnp.uint32(np.random.randint(0, 2**31))
+        if pp_axes is None:
+            def fn(xv, *sv):
+                with _rng.fork_traced(seed):
+                    return self._apply_rows(xv, sv, self._n_blocks)
+        else:
+            fn = self._pipe_fn(self._num_microbatches, seed, pp_axes)
+
+        if _engine.is_grad_enabled() and (not x.stop_gradient or
+                                          any(p.trainable for p in stacked)):
+            out_val, vjp_fn = jax.vjp(fn, x._value, *svals)
+            out = Tensor(out_val, stop_gradient=False)
+            _engine.record_custom("pipeline_middle", lambda g: vjp_fn(g),
+                                  [x] + list(stacked), [out], out_val)
+        else:
+            out = Tensor(fn(x._value, *svals), stop_gradient=True)
+
+        if pp_axes is not None:
+            out = _pp_collect(out, pp_axes, self._num_stages - 1)
+        return out
+
+    def forward(self, *args):
+        x = self._run_seq(self.prologue, args if len(args) > 1 else args[0])
+        enforce(isinstance(x, Tensor),
+                "the pipelined middle takes a single Tensor")
+        x = self._middle(x)
+        return self._run_seq(self.epilogue, x)
+
+    def compute_loss(self, inputs, labels) -> Tensor:
+        """forward + loss_fn + pp grad-ownership masking."""
+        out = self.forward(*inputs) if isinstance(inputs, (tuple, list)) \
+            else self.forward(inputs)
+        enforce(self._loss_fn is not None,
+                "PipelineLayer needs loss_fn for train_batch")
+        loss = self._loss_fn(out, *labels) if isinstance(labels,
+                                                         (tuple, list)) \
+            else self._loss_fn(out, labels)
+        pp_axes = self._pp_axes() if C.in_spmd_region() else None
+        if pp_axes is not None:
+            loss = _pp_own(loss, pp_axes, self._num_stages - 1)
+        return loss
+
+    # reference API parity helpers
+    def get_num_stages(self) -> int:
+        return self._num_stages
+
+    @property
+    def parameters_in_stacked_blocks(self):
+        return list(self._s_params)
+
+
+# -- pp ownership / collect custom ops ----------------------------------
+
+@partial(jax.custom_vjp, nondiff_argnums=(1, 2))
+def _pp_collect_raw(x, axes, src):
+    stage = C.axis_index(axes)
+    return lax.psum(jnp.where(stage == src, x, jnp.zeros((), x.dtype)), axes)
+
+
+_pp_collect_raw.defvjp(
+    lambda x, axes, src: (_pp_collect_raw(x, axes, src), None),
+    lambda axes, src, _, g: (jnp.where(C.axis_index(axes) == src, g,
+                                       jnp.zeros((), g.dtype)),))
+
+
+def _pp_collect(x: Tensor, axes, src) -> Tensor:
+    """Broadcast the last stage's tensor to all pp ranks; cotangent is
+    masked to the source stage (gradient ownership)."""
+    val = _pp_collect_raw(x._value, tuple(axes), src)
+    out = Tensor(val, stop_gradient=x.stop_gradient)
+    if _engine.is_grad_enabled() and not x.stop_gradient:
+        out.stop_gradient = False
+
+        def bwd(g):
+            return (jnp.where(C.axis_index(tuple(axes)) == src, g,
+                              jnp.zeros((), g.dtype)),)
+
+        _engine.record_custom("pp_collect", bwd, [x], [out], val)
+    return out
+
+
+def _pp_own(x: Tensor, axes, owner) -> Tensor:
+    """Identity on the value (it is replicated over pp); backward masks
+    the cotangent to the owner stage so replicated-parameter grads are
+    produced by exactly one pp rank (then psum'd over pp by the engine)."""
+    return _pp_collect(x, axes, owner)

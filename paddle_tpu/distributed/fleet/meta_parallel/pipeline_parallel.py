@@ -1,0 +1,107 @@
+"""PipelineParallel — the train_batch driver for PipelineLayer models.
+
+Reference surface: python/paddle/distributed/fleet/meta_parallel/
+pipeline_parallel.py — ``PipelineParallel.train_batch`` (:689) driving the
+1F1B schedule (forward_backward_pipeline :455) with Python-side NCCL p2p
+per microbatch.
+
+TPU-native: the whole schedule (all microbatches, forward AND backward,
+plus the optimizer update) is ONE compiled XLA program built by
+``ParallelEngine`` — the pipeline rotation lives inside the model's
+``PipelineLayer._pipe_fn`` (lax.scan + ppermute), and its jax.vjp is the
+reverse schedule. Host Python dispatches one executable per step instead
+of 4·M p2p calls, which removes the per-microbatch launch overhead the
+reference pays (SURVEY.md §7 hard parts: "1F1B under XLA").
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+from ....core.enforce import enforce
+from ....tensor import Tensor
+from ...engine import ParallelEngine
+from .parallel_layers.pp_layers import PipelineLayer
+from .tensor_parallel import _DelegateWrapper
+
+__all__ = ["PipelineParallel"]
+
+
+def _unwrap_optimizer(opt):
+    return getattr(opt, "_inner_opt", opt)
+
+
+class PipelineParallel(_DelegateWrapper):
+    def __init__(self, layers: PipelineLayer, hcg, strategy):
+        enforce(isinstance(layers, PipelineLayer),
+                "PipelineParallel expects a PipelineLayer model")
+        super().__init__(layers, hcg, strategy)
+        pconf = getattr(strategy, "pipeline_configs", {}) or {}
+        self.accumulate_steps = int(pconf.get("accumulate_steps", 1))
+        self.micro_batch_size = int(pconf.get("micro_batch_size", 0))
+        self._engine: Optional[ParallelEngine] = None
+        self._train_step = None
+        self._eval_steps: Dict[bool, Any] = {}
+        self.total_loss = None
+
+    # -- engine plumbing -------------------------------------------------
+    def _ensure_engine(self, optimizer):
+        if self._engine is None:
+            self._layers._num_microbatches = self.accumulate_steps
+            self._engine = ParallelEngine(
+                self._layers, _unwrap_optimizer(optimizer),
+                self._hcg.mesh if self._hcg is not None else None)
+        return self._engine
+
+    def _check_batch(self, inputs):
+        if self.micro_batch_size <= 0 or self._hcg is None:
+            return
+        first = inputs[0] if isinstance(inputs, (tuple, list)) else inputs
+        data_deg = (self._hcg.get_data_parallel_world_size()
+                    * self._hcg.get_sharding_parallel_world_size())
+        want = self.micro_batch_size * self.accumulate_steps * data_deg
+        enforce(first.shape[0] == want,
+                f"global batch {first.shape[0]} != micro_batch_size "
+                f"{self.micro_batch_size} x accumulate_steps "
+                f"{self.accumulate_steps} x data degree {data_deg}")
+
+    # -- reference API ---------------------------------------------------
+    def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None):
+        """One full pipeline step: data = [inputs, labels].
+
+        (reference pipeline_parallel.py:689 — here fwd+bwd over all
+        microbatches plus the optimizer step execute as one XLA program.)
+        """
+        inputs, labels = data
+        self._check_batch(inputs)
+        eng = self._ensure_engine(optimizer)
+        if self._train_step is None:
+            def fn(model, batch):
+                return model.compute_loss(batch["inputs"], batch["labels"])
+
+            self._train_step = eng.train_step(fn)
+        return self._train_step({"inputs": inputs, "labels": labels})
+
+    def eval_batch(self, data, compute_loss: bool = True):
+        inputs, labels = data
+        eng = self._engine
+        enforce(eng is not None, "call train_batch once before eval_batch "
+                "(or use forward directly)")
+        if compute_loss not in self._eval_steps:
+            from jax import lax
+            from jax.sharding import PartitionSpec as P
+
+            axes = tuple(a for a in eng.mesh.axis_names
+                         if eng.mesh.shape[a] > 1)
+
+            def fn(model, batch, _loss=compute_loss):
+                if _loss:
+                    loss = model.compute_loss(batch["inputs"],
+                                              batch["labels"])
+                    v = lax.pmean(loss._value, axes) if axes else loss._value
+                    return Tensor(v, stop_gradient=True)
+                return model(batch["inputs"])
+
+            self._eval_steps[compute_loss] = (
+                eng.eval_step(fn), P() if compute_loss else None)
+        step, out_spec = self._eval_steps[compute_loss]
+        return step({"inputs": inputs, "labels": labels}, out_spec=out_spec)

@@ -1,0 +1,45 @@
+"""TensorParallel wrapper (reference:
+python/paddle/distributed/fleet/meta_parallel/tensor_parallel.py — wraps
+the model and broadcasts mp params within the mp group so every rank
+starts from identical weights).
+
+TPU-native: parameters are single-controller global jax.Arrays, so they
+are consistent across ranks by construction; the wrapper is API surface
+(strategy bookkeeping + forward delegation)."""
+from __future__ import annotations
+
+from ....nn.layer import Layer
+
+__all__ = ["TensorParallel", "SegmentParallel", "_DelegateWrapper"]
+
+
+class _DelegateWrapper(Layer):
+    def __init__(self, layers: Layer, hcg=None, strategy=None):
+        super().__init__()
+        self._layers = layers
+        self._hcg = hcg
+        self._strategy = strategy
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    def parameters(self, include_sublayers: bool = True):
+        return self._layers.parameters(include_sublayers)
+
+    def named_parameters(self, prefix: str = "", include_sublayers=True):
+        return self._layers.named_parameters(prefix, include_sublayers)
+
+    def state_dict(self, *a, **k):
+        return self._layers.state_dict(*a, **k)
+
+    def set_state_dict(self, state_dict, *a, **k):
+        return self._layers.set_state_dict(state_dict, *a, **k)
+
+
+class TensorParallel(_DelegateWrapper):
+    pass
+
+
+class SegmentParallel(_DelegateWrapper):
+    """(reference meta_parallel/segment_parallel.py:26 — sep axis wrapper)"""
+    pass

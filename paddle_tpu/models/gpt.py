@@ -286,3 +286,62 @@ def gpt_1p3b(**kw) -> GPTConfig:
 def gpt_13b(**kw) -> GPTConfig:
     return GPTConfig(hidden_size=5120, num_layers=40, num_heads=40,
                      max_position_embeddings=2048, **kw)
+
+
+# -- pipeline-parallel variant -------------------------------------------
+
+def _tied_head_forward(shared_emb, x):
+    """LM head applied with the shared embedding's weight (reference:
+    SharedLayerDesc weight tying across first/last pp stage)."""
+    w = shared_emb.word_embeddings.weight
+    if mp_active():
+        x = _c_identity(x)
+    return ops.matmul(x, w, transpose_y=True)
+
+
+class GPTForCausalLMPipe:
+    """Builds the PipelineLayer form of GPTForCausalLM.
+
+    (reference: PaddleNLP GPTForCausalLMPipe / reference pp_layers.py:261
+    PipelineLayer usage — LayerDesc list with SharedLayerDesc embedding
+    tying; here the homogeneous GPTDecoderLayer run becomes the
+    stacked/scanned pipelined middle.)
+
+    Use as ``model = GPTForCausalLMPipe(config)`` — returns a
+    PipelineLayer with loss_fn=GPTPretrainingCriterion, ready for
+    ``fleet.distributed_model`` + ``train_batch``.
+    """
+
+    def __new__(cls, config: GPTConfig, num_stages=None,
+                recompute_interval: int = 0, **pp_kwargs):
+        from ..distributed.fleet.meta_parallel import (LayerDesc,
+                                                       PipelineLayer,
+                                                       SharedLayerDesc)
+
+        descs = [
+            SharedLayerDesc("embed", GPTEmbeddings, None, "weight", config),
+            *[LayerDesc(GPTDecoderLayer, config)
+              for _ in range(config.num_layers)],
+            LayerDesc(LayerNorm, config.hidden_size,
+                      epsilon=config.layer_norm_eps),
+        ]
+        if config.tie_word_embeddings:
+            descs.append(SharedLayerDesc("embed", GPTEmbeddings,
+                                         _tied_head_forward, "weight",
+                                         config))
+        else:
+            descs.append(LayerDesc(
+                ColumnParallelLinear, config.hidden_size, config.vocab_size,
+                weight_attr=_init_attr(config.initializer_range),
+                has_bias=False, gather_output=False))
+        model = PipelineLayer(
+            layers=descs, num_stages=num_stages,
+            loss_fn=GPTPretrainingCriterion(config),
+            seg_method="layer:GPTDecoderLayer",
+            recompute_interval=recompute_interval, **pp_kwargs)
+        if config.dtype not in ("float32", None):
+            model.astype(config.dtype)
+        return model
+
+
+__all__.append("GPTForCausalLMPipe")
