@@ -12,8 +12,10 @@ from .collective import (  # noqa: F401
     isend, new_group, recv, reduce, reduce_scatter, scatter, send,
     spmd_region, ReduceOp, Group, ProcessGroup, split_group)
 from . import fleet  # noqa: F401
+from . import sharding  # noqa: F401
 from .engine import ParallelEngine, bind_params, shard_module_params  # noqa: F401
 from .parallel import DataParallel, ParallelEnv  # noqa: F401
+from .sharding import group_sharded_parallel, save_group_sharded_model  # noqa: F401
 
 __all__ = [
     "all_gather", "all_reduce", "all_to_all", "barrier", "broadcast",
@@ -21,5 +23,6 @@ __all__ = [
     "new_group", "recv", "reduce", "reduce_scatter", "scatter", "send",
     "isend", "irecv", "ReduceOp", "Group", "ProcessGroup", "fleet",
     "DataParallel", "ParallelEnv", "spmd_region", "in_spmd_region",
-    "split_group",
+    "split_group", "sharding", "group_sharded_parallel",
+    "save_group_sharded_model",
 ]

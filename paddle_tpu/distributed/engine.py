@@ -60,6 +60,69 @@ def param_spec(p) -> P:
     return da if isinstance(da, P) else P()
 
 
+def _with_axis(spec: P, ndim: int, dim: int, axis: str) -> P:
+    """``spec`` with mesh ``axis`` added as the sharding of dim ``dim``."""
+    parts = list(spec) + [None] * (ndim - len(spec))
+    parts[dim] = axis
+    return P(*parts)
+
+
+class _ZeroPlan:
+    """ZeRO param/state sharding plan over the 'sharding' mesh axis.
+
+    The reference partitions params greedily by size and hand-codes the
+    reduce-scatter/broadcast traffic (dygraph_sharding_optimizer.py:224,
+    group_sharded_stage3.py). Here the plan is declarative: each eligible
+    parameter gets a shard *dim* (first dim divisible by the sharding
+    degree and not already sharded by tp/pp), and the engine emits
+    all_gather / psum_scatter on that dim inside the compiled step —
+    XLA schedules and overlaps the traffic on ICI.
+
+    Stage 1/2 ("os"/"os_g"): optimizer states (and the update math) are
+    sharded; params stay replicated across 'sharding'.
+    Stage 3 ("p_g_os"):   params are *stored* sharded and all-gathered
+    at step entry (donated buffers keep peak memory at shard size).
+    """
+
+    def __init__(self, mesh: Mesh, trainable, optimizer):
+        axis = getattr(optimizer, "state_partition_axis", None) \
+            if optimizer is not None else None
+        stage3 = any(getattr(p, "_zero3", False) for p in trainable)
+        if stage3 and axis is None:
+            axis = "sharding"
+        self.axis = axis
+        self.n = (mesh.shape[axis]
+                  if axis is not None and axis in mesh.axis_names else 1)
+        self.entries = {}
+        if self.n <= 1:
+            self.axis = None
+            return
+        for p in trainable:
+            spec = param_spec(p)
+            shape = tuple(p._value.shape)
+            for d in range(len(shape)):
+                used = spec[d] if d < len(spec) else None
+                if used is None and shape[d] % self.n == 0 \
+                        and shape[d] >= self.n:
+                    self.entries[id(p)] = (d, getattr(p, "_zero3", False))
+                    break
+
+    def entry(self, p):
+        return self.entries.get(id(p)) if self.axis else None
+
+    def state_spec(self, p) -> P:
+        e = self.entry(p)
+        if e is None:
+            return param_spec(p)
+        return _with_axis(param_spec(p), p._value.ndim, e[0], self.axis)
+
+    def storage_spec(self, p) -> P:
+        e = self.entry(p)
+        if e is None or not e[1]:
+            return param_spec(p)
+        return _with_axis(param_spec(p), p._value.ndim, e[0], self.axis)
+
+
 @contextlib.contextmanager
 def bind_params(params: Sequence, values: Sequence):
     """Temporarily swap each Parameter's backing array (functional call).
@@ -123,7 +186,10 @@ class ParallelEngine:
         self.trainable: List = [p for p in self.params if p.trainable]
         self._seed = 0
         self._compiled: Dict[Any, Callable] = {}
-        shard_module_params(model, mesh)
+        self._zero = _ZeroPlan(mesh, self.trainable, optimizer)
+        for p in self.params:
+            sh = NamedSharding(mesh, self._zero.storage_spec(p))
+            p._value = jax.device_put(p._value, sh)
 
     # -- optimizer state management -------------------------------------
     def _ensure_opt_states(self):
@@ -132,7 +198,7 @@ class ParallelEngine:
         states = []
         for p in self.trainable:
             st = opt._param_state(p, shapes)
-            sh = NamedSharding(self.mesh, param_spec(p))
+            sh = NamedSharding(self.mesh, self._zero.state_spec(p))
             st = {k: jax.device_put(v, sh) if v.shape == tuple(p._value.shape)
                   else v for k, v in st.items()}
             opt._states[id(p)] = st
@@ -155,9 +221,11 @@ class ParallelEngine:
         t_index = [i for i, p in enumerate(params) if p.trainable]
 
         self._ensure_opt_states()
-        pspecs = tuple(param_spec(p) for p in params)
-        sspecs = tuple({k: param_spec(p) if v.shape == tuple(p._value.shape)
-                        else P() for k, v in opt._states[id(p)].items()}
+        zero = self._zero
+        pspecs = tuple(zero.storage_spec(p) for p in params)
+        sspecs = tuple({k: zero.state_spec(p)
+                        if v.shape == tuple(p._value.shape) else P()
+                        for k, v in opt._states[id(p)].items()}
                        for p in trainable)
 
         def _step(pvals, svals, mvals, batch, lr, stepc, seed):
@@ -188,9 +256,32 @@ class ParallelEngine:
                     spec_axes.update(ax)
                 elif ax is not None:
                     spec_axes.add(ax)
-            return tuple(a for a in pp_axes if a not in spec_axes)
+            extra = tuple(a for a in pp_axes if a not in spec_axes)
+            # sequence-parallel replicated params (LayerNorm etc.) see only
+            # a seq shard per mp rank: their grads must psum over mp
+            # (reference sequence_parallel_utils.py:156 allreduce hooks)
+            if getattr(p, "sequence_parallel", False):
+                extra += tuple(
+                    a for a in ("mp",) if a in mesh.axis_names
+                    and mesh.shape[a] > 1 and a not in spec_axes)
+            return extra
+
+        def _shard_of(p, v, dim):
+            idx = lax.axis_index(zero.axis)
+            loc = v.shape[dim] // zero.n
+            return lax.dynamic_slice_in_dim(v, idx * loc, loc, axis=dim)
 
         def _step_inner(pvals, svals, mvals, batch, lr, stepc):
+            # ZeRO-3 params arrive as shards: all-gather for the forward,
+            # but keep the stored shard for the optimizer update
+            pshards = pvals
+            pvals = list(pvals)
+            for i, p in enumerate(params):
+                e = zero.entry(p)
+                if e is not None and e[1]:
+                    pvals[i] = lax.all_gather(pvals[i], zero.axis,
+                                              axis=e[0], tiled=True)
+            pvals = tuple(pvals)
             with bind_params(params, pvals):
                 t_batch = jax.tree_util.tree_map(
                     lambda v: Tensor(v, stop_gradient=True), batch)
@@ -200,24 +291,55 @@ class ParallelEngine:
                 for i, p in zip(t_index, trainable):
                     g = (p.grad._value if p.grad is not None
                          else jnp.zeros_like(p._value))
-                    if data_axes:
-                        g = lax.pmean(g, data_axes)
-                    psum_axes = _grad_axes(p)
-                    if psum_axes:
-                        g = lax.psum(g, psum_axes)
+                    e = zero.entry(p)
+                    if e is not None:
+                        # grad mean over plain dp, then reduce-scatter the
+                        # sharding axis onto the owner shard (ZeRO)
+                        dim = e[0]
+                        dp_only = tuple(a for a in data_axes
+                                        if a != zero.axis)
+                        if dp_only:
+                            g = lax.pmean(g, dp_only)
+                        psum_axes = _grad_axes(p)
+                        if psum_axes:
+                            g = lax.psum(g, psum_axes)
+                        if zero.axis in data_axes:
+                            g = lax.psum_scatter(
+                                g, zero.axis, scatter_dimension=dim,
+                                tiled=True) / zero.n
+                        else:
+                            g = _shard_of(p, g, dim)
+                        upd_in.append(mvals[i] if mvals and i in mvals
+                                      else (pshards[i] if e[1]
+                                            else _shard_of(p, pvals[i], dim)))
+                    else:
+                        if data_axes:
+                            g = lax.pmean(g, data_axes)
+                        psum_axes = _grad_axes(p)
+                        if psum_axes:
+                            g = lax.psum(g, psum_axes)
+                        upd_in.append(mvals[i] if mvals and i in mvals
+                                      else pvals[i])
                     grads.append(g)
-                    upd_in.append(mvals[i] if mvals and i in mvals
-                                  else pvals[i])
                 new_p, new_s = opt._fused_update(
                     tuple(upd_in), tuple(grads), tuple(svals), lr, stepc)
                 out_p = list(pvals)
                 out_m = dict(mvals) if mvals else {}
                 for i, p, nv in zip(t_index, trainable, new_p):
+                    e = zero.entry(p)
+                    if e is not None and not e[1]:
+                        # stage 1/2: params stay replicated — gather the
+                        # updated shards (the reference's param broadcast,
+                        # dygraph_sharding_optimizer.py:317)
+                        nv_p = lax.all_gather(nv, zero.axis, axis=e[0],
+                                              tiled=True)
+                    else:
+                        nv_p = nv
                     if out_m and i in out_m:
                         out_m[i] = nv
-                        out_p[i] = nv.astype(pvals[i].dtype)
+                        out_p[i] = nv_p.astype(pvals[i].dtype)
                     else:
-                        out_p[i] = nv
+                        out_p[i] = nv_p
                 lv = loss._value
                 all_axes = tuple(a for a in mesh.axis_names
                                  if mesh.shape[a] > 1)
@@ -251,7 +373,7 @@ class ParallelEngine:
             mvals = {i: opt._master_weights[id(p)]
                      for i, p in zip(t_index, trainable)
                      if id(p) in opt._master_weights}
-            mspecs = {i: param_spec(params[i]) for i in mvals}
+            mspecs = {i: zero.state_spec(params[i]) for i in mvals}
             key = (treedef, tuple((v.shape, str(v.dtype))
                                   for v in leaf_vals), b_specs,
                    tuple(sorted(mvals)))
@@ -285,11 +407,19 @@ class ParallelEngine:
         mesh = self.mesh
         data_axes = _mesh_data_axes(mesh)
         params = self.params
-        pspecs = tuple(param_spec(p) for p in params)
+        zero = self._zero
+        pspecs = tuple(zero.storage_spec(p) for p in params)
         compiled: Dict[Any, Callable] = {}
 
         def make(treedef, b_specs, out_spec):
             def flat_fwd(pvals, batch_leaves):
+                pvals = list(pvals)
+                for i, p in enumerate(params):
+                    e = zero.entry(p)
+                    if e is not None and e[1]:
+                        pvals[i] = lax.all_gather(pvals[i], zero.axis,
+                                                  axis=e[0], tiled=True)
+                pvals = tuple(pvals)
                 with C.spmd_region(), bind_params(params, pvals), \
                         _ad.no_grad():
                     batch = jax.tree_util.tree_unflatten(treedef,

@@ -11,6 +11,8 @@ from typing import Optional
 from .base.distributed_strategy import DistributedStrategy
 from .base.topology import CommunicateTopology, HybridCommunicateGroup
 from . import layers  # noqa: F401
+from . import utils  # noqa: F401
+from .utils.recompute import recompute  # noqa: F401
 
 __all__ = ["init", "DistributedStrategy", "HybridCommunicateGroup",
            "CommunicateTopology", "get_hybrid_communicate_group",

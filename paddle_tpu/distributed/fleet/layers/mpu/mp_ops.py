@@ -31,7 +31,9 @@ from .....autograd import engine as _engine
 from .....tensor import Tensor
 
 __all__ = ["_c_identity", "_c_concat", "_c_split", "_mp_allreduce",
-           "mp_axes", "mp_active"]
+           "mp_axes", "mp_active", "allgather_slice_bwd",
+           "slice_allgather_bwd", "allgather_reducescatter_bwd",
+           "reducescatter_allgather_bwd"]
 
 
 def mp_axes(group: Optional[C.Group] = None):
@@ -73,39 +75,74 @@ psum_identity_bwd.defvjp(lambda x, axes: (lax.psum(x, axes), None),
                          lambda axes, _, g: (g,))
 
 
-@partial(jax.custom_vjp, nondiff_argnums=(1,))
-def allgather_slice_bwd(x, axes):
-    """Forward all-gather (tiled, last dim); backward local slice."""
-    return lax.all_gather(x, axes, axis=x.ndim - 1, tiled=True)
+@partial(jax.custom_vjp, nondiff_argnums=(1, 2))
+def allgather_slice_bwd(x, axes, axis=-1):
+    """Forward all-gather (tiled) along ``axis``; backward local slice."""
+    return lax.all_gather(x, axes, axis=axis % x.ndim, tiled=True)
 
 
-def _ag_fwd(x, axes):
-    return allgather_slice_bwd(x, axes), x.shape[-1]
+def _ag_fwd(x, axes, axis):
+    return allgather_slice_bwd(x, axes, axis), x.shape[axis]
 
 
-def _ag_bwd(axes, local, g):
+def _ag_bwd(axes, axis, local, g):
     idx = C.axis_index(axes)
-    return (lax.dynamic_slice_in_dim(g, idx * local, local, axis=-1),)
+    return (lax.dynamic_slice_in_dim(g, idx * local, local, axis=axis),)
 
 
 allgather_slice_bwd.defvjp(_ag_fwd, _ag_bwd)
 
 
-@partial(jax.custom_vjp, nondiff_argnums=(1,))
-def slice_allgather_bwd(x, axes):
-    """Forward this rank's last-dim slice; backward all-gather."""
+@partial(jax.custom_vjp, nondiff_argnums=(1, 2))
+def slice_allgather_bwd(x, axes, axis=-1):
+    """Forward this rank's slice of ``axis``; backward all-gather."""
     n = 1
     for a in axes:
         n *= lax.axis_size(a)
-    local = x.shape[-1] // n
+    local = x.shape[axis] // n
     idx = C.axis_index(axes)
-    return lax.dynamic_slice_in_dim(x, idx * local, local, axis=-1)
+    return lax.dynamic_slice_in_dim(x, idx * local, local, axis=axis)
 
 
 slice_allgather_bwd.defvjp(
-    lambda x, axes: (slice_allgather_bwd(x, axes), None),
-    lambda axes, _, g: (lax.all_gather(g, axes, axis=g.ndim - 1,
-                                       tiled=True),))
+    lambda x, axes, axis: (slice_allgather_bwd(x, axes, axis), None),
+    lambda axes, axis, _, g: (lax.all_gather(g, axes, axis=axis % g.ndim,
+                                             tiled=True),))
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(1, 2))
+def allgather_reducescatter_bwd(x, axes, axis=0):
+    """Forward all-gather along ``axis``; backward reduce-scatter (sum).
+    The SP pairing (sequence_parallel_utils AllGatherOp)."""
+    return lax.all_gather(x, axes, axis=axis, tiled=True)
+
+
+def _agrs_bwd(axes, axis, _, g):
+    out = g
+    for a in axes:
+        out = lax.psum_scatter(out, a, scatter_dimension=axis, tiled=True)
+    return (out,)
+
+
+allgather_reducescatter_bwd.defvjp(
+    lambda x, axes, axis: (allgather_reducescatter_bwd(x, axes, axis), None),
+    _agrs_bwd)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(1, 2))
+def reducescatter_allgather_bwd(x, axes, axis=0):
+    """Forward reduce-scatter (sum) along ``axis``; backward all-gather.
+    The SP pairing (sequence_parallel_utils ReduceScatterOp)."""
+    out = x
+    for a in axes:
+        out = lax.psum_scatter(out, a, scatter_dimension=axis, tiled=True)
+    return out
+
+
+reducescatter_allgather_bwd.defvjp(
+    lambda x, axes, axis: (reducescatter_allgather_bwd(x, axes, axis), None),
+    lambda axes, axis, _, g: (lax.all_gather(g, axes, axis=axis,
+                                             tiled=True),))
 
 
 def _custom(name, fwd_value, backward_fn, x: Tensor) -> Tensor:
@@ -160,7 +197,7 @@ def _c_concat(x: Tensor, group: Optional[C.Group] = None) -> Tensor:
         idx = C.axis_index(axes)
         return (lax.dynamic_slice_in_dim(g, idx * local, local, axis=-1),)
 
-    return _custom("c_concat", allgather_slice_bwd(x._value, axes), bwd, x)
+    return _custom("c_concat", allgather_slice_bwd(x._value, axes, -1), bwd, x)
 
 
 def _c_split(x: Tensor, group: Optional[C.Group] = None) -> Tensor:
@@ -173,4 +210,4 @@ def _c_split(x: Tensor, group: Optional[C.Group] = None) -> Tensor:
     def bwd(g):
         return (lax.all_gather(g, axes, axis=g.ndim - 1, tiled=True),)
 
-    return _custom("c_split", slice_allgather_bwd(x._value, axes), bwd, x)
+    return _custom("c_split", slice_allgather_bwd(x._value, axes, -1), bwd, x)

@@ -38,6 +38,18 @@ class _OptimizerWrapper:
     def __getattr__(self, name):
         return getattr(self._inner_opt, name)
 
+    # _step_count is read AND written by the engine (`opt._step_count += 1`);
+    # without a data descriptor the write would shadow the inner counter and
+    # state_dict() would save a frozen step (wrong Adam bias correction on
+    # resume)
+    @property
+    def _step_count(self):
+        return self._inner_opt._step_count
+
+    @_step_count.setter
+    def _step_count(self, v):
+        self._inner_opt._step_count = v
+
     def step(self):
         return self._inner_opt.step()
 
@@ -64,11 +76,8 @@ class HybridParallelOptimizer(_OptimizerWrapper):
         super().__init__(optimizer, hcg, strategy)
         sharding_degree = (hcg.get_sharding_parallel_world_size()
                            if hcg is not None else 1)
-        if sharding_degree > 1 and not isinstance(
-                optimizer, DygraphShardingOptimizer):
-            # fleet auto-wraps with stage-1 sharding when the axis exists
-            self._inner_opt = DygraphShardingOptimizer(
-                optimizer, hcg)._inner_opt
+        if sharding_degree > 1:
+            # fleet auto-applies stage-1 sharding when the axis exists
             self._inner_opt.state_partition_axis = "sharding"
 
 

@@ -181,6 +181,14 @@ class PipelineLayer(Layer):
         self._num_stages = int(num_stages)
         self._vpp = int(num_virtual_pipeline_stages or 1)
         self._loss_fn = loss_fn
+        # the stacked blocks share ONE scanned body, so recompute is
+        # all-or-nothing here: every block (interval=1) or none (0) —
+        # a per-k-th-layer policy is not expressible inside lax.scan
+        from .....core.enforce import enforce
+
+        enforce(recompute_interval in (0, 1),
+                "recompute_interval must be 0 (off) or 1 (recompute every "
+                f"block); got {recompute_interval}")
         self._recompute_interval = recompute_interval
         self._seg_method = seg_method
         self._num_microbatches = 1

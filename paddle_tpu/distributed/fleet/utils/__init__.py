@@ -1,0 +1,11 @@
+"""Fleet utility namespace (reference: python/paddle/distributed/fleet/utils/).
+
+``sequence_parallel_utils`` — Megatron-style sequence parallelism.
+``recompute`` / ``hybrid_parallel_util`` helpers live at this level in the
+reference; here grad sync is performed inside the compiled SPMD step, so
+the hook-based helpers reduce to markers the engine reads.
+"""
+from . import sequence_parallel_utils  # noqa: F401
+from .recompute import recompute  # noqa: F401
+
+__all__ = ["sequence_parallel_utils", "recompute"]

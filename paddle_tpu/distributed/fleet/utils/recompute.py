@@ -1,0 +1,147 @@
+"""Activation recomputation (gradient checkpointing).
+
+TPU-native re-design of the reference's RecomputeFunction
+(reference: python/paddle/distributed/fleet/recompute/recompute.py:108,404
+— a PyLayer that stashes RNG state, drops activations, and re-runs the
+forward inside backward; hybrid variant recompute_hybrid.py).
+
+Here the block is wrapped in ``jax.checkpoint`` (remat): XLA drops the
+block's internal activations and re-emits its forward into the backward
+computation — the compiler-native version of re-running under a fresh
+tape. RNG consistency is automatic: the rematerialized subgraph is the
+*same traced program* (same PRNG key derivations), so dropout masks match
+without the reference's CUDA RNG state-tracker dance (mpu/random.py:34).
+"""
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+
+from ....autograd import engine as _engine
+from ....nn.layer import Layer
+from ....tensor import Tensor
+
+__all__ = ["recompute", "recompute_sequential"]
+
+
+def _collect_params(function):
+    """Find the trainable params ``function`` will touch.
+
+    Covers a Layer, a bound method of a Layer, and — the common reference
+    idiom — a closure (``lambda h: self.mlp(h)``): closure cells holding
+    Layers or Tensors are scanned so their params still receive grads.
+    """
+    seen, params = set(), []
+
+    def add_layer(layer):
+        if id(layer) in seen:
+            return
+        seen.add(id(layer))
+        for p in layer.parameters():
+            if not p.stop_gradient and id(p) not in seen:
+                seen.add(id(p))
+                params.append(p)
+
+    if isinstance(function, Layer):
+        add_layer(function)
+        return params
+    owner = getattr(function, "__self__", None)
+    if isinstance(owner, Layer):
+        add_layer(owner)
+    for cell in getattr(function, "__closure__", None) or ():
+        try:
+            v = cell.cell_contents
+        except ValueError:
+            continue
+        if isinstance(v, Layer):
+            add_layer(v)
+        elif isinstance(v, Tensor) and not v.stop_gradient \
+                and id(v) not in seen:
+            seen.add(id(v))
+            params.append(v)
+    return params
+
+
+def recompute(function, *args, use_reentrant: bool = True,
+              preserve_rng_state: bool = True, **kwargs):
+    """Run ``function(*args, **kwargs)`` without keeping its internal
+    activations; they are rematerialized during backward.
+
+    ``function`` is typically a sublayer (or bound method of one) — its
+    parameters are discovered so their gradients flow. Free functions of
+    the inputs work too.
+    """
+    params = _collect_params(function)
+
+    flat_in, treedef = jax.tree_util.tree_flatten(
+        (args, kwargs), is_leaf=lambda x: isinstance(x, Tensor))
+    t_idx = [i for i, v in enumerate(flat_in) if isinstance(v, Tensor)]
+    t_args = [flat_in[i] for i in t_idx]
+
+    need_grad = _engine.is_grad_enabled() and (
+        any(not t.stop_gradient for t in t_args) or bool(params))
+    if not need_grad:
+        return function(*args, **kwargs)
+
+    from ...engine import bind_params
+
+    def _pure(pvals, avals):
+        leaves = list(flat_in)
+        for i, v in zip(t_idx, avals):
+            leaves[i] = Tensor(v, stop_gradient=True)
+        a, kw = jax.tree_util.tree_unflatten(treedef, leaves)
+        with bind_params(params, pvals), _engine.no_grad():
+            out = function(*a, **kw)
+        if isinstance(out, (tuple, list)):
+            return tuple(o._value if isinstance(o, Tensor) else o
+                         for o in out)
+        return out._value if isinstance(out, Tensor) else out
+
+    ckpt = jax.checkpoint(_pure)
+    pvals = tuple(p._value for p in params)
+    avals = tuple(t._value for t in t_args)
+    out_vals, vjp_fn = jax.vjp(ckpt, pvals, avals)
+
+    multi = isinstance(out_vals, tuple)
+    outs = [Tensor(v, stop_gradient=False)
+            for v in (out_vals if multi else (out_vals,))]
+
+    def bwd(*gouts):
+        g = gouts if multi else gouts[0]
+        pgrads, agrads = vjp_fn(g)
+        return tuple(pgrads) + tuple(agrads)
+
+    _engine.record_custom("recompute", bwd, list(params) + t_args, outs,
+                          out_vals if multi else (out_vals,))
+    return tuple(outs) if multi else outs[0]
+
+
+def recompute_sequential(ctx: dict, functions, *args, **kwargs):
+    """Recompute a ``nn.Sequential`` in segments
+    (reference: fleet/recompute/recompute_sequential.py)."""
+    segments = int(ctx.get("segments", 1)) if ctx else 1
+    layers = list(functions)
+    if segments <= 1:
+        return recompute(_Seq(layers), *args, **kwargs)
+    size = max(1, len(layers) // segments)
+    out = args
+    for start in range(0, len(layers), size):
+        seg = _Seq(layers[start:start + size])
+        out = recompute(seg, *(out if isinstance(out, tuple) else (out,)),
+                        **kwargs)
+        kwargs = {}
+    return out
+
+
+class _Seq(Layer):
+    def __init__(self, layers):
+        super().__init__()
+        for i, l in enumerate(layers):
+            self.add_sublayer(str(i), l)
+        self._layers_list = layers
+
+    def forward(self, *x):
+        for l in self._layers_list:
+            x = l(*x) if isinstance(x, tuple) else l(x)
+        return x

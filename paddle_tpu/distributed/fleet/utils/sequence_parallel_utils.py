@@ -1,0 +1,286 @@
+"""Megatron-style sequence parallelism over the mp mesh axis.
+
+TPU-native re-design of the reference's SP utilities
+(reference: python/paddle/distributed/fleet/utils/sequence_parallel_utils.py
+:85-340 — ScatterOp/GatherOp/AllGatherOp/ReduceScatterOp PyLayers +
+ColumnSequenceParallelLinear/RowSequenceParallelLinear;
+register_sequence_parallel_allreduce_hooks:192).
+
+SP keeps activations sharded along the *sequence* dim between the TP
+linears: the column linear all-gathers the sequence right before its
+matmul (backward: reduce-scatter), and the row linear reduce-scatters its
+output along the sequence (backward: all-gather) — replacing the
+identity/allreduce pair of plain TP with an allgather/reduce-scatter pair
+of the same total bytes but sqrt(mp) lower peak activation memory.
+
+Here every primitive is an XLA collective on the 'mp' axis inside the
+SPMD region (shard_map), so XLA overlaps them with the matmuls on ICI.
+Outside an SPMD region all primitives are identities (single-card parity,
+the reference test strategy).
+
+Layout note: the reference fixes seq as dim 0 ([s, b, h]); here the
+sequence axis is a parameter (default 0 for reference parity) since the
+native models use [b, s, h].
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ... import collective as C
+from ....autograd import engine as _engine
+from ....core.enforce import enforce
+from ....framework.param_attr import ParamAttr
+from ....nn import functional as F
+from ....nn.layer import Layer
+from ....tensor import Tensor
+from ..layers.mpu.mp_ops import mp_active, mp_axes
+
+__all__ = [
+    "ScatterOp", "GatherOp", "AllGatherOp", "ReduceScatterOp",
+    "scatter", "all_gather", "reduce_scatter",
+    "identity_in_sequence_parallel",
+    "mark_as_sequence_parallel_parameter",
+    "is_sequence_parallel_parameter",
+    "register_sequence_parallel_allreduce_hooks",
+    "ColumnSequenceParallelLinear", "RowSequenceParallelLinear",
+]
+
+
+# the custom-vjp collective pairings and the tape-recording helper are
+# shared with the TP primitives (mp_ops.py) — SP only changes which dim
+# is gathered/scattered
+from ..layers.mpu.mp_ops import (
+    _custom, allgather_reducescatter_bwd as _allgather_rs_bwd,
+    allgather_slice_bwd as _allgather_slice_bwd,
+    reducescatter_allgather_bwd as _rs_allgather_bwd,
+    slice_allgather_bwd as _slice_allgather_bwd)
+
+
+# -- tensor-level SP ops (reference PyLayers) -----------------------------
+
+def scatter(x: Tensor, group=None, axis: int = 0) -> Tensor:
+    """Split the sequence dim across mp; backward all-gathers
+    (reference ScatterOp, sequence_parallel_utils.py:85)."""
+    if not mp_active(group):
+        return x
+    axes = mp_axes(group)
+    enforce(x.shape[axis] % C.get_world_size(_group(group)) == 0,
+            f"sequence dim {x.shape[axis]} must divide mp degree")
+
+    def bwd(g):
+        return (lax.all_gather(g, axes, axis=axis, tiled=True),)
+
+    return _custom("sp_scatter", _slice_allgather_bwd(x._value, axes, axis),
+                   bwd, x)
+
+
+def all_gather(x: Tensor, group=None, axis: int = 0) -> Tensor:
+    """All-gather the sequence dim; backward reduce-scatters
+    (reference AllGatherOp:150)."""
+    if not mp_active(group):
+        return x
+    axes = mp_axes(group)
+
+    def bwd(g):
+        out = g
+        for a in axes:
+            out = lax.psum_scatter(out, a, scatter_dimension=axis,
+                                   tiled=True)
+        return (out,)
+
+    return _custom("sp_all_gather", _allgather_rs_bwd(x._value, axes, axis),
+                   bwd, x)
+
+
+def gather(x: Tensor, group=None, axis: int = 0) -> Tensor:
+    """All-gather the sequence dim; backward takes the local slice
+    (reference GatherOp:117)."""
+    if not mp_active(group):
+        return x
+    axes = mp_axes(group)
+    local = x._value.shape[axis]
+
+    def bwd(g):
+        idx = C.axis_index(axes)
+        return (lax.dynamic_slice_in_dim(g, idx * local, local, axis=axis),)
+
+    return _custom("sp_gather", _allgather_slice_bwd(x._value, axes, axis),
+                   bwd, x)
+
+
+def reduce_scatter(x: Tensor, group=None, axis: int = 0) -> Tensor:
+    """Reduce-scatter (sum) along the sequence dim; backward all-gathers
+    (reference ReduceScatterOp:180)."""
+    if not mp_active(group):
+        return x
+    axes = mp_axes(group)
+
+    def bwd(g):
+        return (lax.all_gather(g, axes, axis=axis, tiled=True),)
+
+    return _custom("sp_reduce_scatter",
+                   _rs_allgather_bwd(x._value, axes, axis), bwd, x)
+
+
+# class-style aliases for reference API parity (PyLayer.apply surface)
+class _OpAlias:
+    def __init__(self, fn):
+        self._fn = fn
+
+    def apply(self, x, group=None, axis: int = 0):
+        return self._fn(x, group=group, axis=axis)
+
+    __call__ = apply
+
+
+ScatterOp = _OpAlias(scatter)
+GatherOp = _OpAlias(gather)
+AllGatherOp = _OpAlias(all_gather)
+ReduceScatterOp = _OpAlias(reduce_scatter)
+
+
+def identity_in_sequence_parallel(x: Tensor) -> Tensor:
+    return x
+
+
+def _group(group):
+    if group is not None:
+        return group
+    from ... import fleet as _fleet
+
+    hcg = _fleet.get_hybrid_communicate_group()
+    return hcg.get_model_parallel_group() if hcg is not None else None
+
+
+# -- replicated-param grad sync markers -----------------------------------
+
+def mark_as_sequence_parallel_parameter(parameter) -> None:
+    """Mark a replicated parameter used on sequence-sharded activations
+    (LayerNorm scales/biases, position embeddings). Its gradient is then
+    psum'ed over mp inside the compiled step — the engine-side analog of
+    the reference's allreduce hook (sequence_parallel_utils.py:156)."""
+    parameter.sequence_parallel = True
+
+
+def is_sequence_parallel_parameter(parameter) -> bool:
+    return bool(getattr(parameter, "sequence_parallel", False))
+
+
+def register_sequence_parallel_allreduce_hooks(model,
+                                               accumulation_steps: int = 1,
+                                               fused_allreduce: bool = False):
+    """Reference :192 registers backward hooks allreducing marked params'
+    grads over mp. In the SPMD engine the psum happens inside the one
+    compiled step, so this only validates the marks exist."""
+    return [p for p in model.parameters()
+            if is_sequence_parallel_parameter(p)]
+
+
+# -- SP linears (reference :222 ColumnSequenceParallelLinear,
+#    :286 RowSequenceParallelLinear) --------------------------------------
+
+class ColumnSequenceParallelLinear(Layer):
+    """Column-parallel linear whose input arrives sequence-sharded.
+
+    Forward: all-gather input along seq → local matmul with the
+    column-sharded weight. Backward of the gather is a reduce-scatter.
+    ``gather_output`` must be False (reference enforces the same).
+    """
+
+    def __init__(self, in_features: int, out_features: int, weight_attr=None,
+                 has_bias: bool = True, gather_output: bool = False,
+                 fuse_matmul_bias: bool = False, mp_group=None, name=None,
+                 seq_axis: int = 0):
+        super().__init__()
+        enforce(not gather_output,
+                "ColumnSequenceParallelLinear requires gather_output=False")
+        self._mp_group = mp_group
+        self._seq_axis = seq_axis
+        g = _group(mp_group)
+        self.world_size = g.nranks if g is not None else 1
+        self.is_mp = self.world_size > 1
+        enforce(out_features % self.world_size == 0,
+                f"out_features {out_features} must divide mp degree "
+                f"{self.world_size}")
+        self.in_features = in_features
+        self.out_features = out_features
+        from jax.sharding import PartitionSpec as P
+
+        self.weight = self.create_parameter(
+            (in_features, out_features), attr=ParamAttr._to_attr(weight_attr))
+        self.bias = self.create_parameter(
+            (out_features,), attr=ParamAttr._to_attr(None), is_bias=True) \
+            if has_bias else None
+        if self.is_mp:
+            self.weight.dist_attr = P(None, "mp")
+            self.weight.is_distributed = True
+            if self.bias is not None:
+                self.bias.dist_attr = P("mp")
+                self.bias.is_distributed = True
+
+    def forward(self, x):
+        if self.is_mp:
+            x = all_gather(x, self._mp_group, axis=self._seq_axis)
+        return F.linear(x, self.weight, self.bias)
+
+    def extra_repr(self):
+        return (f"in={self.in_features}, out={self.out_features}, "
+                f"mp={self.world_size}, sp")
+
+
+class RowSequenceParallelLinear(Layer):
+    """Row-parallel linear whose output leaves sequence-sharded.
+
+    Forward: local matmul with the row-sharded weight → reduce-scatter
+    along seq (replacing plain TP's allreduce). Backward is an
+    all-gather. ``input_is_parallel`` must be True (reference parity).
+    """
+
+    def __init__(self, in_features: int, out_features: int, weight_attr=None,
+                 has_bias: bool = True, input_is_parallel: bool = True,
+                 fuse_matmul_bias: bool = False, mp_group=None, name=None,
+                 seq_axis: int = 0):
+        super().__init__()
+        enforce(input_is_parallel,
+                "RowSequenceParallelLinear requires input_is_parallel=True")
+        self._mp_group = mp_group
+        self._seq_axis = seq_axis
+        g = _group(mp_group)
+        self.world_size = g.nranks if g is not None else 1
+        self.is_mp = self.world_size > 1
+        enforce(in_features % self.world_size == 0,
+                f"in_features {in_features} must divide mp degree "
+                f"{self.world_size}")
+        self.in_features = in_features
+        self.out_features = out_features
+        from jax.sharding import PartitionSpec as P
+
+        self.weight = self.create_parameter(
+            (in_features, out_features), attr=ParamAttr._to_attr(weight_attr))
+        self.bias = self.create_parameter(
+            (out_features,), attr=ParamAttr._to_attr(None), is_bias=True) \
+            if has_bias else None
+        if self.is_mp:
+            self.weight.dist_attr = P("mp", None)
+            self.weight.is_distributed = True
+            # bias replicated but applied on seq shards → grads need the
+            # mp psum: mark it sequence-parallel
+            if self.bias is not None:
+                mark_as_sequence_parallel_parameter(self.bias)
+
+    def forward(self, x):
+        out = F.linear(x, self.weight, None)
+        if self.is_mp:
+            out = reduce_scatter(out, self._mp_group, axis=self._seq_axis)
+        if self.bias is not None:
+            out = out + self.bias
+        return out
+
+    def extra_repr(self):
+        return (f"in={self.in_features}, out={self.out_features}, "
+                f"mp={self.world_size}, sp")

@@ -1,0 +1,72 @@
+"""Group-sharded (ZeRO) data parallelism — public API.
+
+TPU-native re-design of the reference's group_sharded_parallel
+(reference: python/paddle/distributed/sharding/group_sharded.py:40;
+stage impls meta_parallel/sharding/group_sharded_stage2.py,
+group_sharded_stage3.py, group_sharded_optimizer_stage2.py).
+
+Levels (reference naming):
+- ``os``      — ZeRO-1: optimizer states sharded over the 'sharding' axis.
+- ``os_g``    — ZeRO-2: + gradients reduce-scattered to the owner shard.
+- ``p_g_os``  — ZeRO-3: + parameters stored sharded, all-gathered per step.
+
+Mechanically all three are declarative here: parameters/states carry a
+sharding plan (engine._ZeroPlan) and the compiled SPMD step emits
+all_gather / psum_scatter on ICI with donated buffers — XLA's scheduler
+provides the comm/compute overlap the reference hand-codes with comm
+streams (group_sharded_stage2.py:_comm_grads).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from ..fleet.meta_optimizers.dygraph_optimizer import DygraphShardingOptimizer
+
+__all__ = ["group_sharded_parallel", "save_group_sharded_model"]
+
+
+def group_sharded_parallel(model, optimizer, level: str, scaler=None,
+                           group=None, offload: bool = False,
+                           sync_buffers: bool = False, buffer_max_size=2**23,
+                           segment_size=2**20, sync_comm: bool = False,
+                           dp_group=None, exclude_layer=None):
+    """Wrap model/optimizer for ZeRO training (reference group_sharded.py:40).
+
+    Returns ``(model, optimizer, scaler)``. The returned objects are the
+    same instances, annotated with the sharding plan the ParallelEngine
+    honors when compiling the train step over a mesh with a 'sharding'
+    axis (strategy.hybrid_configs["sharding_degree"] > 1).
+    """
+    levels = ("os", "os_g", "p_g_os")
+    if level not in levels:
+        raise ValueError(f"level must be one of {levels}, got {level!r}")
+    inner = getattr(optimizer, "_inner_opt", optimizer)
+    inner.state_partition_axis = "sharding"
+    if level in ("os_g", "p_g_os"):
+        inner.shard_gradients = True  # informational; engine scatters anyway
+    if level == "p_g_os":
+        for p in model.parameters():
+            if p.trainable:
+                p._zero3 = True
+        model._group_sharded_stage = 3
+    else:
+        model._group_sharded_stage = 2 if level == "os_g" else 1
+    if not isinstance(optimizer, DygraphShardingOptimizer) and \
+            not hasattr(optimizer, "_inner_opt"):
+        optimizer = DygraphShardingOptimizer(optimizer)
+    return model, optimizer, scaler
+
+
+def save_group_sharded_model(model, output, optimizer=None):
+    """Save a group-sharded model's full (unsharded) state
+    (reference group_sharded.py:149). Parameters are global jax.Arrays,
+    so the gather is implicit in ``.numpy()``."""
+    import os
+
+    from ...framework import io as _io
+
+    os.makedirs(output, exist_ok=True)
+    _io.save(model.state_dict(), os.path.join(output, "model.pdmodel"))
+    if optimizer is not None:
+        _io.save(optimizer.state_dict(),
+                 os.path.join(output, "model.pdopt"))
