@@ -29,6 +29,7 @@ from . import vision  # noqa: F401
 from . import jit  # noqa: F401
 from . import device  # noqa: F401
 from . import framework  # noqa: F401
+from . import incubate  # noqa: F401
 from .framework.io import load, save
 from . import metric  # noqa: F401
 from . import distributed  # noqa: F401

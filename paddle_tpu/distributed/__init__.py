@@ -13,6 +13,7 @@ from .collective import (  # noqa: F401
     spmd_region, ReduceOp, Group, ProcessGroup, split_group)
 from . import fleet  # noqa: F401
 from . import sharding  # noqa: F401
+from . import utils  # noqa: F401
 from .engine import ParallelEngine, bind_params, shard_module_params  # noqa: F401
 from .parallel import DataParallel, ParallelEnv  # noqa: F401
 from .sharding import group_sharded_parallel, save_group_sharded_model  # noqa: F401

@@ -99,6 +99,15 @@ class _ZeroPlan:
             return
         for p in trainable:
             spec = param_spec(p)
+            flat_spec = set()
+            for ax in spec:
+                flat_spec.update(ax if isinstance(ax, (tuple, list))
+                                 else (ax,))
+            # params already sharded over a data axis (MoE experts over dp)
+            # have per-rank-distinct grads; the ZeRO scatter math below
+            # assumes replicated grads, so leave them out of the plan
+            if flat_spec & set(_DATA_AXES):
+                continue
             shape = tuple(p._value.shape)
             for d in range(len(shape)):
                 used = spec[d] if d < len(spec) else None
@@ -249,13 +258,17 @@ class ParallelEngine:
                         if getattr(self.model, "_pp_ownership", False)
                         and a in mesh.axis_names and mesh.shape[a] > 1)
 
-        def _grad_axes(p):
+        def _spec_axes(p):
             spec_axes = set()
             for ax in param_spec(p):
                 if isinstance(ax, (tuple, list)):
                     spec_axes.update(ax)
                 elif ax is not None:
                     spec_axes.add(ax)
+            return spec_axes
+
+        def _grad_axes(p):
+            spec_axes = _spec_axes(p)
             extra = tuple(a for a in pp_axes if a not in spec_axes)
             # sequence-parallel replicated params (LayerNorm etc.) see only
             # a seq shard per mp rank: their grads must psum over mp
@@ -313,8 +326,21 @@ class ParallelEngine:
                                       else (pshards[i] if e[1]
                                             else _shard_of(p, pvals[i], dim)))
                     else:
-                        if data_axes:
-                            g = lax.pmean(g, data_axes)
+                        # params sharded over a data axis (MoE experts over
+                        # dp) already receive their cross-rank grad sum via
+                        # the all_to_all transpose — no pmean over that
+                        # axis, only the global-batch mean rescale
+                        spec_axes = _spec_axes(p)
+                        pm = tuple(a for a in data_axes
+                                   if a not in spec_axes)
+                        if pm:
+                            g = lax.pmean(g, pm)
+                        dup = 1
+                        for a in data_axes:
+                            if a in spec_axes:
+                                dup *= mesh.shape[a]
+                        if dup > 1:
+                            g = g / dup
                         psum_axes = _grad_axes(p)
                         if psum_axes:
                             g = lax.psum(g, psum_axes)

@@ -1,0 +1,4 @@
+from . import moe_utils  # noqa: F401
+from .moe_utils import global_gather, global_scatter  # noqa: F401
+
+__all__ = ["moe_utils", "global_scatter", "global_gather"]

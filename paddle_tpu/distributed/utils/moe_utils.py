@@ -1,0 +1,93 @@
+"""MoE all-to-all communication helpers.
+
+TPU-native replacement for the reference's variable-length collectives
+(reference: python/paddle/distributed/utils/moe_utils.py:20
+global_scatter/global_gather; CUDA
+fluid/operators/collective/global_scatter_op.cu.cc — NCCL grouped
+send/recv driven by per-(rank,expert) counts).
+
+XLA collectives are compiled with static shapes, so the variable-count
+protocol becomes a *uniform-slot* all-to-all: callers lay tokens out as
+``[n_expert_total, capacity, d]`` (MoELayer's dense dispatch does this)
+and the exchange is one ``lax.all_to_all`` on ICI. The count-based
+entry points below therefore require uniform counts; MoELayer never
+calls them with anything else.
+"""
+from __future__ import annotations
+
+import jax
+from jax import lax
+
+from .. import collective as C
+from ...autograd import engine as _engine
+from ...core.enforce import enforce
+from ...tensor import Tensor
+
+__all__ = ["global_scatter", "global_gather"]
+
+
+def _a2a(x: Tensor, axes, split_axis: int, concat_axis: int,
+         name: str) -> Tensor:
+    val = lax.all_to_all(x._value, axes, split_axis, concat_axis,
+                         tiled=True)
+    out = Tensor(val, stop_gradient=x.stop_gradient)
+    if _engine.is_grad_enabled() and not x.stop_gradient:
+        out.stop_gradient = False
+
+        def bwd(g):
+            return (lax.all_to_all(g, axes, concat_axis, split_axis,
+                                   tiled=True),)
+
+        _engine.record_custom(name, bwd, [x], [out], val)
+    return out
+
+
+def _check_uniform(counts, world, name):
+    if counts is None:
+        return
+    vals = counts.numpy() if isinstance(counts, Tensor) else counts
+    enforce(len(set(int(v) for v in vals)) <= 1,
+            f"{name}: XLA all_to_all needs uniform per-rank counts; lay "
+            "tokens out at fixed capacity (MoELayer does this) — got "
+            f"{list(vals)[:8]}")
+
+
+def global_scatter(x: Tensor, local_count=None, global_count=None,
+                   group=None, use_calc_stream: bool = True) -> Tensor:
+    """Send token slots to the ranks owning their experts
+    (reference moe_utils.py:20). ``x``: [E_total*C_local, d] or
+    [E_total, C, d]; returns this rank's experts' slots from all ranks."""
+    g = group if group is not None else C.get_group(0)
+    if g is None or g.nranks <= 1 or not C.in_spmd_region():
+        return x
+    _check_uniform(local_count, g.nranks, "global_scatter")
+    axes = g.axis_names
+    squeeze = x.ndim == 2
+    if squeeze:
+        from ...ops import manipulation as M
+
+        n = g.nranks
+        x = M.reshape(x, [n, x.shape[0] // n, x.shape[1]])
+        out = _a2a(x, axes, 0, 1, "global_scatter")
+        return M.reshape(out, [-1, out.shape[-1]])
+    return _a2a(x, axes, 0, 1, "global_scatter")
+
+
+def global_gather(x: Tensor, local_count=None, global_count=None,
+                  group=None, use_calc_stream: bool = True) -> Tensor:
+    """Inverse of global_scatter: return expert outputs to the token-origin
+    ranks (reference moe_utils.py:109)."""
+    g = group if group is not None else C.get_group(0)
+    if g is None or g.nranks <= 1 or not C.in_spmd_region():
+        return x
+    _check_uniform(local_count, g.nranks, "global_gather")
+    axes = g.axis_names
+    squeeze = x.ndim == 2
+    if squeeze:
+        from ...ops import manipulation as M
+
+        n = g.nranks
+        x = M.reshape(x, [n, x.shape[0] // n, x.shape[1]])
+        out = _a2a(x, axes, 1, 0, "global_gather")
+        return M.reshape(out, [-1, out.shape[-1]])
+    return _a2a(x, axes, 1, 0, "global_gather")

@@ -1,0 +1,5 @@
+"""Incubating APIs (reference: python/paddle/incubate/)."""
+from . import distributed  # noqa: F401
+from . import nn  # noqa: F401
+
+__all__ = ["distributed", "nn"]

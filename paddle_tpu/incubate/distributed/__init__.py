@@ -1,0 +1,3 @@
+from . import models  # noqa: F401
+
+__all__ = ["models"]

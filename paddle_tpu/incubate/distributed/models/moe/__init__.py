@@ -1,0 +1,6 @@
+"""Mixture-of-Experts with expert parallelism
+(reference: python/paddle/incubate/distributed/models/moe/)."""
+from .gate import BaseGate, GShardGate, NaiveGate, SwitchGate  # noqa: F401
+from .moe_layer import MoELayer  # noqa: F401
+
+__all__ = ["MoELayer", "BaseGate", "NaiveGate", "GShardGate", "SwitchGate"]

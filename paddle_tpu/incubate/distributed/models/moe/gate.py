@@ -1,0 +1,84 @@
+"""MoE gates: naive top-k, Switch (top-1), GShard (top-2).
+
+TPU-native re-design of the reference's gate zoo
+(reference: python/paddle/incubate/distributed/models/moe/gate/
+naive_gate.py, switch_gate.py, gshard_gate.py, base_gate.py).
+
+The reference gates emit per-token expert indices consumed by the
+variable-length ``global_scatter`` CUDA op. XLA needs static shapes, so
+here a gate is a *policy object* — (top_k, capacity_factor, jitter,
+aux-loss style) — and the dense capacity-C dispatch/combine tensors are
+built inside the MoE kernel (moe_layer.py::_topk_dispatch), the standard
+GShard einsum formulation that maps onto the MXU.
+
+The gate projection weight lives in the gate (a Layer, reference parity)
+and is replicated across expert-parallel ranks.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from .....nn.layer import Layer
+
+__all__ = ["BaseGate", "NaiveGate", "SwitchGate", "GShardGate"]
+
+
+class BaseGate(Layer):
+    """Holds the [d_model, num_experts] router projection + policy knobs."""
+
+    top_k = 1
+    capacity_factor: Optional[float] = None  # None → no token dropping
+    jitter = 0.0
+
+    def __init__(self, d_model: int, num_experts: int, weight_attr=None):
+        super().__init__()
+        self.d_model = d_model
+        self.num_experts = num_experts
+        self.weight = self.create_parameter((d_model, num_experts))
+        self._loss = None
+
+    def get_loss(self, clear: bool = True):
+        """The auxiliary load-balancing loss of the last forward
+        (reference base_gate.py:49 set_loss/get_loss)."""
+        loss = self._loss
+        if clear:
+            self._loss = None
+        return loss
+
+    def set_loss(self, loss):
+        self._loss = loss
+
+    def extra_repr(self):
+        return (f"d={self.d_model}, experts={self.num_experts}, "
+                f"k={self.top_k}, cf={self.capacity_factor}")
+
+
+class NaiveGate(BaseGate):
+    """Plain top-k routing, generous capacity (reference naive_gate.py)."""
+
+    def __init__(self, d_model, num_experts, topk: int = 2, **kw):
+        super().__init__(d_model, num_experts)
+        self.top_k = topk
+        self.capacity_factor = None
+
+
+class SwitchGate(BaseGate):
+    """Switch-Transformer top-1 gate with capacity
+    (reference switch_gate.py — topk=1, capacity via switch_capacity)."""
+
+    def __init__(self, d_model, num_experts, topk: int = 1,
+                 capacity: float = 1.25, **kw):
+        super().__init__(d_model, num_experts)
+        self.top_k = 1
+        self.capacity_factor = capacity
+
+
+class GShardGate(BaseGate):
+    """GShard top-2 gate with capacity and load-balance loss
+    (reference gshard_gate.py — topk=2, capacity=(1.2, 2.4))."""
+
+    def __init__(self, d_model, num_experts, topk: int = 2,
+                 capacity: float = 2.0, random_routing: bool = True, **kw):
+        super().__init__(d_model, num_experts)
+        self.top_k = 2
+        self.capacity_factor = capacity

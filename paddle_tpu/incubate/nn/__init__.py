@@ -1,0 +1,8 @@
+"""Incubating NN layers (reference: python/paddle/incubate/nn/).
+
+Fused transformer-era layers land here (FusedMultiTransformer analog,
+fused rms_norm/rope functional) — see ``functional``.
+"""
+from . import functional  # noqa: F401
+
+__all__ = ["functional"]

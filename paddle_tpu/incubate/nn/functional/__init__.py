@@ -1,0 +1,7 @@
+"""Fused functional ops (reference: python/paddle/incubate/nn/functional/).
+
+The Pallas/XLA fused kernels register here under the reference names;
+see ops/fused.py for the kernel implementations.
+"""
+
+__all__ = []

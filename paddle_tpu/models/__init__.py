@@ -1,9 +1,9 @@
 """Flagship model families (GPT for hybrid-parallel training; the
 reference trains these through Fleet — SURVEY.md §3.3)."""
 from .gpt import (GPTConfig, GPTForCausalLM, GPTForCausalLMPipe, GPTModel,
-                  GPTPretrainingCriterion, gpt_125m, gpt_13b, gpt_1p3b,
-                  gpt_350m, gpt_tiny)
+                  GPTPretrainingCriterion, ernie_moe_base, gpt_125m,
+                  gpt_13b, gpt_1p3b, gpt_350m, gpt_moe_tiny, gpt_tiny)
 
 __all__ = ["GPTConfig", "GPTModel", "GPTForCausalLM", "GPTForCausalLMPipe",
            "GPTPretrainingCriterion", "gpt_tiny", "gpt_125m", "gpt_350m",
-           "gpt_1p3b", "gpt_13b"]
+           "gpt_1p3b", "gpt_13b", "gpt_moe_tiny", "ernie_moe_base"]

@@ -60,6 +60,12 @@ class GPTConfig:
     use_flash_attention: bool = True
     tie_word_embeddings: bool = True
     dtype: str = "float32"
+    # MoE (ERNIE-MoE style): num_experts > 0 replaces the MLP of every
+    # `moe_every`-th block with an expert-parallel MoELayer
+    num_experts: int = 0
+    moe_every: int = 2
+    moe_gate: str = "gshard"
+    moe_aux_coef: float = 0.01
 
     def __post_init__(self):
         if not self.intermediate_size:
@@ -147,15 +153,39 @@ class GPTMLP(Layer):
         return self.dropout(self.fc2(F.gelu(self.fc1(x), approximate=True)))
 
 
+class GPTMoEMLP(Layer):
+    """Expert-parallel MoE feed-forward (ERNIE-MoE block: reference
+    incubate/distributed/models/moe/moe_layer.py used inside ERNIE)."""
+
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        from ..incubate.distributed.models.moe import MoELayer
+
+        self.moe = MoELayer(config.hidden_size,
+                            d_hidden=config.intermediate_size,
+                            num_experts=config.num_experts,
+                            gate=config.moe_gate)
+        self.dropout = Dropout(config.hidden_dropout)
+
+    @property
+    def aux_loss(self):
+        return self.moe.aux_loss
+
+    def forward(self, x):
+        return self.dropout(self.moe(x))
+
+
 class GPTDecoderLayer(Layer):
     """Pre-LN decoder block; homogeneous across the stack (pipelineable)."""
 
-    def __init__(self, config: GPTConfig):
+    def __init__(self, config: GPTConfig, layer_idx: int = 0):
         super().__init__()
         self.ln1 = LayerNorm(config.hidden_size, epsilon=config.layer_norm_eps)
         self.attn = GPTAttention(config)
         self.ln2 = LayerNorm(config.hidden_size, epsilon=config.layer_norm_eps)
-        self.mlp = GPTMLP(config)
+        use_moe = (config.num_experts > 0
+                   and layer_idx % max(1, config.moe_every) == 0)
+        self.mlp = GPTMoEMLP(config) if use_moe else GPTMLP(config)
 
     def forward(self, x, cache=None):
         if cache is not None:
@@ -191,8 +221,8 @@ class GPTModel(Layer):
         super().__init__()
         self.config = config
         self.embeddings = GPTEmbeddings(config)
-        self.layers = LayerList([GPTDecoderLayer(config)
-                                 for _ in range(config.num_layers)])
+        self.layers = LayerList([GPTDecoderLayer(config, layer_idx=i)
+                                 for i in range(config.num_layers)])
         self.final_ln = LayerNorm(config.hidden_size,
                                   epsilon=config.layer_norm_eps)
 
@@ -247,6 +277,21 @@ class GPTForCausalLM(Layer):
                                      position_offset=position_offset)
             return self._logits(x), new_caches
         return self._logits(self.gpt(input_ids))
+
+    @property
+    def aux_loss(self):
+        """Sum of MoE load-balance losses of the last forward (scaled by
+        config.moe_aux_coef); 0 for dense models."""
+        total = None
+        for layer in self.gpt.layers:
+            a = getattr(layer.mlp, "aux_loss", None)
+            if a is not None:
+                total = a if total is None else total + a
+        if total is None:
+            from ..tensor import to_tensor
+
+            return to_tensor(0.0)
+        return total * self.config.moe_aux_coef
 
 
 class GPTPretrainingCriterion(Layer):
@@ -345,3 +390,15 @@ class GPTForCausalLMPipe:
 
 
 __all__.append("GPTForCausalLMPipe")
+
+
+def gpt_moe_tiny(**kw) -> GPTConfig:
+    kw.setdefault("num_experts", 4)
+    return gpt_tiny(**kw)
+
+
+def ernie_moe_base(**kw) -> GPTConfig:
+    """ERNIE-MoE style base config (BASELINE.md EP benchmark row)."""
+    kw.setdefault("num_experts", 64)
+    kw.setdefault("moe_every", 2)
+    return GPTConfig(hidden_size=768, num_layers=12, num_heads=12, **kw)
