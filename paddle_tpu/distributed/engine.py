@@ -225,6 +225,13 @@ class ParallelEngine:
         loss Tensor."""
         mesh = self.mesh
         data_axes = _mesh_data_axes(mesh)
+        # 'sep' (context parallel) splits the *sequence*: grads of
+        # replicated params are per-block partials, so they average over
+        # sep exactly like a batch split (but batch dims are NOT sharded
+        # over sep — the model slices seq itself)
+        sep_axes = tuple(a for a in ("sep",) if a in mesh.axis_names
+                         and mesh.shape[a] > 1)
+        gmean_axes = data_axes + sep_axes
         opt = self.optimizer
         params, trainable = self.params, self.trainable
         t_index = [i for i, p in enumerate(params) if p.trainable]
@@ -239,12 +246,12 @@ class ParallelEngine:
 
         def _step(pvals, svals, mvals, batch, lr, stepc, seed):
             with C.spmd_region():
-                if data_axes:
-                    # distinct RNG stream per data-parallel rank (mp/pp
+                if gmean_axes:
+                    # distinct RNG stream per data-parallel/sep rank (mp/pp
                     # ranks share a stream: replicated tensors must drop
                     # identically; mp-sharded ones use 'local_seed')
                     seed = seed * jnp.uint32(1000003) + \
-                        C.axis_index(data_axes).astype(jnp.uint32)
+                        C.axis_index(gmean_axes).astype(jnp.uint32)
                 ctx = _rng.fork_traced(seed)
                 ctx.__enter__()
                 try:
@@ -309,7 +316,7 @@ class ParallelEngine:
                         # grad mean over plain dp, then reduce-scatter the
                         # sharding axis onto the owner shard (ZeRO)
                         dim = e[0]
-                        dp_only = tuple(a for a in data_axes
+                        dp_only = tuple(a for a in gmean_axes
                                         if a != zero.axis)
                         if dp_only:
                             g = lax.pmean(g, dp_only)
@@ -331,12 +338,12 @@ class ParallelEngine:
                         # the all_to_all transpose — no pmean over that
                         # axis, only the global-batch mean rescale
                         spec_axes = _spec_axes(p)
-                        pm = tuple(a for a in data_axes
+                        pm = tuple(a for a in gmean_axes
                                    if a not in spec_axes)
                         if pm:
                             g = lax.pmean(g, pm)
                         dup = 1
-                        for a in data_axes:
+                        for a in gmean_axes:
                             if a in spec_axes:
                                 dup *= mesh.shape[a]
                         if dup > 1:

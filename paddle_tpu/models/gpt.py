@@ -87,6 +87,43 @@ def _init_attr(std):
     return ParamAttr(initializer=I.Normal(mean=0.0, std=std))
 
 
+def _sep_axes():
+    """Active context-parallel ('sep') mesh axes, or None.
+
+    The reference's SEP splits sequence segments across ranks with P2P
+    helpers but no ring-attention kernel (SURVEY.md §2.4 — CP absent).
+    Here sep ranks hold contiguous sequence blocks and attention runs the
+    exact ring algorithm (ops/ring_attention.py)."""
+    from ..distributed import collective as C
+    from ..distributed import fleet as _fleet
+
+    if not C.in_spmd_region():
+        return None
+    hcg = _fleet.get_hybrid_communicate_group()
+    if hcg is None or hcg.get_sep_parallel_world_size() <= 1:
+        return None
+    return hcg.get_sep_parallel_group().axis_names
+
+
+def _sep_shard(value, axis: int):
+    """This sep rank's contiguous block of ``axis`` (+ global offset)."""
+    import jax.numpy as jnp
+    from jax import lax as _lax
+
+    from ..distributed import collective as C
+
+    axes = _sep_axes()
+    if axes is None:
+        return value, 0
+    n = 1
+    for a in axes:
+        n *= _lax.axis_size(a)
+    idx = C.axis_index(axes)
+    loc = value.shape[axis] // n
+    off = idx * loc
+    return _lax.dynamic_slice_in_dim(value, off, loc, axis=axis), off
+
+
 class GPTAttention(Layer):
     """Causal self-attention; qkv column-parallel, out row-parallel."""
 
@@ -116,6 +153,13 @@ class GPTAttention(Layer):
             v = ops.concat([cache[1], v], axis=1)
             new_cache = (k, v)
             out = flash_attention(q, k, v, causal=S > 1)
+        elif _sep_axes() is not None:
+            # context parallelism: seq is sep-sharded; exact ring attention
+            new_cache = None
+            from ..ops.ring_attention import ring_flash_attention
+
+            out = ring_flash_attention(q, k, v, axes=_sep_axes(),
+                                       causal=True)
         else:
             new_cache = None
             p = self.config.attention_dropout if self.training else 0.0
@@ -211,7 +255,11 @@ class GPTEmbeddings(Layer):
 
     def forward(self, input_ids, position_offset=0):
         S = input_ids.shape[1]
-        pos = ops.arange(position_offset, position_offset + S, dtype="int32")
+        # offset may be a traced scalar (sep rank * block), so add it
+        # rather than baking it into arange bounds
+        pos = ops.arange(0, S, dtype="int32")
+        if not isinstance(position_offset, int) or position_offset:
+            pos = pos + position_offset
         x = self.word_embeddings(input_ids) + self.position_embeddings(pos)
         return self.dropout(x)
 
@@ -227,6 +275,12 @@ class GPTModel(Layer):
                                   epsilon=config.layer_norm_eps)
 
     def forward(self, input_ids, caches=None, position_offset=0):
+        if caches is None and _sep_axes() is not None:
+            # context parallel: each sep rank embeds+computes only its
+            # contiguous sequence block; ring attention stitches them
+            ids_local, off = _sep_shard(input_ids._value, axis=1)
+            input_ids = Tensor(ids_local, stop_gradient=True)
+            position_offset = off
         x = self.embeddings(input_ids, position_offset)
         if caches is not None:
             new_caches = []
@@ -302,6 +356,14 @@ class GPTPretrainingCriterion(Layer):
         self._mp_group = mp_group
 
     def forward(self, logits, labels, loss_mask=None):
+        if _sep_axes() is not None and labels.shape[1] != logits.shape[1]:
+            # context parallel: logits are seq-local — take the matching
+            # label (and mask) block; mean-of-local-means == global mean
+            lv, _ = _sep_shard(labels._value, axis=1)
+            labels = Tensor(lv, stop_gradient=True)
+            if loss_mask is not None:
+                mv, _ = _sep_shard(loss_mask._value, axis=1)
+                loss_mask = Tensor(mv, stop_gradient=True)
         loss = parallel_cross_entropy(logits, labels, self._mp_group)
         loss = ops.squeeze(loss, axis=-1)
         if loss_mask is not None:

@@ -1,0 +1,111 @@
+"""Ring attention: exact flash attention over a sequence-sharded ring.
+
+The reference snapshot has NO ring attention / context parallelism
+(SURVEY.md §5 long-context: only Megatron-SP + SEP topology + flash
+attention; repo-wide grep confirms absence). This is the planned
+superset feature: the ICI torus is a natural ring, so blockwise online-
+softmax attention with k/v rotating one hop per step gives exact
+attention over sequences sharded across the 'sep' mesh axis with O(S/n)
+activation memory per chip and comm that overlaps the per-block matmuls
+(XLA pipelines the ppermute with the einsums).
+
+Algorithm (Liu et al. ring attention; blockwise flash accumulation):
+each step computes the local q block against the currently-held k/v
+block in f32 with running (max, sum, acc) statistics, then ppermutes
+k/v one rank forward. Causality is applied with *global* positions, so
+the result is bit-identical math to full causal attention.
+
+Gradients come from jax.vjp through the loop (ppermute is linear; its
+transpose is the reverse ppermute), so backward re-runs the ring in the
+opposite direction inside the same compiled step.
+"""
+from __future__ import annotations
+
+import jax
+import numpy as np
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.dispatch import def_op
+from ..core.enforce import enforce
+
+_NEG = -1e30
+
+
+def _ring_attention(q, k, v, axes=(), causal=True, scale=None):
+    """q,k,v: [B, S_local, H, D] (seq sharded over ``axes``)."""
+    B, Sq, H, D = q.shape
+    Skv = k.shape[1]
+    if scale is None:
+        scale = 1.0 / np.sqrt(D)
+    f32 = jnp.float32
+    qf = q.astype(f32) * scale
+
+    if not axes:
+        n = 1
+        idx = jnp.int32(0)
+    else:
+        n = 1
+        for a in axes:
+            n *= lax.axis_size(a)
+        from ..distributed import collective as C
+
+        idx = C.axis_index(axes)
+
+    q_pos = idx * Sq + jnp.arange(Sq)
+    m = jnp.full((B, H, Sq), _NEG, f32)
+    l = jnp.zeros((B, H, Sq), f32)
+    acc = jnp.zeros((B, H, Sq, D), f32)
+    kj, vj = k, v
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    for t in range(n):
+        src = (idx - t) % n  # who produced the block we now hold
+        kv_pos = src * Skv + jnp.arange(Skv)
+        s = jnp.einsum("bqhd,bkhd->bhqk", qf, kj.astype(f32))
+        if causal:
+            keep = (q_pos[:, None] >= kv_pos[None, :])
+            s = jnp.where(keep[None, None], s, _NEG)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        p = jnp.exp(s - m_new[..., None])
+        if causal:
+            p = jnp.where(keep[None, None], p, 0.0)
+        corr = jnp.exp(m - m_new)
+        l = l * corr + jnp.sum(p, axis=-1)
+        acc = acc * corr[..., None] + \
+            jnp.einsum("bhqk,bkhd->bhqd", p, vj.astype(f32))
+        m = m_new
+        if t < n - 1:
+            kj = lax.ppermute(kj, axes[0], perm)
+            vj = lax.ppermute(vj, axes[0], perm)
+    out = acc / jnp.maximum(l, 1e-30)[..., None]
+    return jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)
+
+
+@def_op("ring_flash_attention")
+def ring_flash_attention(q, k, v, axes=(), causal=True, scale=None):
+    """Exact attention over seq-sharded q/k/v; [B, S_local, H, D] in/out."""
+    return _ring_attention(q, k, v, axes=tuple(axes), causal=causal,
+                           scale=scale)
+
+
+def ring_attention(q, k, v, group=None, causal=True, scale=None):
+    """Tensor-level entry. ``group`` defaults to the fleet sep group;
+    falls back to plain attention when the ring has one rank."""
+    from ..distributed import collective as C
+
+    axes = None
+    if group is not None:
+        axes = group.axis_names if group.nranks > 1 else None
+    else:
+        from ..distributed import fleet as _fleet
+
+        hcg = _fleet.get_hybrid_communicate_group()
+        if hcg is not None and hcg.get_sep_parallel_world_size() > 1:
+            axes = hcg.get_sep_parallel_group().axis_names
+    if axes is None or not C.in_spmd_region():
+        from .attention import flash_attention
+
+        return flash_attention(q, k, v, causal=causal)
+    enforce(len(axes) == 1, "ring attention needs a single mesh axis")
+    return ring_flash_attention(q, k, v, axes=tuple(axes), causal=causal,
+                                scale=scale)
