@@ -12,9 +12,10 @@ import time
 
 import numpy as np
 
-# Peak dense bf16/f32 FLOPs per chip by TPU generation (public specs).
+# Peak dense bf16 FLOPs per chip by TPU generation (public specs).
 _PEAK = {
-    "v4": 275e12, "v5e": 197e12, "v5p": 459e12, "v6e": 918e12,
+    "v4": 275e12, "v5e": 197e12, "v5 lite": 197e12, "v5litepod": 197e12,
+    "v5p": 459e12, "v6e": 918e12, "v6 lite": 918e12,
 }
 
 
@@ -24,7 +25,7 @@ def _peak_flops(device) -> float:
         if k in kind:
             return v
     if "tpu" in str(getattr(device, "platform", "")).lower():
-        return 459e12  # assume v5p
+        return 459e12  # unknown generation: assume v5p
     return 0.0  # CPU: MFU not meaningful
 
 

@@ -1,7 +1,116 @@
-"""Fused functional ops (reference: python/paddle/incubate/nn/functional/).
+"""Fused functional ops (reference: python/paddle/incubate/nn/functional/
+— fused_rms_norm, fused_layer_norm, fused_rotary_position_embedding,
+fused_bias_act, fused_dropout_add, swiglu).
 
-The Pallas/XLA fused kernels register here under the reference names;
-see ops/fused.py for the kernel implementations.
+Each fuses into the surrounding XLA program; on TPU the rms_norm and
+flash-attention paths dispatch to the Pallas kernels (ops/pallas/).
 """
+from __future__ import annotations
 
-__all__ = []
+from typing import Optional
+
+from ....ops import nn_ops as _nn
+from ....ops.nn_ops import fused_rope as _fused_rope
+from ....tensor import Tensor
+
+__all__ = [
+    "fused_rms_norm", "fused_layer_norm",
+    "fused_rotary_position_embedding", "fused_bias_act",
+    "fused_dropout_add", "swiglu", "fused_linear",
+]
+
+
+def fused_rms_norm(x, norm_weight, norm_bias=None, epsilon=1e-6,
+                   begin_norm_axis=-1, bias=None, residual=None,
+                   quant_scale=-1, **kw):
+    """(reference: incubate/nn/functional/fused_rms_norm.py →
+    phi/kernels/gpu/rms_norm_kernel.cu). Returns (out, residual_out) like
+    the reference when a residual is supplied, else out."""
+    if bias is not None:
+        x = x + bias
+    if residual is not None:
+        x = x + residual
+        residual_out = x
+    out = _nn.rms_norm(x, norm_weight, norm_bias, epsilon=epsilon,
+                       begin_norm_axis=begin_norm_axis)
+    if residual is not None:
+        return out, residual_out
+    return out
+
+
+def fused_layer_norm(x, norm_weight, norm_bias, epsilon=1e-5,
+                     begin_norm_axis=-1, bias=None, residual=None, **kw):
+    """(reference: phi/kernels/fusion/gpu/fused_layernorm_kernel.cu —
+    residual-add + layernorm fusion)."""
+    if bias is not None:
+        x = x + bias
+    if residual is not None:
+        x = x + residual
+        residual_out = x
+    out = _nn.layer_norm(x, norm_weight, norm_bias, epsilon=epsilon,
+                         begin_norm_axis=begin_norm_axis)
+    if residual is not None:
+        return out, residual_out
+    return out
+
+
+def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
+                                    position_ids=None,
+                                    use_neox_rotary_style=True, **kw):
+    """(reference: incubate/nn/functional/fused_rotary_position_embedding
+    → phi/kernels/fusion/gpu/fused_rope_kernel.cu; SPMD rule
+    spmd_rules/fused_rope.cc). q/k: [B, S, H, D]; returns the same tuple
+    arity as the reference (q, k, v)."""
+    outs = _fused_rope(q, q if k is None else k, cos, sin,
+                       position_ids=position_ids)
+    q_out, k_out = outs if isinstance(outs, (tuple, list)) else (outs, None)
+    return q_out, (None if k is None else k_out), v
+
+
+def fused_bias_act(x, bias=None, act_method: str = "gelu", **kw):
+    """(reference: phi/kernels/fusion/gpu/fused_bias_act_kernel.cu)."""
+    from ....nn import functional as F
+
+    if bias is not None:
+        x = x + bias
+    act = {"gelu": F.gelu, "relu": F.relu, "silu": F.silu,
+           "swiglu": swiglu, "geglu": None}.get(act_method)
+    if act_method == "geglu":
+        from ....ops import manipulation as M
+
+        a, b = M.split(x, 2, axis=-1)
+        return F.gelu(a) * b
+    if act is None:
+        raise ValueError(f"unknown act_method {act_method!r}")
+    return act(x)
+
+
+def swiglu(x, y=None):
+    """(reference: incubate/nn/functional/swiglu → phi swiglu kernel).
+    swiglu(x, y) = silu(x) * y; single-arg form splits x in half."""
+    from ....nn import functional as F
+
+    if y is None:
+        from ....ops import manipulation as M
+
+        x, y = M.split(x, 2, axis=-1)
+    return F.silu(x) * y
+
+
+def fused_dropout_add(x, y, p=0.0, training=True, mode="upscale_in_train",
+                      **kw):
+    """(reference: phi/kernels/fusion/gpu/fused_dropout_add_kernel.cu)."""
+    from ....nn import functional as F
+
+    return F.dropout(x, p=p, training=training, mode=mode) + y
+
+
+def fused_linear(x, weight, bias=None, transpose_weight=False, **kw):
+    """(reference: fused_gemm_epilogue — cuBLASLt matmul+bias; XLA fuses
+    the epilogue natively on the MXU)."""
+    from ....ops import math as M
+
+    out = M.matmul(x, weight, transpose_y=transpose_weight)
+    if bias is not None:
+        out = out + bias
+    return out

@@ -34,8 +34,9 @@ def flash_attention(q, k, v, causal=False, dropout=0.0, dropout_key=None):
         try:
             from .pallas.flash_attention import flash_attention_fwd
 
-            return flash_attention_fwd(q, k, v, causal=causal)
-        except Exception:
-            pass
+            # positional: custom_vjp nondiff args reject keywords
+            return flash_attention_fwd(q, k, v, causal, None, None)
+        except ValueError:
+            pass  # unsupported shape → XLA fallback below
     return _sdpa_raw(q, k, v, attn_mask=None, dropout_p=dropout,
                      is_causal=causal, dropout_key=dropout_key)

@@ -395,7 +395,23 @@ def layer_norm(x, weight=None, bias=None, epsilon=1e-5, begin_norm_axis=-1):
 @def_op("rms_norm")
 def rms_norm(x, weight=None, bias=None, epsilon=1e-6, begin_norm_axis=-1):
     """(reference: phi/kernels/gpu/rms_norm_kernel.cu; SPMD rule
-    infermeta/spmd_rules/rms_norm.cc). Accumulates in fp32 like the ref."""
+    infermeta/spmd_rules/rms_norm.cc). Accumulates in fp32 like the ref;
+    on TPU the fused Pallas kernel handles the common last-axis case."""
+    if (weight is not None and bias is None
+            and begin_norm_axis in (-1, x.ndim - 1)
+            and weight.ndim == 1):
+        from ..core import flags as _flags
+
+        if _flags._get("use_pallas_kernels", True):
+            try:
+                import jax as _jax
+
+                if "tpu" in str(_jax.devices()[0].platform).lower():
+                    from .pallas.rms_norm import rms_norm_fused
+
+                    return rms_norm_fused(x, weight, float(epsilon))
+            except Exception:
+                pass
     dtype = x.dtype
     xf = x.astype(jnp.float32)
     axes = tuple(range(begin_norm_axis % x.ndim, x.ndim))

@@ -1,0 +1,8 @@
+"""Pallas TPU kernels for the hot fused ops.
+
+(reference CUDA counterparts: phi/kernels/gpu/flash_attn_kernel.cu,
+rms_norm_kernel.cu, fusion/gpu/fused_rope_kernel.cu,
+fused_multi_transformer_op.cu.h — here each is a Mosaic kernel tiled for
+MXU/VMEM; on non-TPU backends the callers fall back to plain XLA, and
+tests run the kernels in interpret mode.)
+"""

@@ -1,0 +1,168 @@
+"""Pallas TPU flash attention (forward kernel + recompute backward).
+
+TPU-native replacement for the reference's dynloaded flashattn-v2 CUDA
+library (reference: phi/kernels/gpu/flash_attn_kernel.cu,
+backends/dynload/flashattn.h, python surface
+nn/functional/flash_attention.py:147).
+
+Design: classic flash — the q block lives in VMEM, k/v stream through
+VMEM blocks, online-softmax statistics (m, l) carried through a
+fori_loop so attention probabilities never hit HBM. The causal variant
+skips k/v blocks entirely above the diagonal (the loop's upper bound is
+a function of the q-block index), halving FLOPs. Backward recomputes
+through the XLA softmax-attention VJP under jax.checkpoint semantics —
+residuals are just (q, k, v), preserving flash's O(S) memory.
+
+Layout [B, S, H, D] (the paddle flash_attention layout). Grid:
+(B*H, S/block_q); f32 accumulation; MXU-shaped tiles (128 lanes).
+"""
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import numpy as np
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+
+try:  # TPU memory spaces are unavailable on CPU-only builds
+    from jax.experimental.pallas import tpu as pltpu
+
+    _VMEM = pltpu.VMEM
+except Exception:  # pragma: no cover
+    pltpu = None
+    _VMEM = None
+
+__all__ = ["flash_attention_fwd"]
+
+_NEG = -1e30
+
+
+def _pick_block(S: int, target: int = 128) -> int:
+    for b in (target, 256, 512, 64, 32, 16, 8):
+        if b <= S and S % b == 0:
+            return b
+    return 0
+
+
+def _kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal, block_q,
+            block_kv, seq_kv):
+    qb = q_ref[0].astype(jnp.float32) * scale            # [bq, D]
+    qi = pl.program_id(1)
+    D = qb.shape[-1]
+    nkv = seq_kv // block_kv
+
+    def body(j, carry):
+        m, l, acc = carry
+        kb = k_ref[0, pl.ds(j * block_kv, block_kv), :].astype(jnp.float32)
+        vb = v_ref[0, pl.ds(j * block_kv, block_kv), :].astype(jnp.float32)
+        s = lax.dot_general(qb, kb, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+        if causal:
+            rows = qi * block_q + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_kv), 0)
+            cols = j * block_kv + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_kv), 1)
+            keep = rows >= cols
+            s = jnp.where(keep, s, _NEG)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        if causal:
+            p = jnp.where(keep, p, 0.0)
+        corr = jnp.exp(m - m_new)
+        l = l * corr + jnp.sum(p, axis=-1, keepdims=True)
+        acc = acc * corr + lax.dot_general(
+            p, vb, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return m_new, l, acc
+
+    m0 = jnp.full((block_q, 1), _NEG, jnp.float32)
+    l0 = jnp.zeros((block_q, 1), jnp.float32)
+    acc0 = jnp.zeros((block_q, D), jnp.float32)
+    if causal:
+        # blocks strictly above the diagonal contribute nothing — skip
+        upper = jnp.minimum(
+            (qi * block_q + block_q + block_kv - 1) // block_kv, nkv)
+    else:
+        upper = nkv
+    m, l, acc = lax.fori_loop(0, upper, body, (m0, l0, acc0))
+    o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+
+
+def _pallas_fa(q3, k3, v3, causal, scale, block_q, block_kv, interpret):
+    BH, S, D = q3.shape
+    Skv = k3.shape[1]
+    kw = {} if _VMEM is None else {"memory_space": _VMEM}
+    return pl.pallas_call(
+        partial(_kernel, scale=scale, causal=causal, block_q=block_q,
+                block_kv=block_kv, seq_kv=Skv),
+        grid=(BH, S // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0), **kw),
+            pl.BlockSpec((1, Skv, D), lambda b, i: (b, 0, 0), **kw),
+            pl.BlockSpec((1, Skv, D), lambda b, i: (b, 0, 0), **kw),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0),
+                               **kw),
+        out_shape=jax.ShapeDtypeStruct((BH, S, D), q3.dtype),
+        interpret=interpret,
+    )(q3, k3, v3)
+
+
+def _supported(q, k) -> bool:
+    B, S, H, D = q.shape
+    return k.shape[1] == S and _pick_block(S) > 0
+
+
+def _interpret_default() -> bool:
+    try:
+        return "tpu" not in str(jax.devices()[0].platform).lower()
+    except Exception:
+        return True
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def flash_attention_fwd(q, k, v, causal=False, scale=None,
+                        interpret=None):
+    """[B, S, H, D] → [B, S, H, D]; raises ValueError when the shape
+    needs the XLA fallback (caller catches)."""
+    out, _ = _fa_fwd(q, k, v, causal, scale, interpret)
+    return out
+
+
+def _fa_fwd(q, k, v, causal, scale, interpret):
+    if not _supported(q, k):
+        raise ValueError("flash pallas kernel: unsupported shape "
+                         f"{q.shape}/{k.shape}")
+    B, S, H, D = q.shape
+    if scale is None:
+        scale = 1.0 / np.sqrt(D)
+    if interpret is None:
+        interpret = _interpret_default()
+    block_q = _pick_block(S)
+    block_kv = _pick_block(k.shape[1])
+    to3 = lambda x: jnp.swapaxes(x, 1, 2).reshape(B * H, x.shape[1], D)
+    o3 = _pallas_fa(to3(q), to3(k), to3(v), causal, scale, block_q,
+                    block_kv, interpret)
+    out = jnp.swapaxes(o3.reshape(B, H, S, D), 1, 2)
+    return out, (q, k, v)
+
+
+def _fa_bwd(causal, scale, interpret, res, g):
+    # recompute-based backward: O(S) residual memory, XLA fuses the
+    # attention VJP (flash backward Pallas kernel is a future upgrade)
+    q, k, v = res
+    from ..nn_ops import scaled_dot_product_attention as _sdpa
+
+    def ref(q_, k_, v_):
+        return _sdpa.raw(q_, k_, v_, attn_mask=None, dropout_p=0.0,
+                         is_causal=causal, scale=scale)
+
+    _, vjp_fn = jax.vjp(ref, q, k, v)
+    return vjp_fn(g)
+
+
+flash_attention_fwd.defvjp(lambda q, k, v, causal, scale, interpret:
+                           _fa_fwd(q, k, v, causal, scale, interpret),
+                           _fa_bwd)

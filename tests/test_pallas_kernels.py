@@ -1,0 +1,137 @@
+"""Pallas TPU kernel numerics (interpret mode on CPU — the reference's
+OpTest pattern: kernel output vs a NumPy/XLA reference, fwd + grad)."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu.ops.pallas.flash_attention import flash_attention_fwd
+from paddle_tpu.ops.pallas.rms_norm import rms_norm_fused
+from paddle_tpu.ops.nn_ops import scaled_dot_product_attention as _sdpa
+
+
+def _ref_attn(q, k, v, causal):
+    return _sdpa.raw(q, k, v, attn_mask=None, dropout_p=0.0,
+                     is_causal=causal)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("S", [16, 64])
+def test_flash_attention_forward(causal, S):
+    rng = np.random.RandomState(0)
+    B, H, D = 2, 3, 16
+    q, k, v = (jnp.asarray(rng.randn(B, S, H, D).astype("float32"))
+               for _ in range(3))
+    out = flash_attention_fwd(q, k, v, causal, None, True)
+    ref = _ref_attn(q, k, v, causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_flash_attention_grads():
+    rng = np.random.RandomState(1)
+    B, S, H, D = 1, 32, 2, 8
+    q, k, v = (jnp.asarray(rng.randn(B, S, H, D).astype("float32"))
+               for _ in range(3))
+
+    def f_pallas(q, k, v):
+        return jnp.sum(flash_attention_fwd(q, k, v, True, None, True) ** 2)
+
+    def f_ref(q, k, v):
+        return jnp.sum(_ref_attn(q, k, v, True) ** 2)
+
+    gp = jax.grad(f_pallas, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(f_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(gp, gr, "qkv"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-5, err_msg=name)
+
+
+def test_flash_attention_bf16():
+    rng = np.random.RandomState(2)
+    B, S, H, D = 2, 32, 2, 16
+    mk = lambda: jnp.asarray(rng.randn(B, S, H, D)).astype(jnp.bfloat16)
+    q, k, v = mk(), mk(), mk()
+    out = flash_attention_fwd(q, k, v, True, None, True)
+    assert out.dtype == jnp.bfloat16
+    ref = _ref_attn(q.astype(jnp.float32), k.astype(jnp.float32),
+                    v.astype(jnp.float32), True)
+    np.testing.assert_allclose(np.asarray(out, dtype=np.float32),
+                               np.asarray(ref), rtol=2e-2, atol=2e-2)
+
+
+def test_flash_attention_unsupported_shape_raises():
+    q = jnp.zeros((1, 7, 2, 8), jnp.float32)  # S=7: no block divides it
+    with pytest.raises(ValueError):
+        flash_attention_fwd(q, q, q, True, None, True)
+
+
+def test_rms_norm_fused():
+    rng = np.random.RandomState(3)
+    x = jnp.asarray(rng.randn(6, 5, 32).astype("float32"))
+    w = jnp.asarray(rng.rand(32).astype("float32") + 0.5)
+    out = rms_norm_fused(x, w, 1e-6, True)
+    xf = np.asarray(x)
+    ref = xf / np.sqrt((xf ** 2).mean(-1, keepdims=True) + 1e-6) \
+        * np.asarray(w)
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-5, atol=1e-5)
+
+    def f(x, w):
+        return jnp.sum(rms_norm_fused(x, w, 1e-6, True) ** 2)
+
+    def fr(x, w):
+        xf = x.astype(jnp.float32)
+        ms = jnp.mean(xf * xf, -1, keepdims=True)
+        return jnp.sum((xf * jax.lax.rsqrt(ms + 1e-6) * w) ** 2)
+
+    gx, gw = jax.grad(f, argnums=(0, 1))(x, w)
+    rx, rw = jax.grad(fr, argnums=(0, 1))(x, w)
+    np.testing.assert_allclose(np.asarray(gx), np.asarray(rx), rtol=1e-4,
+                               atol=1e-5)
+    np.testing.assert_allclose(np.asarray(gw), np.asarray(rw), rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_incubate_fused_functional():
+    """Reference-name fused surface: rms_norm/rope/bias_act/swiglu."""
+    import paddle_tpu.incubate.nn.functional as FF
+
+    rng = np.random.RandomState(4)
+    x = paddle.to_tensor(rng.randn(2, 6, 16).astype("float32"))
+    w = paddle.to_tensor(rng.rand(16).astype("float32"))
+    res = paddle.to_tensor(rng.randn(2, 6, 16).astype("float32"))
+
+    out = FF.fused_rms_norm(x, w)
+    assert out.shape == [2, 6, 16]
+    out, res_out = FF.fused_rms_norm(x, w, residual=res)
+    np.testing.assert_allclose(np.asarray(res_out._value),
+                               np.asarray((x + res)._value), rtol=1e-6)
+
+    ln_b = paddle.to_tensor(np.zeros(16, "float32"))
+    out2 = FF.fused_layer_norm(x, w, ln_b)
+    assert out2.shape == [2, 6, 16]
+
+    B, S, H, D = 2, 8, 2, 8
+    q = paddle.to_tensor(rng.randn(B, S, H, D).astype("float32"))
+    k = paddle.to_tensor(rng.randn(B, S, H, D).astype("float32"))
+    inv = 1.0 / 10000 ** (np.arange(0, D, 2) / D)
+    t = np.arange(S)[:, None] * inv[None, :]
+    cos = paddle.to_tensor(np.cos(np.concatenate([t, t], -1))
+                           .astype("float32"))
+    sin = paddle.to_tensor(np.sin(np.concatenate([t, t], -1))
+                           .astype("float32"))
+    qr, kr, _ = FF.fused_rotary_position_embedding(q, k, sin=sin, cos=cos)
+    assert qr.shape == [B, S, H, D] and kr.shape == [B, S, H, D]
+    # rope preserves norms
+    np.testing.assert_allclose(
+        np.linalg.norm(np.asarray(qr._value), axis=-1),
+        np.linalg.norm(np.asarray(q._value), axis=-1), rtol=1e-4)
+
+    y = FF.fused_bias_act(x, bias=paddle.to_tensor(
+        np.zeros(16, "float32")), act_method="gelu")
+    assert y.shape == [2, 6, 16]
+
+    sw = FF.swiglu(x)
+    assert sw.shape == [2, 6, 8]
