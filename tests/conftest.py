@@ -18,3 +18,18 @@ os.environ.setdefault("JAX_ENABLE_X64", "0")
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _reset_fleet_per_module():
+    """Isolate test modules from each other's fleet topology: a module
+    that never calls fleet.init must see single-device behavior even if
+    a previously-run module initialized a hybrid mesh (the reference gets
+    this isolation for free from per-test subprocesses)."""
+    from paddle_tpu.distributed import fleet as _fleet
+
+    _fleet._fleet_state.update(initialized=False, hcg=None, strategy=None)
+    yield
