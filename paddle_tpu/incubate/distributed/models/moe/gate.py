@@ -69,16 +69,25 @@ class SwitchGate(BaseGate):
     def __init__(self, d_model, num_experts, topk: int = 1,
                  capacity: float = 1.25, **kw):
         super().__init__(d_model, num_experts)
+        if topk != 1:
+            raise ValueError("SwitchGate is top-1 by definition; use "
+                             "GShardGate or NaiveGate for top-k routing")
         self.top_k = 1
         self.capacity_factor = capacity
 
 
 class GShardGate(BaseGate):
-    """GShard top-2 gate with capacity and load-balance loss
-    (reference gshard_gate.py — topk=2, capacity=(1.2, 2.4))."""
+    """GShard top-k gate with capacity and load-balance loss
+    (reference gshard_gate.py — topk=2, capacity=(1.2, 2.4)).
+    ``random_routing`` (probability-proportional 2nd-expert drop) is not
+    implemented — routing is deterministic top-k."""
 
     def __init__(self, d_model, num_experts, topk: int = 2,
-                 capacity: float = 2.0, random_routing: bool = True, **kw):
+                 capacity: float = 2.0, random_routing: bool = False, **kw):
         super().__init__(d_model, num_experts)
-        self.top_k = 2
+        if random_routing:
+            raise NotImplementedError(
+                "GShardGate random_routing is not implemented; pass "
+                "random_routing=False for deterministic top-k")
+        self.top_k = topk
         self.capacity_factor = capacity

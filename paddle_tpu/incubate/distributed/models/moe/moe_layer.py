@@ -229,13 +229,17 @@ class MoELayer(Layer):
             return _moe_forward(*vals, axes=axes, k=self.gate.top_k,
                                 cap=cap, act_fn=self._act)
 
-        (y2d, aux), vjp_fn = jax.vjp(pure, *ins)
-        y = Tensor(y2d.reshape(shape), stop_gradient=True)
-        aux_t = Tensor(aux, stop_gradient=True)
         in_tensors = [x, self.gate.weight, self.w1, self.b1, self.w2,
                       self.b2]
-        if _engine.is_grad_enabled() and any(
-                not t.stop_gradient for t in in_tensors):
+        need_grad = _engine.is_grad_enabled() and any(
+            not t.stop_gradient for t in in_tensors)
+        if need_grad:
+            (y2d, aux), vjp_fn = jax.vjp(pure, *ins)
+        else:  # inference: skip the linearization + residuals entirely
+            y2d, aux = pure(*ins)
+        y = Tensor(y2d.reshape(shape), stop_gradient=True)
+        aux_t = Tensor(aux, stop_gradient=True)
+        if need_grad:
             y.stop_gradient = aux_t.stop_gradient = False
 
             def bwd(gy, gaux):

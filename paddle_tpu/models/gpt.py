@@ -105,6 +105,30 @@ def _sep_axes():
     return hcg.get_sep_parallel_group().axis_names
 
 
+def _masked_mean_over_splits(num, den):
+    """Globally-correct masked mean when the batch/sequence is split over
+    dp/sharding/sep: per-rank valid-token counts differ, so divide the
+    LOCAL numerator by the GLOBAL denominator and pre-scale by the rank
+    count — the engine's equal-weight pmean then yields
+    sum(num)/sum(den) with correct per-token gradients."""
+    from jax import lax as _lax
+
+    from ..distributed import collective as C
+    from ..tensor import Tensor as _T
+
+    mesh = C.get_world_mesh() if C.in_spmd_region() else None
+    if mesh is not None:
+        axes = tuple(a for a in ("dp", "sharding", "sep")
+                     if a in mesh.axis_names and mesh.shape[a] > 1)
+        if axes:
+            R = 1
+            for a in axes:
+                R *= mesh.shape[a]
+            den = _T(_lax.psum(den._value, axes), stop_gradient=True)
+            num = num * float(R)
+    return num / ops.clip(den, min=1.0)
+
+
 def _sep_shard(value, axis: int):
     """This sep rank's contiguous block of ``axis`` (+ global offset)."""
     import jax.numpy as jnp
@@ -156,6 +180,11 @@ class GPTAttention(Layer):
         elif _sep_axes() is not None:
             # context parallelism: seq is sep-sharded; exact ring attention
             new_cache = None
+            from ..core.enforce import enforce as _enf
+
+            _enf(not (self.training and self.config.attention_dropout > 0),
+                 "attention_dropout is not supported with context "
+                 "parallelism (ring attention) yet; set it to 0")
             from ..ops.ring_attention import ring_flash_attention
 
             out = ring_flash_attention(q, k, v, axes=_sep_axes(),
@@ -368,7 +397,9 @@ class GPTPretrainingCriterion(Layer):
         loss = ops.squeeze(loss, axis=-1)
         if loss_mask is not None:
             m = ops.cast(loss_mask, str(loss.dtype))
-            return ops.sum(loss * m) / ops.clip(ops.sum(m), min=1.0)
+            num = ops.sum(loss * m)
+            den = ops.sum(m)
+            return _masked_mean_over_splits(num, den)
         return ops.mean(loss)
 
 

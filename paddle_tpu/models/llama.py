@@ -96,9 +96,7 @@ def _rope_tables(cfg: LlamaConfig, dtype=jnp.float32):
     return (jnp.asarray(np.cos(emb), dtype), jnp.asarray(np.sin(emb), dtype))
 
 
-def _rot_half(x):
-    x1, x2 = jnp.split(x, 2, axis=-1)
-    return jnp.concatenate([-x2, x1], axis=-1)
+from ..ops.nn_ops import rotate_half as _rot_half  # noqa: E402
 
 
 def _apply_rope(x, cos, sin, offset):
@@ -355,6 +353,14 @@ class LlamaForCausalLM(Layer):
         B, S0 = ids.shape
         M = max_length or min(self.config.max_position_embeddings,
                               S0 + max_new_tokens)
+        from ..core.enforce import enforce
+
+        enforce(S0 + max_new_tokens <= M,
+                f"prompt ({S0}) + max_new_tokens ({max_new_tokens}) "
+                f"exceeds the cache length {M} "
+                f"(max_position_embeddings="
+                f"{self.config.max_position_embeddings}); writes past the "
+                "cache would silently clamp")
         p_dtype = self.parameters()[0]._value.dtype
         caches = self._empty_caches(B, M, p_dtype)
         pvals = tuple(p._value for p in self.parameters())
@@ -397,8 +403,10 @@ class LlamaPretrainingCriterion(Layer):
         loss = parallel_cross_entropy(logits, labels, self._mp_group)
         loss = ops.squeeze(loss, axis=-1)
         if loss_mask is not None:
+            from .gpt import _masked_mean_over_splits
+
             m = ops.cast(loss_mask, str(loss.dtype))
-            return ops.sum(loss * m) / ops.clip(ops.sum(m), min=1.0)
+            return _masked_mean_over_splits(ops.sum(loss * m), ops.sum(m))
         return ops.mean(loss)
 
 

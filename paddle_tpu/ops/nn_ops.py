@@ -704,15 +704,18 @@ def scaled_dot_product_attention(q, k, v, attn_mask=None, dropout_p=0.0,
     return jnp.swapaxes(out, 1, 2).astype(q.dtype)
 
 
+def rotate_half(x):
+    """[-x2, x1] pairing used by neox-style rotary embeddings."""
+    x1, x2 = jnp.split(x, 2, axis=-1)
+    return jnp.concatenate([-x2, x1], axis=-1)
+
+
 @def_op("fused_rope")
 def fused_rope(q, k, cos, sin, position_ids=None):
     """Rotary embedding applied to q,k [B,S,H,D] (reference:
     phi/kernels/fusion/gpu/fused_rope_kernel.cu; spmd_rules/fused_rope.cc).
     cos/sin: [S, D] or [1, S, 1, D]."""
-
-    def rot(x):
-        x1, x2 = jnp.split(x, 2, axis=-1)
-        return jnp.concatenate([-x2, x1], axis=-1)
+    rot = rotate_half
 
     c = cos.reshape(1, cos.shape[-2], 1, cos.shape[-1]) if cos.ndim == 2 else cos
     s = sin.reshape(1, sin.shape[-2], 1, sin.shape[-1]) if sin.ndim == 2 else sin

@@ -6,3 +6,26 @@ fused_multi_transformer_op.cu.h — here each is a Mosaic kernel tiled for
 MXU/VMEM; on non-TPU backends the callers fall back to plain XLA, and
 tests run the kernels in interpret mode.)
 """
+from __future__ import annotations
+
+import jax
+
+__all__ = ["is_tpu_platform", "pick_block"]
+
+
+def is_tpu_platform() -> bool:
+    """True on real TPU backends (incl. the 'axon' tunnel platform) —
+    kernels compile via Mosaic; elsewhere they run in interpret mode."""
+    try:
+        p = str(jax.devices()[0].platform).lower()
+        return "tpu" in p or "axon" in p
+    except Exception:
+        return False
+
+
+def pick_block(n: int, prefer=(128, 256, 512, 64, 32, 16, 8)) -> int:
+    """Largest MXU/VPU-aligned block size that divides ``n`` (0 = none)."""
+    for b in prefer:
+        if b <= n and n % b == 0:
+            return b
+    return 0

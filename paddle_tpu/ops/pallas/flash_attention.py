@@ -34,16 +34,11 @@ except Exception:  # pragma: no cover
     pltpu = None
     _VMEM = None
 
+from . import is_tpu_platform, pick_block as _pick_block
+
 __all__ = ["flash_attention_fwd"]
 
 _NEG = -1e30
-
-
-def _pick_block(S: int, target: int = 128) -> int:
-    for b in (target, 256, 512, 64, 32, 16, 8):
-        if b <= S and S % b == 0:
-            return b
-    return 0
 
 
 def _kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal, block_q,
@@ -116,10 +111,7 @@ def _supported(q, k) -> bool:
 
 
 def _interpret_default() -> bool:
-    try:
-        return "tpu" not in str(jax.devices()[0].platform).lower()
-    except Exception:
-        return True
+    return not is_tpu_platform()
 
 
 @partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))

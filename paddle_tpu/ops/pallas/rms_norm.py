@@ -25,6 +25,8 @@ except Exception:  # pragma: no cover
     pltpu = None
     _VMEM = None
 
+from . import is_tpu_platform, pick_block
+
 __all__ = ["rms_norm_fused"]
 
 
@@ -36,10 +38,7 @@ def _kernel(x_ref, w_ref, o_ref, *, eps):
 
 
 def _pick_block(T: int) -> int:
-    for b in (256, 128, 512, 64, 32, 16, 8, 4, 2, 1):
-        if b <= T and T % b == 0:
-            return b
-    return 1
+    return pick_block(T, prefer=(256, 128, 512, 64, 32, 16, 8, 4, 2, 1))
 
 
 def _rms_ref(x2, w, eps):
@@ -50,10 +49,7 @@ def _rms_ref(x2, w, eps):
 
 
 def _interpret_default() -> bool:
-    try:
-        return "tpu" not in str(jax.devices()[0].platform).lower()
-    except Exception:
-        return True
+    return not is_tpu_platform()
 
 
 @partial(jax.custom_vjp, nondiff_argnums=(2, 3))

@@ -116,3 +116,42 @@ def test_gpt_context_parallel_parity():
         loss = step({"x": paddle.to_tensor(ids), "y": paddle.to_tensor(ids)})
         np.testing.assert_allclose(float(loss), g_losses[i], rtol=2e-4,
                                    atol=1e-6, err_msg=f"step {i}")
+
+
+def test_masked_loss_unbalanced_split_parity():
+    """Masked LM loss with wildly unbalanced mask across dp ranks must
+    equal the single-device masked mean (global num/den, not
+    mean-of-local-means)."""
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 8, "mp_degree": 1,
+                               "pp_degree": 1}
+    hcg = fleet.init(is_collective=True, strategy=strategy)
+
+    from paddle_tpu.models import (GPTForCausalLM, GPTPretrainingCriterion,
+                                   gpt_tiny)
+
+    cfg = gpt_tiny()
+    paddle.seed(31)
+    model = GPTForCausalLM(cfg)
+    golden = GPTForCausalLM(cfg)
+    golden.set_state_dict(model.state_dict())
+    crit = GPTPretrainingCriterion(cfg)
+
+    rng = np.random.RandomState(5)
+    ids = rng.randint(0, cfg.vocab_size, (8, 16))
+    mask = np.zeros((8, 16), dtype="float32")
+    mask[0, :] = 1.0          # almost all valid tokens on rank 0
+    mask[1:, 0] = 1.0         # one valid token on each other rank
+
+    g_loss = crit(golden(paddle.to_tensor(ids)), paddle.to_tensor(ids),
+                  paddle.to_tensor(mask))
+
+    opt = paddle.optimizer.SGD(learning_rate=0.0,
+                               parameters=model.parameters())
+    eng = ParallelEngine(model, opt, hcg.mesh)
+    step = eng.train_step(
+        lambda m, b: crit(m(b["x"]), b["y"], b["mask"]))
+    loss = step({"x": paddle.to_tensor(ids), "y": paddle.to_tensor(ids),
+                 "mask": paddle.to_tensor(mask)})
+    np.testing.assert_allclose(float(loss), float(g_loss), rtol=1e-4,
+                               atol=1e-6)
