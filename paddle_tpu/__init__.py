@@ -33,6 +33,8 @@ from . import incubate  # noqa: F401
 from .framework.io import load, save
 from . import metric  # noqa: F401
 from . import distributed  # noqa: F401
+from . import hapi  # noqa: F401
+from .hapi import Model  # noqa: F401
 
 # paddle-API aliases
 bool = bool_  # noqa: A001

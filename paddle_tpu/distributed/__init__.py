@@ -11,6 +11,7 @@ from .collective import (  # noqa: F401
     get_rank, get_world_size, in_spmd_region, init_parallel_env, irecv,
     isend, new_group, recv, reduce, reduce_scatter, scatter, send,
     spmd_region, ReduceOp, Group, ProcessGroup, split_group)
+from . import checkpoint  # noqa: F401
 from . import fleet  # noqa: F401
 from . import sharding  # noqa: F401
 from . import utils  # noqa: F401

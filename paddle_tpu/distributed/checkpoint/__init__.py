@@ -1,0 +1,13 @@
+"""Sharded distributed checkpoint (paddle.distributed.checkpoint analog).
+
+(reference: python/paddle/distributed/checkpoint/save_state_dict.py:104 —
+per-rank shard files + global metadata after cross-rank dedup;
+load_state_dict.py reshards on load; metadata.py LocalTensorMetadata /
+LocalTensorIndex.)
+"""
+from .metadata import LocalTensorIndex, LocalTensorMetadata, Metadata  # noqa: F401
+from .save_state_dict import save_state_dict  # noqa: F401
+from .load_state_dict import load_state_dict  # noqa: F401
+
+__all__ = ["save_state_dict", "load_state_dict", "Metadata",
+           "LocalTensorMetadata", "LocalTensorIndex"]

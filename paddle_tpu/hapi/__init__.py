@@ -1,0 +1,6 @@
+from .callbacks import (Callback, EarlyStopping, LRSchedulerCallback,
+                        ModelCheckpoint, ProgBarLogger)  # noqa: F401
+from .model import Model  # noqa: F401
+
+__all__ = ["Model", "Callback", "ProgBarLogger", "ModelCheckpoint",
+           "EarlyStopping", "LRSchedulerCallback"]

@@ -1,0 +1,150 @@
+"""hapi callbacks (reference: python/paddle/hapi/callbacks.py —
+Callback/ProgBarLogger/ModelCheckpoint/EarlyStopping/LRScheduler)."""
+from __future__ import annotations
+
+import os
+import sys
+import time
+from typing import Optional
+
+__all__ = ["Callback", "ProgBarLogger", "ModelCheckpoint", "EarlyStopping",
+           "LRSchedulerCallback", "config_callbacks"]
+
+
+class Callback:
+    def set_model(self, model):
+        self.model = model
+
+    def set_params(self, params):
+        self.params = params
+
+    def on_train_begin(self, logs=None): ...
+    def on_train_end(self, logs=None): ...
+    def on_epoch_begin(self, epoch, logs=None): ...
+    def on_epoch_end(self, epoch, logs=None): ...
+    def on_train_batch_begin(self, step, logs=None): ...
+    def on_train_batch_end(self, step, logs=None): ...
+    def on_eval_begin(self, logs=None): ...
+    def on_eval_end(self, logs=None): ...
+    def on_eval_batch_begin(self, step, logs=None): ...
+    def on_eval_batch_end(self, step, logs=None): ...
+
+
+class CallbackList:
+    def __init__(self, callbacks, model, params):
+        self.callbacks = list(callbacks)
+        for c in self.callbacks:
+            c.set_model(model)
+            c.set_params(params)
+
+    def call(self, name, *args):
+        for c in self.callbacks:
+            getattr(c, name)(*args)
+
+
+class ProgBarLogger(Callback):
+    """Per-epoch progress logging (reference callbacks.py ProgBarLogger)."""
+
+    def __init__(self, log_freq: int = 10, verbose: int = 1):
+        self.log_freq = log_freq
+        self.verbose = verbose
+
+    def on_epoch_begin(self, epoch, logs=None):
+        self._epoch = epoch
+        self._t0 = time.time()
+
+    def on_train_batch_end(self, step, logs=None):
+        if self.verbose and step % self.log_freq == 0:
+            msg = " - ".join(f"{k}: {v:.4f}" for k, v in
+                             (logs or {}).items()
+                             if isinstance(v, (int, float)))
+            print(f"epoch {self._epoch} step {step}: {msg}",
+                  file=sys.stderr)
+
+    def on_epoch_end(self, epoch, logs=None):
+        if self.verbose:
+            msg = " - ".join(f"{k}: {v:.4f}" for k, v in
+                             (logs or {}).items()
+                             if isinstance(v, (int, float)))
+            print(f"epoch {epoch} done in {time.time() - self._t0:.1f}s "
+                  f"{msg}", file=sys.stderr)
+
+
+class ModelCheckpoint(Callback):
+    """Save every N epochs (reference callbacks.py ModelCheckpoint)."""
+
+    def __init__(self, save_freq: int = 1, save_dir: Optional[str] = None):
+        self.save_freq = save_freq
+        self.save_dir = save_dir
+
+    def on_epoch_end(self, epoch, logs=None):
+        if self.save_dir and epoch % self.save_freq == 0:
+            self.model.save(os.path.join(self.save_dir, str(epoch)))
+
+    def on_train_end(self, logs=None):
+        if self.save_dir:
+            self.model.save(os.path.join(self.save_dir, "final"))
+
+
+class EarlyStopping(Callback):
+    """(reference callbacks.py EarlyStopping)."""
+
+    def __init__(self, monitor: str = "loss", mode: str = "min",
+                 patience: int = 0, min_delta: float = 0.0,
+                 baseline=None, save_best_model: bool = False):
+        self.monitor = monitor
+        self.patience = patience
+        self.min_delta = abs(min_delta)
+        self.wait = 0
+        self.best = baseline
+        self.stopped_epoch = 0
+        sign = -1 if mode == "max" else 1
+        self._sign = sign
+        self.stop_training = False
+
+    def on_epoch_end(self, epoch, logs=None):
+        cur = (logs or {}).get(self.monitor)
+        if cur is None:
+            return
+        score = self._sign * float(cur)
+        if self.best is None or score < self._sign * self.best - \
+                self.min_delta:
+            self.best = float(cur)
+            self.wait = 0
+        else:
+            self.wait += 1
+            if self.wait > self.patience:
+                self.stop_training = True
+                self.stopped_epoch = epoch
+
+
+class LRSchedulerCallback(Callback):
+    """Steps an LRScheduler each epoch/step (reference LRScheduler cb)."""
+
+    def __init__(self, by_step: bool = False, by_epoch: bool = True):
+        self.by_step = by_step
+        self.by_epoch = by_epoch
+
+    def _sched(self):
+        opt = getattr(self.model, "_optimizer", None)
+        from ..optimizer.lr import LRScheduler
+
+        lr = getattr(opt, "_lr", None)
+        return lr if isinstance(lr, LRScheduler) else None
+
+    def on_train_batch_end(self, step, logs=None):
+        s = self._sched()
+        if self.by_step and s is not None:
+            s.step()
+
+    def on_epoch_end(self, epoch, logs=None):
+        s = self._sched()
+        if self.by_epoch and s is not None:
+            s.step()
+
+
+def config_callbacks(callbacks, model, params, verbose=1):
+    cbs = list(callbacks or [])
+    if not any(isinstance(c, ProgBarLogger) for c in cbs):
+        cbs.insert(0, ProgBarLogger(verbose=verbose))
+    return CallbackList(cbs, model, params)

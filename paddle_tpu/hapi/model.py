@@ -1,0 +1,241 @@
+"""hapi Model: prepare/fit/evaluate/predict/save/load.
+
+(reference: python/paddle/hapi/model.py — Model.fit:1052, evaluate:1674,
+predict:1754; dynamic/static adapters. Here there is one adapter: the
+eager path runs op-by-op, and when a fleet hybrid mesh is active the
+whole train step is compiled through the ParallelEngine instead — the
+TPU-native replacement for the reference's DistributedModel wrapping.)
+"""
+from __future__ import annotations
+
+import os
+from typing import List, Optional
+
+import numpy as np
+
+from ..io import DataLoader
+from ..metric import Metric
+from ..tensor import Tensor, to_tensor
+from .callbacks import config_callbacks
+
+__all__ = ["Model"]
+
+
+def _as_list(x):
+    if x is None:
+        return []
+    return list(x) if isinstance(x, (list, tuple)) else [x]
+
+
+class Model:
+    """High-level training/eval/predict wrapper around a Layer."""
+
+    def __init__(self, network, inputs=None, labels=None):
+        self.network = network
+        self._optimizer = None
+        self._loss = None
+        self._metrics: List[Metric] = []
+        self._engine = None
+        self._engine_step = None
+        self.stop_training = False
+
+    # -- setup ----------------------------------------------------------
+    def prepare(self, optimizer=None, loss=None, metrics=None,
+                amp_configs=None):
+        self._optimizer = optimizer
+        self._loss = loss
+        self._metrics = _as_list(metrics)
+        from ..distributed import fleet
+
+        hcg = fleet.get_hybrid_communicate_group()
+        if hcg is not None and optimizer is not None and \
+                hcg.mesh.devices.size > 1:
+            from ..distributed.engine import ParallelEngine
+
+            self._engine = ParallelEngine(self.network, optimizer,
+                                          hcg.mesh)
+        return self
+
+    # -- internals ------------------------------------------------------
+    def _compute_loss(self, outputs, labels):
+        loss = self._loss(outputs, *_as_list(labels)) \
+            if not isinstance(self._loss, type(None)) else outputs
+        if isinstance(loss, (list, tuple)):
+            loss = loss[0]
+        return loss
+
+    def train_batch(self, inputs, labels=None, update=True):
+        inputs = _as_list(inputs)
+        labels = _as_list(labels)
+        if self._engine is not None:
+            if self._engine_step is None:
+                n_in = len(inputs)
+
+                def fn(model, batch):
+                    outs = model(*batch["inputs"])
+                    return self._compute_loss(outs, batch["labels"])
+
+                self._engine_step = self._engine.train_step(fn)
+            batch = {"inputs": [to_tensor(np.asarray(i)) for i in inputs],
+                     "labels": [to_tensor(np.asarray(l)) for l in labels]}
+            loss = self._engine_step(batch)
+            return [float(loss)]
+        self.network.train()
+        outs = self.network(*[to_tensor(np.asarray(i)) for i in inputs])
+        loss = self._compute_loss(outs,
+                                  [to_tensor(np.asarray(l))
+                                   for l in labels])
+        loss.backward()
+        if update:
+            self._optimizer.step()
+            self._optimizer.clear_grad()
+        return [float(loss)]
+
+    def eval_batch(self, inputs, labels=None):
+        from ..autograd import no_grad
+
+        self.network.eval()
+        with no_grad():
+            outs = self.network(*[to_tensor(np.asarray(i))
+                                  for i in _as_list(inputs)])
+            lbls = [to_tensor(np.asarray(l)) for l in _as_list(labels)]
+            loss = self._compute_loss(outs, lbls) if self._loss else None
+            for m in self._metrics:
+                if hasattr(m, "compute"):
+                    m.update(m.compute(outs, *lbls))
+                else:
+                    m.update(outs, *lbls)
+        return [float(loss)] if loss is not None else []
+
+    def predict_batch(self, inputs):
+        from ..autograd import no_grad
+
+        self.network.eval()
+        with no_grad():
+            outs = self.network(*[to_tensor(np.asarray(i))
+                                  for i in _as_list(inputs)])
+        return [o.numpy() if isinstance(o, Tensor) else o
+                for o in _as_list(outs)]
+
+    @staticmethod
+    def _loader(data, batch_size, shuffle):
+        if data is None or isinstance(data, DataLoader):
+            return data
+        return DataLoader(data, batch_size=batch_size, shuffle=shuffle)
+
+    @staticmethod
+    def _split_batch(batch):
+        batch = list(batch) if isinstance(batch, (list, tuple)) else [batch]
+        if len(batch) >= 2:
+            return batch[:-1], batch[-1:]
+        return batch, []
+
+    # -- public API -----------------------------------------------------
+    def fit(self, train_data=None, eval_data=None, batch_size=1,
+            epochs=1, eval_freq=1, log_freq=10, save_dir=None,
+            save_freq=1, verbose=1, drop_last=False, shuffle=True,
+            num_workers=0, callbacks=None, **kw):
+        loader = self._loader(train_data, batch_size, shuffle)
+        cbks = config_callbacks(callbacks, self,
+                                {"epochs": epochs, "verbose": verbose},
+                                verbose)
+        if save_dir:
+            from .callbacks import ModelCheckpoint
+
+            cbks.callbacks.append(ModelCheckpoint(save_freq, save_dir))
+            cbks.callbacks[-1].set_model(self)
+        cbks.call("on_train_begin")
+        history = []
+        for epoch in range(epochs):
+            cbks.call("on_epoch_begin", epoch)
+            losses = []
+            for step, batch in enumerate(loader):
+                cbks.call("on_train_batch_begin", step)
+                ins, lbl = self._split_batch(batch)
+                loss = self.train_batch(ins, lbl)
+                losses.append(loss[0])
+                cbks.call("on_train_batch_end", step,
+                          {"loss": loss[0]})
+            logs = {"loss": float(np.mean(losses)) if losses else 0.0}
+            if eval_data is not None and (epoch + 1) % eval_freq == 0:
+                logs.update(self.evaluate(eval_data,
+                                          batch_size=batch_size,
+                                          verbose=0))
+            cbks.call("on_epoch_end", epoch, logs)
+            history.append(logs)
+            if any(getattr(c, "stop_training", False)
+                   for c in cbks.callbacks):
+                self.stop_training = True
+                break
+        cbks.call("on_train_end")
+        return history
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=1,
+                 num_workers=0, callbacks=None, **kw):
+        loader = self._loader(eval_data, batch_size, shuffle=False)
+        for m in self._metrics:
+            m.reset()
+        losses = []
+        for batch in loader:
+            ins, lbl = self._split_batch(batch)
+            out = self.eval_batch(ins, lbl)
+            if out:
+                losses.append(out[0])
+        logs = {}
+        if losses:
+            logs["eval_loss"] = float(np.mean(losses))
+        for m in self._metrics:
+            acc = m.accumulate()
+            name = m.name()
+            if isinstance(name, (list, tuple)):
+                for n, a in zip(name, _as_list(acc)):
+                    logs[f"eval_{n}"] = float(a)
+            else:
+                logs[f"eval_{name}"] = float(acc)
+        return logs
+
+    def predict(self, test_data, batch_size=1, num_workers=0,
+                stack_outputs=False, callbacks=None, verbose=1, **kw):
+        loader = self._loader(test_data, batch_size, shuffle=False)
+        outputs = []
+        for batch in loader:
+            # datasets that also yield labels: feed only the inputs
+            ins, _ = self._split_batch(batch)
+            outputs.append(self.predict_batch(ins))
+        if stack_outputs and outputs:
+            n_out = len(outputs[0])
+            return [np.concatenate([o[i] for o in outputs])
+                    for i in range(n_out)]
+        return outputs
+
+    # -- persistence ----------------------------------------------------
+    def save(self, path: str, training: bool = True):
+        from ..framework import io as _io
+
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        _io.save(self.network.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None:
+            _io.save(self._optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path: str, skip_mismatch: bool = False,
+             reset_optimizer: bool = False):
+        from ..framework import io as _io
+
+        self.network.set_state_dict(_io.load(path + ".pdparams"))
+        opt_path = path + ".pdopt"
+        if not reset_optimizer and self._optimizer is not None and \
+                os.path.exists(opt_path):
+            self._optimizer.set_state_dict(_io.load(opt_path))
+
+    def parameters(self, *a, **kw):
+        return self.network.parameters(*a, **kw)
+
+    def summary(self, input_size=None, dtype=None):
+        lines = [repr(self.network)]
+        n = sum(int(np.prod(p.shape)) for p in self.network.parameters())
+        lines.append(f"Total params: {n:,}")
+        s = "\n".join(lines)
+        print(s)
+        return {"total_params": n}

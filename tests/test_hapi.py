@@ -1,0 +1,84 @@
+"""hapi Model API (reference: python/paddle/hapi/model.py —
+fit/evaluate/predict/save/load + callbacks)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.hapi import EarlyStopping, ModelCheckpoint
+from paddle_tpu.io import Dataset
+
+
+class XorDataset(Dataset):
+    def __init__(self, n=64):
+        rng = np.random.RandomState(0)
+        self.x = rng.randn(n, 8).astype("float32")
+        w = rng.randn(8, 2).astype("float32")
+        self.y = (self.x @ w).argmax(-1).astype("int64")
+
+    def __getitem__(self, i):
+        return self.x[i], self.y[i]
+
+    def __len__(self):
+        return len(self.x)
+
+
+def _network():
+    return paddle.nn.Sequential(paddle.nn.Linear(8, 32), paddle.nn.ReLU(),
+                                paddle.nn.Linear(32, 2))
+
+
+def test_fit_evaluate_predict(tmp_path):
+    paddle.seed(0)
+    model = paddle.Model(_network())
+    model.prepare(
+        optimizer=paddle.optimizer.Adam(learning_rate=0.01,
+                                        parameters=model.parameters()),
+        loss=paddle.nn.CrossEntropyLoss(),
+        metrics=paddle.metric.Accuracy())
+
+    ds = XorDataset()
+    hist = model.fit(ds, epochs=4, batch_size=16, verbose=0)
+    assert hist[-1]["loss"] < hist[0]["loss"]
+
+    logs = model.evaluate(ds, batch_size=16, verbose=0)
+    assert logs["eval_acc"] > 0.8, logs
+
+    preds = model.predict(ds, batch_size=16, stack_outputs=True)
+    assert preds[0].shape == (64, 2)
+
+    # save / load roundtrip
+    p = str(tmp_path / "ck" / "m")
+    model.save(p)
+    model2 = paddle.Model(_network())
+    model2.prepare(
+        optimizer=paddle.optimizer.Adam(parameters=model2.parameters()),
+        loss=paddle.nn.CrossEntropyLoss())
+    model2.load(p)
+    for (n, a), (_, b) in zip(model.network.named_parameters(),
+                              model2.network.named_parameters()):
+        np.testing.assert_array_equal(np.asarray(a._value),
+                                      np.asarray(b._value), err_msg=n)
+
+
+def test_early_stopping_and_checkpoint(tmp_path):
+    paddle.seed(1)
+    model = paddle.Model(_network())
+    model.prepare(
+        optimizer=paddle.optimizer.Adam(learning_rate=0.0,
+                                        parameters=model.parameters()),
+        loss=paddle.nn.CrossEntropyLoss())
+    ds = XorDataset(32)
+    es = EarlyStopping(monitor="loss", patience=1)
+    hist = model.fit(ds, epochs=10, batch_size=16, verbose=0,
+                     callbacks=[es],
+                     save_dir=str(tmp_path / "ckpts"))
+    assert model.stop_training and len(hist) < 10
+    import os
+
+    assert os.path.exists(str(tmp_path / "ckpts" / "final.pdparams"))
+
+
+def test_summary():
+    model = paddle.Model(_network())
+    info = model.summary()
+    assert info["total_params"] == 8 * 32 + 32 + 32 * 2 + 2
