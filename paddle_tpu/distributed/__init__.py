@@ -11,8 +11,12 @@ from .collective import (  # noqa: F401
     get_rank, get_world_size, in_spmd_region, init_parallel_env, irecv,
     isend, new_group, recv, reduce, reduce_scatter, scatter, send,
     spmd_region, ReduceOp, Group, ProcessGroup, split_group)
+from . import auto_parallel  # noqa: F401
 from . import checkpoint  # noqa: F401
 from . import fleet  # noqa: F401
+from .auto_parallel import (Partial, ProcessMesh, Replicate, Shard,  # noqa: F401
+                            dtensor_from_fn, reshard, shard_layer,
+                            shard_tensor)
 from . import sharding  # noqa: F401
 from . import utils  # noqa: F401
 from .engine import ParallelEngine, bind_params, shard_module_params  # noqa: F401

@@ -1,0 +1,219 @@
+"""Semi-auto parallel (DistTensor) API.
+
+(reference: python/paddle/distributed/auto_parallel/api.py —
+shard_tensor:126, dtensor_from_fn:342, reshard:441, shard_layer; C++
+DistTensor phi/core/distributed/auto_parallel/dist_tensor.h with
+ProcessMesh/TensorDistAttr dist_attr.h; pairwise reshard functions
+phi/core/distributed/auto_parallel/reshard/*.cc.)
+
+TPU-native: a "DistTensor" IS a global ``jax.Array`` with a
+``NamedSharding`` — placements map 1:1 onto PartitionSpec entries, and
+the reference's whole pairwise reshard engine (r↔s, s↔r, p↔r, s↔s,
+nd-mesh) collapses into ``jax.device_put(x, new_sharding)``: XLA/IFRT
+computes the minimal resharding collectives. ``Partial`` placements are
+realized immediately (psum on placement) since jax.Arrays don't carry
+pending-reduction state.
+"""
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Sequence, Union
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from ...core.enforce import enforce
+from ...nn.layer import Layer
+from ...tensor import Tensor
+
+__all__ = ["ProcessMesh", "Placement", "Shard", "Replicate", "Partial",
+           "shard_tensor", "dtensor_from_fn", "reshard", "shard_layer"]
+
+
+class Placement:
+    def is_shard(self, dim=None):
+        return False
+
+    def is_replicate(self):
+        return False
+
+    def is_partial(self):
+        return False
+
+
+class Shard(Placement):
+    """Shard the tensor's ``dim`` over the corresponding mesh dim
+    (reference dist_attr Shard placement)."""
+
+    def __init__(self, dim: int):
+        self.dim = dim
+
+    def is_shard(self, dim=None):
+        return dim is None or dim == self.dim
+
+    def __repr__(self):
+        return f"Shard(dim={self.dim})"
+
+    def __eq__(self, o):
+        return isinstance(o, Shard) and o.dim == self.dim
+
+    def __hash__(self):
+        return hash(("shard", self.dim))
+
+
+class Replicate(Placement):
+    def is_replicate(self):
+        return True
+
+    def __repr__(self):
+        return "Replicate()"
+
+    def __eq__(self, o):
+        return isinstance(o, Replicate)
+
+    def __hash__(self):
+        return hash("replicate")
+
+
+class Partial(Placement):
+    """Pending-reduction placement. jax.Arrays carry no partial state, so
+    applying it sums the operand over the mesh dim on placement."""
+
+    def __init__(self, reduce_type: str = "sum"):
+        self.reduce_type = reduce_type
+
+    def is_partial(self):
+        return True
+
+    def __repr__(self):
+        return f"Partial({self.reduce_type})"
+
+
+class ProcessMesh:
+    """N-d logical process mesh (reference: ProcessMesh in
+    distributed/auto_parallel/process_mesh.py; C++ process_mesh.h)."""
+
+    def __init__(self, mesh, dim_names: Optional[List[str]] = None,
+                 shape=None, process_ids=None):
+        arr = np.asarray(mesh)
+        if dim_names is None:
+            dim_names = [f"d{i}" for i in range(arr.ndim)]
+        enforce(arr.ndim == len(dim_names),
+                f"mesh ndim {arr.ndim} != len(dim_names) {len(dim_names)}")
+        self._ids = arr
+        self._dim_names = list(dim_names)
+        devs = jax.devices()
+        enforce(int(arr.max()) < len(devs),
+                f"mesh references device {int(arr.max())} but only "
+                f"{len(devs)} devices are visible")
+        dev_arr = np.empty(arr.shape, dtype=object)
+        for idx in np.ndindex(arr.shape):
+            dev_arr[idx] = devs[int(arr[idx])]
+        self.jax_mesh = Mesh(dev_arr, tuple(self._dim_names))
+
+    @property
+    def shape(self):
+        return list(self._ids.shape)
+
+    @property
+    def ndim(self):
+        return self._ids.ndim
+
+    @property
+    def dim_names(self):
+        return list(self._dim_names)
+
+    @property
+    def process_ids(self):
+        return [int(i) for i in self._ids.flatten()]
+
+    def get_dim_size(self, name: str) -> int:
+        return self._ids.shape[self._dim_names.index(name)]
+
+    def __repr__(self):
+        return (f"ProcessMesh(shape={self.shape}, "
+                f"dim_names={self._dim_names})")
+
+
+def _placements_to_spec(mesh: ProcessMesh, placements) -> P:
+    """placements[i] describes mesh dim i → PartitionSpec over tensor dims."""
+    ndim_t = max([p.dim for p in placements if isinstance(p, Shard)],
+                 default=-1) + 1
+    parts: List = [None] * ndim_t
+    for mesh_dim, pl in enumerate(placements):
+        if isinstance(pl, Shard):
+            name = mesh.dim_names[mesh_dim]
+            cur = parts[pl.dim]
+            if cur is None:
+                parts[pl.dim] = name
+            elif isinstance(cur, tuple):
+                parts[pl.dim] = cur + (name,)
+            else:
+                parts[pl.dim] = (cur, name)
+    return P(*parts)
+
+
+def shard_tensor(data, mesh: ProcessMesh, placements,
+                 dtype=None, place=None, stop_gradient=None) -> Tensor:
+    """Create a distributed tensor placed per ``placements``
+    (reference api.py:126). The result is a normal Tensor whose backing
+    jax.Array is globally sharded; ``dist_attr`` records the spec."""
+    t = data if isinstance(data, Tensor) else Tensor(
+        jax.numpy.asarray(data))
+    placements = list(placements)
+    enforce(len(placements) == mesh.ndim,
+            f"need one placement per mesh dim ({mesh.ndim}), got "
+            f"{len(placements)}")
+    enforce(not any(p.is_partial() for p in placements),
+            "Partial placement is only produced by computations; "
+            "shard_tensor accepts Shard/Replicate")
+    spec = _placements_to_spec(mesh, placements)
+    sharding = NamedSharding(mesh.jax_mesh, spec)
+    val = jax.device_put(t._value, sharding)
+    out = Tensor(val, stop_gradient=t.stop_gradient
+                 if stop_gradient is None else stop_gradient)
+    out.dist_attr = spec
+    out.process_mesh = mesh
+    out.placements = placements
+    return out
+
+
+def dtensor_from_fn(fn: Callable, mesh: ProcessMesh, placements,
+                    *args, **kwargs) -> Tensor:
+    """Build then shard (reference api.py:342 — e.g.
+    dtensor_from_fn(paddle.ones, mesh, [Shard(0)], shape))."""
+    return shard_tensor(fn(*args, **kwargs), mesh, placements)
+
+
+def reshard(x: Tensor, mesh: ProcessMesh, placements) -> Tensor:
+    """Move a dist tensor to a new mesh/placement layout
+    (reference api.py:441; C++ reshard/*_reshard_function.cc). XLA/IFRT
+    emits the minimal collective for the transition."""
+    return shard_tensor(x, mesh, placements)
+
+
+def shard_layer(layer: Layer, process_mesh: ProcessMesh,
+                shard_fn: Optional[Callable] = None,
+                input_fn: Optional[Callable] = None,
+                output_fn: Optional[Callable] = None) -> Layer:
+    """Shard a layer's parameters across a mesh (reference api.py
+    shard_layer). ``shard_fn(name, layer, mesh)`` customizes per-layer
+    placement; default replicates every parameter on the mesh."""
+    if shard_fn is None:
+        def shard_fn(name, sublayer, mesh):
+            for p in sublayer.parameters(include_sublayers=False):
+                v = shard_tensor(p, mesh,
+                                 [Replicate()] * mesh.ndim)
+                p._value = v._value
+                p.dist_attr = v.dist_attr
+
+    for name, sub in layer.named_sublayers(include_self=True):
+        shard_fn(name, sub, process_mesh)
+    if input_fn is not None:
+        layer.register_forward_pre_hook(
+            lambda l, inputs: input_fn(inputs, process_mesh))
+    if output_fn is not None:
+        layer.register_forward_post_hook(
+            lambda l, inputs, outputs: output_fn(outputs, process_mesh))
+    return layer

@@ -1,0 +1,89 @@
+"""Semi-auto parallel (DistTensor) API over the 8-device CPU mesh
+(reference: test/auto_parallel/ shard_tensor/reshard API tests)."""
+import numpy as np
+import pytest
+
+import jax
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+
+
+def test_process_mesh():
+    mesh = dist.ProcessMesh([[0, 1, 2, 3], [4, 5, 6, 7]],
+                            dim_names=["x", "y"])
+    assert mesh.shape == [2, 4]
+    assert mesh.get_dim_size("y") == 4
+    assert mesh.process_ids == list(range(8))
+
+
+def test_shard_tensor_placement():
+    mesh = dist.ProcessMesh([[0, 1, 2, 3], [4, 5, 6, 7]],
+                            dim_names=["x", "y"])
+    x = np.arange(8 * 16, dtype="float32").reshape(8, 16)
+    dt = dist.shard_tensor(x, mesh, [dist.Shard(0), dist.Shard(1)])
+    assert dt.shape == [8, 16]
+    np.testing.assert_array_equal(np.asarray(dt._value), x)
+    # physically: each device holds an (8/2, 16/4) shard
+    shard = dt._value.addressable_shards[0]
+    assert shard.data.shape == (4, 4)
+    assert str(dt.dist_attr) == str(
+        jax.sharding.PartitionSpec("x", "y"))
+
+
+def test_shard_tensor_replicate():
+    mesh = dist.ProcessMesh(list(range(8)), dim_names=["x"])
+    x = np.random.RandomState(0).randn(4, 4).astype("float32")
+    dt = dist.shard_tensor(x, mesh, [dist.Replicate()])
+    assert dt._value.sharding.is_fully_replicated
+
+
+def test_reshard_changes_layout():
+    mesh = dist.ProcessMesh(list(range(8)), dim_names=["x"])
+    x = np.random.RandomState(1).randn(8, 8).astype("float32")
+    dt = dist.shard_tensor(x, mesh, [dist.Shard(0)])
+    assert dt._value.addressable_shards[0].data.shape == (1, 8)
+    dt2 = dist.reshard(dt, mesh, [dist.Shard(1)])
+    assert dt2._value.addressable_shards[0].data.shape == (8, 1)
+    np.testing.assert_array_equal(np.asarray(dt2._value), x)
+    dt3 = dist.reshard(dt2, mesh, [dist.Replicate()])
+    assert dt3._value.sharding.is_fully_replicated
+
+
+def test_dtensor_from_fn():
+    mesh = dist.ProcessMesh(list(range(8)), dim_names=["x"])
+    dt = dist.dtensor_from_fn(paddle.ones, mesh, [dist.Shard(0)], [8, 4])
+    assert dt.shape == [8, 4]
+    np.testing.assert_array_equal(np.asarray(dt._value), np.ones((8, 4)))
+
+
+def test_shard_layer_custom_fn():
+    mesh = dist.ProcessMesh([[0, 1], [2, 3]], dim_names=["dp", "mp"])
+    layer = paddle.nn.Linear(8, 16)
+
+    def shard_fn(name, sub, m):
+        for p in sub.parameters(include_sublayers=False):
+            if p.ndim == 2:  # weight: shard out dim over mp
+                v = dist.shard_tensor(p, m, [dist.Replicate(),
+                                             dist.Shard(1)])
+                p._value = v._value
+                p.dist_attr = v.dist_attr
+
+    dist.shard_layer(layer, mesh, shard_fn)
+    assert "mp" in str(layer.weight._value.sharding.spec)
+    # forward still works on replicated input
+    x = paddle.to_tensor(np.random.RandomState(2).randn(4, 8)
+                         .astype("float32"))
+    assert layer(x).shape == [4, 16]
+
+
+def test_shard_tensor_grad_flows():
+    """DistTensors participate in autograd like any Tensor."""
+    mesh = dist.ProcessMesh(list(range(8)), dim_names=["x"])
+    x = np.random.RandomState(3).randn(8, 4).astype("float32")
+    dt = dist.shard_tensor(x, mesh, [dist.Shard(0)],
+                           stop_gradient=False)
+    loss = paddle.sum(dt * dt)
+    loss.backward()
+    np.testing.assert_allclose(np.asarray(dt.grad._value), 2 * x,
+                               rtol=1e-6)
