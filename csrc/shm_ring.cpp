@@ -1,0 +1,224 @@
+// Shared-memory ring buffer for the multiprocess data loader.
+//
+// Native C++ equivalent of the reference's shared-memory dataloader
+// transport (reference: paddle/fluid/imperative/data_loader.cc —
+// _shared_memory tensor path + paddle/fluid/memory/allocation shm;
+// python side io/dataloader/dataloader_iter.py:358 worker loop).
+//
+// Worker processes serialize batches into a POSIX shm segment holding a
+// bounded byte ring guarded by process-shared pthread mutex/condvars —
+// the parent reads whole records without pipes or pickled fd passing.
+// Records are length-prefixed; writers block when the ring is full,
+// readers when empty (with timeouts so a dead peer can't hang training —
+// the watchdog role of the reference's CommTaskManager, host-side).
+
+#include <fcntl.h>
+#include <pthread.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+#include <cerrno>
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <ctime>
+#include <string>
+
+namespace {
+
+struct RingHeader {
+  pthread_mutex_t mu;
+  pthread_cond_t not_full;
+  pthread_cond_t not_empty;
+  uint64_t capacity;  // data bytes
+  uint64_t head;      // read position (absolute, monotonically increasing)
+  uint64_t tail;      // write position
+  uint32_t closed;
+};
+
+struct Ring {
+  RingHeader* hdr = nullptr;
+  uint8_t* data = nullptr;
+  uint64_t map_size = 0;
+  std::string name;
+  bool owner = false;
+};
+
+void abs_deadline(timespec* ts, int64_t timeout_ms) {
+  clock_gettime(CLOCK_REALTIME, ts);
+  ts->tv_sec += timeout_ms / 1000;
+  ts->tv_nsec += (timeout_ms % 1000) * 1000000L;
+  if (ts->tv_nsec >= 1000000000L) {
+    ts->tv_sec += 1;
+    ts->tv_nsec -= 1000000000L;
+  }
+}
+
+void copy_in(Ring* r, uint64_t pos, const uint8_t* src, uint64_t n) {
+  uint64_t off = pos % r->hdr->capacity;
+  uint64_t first = r->hdr->capacity - off;
+  if (first >= n) {
+    std::memcpy(r->data + off, src, n);
+  } else {
+    std::memcpy(r->data + off, src, first);
+    std::memcpy(r->data, src + first, n - first);
+  }
+}
+
+void copy_out(Ring* r, uint64_t pos, uint8_t* dst, uint64_t n) {
+  uint64_t off = pos % r->hdr->capacity;
+  uint64_t first = r->hdr->capacity - off;
+  if (first >= n) {
+    std::memcpy(dst, r->data + off, n);
+  } else {
+    std::memcpy(dst, r->data + off, first);
+    std::memcpy(dst + first, r->data, n - first);
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+// Create a named ring with `capacity` data bytes. Returns handle or null.
+void* shmring_create(const char* name, uint64_t capacity) {
+  ::shm_unlink(name);
+  int fd = ::shm_open(name, O_CREAT | O_EXCL | O_RDWR, 0600);
+  if (fd < 0) return nullptr;
+  uint64_t total = sizeof(RingHeader) + capacity;
+  if (::ftruncate(fd, static_cast<off_t>(total)) != 0) {
+    ::close(fd);
+    ::shm_unlink(name);
+    return nullptr;
+  }
+  void* mem = ::mmap(nullptr, total, PROT_READ | PROT_WRITE, MAP_SHARED,
+                     fd, 0);
+  ::close(fd);
+  if (mem == MAP_FAILED) {
+    ::shm_unlink(name);
+    return nullptr;
+  }
+  auto* r = new Ring();
+  r->hdr = static_cast<RingHeader*>(mem);
+  r->data = static_cast<uint8_t*>(mem) + sizeof(RingHeader);
+  r->map_size = total;
+  r->name = name;
+  r->owner = true;
+
+  pthread_mutexattr_t ma;
+  pthread_mutexattr_init(&ma);
+  pthread_mutexattr_setpshared(&ma, PTHREAD_PROCESS_SHARED);
+  pthread_mutex_init(&r->hdr->mu, &ma);
+  pthread_condattr_t ca;
+  pthread_condattr_init(&ca);
+  pthread_condattr_setpshared(&ca, PTHREAD_PROCESS_SHARED);
+  pthread_cond_init(&r->hdr->not_full, &ca);
+  pthread_cond_init(&r->hdr->not_empty, &ca);
+  r->hdr->capacity = capacity;
+  r->hdr->head = 0;
+  r->hdr->tail = 0;
+  r->hdr->closed = 0;
+  return r;
+}
+
+void* shmring_attach(const char* name) {
+  int fd = ::shm_open(name, O_RDWR, 0600);
+  if (fd < 0) return nullptr;
+  struct stat st;
+  if (::fstat(fd, &st) != 0) {
+    ::close(fd);
+    return nullptr;
+  }
+  void* mem = ::mmap(nullptr, static_cast<size_t>(st.st_size),
+                     PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  ::close(fd);
+  if (mem == MAP_FAILED) return nullptr;
+  auto* r = new Ring();
+  r->hdr = static_cast<RingHeader*>(mem);
+  r->data = static_cast<uint8_t*>(mem) + sizeof(RingHeader);
+  r->map_size = static_cast<uint64_t>(st.st_size);
+  r->name = name;
+  r->owner = false;
+  return r;
+}
+
+// 0 ok; -1 timeout; -2 closed; -3 record larger than ring.
+int shmring_write(void* handle, const uint8_t* buf, uint64_t len,
+                  int64_t timeout_ms) {
+  auto* r = static_cast<Ring*>(handle);
+  uint64_t need = len + 8;
+  if (need > r->hdr->capacity) return -3;
+  timespec ts;
+  abs_deadline(&ts, timeout_ms);
+  pthread_mutex_lock(&r->hdr->mu);
+  while (r->hdr->tail + need - r->hdr->head > r->hdr->capacity &&
+         !r->hdr->closed) {
+    if (pthread_cond_timedwait(&r->hdr->not_full, &r->hdr->mu, &ts) ==
+        ETIMEDOUT) {
+      pthread_mutex_unlock(&r->hdr->mu);
+      return -1;
+    }
+  }
+  if (r->hdr->closed) {
+    pthread_mutex_unlock(&r->hdr->mu);
+    return -2;
+  }
+  uint64_t len64 = len;
+  copy_in(r, r->hdr->tail, reinterpret_cast<uint8_t*>(&len64), 8);
+  copy_in(r, r->hdr->tail + 8, buf, len);
+  r->hdr->tail += need;
+  pthread_cond_signal(&r->hdr->not_empty);
+  pthread_mutex_unlock(&r->hdr->mu);
+  return 0;
+}
+
+// Returns record length (>=0) with *out malloc'd; -1 timeout; -2 closed
+// and drained.
+int64_t shmring_read(void* handle, uint8_t** out, int64_t timeout_ms) {
+  auto* r = static_cast<Ring*>(handle);
+  timespec ts;
+  abs_deadline(&ts, timeout_ms);
+  pthread_mutex_lock(&r->hdr->mu);
+  while (r->hdr->head == r->hdr->tail && !r->hdr->closed) {
+    if (pthread_cond_timedwait(&r->hdr->not_empty, &r->hdr->mu, &ts) ==
+        ETIMEDOUT) {
+      pthread_mutex_unlock(&r->hdr->mu);
+      return -1;
+    }
+  }
+  if (r->hdr->head == r->hdr->tail && r->hdr->closed) {
+    pthread_mutex_unlock(&r->hdr->mu);
+    return -2;
+  }
+  uint64_t len64;
+  copy_out(r, r->hdr->head, reinterpret_cast<uint8_t*>(&len64), 8);
+  *out = static_cast<uint8_t*>(::malloc(len64 ? len64 : 1));
+  copy_out(r, r->hdr->head + 8, *out, len64);
+  r->hdr->head += len64 + 8;
+  pthread_cond_signal(&r->hdr->not_full);
+  pthread_mutex_unlock(&r->hdr->mu);
+  return static_cast<int64_t>(len64);
+}
+
+void shmring_free(uint8_t* p) { ::free(p); }
+
+void shmring_close(void* handle) {  // mark EOF: readers drain then stop
+  auto* r = static_cast<Ring*>(handle);
+  pthread_mutex_lock(&r->hdr->mu);
+  r->hdr->closed = 1;
+  pthread_cond_broadcast(&r->hdr->not_empty);
+  pthread_cond_broadcast(&r->hdr->not_full);
+  pthread_mutex_unlock(&r->hdr->mu);
+}
+
+void shmring_detach(void* handle) {
+  auto* r = static_cast<Ring*>(handle);
+  bool owner = r->owner;
+  std::string name = r->name;
+  ::munmap(r->hdr, r->map_size);
+  if (owner) ::shm_unlink(name.c_str());
+  delete r;
+}
+
+}  // extern "C"

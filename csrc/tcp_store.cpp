@@ -1,0 +1,294 @@
+// TCPStore: TCP key-value rendezvous for multi-host bootstrap.
+//
+// Native C++ equivalent of the reference's store
+// (reference: paddle/phi/core/distributed/store/tcp_store.h:121 —
+// MasterDaemon accept loop + per-connection command dispatch;
+// store/socket.cpp). Used over DCN to exchange coordinator addresses /
+// ranks before any ICI communication exists (the NCCL-unique-id exchange
+// role; here it bootstraps jax.distributed / multi-host meshes).
+//
+// Protocol (little-endian, length-prefixed):
+//   cmd u8:  1=SET  2=GET(wait)  3=ADD  4=WAIT  5=CHECK  6=DELETE
+//   key:     u32 len + bytes;  value: u32 len + bytes (SET reply: u8 1)
+//   GET/WAIT block server-side (condvar) until the key exists or the
+//   client-supplied timeout_ms elapses (reply vlen=0xFFFFFFFF on timeout).
+//   ADD: i64 delta -> i64 new value (atomic counter, used for barriers).
+//
+// Exposed through a C ABI (ctypes; pybind11 is unavailable in this
+// image) — see python wrapper distributed/store.py.
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+struct Store {
+  std::map<std::string, std::vector<uint8_t>> data;
+  std::mutex mu;
+  std::condition_variable cv;
+};
+
+struct Server {
+  int listen_fd = -1;
+  std::atomic<bool> stop{false};
+  std::thread accept_thread;
+  std::vector<std::thread> conns;
+  std::mutex conns_mu;
+  Store store;
+  ~Server() {
+    stop.store(true);
+    if (listen_fd >= 0) {
+      ::shutdown(listen_fd, SHUT_RDWR);
+      ::close(listen_fd);
+    }
+    if (accept_thread.joinable()) accept_thread.join();
+    std::lock_guard<std::mutex> g(conns_mu);
+    for (auto& t : conns)
+      if (t.joinable()) t.join();
+  }
+};
+
+bool read_full(int fd, void* buf, size_t n) {
+  auto* p = static_cast<uint8_t*>(buf);
+  while (n) {
+    ssize_t r = ::recv(fd, p, n, 0);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool write_full(int fd, const void* buf, size_t n) {
+  auto* p = static_cast<const uint8_t*>(buf);
+  while (n) {
+    ssize_t r = ::send(fd, p, n, MSG_NOSIGNAL);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool read_blob(int fd, std::string* out) {
+  uint32_t len;
+  if (!read_full(fd, &len, 4)) return false;
+  out->resize(len);
+  return len == 0 || read_full(fd, &(*out)[0], len);
+}
+
+void serve_conn(Server* s, int fd) {
+  int one = 1;
+  ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  for (;;) {
+    uint8_t cmd;
+    if (!read_full(fd, &cmd, 1)) break;
+    std::string key;
+    if (!read_blob(fd, &key)) break;
+    if (cmd == 1) {  // SET
+      std::string val;
+      if (!read_blob(fd, &val)) break;
+      {
+        std::lock_guard<std::mutex> g(s->store.mu);
+        s->store.data[key].assign(val.begin(), val.end());
+      }
+      s->store.cv.notify_all();
+      uint8_t ok = 1;
+      if (!write_full(fd, &ok, 1)) break;
+    } else if (cmd == 2 || cmd == 4) {  // GET / WAIT
+      int64_t timeout_ms;
+      if (!read_full(fd, &timeout_ms, 8)) break;
+      std::unique_lock<std::mutex> lk(s->store.mu);
+      bool ok = s->store.cv.wait_for(
+          lk, std::chrono::milliseconds(timeout_ms),
+          [&] { return s->store.data.count(key) > 0 || s->stop.load(); });
+      if (!ok || s->stop.load()) {
+        lk.unlock();
+        uint32_t miss = 0xFFFFFFFFu;
+        if (!write_full(fd, &miss, 4)) break;
+        continue;
+      }
+      std::vector<uint8_t> val = s->store.data[key];
+      lk.unlock();
+      if (cmd == 4) {
+        uint32_t zero = 0;  // WAIT replies empty blob on success
+        if (!write_full(fd, &zero, 4)) break;
+      } else {
+        uint32_t len = static_cast<uint32_t>(val.size());
+        if (!write_full(fd, &len, 4)) break;
+        if (len && !write_full(fd, val.data(), len)) break;
+      }
+    } else if (cmd == 3) {  // ADD
+      int64_t delta, cur = 0;
+      if (!read_full(fd, &delta, 8)) break;
+      {
+        std::lock_guard<std::mutex> g(s->store.mu);
+        auto& v = s->store.data[key];
+        if (v.size() == 8) std::memcpy(&cur, v.data(), 8);
+        cur += delta;
+        v.resize(8);
+        std::memcpy(v.data(), &cur, 8);
+      }
+      s->store.cv.notify_all();
+      if (!write_full(fd, &cur, 8)) break;
+    } else if (cmd == 5) {  // CHECK
+      uint8_t present;
+      {
+        std::lock_guard<std::mutex> g(s->store.mu);
+        present = s->store.data.count(key) ? 1 : 0;
+      }
+      if (!write_full(fd, &present, 1)) break;
+    } else if (cmd == 6) {  // DELETE
+      {
+        std::lock_guard<std::mutex> g(s->store.mu);
+        s->store.data.erase(key);
+      }
+      uint8_t ok = 1;
+      if (!write_full(fd, &ok, 1)) break;
+    } else {
+      break;
+    }
+  }
+  ::close(fd);
+}
+
+}  // namespace
+
+extern "C" {
+
+// ---- server -------------------------------------------------------------
+void* tcpstore_server_start(int port, int* bound_port) {
+  auto* s = new Server();
+  s->listen_fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (s->listen_fd < 0) {
+    delete s;
+    return nullptr;
+  }
+  int one = 1;
+  ::setsockopt(s->listen_fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (::bind(s->listen_fd, reinterpret_cast<sockaddr*>(&addr),
+             sizeof(addr)) != 0 ||
+      ::listen(s->listen_fd, 128) != 0) {
+    ::close(s->listen_fd);
+    delete s;
+    return nullptr;
+  }
+  socklen_t alen = sizeof(addr);
+  ::getsockname(s->listen_fd, reinterpret_cast<sockaddr*>(&addr), &alen);
+  if (bound_port) *bound_port = ntohs(addr.sin_port);
+  s->accept_thread = std::thread([s] {
+    while (!s->stop.load()) {
+      int fd = ::accept(s->listen_fd, nullptr, nullptr);
+      if (fd < 0) break;
+      std::lock_guard<std::mutex> g(s->conns_mu);
+      s->conns.emplace_back(serve_conn, s, fd);
+    }
+  });
+  return s;
+}
+
+void tcpstore_server_stop(void* handle) {
+  delete static_cast<Server*>(handle);
+}
+
+// ---- client -------------------------------------------------------------
+int tcpstore_connect(const char* host, int port) {
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return -1;
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (::inet_pton(AF_INET, host, &addr.sin_addr) != 1) {
+    ::close(fd);
+    return -1;
+  }
+  if (::connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0) {
+    ::close(fd);
+    return -1;
+  }
+  int one = 1;
+  ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  return fd;
+}
+
+void tcpstore_close(int fd) { ::close(fd); }
+
+static bool send_key(int fd, uint8_t cmd, const char* key) {
+  uint32_t klen = static_cast<uint32_t>(std::strlen(key));
+  return write_full(fd, &cmd, 1) && write_full(fd, &klen, 4) &&
+         write_full(fd, key, klen);
+}
+
+int tcpstore_set(int fd, const char* key, const uint8_t* val, uint32_t vlen) {
+  if (!send_key(fd, 1, key)) return -1;
+  if (!write_full(fd, &vlen, 4)) return -1;
+  if (vlen && !write_full(fd, val, vlen)) return -1;
+  uint8_t ok;
+  return read_full(fd, &ok, 1) ? 0 : -1;
+}
+
+// Returns value length, or -1 on timeout/error. Caller frees *out with
+// tcpstore_free.
+int64_t tcpstore_get(int fd, const char* key, int64_t timeout_ms,
+                     uint8_t** out) {
+  if (!send_key(fd, 2, key)) return -1;
+  if (!write_full(fd, &timeout_ms, 8)) return -1;
+  uint32_t len;
+  if (!read_full(fd, &len, 4)) return -1;
+  if (len == 0xFFFFFFFFu) return -1;
+  *out = static_cast<uint8_t*>(::malloc(len ? len : 1));
+  if (len && !read_full(fd, *out, len)) {
+    ::free(*out);
+    return -1;
+  }
+  return static_cast<int64_t>(len);
+}
+
+void tcpstore_free(uint8_t* p) { ::free(p); }
+
+int64_t tcpstore_add(int fd, const char* key, int64_t delta) {
+  if (!send_key(fd, 3, key)) return INT64_MIN;
+  if (!write_full(fd, &delta, 8)) return INT64_MIN;
+  int64_t cur;
+  return read_full(fd, &cur, 8) ? cur : INT64_MIN;
+}
+
+int tcpstore_wait(int fd, const char* key, int64_t timeout_ms) {
+  if (!send_key(fd, 4, key)) return -1;
+  if (!write_full(fd, &timeout_ms, 8)) return -1;
+  uint32_t len;
+  if (!read_full(fd, &len, 4)) return -1;
+  return len == 0xFFFFFFFFu ? -1 : 0;
+}
+
+int tcpstore_check(int fd, const char* key) {
+  if (!send_key(fd, 5, key)) return -1;
+  uint8_t present;
+  return read_full(fd, &present, 1) ? present : -1;
+}
+
+int tcpstore_delete(int fd, const char* key) {
+  if (!send_key(fd, 6, key)) return -1;
+  uint8_t ok;
+  return read_full(fd, &ok, 1) ? 0 : -1;
+}
+
+}  // extern "C"

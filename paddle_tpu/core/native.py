@@ -1,0 +1,96 @@
+"""Loader for the native C++ runtime library.
+
+(The reference's native runtime spans allocator/executor/collective C++;
+here the host-side pieces that XLA does NOT own — bootstrap KV store,
+shared-memory dataloader transport — are C++ in csrc/, built into
+paddle_tpu/lib/libpaddle_tpu_native.so and bound via ctypes since
+pybind11 isn't in this image.)
+
+The library is built on demand with g++ if the .so is missing (first
+import on a fresh checkout); callers treat ``load() is None`` as
+"native unavailable" and fall back to pure-Python paths.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+_LIB = None
+_TRIED = False
+_LOCK = threading.Lock()
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_SO = os.path.join(_ROOT, "lib", "libpaddle_tpu_native.so")
+_CSRC = os.path.join(os.path.dirname(_ROOT), "csrc")
+
+
+def _build() -> bool:
+    if not os.path.isdir(_CSRC):
+        return False
+    try:
+        subprocess.run(["make", "-s"], cwd=_CSRC, check=True,
+                       capture_output=True, timeout=120)
+        return os.path.exists(_SO)
+    except Exception:
+        return False
+
+
+def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
+    c = ctypes
+    lib.tcpstore_server_start.restype = c.c_void_p
+    lib.tcpstore_server_start.argtypes = [c.c_int, c.POINTER(c.c_int)]
+    lib.tcpstore_server_stop.argtypes = [c.c_void_p]
+    lib.tcpstore_connect.restype = c.c_int
+    lib.tcpstore_connect.argtypes = [c.c_char_p, c.c_int]
+    lib.tcpstore_close.argtypes = [c.c_int]
+    lib.tcpstore_set.restype = c.c_int
+    lib.tcpstore_set.argtypes = [c.c_int, c.c_char_p,
+                                 c.POINTER(c.c_uint8), c.c_uint32]
+    lib.tcpstore_get.restype = c.c_int64
+    lib.tcpstore_get.argtypes = [c.c_int, c.c_char_p, c.c_int64,
+                                 c.POINTER(c.POINTER(c.c_uint8))]
+    lib.tcpstore_free.argtypes = [c.POINTER(c.c_uint8)]
+    lib.tcpstore_add.restype = c.c_int64
+    lib.tcpstore_add.argtypes = [c.c_int, c.c_char_p, c.c_int64]
+    lib.tcpstore_wait.restype = c.c_int
+    lib.tcpstore_wait.argtypes = [c.c_int, c.c_char_p, c.c_int64]
+    lib.tcpstore_check.restype = c.c_int
+    lib.tcpstore_check.argtypes = [c.c_int, c.c_char_p]
+    lib.tcpstore_delete.restype = c.c_int
+    lib.tcpstore_delete.argtypes = [c.c_int, c.c_char_p]
+
+    lib.shmring_create.restype = c.c_void_p
+    lib.shmring_create.argtypes = [c.c_char_p, c.c_uint64]
+    lib.shmring_attach.restype = c.c_void_p
+    lib.shmring_attach.argtypes = [c.c_char_p]
+    lib.shmring_write.restype = c.c_int
+    lib.shmring_write.argtypes = [c.c_void_p, c.POINTER(c.c_uint8),
+                                  c.c_uint64, c.c_int64]
+    lib.shmring_read.restype = c.c_int64
+    lib.shmring_read.argtypes = [c.c_void_p,
+                                 c.POINTER(c.POINTER(c.c_uint8)),
+                                 c.c_int64]
+    lib.shmring_free.argtypes = [c.POINTER(c.c_uint8)]
+    lib.shmring_close.argtypes = [c.c_void_p]
+    lib.shmring_detach.argtypes = [c.c_void_p]
+    return lib
+
+
+def load() -> Optional[ctypes.CDLL]:
+    """The native library, building it first if needed; None if neither
+    loading nor building is possible."""
+    global _LIB, _TRIED
+    with _LOCK:
+        if _LIB is not None or _TRIED:
+            return _LIB
+        _TRIED = True
+        if not os.path.exists(_SO) and not _build():
+            return None
+        try:
+            _LIB = _bind(ctypes.CDLL(_SO))
+        except OSError:
+            _LIB = None
+        return _LIB

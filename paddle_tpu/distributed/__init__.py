@@ -21,6 +21,7 @@ from . import sharding  # noqa: F401
 from . import utils  # noqa: F401
 from .engine import ParallelEngine, bind_params, shard_module_params  # noqa: F401
 from .parallel import DataParallel, ParallelEnv  # noqa: F401
+from .store import TCPStore, create_or_get_global_tcp_store  # noqa: F401
 from .sharding import group_sharded_parallel, save_group_sharded_model  # noqa: F401
 
 __all__ = [

@@ -1,0 +1,118 @@
+"""TCPStore: python surface over the native C++ store.
+
+(reference: phi/core/distributed/store/tcp_store.h:121 TCPStore +
+MasterDaemon; python/paddle/distributed/parallel.py:1099
+create_or_get_global_tcp_store. The store bootstraps multi-host jobs
+over DCN — coordinator address exchange, rank barriers — before any
+ICI/XLA communication exists.)
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import time
+from typing import Optional
+
+from ..core import native
+from ..core.enforce import enforce
+
+__all__ = ["TCPStore", "create_or_get_global_tcp_store"]
+
+_global_store: Optional["TCPStore"] = None
+
+
+class TCPStore:
+    """KV store client (and, on the master rank, the server too)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 is_master: bool = False, world_size: int = 1,
+                 timeout: float = 900.0):
+        self._lib = native.load()
+        enforce(self._lib is not None,
+                "native library unavailable (csrc build failed)")
+        self._server = None
+        self.timeout_ms = int(timeout * 1000)
+        if is_master:
+            bound = ctypes.c_int(0)
+            self._server = self._lib.tcpstore_server_start(
+                port, ctypes.byref(bound))
+            enforce(self._server, f"TCPStore: cannot bind port {port}")
+            port = bound.value
+        self.host, self.port = host, port
+        deadline = time.time() + timeout
+        self._fd = -1
+        while time.time() < deadline:
+            self._fd = self._lib.tcpstore_connect(host.encode(), port)
+            if self._fd >= 0:
+                break
+            time.sleep(0.05)
+        enforce(self._fd >= 0,
+                f"TCPStore: cannot connect to {host}:{port}")
+
+    def set(self, key: str, value) -> None:
+        data = value if isinstance(value, (bytes, bytearray)) else \
+            str(value).encode()
+        buf = (ctypes.c_uint8 * len(data)).from_buffer_copy(data)
+        rc = self._lib.tcpstore_set(self._fd, key.encode(), buf, len(data))
+        enforce(rc == 0, f"TCPStore.set({key!r}) failed")
+
+    def get(self, key: str) -> bytes:
+        out = ctypes.POINTER(ctypes.c_uint8)()
+        n = self._lib.tcpstore_get(self._fd, key.encode(),
+                                   self.timeout_ms, ctypes.byref(out))
+        enforce(n >= 0, f"TCPStore.get({key!r}) timed out")
+        data = ctypes.string_at(out, n)
+        self._lib.tcpstore_free(out)
+        return data
+
+    def add(self, key: str, delta: int) -> int:
+        v = self._lib.tcpstore_add(self._fd, key.encode(), int(delta))
+        enforce(v != -(2 ** 63), f"TCPStore.add({key!r}) failed")
+        return int(v)
+
+    def wait(self, key: str, timeout: Optional[float] = None) -> None:
+        ms = int(timeout * 1000) if timeout else self.timeout_ms
+        rc = self._lib.tcpstore_wait(self._fd, key.encode(), ms)
+        enforce(rc == 0, f"TCPStore.wait({key!r}) timed out")
+
+    def check(self, key: str) -> bool:
+        return self._lib.tcpstore_check(self._fd, key.encode()) == 1
+
+    def delete_key(self, key: str) -> None:
+        self._lib.tcpstore_delete(self._fd, key.encode())
+
+    def barrier(self, name: str, world_size: int,
+                timeout: Optional[float] = None) -> None:
+        """Count-up barrier via the atomic ADD counter."""
+        n = self.add(f"__barrier__/{name}", 1)
+        if n >= world_size:
+            self.set(f"__barrier__/{name}/go", b"1")
+        self.wait(f"__barrier__/{name}/go", timeout)
+
+    def close(self) -> None:
+        if self._fd >= 0:
+            self._lib.tcpstore_close(self._fd)
+            self._fd = -1
+        if self._server:
+            self._lib.tcpstore_server_stop(self._server)
+            self._server = None
+
+    def __del__(self):  # pragma: no cover
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+def create_or_get_global_tcp_store() -> TCPStore:
+    """(reference parallel.py:1099) — master/port from the launcher envs
+    PADDLE_MASTER / PADDLE_TRAINER_ID."""
+    global _global_store
+    if _global_store is None:
+        master = os.environ.get("PADDLE_MASTER", "127.0.0.1:0")
+        host, _, port = master.partition(":")
+        rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+        world = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+        _global_store = TCPStore(host or "127.0.0.1", int(port or 0),
+                                 is_master=(rank == 0), world_size=world)
+    return _global_store

@@ -257,6 +257,19 @@ class DataLoader:
                 yield self.collate_fn([self.dataset[i] for i in batch_idx])
 
     def __iter__(self):
+        if self.num_workers and self.num_workers > 0 and \
+                not isinstance(self.dataset, IterableDataset) and \
+                self.batch_sampler is not None:
+            from . import shm_loader
+
+            if shm_loader.available():
+                # native path: forked workers collate into the C++
+                # shared-memory ring (csrc/shm_ring.cpp)
+                yield from shm_loader.iter_multiprocess(
+                    self.dataset, list(self.batch_sampler),
+                    self.collate_fn, int(self.num_workers),
+                    worker_init_fn=getattr(self, "worker_init_fn", None))
+                return
         if not self.use_buffer_reader:
             yield from self._iter_sync()
             return

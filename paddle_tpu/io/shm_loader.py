@@ -1,0 +1,135 @@
+"""Multiprocess data loading over the native shared-memory ring.
+
+(reference: python/paddle/io/dataloader/dataloader_iter.py:358
+_DataLoaderIterMultiProcess — worker processes + shared-memory tensor
+transport from fluid/imperative/data_loader.cc. Here the transport is
+csrc/shm_ring.cpp: workers serialize collated batches straight into a
+process-shared ring; the parent reorders by batch index so iteration
+order matches the single-process loader exactly.)
+"""
+from __future__ import annotations
+
+import ctypes
+import multiprocessing as mp
+import os
+import pickle
+from typing import Any, Dict
+
+import numpy as np
+
+from ..core import native
+from ..tensor import Tensor
+
+__all__ = ["iter_multiprocess", "available"]
+
+_TIMEOUT_MS = 120_000
+
+
+def available() -> bool:
+    return native.load() is not None and hasattr(os, "fork")
+
+
+def _to_plain(obj: Any) -> Any:
+    """Tensors → ndarrays for pickling across the process boundary."""
+    if isinstance(obj, Tensor):
+        return {"__t__": True, "d": obj.numpy()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_to_plain(v) for v in obj)
+    if isinstance(obj, dict):
+        return {k: _to_plain(v) for k, v in obj.items()}
+    return obj
+
+
+def _from_plain(obj: Any) -> Any:
+    if isinstance(obj, dict):
+        if obj.get("__t__"):
+            return Tensor(__import__("jax.numpy", fromlist=["asarray"])
+                          .asarray(obj["d"]))
+        return {k: _from_plain(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_from_plain(v) for v in obj)
+    return obj
+
+
+def _worker_main(ring_name: bytes, dataset, batches, collate_fn,
+                 worker_id: int, num_workers: int, init_fn):
+    lib = native.load()
+    h = lib.shmring_attach(ring_name)
+    if not h:
+        os._exit(1)
+    try:
+        if init_fn is not None:
+            init_fn(worker_id)
+        for seq, batch_idx in enumerate(batches):
+            if seq % num_workers != worker_id:
+                continue
+            items = [dataset[i] for i in batch_idx]
+            payload = pickle.dumps((seq, _to_plain(collate_fn(items))),
+                                   protocol=4)
+            buf = (ctypes.c_uint8 * len(payload)).from_buffer_copy(payload)
+            rc = lib.shmring_write(h, buf, len(payload), _TIMEOUT_MS)
+            if rc != 0:
+                os._exit(2)
+        done = pickle.dumps(("__done__", worker_id), protocol=4)
+        buf = (ctypes.c_uint8 * len(done)).from_buffer_copy(done)
+        lib.shmring_write(h, buf, len(done), _TIMEOUT_MS)
+    finally:
+        lib.shmring_detach(h)
+    os._exit(0)
+
+
+def iter_multiprocess(dataset, batch_indices, collate_fn, num_workers: int,
+                      ring_bytes: int = 64 << 20, worker_init_fn=None,
+                      timeout_s: float = 120.0):
+    """Yield collated batches in order, produced by ``num_workers``
+    forked processes through the shm ring."""
+    lib = native.load()
+    if lib is None:
+        raise RuntimeError("native shm ring unavailable")
+    batches = list(batch_indices)
+    name = f"/ptpu_ring_{os.getpid()}_{id(batches) & 0xffff}".encode()
+    h = lib.shmring_create(name, ring_bytes)
+    if not h:
+        raise RuntimeError("shmring_create failed")
+    ctx = mp.get_context("fork")
+    procs = [ctx.Process(target=_worker_main,
+                         args=(name, dataset, batches, collate_fn, w,
+                               num_workers, worker_init_fn), daemon=True)
+             for w in range(num_workers)]
+    for p in procs:
+        p.start()
+    pending: Dict[int, Any] = {}
+    next_seq, done = 0, 0
+    out = ctypes.POINTER(ctypes.c_uint8)()
+    try:
+        while next_seq < len(batches):
+            if next_seq in pending:
+                yield pending.pop(next_seq)
+                next_seq += 1
+                continue
+            if done >= num_workers:
+                raise RuntimeError(
+                    f"dataloader workers exited early: batch {next_seq} "
+                    "never arrived")
+            n = lib.shmring_read(h, ctypes.byref(out),
+                                 int(timeout_s * 1000))
+            if n < 0:
+                dead = [p.exitcode for p in procs
+                        if p.exitcode not in (None, 0)]
+                raise RuntimeError(
+                    "dataloader shm read timed out"
+                    + (f"; worker exit codes {dead}" if dead else ""))
+            payload = ctypes.string_at(out, n)
+            lib.shmring_free(out)
+            seq, batch = pickle.loads(payload)
+            if seq == "__done__":
+                done += 1
+                continue
+            pending[seq] = _from_plain(batch)
+    finally:
+        lib.shmring_close(h)
+        for p in procs:
+            p.join(timeout=5)
+            if p.is_alive():
+                p.terminate()
+        lib.shmring_detach(h)

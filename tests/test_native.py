@@ -1,0 +1,117 @@
+"""Native C++ runtime components: TCPStore + shm-ring dataloader
+(reference patterns: phi/core/distributed/store/tcp_store.h unit tests;
+multiprocess dataloader tests in test/legacy_test)."""
+import threading
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.core import native
+
+pytestmark = pytest.mark.skipif(native.load() is None,
+                                reason="native library unavailable")
+
+
+def test_tcpstore_set_get_add_wait():
+    from paddle_tpu.distributed import TCPStore
+
+    master = TCPStore("127.0.0.1", 0, is_master=True, timeout=10)
+    client = TCPStore("127.0.0.1", master.port, is_master=False,
+                      timeout=10)
+
+    master.set("k1", b"hello")
+    assert client.get("k1") == b"hello"
+    assert client.check("k1") and not client.check("nope")
+
+    assert client.add("ctr", 3) == 3
+    assert master.add("ctr", 4) == 7
+
+    # wait unblocks when another connection sets the key
+    done = []
+
+    def waiter():
+        client.wait("later", timeout=10)
+        done.append(client.get("later"))
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    master.set("later", b"v")
+    t.join(timeout=10)
+    assert done == [b"v"]
+
+    client.delete_key("k1")
+    assert not master.check("k1")
+    client.close()
+    master.close()
+
+
+def test_tcpstore_barrier():
+    from paddle_tpu.distributed import TCPStore
+
+    master = TCPStore("127.0.0.1", 0, is_master=True, timeout=10)
+    clients = [TCPStore("127.0.0.1", master.port) for _ in range(3)]
+    results = []
+
+    def enter(store, i):
+        store.barrier("b0", 3, timeout=10)
+        results.append(i)
+
+    threads = [threading.Thread(target=enter, args=(c, i))
+               for i, c in enumerate(clients)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=10)
+    assert sorted(results) == [0, 1, 2]
+    for c in clients:
+        c.close()
+    master.close()
+
+
+def test_shm_ring_roundtrip():
+    import ctypes
+
+    lib = native.load()
+    h = lib.shmring_create(b"/ptpu_test_ring", 1 << 16)
+    assert h
+    payloads = [bytes([i]) * (100 + i) for i in range(50)]
+    for p in payloads:
+        buf = (ctypes.c_uint8 * len(p)).from_buffer_copy(p)
+        assert lib.shmring_write(h, buf, len(p), 1000) == 0
+    out = ctypes.POINTER(ctypes.c_uint8)()
+    for p in payloads:
+        n = lib.shmring_read(h, ctypes.byref(out), 1000)
+        assert n == len(p)
+        assert ctypes.string_at(out, n) == p
+        lib.shmring_free(out)
+    # empty + closed → -2 after close
+    lib.shmring_close(h)
+    assert lib.shmring_read(h, ctypes.byref(out), 100) == -2
+    lib.shmring_detach(h)
+
+
+def test_multiprocess_dataloader():
+    from paddle_tpu.io import DataLoader, Dataset
+
+    class DS(Dataset):
+        def __init__(self):
+            self.x = np.arange(64, dtype="float32").reshape(32, 2)
+
+        def __getitem__(self, i):
+            return self.x[i], np.int64(i % 4)
+
+        def __len__(self):
+            return 32
+
+    loader = DataLoader(DS(), batch_size=4, shuffle=False, num_workers=2)
+    batches = list(loader)
+    assert len(batches) == 8
+    # ordering must match the single-process loader exactly
+    ref = list(DataLoader(DS(), batch_size=4, shuffle=False,
+                          num_workers=0))
+    for (xa, ya), (xb, yb) in zip(batches, ref):
+        np.testing.assert_array_equal(np.asarray(xa._value),
+                                      np.asarray(xb._value))
+        np.testing.assert_array_equal(np.asarray(ya._value),
+                                      np.asarray(yb._value))
