@@ -1,0 +1,117 @@
+"""AMP accuracy debugging tools.
+
+(reference: python/paddle/amp/debugging.py — collect_operator_stats,
+TensorCheckerConfig/enable_tensor_checker, check_numerics;
+FLAGS_check_nan_inf hooks fluid/eager/nan_inf_utils.h:38 after every
+eager op. Here the same chokepoint is core/dispatch.py: an op observer
+counts dispatches by dtype, and the existing check_nan_inf flag scans
+op outputs inside the jit-cached kernels.)
+"""
+from __future__ import annotations
+
+import contextlib
+from collections import defaultdict
+from typing import Dict, Optional
+
+import jax
+import numpy as np
+import jax.numpy as jnp
+
+from ..core import dispatch as _dispatch
+from ..core import flags as _flags
+from ..tensor import Tensor
+
+__all__ = ["collect_operator_stats", "enable_operator_stats_collection",
+           "disable_operator_stats_collection", "check_numerics",
+           "TensorCheckerConfig", "enable_tensor_checker",
+           "disable_tensor_checker", "DebugMode"]
+
+
+class DebugMode:
+    CHECK_NAN_INF_AND_ABORT = 0
+    CHECK_NAN_INF = 1
+    CHECK_ALL = 4
+
+
+_stats: Optional[Dict[str, Dict[str, int]]] = None
+
+
+def _observer(op_name, conv_args):
+    dt = "other"
+    for a in conv_args:
+        if hasattr(a, "dtype"):
+            dt = str(a.dtype)
+            break
+    _stats[op_name][dt] += 1
+
+
+def enable_operator_stats_collection():
+    """(reference debugging.py enable_operator_stats_collection)."""
+    global _stats
+    _stats = defaultdict(lambda: defaultdict(int))
+    _dispatch._op_observer = _observer
+
+
+def disable_operator_stats_collection():
+    global _stats
+    _dispatch._op_observer = None
+    if _stats:
+        print(f"{'op':<32} {'dtype':<12} {'calls':>8}")
+        for op, by_dt in sorted(_stats.items()):
+            for dt, n in sorted(by_dt.items()):
+                print(f"{op:<32} {dt:<12} {n:>8}")
+    stats, _stats = _stats, None
+    return stats
+
+
+@contextlib.contextmanager
+def collect_operator_stats():
+    """(reference debugging.py collect_operator_stats context)."""
+    enable_operator_stats_collection()
+    try:
+        yield
+    finally:
+        disable_operator_stats_collection()
+
+
+def check_numerics(tensor, op_type: str = "", var_name: str = "",
+                   debug_mode=DebugMode.CHECK_NAN_INF_AND_ABORT):
+    """Count (nan, inf, num) in a tensor; abort mode raises
+    (reference debugging.py check_numerics →
+    phi/kernels/check_numerics_kernel.h)."""
+    v = tensor._value if isinstance(tensor, Tensor) else jnp.asarray(tensor)
+    if not jnp.issubdtype(v.dtype, jnp.floating):
+        return 0, 0, int(np.prod(v.shape) or 1)
+    n_nan = int(jnp.sum(jnp.isnan(v)))
+    n_inf = int(jnp.sum(jnp.isinf(v)))
+    n_num = int(np.prod(v.shape) or 1) - n_nan - n_inf
+    if debug_mode == DebugMode.CHECK_NAN_INF_AND_ABORT and \
+            (n_nan or n_inf):
+        raise FloatingPointError(
+            f"check_numerics: {op_type or '<tensor>'} {var_name} has "
+            f"{n_nan} NaN and {n_inf} Inf values")
+    return n_nan, n_inf, n_num
+
+
+class TensorCheckerConfig:
+    """(reference debugging.py TensorCheckerConfig)."""
+
+    def __init__(self, enable: bool = True,
+                 debug_mode=DebugMode.CHECK_NAN_INF_AND_ABORT,
+                 output_dir=None, checked_op_list=None,
+                 skipped_op_list=None, debug_step=None, stack_height_limit=1):
+        self.enable = enable
+        self.debug_mode = debug_mode
+        self.checked_op_list = checked_op_list
+        self.skipped_op_list = skipped_op_list
+
+
+def enable_tensor_checker(checker_config: TensorCheckerConfig):
+    """Turn on per-op NaN/Inf scanning (FLAGS_check_nan_inf — the
+    dispatch layer scans every op output)."""
+    if checker_config.enable:
+        _flags.set_flags({"FLAGS_check_nan_inf": True})
+
+
+def disable_tensor_checker():
+    _flags.set_flags({"FLAGS_check_nan_inf": False})

@@ -23,6 +23,15 @@ __all__ = ["apply", "def_op", "def_grad"]
 # (op_name, tensor_values) -> tensor_values.
 _amp_hook = None
 
+# Set by paddle_tpu.profiler while recording: wraps each op dispatch in a
+# host RecordEvent (the reference hooks its host tracer into the
+# generated ad_funcs the same way).
+_profile_hook = None
+
+# Set by paddle_tpu.amp.debugging: observes (op_name, arg_values) at each
+# dispatch (operator-stats collection, amp accuracy tooling).
+_op_observer = None
+
 
 def apply(opdef: OpDef, args, kwargs):
     from ..tensor import Tensor
@@ -57,7 +66,13 @@ def apply(opdef: OpDef, args, kwargs):
     requires_grad = opdef.differentiable and engine.is_grad_enabled() and any(
         t is not None and not t.stop_gradient for t in in_tensors
     )
-    outs = run_op(call)
+    if _op_observer is not None:
+        _op_observer(opdef.name, conv_args)
+    if _profile_hook is not None:
+        with _profile_hook(opdef.name):
+            outs = run_op(call)
+    else:
+        outs = run_op(call)
 
     multi = isinstance(outs, tuple)
     out_list = list(outs) if multi else [outs]

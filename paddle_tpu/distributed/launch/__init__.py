@@ -1,0 +1,3 @@
+from .main import launch  # noqa: F401
+
+__all__ = ["launch"]

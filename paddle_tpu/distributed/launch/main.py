@@ -1,0 +1,133 @@
+"""Job launcher: ``python -m paddle_tpu.distributed.launch``.
+
+(reference: python/paddle/distributed/launch/main.py:20 +
+controllers/collective.py:37 CollectiveController.build_pod — spawns one
+process per GPU with PADDLE_TRAINER_ENDPOINTS / PADDLE_MASTER / rank
+envs; controllers/watcher.py liveness monitor.)
+
+TPU-native process model: XLA is single-controller per HOST — one
+process drives all local chips (the reference runs one per GPU). So:
+- single host, no --nnodes: exec the script in-process (env setup only);
+- --nnodes N: this process is one trainer of N; we export the PADDLE_*
+  envs and (when available) point jax.distributed at the coordinator so
+  multi-host meshes form over DCN;
+- --nproc_per_node K (testing / CPU simulation): fork K local trainer
+  processes with ranked envs, watch them, propagate the first failure
+  (the watcher role).
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+import time
+from typing import List
+
+__all__ = ["launch"]
+
+
+def _parse(argv=None):
+    p = argparse.ArgumentParser(
+        prog="paddle_tpu.distributed.launch",
+        description="Launch a (multi-host) training job")
+    p.add_argument("--master", default=None,
+                   help="coordinator host:port (rank-0 host)")
+    p.add_argument("--nnodes", type=int, default=1,
+                   help="number of hosts in the job")
+    p.add_argument("--rank", type=int, default=None,
+                   help="this host's rank (default: from env or 0)")
+    p.add_argument("--nproc_per_node", type=int, default=1,
+                   help="local trainer processes (testing; TPU uses 1)")
+    p.add_argument("--devices", default=None,
+                   help="visible device ids, comma separated")
+    p.add_argument("--log_dir", default=None, help="per-rank log dir")
+    p.add_argument("training_script", help="script to run")
+    p.add_argument("training_script_args", nargs=argparse.REMAINDER)
+    return p.parse_args(argv)
+
+
+def _base_env(args, rank: int, world: int) -> dict:
+    env = dict(os.environ)
+    env["PADDLE_TRAINER_ID"] = str(rank)
+    env["PADDLE_TRAINERS_NUM"] = str(world)
+    if args.master:
+        env["PADDLE_MASTER"] = args.master
+    if args.devices is not None:
+        env["CUDA_VISIBLE_DEVICES"] = args.devices  # parity name
+        env["TPU_VISIBLE_DEVICES"] = args.devices
+    env["PADDLE_DISTRI_BACKEND"] = "xla"
+    return env
+
+
+def _watch(procs: List[subprocess.Popen]) -> int:
+    """Reference watcher.py: first non-zero exit kills the pod."""
+    try:
+        while True:
+            alive = False
+            for i, p in enumerate(procs):
+                rc = p.poll()
+                if rc is None:
+                    alive = True
+                elif rc != 0:
+                    for q in procs:
+                        if q.poll() is None:
+                            q.send_signal(signal.SIGTERM)
+                    return rc
+            if not alive:
+                return 0
+            time.sleep(0.2)
+    except KeyboardInterrupt:
+        for q in procs:
+            if q.poll() is None:
+                q.send_signal(signal.SIGTERM)
+        return 130
+
+
+def launch(argv=None) -> int:
+    args = _parse(argv)
+    world_hosts = args.nnodes
+    host_rank = args.rank if args.rank is not None else \
+        int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+
+    if args.nproc_per_node <= 1:
+        # TPU path: ONE process drives all local chips
+        env = _base_env(args, host_rank, world_hosts)
+        if world_hosts > 1 and args.master:
+            # multi-host: jax.distributed coordinator over DCN
+            env.setdefault("JAX_COORDINATOR_ADDRESS", args.master)
+            env.setdefault("JAX_NUM_PROCESSES", str(world_hosts))
+            env.setdefault("JAX_PROCESS_ID", str(host_rank))
+        os.environ.update(env)
+        cmd = [sys.executable, args.training_script,
+               *args.training_script_args]
+        return subprocess.call(cmd, env=env)
+
+    # simulation path: K ranked local processes (reference build_pod)
+    procs = []
+    world = args.nproc_per_node * world_hosts
+    master = args.master or "127.0.0.1:35127"
+    for local in range(args.nproc_per_node):
+        rank = host_rank * args.nproc_per_node + local
+        env = _base_env(args, rank, world)
+        env["PADDLE_MASTER"] = master
+        env["PADDLE_LOCAL_RANK"] = str(local)
+        stdout = None
+        if args.log_dir:
+            os.makedirs(args.log_dir, exist_ok=True)
+            stdout = open(os.path.join(args.log_dir,
+                                       f"workerlog.{rank}"), "w")
+        procs.append(subprocess.Popen(
+            [sys.executable, args.training_script,
+             *args.training_script_args],
+            env=env, stdout=stdout,
+            stderr=subprocess.STDOUT if stdout else None))
+    rc = _watch(procs)
+    if rc != 0:
+        print(f"launch: pod failed with exit code {rc}", file=sys.stderr)
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(launch())

@@ -1,0 +1,257 @@
+"""Profiler (paddle.profiler analog).
+
+(reference: python/paddle/profiler/profiler.py:79,99 — Profiler with
+states/targets, export_chrome_tracing:215, RecordEvent host events,
+profiler_statistic.py summaries; C++ host tracer
+fluid/platform/profiler/host_tracer.cc + CUPTI cuda_tracer.)
+
+TPU-native: the device side is the XLA/TPU profiler (xplane) reached
+through ``jax.profiler`` — traces open in TensorBoard/Perfetto, covering
+what CUPTI covered. The host side is a lightweight in-process event
+recorder (RecordEvent) feeding ``summary()`` and the chrome-trace
+exporter, the host_tracer role.
+"""
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import threading
+import time
+from enum import Enum
+from typing import Callable, List, Optional, Tuple
+
+__all__ = ["Profiler", "ProfilerTarget", "ProfilerState", "RecordEvent",
+           "export_chrome_tracing", "make_scheduler", "load_profiler_result"]
+
+
+class ProfilerTarget(Enum):
+    CPU = 0
+    GPU = 1
+    XPU = 2
+    CUSTOM_DEVICE = 3
+    TPU = 4
+
+
+class ProfilerState(Enum):
+    CLOSED = 0
+    READY = 1
+    RECORD = 2
+    RECORD_AND_RETURN = 3
+
+
+_events: List[Tuple[str, float, float, str]] = []
+_events_lock = threading.Lock()
+_active = 0
+
+
+class RecordEvent:
+    """Host-side named range (reference profiler/utils.py RecordEvent)."""
+
+    def __init__(self, name: str, event_type: str = "UserDefined"):
+        self.name = name
+        self.event_type = event_type
+        self._t0 = None
+
+    def begin(self):
+        self._t0 = time.perf_counter()
+
+    def end(self):
+        if self._t0 is None or not _active:
+            return
+        t1 = time.perf_counter()
+        with _events_lock:
+            _events.append((self.name, self._t0, t1, self.event_type))
+        self._t0 = None
+
+    def __enter__(self):
+        self.begin()
+        return self
+
+    def __exit__(self, *exc):
+        self.end()
+
+
+@contextlib.contextmanager
+def _op_record(name: str):
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        t1 = time.perf_counter()
+        with _events_lock:
+            _events.append((name, t0, t1, "Operator"))
+
+
+def make_scheduler(*, closed: int, ready: int, record: int, repeat: int = 0,
+                   skip_first: int = 0) -> Callable[[int], ProfilerState]:
+    """(reference profiler.py make_scheduler) step → state."""
+    period = closed + ready + record
+
+    def schedule(step: int) -> ProfilerState:
+        if step < skip_first:
+            return ProfilerState.CLOSED
+        s = step - skip_first
+        if repeat and s >= repeat * period:
+            return ProfilerState.CLOSED
+        pos = s % period
+        if pos < closed:
+            return ProfilerState.CLOSED
+        if pos < closed + ready:
+            return ProfilerState.READY
+        if pos == period - 1:
+            return ProfilerState.RECORD_AND_RETURN
+        return ProfilerState.RECORD
+
+    return schedule
+
+
+def export_chrome_tracing(dir_name: str, worker_name: Optional[str] = None):
+    """on_trace_ready callback writing chrome://tracing json
+    (reference profiler.py:215)."""
+
+    def handler(prof: "Profiler"):
+        os.makedirs(dir_name, exist_ok=True)
+        fname = f"{worker_name or 'worker'}_{os.getpid()}.pt.trace.json"
+        prof._export_chrome(os.path.join(dir_name, fname))
+
+    return handler
+
+
+class Profiler:
+    """paddle.profiler.Profiler analog.
+
+    ``timer_only=True`` records host events only; otherwise the XLA/TPU
+    device trace runs too (``jax.profiler``), written to ``log_dir`` for
+    TensorBoard. ``scheduler`` is (start, end) step bounds or a
+    make_scheduler callable.
+    """
+
+    def __init__(self, *, targets=None, scheduler=None,
+                 on_trace_ready=None, timer_only: bool = False,
+                 record_shapes: bool = False, profile_memory: bool = False,
+                 log_dir: str = "./profiler_log"):
+        self.timer_only = timer_only
+        self.log_dir = log_dir
+        self.on_trace_ready = on_trace_ready
+        if isinstance(scheduler, tuple):
+            lo, hi = scheduler
+            scheduler = make_scheduler(closed=lo, ready=0, record=hi - lo,
+                                       repeat=1)
+        self.scheduler = scheduler
+        self.step_num = 0
+        self._state = ProfilerState.CLOSED
+        self._device_tracing = False
+        self._step_times: List[float] = []
+        self._last_step_t = None
+
+    # -- lifecycle ------------------------------------------------------
+    def start(self):
+        global _active
+        _active += 1
+        with _events_lock:
+            _events.clear()
+        self._state = (self.scheduler(self.step_num)
+                       if self.scheduler else ProfilerState.RECORD)
+        self._maybe_device(True)
+        self._last_step_t = time.perf_counter()
+        from ..core import dispatch as _dispatch
+
+        _dispatch._profile_hook = _op_record
+
+    def stop(self):
+        global _active
+        from ..core import dispatch as _dispatch
+
+        _dispatch._profile_hook = None
+        self._maybe_device(False)
+        _active = max(0, _active - 1)
+        if self.on_trace_ready:
+            self.on_trace_ready(self)
+
+    def _maybe_device(self, start: bool):
+        if self.timer_only:
+            return
+        try:
+            import jax
+
+            if start and not self._device_tracing and \
+                    self._state in (ProfilerState.RECORD,
+                                    ProfilerState.RECORD_AND_RETURN):
+                jax.profiler.start_trace(self.log_dir)
+                self._device_tracing = True
+            elif not start and self._device_tracing:
+                jax.profiler.stop_trace()
+                self._device_tracing = False
+        except Exception:
+            self._device_tracing = False
+
+    def step(self, num_samples: Optional[int] = None):
+        now = time.perf_counter()
+        if self._last_step_t is not None:
+            self._step_times.append(now - self._last_step_t)
+        self._last_step_t = now
+        self.step_num += 1
+        if self.scheduler:
+            new = self.scheduler(self.step_num)
+            if new != self._state:
+                old, self._state = self._state, new
+                if new in (ProfilerState.RECORD,
+                           ProfilerState.RECORD_AND_RETURN):
+                    self._maybe_device(True)
+                elif old in (ProfilerState.RECORD,
+                             ProfilerState.RECORD_AND_RETURN):
+                    self._maybe_device(False)
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    # -- reporting ------------------------------------------------------
+    def summary(self, sorted_by=None, op_detail: bool = True,
+                thread_sep: bool = False, time_unit: str = "ms") -> str:
+        unit = {"s": 1.0, "ms": 1e3, "us": 1e6}[time_unit]
+        agg = {}
+        with _events_lock:
+            for name, t0, t1, _ in _events:
+                tot, cnt = agg.get(name, (0.0, 0))
+                agg[name] = (tot + (t1 - t0), cnt + 1)
+        lines = [f"{'Name':<40} {'Calls':>8} {'Total(' + time_unit + ')':>14}"
+                 f" {'Avg(' + time_unit + ')':>12}"]
+        for name, (tot, cnt) in sorted(agg.items(),
+                                       key=lambda kv: -kv[1][0]):
+            lines.append(f"{name[:40]:<40} {cnt:>8} {tot * unit:>14.3f} "
+                         f"{tot * unit / cnt:>12.3f}")
+        if self._step_times:
+            import numpy as np
+
+            st = np.asarray(self._step_times)
+            lines.append(f"steps: {len(st)}  avg "
+                         f"{st.mean() * unit:.3f}{time_unit}  p50 "
+                         f"{np.percentile(st, 50) * unit:.3f}  p99 "
+                         f"{np.percentile(st, 99) * unit:.3f}")
+        out = "\n".join(lines)
+        print(out)
+        return out
+
+    def _export_chrome(self, path: str):
+        with _events_lock:
+            evs = list(_events)
+        base = min((t0 for _, t0, _, _ in evs), default=0.0)
+        trace = {"traceEvents": [
+            {"name": name, "ph": "X", "pid": os.getpid(), "tid": 0,
+             "ts": (t0 - base) * 1e6, "dur": (t1 - t0) * 1e6,
+             "cat": cat}
+            for name, t0, t1, cat in evs]}
+        with open(path, "w") as f:
+            json.dump(trace, f)
+
+    export = _export_chrome
+
+
+def load_profiler_result(path: str):
+    with open(path) as f:
+        return json.load(f)

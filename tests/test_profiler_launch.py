@@ -1,0 +1,113 @@
+"""Profiler, launcher, and AMP debugging tools."""
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_profiler_records_ops_and_exports(tmp_path):
+    import paddle_tpu.profiler as profiler
+
+    x = paddle.to_tensor(np.random.RandomState(0).randn(8, 8)
+                         .astype("float32"))
+    with profiler.Profiler(timer_only=True) as p:
+        with profiler.RecordEvent("user_block"):
+            for _ in range(3):
+                y = paddle.matmul(x, x)
+        p.step()
+        for _ in range(2):
+            y = paddle.matmul(x, x)
+        p.step()
+    out = p.summary()
+    assert "matmul" in out and "user_block" in out
+    trace = str(tmp_path / "trace.json")
+    p._export_chrome(trace)
+    data = json.load(open(trace))
+    names = {e["name"] for e in data["traceEvents"]}
+    assert "matmul" in names and "user_block" in names
+
+
+def test_profiler_scheduler():
+    import paddle_tpu.profiler as profiler
+
+    sched = profiler.make_scheduler(closed=1, ready=1, record=2, repeat=1)
+    states = [sched(i) for i in range(5)]
+    assert states[0] == profiler.ProfilerState.CLOSED
+    assert states[1] == profiler.ProfilerState.READY
+    assert states[2] == profiler.ProfilerState.RECORD
+    assert states[3] == profiler.ProfilerState.RECORD_AND_RETURN
+    assert states[4] == profiler.ProfilerState.CLOSED
+
+
+def test_operator_stats_collection(capsys):
+    from paddle_tpu.amp.debugging import collect_operator_stats
+
+    x = paddle.to_tensor(np.ones((4, 4), "float32"))
+    with collect_operator_stats():
+        paddle.matmul(x, x)
+        paddle.matmul(x, x)
+        x + x
+    out = capsys.readouterr().out
+    assert "matmul" in out and "float32" in out
+
+
+def test_check_numerics():
+    from paddle_tpu.amp.debugging import DebugMode, check_numerics
+
+    good = paddle.to_tensor(np.ones(4, "float32"))
+    assert check_numerics(good) == (0, 0, 4)
+    bad = paddle.to_tensor(np.array([1.0, np.nan, np.inf], "float32"))
+    with pytest.raises(FloatingPointError):
+        check_numerics(bad, "my_op", "x")
+    n_nan, n_inf, n_num = check_numerics(
+        bad, debug_mode=DebugMode.CHECK_NAN_INF)
+    assert (n_nan, n_inf, n_num) == (1, 1, 1)
+
+
+def test_launch_single(tmp_path):
+    script = tmp_path / "train.py"
+    script.write_text(
+        "import os\n"
+        "assert os.environ['PADDLE_TRAINER_ID'] == '0'\n"
+        "assert os.environ['PADDLE_TRAINERS_NUM'] == '1'\n"
+        "print('LAUNCH_OK')\n")
+    r = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         str(script)], capture_output=True, text=True,
+        cwd="/root/repo", timeout=120)
+    assert r.returncode == 0, r.stderr
+    assert "LAUNCH_OK" in r.stdout
+
+
+def test_launch_multiproc_pod(tmp_path):
+    script = tmp_path / "worker.py"
+    script.write_text(
+        "import os, sys\n"
+        "rank = os.environ['PADDLE_TRAINER_ID']\n"
+        "print('RANK', rank, 'of', os.environ['PADDLE_TRAINERS_NUM'])\n")
+    r = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nproc_per_node", "3", "--log_dir", str(tmp_path / "logs"),
+         str(script)], capture_output=True, text=True,
+        cwd="/root/repo", timeout=120)
+    assert r.returncode == 0, r.stderr
+    logs = sorted(os.listdir(tmp_path / "logs"))
+    assert logs == ["workerlog.0", "workerlog.1", "workerlog.2"]
+    content = "".join(open(tmp_path / "logs" / f).read() for f in logs)
+    for i in range(3):
+        assert f"RANK {i} of 3" in content
+
+
+def test_launch_propagates_failure(tmp_path):
+    script = tmp_path / "bad.py"
+    script.write_text("import sys; sys.exit(3)\n")
+    r = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nproc_per_node", "2", str(script)],
+        capture_output=True, text=True, cwd="/root/repo", timeout=120)
+    assert r.returncode == 3
