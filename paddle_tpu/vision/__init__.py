@@ -1,2 +1,4 @@
 """Vision: models/datasets/transforms (reference: python/paddle/vision/)."""
+from . import datasets  # noqa: F401
 from . import models  # noqa: F401
+from . import transforms  # noqa: F401

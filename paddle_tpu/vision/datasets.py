@@ -1,0 +1,164 @@
+"""Vision datasets (reference: python/paddle/vision/datasets/ — MNIST,
+FashionMNIST, Cifar10/100 download-based loaders).
+
+Zero-egress environment: the file-format parsers are kept (idx/ubyte for
+MNIST, the CIFAR pickle batches) so local copies load exactly like the
+reference, and ``FakeData`` provides deterministic synthetic images for
+tests/benchmarks (the reference tests use the same trick).
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import pickle
+import struct
+import tarfile
+from typing import Callable, Optional
+
+import numpy as np
+
+from ..io import Dataset
+
+__all__ = ["MNIST", "FashionMNIST", "Cifar10", "Cifar100", "FakeData"]
+
+
+class FakeData(Dataset):
+    """Deterministic synthetic image classification data."""
+
+    def __init__(self, num_samples: int = 128, image_shape=(3, 32, 32),
+                 num_classes: int = 10, transform: Optional[Callable] = None,
+                 seed: int = 0):
+        rng = np.random.RandomState(seed)
+        self.images = rng.randint(
+            0, 256, (num_samples,) + tuple(image_shape[1:])
+            + (image_shape[0],), dtype=np.uint8)
+        self.labels = rng.randint(0, num_classes,
+                                  (num_samples,)).astype("int64")
+        self.transform = transform
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, self.labels[idx]
+
+    def __len__(self):
+        return len(self.images)
+
+
+class MNIST(Dataset):
+    """idx/ubyte-format MNIST (reference vision/datasets/mnist.py).
+
+    ``image_path``/``label_path`` point at local (optionally .gz) idx
+    files; no downloading in this environment.
+    """
+
+    NAME = "mnist"
+
+    def __init__(self, image_path: Optional[str] = None,
+                 label_path: Optional[str] = None, mode: str = "train",
+                 transform: Optional[Callable] = None,
+                 download: bool = False, backend=None):
+        if image_path is None or label_path is None:
+            raise ValueError(
+                f"{type(self).__name__}: pass local image_path/label_path "
+                "(idx/ubyte, optionally .gz) — downloading is disabled in "
+                "this environment; use FakeData for synthetic runs")
+        self.images = self._read_images(image_path)
+        self.labels = self._read_labels(label_path)
+        self.transform = transform
+
+    @staticmethod
+    def _open(path):
+        return gzip.open(path, "rb") if path.endswith(".gz") else \
+            open(path, "rb")
+
+    def _read_images(self, path):
+        with self._open(path) as f:
+            magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+            if magic != 2051:
+                raise ValueError(f"bad idx image magic {magic}")
+            data = np.frombuffer(f.read(n * rows * cols), dtype=np.uint8)
+            return data.reshape(n, rows, cols)
+
+    def _read_labels(self, path):
+        with self._open(path) as f:
+            magic, n = struct.unpack(">II", f.read(8))
+            if magic != 2049:
+                raise ValueError(f"bad idx label magic {magic}")
+            return np.frombuffer(f.read(n), dtype=np.uint8).astype("int64")
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, self.labels[idx]
+
+    def __len__(self):
+        return len(self.images)
+
+
+class FashionMNIST(MNIST):
+    NAME = "fashion-mnist"
+
+
+class _CifarBase(Dataset):
+    """CIFAR pickle-batch format (reference vision/datasets/cifar.py)."""
+
+    _coarse = False
+
+    def __init__(self, data_file: Optional[str] = None, mode: str = "train",
+                 transform: Optional[Callable] = None,
+                 download: bool = False, backend=None):
+        if data_file is None:
+            raise ValueError(
+                f"{type(self).__name__}: pass a local data_file "
+                "(cifar tar.gz or a batch pickle) — downloading is "
+                "disabled; use FakeData for synthetic runs")
+        images, labels = [], []
+        label_key = self._label_key(mode)
+        if data_file.endswith((".tar.gz", ".tgz", ".tar")):
+            with tarfile.open(data_file) as tf:
+                for m in tf.getmembers():
+                    if self._want_member(m.name, mode):
+                        d = pickle.load(tf.extractfile(m),
+                                        encoding="bytes")
+                        images.append(d[b"data"])
+                        labels.extend(d[label_key])
+        else:
+            with open(data_file, "rb") as f:
+                d = pickle.load(f, encoding="bytes")
+            images.append(d[b"data"])
+            labels.extend(d[label_key])
+        data = np.concatenate(images).reshape(-1, 3, 32, 32)
+        self.images = data.transpose(0, 2, 3, 1)  # HWC like reference
+        self.labels = np.asarray(labels, dtype="int64")
+        self.transform = transform
+
+    def _want_member(self, name, mode):
+        base = os.path.basename(name)
+        if mode == "train":
+            return base.startswith("data_batch") or base == "train"
+        return base.startswith("test_batch") or base == "test"
+
+    def _label_key(self, mode):
+        return b"coarse_labels" if self._coarse else (
+            b"labels" if not self._coarse else b"labels")
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, self.labels[idx]
+
+    def __len__(self):
+        return len(self.images)
+
+
+class Cifar10(_CifarBase):
+    pass
+
+
+class Cifar100(_CifarBase):
+    def _label_key(self, mode):
+        return b"fine_labels"

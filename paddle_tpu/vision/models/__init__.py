@@ -1,5 +1,9 @@
+from .lenet import LeNet  # noqa: F401
+from .mobilenetv2 import MobileNetV2, mobilenet_v2  # noqa: F401
 from .resnet import (ResNet, resnet18, resnet34, resnet50, resnet101,
                      resnet152)  # noqa: F401
+from .vgg import VGG, vgg11, vgg13, vgg16, vgg19  # noqa: F401
 
 __all__ = ["ResNet", "resnet18", "resnet34", "resnet50", "resnet101",
-           "resnet152"]
+           "resnet152", "LeNet", "VGG", "vgg11", "vgg13", "vgg16",
+           "vgg19", "MobileNetV2", "mobilenet_v2"]

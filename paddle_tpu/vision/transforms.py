@@ -1,0 +1,232 @@
+"""Image transforms (reference: python/paddle/vision/transforms/
+transforms.py + functional.py — Compose, Resize, Normalize, crops/flips,
+ToTensor). Numpy/ndarray based (HWC uint8 in, like the reference's
+'cv2'/'pil' backends); ToTensor produces CHW float Tensors.
+"""
+from __future__ import annotations
+
+import numbers
+import random
+from typing import List, Sequence
+
+import numpy as np
+
+from ..tensor import Tensor
+
+__all__ = [
+    "Compose", "BaseTransform", "ToTensor", "Normalize", "Resize",
+    "CenterCrop", "RandomCrop", "RandomHorizontalFlip",
+    "RandomVerticalFlip", "Transpose", "Pad", "to_tensor", "normalize",
+    "resize", "hflip", "vflip", "center_crop", "crop", "pad",
+]
+
+
+def _as_hwc(img) -> np.ndarray:
+    arr = np.asarray(img)
+    if arr.ndim == 2:
+        arr = arr[:, :, None]
+    return arr
+
+
+def resize(img, size, interpolation="bilinear") -> np.ndarray:
+    """Nearest/bilinear resize with pure numpy (no cv2/PIL dependency)."""
+    arr = _as_hwc(img)
+    h, w = arr.shape[:2]
+    if isinstance(size, numbers.Number):
+        # shorter side → size, keep aspect (reference semantics)
+        if h <= w:
+            oh, ow = int(size), max(1, int(round(w * size / h)))
+        else:
+            oh, ow = max(1, int(round(h * size / w))), int(size)
+    else:
+        oh, ow = size
+    if (oh, ow) == (h, w):
+        return arr
+    if interpolation == "nearest":
+        ry = (np.arange(oh) * h / oh).astype(int).clip(0, h - 1)
+        rx = (np.arange(ow) * w / ow).astype(int).clip(0, w - 1)
+        return arr[ry][:, rx]
+    # bilinear
+    y = (np.arange(oh) + 0.5) * h / oh - 0.5
+    x = (np.arange(ow) + 0.5) * w / ow - 0.5
+    y0 = np.clip(np.floor(y).astype(int), 0, h - 1)
+    x0 = np.clip(np.floor(x).astype(int), 0, w - 1)
+    y1 = np.clip(y0 + 1, 0, h - 1)
+    x1 = np.clip(x0 + 1, 0, w - 1)
+    wy = np.clip(y - y0, 0, 1)[:, None, None]
+    wx = np.clip(x - x0, 0, 1)[None, :, None]
+    a = arr.astype(np.float32)
+    out = (a[y0][:, x0] * (1 - wy) * (1 - wx) + a[y1][:, x0] * wy * (1 - wx)
+           + a[y0][:, x1] * (1 - wy) * wx + a[y1][:, x1] * wy * wx)
+    return out.astype(arr.dtype) if np.issubdtype(arr.dtype, np.integer) \
+        else out
+
+
+def crop(img, top, left, height, width) -> np.ndarray:
+    return _as_hwc(img)[top:top + height, left:left + width]
+
+
+def center_crop(img, output_size) -> np.ndarray:
+    arr = _as_hwc(img)
+    if isinstance(output_size, numbers.Number):
+        output_size = (int(output_size), int(output_size))
+    h, w = arr.shape[:2]
+    th, tw = output_size
+    return crop(arr, max(0, (h - th) // 2), max(0, (w - tw) // 2), th, tw)
+
+
+def hflip(img) -> np.ndarray:
+    return _as_hwc(img)[:, ::-1]
+
+
+def vflip(img) -> np.ndarray:
+    return _as_hwc(img)[::-1]
+
+
+def pad(img, padding, fill=0, padding_mode="constant") -> np.ndarray:
+    arr = _as_hwc(img)
+    if isinstance(padding, numbers.Number):
+        pl = pr = pt = pb = int(padding)
+    elif len(padding) == 2:
+        pl, pt = padding
+        pr, pb = padding
+    else:
+        pl, pt, pr, pb = padding
+    mode = {"constant": "constant", "edge": "edge",
+            "reflect": "reflect", "symmetric": "symmetric"}[padding_mode]
+    kw = {"constant_values": fill} if mode == "constant" else {}
+    return np.pad(arr, ((pt, pb), (pl, pr), (0, 0)), mode=mode, **kw)
+
+
+def to_tensor(img, data_format="CHW") -> Tensor:
+    arr = _as_hwc(img).astype(np.float32)
+    if arr.dtype == np.float32 and arr.max() > 1.5:
+        arr = arr / 255.0
+    if data_format == "CHW":
+        arr = arr.transpose(2, 0, 1)
+    return Tensor(__import__("jax.numpy", fromlist=["asarray"])
+                  .asarray(arr))
+
+
+def normalize(img, mean, std, data_format="CHW", to_rgb=False):
+    if isinstance(img, Tensor):
+        import jax.numpy as jnp
+
+        mean_a = jnp.asarray(mean, jnp.float32)
+        std_a = jnp.asarray(std, jnp.float32)
+        shape = (-1, 1, 1) if data_format == "CHW" else (1, 1, -1)
+        return Tensor((img._value - mean_a.reshape(shape))
+                      / std_a.reshape(shape))
+    arr = np.asarray(img, np.float32)
+    shape = (-1, 1, 1) if data_format == "CHW" else (1, 1, -1)
+    return (arr - np.reshape(mean, shape)) / np.reshape(std, shape)
+
+
+class BaseTransform:
+    def __call__(self, img):
+        return self._apply_image(img)
+
+    def _apply_image(self, img):
+        raise NotImplementedError
+
+
+class Compose:
+    def __init__(self, transforms: Sequence):
+        self.transforms = list(transforms)
+
+    def __call__(self, img):
+        for t in self.transforms:
+            img = t(img)
+        return img
+
+
+class ToTensor(BaseTransform):
+    def __init__(self, data_format="CHW", keys=None):
+        self.data_format = data_format
+
+    def _apply_image(self, img):
+        return to_tensor(img, self.data_format)
+
+
+class Normalize(BaseTransform):
+    def __init__(self, mean=0.0, std=1.0, data_format="CHW",
+                 to_rgb=False, keys=None):
+        if isinstance(mean, numbers.Number):
+            mean = [mean] * 3
+        if isinstance(std, numbers.Number):
+            std = [std] * 3
+        self.mean, self.std = mean, std
+        self.data_format = data_format
+
+    def _apply_image(self, img):
+        return normalize(img, self.mean, self.std, self.data_format)
+
+
+class Resize(BaseTransform):
+    def __init__(self, size, interpolation="bilinear", keys=None):
+        self.size = size
+        self.interpolation = interpolation
+
+    def _apply_image(self, img):
+        return resize(img, self.size, self.interpolation)
+
+
+class CenterCrop(BaseTransform):
+    def __init__(self, size, keys=None):
+        self.size = size
+
+    def _apply_image(self, img):
+        return center_crop(img, self.size)
+
+
+class RandomCrop(BaseTransform):
+    def __init__(self, size, padding=None, pad_if_needed=False, fill=0,
+                 padding_mode="constant", keys=None):
+        self.size = (int(size), int(size)) if isinstance(
+            size, numbers.Number) else tuple(size)
+        self.padding = padding
+        self.fill = fill
+        self.padding_mode = padding_mode
+
+    def _apply_image(self, img):
+        arr = _as_hwc(img)
+        if self.padding is not None:
+            arr = pad(arr, self.padding, self.fill, self.padding_mode)
+        h, w = arr.shape[:2]
+        th, tw = self.size
+        top = random.randint(0, max(0, h - th))
+        left = random.randint(0, max(0, w - tw))
+        return crop(arr, top, left, th, tw)
+
+
+class RandomHorizontalFlip(BaseTransform):
+    def __init__(self, prob=0.5, keys=None):
+        self.prob = prob
+
+    def _apply_image(self, img):
+        return hflip(img) if random.random() < self.prob else _as_hwc(img)
+
+
+class RandomVerticalFlip(BaseTransform):
+    def __init__(self, prob=0.5, keys=None):
+        self.prob = prob
+
+    def _apply_image(self, img):
+        return vflip(img) if random.random() < self.prob else _as_hwc(img)
+
+
+class Transpose(BaseTransform):
+    def __init__(self, order=(2, 0, 1), keys=None):
+        self.order = order
+
+    def _apply_image(self, img):
+        return _as_hwc(img).transpose(self.order)
+
+
+class Pad(BaseTransform):
+    def __init__(self, padding, fill=0, padding_mode="constant", keys=None):
+        self.padding, self.fill = padding, fill
+        self.padding_mode = padding_mode
+
+    def _apply_image(self, img):
+        return pad(img, self.padding, self.fill, self.padding_mode)

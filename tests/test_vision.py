@@ -1,0 +1,109 @@
+"""Vision transforms/datasets/models (reference test/legacy_test
+vision tests; numeric checks vs numpy references)."""
+import gzip
+import pickle
+import struct
+import tarfile
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.vision import datasets, transforms as T
+from paddle_tpu.vision.models import (LeNet, MobileNetV2, mobilenet_v2,
+                                      vgg11)
+
+
+def test_transforms_pipeline():
+    img = np.random.RandomState(0).randint(0, 256, (40, 60, 3),
+                                           dtype=np.uint8)
+    tr = T.Compose([T.Resize(32), T.CenterCrop(32), T.ToTensor(),
+                    T.Normalize(mean=[0.5, 0.5, 0.5], std=[0.5, 0.5, 0.5])])
+    out = tr(img)
+    assert out.shape == [3, 32, 32]
+    v = np.asarray(out._value)
+    assert v.min() >= -1.001 and v.max() <= 1.001
+
+
+def test_resize_semantics():
+    img = np.zeros((10, 20, 3), np.uint8)
+    assert T.resize(img, 5).shape == (5, 10, 3)       # short side
+    assert T.resize(img, (7, 9)).shape == (7, 9, 3)   # explicit
+    assert T.resize(img, (7, 9), "nearest").shape == (7, 9, 3)
+
+
+def test_random_transforms_shapes():
+    img = np.random.RandomState(1).randint(0, 256, (36, 36, 3),
+                                           dtype=np.uint8)
+    assert T.RandomCrop(32)(img).shape == (32, 32, 3)
+    assert T.RandomHorizontalFlip(1.0)(img).shape == (36, 36, 3)
+    np.testing.assert_array_equal(T.RandomHorizontalFlip(1.0)(img),
+                                  img[:, ::-1])
+    assert T.Pad(2)(img).shape == (40, 40, 3)
+
+
+def test_mnist_idx_parser(tmp_path):
+    rng = np.random.RandomState(0)
+    imgs = rng.randint(0, 256, (5, 28, 28), dtype=np.uint8)
+    lbls = rng.randint(0, 10, (5,)).astype(np.uint8)
+    ip = tmp_path / "imgs.gz"
+    lp = tmp_path / "lbls.gz"
+    with gzip.open(ip, "wb") as f:
+        f.write(struct.pack(">IIII", 2051, 5, 28, 28))
+        f.write(imgs.tobytes())
+    with gzip.open(lp, "wb") as f:
+        f.write(struct.pack(">II", 2049, 5))
+        f.write(lbls.tobytes())
+    ds = datasets.MNIST(image_path=str(ip), label_path=str(lp))
+    assert len(ds) == 5
+    img, lbl = ds[2]
+    np.testing.assert_array_equal(img, imgs[2])
+    assert lbl == lbls[2]
+
+
+def test_cifar_pickle_parser(tmp_path):
+    rng = np.random.RandomState(1)
+    data = rng.randint(0, 256, (4, 3 * 32 * 32), dtype=np.uint8)
+    batch = {b"data": data, b"labels": [0, 1, 2, 3]}
+    p = tmp_path / "test_batch"
+    with open(p, "wb") as f:
+        pickle.dump(batch, f)
+    ds = datasets.Cifar10(data_file=str(p), mode="test")
+    assert len(ds) == 4
+    img, lbl = ds[1]
+    assert img.shape == (32, 32, 3) and lbl == 1
+
+
+def test_fakedata_with_loader():
+    ds = datasets.FakeData(num_samples=16, image_shape=(1, 28, 28),
+                           num_classes=10, transform=T.Compose(
+                               [T.ToTensor()]))
+    from paddle_tpu.io import DataLoader
+
+    batch = next(iter(DataLoader(ds, batch_size=4)))
+    assert batch[0].shape == [4, 1, 28, 28]
+    assert batch[1].shape == [4]
+
+
+def test_lenet_trains():
+    paddle.seed(0)
+    model = LeNet()
+    x = paddle.to_tensor(np.random.RandomState(0).randn(2, 1, 28, 28)
+                         .astype("float32"))
+    out = model(x)
+    assert out.shape == [2, 10]
+    loss = paddle.mean(out ** 2)
+    loss.backward()
+    assert model.features[0].weight.grad is not None
+
+
+def test_vgg_and_mobilenet_forward():
+    paddle.seed(1)
+    x = paddle.to_tensor(np.random.RandomState(2).randn(1, 3, 32, 32)
+                         .astype("float32"))
+    v = vgg11(num_classes=7, with_pool=True)
+    # 32x32 input → features 1x1; adaptive pool to 7x7 upsamples
+    assert v(x).shape == [1, 7]
+    m = mobilenet_v2(num_classes=5)
+    m.eval()
+    assert m(x).shape == [1, 5]
