@@ -35,6 +35,7 @@ from . import metric  # noqa: F401
 from . import distributed  # noqa: F401
 from . import hapi  # noqa: F401
 from . import profiler  # noqa: F401
+from . import static  # noqa: F401
 from .hapi import Model  # noqa: F401
 
 # paddle-API aliases

@@ -159,14 +159,106 @@ def not_to_static(fn):
 
 
 def save(layer, path, input_spec=None, **configs):
-    """Persist a Layer's state (TranslatedLayer-style save: state only; the
-    program is re-traced at load — XLA recompiles from the same python)."""
-    from ..framework.io import save as fsave
+    """Export a deployable inference artifact (reference: jit/api.py
+    ``paddle.jit.save`` → TranslatedLayer program + params; C++
+    jit::Layer loads it without Python).
 
+    TPU-native format: ``path + '.pdmodel'`` holds the serialized
+    StableHLO export of the traced forward (jax.export — loadable with
+    NO model code), ``path + '.pdparams'`` the state_dict. ``input_spec``
+    (paddle.static.InputSpec list) fixes the signature; ``None`` dims
+    become symbolic so the exported program accepts any batch size.
+    """
+    import os
+
+    from ..framework.io import save as fsave
+    from ..static import InputSpec
+
+    enforce_layer = isinstance(layer, Layer)
+    if not enforce_layer:
+        raise TypeError("jit.save expects a Layer")
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
     fsave(layer.state_dict(), path + ".pdparams")
+
+    if input_spec is None:
+        fn = getattr(layer, "_traced_call", None)
+        raise ValueError(
+            "jit.save needs input_spec=[InputSpec(shape, dtype), ...] "
+            "to export the program (None dims = dynamic)")
+
+    params = list(layer.parameters())
+    buffers = list(layer.buffers())
+    state = params + buffers
+    from ..distributed.engine import bind_params
+    from ..autograd import no_grad
+
+    def pure(state_vals, *inputs):
+        with no_grad(), bind_params(state, state_vals):
+            out = layer(*[Tensor(i, stop_gradient=True) for i in inputs])
+        return jax.tree_util.tree_map(
+            _unwrap, out, is_leaf=lambda x: isinstance(x, Tensor))
+
+    # symbolic dims for None entries (dynamic batch)
+    sym_names = iter("bcdefghij")
+    scopes = jax.export.SymbolicScope()
+    in_specs = []
+    for spec in input_spec:
+        if not isinstance(spec, InputSpec):
+            spec = InputSpec.from_tensor(spec)
+        dims = []
+        for dim in spec.shape:
+            if dim is None or (isinstance(dim, int) and dim < 0):
+                dims.append(jax.export.symbolic_shape(
+                    next(sym_names), scope=scopes)[0])
+            else:
+                dims.append(dim)
+        in_specs.append(jax.ShapeDtypeStruct(tuple(dims), spec.dtype))
+    state_specs = [jax.ShapeDtypeStruct(v._value.shape, v._value.dtype)
+                   for v in state]
+    exported = jax.export.export(jax.jit(pure))(state_specs, *in_specs)
+    with open(path + ".pdmodel", "wb") as f:
+        f.write(exported.serialize())
+
+
+class TranslatedLayer(Layer):
+    """A loaded inference program (reference: TranslatedLayer in
+    jit/translated_layer.py; C++ jit::Layer). Executes the serialized
+    StableHLO export — no original model code needed."""
+
+    def __init__(self, exported, state_dict):
+        super().__init__()
+        self._exported = exported
+        # keep insertion order: params first, buffers after (matches save)
+        self._state_vals = [v._value if isinstance(v, Tensor) else
+                            jnp.asarray(v) for v in state_dict.values()]
+        self._state_keys = list(state_dict.keys())
+        for k, v in state_dict.items():
+            self.add_parameter(k.replace(".", "__"),
+                               Parameter(v._value if isinstance(v, Tensor)
+                                         else jnp.asarray(v),
+                                         trainable=False))
+
+    def forward(self, *inputs):
+        vals = [i._value if isinstance(i, Tensor) else jnp.asarray(i)
+                for i in inputs]
+        out = self._exported.call(self._state_vals, *vals)
+        return jax.tree_util.tree_map(
+            lambda v: Tensor(v, stop_gradient=True), out)
 
 
 def load(path, **configs):
+    """Load a jit.save artifact as a callable TranslatedLayer; falls back
+    to returning the raw state_dict when only params were saved."""
+    import os
+
     from ..framework.io import load as fload
 
-    return fload(path + ".pdparams")
+    state = fload(path + ".pdparams")
+    model_file = path + ".pdmodel"
+    if not os.path.exists(model_file):
+        return state
+    with open(model_file, "rb") as f:
+        exported = jax.export.deserialize(bytearray(f.read()))
+    return TranslatedLayer(exported, state)

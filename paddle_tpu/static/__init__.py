@@ -1,0 +1,100 @@
+"""Static-graph compatibility surface (reference: python/paddle/static/).
+
+The reference's static mode builds a ProgramDesc executed by the C++
+interpreter (SURVEY.md §3.4); here "static" IS jax.jit tracing, so this
+module provides the declarative pieces programs are written against —
+InputSpec for signatures — plus thin Program/Executor shims that map the
+classic ``paddle.static`` training-script shape onto traced execution.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+from ..core.dtype import convert_dtype
+
+__all__ = ["InputSpec", "Program", "default_main_program",
+           "default_startup_program", "program_guard", "Executor",
+           "name_scope"]
+
+
+class InputSpec:
+    """Signature element (reference: paddle/static/input.py InputSpec).
+    ``None`` dims become symbolic (dynamic batch) on export."""
+
+    def __init__(self, shape: Sequence[Optional[int]], dtype="float32",
+                 name: Optional[str] = None, stop_gradient: bool = True):
+        self.shape = tuple(shape)
+        self.dtype = convert_dtype(dtype)
+        self.name = name
+        self.stop_gradient = stop_gradient
+
+    @classmethod
+    def from_tensor(cls, tensor, name=None):
+        return cls(tuple(tensor.shape), str(tensor.dtype), name)
+
+    @classmethod
+    def from_numpy(cls, ndarray, name=None):
+        return cls(ndarray.shape, str(ndarray.dtype), name)
+
+    def __repr__(self):
+        return (f"InputSpec(shape={self.shape}, dtype={self.dtype}, "
+                f"name={self.name})")
+
+
+class Program:
+    """Placeholder program object (graphs are implicit under jit)."""
+
+    def __init__(self):
+        self._ops = []
+
+    def global_block(self):
+        return self
+
+    def clone(self, for_test: bool = False):
+        return self
+
+
+_main = Program()
+_startup = Program()
+
+
+def default_main_program() -> Program:
+    return _main
+
+
+def default_startup_program() -> Program:
+    return _startup
+
+
+import contextlib  # noqa: E402
+
+
+@contextlib.contextmanager
+def program_guard(main_program, startup_program=None):
+    yield
+
+
+@contextlib.contextmanager
+def name_scope(prefix: str = ""):
+    yield
+
+
+class Executor:
+    """Minimal Executor shim (reference base/executor.py:1162): ``run``
+    calls a compiled callable registered as the fetch target."""
+
+    def __init__(self, place=None):
+        self.place = place
+
+    def run(self, program=None, feed=None, fetch_list=None, **kw):
+        if callable(program):
+            out = program(**(feed or {}))
+            return [np.asarray(getattr(o, "_value", o))
+                    for o in (out if isinstance(out, (list, tuple))
+                              else [out])]
+        raise NotImplementedError(
+            "static Program execution is implicit under jit in this "
+            "framework; pass a compiled callable (paddle.jit.to_static) "
+            "or use the eager/hapi APIs")

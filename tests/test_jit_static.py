@@ -1,0 +1,57 @@
+"""jit.save/load StableHLO export + static InputSpec
+(reference: TranslatedLayer save/load tests in test/dygraph_to_static)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.static import InputSpec
+
+
+def _net():
+    return paddle.nn.Sequential(paddle.nn.Linear(8, 16), paddle.nn.ReLU(),
+                                paddle.nn.Linear(16, 4))
+
+
+def test_jit_save_load_exported_program(tmp_path):
+    paddle.seed(0)
+    net = _net()
+    net.eval()
+    x = np.random.RandomState(0).randn(3, 8).astype("float32")
+    ref = np.asarray(net(paddle.to_tensor(x))._value)
+
+    p = str(tmp_path / "m" / "infer")
+    paddle.jit.save(net, p, input_spec=[InputSpec([None, 8], "float32")])
+
+    loaded = paddle.jit.load(p)
+    out = loaded(paddle.to_tensor(x))
+    np.testing.assert_allclose(np.asarray(out._value), ref, rtol=1e-5,
+                               atol=1e-6)
+    # dynamic batch: a different batch size runs through the same program
+    x2 = np.random.RandomState(1).randn(7, 8).astype("float32")
+    out2 = loaded(paddle.to_tensor(x2))
+    np.testing.assert_allclose(np.asarray(out2._value),
+                               np.asarray(net(paddle.to_tensor(x2))._value),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_jit_save_requires_spec(tmp_path):
+    with pytest.raises(ValueError):
+        paddle.jit.save(_net(), str(tmp_path / "x"))
+
+
+def test_input_spec_helpers():
+    t = paddle.to_tensor(np.zeros((2, 3), "float32"))
+    s = InputSpec.from_tensor(t)
+    assert s.shape == (2, 3)
+    s2 = InputSpec.from_numpy(np.zeros((4, 5), "int64"))
+    assert str(s2.dtype) == "int64"
+
+
+def test_static_executor_shim():
+    ex = paddle.static.Executor()
+    net = _net()
+    compiled = paddle.jit.to_static(net)
+    out = ex.run(lambda x: compiled(x),
+                 feed={"x": paddle.to_tensor(
+                     np.zeros((2, 8), "float32"))})
+    assert out[0].shape == (2, 4)
