@@ -1,0 +1,374 @@
+"""Probability distributions (reference: python/paddle/distribution/ —
+Distribution base, Normal, Uniform, Categorical, Bernoulli, Beta,
+Dirichlet, Multinomial, kl_divergence registry).
+
+Sampling draws from the framework's global RNG (core/rng) so
+paddle.seed governs reproducibility, and every density is a jnp
+expression — usable inside compiled steps (policy-gradient losses etc.).
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+import jax.numpy as jnp
+
+from ..core import rng as _rng
+from ..tensor import Tensor, to_tensor
+
+__all__ = ["Distribution", "Normal", "Uniform", "Categorical", "Bernoulli",
+           "Beta", "Dirichlet", "Multinomial", "Exponential", "Gumbel",
+           "Laplace", "LogNormal", "kl_divergence",
+           "register_kl"]
+
+
+def _val(x):
+    if isinstance(x, Tensor):
+        return x._value
+    return jnp.asarray(x, jnp.float32)
+
+
+def _key():
+    return _rng.get_key()
+
+
+class Distribution:
+    def __init__(self, batch_shape=(), event_shape=()):
+        self._batch_shape = tuple(batch_shape)
+        self._event_shape = tuple(event_shape)
+
+    @property
+    def batch_shape(self):
+        return self._batch_shape
+
+    @property
+    def event_shape(self):
+        return self._event_shape
+
+    def sample(self, shape=()):
+        raise NotImplementedError
+
+    def rsample(self, shape=()):
+        return self.sample(shape)
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def prob(self, value):
+        return Tensor(jnp.exp(self.log_prob(value)._value))
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def kl_divergence(self, other):
+        return kl_divergence(self, other)
+
+
+class Normal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _val(loc)
+        self.scale = _val(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape,
+                                              self.scale.shape))
+
+    def sample(self, shape=()):
+        shp = tuple(shape) + self._batch_shape
+        eps = jax.random.normal(_key(), shp)
+        return Tensor(self.loc + self.scale * eps)
+
+    rsample = sample
+
+    def log_prob(self, value):
+        v = _val(value)
+        var = self.scale ** 2
+        return Tensor(-((v - self.loc) ** 2) / (2 * var)
+                      - jnp.log(self.scale) - 0.5 * math.log(2 * math.pi))
+
+    def entropy(self):
+        return Tensor(0.5 + 0.5 * math.log(2 * math.pi)
+                      + jnp.log(self.scale)
+                      + jnp.zeros(self._batch_shape))
+
+    @property
+    def mean(self):
+        return Tensor(jnp.broadcast_to(self.loc, self._batch_shape))
+
+    @property
+    def variance(self):
+        return Tensor(jnp.broadcast_to(self.scale ** 2, self._batch_shape))
+
+
+class LogNormal(Normal):
+    def sample(self, shape=()):
+        return Tensor(jnp.exp(super().sample(shape)._value))
+
+    def log_prob(self, value):
+        v = _val(value)
+        lv = jnp.log(v)
+        base = super().log_prob(Tensor(lv))._value
+        return Tensor(base - lv)
+
+
+class Uniform(Distribution):
+    def __init__(self, low, high, name=None):
+        self.low = _val(low)
+        self.high = _val(high)
+        super().__init__(jnp.broadcast_shapes(self.low.shape,
+                                              self.high.shape))
+
+    def sample(self, shape=()):
+        shp = tuple(shape) + self._batch_shape
+        u = jax.random.uniform(_key(), shp)
+        return Tensor(self.low + (self.high - self.low) * u)
+
+    def log_prob(self, value):
+        v = _val(value)
+        inside = (v >= self.low) & (v < self.high)
+        lp = -jnp.log(self.high - self.low)
+        return Tensor(jnp.where(inside, lp, -jnp.inf))
+
+    def entropy(self):
+        return Tensor(jnp.log(self.high - self.low)
+                      + jnp.zeros(self._batch_shape))
+
+
+class Categorical(Distribution):
+    def __init__(self, logits=None, probs=None, name=None):
+        if logits is None and probs is None:
+            raise ValueError("need logits or probs")
+        if logits is not None and probs is None:
+            self.logits = _val(logits)
+        else:
+            self.logits = jnp.log(jnp.clip(_val(probs), 1e-30))
+        self._probs = jax.nn.softmax(self.logits, -1)
+        super().__init__(self.logits.shape[:-1])
+
+    @property
+    def probs(self):
+        return Tensor(self._probs)
+
+    def sample(self, shape=()):
+        shp = tuple(shape) + self._batch_shape
+        return Tensor(jax.random.categorical(
+            _key(), self.logits, axis=-1, shape=shp))
+
+    def log_prob(self, value):
+        v = _val(value).astype(jnp.int32)
+        logp = jax.nn.log_softmax(self.logits, -1)
+        return Tensor(jnp.take_along_axis(
+            logp, v[..., None], axis=-1)[..., 0])
+
+    def entropy(self):
+        logp = jax.nn.log_softmax(self.logits, -1)
+        return Tensor(-jnp.sum(self._probs * logp, -1))
+
+
+class Bernoulli(Distribution):
+    def __init__(self, probs=None, logits=None, name=None):
+        if probs is not None:
+            self._probs = _val(probs)
+            self.logits = jnp.log(self._probs / (1 - self._probs))
+        else:
+            self.logits = _val(logits)
+            self._probs = jax.nn.sigmoid(self.logits)
+        super().__init__(self._probs.shape)
+
+    @property
+    def probs(self):
+        return Tensor(self._probs)
+
+    def sample(self, shape=()):
+        shp = tuple(shape) + self._batch_shape
+        return Tensor(jax.random.bernoulli(
+            _key(), self._probs, shape=shp).astype(jnp.float32))
+
+    def log_prob(self, value):
+        v = _val(value)
+        p = jnp.clip(self._probs, 1e-7, 1 - 1e-7)
+        return Tensor(v * jnp.log(p) + (1 - v) * jnp.log1p(-p))
+
+    def entropy(self):
+        p = jnp.clip(self._probs, 1e-7, 1 - 1e-7)
+        return Tensor(-(p * jnp.log(p) + (1 - p) * jnp.log1p(-p)))
+
+
+class Beta(Distribution):
+    def __init__(self, alpha, beta):
+        self.alpha = _val(alpha)
+        self.beta = _val(beta)
+        super().__init__(jnp.broadcast_shapes(self.alpha.shape,
+                                              self.beta.shape))
+
+    def sample(self, shape=()):
+        shp = tuple(shape) + self._batch_shape
+        return Tensor(jax.random.beta(_key(), self.alpha, self.beta, shp))
+
+    def log_prob(self, value):
+        v = _val(value)
+        from jax.scipy.special import betaln
+
+        return Tensor((self.alpha - 1) * jnp.log(v)
+                      + (self.beta - 1) * jnp.log1p(-v)
+                      - betaln(self.alpha, self.beta))
+
+    @property
+    def mean(self):
+        return Tensor(self.alpha / (self.alpha + self.beta))
+
+    def entropy(self):
+        from jax.scipy.special import betaln, digamma
+
+        a, b = self.alpha, self.beta
+        return Tensor(betaln(a, b) - (a - 1) * digamma(a)
+                      - (b - 1) * digamma(b)
+                      + (a + b - 2) * digamma(a + b))
+
+
+class Dirichlet(Distribution):
+    def __init__(self, concentration):
+        self.concentration = _val(concentration)
+        super().__init__(self.concentration.shape[:-1],
+                         self.concentration.shape[-1:])
+
+    def sample(self, shape=()):
+        shp = tuple(shape) + self._batch_shape
+        return Tensor(jax.random.dirichlet(
+            _key(), self.concentration, shp))
+
+    def log_prob(self, value):
+        from jax.scipy.special import gammaln
+
+        v = _val(value)
+        a = self.concentration
+        return Tensor(jnp.sum((a - 1) * jnp.log(v), -1)
+                      + gammaln(jnp.sum(a, -1)) - jnp.sum(gammaln(a), -1))
+
+
+class Multinomial(Distribution):
+    def __init__(self, total_count, probs):
+        self.total_count = int(total_count)
+        self._probs = _val(probs)
+        super().__init__(self._probs.shape[:-1], self._probs.shape[-1:])
+
+    def sample(self, shape=()):
+        n = self._probs.shape[-1]
+        shp = tuple(shape) + self._batch_shape
+        draws = jax.random.categorical(
+            _key(), jnp.log(jnp.clip(self._probs, 1e-30)), axis=-1,
+            shape=(self.total_count,) + shp)
+        counts = jax.nn.one_hot(draws, n).sum(0)
+        return Tensor(counts)
+
+    def log_prob(self, value):
+        from jax.scipy.special import gammaln
+
+        v = _val(value)
+        logp = jnp.log(jnp.clip(self._probs, 1e-30))
+        return Tensor(gammaln(self.total_count + 1.0)
+                      - jnp.sum(gammaln(v + 1.0), -1)
+                      + jnp.sum(v * logp, -1))
+
+
+class Exponential(Distribution):
+    def __init__(self, rate):
+        self.rate = _val(rate)
+        super().__init__(self.rate.shape)
+
+    def sample(self, shape=()):
+        shp = tuple(shape) + self._batch_shape
+        return Tensor(jax.random.exponential(_key(), shp) / self.rate)
+
+    def log_prob(self, value):
+        v = _val(value)
+        return Tensor(jnp.log(self.rate) - self.rate * v)
+
+    def entropy(self):
+        return Tensor(1.0 - jnp.log(self.rate))
+
+
+class Gumbel(Distribution):
+    def __init__(self, loc, scale):
+        self.loc = _val(loc)
+        self.scale = _val(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape,
+                                              self.scale.shape))
+
+    def sample(self, shape=()):
+        shp = tuple(shape) + self._batch_shape
+        return Tensor(self.loc + self.scale
+                      * jax.random.gumbel(_key(), shp))
+
+    def log_prob(self, value):
+        z = (_val(value) - self.loc) / self.scale
+        return Tensor(-(z + jnp.exp(-z)) - jnp.log(self.scale))
+
+
+class Laplace(Distribution):
+    def __init__(self, loc, scale):
+        self.loc = _val(loc)
+        self.scale = _val(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape,
+                                              self.scale.shape))
+
+    def sample(self, shape=()):
+        shp = tuple(shape) + self._batch_shape
+        return Tensor(self.loc + self.scale
+                      * jax.random.laplace(_key(), shp))
+
+    def log_prob(self, value):
+        v = _val(value)
+        return Tensor(-jnp.abs(v - self.loc) / self.scale
+                      - jnp.log(2 * self.scale))
+
+    def entropy(self):
+        return Tensor(1.0 + jnp.log(2 * self.scale))
+
+
+# -- KL divergence registry ----------------------------------------------
+
+_KL_REGISTRY = {}
+
+
+def register_kl(p_cls, q_cls):
+    def deco(fn):
+        _KL_REGISTRY[(p_cls, q_cls)] = fn
+        return fn
+
+    return deco
+
+
+def kl_divergence(p: Distribution, q: Distribution) -> Tensor:
+    fn = _KL_REGISTRY.get((type(p), type(q)))
+    if fn is None:
+        raise NotImplementedError(
+            f"KL({type(p).__name__} || {type(q).__name__}) not registered")
+    return fn(p, q)
+
+
+@register_kl(Normal, Normal)
+def _kl_normal(p, q):
+    var_p, var_q = p.scale ** 2, q.scale ** 2
+    return Tensor(jnp.log(q.scale / p.scale)
+                  + (var_p + (p.loc - q.loc) ** 2) / (2 * var_q) - 0.5)
+
+
+@register_kl(Categorical, Categorical)
+def _kl_categorical(p, q):
+    logp = jax.nn.log_softmax(p.logits, -1)
+    logq = jax.nn.log_softmax(q.logits, -1)
+    return Tensor(jnp.sum(jnp.exp(logp) * (logp - logq), -1))
+
+
+@register_kl(Bernoulli, Bernoulli)
+def _kl_bernoulli(p, q):
+    pp = jnp.clip(p._probs, 1e-7, 1 - 1e-7)
+    qq = jnp.clip(q._probs, 1e-7, 1 - 1e-7)
+    return Tensor(pp * (jnp.log(pp) - jnp.log(qq))
+                  + (1 - pp) * (jnp.log1p(-pp) - jnp.log1p(-qq)))
+
+
+@register_kl(Uniform, Uniform)
+def _kl_uniform(p, q):
+    return Tensor(jnp.log((q.high - q.low) / (p.high - p.low)))

@@ -1,0 +1,101 @@
+"""paddle.distribution (reference: test/distribution/ — moment checks on
+large samples + closed-form log_prob/entropy/KL)."""
+import numpy as np
+import pytest
+from scipy import stats as sps
+
+import paddle_tpu as paddle
+from paddle_tpu import distribution as D
+
+
+def setup_function(_):
+    paddle.seed(0)
+
+
+def test_normal():
+    n = D.Normal(1.0, 2.0)
+    s = n.sample((20000,))
+    arr = np.asarray(s._value)
+    assert abs(arr.mean() - 1.0) < 0.1 and abs(arr.std() - 2.0) < 0.1
+    lp = float(n.log_prob(paddle.to_tensor(0.5))._value)
+    np.testing.assert_allclose(lp, sps.norm(1.0, 2.0).logpdf(0.5),
+                               rtol=1e-5)
+    np.testing.assert_allclose(float(n.entropy()._value),
+                               sps.norm(1.0, 2.0).entropy(), rtol=1e-5)
+
+
+def test_uniform_categorical_bernoulli():
+    u = D.Uniform(-1.0, 3.0)
+    arr = np.asarray(u.sample((10000,))._value)
+    assert arr.min() >= -1 and arr.max() < 3
+    np.testing.assert_allclose(float(u.log_prob(
+        paddle.to_tensor(0.0))._value), -np.log(4.0), rtol=1e-6)
+    assert np.isneginf(float(u.log_prob(paddle.to_tensor(5.0))._value))
+
+    c = D.Categorical(probs=paddle.to_tensor(
+        np.array([0.2, 0.3, 0.5], "float32")))
+    samples = np.asarray(c.sample((20000,))._value)
+    freq = np.bincount(samples, minlength=3) / 20000
+    np.testing.assert_allclose(freq, [0.2, 0.3, 0.5], atol=0.02)
+    np.testing.assert_allclose(float(c.entropy()._value),
+                               sps.entropy([0.2, 0.3, 0.5]), rtol=1e-5)
+
+    b = D.Bernoulli(probs=0.3)
+    arr = np.asarray(b.sample((20000,))._value)
+    assert abs(arr.mean() - 0.3) < 0.02
+    np.testing.assert_allclose(
+        float(b.log_prob(paddle.to_tensor(1.0))._value), np.log(0.3),
+        rtol=1e-5)
+
+
+def test_beta_dirichlet_multinomial():
+    be = D.Beta(2.0, 3.0)
+    arr = np.asarray(be.sample((20000,))._value)
+    np.testing.assert_allclose(arr.mean(), 2 / 5, atol=0.02)
+    np.testing.assert_allclose(
+        float(be.log_prob(paddle.to_tensor(0.4))._value),
+        sps.beta(2, 3).logpdf(0.4), rtol=1e-4)
+
+    d = D.Dirichlet(paddle.to_tensor(np.array([1.0, 2.0, 3.0], "float32")))
+    s = np.asarray(d.sample((5000,))._value)
+    np.testing.assert_allclose(s.sum(-1), 1.0, rtol=1e-4)
+    np.testing.assert_allclose(s.mean(0), [1 / 6, 2 / 6, 3 / 6], atol=0.02)
+
+    m = D.Multinomial(10, paddle.to_tensor(
+        np.array([0.25, 0.75], "float32")))
+    s = np.asarray(m.sample((2000,))._value)
+    assert s.shape == (2000, 2) and np.all(s.sum(-1) == 10)
+    np.testing.assert_allclose(s.mean(0), [2.5, 7.5], atol=0.15)
+    np.testing.assert_allclose(
+        float(m.log_prob(paddle.to_tensor(
+            np.array([2.0, 8.0], "float32")))._value),
+        sps.multinomial(10, [0.25, 0.75]).logpmf([2, 8]), rtol=1e-4)
+
+
+def test_more_families_and_kl():
+    e = D.Exponential(2.0)
+    arr = np.asarray(e.sample((20000,))._value)
+    np.testing.assert_allclose(arr.mean(), 0.5, atol=0.02)
+
+    g = D.Gumbel(0.0, 1.0)
+    assert np.isfinite(float(g.log_prob(paddle.to_tensor(0.3))._value))
+
+    l = D.Laplace(0.0, 1.0)
+    np.testing.assert_allclose(
+        float(l.log_prob(paddle.to_tensor(0.5))._value),
+        sps.laplace.logpdf(0.5), rtol=1e-5)
+
+    kl = D.kl_divergence(D.Normal(0.0, 1.0), D.Normal(1.0, 2.0))
+    ref = (np.log(2.0) + (1 + 1) / 8 - 0.5)
+    np.testing.assert_allclose(float(kl._value), ref, rtol=1e-5)
+
+    klc = D.kl_divergence(
+        D.Categorical(probs=paddle.to_tensor(
+            np.array([0.5, 0.5], "float32"))),
+        D.Categorical(probs=paddle.to_tensor(
+            np.array([0.9, 0.1], "float32"))))
+    ref = 0.5 * np.log(0.5 / 0.9) + 0.5 * np.log(0.5 / 0.1)
+    np.testing.assert_allclose(float(klc._value), ref, rtol=1e-5)
+
+    with pytest.raises(NotImplementedError):
+        D.kl_divergence(D.Normal(0.0, 1.0), D.Uniform(0.0, 1.0))
