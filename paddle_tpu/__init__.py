@@ -37,6 +37,7 @@ from . import hapi  # noqa: F401
 from . import profiler  # noqa: F401
 from . import static  # noqa: F401
 from . import distribution  # noqa: F401
+from . import quantization  # noqa: F401
 from .hapi import Model  # noqa: F401
 
 # paddle-API aliases

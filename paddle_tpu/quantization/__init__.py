@@ -1,0 +1,251 @@
+"""Quantization: QAT (fake quant with STE) + PTQ (observers).
+
+(reference: python/paddle/quantization/ — QuantConfig config.py, QAT
+qat.py, PTQ ptq.py, observers in observer.py, fake quanters in
+quanter.py; CUDA fake-quant kernels fluid/operators/fake_quantize_op.*.)
+
+TPU-native: fake-quant is a pure jnp simulation (scale/round/clip/
+rescale) with a straight-through-estimator gradient, so QAT runs inside
+compiled training steps; PTQ observers collect absmax ranges during
+eager/compiled calibration forwards and ``convert`` bakes the scales
+into Quanted layers.
+"""
+from __future__ import annotations
+
+import copy
+from typing import Dict, Optional, Type
+
+import jax
+import numpy as np
+import jax.numpy as jnp
+
+from ..core.dispatch import def_grad, def_op
+from ..nn import functional as F
+from ..nn.common import Linear
+from ..nn.conv import Conv2D
+from ..nn.layer import Layer
+from ..tensor import Tensor
+
+__all__ = ["QuantConfig", "QAT", "PTQ", "AbsmaxObserver",
+           "FakeQuanterWithAbsMaxObserver", "QuantedLinear",
+           "QuantedConv2D", "quant_dequant"]
+
+
+@def_op("fake_quantize_dequantize_abs_max")
+def _fake_qdq(x, scale, bit_length=8):
+    """Simulated quantization q(x) = round(x/s * qmax)/qmax * s."""
+    qmax = float(2 ** (bit_length - 1) - 1)
+    s = jnp.maximum(scale, 1e-8)
+    q = jnp.clip(jnp.round(x / s * qmax), -qmax, qmax)
+    return q / qmax * s
+
+
+@def_grad("fake_quantize_dequantize_abs_max")
+def _fake_qdq_grad(in_values, out_values, out_grads, **attrs):
+    # straight-through estimator: d out / d x = 1 inside the clip range
+    x, scale = in_values[0], in_values[1]
+    g = out_grads if not isinstance(out_grads, (tuple, list)) \
+        else out_grads[0]
+    s = jnp.maximum(scale, 1e-8)
+    inside = jnp.abs(x) <= s
+    gx = jnp.where(inside, g, jnp.zeros((), g.dtype))
+    return tuple([gx] + [None] * (len(in_values) - 1))
+
+
+def quant_dequant(x, scale, bit_length: int = 8):
+    """Public fake quant-dequant (STE gradient)."""
+    if not isinstance(scale, Tensor):
+        scale = Tensor(jnp.asarray(scale, jnp.float32))
+    return _fake_qdq(x, scale, bit_length)
+
+
+class AbsmaxObserver(Layer):
+    """PTQ range observer (reference observer.py AbsmaxObserver)."""
+
+    def __init__(self, quant_bits: int = 8):
+        super().__init__()
+        self.quant_bits = quant_bits
+        self._max = 0.0
+
+    def forward(self, x):
+        self._max = max(self._max,
+                        float(jnp.max(jnp.abs(x._value))))
+        return x
+
+    def scales(self) -> float:
+        return self._max if self._max > 0 else 1e-8
+
+    def _instance(self, layer):
+        return AbsmaxObserver(self.quant_bits)
+
+
+class FakeQuanterWithAbsMaxObserver(Layer):
+    """QAT fake quanter with moving-average absmax
+    (reference quanter.py FakeQuanterWithAbsMaxObserver)."""
+
+    def __init__(self, moving_rate: float = 0.9, bit_length: int = 8,
+                 **kw):
+        super().__init__()
+        self.moving_rate = moving_rate
+        self.bit_length = bit_length
+        self._scale = 1.0
+
+    def forward(self, x):
+        cur = float(jnp.max(jnp.abs(jax.lax.stop_gradient(x._value)))) \
+            if not self._is_traced(x) else None
+        if cur is not None:
+            r = self.moving_rate
+            self._scale = r * self._scale + (1 - r) * cur \
+                if self._scale != 1.0 or cur == 0 else cur
+        return quant_dequant(x, self._scale, self.bit_length)
+
+    @staticmethod
+    def _is_traced(x):
+        return isinstance(x._value, jax.core.Tracer)
+
+    def scales(self) -> float:
+        return self._scale
+
+    def _instance(self, layer):
+        return FakeQuanterWithAbsMaxObserver(self.moving_rate,
+                                             self.bit_length)
+
+
+class QuantConfig:
+    """(reference config.py QuantConfig) — default + per-type/per-layer
+    activation/weight quanter prototypes."""
+
+    def __init__(self, activation=None, weight=None):
+        self.default = (activation, weight)
+        self._type_cfg: Dict[Type, tuple] = {}
+        self._layer_cfg: Dict[int, tuple] = {}
+
+    def add_type_config(self, layer_type, activation=None, weight=None):
+        for t in (layer_type if isinstance(layer_type, (list, tuple))
+                  else [layer_type]):
+            self._type_cfg[t] = (activation, weight)
+
+    def add_layer_config(self, layer, activation=None, weight=None):
+        for l in (layer if isinstance(layer, (list, tuple)) else [layer]):
+            self._layer_cfg[id(l)] = (activation, weight)
+
+    def config_for(self, layer):
+        if id(layer) in self._layer_cfg:
+            return self._layer_cfg[id(layer)]
+        for t, cfg in self._type_cfg.items():
+            if isinstance(layer, t):
+                return cfg
+        return self.default
+
+
+class QuantedLinear(Layer):
+    """Linear with fake-quanted weight + activation (reference
+    nn/quant/qat/linear.py QuantedLinear)."""
+
+    def __init__(self, inner: Linear, act_quanter, w_quanter):
+        super().__init__()
+        self.inner = inner
+        self.activation_quanter = act_quanter
+        self.weight_quanter = w_quanter
+
+    @property
+    def weight(self):
+        return self.inner.weight
+
+    @property
+    def bias(self):
+        return self.inner.bias
+
+    def forward(self, x):
+        if self.activation_quanter is not None:
+            x = self.activation_quanter(x)
+        w = self.inner.weight
+        if self.weight_quanter is not None:
+            w = self.weight_quanter(w)
+        return F.linear(x, w, self.inner.bias)
+
+
+class QuantedConv2D(Layer):
+    def __init__(self, inner: Conv2D, act_quanter, w_quanter):
+        super().__init__()
+        self.inner = inner
+        self.activation_quanter = act_quanter
+        self.weight_quanter = w_quanter
+
+    def forward(self, x):
+        if self.activation_quanter is not None:
+            x = self.activation_quanter(x)
+        w = self.inner.weight
+        if self.weight_quanter is not None:
+            w = self.weight_quanter(w)
+        return F.conv2d(x, w, self.inner.bias, stride=self.inner._stride,
+                        padding=self.inner._padding,
+                        dilation=self.inner._dilation,
+                        groups=self.inner._groups)
+
+
+_QUANTABLE = {Linear: QuantedLinear, Conv2D: QuantedConv2D}
+
+
+def _wrap_model(model: Layer, config: QuantConfig, inplace: bool):
+    if not inplace:
+        model = copy.deepcopy(model)
+    for parent in model.sublayers(include_self=True):
+        for name, child in list(parent.named_children()):
+            qcls = _QUANTABLE.get(type(child))
+            if qcls is None:
+                continue
+            act_p, w_p = config.config_for(child)
+            act = act_p._instance(child) if act_p is not None else None
+            wq = w_p._instance(child) if w_p is not None else None
+            if act is None and wq is None:
+                continue
+            setattr(parent, name, qcls(child, act, wq))
+    return model
+
+
+class QAT:
+    """Quantization-aware training (reference qat.py QAT)."""
+
+    def __init__(self, config: QuantConfig):
+        self.config = config
+
+    def quantize(self, model: Layer, inplace: bool = False) -> Layer:
+        return _wrap_model(model, self.config, inplace)
+
+
+class PTQ:
+    """Post-training quantization (reference ptq.py PTQ): quantize()
+    inserts observers; run calibration forwards; convert() freezes the
+    observed scales into fake-quant layers."""
+
+    def __init__(self, config: QuantConfig):
+        self.config = config
+
+    def quantize(self, model: Layer, inplace: bool = False) -> Layer:
+        return _wrap_model(model, self.config, inplace)
+
+    def convert(self, model: Layer, inplace: bool = True) -> Layer:
+        if not inplace:
+            model = copy.deepcopy(model)
+        for layer in model.sublayers(include_self=True):
+            if isinstance(layer, (QuantedLinear, QuantedConv2D)):
+                for attr in ("activation_quanter", "weight_quanter"):
+                    q = getattr(layer, attr)
+                    if isinstance(q, AbsmaxObserver):
+                        setattr(layer, attr,
+                                _FrozenQuant(q.scales(), q.quant_bits))
+        return model
+
+
+class _FrozenQuant(Layer):
+    def __init__(self, scale: float, bits: int):
+        super().__init__()
+        self.scale = scale
+        self.bits = bits
+
+    def forward(self, x):
+        return quant_dequant(x, self.scale, self.bits)
+
+    def scales(self):
+        return self.scale

@@ -1,0 +1,93 @@
+"""Quantization: QAT fake-quant (STE) + PTQ observers
+(reference: test/quantization/ — QAT/PTQ workflow tests)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.quantization import (AbsmaxObserver,
+                                     FakeQuanterWithAbsMaxObserver, PTQ,
+                                     QAT, QuantConfig, QuantedLinear,
+                                     quant_dequant)
+
+
+def test_quant_dequant_grid_and_ste():
+    x = paddle.to_tensor(np.linspace(-1, 1, 11).astype("float32"),
+                         stop_gradient=False)
+    out = quant_dequant(x, 1.0, bit_length=8)
+    v = np.asarray(out._value)
+    grid = np.round(np.linspace(-1, 1, 11) * 127) / 127
+    np.testing.assert_allclose(v, grid, atol=1e-6)
+
+    loss = paddle.sum(out)
+    loss.backward()
+    np.testing.assert_allclose(np.asarray(x.grad._value), np.ones(11),
+                               atol=1e-6)  # STE: identity inside range
+
+    y = paddle.to_tensor(np.array([5.0, -7.0], "float32"),
+                         stop_gradient=False)
+    out2 = quant_dequant(y, 1.0)
+    paddle.sum(out2).backward()
+    np.testing.assert_allclose(np.asarray(y.grad._value), np.zeros(2),
+                               atol=1e-6)  # clipped region: zero grad
+
+
+def _net():
+    return paddle.nn.Sequential(paddle.nn.Linear(8, 16), paddle.nn.ReLU(),
+                                paddle.nn.Linear(16, 4))
+
+
+def test_qat_quantize_and_train():
+    paddle.seed(0)
+    model = _net()
+    cfg = QuantConfig(activation=FakeQuanterWithAbsMaxObserver(),
+                      weight=FakeQuanterWithAbsMaxObserver())
+    qat = QAT(cfg)
+    qmodel = qat.quantize(model, inplace=False)
+    assert isinstance(qmodel[0], QuantedLinear)
+    # original untouched
+    assert not isinstance(model[0], QuantedLinear)
+
+    opt = paddle.optimizer.Adam(learning_rate=0.01,
+                                parameters=qmodel.parameters())
+    x = np.random.RandomState(0).randn(16, 8).astype("float32")
+    y = np.random.RandomState(1).randn(16, 4).astype("float32")
+    losses = []
+    for _ in range(15):
+        loss = paddle.mean((qmodel(paddle.to_tensor(x))
+                            - paddle.to_tensor(y)) ** 2)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] * 0.8, losses
+
+
+def test_ptq_observe_convert():
+    paddle.seed(1)
+    model = _net()
+    cfg = QuantConfig(activation=AbsmaxObserver(), weight=AbsmaxObserver())
+    ptq = PTQ(cfg)
+    qmodel = ptq.quantize(model, inplace=False)
+
+    x = np.random.RandomState(2).randn(32, 8).astype("float32")
+    ref = np.asarray(model(paddle.to_tensor(x))._value)
+    for _ in range(4):  # calibration passes
+        qmodel(paddle.to_tensor(x))
+    scale = qmodel[0].activation_quanter.scales()
+    assert scale > 0
+
+    final = ptq.convert(qmodel)
+    out = np.asarray(final(paddle.to_tensor(x))._value)
+    # int8 simulation stays close to fp32
+    assert np.mean(np.abs(out - ref)) < 0.1 * (np.abs(ref).mean() + 1e-6)
+
+
+def test_type_and_layer_config():
+    model = _net()
+    cfg = QuantConfig()
+    cfg.add_type_config(paddle.nn.Linear,
+                        weight=FakeQuanterWithAbsMaxObserver())
+    q = QAT(cfg).quantize(model, inplace=False)
+    assert isinstance(q[0], QuantedLinear)
+    assert q[0].activation_quanter is None
+    assert q[0].weight_quanter is not None
