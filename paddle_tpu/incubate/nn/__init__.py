@@ -1,8 +1,11 @@
 """Incubating NN layers (reference: python/paddle/incubate/nn/).
 
-Fused transformer-era layers land here (FusedMultiTransformer analog,
-fused rms_norm/rope functional) — see ``functional``.
+FusedMultiTransformer and friends — the inference fast path
+(see layer/fused_transformer.py); fused functional ops in functional/.
 """
 from . import functional  # noqa: F401
+from .layer import (FusedFeedForward, FusedMultiHeadAttention,
+                    FusedMultiTransformer)  # noqa: F401
 
-__all__ = ["functional"]
+__all__ = ["functional", "FusedMultiHeadAttention", "FusedFeedForward",
+           "FusedMultiTransformer"]

@@ -1,0 +1,5 @@
+from .fused_transformer import (FusedFeedForward, FusedMultiHeadAttention,
+                                FusedMultiTransformer)  # noqa: F401
+
+__all__ = ["FusedMultiHeadAttention", "FusedFeedForward",
+           "FusedMultiTransformer"]
