@@ -1,0 +1,3 @@
+from .manager import ElasticManager, ElasticStatus  # noqa: F401
+
+__all__ = ["ElasticManager", "ElasticStatus"]

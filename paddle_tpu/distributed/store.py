@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import ctypes
 import os
+import threading
 import time
 from typing import Optional
 
@@ -30,6 +31,9 @@ class TCPStore:
         self._lib = native.load()
         enforce(self._lib is not None,
                 "native library unavailable (csrc build failed)")
+        # one socket per store object: serialize request/response pairs
+        # (heartbeat + watcher threads share the connection)
+        self._mu = threading.Lock()
         self._server = None
         self.timeout_ms = int(timeout * 1000)
         if is_master:
@@ -53,33 +57,41 @@ class TCPStore:
         data = value if isinstance(value, (bytes, bytearray)) else \
             str(value).encode()
         buf = (ctypes.c_uint8 * len(data)).from_buffer_copy(data)
-        rc = self._lib.tcpstore_set(self._fd, key.encode(), buf, len(data))
+        with self._mu:
+            rc = self._lib.tcpstore_set(self._fd, key.encode(), buf,
+                                        len(data))
         enforce(rc == 0, f"TCPStore.set({key!r}) failed")
 
-    def get(self, key: str) -> bytes:
+    def get(self, key: str, timeout: Optional[float] = None) -> bytes:
         out = ctypes.POINTER(ctypes.c_uint8)()
-        n = self._lib.tcpstore_get(self._fd, key.encode(),
-                                   self.timeout_ms, ctypes.byref(out))
-        enforce(n >= 0, f"TCPStore.get({key!r}) timed out")
-        data = ctypes.string_at(out, n)
-        self._lib.tcpstore_free(out)
+        ms = int(timeout * 1000) if timeout is not None else self.timeout_ms
+        with self._mu:
+            n = self._lib.tcpstore_get(self._fd, key.encode(),
+                                       ms, ctypes.byref(out))
+            enforce(n >= 0, f"TCPStore.get({key!r}) timed out")
+            data = ctypes.string_at(out, n)
+            self._lib.tcpstore_free(out)
         return data
 
     def add(self, key: str, delta: int) -> int:
-        v = self._lib.tcpstore_add(self._fd, key.encode(), int(delta))
+        with self._mu:
+            v = self._lib.tcpstore_add(self._fd, key.encode(), int(delta))
         enforce(v != -(2 ** 63), f"TCPStore.add({key!r}) failed")
         return int(v)
 
     def wait(self, key: str, timeout: Optional[float] = None) -> None:
         ms = int(timeout * 1000) if timeout else self.timeout_ms
-        rc = self._lib.tcpstore_wait(self._fd, key.encode(), ms)
+        with self._mu:
+            rc = self._lib.tcpstore_wait(self._fd, key.encode(), ms)
         enforce(rc == 0, f"TCPStore.wait({key!r}) timed out")
 
     def check(self, key: str) -> bool:
-        return self._lib.tcpstore_check(self._fd, key.encode()) == 1
+        with self._mu:
+            return self._lib.tcpstore_check(self._fd, key.encode()) == 1
 
     def delete_key(self, key: str) -> None:
-        self._lib.tcpstore_delete(self._fd, key.encode())
+        with self._mu:
+            self._lib.tcpstore_delete(self._fd, key.encode())
 
     def barrier(self, name: str, world_size: int,
                 timeout: Optional[float] = None) -> None:

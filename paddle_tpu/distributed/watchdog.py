@@ -1,0 +1,129 @@
+"""Hang detection for compiled collective steps.
+
+(reference: phi/core/distributed/comm_task_manager.h:37 CommTaskManager —
+background threads tracking in-flight NCCL collectives,
+NCCLCommTask::IsTimeout/AbortComm, ErrorHandlingMode::TearDown;
+enabled via FLAGS_enable_async_trace.)
+
+TPU-native: XLA collectives are compiled into the step, not enqueued as
+tasks, so hang detection wraps the *step execution*: a monitor thread
+arms a deadline around each tracked region (dispatch → block_until_ready)
+and fires the timeout handler if the device never comes back — the
+typical cause being a peer host dropping out of a multi-host collective.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Callable, Optional
+
+__all__ = ["CommTaskManager", "TimeoutError_", "watch"]
+
+
+class TimeoutError_(RuntimeError):
+    pass
+
+
+class _Task:
+    def __init__(self, name: str, deadline: float):
+        self.name = name
+        self.deadline = deadline
+        self.done = False
+
+
+class CommTaskManager:
+    """Tracks in-flight step executions against a timeout.
+
+    ``error_handling``: "raise" (raise TimeoutError_ in the monitor and
+    record it for the main thread), "log", or "teardown" (SIGABRT the
+    process — the reference's ErrorHandlingMode::TearDown, letting the
+    launcher's watcher restart the pod).
+    """
+
+    def __init__(self, timeout: float = 1800.0,
+                 error_handling: str = "raise",
+                 on_timeout: Optional[Callable] = None,
+                 poll_interval: float = 0.5):
+        self.timeout = timeout
+        self.error_handling = error_handling
+        self.on_timeout = on_timeout
+        self.poll = poll_interval
+        self._tasks = []
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._timed_out: Optional[str] = None
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def _loop(self):
+        while not self._stop.wait(self.poll):
+            now = time.monotonic()
+            with self._lock:
+                hung = [t for t in self._tasks
+                        if not t.done and now > t.deadline]
+                self._tasks = [t for t in self._tasks if not t.done]
+            for t in hung:
+                t.done = True
+                self._timed_out = t.name
+                if self.on_timeout:
+                    self.on_timeout(t.name)
+                if self.error_handling == "teardown":
+                    os.abort()
+
+    def check(self):
+        """Raise if any tracked region has timed out (call between
+        steps — the main thread may be past the hung region by then)."""
+        if self._timed_out is not None and self.error_handling == "raise":
+            name, self._timed_out = self._timed_out, None
+            raise TimeoutError_(
+                f"collective step '{name}' exceeded "
+                f"{self.timeout}s — a peer likely left the mesh "
+                "(reference: NCCLCommTask::IsTimeout)")
+
+    def track(self, name: str = "step", timeout: Optional[float] = None):
+        return _Tracker(self, name, timeout or self.timeout)
+
+    def shutdown(self):
+        self._stop.set()
+        self._thread.join(timeout=2)
+
+
+class _Tracker:
+    def __init__(self, mgr: CommTaskManager, name: str, timeout: float):
+        self._mgr = mgr
+        self._name = name
+        self._timeout = timeout
+        self._task = None
+
+    def __enter__(self):
+        self._task = _Task(self._name,
+                           time.monotonic() + self._timeout)
+        with self._mgr._lock:
+            self._mgr._tasks.append(self._task)
+        return self
+
+    def __exit__(self, *exc):
+        self._task.done = True
+        self._mgr.check()
+        return False
+
+
+def watch(fn: Callable, timeout: float = 1800.0, name: str = "step",
+          **mgr_kw):
+    """Wrap a compiled step so each call is tracked: blocks until the
+    result is device-ready inside the watched region."""
+    mgr = CommTaskManager(timeout=timeout, **mgr_kw)
+
+    def wrapped(*args, **kwargs):
+        import jax
+
+        with mgr.track(name):
+            out = fn(*args, **kwargs)
+            jax.block_until_ready(
+                jax.tree_util.tree_map(
+                    lambda t: getattr(t, "_value", t), out))
+        return out
+
+    wrapped._watchdog = mgr
+    return wrapped

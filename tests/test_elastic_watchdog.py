@@ -1,0 +1,79 @@
+"""Elastic manager (TCPStore heartbeats) + collective watchdog
+(reference: fleet/elastic/manager.py + CommTaskManager timeout)."""
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.core import native
+from paddle_tpu.distributed.watchdog import (CommTaskManager,
+                                             TimeoutError_, watch)
+
+
+def test_watchdog_passes_fast_steps():
+    mgr = CommTaskManager(timeout=5.0, poll_interval=0.05)
+    for _ in range(3):
+        with mgr.track("step"):
+            time.sleep(0.01)
+    mgr.check()
+    mgr.shutdown()
+
+
+def test_watchdog_detects_hang():
+    fired = []
+    mgr = CommTaskManager(timeout=0.2, poll_interval=0.05,
+                          on_timeout=lambda name: fired.append(name))
+    with pytest.raises(TimeoutError_):
+        with mgr.track("hung_allreduce"):
+            time.sleep(0.6)
+    assert fired == ["hung_allreduce"]
+    mgr.shutdown()
+
+
+def test_watch_wrapper_blocks_until_ready():
+    import jax.numpy as jnp
+
+    def step(x):
+        return paddle.to_tensor(np.asarray(x) * 2)
+
+    wrapped = watch(step, timeout=5.0, poll_interval=0.05)
+    out = wrapped(np.ones(4, "float32"))
+    np.testing.assert_array_equal(np.asarray(out._value), 2 * np.ones(4))
+    wrapped._watchdog.shutdown()
+
+
+@pytest.mark.skipif(native.load() is None, reason="native lib unavailable")
+def test_elastic_heartbeats_and_scale_in():
+    from paddle_tpu.distributed import TCPStore
+    from paddle_tpu.distributed.fleet.elastic import (ElasticManager,
+                                                      ElasticStatus)
+
+    master = TCPStore("127.0.0.1", 0, is_master=True, timeout=10)
+    stores = [TCPStore("127.0.0.1", master.port) for _ in range(3)]
+    changes = []
+    mgrs = [ElasticManager(s, job_id="j1", rank=i, np_=3,
+                           heartbeat_interval=0.1, node_timeout=0.5,
+                           on_world_change=lambda w, i=i:
+                           changes.append((i, tuple(w))))
+            for i, s in enumerate(stores)]
+    for m in mgrs:
+        m.register()
+    assert mgrs[0].wait_world(3, timeout=5)
+    assert sorted(mgrs[0].alive_ranks()) == [0, 1, 2]
+
+    # rank 2 dies: its heartbeat stops → peers see scale-in
+    mgrs[2]._stop.set()
+    time.sleep(0.3)  # let an in-flight heartbeat write drain
+    master.delete_key("/elastic/j1/nodes/2")
+    deadline = time.time() + 5
+    while time.time() < deadline and not changes:
+        time.sleep(0.1)
+    assert changes and all(2 not in w for _, w in changes)
+    assert mgrs[0].status == ElasticStatus.RESTART
+
+    for m in mgrs[:2]:
+        m.exit()
+    for s in stores:
+        s.close()
+    master.close()
