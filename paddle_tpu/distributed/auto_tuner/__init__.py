@@ -1,0 +1,5 @@
+from .tuner import AutoTuner, default_candidates  # noqa: F401
+from .cost_model import estimate_memory_gb, estimate_step_time  # noqa: F401
+
+__all__ = ["AutoTuner", "default_candidates", "estimate_memory_gb",
+           "estimate_step_time"]

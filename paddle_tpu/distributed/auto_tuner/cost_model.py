@@ -1,0 +1,79 @@
+"""Analytic cost & memory models for hybrid-parallel config pruning.
+
+(reference: python/paddle/distributed/auto_tuner/cost_model.py +
+memory_cost_model.py — per-config step-time and HBM estimates used to
+prune the search space before launching trials.)
+
+Transformer-shaped models only (the tuner's target); constants are
+calibratable but the *ordering* of configs is what pruning needs.
+"""
+from __future__ import annotations
+
+from typing import Dict
+
+__all__ = ["estimate_memory_gb", "estimate_step_time"]
+
+
+def _num_params(model: Dict) -> float:
+    h = model["hidden_size"]
+    L = model["num_layers"]
+    V = model.get("vocab_size", 50304)
+    i = model.get("intermediate_size", 4 * h)
+    return V * h + L * (4 * h * h + 2 * h * i) + 2 * h
+
+
+def estimate_memory_gb(model: Dict, cfg: Dict, global_batch: int,
+                       seq_len: int, dtype_bytes: int = 2,
+                       optimizer_mult: float = 6.0,
+                       recompute: bool = False) -> float:
+    """Per-chip HBM estimate (params + grads + optimizer + activations).
+
+    optimizer_mult: bytes per param beyond weights (Adam fp32 moments +
+    master weights ≈ 12 over bf16 weights of 2 → default 6x weight bytes).
+    """
+    dp = cfg.get("dp_degree", 1)
+    mp = cfg.get("mp_degree", 1)
+    pp = cfg.get("pp_degree", 1)
+    sh = cfg.get("sharding_degree", 1)
+    micro = cfg.get("micro_batch_size",
+                    max(1, global_batch // max(1, dp * sh)))
+    P = _num_params(model) / (mp * pp)
+    param_bytes = P * dtype_bytes
+    grad_bytes = P * dtype_bytes
+    opt_bytes = P * dtype_bytes * optimizer_mult / sh
+    if cfg.get("sharding_stage", 1) >= 3:
+        param_bytes /= sh
+        grad_bytes /= sh
+    h = model["hidden_size"]
+    L = model["num_layers"] / pp
+    act_per_layer = micro * seq_len * h * dtype_bytes
+    act_mult = 4 if recompute else 34  # flash-attn era per-layer factor
+    act_bytes = L * act_per_layer * act_mult / mp
+    return (param_bytes + grad_bytes + opt_bytes + act_bytes) / 1e9
+
+
+def estimate_step_time(model: Dict, cfg: Dict, global_batch: int,
+                       seq_len: int, peak_flops: float = 459e12,
+                       ici_bw: float = 9e10) -> float:
+    """Relative step-time: MXU compute + mp/pp/dp comm terms."""
+    dp = cfg.get("dp_degree", 1)
+    mp = cfg.get("mp_degree", 1)
+    pp = cfg.get("pp_degree", 1)
+    sh = cfg.get("sharding_degree", 1)
+    n = dp * mp * pp * sh
+    P = _num_params(model)
+    tokens = global_batch * seq_len
+    compute = 6.0 * P * tokens / (n * peak_flops * 0.5)
+    h = model["hidden_size"]
+    L = model["num_layers"]
+    micro_tokens = tokens / max(1, dp * sh)
+    # mp: 4 allreduces of activations per layer
+    comm_mp = 0.0 if mp == 1 else \
+        4 * L * micro_tokens * h * 2 * 2 * (mp - 1) / mp / ici_bw
+    # dp/sharding: grad reduce of the param shard
+    comm_dp = 0.0 if dp * sh == 1 else \
+        2 * (P / (mp * pp)) * 2 * (dp * sh - 1) / (dp * sh) / ici_bw
+    # pp: bubble fraction
+    acc = cfg.get("accumulate_steps", max(1, 2 * pp))
+    bubble = (pp - 1) / max(1, acc + pp - 1)
+    return (compute + comm_mp + comm_dp) / max(1e-9, 1 - bubble)

@@ -1,0 +1,146 @@
+"""Auto-tuner: search over hybrid-parallel configurations.
+
+(reference: python/paddle/distributed/auto_tuner/tuner.py + search.py +
+prune.py — grid/GBS search over dp/mp/pp/sharding/micro-batch configs by
+launching trial jobs, with analytic pruning.)
+"""
+from __future__ import annotations
+
+import itertools
+import json
+from typing import Callable, Dict, List, Optional
+
+from .cost_model import estimate_memory_gb, estimate_step_time
+
+__all__ = ["AutoTuner", "default_candidates"]
+
+
+def _factorizations(n: int, dims: int):
+    """All tuples of `dims` positive ints whose product is n."""
+    if dims == 1:
+        yield (n,)
+        return
+    for d in range(1, n + 1):
+        if n % d == 0:
+            for rest in _factorizations(n // d, dims - 1):
+                yield (d,) + rest
+
+
+def default_candidates(num_devices: int, model: Dict,
+                       global_batch: int,
+                       tune_sharding: bool = True) -> List[Dict]:
+    """Valid (dp, mp, pp, sharding, micro) configs for the device count,
+    pruned by divisibility (reference prune.py rules)."""
+    heads = model.get("num_heads", 1)
+    layers = model["num_layers"]
+    vocab = model.get("vocab_size", 0)
+    out = []
+    dims = 4 if tune_sharding else 3
+    for fact in _factorizations(num_devices, dims):
+        if tune_sharding:
+            dp, mp, pp, sh = fact
+        else:
+            dp, mp, pp = fact
+            sh = 1
+        if heads % mp or (vocab and vocab % mp):
+            continue
+        if layers % pp:
+            continue
+        data_ways = dp * sh
+        if global_batch % data_ways:
+            continue
+        per_rank = global_batch // data_ways
+        for micro in {1, 2, 4, 8, per_rank}:
+            if micro > per_rank or per_rank % micro:
+                continue
+            cfg = {"dp_degree": dp, "mp_degree": mp, "pp_degree": pp,
+                   "sharding_degree": sh, "micro_batch_size": micro,
+                   "accumulate_steps": per_rank // micro}
+            out.append(cfg)
+    return out
+
+
+class AutoTuner:
+    """Prunes by the memory model, ranks by the cost model, optionally
+    runs measured trials (reference tuner.py loop).
+
+    Usage::
+
+        tuner = AutoTuner(model_cfg, num_devices=64, global_batch=512,
+                          seq_len=2048, hbm_gb=95)
+        best = tuner.tune(trial_fn=my_run)   # or .best_by_model()
+    """
+
+    def __init__(self, model: Dict, num_devices: int, global_batch: int,
+                 seq_len: int, hbm_gb: float = 95.0,
+                 peak_flops: float = 459e12, recompute: bool = False,
+                 candidates: Optional[List[Dict]] = None,
+                 max_trials: int = 16):
+        self.model = model
+        self.num_devices = num_devices
+        self.global_batch = global_batch
+        self.seq_len = seq_len
+        self.hbm_gb = hbm_gb
+        self.peak_flops = peak_flops
+        self.recompute = recompute
+        self.max_trials = max_trials
+        self.history: List[Dict] = []
+        self._candidates = candidates
+
+    # -- search space ---------------------------------------------------
+    def candidates(self) -> List[Dict]:
+        if self._candidates is None:
+            self._candidates = default_candidates(
+                self.num_devices, self.model, self.global_batch)
+        return self._candidates
+
+    def pruned(self) -> List[Dict]:
+        """Configs that fit the memory budget, best-predicted first."""
+        fits = []
+        for cfg in self.candidates():
+            mem = estimate_memory_gb(self.model, cfg, self.global_batch,
+                                     self.seq_len,
+                                     recompute=self.recompute)
+            if mem <= self.hbm_gb:
+                t = estimate_step_time(self.model, cfg, self.global_batch,
+                                       self.seq_len, self.peak_flops)
+                fits.append((t, mem, cfg))
+        fits.sort(key=lambda x: x[0])
+        return [dict(cfg, _pred_time=t, _pred_mem_gb=mem)
+                for t, mem, cfg in fits]
+
+    def best_by_model(self) -> Dict:
+        ranked = self.pruned()
+        if not ranked:
+            raise RuntimeError(
+                "no config fits the memory budget — enable recompute / "
+                "sharding or add devices")
+        return ranked[0]
+
+    # -- measured trials -------------------------------------------------
+    def tune(self, trial_fn: Optional[Callable[[Dict], float]] = None
+             ) -> Dict:
+        """Run up to max_trials measured trials (``trial_fn(cfg)`` returns
+        throughput, higher better; exceptions = OOM/failure → pruned).
+        Without a trial_fn, returns the model-predicted best."""
+        ranked = self.pruned()
+        if trial_fn is None:
+            return self.best_by_model()
+        best, best_metric = None, -float("inf")
+        for cfg in ranked[:self.max_trials]:
+            try:
+                metric = float(trial_fn({k: v for k, v in cfg.items()
+                                         if not k.startswith("_")}))
+                status = "ok"
+            except Exception as e:  # OOM or crash: record and move on
+                metric, status = -float("inf"), f"failed: {e}"
+            self.history.append(dict(cfg, metric=metric, status=status))
+            if metric > best_metric:
+                best, best_metric = cfg, metric
+        if best is None:
+            raise RuntimeError("all trials failed")
+        return best
+
+    def save_history(self, path: str):
+        with open(path, "w") as f:
+            json.dump(self.history, f, indent=2)

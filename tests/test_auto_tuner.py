@@ -1,0 +1,78 @@
+"""Auto-tuner: candidate generation, pruning, model ranking, trials
+(reference: distributed/auto_tuner tests)."""
+import numpy as np
+import pytest
+
+from paddle_tpu.distributed.auto_tuner import (AutoTuner,
+                                               default_candidates,
+                                               estimate_memory_gb,
+                                               estimate_step_time)
+
+MODEL = {"hidden_size": 768, "num_layers": 12, "num_heads": 12,
+         "vocab_size": 50304}
+
+
+def test_candidates_respect_divisibility():
+    cands = default_candidates(8, MODEL, global_batch=32)
+    assert cands
+    for c in cands:
+        assert (c["dp_degree"] * c["mp_degree"] * c["pp_degree"]
+                * c["sharding_degree"]) == 8
+        assert MODEL["num_heads"] % c["mp_degree"] == 0
+        assert MODEL["num_layers"] % c["pp_degree"] == 0
+        assert 32 % (c["dp_degree"] * c["sharding_degree"]) == 0
+    # mp=5 etc. never appear
+    assert all(c["mp_degree"] in (1, 2, 4) for c in cands)
+
+
+def test_memory_model_monotonic():
+    base = {"dp_degree": 1, "mp_degree": 1, "pp_degree": 1,
+            "sharding_degree": 1, "micro_batch_size": 8}
+    m1 = estimate_memory_gb(MODEL, base, 8, 1024)
+    mp2 = estimate_memory_gb(MODEL, dict(base, mp_degree=2), 8, 1024)
+    sh2 = estimate_memory_gb(MODEL, dict(base, sharding_degree=2), 8, 1024)
+    assert mp2 < m1 and sh2 < m1
+    rem = estimate_memory_gb(MODEL, base, 8, 1024, recompute=True)
+    assert rem < m1
+
+
+def test_cost_model_prefers_parallelism_for_big_models():
+    big = {"hidden_size": 4096, "num_layers": 32, "num_heads": 32,
+           "vocab_size": 32000}
+    single = {"dp_degree": 1, "mp_degree": 1, "pp_degree": 1,
+              "sharding_degree": 1}
+    t1 = estimate_step_time(big, single, 64, 2048)
+    t8 = estimate_step_time(big, dict(single, dp_degree=8), 64, 2048)
+    assert t8 < t1
+
+
+def test_tuner_prune_and_trials(tmp_path):
+    tuner = AutoTuner(MODEL, num_devices=8, global_batch=32,
+                      seq_len=1024, hbm_gb=16.0, max_trials=100)
+    ranked = tuner.pruned()
+    assert ranked and all(c["_pred_mem_gb"] <= 16.0 for c in ranked)
+    assert ranked == sorted(ranked, key=lambda c: c["_pred_time"])
+
+    best_model = tuner.best_by_model()
+    assert "_pred_time" in best_model
+
+    # measured trials: pretend dp=2/mp=4 is the fastest
+    def trial(cfg):
+        if cfg["mp_degree"] == 4 and cfg["dp_degree"] == 2:
+            return 100.0
+        if cfg["pp_degree"] > 1:
+            raise MemoryError("oom")  # failures are pruned, not fatal
+        return 10.0
+
+    best = tuner.tune(trial)
+    assert best["mp_degree"] == 4 and best["dp_degree"] == 2
+    assert any(h["status"].startswith("failed") or h["metric"] == 10.0
+               for h in tuner.history)
+    tuner.save_history(str(tmp_path / "hist.json"))
+
+
+def test_tiny_memory_budget_raises():
+    tuner = AutoTuner(MODEL, num_devices=1, global_batch=8,
+                      seq_len=1024, hbm_gb=0.001)
+    with pytest.raises(RuntimeError):
+        tuner.best_by_model()
