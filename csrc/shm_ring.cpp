@@ -55,6 +55,21 @@ void abs_deadline(timespec* ts, int64_t timeout_ms) {
   }
 }
 
+// Acquire the process-shared mutex with a deadline. The mutex is ROBUST:
+// if a worker dies while holding it we get EOWNERDEAD, mark the state
+// consistent, and carry on — a killed peer must not hang training.
+// Returns 0 on success, -1 on timeout/unrecoverable.
+int lock_robust(RingHeader* hdr, const timespec* ts) {
+  int rc = pthread_mutex_timedlock(&hdr->mu, ts);
+  if (rc == EOWNERDEAD) {
+    pthread_mutex_consistent(&hdr->mu);
+    // A writer may have died mid-record; the ring byte-counters are only
+    // advanced after a full copy, so the shared state is still coherent.
+    rc = 0;
+  }
+  return rc == 0 ? 0 : -1;
+}
+
 void copy_in(Ring* r, uint64_t pos, const uint8_t* src, uint64_t n) {
   uint64_t off = pos % r->hdr->capacity;
   uint64_t first = r->hdr->capacity - off;
@@ -109,6 +124,7 @@ void* shmring_create(const char* name, uint64_t capacity) {
   pthread_mutexattr_t ma;
   pthread_mutexattr_init(&ma);
   pthread_mutexattr_setpshared(&ma, PTHREAD_PROCESS_SHARED);
+  pthread_mutexattr_setrobust(&ma, PTHREAD_MUTEX_ROBUST);
   pthread_mutex_init(&r->hdr->mu, &ma);
   pthread_condattr_t ca;
   pthread_condattr_init(&ca);
@@ -151,11 +167,12 @@ int shmring_write(void* handle, const uint8_t* buf, uint64_t len,
   if (need > r->hdr->capacity) return -3;
   timespec ts;
   abs_deadline(&ts, timeout_ms);
-  pthread_mutex_lock(&r->hdr->mu);
+  if (lock_robust(r->hdr, &ts) != 0) return -1;
   while (r->hdr->tail + need - r->hdr->head > r->hdr->capacity &&
          !r->hdr->closed) {
-    if (pthread_cond_timedwait(&r->hdr->not_full, &r->hdr->mu, &ts) ==
-        ETIMEDOUT) {
+    int rc = pthread_cond_timedwait(&r->hdr->not_full, &r->hdr->mu, &ts);
+    if (rc == EOWNERDEAD) pthread_mutex_consistent(&r->hdr->mu);
+    if (rc == ETIMEDOUT) {
       pthread_mutex_unlock(&r->hdr->mu);
       return -1;
     }
@@ -179,10 +196,11 @@ int64_t shmring_read(void* handle, uint8_t** out, int64_t timeout_ms) {
   auto* r = static_cast<Ring*>(handle);
   timespec ts;
   abs_deadline(&ts, timeout_ms);
-  pthread_mutex_lock(&r->hdr->mu);
+  if (lock_robust(r->hdr, &ts) != 0) return -1;
   while (r->hdr->head == r->hdr->tail && !r->hdr->closed) {
-    if (pthread_cond_timedwait(&r->hdr->not_empty, &r->hdr->mu, &ts) ==
-        ETIMEDOUT) {
+    int rc = pthread_cond_timedwait(&r->hdr->not_empty, &r->hdr->mu, &ts);
+    if (rc == EOWNERDEAD) pthread_mutex_consistent(&r->hdr->mu);
+    if (rc == ETIMEDOUT) {
       pthread_mutex_unlock(&r->hdr->mu);
       return -1;
     }
@@ -193,6 +211,13 @@ int64_t shmring_read(void* handle, uint8_t** out, int64_t timeout_ms) {
   }
   uint64_t len64;
   copy_out(r, r->hdr->head, reinterpret_cast<uint8_t*>(&len64), 8);
+  if (len64 > r->hdr->capacity - 8) {  // corrupt header — fail loudly
+    r->hdr->closed = 1;
+    pthread_cond_broadcast(&r->hdr->not_full);
+    pthread_cond_broadcast(&r->hdr->not_empty);  // wake blocked readers too
+    pthread_mutex_unlock(&r->hdr->mu);
+    return -2;
+  }
   *out = static_cast<uint8_t*>(::malloc(len64 ? len64 : 1));
   copy_out(r, r->hdr->head + 8, *out, len64);
   r->hdr->head += len64 + 8;

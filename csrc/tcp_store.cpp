@@ -47,6 +47,7 @@ struct Server {
   std::atomic<bool> stop{false};
   std::thread accept_thread;
   std::vector<std::thread> conns;
+  std::vector<int> conn_fds;
   std::mutex conns_mu;
   Store store;
   ~Server() {
@@ -55,12 +56,27 @@ struct Server {
       ::shutdown(listen_fd, SHUT_RDWR);
       ::close(listen_fd);
     }
+    // Wake every serve_conn thread: those parked in cv.wait_for (GET/WAIT)
+    // observe stop via the predicate; those blocked in recv() get EOF from
+    // the socket shutdown. Without both, join() below can hang for the
+    // full client timeout (900s default).
+    store.cv.notify_all();
+    {
+      std::lock_guard<std::mutex> g(conns_mu);
+      for (int fd : conn_fds) ::shutdown(fd, SHUT_RDWR);
+    }
     if (accept_thread.joinable()) accept_thread.join();
     std::lock_guard<std::mutex> g(conns_mu);
     for (auto& t : conns)
       if (t.joinable()) t.join();
   }
 };
+
+// Upper bound on any key/value frame. Object collectives ship pickled
+// host metadata through the store, so this is generous — but bounded, so
+// a garbage frame from a stray client can't force a multi-GiB allocation
+// on the coordinator.
+constexpr uint32_t kMaxBlob = 64u * 1024 * 1024;
 
 bool read_full(int fd, void* buf, size_t n) {
   auto* p = static_cast<uint8_t*>(buf);
@@ -87,6 +103,7 @@ bool write_full(int fd, const void* buf, size_t n) {
 bool read_blob(int fd, std::string* out) {
   uint32_t len;
   if (!read_full(fd, &len, 4)) return false;
+  if (len > kMaxBlob) return false;  // drop connection on oversized frame
   out->resize(len);
   return len == 0 || read_full(fd, &(*out)[0], len);
 }
@@ -163,6 +180,18 @@ void serve_conn(Server* s, int fd) {
       break;
     }
   }
+  {
+    // drop our fd from the shutdown list BEFORE closing: the number can
+    // be reused by an unrelated descriptor, and ~Server must not
+    // shutdown() that one
+    std::lock_guard<std::mutex> g(s->conns_mu);
+    for (auto it = s->conn_fds.begin(); it != s->conn_fds.end(); ++it) {
+      if (*it == fd) {
+        s->conn_fds.erase(it);
+        break;
+      }
+    }
+  }
   ::close(fd);
 }
 
@@ -199,6 +228,7 @@ void* tcpstore_server_start(int port, int* bound_port) {
       int fd = ::accept(s->listen_fd, nullptr, nullptr);
       if (fd < 0) break;
       std::lock_guard<std::mutex> g(s->conns_mu);
+      s->conn_fds.push_back(fd);
       s->conns.emplace_back(serve_conn, s, fd);
     }
   });

@@ -5,6 +5,12 @@ mirrors ``paddle``'s eager + distributed semantics.
 """
 from __future__ import annotations
 
+# Multi-process pods must join the global jax runtime BEFORE anything
+# touches the XLA backend (see _bootstrap docstring).
+from ._bootstrap import bootstrap as _mp_bootstrap
+
+_mp_bootstrap()
+
 # Core substrate first (flags/dtypes), then Tensor, then ops which register
 # kernels, then method monkey-patching (reference-style late binding).
 from .core import flags as _flags_mod

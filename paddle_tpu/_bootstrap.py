@@ -1,0 +1,49 @@
+"""Multi-process bootstrap — MUST run before any XLA backend touch.
+
+``jax.distributed.initialize`` has to be called before the first
+``jax.devices()``/computation, but importing the framework already
+touches the backend (op registration, dtype tables). So the very first
+statement of ``paddle_tpu/__init__`` calls :func:`bootstrap`, which joins
+the global jax runtime when the launcher envs say this is a ranked
+process of a pod (reference analog: parallel.py:943 init_parallel_env's
+store+ProcessGroup bootstrap, which Paddle likewise triggers before any
+collective).
+
+Kept dependency-free (no other paddle_tpu imports) so it can run first.
+The jax coordination service address is ``PADDLE_MASTER`` host with
+port+1 — the TCPStore owns the master port itself — or the explicit
+``JAX_COORDINATOR_ADDRESS`` override set by the launcher.
+"""
+from __future__ import annotations
+
+import os
+
+_done = False
+
+
+def bootstrap() -> None:
+    global _done
+    if _done:
+        return
+    _done = True
+    world = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+    if world <= 1:
+        return
+    rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+    coord = os.environ.get("JAX_COORDINATOR_ADDRESS")
+    if not coord:
+        master = os.environ.get("PADDLE_MASTER", "")
+        if not master:
+            return  # no rendezvous info — stay single-process
+        host, _, port = master.partition(":")
+        coord = f"{host or '127.0.0.1'}:{int(port or 0) + 1}"
+    import jax
+
+    try:
+        # XLA:CPU cross-process collectives ride gloo (the reference's
+        # process_group_gloo.cc role); harmless on TPU backends.
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception:
+        pass
+    jax.distributed.initialize(coordinator_address=coord,
+                               num_processes=world, process_id=rank)

@@ -91,6 +91,32 @@ def save_state_dict(state_dict: Dict, path: str,
 
     with open(os.path.join(path, fname), "wb") as f:
         pickle.dump(shards_out, f, protocol=4)
+
+    # Multi-host: the coordinator's own addressable shards are only a
+    # slice of the global layout — gather every process's local metadata
+    # before writing 0.metadata, or load_state_dict would silently
+    # zero-fill the missing regions (reference save_state_dict.py:50-104
+    # does the same all_gather_object pass before rank 0 writes).
+    from .. import runtime as _rt
+
+    if _rt.is_multiprocess():
+        all_md = _rt.all_gather_object_host(
+            (md.state_dict_metadata, md.storage_metadata, md.global_shape))
+        if proc == coordinator_rank:
+            merged = Metadata()
+            for sd_md, st_md, gshape in all_md:
+                for key, metas in sd_md.items():
+                    have = merged.state_dict_metadata.setdefault(key, [])
+                    seen_off = {tuple(m.global_offset) for m in have}
+                    for m in metas:
+                        if tuple(m.global_offset) not in seen_off:
+                            have.append(m)
+                            seen_off.add(tuple(m.global_offset))
+                merged.storage_metadata.update(st_md)
+                merged.global_shape.update(gshape)
+            md = merged
     if proc == coordinator_rank:
         with open(os.path.join(path, "0.metadata"), "w") as f:
             json.dump(md.to_json(), f)
+    if _rt.is_multiprocess():
+        _rt.host_barrier("ckpt_save")  # all files durable before return

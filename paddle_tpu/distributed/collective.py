@@ -36,7 +36,8 @@ from ..tensor import Tensor
 __all__ = [
     "ReduceOp", "Group", "ProcessGroup", "init_parallel_env", "new_group",
     "get_group", "get_rank", "get_world_size", "all_reduce", "all_gather",
-    "all_gather_object", "all_to_all", "reduce_scatter", "broadcast",
+    "all_gather_object", "broadcast_object_list", "all_to_all",
+    "reduce_scatter", "broadcast",
     "reduce", "scatter", "send", "recv", "isend", "irecv", "barrier",
     "spmd_region", "in_spmd_region", "split_group", "stream",
     "all_reduce_mean_value", "wait", "ppermute", "axis_index",
@@ -113,11 +114,14 @@ def _mesh_devices(n: Optional[int] = None):
 
 def init_parallel_env(mesh: Optional[jax.sharding.Mesh] = None,
                       strategy=None) -> Group:
-    """(reference: python/paddle/distributed/parallel.py:943 — TCPStore
-    rendezvous + ProcessGroupNCCL creation. TPU-native: the PJRT client
-    already knows every chip; multi-host rendezvous happens in
-    jax.distributed.initialize via the launch module. Here we build the
-    world mesh and the default group.)"""
+    """(reference: python/paddle/distributed/parallel.py:943-1101 —
+    TCPStore rendezvous → ProcessGroup creation. TPU-native: the same
+    TCPStore bootstraps ``jax.distributed.initialize`` (runtime.py), after
+    which ``jax.devices()`` is the GLOBAL device list; the world mesh is
+    built over it and in-graph collectives cross processes.)"""
+    from . import runtime as _rt
+
+    _rt.ensure_initialized()
     if _world.initialized and mesh is None:
         return _world.default_group
     if mesh is None:
@@ -186,7 +190,59 @@ def new_group(ranks=None, backend=None, timeout=None,
 
 
 def split_group(parent: Group, every: int) -> Group:
-    raise NotImplementedError
+    """Split ``parent`` into contiguous subgroups of size ``every``.
+
+    TPU-native: a mesh axis of size ``n = k*every`` factors into
+    ``(outer k, inner every)``; the subgroup is the *inner* axis. When
+    the world mesh owns the parent axis we reshape it into two axes and
+    return a Group over the inner one (reference analog:
+    python/paddle/distributed/communication/group.py split by rank list).
+    """
+    enforce(parent.nranks % every == 0,
+            f"split_group: {parent.nranks} ranks not divisible by {every}")
+    if parent.nranks == every:
+        return parent
+    mesh = _world.mesh
+    if mesh is not None and len(parent.axis_names) == 1 \
+            and parent.axis_names[0] in mesh.axis_names:
+        ax = parent.axis_names[0]
+        outer = parent.nranks // every
+        inner_name, outer_name = f"{ax}_in{every}", f"{ax}_out{every}"
+        if inner_name not in mesh.axis_names:
+            # rebuild the world mesh with the parent axis factored
+            # (outer-major, so linearised (outer, inner) order == the
+            # original axis order) and rewrite EVERY existing group that
+            # referenced the old axis onto the (outer, inner) pair —
+            # psum over both sub-axes is exactly psum over the original
+            # axis, so their collectives keep the same semantics.
+            axes, sizes = [], []
+            for a in mesh.axis_names:
+                if a == ax:
+                    axes += [outer_name, inner_name]
+                    sizes += [outer, every]
+                else:
+                    axes.append(a)
+                    sizes.append(mesh.shape[a])
+            _world.mesh = jax.sharding.Mesh(
+                mesh.devices.reshape(sizes), tuple(axes))
+            for g in _world.groups.values():
+                if ax in g.axis_names:
+                    g.axis_names = tuple(
+                        sub for a in g.axis_names
+                        for sub in ((outer_name, inner_name) if a == ax
+                                    else (a,)))
+        g = Group((inner_name,), every, name=f"{parent.name}/{every}")
+        _world.groups[g.id] = g
+        return g
+    # no owning mesh axis: host-side subgroup — members are the
+    # contiguous block of `every` ranks containing THIS process
+    from . import runtime as _rt
+
+    lo = (_rt.process_rank() // every) * every
+    g = Group((), every, name=f"{parent.name}/{every}")
+    g._ranks = list(range(lo, lo + every))
+    _world.groups[g.id] = g
+    return g
 
 
 # ---------------------------------------------------------------------------
@@ -234,7 +290,14 @@ def _psum_like(x, op: int, axes):
     if op == ReduceOp.AVG:
         return lax.pmean(x, axes)
     if op == ReduceOp.PROD:
-        return jnp.exp(lax.psum(jnp.log(x), axes))
+        # sign/zero-correct product: magnitude via exp∘psum∘log of |x|,
+        # sign via negative-count parity, zero if any member holds a zero
+        zero = lax.pmax((x == 0).astype(x.dtype), axes)
+        negs = lax.psum((x < 0).astype(jnp.int32), axes)
+        sign = jnp.where(negs % 2 == 0, jnp.ones_like(x), -jnp.ones_like(x))
+        safe = jnp.where(x == 0, jnp.ones_like(x), jnp.abs(x))
+        mag = jnp.exp(lax.psum(jnp.log(safe), axes))
+        return jnp.where(zero > 0, jnp.zeros_like(x), sign * mag)
     raise ValueError(f"bad reduce op {op}")
 
 
@@ -281,6 +344,16 @@ def _c_ppermute(x, axes=(), perm=()):
 def _group_axes(group: Optional[Group]):
     g = group or _world.default_group
     if g is None or not g.axis_names:
+        # a rank-list group with >1 members but no mesh axis cannot lower
+        # to an XLA collective — silently becoming an identity would be a
+        # correctness trap, so fail loudly inside traced SPMD code
+        if (in_spmd_region() and g is not None and g.nranks > 1
+                and getattr(g, "_ranks", None)):
+            raise PreconditionNotMetError(
+                f"group {g.name!r} was created from a rank list without a "
+                f"mesh axis; inside an SPMD region collectives need mesh "
+                f"axes — create the group via the hybrid topology "
+                f"(fleet.init) or new_group(axis_names=...)")
         return None
     return g.axis_names
 
@@ -325,7 +398,7 @@ def all_gather(tensor_list: Optional[List], tensor: Tensor = None,
         n = (group or _world.default_group).nranks
         from ..ops.manipulation import split as _split
 
-        tensor_list.extend(_split(out, n, axis=0))
+        tensor_list.extend(_split(out, n, axis=axis))
     return out
 
 
@@ -430,21 +503,86 @@ def ppermute(tensor: Tensor, perm: List[Tuple[int, int]],
 
 def send(tensor: Tensor, dst: int = 0, group: Optional[Group] = None,
          sync_op: bool = True):
-    raise PreconditionNotMetError(
-        "point-to-point send/recv are expressed as ppermute pairs in the "
-        "SPMD model; use paddle_tpu.distributed.ppermute or the pipeline "
-        "p2p helpers (fleet.meta_parallel.pp_utils)")
+    """Point-to-point send.
+
+    Inside an SPMD region p2p is a *collective* — use
+    :func:`ppermute` (which lowers to XLA collective-permute on ICI,
+    the pipeline engine's p2p primitive). Eagerly (outside shard_map)
+    this is a host-side transfer over the TCPStore/DCN — the role the
+    reference's gloo send fills (process_group_gloo.cc).
+    """
+    if in_spmd_region():
+        raise PreconditionNotMetError(
+            "inside an SPMD region p2p is collective: express the "
+            "send/recv pair as paddle_tpu.distributed.ppermute(tensor, "
+            "perm=[(src, dst)])")
+    from . import runtime as _rt
+
+    val = np.asarray(tensor._value if isinstance(tensor, Tensor) else tensor)
+    if not _rt.is_multiprocess():
+        _loopback.setdefault((0, int(dst)), []).append(val)  # self-send
+        return _SendRecvTask(tensor)
+    _rt.send_object(val, dst)
+    return _SendRecvTask(tensor)
 
 
-recv = send
-isend = send
-irecv = send
+def recv(tensor: Tensor, src: int = 0, group: Optional[Group] = None,
+         sync_op: bool = True):
+    if in_spmd_region():
+        raise PreconditionNotMetError(
+            "inside an SPMD region p2p is collective: express the "
+            "send/recv pair as paddle_tpu.distributed.ppermute(tensor, "
+            "perm=[(src, dst)])")
+    from . import runtime as _rt
+
+    if not _rt.is_multiprocess():
+        q = _loopback.get((int(src), 0))
+        enforce(q, f"recv(src={src}): no matching send buffered "
+                   f"(single-process loopback)")
+        val = q.pop(0)
+    else:
+        val = _rt.recv_object(src)
+    arr = jnp.asarray(val)
+    if isinstance(tensor, Tensor):
+        tensor._value = arr.astype(tensor._value.dtype).reshape(
+            tensor._value.shape)
+    return _SendRecvTask(tensor)
+
+
+# single-process (src,dst) -> FIFO of pending sends, so a send/recv pair
+# in a world of 1 still transfers the bytes instead of silently no-opping
+_loopback: Dict[Tuple[int, int], List] = {}
+
+
+class _SendRecvTask:
+    """Completed-task handle (API parity with ProcessGroup::Task)."""
+
+    def __init__(self, tensor):
+        self.tensor = tensor
+
+    def wait(self):
+        return self.tensor
+
+    def is_completed(self):
+        return True
+
+
+def isend(tensor, dst=0, group=None):
+    return send(tensor, dst, group, sync_op=False)
+
+
+def irecv(tensor, src=0, group=None):
+    return recv(tensor, src, group, sync_op=False)
 
 
 def barrier(group: Optional[Group] = None):
     if not in_spmd_region():
-        # host-level barrier: all queued device work done
+        from . import runtime as _rt
+
+        # device flush + cross-process host barrier (reference: gloo
+        # barrier in process_group_gloo.cc; here the TCPStore counter)
         jnp.zeros(()).block_until_ready()
+        _rt.host_barrier("dist_barrier")
         return
     return None
 
@@ -454,7 +592,20 @@ def wait(tensor, group=None, use_calc_stream=True):
 
 
 def all_gather_object(object_list, obj, group=None):
-    object_list.append(obj)
+    """Gather picklable objects from every process (reference:
+    python/paddle/distributed/communication/all_gather.py object path —
+    gloo-backed; here pickled blobs through the TCPStore over DCN)."""
+    from . import runtime as _rt
+
+    object_list.extend(_rt.all_gather_object_host(obj))
+    return object_list
+
+
+def broadcast_object_list(object_list, src: int = 0, group=None):
+    from . import runtime as _rt
+
+    # one blob + one barrier for the whole list (not per element)
+    object_list[:] = _rt.broadcast_object_host(list(object_list), src=src)
     return object_list
 
 

@@ -159,11 +159,48 @@ def _mesh_data_axes(mesh: Mesh):
                  if a in mesh.axis_names and mesh.shape[a] > 1)
 
 
+def _multiprocess(mesh: Mesh) -> bool:
+    return jax.process_count() > 1
+
+
+def global_put(value, mesh: Mesh, spec: P):
+    """Place a host-replicated value as a global array sharded by spec.
+
+    Single-process: plain device_put. Multi-process: every process holds
+    the FULL value (deterministic init); each contributes its addressable
+    shards (reference analog: broadcast_mp_parameters — here no traffic,
+    the copy is local because the host already has the bytes).
+    """
+    sh = NamedSharding(mesh, spec)
+    if not _multiprocess(mesh):
+        return jax.device_put(value, sh)
+    np_val = np.asarray(value)
+    return jax.make_array_from_callback(np_val.shape, sh,
+                                        lambda idx: np_val[idx])
+
+
+def _globalize_batch(leaf_vals, b_specs, mesh: Mesh):
+    """Multi-process: each process feeds its LOCAL batch (the
+    DistributedBatchSampler contract); assemble global arrays whose
+    data-axis shards are the per-process pieces."""
+    if not _multiprocess(mesh):
+        return leaf_vals
+    from jax.experimental import multihost_utils as mh
+
+    out = []
+    for v, spec in zip(leaf_vals, b_specs):
+        if spec == P() or all(s is None for s in spec):
+            out.append(global_put(v, mesh, spec))
+        else:
+            out.append(mh.host_local_array_to_global_array(
+                np.asarray(v), mesh, spec))
+    return tuple(out)
+
+
 def shard_module_params(model, mesh: Mesh):
     """Physically shard every parameter per its dist_attr (global arrays)."""
     for p in model.parameters():
-        sh = NamedSharding(mesh, param_spec(p))
-        p._value = jax.device_put(p._value, sh)
+        p._value = global_put(p._value, mesh, param_spec(p))
     return model
 
 
@@ -197,8 +234,7 @@ class ParallelEngine:
         self._compiled: Dict[Any, Callable] = {}
         self._zero = _ZeroPlan(mesh, self.trainable, optimizer)
         for p in self.params:
-            sh = NamedSharding(mesh, self._zero.storage_spec(p))
-            p._value = jax.device_put(p._value, sh)
+            p._value = global_put(p._value, mesh, self._zero.storage_spec(p))
 
     # -- optimizer state management -------------------------------------
     def _ensure_opt_states(self):
@@ -207,14 +243,15 @@ class ParallelEngine:
         states = []
         for p in self.trainable:
             st = opt._param_state(p, shapes)
-            sh = NamedSharding(self.mesh, self._zero.state_spec(p))
-            st = {k: jax.device_put(v, sh) if v.shape == tuple(p._value.shape)
+            spec = self._zero.state_spec(p)
+            st = {k: global_put(v, self.mesh, spec)
+                  if v.shape == tuple(p._value.shape)
                   else v for k, v in st.items()}
             opt._states[id(p)] = st
             states.append(st)
             mw = opt._master_weights.get(id(p))
             if mw is not None:
-                opt._master_weights[id(p)] = jax.device_put(mw, sh)
+                opt._master_weights[id(p)] = global_put(mw, self.mesh, spec)
         return states
 
     # -- the compiled step ----------------------------------------------
@@ -419,6 +456,11 @@ class ParallelEngine:
             lr = jnp.asarray(opt.get_lr(), jnp.float32)
             stepc = jnp.asarray(opt._step_count, jnp.int32)
             seed = jnp.asarray(self._seed, jnp.uint32)
+            leaf_vals = _globalize_batch(leaf_vals, b_specs, mesh)
+            if _multiprocess(mesh):
+                lr = global_put(lr, mesh, P())
+                stepc = global_put(stepc, mesh, P())
+                seed = global_put(seed, mesh, P())
             lv, new_p, new_s, new_m = self._compiled[key](
                 pvals, svals, mvals, leaf_vals, lr, stepc, seed)
             for p, nv in zip(params, new_p):
@@ -483,6 +525,7 @@ class ParallelEngine:
                                   for v in leaf_vals), b_specs, str(ospec))
             if key not in compiled:
                 compiled[key] = make(treedef, b_specs, ospec)
+            leaf_vals = _globalize_batch(leaf_vals, b_specs, mesh)
             out = compiled[key](tuple(p._value for p in params), leaf_vals)
             return jax.tree_util.tree_map(
                 lambda v: Tensor(v, stop_gradient=True), out)

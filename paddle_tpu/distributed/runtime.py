@@ -1,0 +1,144 @@
+"""Multi-process runtime bootstrap + host-side object collectives.
+
+This is the process-real half of the distributed stack (reference:
+python/paddle/distributed/parallel.py:943-1101 — TCPStore rendezvous →
+ProcessGroup creation; the Gloo host collectives the reference keeps for
+object all_gather / barrier). TPU-native layering:
+
+- device collectives  → XLA collectives over ICI inside shard_map
+  (collective.py), which need every process to join one jax runtime:
+  that is ``jax.distributed.initialize``, bootstrapped here over the
+  native TCPStore (csrc/tcp_store.cpp).
+- host collectives    → pickled blobs through the same TCPStore over
+  DCN (the Gloo role: all_gather_object, broadcast_object_list,
+  barrier) — no device traffic, works before any mesh exists.
+
+One process per host drives all local chips (XLA single-controller);
+``launch --nproc_per_node K`` forks K ranked processes for CPU
+simulation, exactly the reference's multi-process test harness
+(SURVEY.md §4: _run_cluster_gloo / fake device strategy).
+"""
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Any, List, Optional
+
+__all__ = [
+    "ensure_initialized", "is_multiprocess", "process_rank",
+    "process_world", "host_barrier", "all_gather_object_host",
+    "broadcast_object_host", "send_object", "recv_object",
+]
+
+_initialized = False
+_gen = 0  # monotonically-increasing collective-call counter
+
+
+def process_world() -> int:
+    return int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+
+
+def process_rank() -> int:
+    return int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+
+
+def is_multiprocess() -> bool:
+    return process_world() > 1
+
+
+def _store():
+    from .store import create_or_get_global_tcp_store
+
+    return create_or_get_global_tcp_store()
+
+
+def ensure_initialized() -> None:
+    """Join the global jax runtime (idempotent).
+
+    The actual ``jax.distributed.initialize`` runs in
+    ``paddle_tpu._bootstrap`` at import time — it must precede any XLA
+    backend touch. This re-invocation covers direct users of the
+    distributed API in embeddings where the package import order differs.
+    After it, ``jax.devices()`` is the GLOBAL device list and in-graph
+    collectives cross process boundaries (gloo on CPU, ICI/DCN on TPU).
+    """
+    global _initialized
+    if _initialized:
+        return
+    from .._bootstrap import bootstrap
+
+    bootstrap()
+    _initialized = True
+
+
+# ---------------------------------------------------------------------------
+# Host-side object collectives (the Gloo role). All ranks must call each
+# collective the same number of times in the same order — the shared
+# generation counter keys each call's store namespace so values never
+# collide across calls or restarts.
+# ---------------------------------------------------------------------------
+
+
+def _next_gen() -> int:
+    global _gen
+    _gen += 1
+    return _gen
+
+
+def host_barrier(name: str = "host", timeout: Optional[float] = None) -> None:
+    if not is_multiprocess():
+        return
+    _store().barrier(f"{name}/{_next_gen()}", process_world(), timeout)
+
+
+def all_gather_object_host(obj: Any,
+                           timeout: Optional[float] = None) -> List[Any]:
+    """Gather one picklable object from every process, ordered by rank."""
+    if not is_multiprocess():
+        return [obj]
+    store, gen = _store(), _next_gen()
+    rank, world = process_rank(), process_world()
+    store.set(f"og/{gen}/{rank}", pickle.dumps(obj, protocol=4))
+    out = [pickle.loads(store.get(f"og/{gen}/{r}", timeout))
+           for r in range(world)]
+    # clean own key next round: barrier then delete own slot
+    store.barrier(f"og/{gen}", world, timeout)
+    store.delete_key(f"og/{gen}/{rank}")
+    return out
+
+
+def broadcast_object_host(obj: Any, src: int = 0,
+                          timeout: Optional[float] = None) -> Any:
+    if not is_multiprocess():
+        return obj
+    store, gen = _store(), _next_gen()
+    if process_rank() == src:
+        store.set(f"bc/{gen}", pickle.dumps(obj, protocol=4))
+        out = obj
+    else:
+        out = pickle.loads(store.get(f"bc/{gen}", timeout))
+    store.barrier(f"bc/{gen}/done", process_world(), timeout)
+    if process_rank() == src:
+        store.delete_key(f"bc/{gen}")
+    return out
+
+
+def send_object(obj: Any, dst: int) -> None:
+    """Host-side point-to-point (the reference's eager send over gloo).
+
+    Pairs with :func:`recv_object` on ``dst``. Per-(src,dst) sequence
+    numbers keep repeated sends ordered without a global generation.
+    """
+    store = _store()
+    src = process_rank()
+    seq = store.add(f"p2p/{src}->{dst}/seq", 1)
+    store.set(f"p2p/{src}->{dst}/{seq}", pickle.dumps(obj, protocol=4))
+
+
+def recv_object(src: int, timeout: Optional[float] = None) -> Any:
+    store = _store()
+    dst = process_rank()
+    seq = store.add(f"p2p/{src}->{dst}/rseq", 1)
+    data = store.get(f"p2p/{src}->{dst}/{seq}", timeout)
+    store.delete_key(f"p2p/{src}->{dst}/{seq}")
+    return pickle.loads(data)

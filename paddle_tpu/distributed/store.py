@@ -95,11 +95,19 @@ class TCPStore:
 
     def barrier(self, name: str, world_size: int,
                 timeout: Optional[float] = None) -> None:
-        """Count-up barrier via the atomic ADD counter."""
+        """Reusable count-up barrier via the atomic ADD counter.
+
+        The go-key is namespaced by generation (arrival count //
+        world_size), so the same barrier name can be reused across steps
+        and across elastic restarts without tripping on a stale go-key
+        left in the store by a previous generation.
+        """
         n = self.add(f"__barrier__/{name}", 1)
-        if n >= world_size:
-            self.set(f"__barrier__/{name}/go", b"1")
-        self.wait(f"__barrier__/{name}/go", timeout)
+        gen = (n - 1) // world_size
+        go = f"__barrier__/{name}/go/{gen}"
+        if n == (gen + 1) * world_size:
+            self.set(go, b"1")
+        self.wait(go, timeout)
 
     def close(self) -> None:
         if self._fd >= 0:

@@ -36,7 +36,21 @@ def flash_attention(q, k, v, causal=False, dropout=0.0, dropout_key=None):
 
             # positional: custom_vjp nondiff args reject keywords
             return flash_attention_fwd(q, k, v, causal, None, None)
-        except ValueError:
-            pass  # unsupported shape → XLA fallback below
+        except (KeyboardInterrupt, SystemExit):
+            raise
+        except Exception as e:  # unsupported shape, Mosaic compile
+            # failure, platform quirk — keep training alive on the XLA
+            # path rather than dying on a kernel-only problem.
+            global _warned_fallback
+            if not _warned_fallback:
+                _warned_fallback = True
+                import warnings
+
+                warnings.warn(
+                    f"flash_attention: Pallas kernel unavailable "
+                    f"({type(e).__name__}: {e}); using XLA fallback")
     return _sdpa_raw(q, k, v, attn_mask=None, dropout_p=dropout,
                      is_causal=causal, dropout_key=dropout_key)
+
+
+_warned_fallback = False

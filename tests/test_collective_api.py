@@ -1,0 +1,136 @@
+"""Eager collective API surface: the holes VERDICT r1 flagged.
+
+(reference surface: python/paddle/distributed/communication/ — every
+entry point works, none raises NotImplementedError.)
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+from paddle_tpu.core.enforce import PreconditionNotMetError
+
+
+def _mesh(n=4, name="x"):
+    return Mesh(np.array(jax.devices()[:n]), (name,))
+
+
+def _run_spmd(mesh, fn, x, in_spec, out_spec):
+    from paddle_tpu.distributed.engine import _shard_map
+
+    def wrapped(v):
+        with dist.spmd_region():
+            t = paddle.Tensor(v, stop_gradient=True)
+            out = fn(t)
+            return out._value if hasattr(out, "_value") else out
+
+    return np.asarray(jax.jit(_shard_map(
+        wrapped, mesh, (in_spec,), out_spec))(x))
+
+
+def test_reduce_prod_negatives_and_zeros():
+    mesh = _mesh(4)
+    g = dist.new_group(axis_names=("x",), nranks=4)
+    vals = np.array([2.0, -3.0, 4.0, -5.0], np.float32)
+    out = _run_spmd(mesh, lambda t: dist.all_reduce(t, op=dist.ReduceOp.PROD,
+                                                    group=g),
+                    vals, P("x"), P("x"))
+    np.testing.assert_allclose(out, np.full(4, 120.0), rtol=1e-5)
+    # odd number of negatives
+    vals = np.array([2.0, -3.0, 4.0, 5.0], np.float32)
+    out = _run_spmd(mesh, lambda t: dist.all_reduce(t, op=dist.ReduceOp.PROD,
+                                                    group=g),
+                    vals, P("x"), P("x"))
+    np.testing.assert_allclose(out, np.full(4, -120.0), rtol=1e-5)
+    # any zero -> 0
+    vals = np.array([2.0, 0.0, 4.0, -5.0], np.float32)
+    out = _run_spmd(mesh, lambda t: dist.all_reduce(t, op=dist.ReduceOp.PROD,
+                                                    group=g),
+                    vals, P("x"), P("x"))
+    np.testing.assert_allclose(out, np.zeros(4), atol=1e-6)
+
+
+def test_all_gather_axis_nonzero():
+    mesh = _mesh(4)
+    g = dist.new_group(axis_names=("x",), nranks=4)
+    x = np.arange(4 * 2 * 3, dtype=np.float32).reshape(4, 2, 3)
+
+    def fn(t):
+        parts = []
+        out = dist.all_gather(parts, t, group=g, axis=1)
+        # tensor_list entries must be the per-rank slices along `axis`
+        assert len(parts) == 4
+        assert tuple(parts[0].shape) == (1, 2, 3)
+        return out
+
+    out = _run_spmd(mesh, fn, x, P("x"), P("x", None, None))
+    # each rank gathers all 4 shards along axis=1: local (1,8,3)
+    assert out.shape == (4, 8, 3)
+
+
+def test_axisless_rank_group_fails_loudly_in_spmd():
+    mesh = _mesh(4)
+    dist.init_parallel_env()
+    g = dist.new_group(ranks=[0, 1])
+    with pytest.raises(Exception) as ei:
+        _run_spmd(mesh, lambda t: dist.all_reduce(t, group=g),
+                  np.ones(4, np.float32), P("x"), P("x"))
+    assert "mesh ax" in str(ei.value) or "axis" in str(ei.value)
+
+
+def test_split_group_over_mesh_axis():
+    dist.collective._world.initialized = False
+    dist.init_parallel_env(Mesh(np.array(jax.devices()[:8]), ("world",)))
+    parent = dist.get_group(0)
+    sub = dist.split_group(parent, 4)
+    assert sub.nranks == 4
+    assert sub.axis_names  # device-collective capable
+    mesh = dist.collective.get_world_mesh()
+    assert sub.axis_names[0] in mesh.axis_names
+    assert mesh.shape[sub.axis_names[0]] == 4
+    # the parent/world group must STILL be collective-capable after the
+    # mesh refactor: its axis was rewritten onto the (outer, inner) pair
+    assert all(a in mesh.axis_names for a in parent.axis_names)
+    x = np.ones(8, np.float32)
+    out = _run_spmd(mesh, lambda t: dist.all_reduce(t, group=parent),
+                    x, P(parent.axis_names), P(parent.axis_names))
+    np.testing.assert_allclose(out, np.full(8, 8.0))
+    # and the subgroup reduces over its 4 members only
+    vals = np.arange(8, dtype=np.float32)
+    out = _run_spmd(mesh, lambda t: dist.all_reduce(t, group=sub),
+                    vals, P(parent.axis_names), P(parent.axis_names))
+    np.testing.assert_allclose(out, np.array([6, 6, 6, 6, 22, 22, 22, 22],
+                                             np.float32))
+
+
+def test_send_recv_single_process_loopback():
+    t = paddle.to_tensor(np.arange(4, dtype=np.float32))
+    task = dist.isend(t, dst=0)
+    assert task.is_completed()
+    r = paddle.to_tensor(np.zeros(4, dtype=np.float32))
+    dist.irecv(r, src=0).wait()
+    np.testing.assert_allclose(np.asarray(r._value),
+                               np.arange(4, dtype=np.float32))
+
+
+def test_send_recv_rejected_inside_spmd():
+    mesh = _mesh(2)
+    with pytest.raises(PreconditionNotMetError):
+        _run_spmd(mesh, lambda t: dist.send(t, dst=1),
+                  np.ones(2, np.float32), P("x"), P("x"))
+
+
+def test_broadcast_object_list_single_process():
+    objs = [{"a": 1}]
+    dist.broadcast_object_list(objs, src=0)
+    assert objs == [{"a": 1}]
+
+
+def test_all_gather_object_single_process():
+    out = []
+    dist.all_gather_object(out, {"r": 0})
+    assert out == [{"r": 0}]

@@ -1,0 +1,93 @@
+"""Trainer worker for the multi-process runtime tests.
+
+Run as one ranked process of a pod (env: PADDLE_TRAINER_ID,
+PADDLE_TRAINERS_NUM, PADDLE_MASTER, TEST_DP, TEST_OUT). Trains GPT-tiny
+for a few steps under the ParallelEngine over a dp mesh that may span
+processes (jax.distributed over the native TCPStore), then exercises the
+host-side object collectives and p2p. The parent test asserts loss
+parity between a 1-process and a 2-process run of the same global batch
+(the reference's TestDistBase._run_cluster_gloo loss-parity pattern,
+test/legacy_test/test_dist_base.py:959).
+"""
+import json
+import os
+import sys
+
+os.environ.setdefault("XLA_FLAGS",
+                      "--xla_force_host_platform_device_count=1")
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np  # noqa: E402
+
+import paddle_tpu as paddle  # noqa: E402
+import paddle_tpu.distributed as dist  # noqa: E402
+from paddle_tpu.distributed import fleet  # noqa: E402
+from paddle_tpu.distributed.engine import ParallelEngine  # noqa: E402
+from paddle_tpu.models import (GPTForCausalLM,  # noqa: E402
+                               GPTPretrainingCriterion, gpt_tiny)
+
+
+def main():
+    out_path = os.environ["TEST_OUT"]
+    rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+    world = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+    dp = int(os.environ.get("TEST_DP", "2"))
+
+    dist.init_parallel_env()
+    assert len(jax.devices()) >= dp, \
+        f"global devices {len(jax.devices())} < dp {dp}"
+
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": dp, "mp_degree": 1,
+                               "pp_degree": 1}
+    hcg = fleet.init(is_collective=True, strategy=strategy)
+
+    paddle.seed(42)
+    cfg = gpt_tiny()
+    model = GPTForCausalLM(cfg)
+    crit = GPTPretrainingCriterion(cfg)
+    opt = paddle.optimizer.SGD(learning_rate=0.05,
+                               parameters=model.parameters())
+    eng = ParallelEngine(model, opt, hcg.mesh)
+    step = eng.train_step(lambda m, b: crit(m(b["x"]), b["y"]))
+
+    B, S, V = 8, 16, cfg.vocab_size
+    r = np.random.RandomState(0)
+    losses = []
+    for _ in range(3):
+        ids = r.randint(0, V, (B, S + 1))
+        x, y = ids[:, :-1], ids[:, 1:]
+        if world > 1:
+            lo, hi = rank * B // world, (rank + 1) * B // world
+            x, y = x[lo:hi], y[lo:hi]
+        loss = step({"x": paddle.to_tensor(x), "y": paddle.to_tensor(y)})
+        losses.append(float(loss))
+
+    result = {"rank": rank, "losses": losses}
+    if world > 1:
+        gathered = []
+        dist.all_gather_object(gathered, {"rank": rank, "tag": "hello"})
+        result["gathered"] = gathered
+        objs = [{"payload": 123} if rank == 0 else None]
+        dist.broadcast_object_list(objs, src=0)
+        result["bcast"] = objs[0]
+        if rank == 0:
+            dist.send(paddle.to_tensor(
+                np.arange(4, dtype=np.float32) + 1.0), dst=1)
+        elif rank == 1:
+            t = paddle.to_tensor(np.zeros(4, dtype=np.float32))
+            dist.recv(t, src=0)
+            result["recv"] = np.asarray(t._value).tolist()
+        dist.barrier()
+    with open(f"{out_path}.{rank}", "w") as f:
+        json.dump(result, f)
+
+
+if __name__ == "__main__":
+    main()
