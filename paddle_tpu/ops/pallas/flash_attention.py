@@ -1,20 +1,26 @@
-"""Pallas TPU flash attention (forward kernel + recompute backward).
+"""Pallas TPU flash attention (forward + backward kernels).
 
 TPU-native replacement for the reference's dynloaded flashattn-v2 CUDA
 library (reference: phi/kernels/gpu/flash_attn_kernel.cu,
-backends/dynload/flashattn.h, python surface
+flash_attn_grad_kernel.cu, backends/dynload/flashattn.h, python surface
 nn/functional/flash_attention.py:147).
 
 Design: classic flash — the q block lives in VMEM, k/v stream through
 VMEM blocks, online-softmax statistics (m, l) carried through a
 fori_loop so attention probabilities never hit HBM. The causal variant
 skips k/v blocks entirely above the diagonal (the loop's upper bound is
-a function of the q-block index), halving FLOPs. Backward recomputes
-through the XLA softmax-attention VJP under jax.checkpoint semantics —
-residuals are just (q, k, v), preserving flash's O(S) memory.
+a function of the q-block index), halving FLOPs.
+
+Backward (FlashAttention-2 recurrence, the capability of the
+reference's flash_attn_grad_kernel.cu): the forward additionally emits
+the per-row logsumexp L; backward recomputes P = exp(S - L) blockwise in
+VMEM and runs TWO kernels — a dq kernel gridded over q blocks and a
+dk/dv kernel gridded over kv blocks (TPU has no atomics, so each output
+gets its own reduction loop). Residual memory is O(S) per head
+(L + delta), never O(S²).
 
 Layout [B, S, H, D] (the paddle flash_attention layout). Grid:
-(B*H, S/block_q); f32 accumulation; MXU-shaped tiles (128 lanes).
+(B*H, S/block); f32 accumulation; MXU-shaped tiles (128 lanes).
 """
 from __future__ import annotations
 
@@ -41,7 +47,15 @@ __all__ = ["flash_attention_fwd"]
 _NEG = -1e30
 
 
-def _kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal, block_q,
+def _causal_mask(qi, j, block_q, block_kv):
+    rows = qi * block_q + lax.broadcasted_iota(
+        jnp.int32, (block_q, block_kv), 0)
+    cols = j * block_kv + lax.broadcasted_iota(
+        jnp.int32, (block_q, block_kv), 1)
+    return rows >= cols
+
+
+def _kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal, block_q,
             block_kv, seq_kv):
     qb = q_ref[0].astype(jnp.float32) * scale            # [bq, D]
     qi = pl.program_id(1)
@@ -55,11 +69,7 @@ def _kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal, block_q,
         s = lax.dot_general(qb, kb, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32)
         if causal:
-            rows = qi * block_q + lax.broadcasted_iota(
-                jnp.int32, (block_q, block_kv), 0)
-            cols = j * block_kv + lax.broadcasted_iota(
-                jnp.int32, (block_q, block_kv), 1)
-            keep = rows >= cols
+            keep = _causal_mask(qi, j, block_q, block_kv)
             s = jnp.where(keep, s, _NEG)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
         p = jnp.exp(s - m_new)
@@ -82,7 +92,9 @@ def _kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal, block_q,
     else:
         upper = nkv
     m, l, acc = lax.fori_loop(0, upper, body, (m0, l0, acc0))
-    o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+    l = jnp.maximum(l, 1e-30)
+    o_ref[0] = (acc / l).astype(o_ref.dtype)
+    lse_ref[0, 0, :] = (m + jnp.log(l))[:, 0]
 
 
 def _pallas_fa(q3, k3, v3, causal, scale, block_q, block_kv, interpret):
@@ -98,11 +110,146 @@ def _pallas_fa(q3, k3, v3, causal, scale, block_q, block_kv, interpret):
             pl.BlockSpec((1, Skv, D), lambda b, i: (b, 0, 0), **kw),
             pl.BlockSpec((1, Skv, D), lambda b, i: (b, 0, 0), **kw),
         ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0), **kw),
+            pl.BlockSpec((1, 1, block_q), lambda b, i: (b, 0, i), **kw),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, S, D), q3.dtype),
+            jax.ShapeDtypeStruct((BH, 1, S), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q3, k3, v3)
+
+
+# ---------------------------------------------------------------------------
+# Backward kernels (reference capability: flash_attn_grad_kernel.cu)
+# ---------------------------------------------------------------------------
+
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref, dq_ref, *,
+               scale, causal, block_q, block_kv, seq_kv):
+    qb = q_ref[0].astype(jnp.float32) * scale            # [bq, D]
+    dob = do_ref[0].astype(jnp.float32)                  # [bq, D]
+    lse = lse_ref[0, 0, :].astype(jnp.float32)[:, None]   # [bq, 1]
+    delta = dl_ref[0, 0, :].astype(jnp.float32)[:, None]  # [bq, 1]
+    qi = pl.program_id(1)
+    D = qb.shape[-1]
+    nkv = seq_kv // block_kv
+
+    def body(j, dq):
+        kb = k_ref[0, pl.ds(j * block_kv, block_kv), :].astype(jnp.float32)
+        vb = v_ref[0, pl.ds(j * block_kv, block_kv), :].astype(jnp.float32)
+        s = lax.dot_general(qb, kb, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+        if causal:
+            keep = _causal_mask(qi, j, block_q, block_kv)
+            s = jnp.where(keep, s, _NEG)
+        p = jnp.exp(s - lse)                             # [bq, bkv]
+        if causal:
+            p = jnp.where(keep, p, 0.0)
+        dp = lax.dot_general(dob, vb, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        return dq + lax.dot_general(ds, kb, (((1,), (0,)), ((), ())),
+                                    preferred_element_type=jnp.float32)
+
+    if causal:
+        upper = jnp.minimum(
+            (qi * block_q + block_q + block_kv - 1) // block_kv, nkv)
+    else:
+        upper = nkv
+    dq = lax.fori_loop(0, upper, body, jnp.zeros((block_q, D), jnp.float32))
+    dq_ref[0] = (dq * scale).astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref, dk_ref,
+                dv_ref, *, scale, causal, block_q, block_kv, seq_q):
+    kb = k_ref[0].astype(jnp.float32)                    # [bkv, D]
+    vb = v_ref[0].astype(jnp.float32)
+    ki = pl.program_id(1)
+    D = kb.shape[-1]
+    nq = seq_q // block_q
+
+    def body(i, carry):
+        dk, dv = carry
+        qb = q_ref[0, pl.ds(i * block_q, block_q), :].astype(
+            jnp.float32) * scale
+        dob = do_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[0, 0, pl.ds(i * block_q, block_q)].astype(
+            jnp.float32)[:, None]
+        delta = dl_ref[0, 0, pl.ds(i * block_q, block_q)].astype(
+            jnp.float32)[:, None]
+        s = lax.dot_general(qb, kb, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+        if causal:
+            keep = _causal_mask(i, ki, block_q, block_kv)
+            s = jnp.where(keep, s, _NEG)
+        p = jnp.exp(s - lse)
+        if causal:
+            p = jnp.where(keep, p, 0.0)
+        dv = dv + lax.dot_general(p, dob, (((0,), (0,)), ((), ())),
+                                  preferred_element_type=jnp.float32)
+        dp = lax.dot_general(dob, vb, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        dk = dk + lax.dot_general(ds, qb, (((0,), (0,)), ((), ())),
+                                  preferred_element_type=jnp.float32)
+        return dk, dv
+
+    # causal: q blocks strictly before this kv block see none of it
+    lower = (ki * block_kv) // block_q if causal else 0
+    z = jnp.zeros((block_kv, D), jnp.float32)
+    dk, dv = lax.fori_loop(lower, nq, body, (z, z))
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _pallas_fa_bwd(q3, k3, v3, do3, lse, delta, causal, scale, block_q,
+                   block_kv, interpret):
+    BH, S, D = q3.shape
+    Skv = k3.shape[1]
+    kw = {} if _VMEM is None else {"memory_space": _VMEM}
+    dq = pl.pallas_call(
+        partial(_dq_kernel, scale=scale, causal=causal, block_q=block_q,
+                block_kv=block_kv, seq_kv=Skv),
+        grid=(BH, S // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0), **kw),
+            pl.BlockSpec((1, Skv, D), lambda b, i: (b, 0, 0), **kw),
+            pl.BlockSpec((1, Skv, D), lambda b, i: (b, 0, 0), **kw),
+            pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0), **kw),
+            pl.BlockSpec((1, 1, block_q), lambda b, i: (b, 0, i), **kw),
+            pl.BlockSpec((1, 1, block_q), lambda b, i: (b, 0, i), **kw),
+        ],
         out_specs=pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0),
                                **kw),
         out_shape=jax.ShapeDtypeStruct((BH, S, D), q3.dtype),
         interpret=interpret,
-    )(q3, k3, v3)
+    )(q3, k3, v3, do3, lse, delta)
+    dk, dv = pl.pallas_call(
+        partial(_dkv_kernel, scale=scale, causal=causal, block_q=block_q,
+                block_kv=block_kv, seq_q=S),
+        grid=(BH, Skv // block_kv),
+        in_specs=[
+            pl.BlockSpec((1, S, D), lambda b, j: (b, 0, 0), **kw),
+            pl.BlockSpec((1, block_kv, D), lambda b, j: (b, j, 0), **kw),
+            pl.BlockSpec((1, block_kv, D), lambda b, j: (b, j, 0), **kw),
+            pl.BlockSpec((1, S, D), lambda b, j: (b, 0, 0), **kw),
+            pl.BlockSpec((1, 1, S), lambda b, j: (b, 0, 0), **kw),
+            pl.BlockSpec((1, 1, S), lambda b, j: (b, 0, 0), **kw),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_kv, D), lambda b, j: (b, j, 0), **kw),
+            pl.BlockSpec((1, block_kv, D), lambda b, j: (b, j, 0), **kw),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, Skv, D), k3.dtype),
+            jax.ShapeDtypeStruct((BH, Skv, D), v3.dtype),
+        ],
+        interpret=interpret,
+    )(q3, k3, v3, do3, lse, delta)
+    return dq, dk, dv
 
 
 def _supported(q, k) -> bool:
@@ -112,6 +259,16 @@ def _supported(q, k) -> bool:
 
 def _interpret_default() -> bool:
     return not is_tpu_platform()
+
+
+def _to3(x):
+    B, S, H, D = x.shape
+    return jnp.swapaxes(x, 1, 2).reshape(B * H, S, D)
+
+
+def _from3(x3, B, H):
+    BH, S, D = x3.shape
+    return jnp.swapaxes(x3.reshape(B, H, S, D), 1, 2)
 
 
 @partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
@@ -134,25 +291,29 @@ def _fa_fwd(q, k, v, causal, scale, interpret):
         interpret = _interpret_default()
     block_q = _pick_block(S)
     block_kv = _pick_block(k.shape[1])
-    to3 = lambda x: jnp.swapaxes(x, 1, 2).reshape(B * H, x.shape[1], D)
-    o3 = _pallas_fa(to3(q), to3(k), to3(v), causal, scale, block_q,
-                    block_kv, interpret)
-    out = jnp.swapaxes(o3.reshape(B, H, S, D), 1, 2)
-    return out, (q, k, v)
+    o3, lse = _pallas_fa(_to3(q), _to3(k), _to3(v), causal, scale, block_q,
+                         block_kv, interpret)
+    out = _from3(o3, B, H)
+    return out, (q, k, v, out, lse)
 
 
 def _fa_bwd(causal, scale, interpret, res, g):
-    # recompute-based backward: O(S) residual memory, XLA fuses the
-    # attention VJP (flash backward Pallas kernel is a future upgrade)
-    q, k, v = res
-    from ..nn_ops import scaled_dot_product_attention as _sdpa
-
-    def ref(q_, k_, v_):
-        return _sdpa.raw(q_, k_, v_, attn_mask=None, dropout_p=0.0,
-                         is_causal=causal, scale=scale)
-
-    _, vjp_fn = jax.vjp(ref, q, k, v)
-    return vjp_fn(g)
+    q, k, v, out, lse = res
+    B, S, H, D = q.shape
+    if scale is None:
+        scale = 1.0 / np.sqrt(D)
+    if interpret is None:
+        interpret = _interpret_default()
+    q3, k3, v3 = _to3(q), _to3(k), _to3(v)
+    do3, o3 = _to3(g), _to3(out)
+    # delta_i = rowsum(dO ∘ O): O(S) per head, fused by XLA
+    delta = jnp.sum(do3.astype(jnp.float32) * o3.astype(jnp.float32),
+                    axis=-1)[:, None, :]
+    block_q = _pick_block(S)
+    block_kv = _pick_block(k.shape[1])
+    dq3, dk3, dv3 = _pallas_fa_bwd(q3, k3, v3, do3, lse, delta, causal,
+                                   scale, block_q, block_kv, interpret)
+    return (_from3(dq3, B, H), _from3(dk3, B, H), _from3(dv3, B, H))
 
 
 flash_attention_fwd.defvjp(lambda q, k, v, causal, scale, interpret:

@@ -33,7 +33,7 @@ class Optimizer:
 
     def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
                  grad_clip: Optional[ClipGradBase] = None, name=None,
-                 multi_precision: bool = False):
+                 multi_precision: bool = False, state_dtype=None):
         self._lr = learning_rate
         self._parameter_list = list(parameters) if parameters is not None else None
         self._grad_clip = grad_clip
@@ -45,6 +45,11 @@ class Optimizer:
             self._weight_decay = float(getattr(weight_decay, "_coeff",
                                                getattr(weight_decay, "coeff", 0.0)))
         self._multi_precision = multi_precision
+        # dtype of per-param moment buffers. f32 default (the reference's
+        # AdamW); bf16 halves optimizer-state HBM on memory-bound chips
+        # (the update math still runs in f32 — states are cast in/out).
+        self._state_dtype = (jnp.dtype(state_dtype) if state_dtype
+                             else jnp.float32)
         self._states: Dict[int, Dict[str, jnp.ndarray]] = {}
         self._step_count = 0
         self._jitted = None
@@ -69,7 +74,7 @@ class Optimizer:
         st = self._states.get(id(p))
         if st is None:
             st = {k: jnp.zeros(s if s is not None else p._value.shape,
-                               jnp.float32)
+                               self._state_dtype)
                   for k, s in shapes.items()}
             if self._multi_precision and p._value.dtype != jnp.float32:
                 self._master_weights[id(p)] = p._value.astype(jnp.float32)
@@ -114,6 +119,22 @@ class Optimizer:
                 p._value = nv
             self._states[id(p)] = ns
 
+    def _cast_state_in(self, s):
+        """Moment buffers may be stored low-precision (state_dtype); the
+        update math always runs f32."""
+        if self._state_dtype == jnp.float32:
+            return s
+        return {k: v.astype(jnp.float32)
+                if jnp.issubdtype(v.dtype, jnp.floating) else v
+                for k, v in s.items()}
+
+    def _cast_state_out(self, s):
+        if self._state_dtype == jnp.float32:
+            return s
+        return {k: v.astype(self._state_dtype)
+                if jnp.issubdtype(v.dtype, jnp.floating) else v
+                for k, v in s.items()}
+
     def _fused_update(self, pvals, gvals, states, lr_value, step_value):
         # One jitted executable updating every parameter (multi-tensor
         # fused path — FusedAdam analog). jax.jit caches on pytree
@@ -126,9 +147,10 @@ class Optimizer:
                     gvals, _ = clip.apply_values(list(gvals))
                 out_p, out_s = [], []
                 for p, g, s in zip(pvals, gvals, states):
-                    np_, ns_ = self._update_rule(p, g, s, lr_value, step_value)
+                    np_, ns_ = self._update_rule(
+                        p, g, self._cast_state_in(s), lr_value, step_value)
                     out_p.append(np_)
-                    out_s.append(ns_)
+                    out_s.append(self._cast_state_out(ns_))
                 return tuple(out_p), tuple(out_s)
 
             self._jitted = jax.jit(update_all)
@@ -138,7 +160,9 @@ class Optimizer:
             clip = self._grad_clip
             if clip is not None:
                 gvals, _ = clip.apply_values(list(gvals))
-            out = [self._update_rule(p, g, s, lr_value, step_value)
+            out = [(lambda np_, ns_: (np_, self._cast_state_out(ns_)))(
+                *self._update_rule(p, g, self._cast_state_in(s), lr_value,
+                                   step_value))
                    for p, g, s in zip(pvals, gvals, states)]
             return tuple(o[0] for o in out), tuple(o[1] for o in out)
         return self._jitted(pvals, gvals, states, lr_value, step_value)

@@ -49,6 +49,30 @@ def test_flash_attention_grads():
                                    rtol=2e-4, atol=2e-5, err_msg=name)
 
 
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_attention_grads_multiblock(causal):
+    """S=256 → multiple 128-blocks: exercises the dq upper bound and the
+    dkv lower bound of the backward kernels across block boundaries."""
+    rng = np.random.RandomState(3)
+    B, S, H, D = 1, 256, 2, 16
+    q, k, v = (jnp.asarray(rng.randn(B, S, H, D).astype("float32"))
+               for _ in range(3))
+    ct = jnp.asarray(rng.randn(B, S, H, D).astype("float32"))
+
+    def f_pallas(q, k, v):
+        return jnp.sum(flash_attention_fwd(q, k, v, causal, None, True)
+                       * ct)
+
+    def f_ref(q, k, v):
+        return jnp.sum(_ref_attn(q, k, v, causal) * ct)
+
+    gp = jax.grad(f_pallas, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(f_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(gp, gr, "qkv"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=1e-4, err_msg=name)
+
+
 def test_flash_attention_bf16():
     rng = np.random.RandomState(2)
     B, S, H, D = 2, 32, 2, 16
