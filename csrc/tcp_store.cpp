@@ -61,13 +61,19 @@ struct Server {
     // the socket shutdown. Without both, join() below can hang for the
     // full client timeout (900s default).
     store.cv.notify_all();
+    // Join the accept thread first (listen_fd is already shut down, so it
+    // exits promptly) — after this no new conn threads can be registered.
+    if (accept_thread.joinable()) accept_thread.join();
+    // Swap the thread list out under the lock, then join WITHOUT holding
+    // conns_mu: serve_conn must take conns_mu to erase its fd on exit, so
+    // joining while holding it deadlocks against any live connection.
+    std::vector<std::thread> to_join;
     {
       std::lock_guard<std::mutex> g(conns_mu);
       for (int fd : conn_fds) ::shutdown(fd, SHUT_RDWR);
+      to_join.swap(conns);
     }
-    if (accept_thread.joinable()) accept_thread.join();
-    std::lock_guard<std::mutex> g(conns_mu);
-    for (auto& t : conns)
+    for (auto& t : to_join)
       if (t.joinable()) t.join();
   }
 };

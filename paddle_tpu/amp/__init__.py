@@ -138,10 +138,26 @@ class GradScaler:
         self._good_steps = 0
         self._bad_steps = 0
         self._found_inf = False
+        # device-resident state when driven by ParallelEngine (traced
+        # protocol): (scale f32, good i32, bad i32, applied-step i32)
+        self._dev = None
+        self._dev_global = False  # True once _dev is a committed global
+        self._found_inf_dev = None
+        self._applied_steps = 0
+
+    def _to_eager(self):
+        """Hand device-resident scaler state back to the eager protocol:
+        sync the host mirrors, then drop the device copy so subsequent
+        engine steps reseed from the (possibly eager-updated) host
+        values instead of clobbering them with stale device state."""
+        self._sync_from_dev()
+        self._dev = None
+        self._found_inf_dev = None
 
     def scale(self, var: Tensor) -> Tensor:
         if not self._enable:
             return var
+        self._to_eager()
         from ..ops import math as M
 
         return M.scale(var, scale=self._scale)
@@ -149,6 +165,7 @@ class GradScaler:
     def unscale_(self, optimizer):
         if not self._enable:
             return
+        self._to_eager()
         inv = 1.0 / self._scale
         found = False
         for p in (optimizer._parameter_list or []):
@@ -158,14 +175,46 @@ class GradScaler:
         self._found_inf = self._check_found_inf(optimizer)
 
     def _check_found_inf(self, optimizer) -> bool:
-        total = None
+        # all-finite test (not abs-sum: summing many f16 grads can
+        # overflow on its own). Eager-only — inside a compiled step the
+        # engine runs the traced protocol below instead.
+        finite = True
         for p in (optimizer._parameter_list or []):
             if p is not None and p.grad is not None:
-                s = jnp.sum(jnp.abs(p.grad._value.astype(jnp.float32)))
-                total = s if total is None else total + s
-        if total is None:
-            return False
-        return not bool(jnp.isfinite(total))
+                finite = finite & jnp.all(jnp.isfinite(
+                    p.grad._value.astype(jnp.float32)))
+        return not bool(finite)
+
+    # -- traced protocol (ParallelEngine.train_step(scaler=...)) ---------
+    def _traced_state(self, fallback_step: int = 0):
+        """Scaler state as device scalars, carried through the compiled
+        step (reference: hybrid_parallel_gradscaler.py keeps these as
+        host floats and syncs found_inf with a blocking allreduce; here
+        the whole protocol stays on device — no host round-trip).
+
+        ``fallback_step`` seeds the applied-step counter (used for Adam
+        bias correction) when no checkpointed value exists — the engine
+        passes the optimizer's step count so a resumed run does not
+        restart bias correction at t=1."""
+        if self._dev is None:
+            self._dev = (jnp.float32(self._scale),
+                         jnp.int32(self._good_steps),
+                         jnp.int32(self._bad_steps),
+                         jnp.int32(self._applied_steps or fallback_step))
+            self._dev_global = False
+        return self._dev
+
+    def _store_traced(self, out):
+        self._dev = tuple(out[:4])
+        self._dev_global = True  # jit outputs are committed global arrays
+        self._found_inf_dev = out[4]
+
+    @property
+    def last_found_inf(self):
+        """Whether the most recent engine step hit inf/nan (host sync)."""
+        if self._found_inf_dev is not None:
+            return bool(self._found_inf_dev > 0)
+        return self._found_inf
 
     def step(self, optimizer):
         if not self._enable:
@@ -203,21 +252,35 @@ class GradScaler:
     def is_use_dynamic_loss_scaling(self) -> bool:
         return self._dynamic
 
+    def _sync_from_dev(self):
+        if self._dev is not None:
+            self._scale = float(self._dev[0])
+            self._good_steps = int(self._dev[1])
+            self._bad_steps = int(self._dev[2])
+            self._applied_steps = int(self._dev[3])
+
     def get_loss_scaling(self) -> float:
+        self._sync_from_dev()
         return self._scale
 
     def set_init_loss_scaling(self, v: float):
+        self._sync_from_dev()  # keep counters; only the scale resets
         self._scale = float(v)
+        self._dev = None
 
     def state_dict(self):
+        self._sync_from_dev()
         return {"scale": self._scale, "incr_ratio": self._incr_ratio,
                 "decr_ratio": self._decr_ratio,
-                "good_steps": self._good_steps, "bad_steps": self._bad_steps}
+                "good_steps": self._good_steps, "bad_steps": self._bad_steps,
+                "applied_steps": self._applied_steps}
 
     def load_state_dict(self, state):
         self._scale = state.get("scale", self._scale)
         self._good_steps = state.get("good_steps", 0)
         self._bad_steps = state.get("bad_steps", 0)
+        self._applied_steps = state.get("applied_steps", 0)
+        self._dev = None
 
 
 from . import debugging  # noqa: E402,F401

@@ -101,6 +101,11 @@ class _World:
         self.initialized = False
         self.rank = 0
         self.world_size = 1
+        # Bumped whenever the world mesh is REBUILT (split_group axis
+        # factoring). Engines/compiled steps snapshot the epoch at build
+        # time and refuse to run against a newer mesh — shardings compiled
+        # against deleted axis names must not silently execute.
+        self.mesh_epoch = 0
 
 
 _world = _World()
@@ -150,6 +155,11 @@ def is_initialized() -> bool:
 
 def get_world_mesh() -> Optional[jax.sharding.Mesh]:
     return _world.mesh
+
+
+def mesh_epoch() -> int:
+    """Current world-mesh generation (see _World.mesh_epoch)."""
+    return _world.mesh_epoch
 
 
 def get_rank(group: Optional[Group] = None):
@@ -225,6 +235,7 @@ def split_group(parent: Group, every: int) -> Group:
                     sizes.append(mesh.shape[a])
             _world.mesh = jax.sharding.Mesh(
                 mesh.devices.reshape(sizes), tuple(axes))
+            _world.mesh_epoch += 1  # invalidate engines built on old axes
             for g in _world.groups.values():
                 if ax in g.axis_names:
                     g.axis_names = tuple(
@@ -520,7 +531,13 @@ def send(tensor: Tensor, dst: int = 0, group: Optional[Group] = None,
 
     val = np.asarray(tensor._value if isinstance(tensor, Tensor) else tensor)
     if not _rt.is_multiprocess():
-        _loopback.setdefault((0, int(dst)), []).append(val)  # self-send
+        # world of 1: the only process is rank 0, so only a self-send can
+        # ever be matched — reject anything else instead of buffering a
+        # message no recv key will find
+        enforce(int(dst) == 0,
+                f"send(dst={dst}) in a single-process world: only "
+                f"self-send (dst=0) is possible")
+        _loopback.setdefault((0, 0), []).append(val)
         return _SendRecvTask(tensor)
     _rt.send_object(val, dst)
     return _SendRecvTask(tensor)
@@ -536,7 +553,10 @@ def recv(tensor: Tensor, src: int = 0, group: Optional[Group] = None,
     from . import runtime as _rt
 
     if not _rt.is_multiprocess():
-        q = _loopback.get((int(src), 0))
+        enforce(int(src) == 0,
+                f"recv(src={src}) in a single-process world: only "
+                f"self-recv (src=0) is possible")
+        q = _loopback.get((0, 0))
         enforce(q, f"recv(src={src}): no matching send buffered "
                    f"(single-process loopback)")
         val = q.pop(0)

@@ -231,6 +231,7 @@ class ParallelEngine:
         self.params: List = list(model.parameters())
         self.trainable: List = [p for p in self.params if p.trainable]
         self._seed = 0
+        self._mesh_epoch = C.mesh_epoch()
         self._compiled: Dict[Any, Callable] = {}
         self._zero = _ZeroPlan(mesh, self.trainable, optimizer)
         for p in self.params:
@@ -256,10 +257,18 @@ class ParallelEngine:
 
     # -- the compiled step ----------------------------------------------
     def train_step(self, fn: Callable, batch_specs=None,
-                   donate: bool = True):
+                   donate: bool = True, scaler=None):
         """Build ``step(batch) -> loss`` running fwd+bwd+update as one
         sharded XLA program. ``fn(model, batch)`` must return a scalar
-        loss Tensor."""
+        loss Tensor.
+
+        ``scaler``: an ``amp.GradScaler`` — when given, the whole dynamic
+        loss-scaling protocol runs INSIDE the compiled step (reference:
+        hybrid_parallel_gradscaler.py — found_inf allreduced over every
+        parallel group; here a traced pmax over all mesh axes, with the
+        scale/counters as carried device state and the param/state update
+        where-guarded so an overflow step is a true no-op).
+        """
         mesh = self.mesh
         data_axes = _mesh_data_axes(mesh)
         # 'sep' (context parallel) splits the *sequence*: grads of
@@ -281,7 +290,9 @@ class ParallelEngine:
                         for k, v in opt._states[id(p)].items()}
                        for p in trainable)
 
-        def _step(pvals, svals, mvals, batch, lr, stepc, seed):
+        use_scaler = scaler is not None and scaler.is_enable()
+
+        def _step(pvals, svals, mvals, batch, lr, stepc, seed, amp_in):
             with C.spmd_region():
                 if gmean_axes:
                     # distinct RNG stream per data-parallel/sep rank (mp/pp
@@ -292,7 +303,8 @@ class ParallelEngine:
                 ctx = _rng.fork_traced(seed)
                 ctx.__enter__()
                 try:
-                    return _step_inner(pvals, svals, mvals, batch, lr, stepc)
+                    return _step_inner(pvals, svals, mvals, batch, lr,
+                                       stepc, amp_in)
                 finally:
                     ctx.__exit__(None, None, None)
 
@@ -328,7 +340,7 @@ class ParallelEngine:
             loc = v.shape[dim] // zero.n
             return lax.dynamic_slice_in_dim(v, idx * loc, loc, axis=dim)
 
-        def _step_inner(pvals, svals, mvals, batch, lr, stepc):
+        def _step_inner(pvals, svals, mvals, batch, lr, stepc, amp_in):
             # ZeRO-3 params arrive as shards: all-gather for the forward,
             # but keep the stored shard for the optimizer update
             pshards = pvals
@@ -343,7 +355,25 @@ class ParallelEngine:
                 t_batch = jax.tree_util.tree_map(
                     lambda v: Tensor(v, stop_gradient=True), batch)
                 loss = fn(self.model, t_batch)
-                loss.backward()
+                if use_scaler:
+                    scale_v, good_v, bad_v, tstep_v = amp_in
+                    # cap the scale below the loss dtype's max so the
+                    # backward seed can never itself overflow to inf
+                    # (f16 max is 65504 — one doubling past the default
+                    # 2^15 scale would cross it). Power-of-two cap keeps
+                    # scale/unscale an exact mantissa-preserving round
+                    # trip and leaves the default 2^15 init untouched.
+                    ldt = loss._value.dtype
+                    scale_cap = 2.0 ** 15 if ldt == jnp.float16 else 2.0 ** 62
+                    scale_v = jnp.minimum(scale_v, jnp.float32(scale_cap))
+                    # loss scaling = seeding the tape with `scale` instead
+                    # of 1 (same grads as (loss*scale).backward(), one
+                    # less op); the reported loss stays unscaled
+                    loss.backward(Tensor(
+                        scale_v.astype(loss._value.dtype),
+                        stop_gradient=True))
+                else:
+                    loss.backward()
                 upd_in, grads = [], []
                 for i, p in zip(t_index, trainable):
                     g = (p.grad._value if p.grad is not None
@@ -391,8 +421,61 @@ class ParallelEngine:
                         upd_in.append(mvals[i] if mvals and i in mvals
                                       else pvals[i])
                     grads.append(g)
+                amp_out = ()
+                if use_scaler:
+                    # traced found_inf, synced across EVERY parallel axis
+                    # (the reference allreduces found_inf over mp/pp/
+                    # sharding groups one by one; one pmax is equivalent)
+                    finite = jnp.float32(1.0)
+                    for g in grads:
+                        finite = finite * jnp.all(
+                            jnp.isfinite(g)).astype(jnp.float32)
+                    found = 1.0 - finite
+                    sync_axes = tuple(a for a in mesh.axis_names
+                                      if mesh.shape[a] > 1)
+                    if sync_axes:
+                        found = lax.pmax(found, sync_axes)
+                    found_b = found > 0
+                    # unscale in f32; zero overflowed grads so the (thrown
+                    # away) update math stays NaN-free
+                    inv = jnp.where(found_b, 0.0, 1.0 / scale_v)
+                    grads = [(g.astype(jnp.float32) * inv).astype(g.dtype)
+                             for g in grads]
+                    # bias-correction step count advances only on applied
+                    # steps (the reference skips optimizer.step entirely)
+                    stepc = tstep_v + (1 - found.astype(jnp.int32))
                 new_p, new_s = opt._fused_update(
                     tuple(upd_in), tuple(grads), tuple(svals), lr, stepc)
+                if use_scaler:
+                    new_p = tuple(jnp.where(found_b, u, n)
+                                  for u, n in zip(upd_in, new_p))
+                    new_s = tuple(
+                        {k: jnp.where(found_b, old[k], ns[k])
+                         if hasattr(ns[k], "shape") else ns[k]
+                         for k in ns}
+                        for old, ns in zip(svals, new_s))
+                    if scaler.is_use_dynamic_loss_scaling():
+                        # dynamic loss-scale bookkeeping, pure arithmetic
+                        bad1 = jnp.where(found_b, bad_v + 1, 0)
+                        good1 = jnp.where(found_b, 0, good_v + 1)
+                        dec = found_b & (bad1 >= scaler._decr_every)
+                        scale1 = jnp.where(
+                            dec,
+                            jnp.maximum(scale_v * scaler._decr_ratio, 1.0),
+                            scale_v)
+                        bad2 = jnp.where(dec, 0, bad1)
+                        inc = (~found_b) & (good1 >= scaler._incr_every)
+                        scale2 = jnp.minimum(
+                            jnp.where(inc, scale1 * scaler._incr_ratio,
+                                      scale1),
+                            jnp.float32(scale_cap))
+                        good2 = jnp.where(inc, 0, good1)
+                    else:  # static scale: counters track, scale is fixed
+                        scale2 = scale_v
+                        good2 = jnp.where(found_b, 0, good_v + 1)
+                        bad2 = jnp.where(found_b, bad_v + 1, 0)
+                    amp_out = (scale2, good2, bad2, stepc,
+                               found.astype(jnp.float32))
                 out_p = list(pvals)
                 out_m = dict(mvals) if mvals else {}
                 for i, p, nv in zip(t_index, trainable, new_p):
@@ -415,21 +498,27 @@ class ParallelEngine:
                                  if mesh.shape[a] > 1)
                 if all_axes:
                     lv = lax.pmean(lv, all_axes)
-            return lv, tuple(out_p), tuple(new_s), out_m
+            return lv, tuple(out_p), tuple(new_s), out_m, amp_out
 
         def make(batch_treedef, b_specs, mspecs):
-            def flat_step(pvals, svals, mvals, batch_leaves, lr, stepc, seed):
+            def flat_step(pvals, svals, mvals, batch_leaves, lr, stepc,
+                          seed, amp_in):
                 batch = jax.tree_util.tree_unflatten(batch_treedef,
                                                      batch_leaves)
-                return _step(pvals, svals, mvals, batch, lr, stepc, seed)
+                return _step(pvals, svals, mvals, batch, lr, stepc, seed,
+                             amp_in)
 
-            in_specs = (pspecs, sspecs, mspecs, tuple(b_specs), P(), P(), P())
-            out_specs = (P(), pspecs, sspecs, mspecs)
+            amp_ispec = (P(),) * 4 if use_scaler else ()
+            amp_ospec = (P(),) * 5 if use_scaler else ()
+            in_specs = (pspecs, sspecs, mspecs, tuple(b_specs), P(), P(),
+                        P(), amp_ispec)
+            out_specs = (P(), pspecs, sspecs, mspecs, amp_ospec)
             sharded = _shard_map(flat_step, mesh, in_specs, out_specs)
             return jax.jit(sharded,
                            donate_argnums=(0, 1, 2) if donate else ())
 
         def step(batch):
+            self._check_mesh_epoch()
             leaves, treedef = jax.tree_util.tree_flatten(
                 batch, is_leaf=lambda x: isinstance(x, Tensor))
             leaf_vals = tuple(v._value if isinstance(v, Tensor) else
@@ -444,9 +533,15 @@ class ParallelEngine:
                      for i, p in zip(t_index, trainable)
                      if id(p) in opt._master_weights}
             mspecs = {i: zero.state_spec(params[i]) for i in mvals}
+            # scaler hyperparameters are baked into the trace as Python
+            # constants — key them so two differently-configured scalers
+            # never share an executable
+            amp_key = ((scaler._dynamic, scaler._incr_every,
+                        scaler._decr_every, scaler._incr_ratio,
+                        scaler._decr_ratio) if use_scaler else None)
             key = (treedef, tuple((v.shape, str(v.dtype))
                                   for v in leaf_vals), b_specs,
-                   tuple(sorted(mvals)))
+                   tuple(sorted(mvals)), amp_key)
             if key not in self._compiled:
                 self._compiled[key] = make(treedef, b_specs, mspecs)
             pvals = tuple(p._value for p in params)
@@ -456,19 +551,33 @@ class ParallelEngine:
             lr = jnp.asarray(opt.get_lr(), jnp.float32)
             stepc = jnp.asarray(opt._step_count, jnp.int32)
             seed = jnp.asarray(self._seed, jnp.uint32)
+            # -1: _step_count was already incremented for THIS step; the
+            # traced counter advances inside the step on application
+            amp_in = (scaler._traced_state(fallback_step=opt._step_count - 1)
+                      if use_scaler else ())
             leaf_vals = _globalize_batch(leaf_vals, b_specs, mesh)
             if _multiprocess(mesh):
                 lr = global_put(lr, mesh, P())
                 stepc = global_put(stepc, mesh, P())
                 seed = global_put(seed, mesh, P())
-            lv, new_p, new_s, new_m = self._compiled[key](
-                pvals, svals, mvals, leaf_vals, lr, stepc, seed)
+                # amp state from a previous compiled step is already a
+                # committed global array — re-global_put would force a
+                # blocking host sync on every step
+                if use_scaler and not scaler._dev_global:
+                    amp_in = tuple(global_put(v, mesh, P())
+                                   for v in amp_in)
+                    scaler._dev = amp_in
+                    scaler._dev_global = True
+            lv, new_p, new_s, new_m, amp_out = self._compiled[key](
+                pvals, svals, mvals, leaf_vals, lr, stepc, seed, amp_in)
             for p, nv in zip(params, new_p):
                 p._value = nv
             for p, ns in zip(trainable, new_s):
                 opt._states[id(p)] = ns
             for i, nv in new_m.items():
                 opt._master_weights[id(params[i])] = nv
+            if use_scaler:
+                scaler._store_traced(amp_out)
             from ..optimizer.lr import LRScheduler
 
             if isinstance(opt._lr, LRScheduler):
@@ -476,6 +585,17 @@ class ParallelEngine:
             return Tensor(lv, stop_gradient=True)
 
         return step
+
+    def _check_mesh_epoch(self):
+        if C.mesh_epoch() != self._mesh_epoch:
+            from ..core.enforce import PreconditionNotMetError
+
+            raise PreconditionNotMetError(
+                "the world mesh was rebuilt (split_group factored an "
+                "axis) after this ParallelEngine was created; its "
+                "compiled steps reference deleted axis names. Call "
+                "split_group BEFORE building engines/shardings, or "
+                "recreate the ParallelEngine.")
 
     # -- forward-only (eval / inference) --------------------------------
     def eval_step(self, fn: Callable, batch_specs=None):
@@ -512,6 +632,7 @@ class ParallelEngine:
             return jax.jit(sharded)
 
         def step(batch, out_spec=None):
+            self._check_mesh_epoch()
             leaves, treedef = jax.tree_util.tree_flatten(
                 batch, is_leaf=lambda x: isinstance(x, Tensor))
             leaf_vals = tuple(v._value if isinstance(v, Tensor) else

@@ -88,7 +88,11 @@ def _next_gen() -> int:
 def host_barrier(name: str = "host", timeout: Optional[float] = None) -> None:
     if not is_multiprocess():
         return
-    _store().barrier(f"{name}/{_next_gen()}", process_world(), timeout)
+    # Fixed (reusable) barrier name: store.barrier generation-keys each
+    # pass internally and reaps the previous generation's go-key, so the
+    # coordinator's footprint stays O(#distinct names), not O(#calls).
+    _next_gen()
+    _store().barrier(f"hb/{name}", process_world(), timeout)
 
 
 def all_gather_object_host(obj: Any,
@@ -101,8 +105,9 @@ def all_gather_object_host(obj: Any,
     store.set(f"og/{gen}/{rank}", pickle.dumps(obj, protocol=4))
     out = [pickle.loads(store.get(f"og/{gen}/{r}", timeout))
            for r in range(world)]
-    # clean own key next round: barrier then delete own slot
-    store.barrier(f"og/{gen}", world, timeout)
+    # clean own key next round: barrier then delete own slot (fixed
+    # reusable barrier name — see host_barrier)
+    store.barrier("og", world, timeout)
     store.delete_key(f"og/{gen}/{rank}")
     return out
 
@@ -117,7 +122,7 @@ def broadcast_object_host(obj: Any, src: int = 0,
         out = obj
     else:
         out = pickle.loads(store.get(f"bc/{gen}", timeout))
-    store.barrier(f"bc/{gen}/done", process_world(), timeout)
+    store.barrier("bc", process_world(), timeout)
     if process_rank() == src:
         store.delete_key(f"bc/{gen}")
     return out

@@ -53,9 +53,20 @@ class TCPStore:
         enforce(self._fd >= 0,
                 f"TCPStore: cannot connect to {host}:{port}")
 
+    # Mirror of kMaxBlob in csrc/tcp_store.cpp. The server drops the
+    # connection on an oversized frame, which would surface to peers as an
+    # opaque timeout — so fail fast on the client with a clear message.
+    MAX_BLOB = 64 * 1024 * 1024
+
     def set(self, key: str, value) -> None:
         data = value if isinstance(value, (bytes, bytearray)) else \
             str(value).encode()
+        if len(data) > self.MAX_BLOB:
+            raise ValueError(
+                f"TCPStore.set({key!r}): payload of {len(data)} bytes "
+                f"exceeds the {self.MAX_BLOB}-byte frame cap; the store "
+                "carries bootstrap metadata, not tensor data — shard or "
+                "compress large objects before shipping them")
         buf = (ctypes.c_uint8 * len(data)).from_buffer_copy(data)
         with self._mu:
             rc = self._lib.tcpstore_set(self._fd, key.encode(), buf,
@@ -107,6 +118,11 @@ class TCPStore:
         go = f"__barrier__/{name}/go/{gen}"
         if n == (gen + 1) * world_size:
             self.set(go, b"1")
+            # Reap the previous generation's go-key so long jobs don't
+            # accumulate one store entry per barrier call. gen-1 is safe
+            # to delete: every rank must have passed it to arrive here.
+            if gen > 0:
+                self.delete_key(f"__barrier__/{name}/go/{gen - 1}")
         self.wait(go, timeout)
 
     def close(self) -> None:

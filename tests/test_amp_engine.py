@@ -1,0 +1,142 @@
+"""Traced GradScaler protocol inside the compiled engine step
+(reference: python/paddle/distributed/fleet/meta_optimizers/
+dygraph_optimizer/hybrid_parallel_gradscaler.py — found_inf allreduced
+across every parallel group, update skipped on overflow; here the whole
+protocol is carried device state inside ONE jitted step)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+from paddle_tpu.distributed import fleet
+from paddle_tpu.distributed.engine import ParallelEngine
+
+
+def _mlp(d=8, h=16):
+    class MLP(paddle.nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc1 = paddle.nn.Linear(d, h)
+            self.fc2 = paddle.nn.Linear(h, d)
+
+        def forward(self, x):
+            return self.fc2(paddle.nn.functional.relu(self.fc1(x)))
+
+    return MLP()
+
+
+def _loss_fn(model, batch):
+    out = model(batch["x"])
+    return paddle.mean((out - batch["y"]) ** 2)
+
+
+def _init_hybrid(dp=2):
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": dp, "mp_degree": 1,
+                               "pp_degree": 1, "sharding_degree": 1}
+    return fleet.init(is_collective=True, strategy=strategy)
+
+
+def test_scaler_parity_on_clean_data():
+    """scale/unscale must cancel exactly: scaled run == unscaled run."""
+    _init_hybrid(dp=2)
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((4, 8)).astype(np.float32)
+    y = rng.standard_normal((4, 8)).astype(np.float32)
+
+    losses = {}
+    for use_scaler in (False, True):
+        paddle.seed(7)
+        model = _mlp()
+        opt = paddle.optimizer.Adam(learning_rate=0.05,
+                                    parameters=model.parameters())
+        eng = ParallelEngine(model, opt)
+        scaler = paddle.amp.GradScaler(
+            init_loss_scaling=2.0 ** 10) if use_scaler else None
+        step = eng.train_step(_loss_fn, scaler=scaler)
+        ls = [float(step({"x": x, "y": y})) for _ in range(4)]
+        losses[use_scaler] = ls
+    np.testing.assert_allclose(losses[False], losses[True],
+                               rtol=2e-5, atol=2e-6)
+
+
+def test_scaler_skips_on_inf_and_decays_scale():
+    """An injected inf must (a) leave params+opt states untouched,
+    (b) decay the scale, (c) be visible via last_found_inf — and the
+    next clean step must resume training."""
+    _init_hybrid(dp=2)
+    paddle.seed(11)
+    model = _mlp()
+    opt = paddle.optimizer.Adam(learning_rate=0.05,
+                                parameters=model.parameters())
+    eng = ParallelEngine(model, opt)
+    scaler = paddle.amp.GradScaler(init_loss_scaling=2.0 ** 8,
+                                   decr_every_n_nan_or_inf=1)
+    step = eng.train_step(_loss_fn, scaler=scaler)
+
+    rng = np.random.default_rng(1)
+    x = rng.standard_normal((4, 8)).astype(np.float32)
+    y = rng.standard_normal((4, 8)).astype(np.float32)
+
+    l0 = float(step({"x": x, "y": y}))
+    assert not scaler.last_found_inf
+    params_before = [np.asarray(p._value) for p in model.parameters()]
+    m_before = [np.asarray(opt._states[id(p)]["moment1"])
+                for p in model.parameters() if p.trainable]
+
+    bad_x = x.copy()
+    bad_x[0, 0] = np.inf
+    bad_loss = step({"x": bad_x, "y": y})
+    assert scaler.last_found_inf
+    for p, before in zip(model.parameters(), params_before):
+        np.testing.assert_array_equal(np.asarray(p._value), before)
+    for p, before in zip([p for p in model.parameters() if p.trainable],
+                         m_before):
+        np.testing.assert_array_equal(
+            np.asarray(opt._states[id(p)]["moment1"]), before)
+    assert scaler.get_loss_scaling() == pytest.approx(2.0 ** 7)
+
+    l2 = float(step({"x": x, "y": y}))
+    assert not scaler.last_found_inf
+    assert np.isfinite(l2) and l2 < l0
+    for p, before in zip(model.parameters(), params_before):
+        assert not np.array_equal(np.asarray(p._value), before)
+
+
+def test_scaler_growth_after_n_good_steps():
+    _init_hybrid(dp=1)
+    paddle.seed(5)
+    model = _mlp()
+    opt = paddle.optimizer.SGD(learning_rate=0.01,
+                               parameters=model.parameters())
+    eng = ParallelEngine(model, opt)
+    scaler = paddle.amp.GradScaler(init_loss_scaling=64.0,
+                                   incr_every_n_steps=3)
+    step = eng.train_step(_loss_fn, scaler=scaler)
+    rng = np.random.default_rng(2)
+    x = rng.standard_normal((2, 8)).astype(np.float32)
+    y = rng.standard_normal((2, 8)).astype(np.float32)
+    for _ in range(3):
+        step({"x": x, "y": y})
+    assert scaler.get_loss_scaling() == pytest.approx(128.0)
+    state = scaler.state_dict()
+    assert state["good_steps"] == 0
+
+
+def test_eager_scaler_found_inf_still_works():
+    """Eager (non-engine) GradScaler path: overflow detection + skip."""
+    paddle.seed(3)
+    model = _mlp()
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=model.parameters())
+    scaler = paddle.amp.GradScaler(init_loss_scaling=8.0,
+                                   decr_every_n_nan_or_inf=1)
+    x = paddle.to_tensor(np.full((2, 8), np.inf, dtype=np.float32))
+    y = paddle.to_tensor(np.zeros((2, 8), dtype=np.float32))
+    loss = paddle.mean((model(x) - y) ** 2)
+    scaled = scaler.scale(loss)
+    scaled.backward()
+    w0 = np.asarray(model.fc1.weight._value)
+    scaler.step(opt)
+    np.testing.assert_array_equal(np.asarray(model.fc1.weight._value), w0)
+    assert scaler.get_loss_scaling() == pytest.approx(4.0)
