@@ -173,7 +173,8 @@ class FusedMultiTransformer(Layer):
 
     ``forward(src, caches=None, time_step=None)``:
     - training/no-cache: causal flash attention over the full sequence;
-    - with caches (list of (k_cache, v_cache) raw [B, M, H, D] arrays):
+    - with caches (list of (k_cache, v_cache) raw head-major
+      [B, H, M, D] arrays, the Pallas decode-kernel layout):
       writes the new kv at ``time_step`` and attends over the cache —
       prefill (S>1, time_step=0) and decode (S=1) share the path.
     """
@@ -231,7 +232,7 @@ class FusedMultiTransformer(Layer):
 
     def empty_caches(self, batch_size: int, max_len: int,
                      dtype=jnp.float32) -> List[Tuple]:
-        shape = (batch_size, max_len, self.num_heads, self.head_dim)
+        shape = (batch_size, self.num_heads, max_len, self.head_dim)
         return [(jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
                 for _ in range(self.num_layers)]
 
@@ -246,11 +247,13 @@ class FusedMultiTransformer(Layer):
         q, k, v = M.split(qkv, 3, axis=-1)
         new_cache = None
         if cache is not None:
-            k_cache, v_cache = cache
+            k_cache, v_cache = cache    # head-major [B, H, M, D]
             k_cache = lax.dynamic_update_slice_in_dim(
-                k_cache, k._value.astype(k_cache.dtype), offset, axis=1)
+                k_cache, jnp.swapaxes(k._value, 1, 2).astype(k_cache.dtype),
+                offset, axis=2)
             v_cache = lax.dynamic_update_slice_in_dim(
-                v_cache, v._value.astype(v_cache.dtype), offset, axis=1)
+                v_cache, jnp.swapaxes(v._value, 1, 2).astype(v_cache.dtype),
+                offset, axis=2)
             ov = _cache_attention(q._value, k_cache, v_cache, offset, S)
             out = Tensor(ov.reshape(B, S, self.embed_dim),
                          stop_gradient=True)

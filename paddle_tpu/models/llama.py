@@ -14,7 +14,7 @@ exactly like GPT.
 
 Generation redesign: instead of a hand-written CUDA decoder, the decode
 step is ONE jitted XLA program with *static-shape* preallocated KV
-caches ([B, max_len, KV, D]) updated in place via donated buffers —
+caches (head-major [B, KV, max_len, D]) updated in place via donated buffers —
 the XLA-idiomatic equivalent of the paged cache-KV loop. Prefill and
 decode share a single forward path (offset + sequence masking), so the
 program compiles twice (prefill shape, decode shape) and never again.
@@ -108,17 +108,47 @@ def _apply_rope(x, cos, sin, offset):
 
 
 def _cache_attention(q, k_cache, v_cache, offset, S):
-    """Masked attention of q [B,S,H,D] against static caches [B,M,KV,D];
-    valid kv positions are < offset + S (the fused_multi_transformer
-    cache-KV attention, XLA style: full-cache matmul + length mask)."""
+    """Attention of q [B,S,H,D] against static caches [B,KV,M,D]; valid
+    kv positions are <= offset + row (the fused_multi_transformer
+    cache-KV attention). On TPU this is the Pallas decode kernel —
+    cache streamed in blocks, DMA stops at the valid frontier, GQA
+    grouped natively (ops/pallas/decode_attention.py); the portable
+    path is a full-cache matmul + length mask in XLA."""
+    from ..core import flags as _flags
+    from ..ops.pallas import decode_attention as _da
+
+    if (_flags._get("use_pallas_kernels", True)
+            and _da.supported(q.shape, k_cache.shape)
+            and (jax.default_backend() != "cpu")):
+        try:
+            return _da.decode_attention(q, k_cache, v_cache, offset)
+        except (KeyboardInterrupt, SystemExit):
+            raise
+        except Exception as e:
+            global _decode_warned
+            if not _decode_warned:
+                _decode_warned = True
+                import warnings
+
+                warnings.warn(f"decode_attention: Pallas kernel "
+                              f"unavailable ({type(e).__name__}: {e}); "
+                              "using dense XLA fallback")
+    return _cache_attention_dense(q, k_cache, v_cache, offset, S)
+
+
+_decode_warned = False
+
+
+def _cache_attention_dense(q, k_cache, v_cache, offset, S):
+    """Caches are head-major [B, KV, M, D]."""
     B, _, H, D = q.shape
-    M, KV = k_cache.shape[1], k_cache.shape[2]
+    KV, M = k_cache.shape[1], k_cache.shape[2]
     if KV != H:
-        k_cache = jnp.repeat(k_cache, H // KV, axis=2)
-        v_cache = jnp.repeat(v_cache, H // KV, axis=2)
+        k_cache = jnp.repeat(k_cache, H // KV, axis=1)
+        v_cache = jnp.repeat(v_cache, H // KV, axis=1)
     qf = jnp.swapaxes(q, 1, 2).astype(jnp.float32)       # B,H,S,D
-    kf = jnp.swapaxes(k_cache, 1, 2).astype(jnp.float32)  # B,H,M,D
-    vf = jnp.swapaxes(v_cache, 1, 2).astype(jnp.float32)
+    kf = k_cache.astype(jnp.float32)                     # B,H,M,D
+    vf = v_cache.astype(jnp.float32)
     scores = jnp.einsum("bhsd,bhmd->bhsm", qf, kf) / np.sqrt(D)
     q_pos = offset + jnp.arange(S)                        # [S]
     kv_pos = jnp.arange(M)                                # [M]
@@ -181,11 +211,13 @@ class LlamaAttention(Layer):
         kv_ = _apply_rope(kv_, cos, sin, offset)
 
         if cache is not None:
-            k_cache, v_cache = cache
+            k_cache, v_cache = cache    # head-major [B, KV, M, D]
             k_cache = lax.dynamic_update_slice_in_dim(
-                k_cache, kv_.astype(k_cache.dtype), offset, axis=1)
+                k_cache, jnp.swapaxes(kv_, 1, 2).astype(k_cache.dtype),
+                offset, axis=2)
             v_cache = lax.dynamic_update_slice_in_dim(
-                v_cache, vv.astype(v_cache.dtype), offset, axis=1)
+                v_cache, jnp.swapaxes(vv, 1, 2).astype(v_cache.dtype),
+                offset, axis=2)
             ov = _cache_attention(qv, k_cache, v_cache, offset, S)
             out = Tensor(ov.reshape(B, S, n_local * D), stop_gradient=True)
             return self.o_proj(out), (k_cache, v_cache)
@@ -316,8 +348,10 @@ class LlamaForCausalLM(Layer):
 
     # -- generation (compiled decode loop) ------------------------------
     def _empty_caches(self, B: int, max_len: int, dtype):
+        # head-major [B, KV, M, D]: each head's [M, D] plane contiguous
+        # (Mosaic-tileable for the Pallas decode kernel)
         cfg = self.config
-        shape = (B, max_len, cfg.num_kv_heads, cfg.head_dim)
+        shape = (B, cfg.num_kv_heads, max_len, cfg.head_dim)
         return [(jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
                 for _ in range(cfg.num_layers)]
 

@@ -84,9 +84,24 @@ def flash_attention(query, key, value, dropout=0.0, causal=False,
     return out, None
 
 
-def flash_attn_unpadded(*args, **kwargs):
-    raise NotImplementedError("varlen flash attention lands with the Pallas "
-                              "paged-attention kernel")
+def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
+                        max_seqlen_q=None, max_seqlen_k=None, scale=None,
+                        dropout=0.0, causal=False, return_softmax=False,
+                        fixed_seed_offset=None, rng_name="", training=True,
+                        name=None):
+    """Packed varlen flash attention (reference: python/paddle/nn/
+    functional/flash_attention.py:384 flash_attn_unpadded). q/k/v are
+    [total_tokens, H, D]; cu_seqlens mark sequence boundaries. On TPU
+    the Pallas flash kernel runs with segment-id masking; elsewhere a
+    dense segment mask. Returns (out, softmax) like the reference."""
+    from ..core import rng as _rng
+    from ..ops import attention as _attn
+
+    p = float(dropout) if training else 0.0
+    out = _attn.flash_attn_varlen(
+        query, key, value, cu_seqlens_q, cu_seqlens_k, causal=bool(causal),
+        scale=scale, dropout=p, dropout_key=_rng.get_key() if p else None)
+    return out, None
 
 
 def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):

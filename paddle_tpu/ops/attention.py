@@ -54,3 +54,56 @@ def flash_attention(q, k, v, causal=False, dropout=0.0, dropout_key=None):
 
 
 _warned_fallback = False
+
+
+def _segments_from_cu(cu, total):
+    """cu_seqlens [n+1] -> per-token segment ids [total] (padding past
+    cu[-1] gets id -1, which still self-matches so padded rows stay
+    finite and are sliced away by the caller)."""
+    cu = jnp.asarray(cu, jnp.int32)
+    pos = jnp.arange(total, dtype=jnp.int32)
+    seg = jnp.searchsorted(cu[1:], pos, side="right").astype(jnp.int32)
+    return jnp.where(pos < cu[-1], seg, -1)
+
+
+@def_op("flash_attn_varlen")
+def flash_attn_varlen(q, k, v, cu_seqlens_q, cu_seqlens_k, causal=False,
+                      scale=None, dropout=0.0, dropout_key=None):
+    """Packed varlen attention (reference: flash_attn_unpadded /
+    flash_attn_varlen_func, python/paddle/nn/functional/
+    flash_attention.py:384 over phi flash_attn_unpadded kernel).
+
+    q/k/v: [total_tokens, H, D] packed concatenations of sequences with
+    boundaries cu_seqlens (e.g. [0, s1, s1+s2, ...]). Tokens never
+    attend across sequence boundaries. TPU path: the Pallas flash
+    kernel with segment-id masking; portable path: dense mask."""
+    Tq, H, D = q.shape
+    Tk = k.shape[0]
+    qseg = _segments_from_cu(cu_seqlens_q, Tq)
+    kseg = _segments_from_cu(cu_seqlens_k, Tk)
+    q4, k4, v4 = q[None], k[None], v[None]
+    if _use_pallas(q) and not dropout and Tq == Tk:
+        try:
+            from .pallas.flash_attention import flash_attention_fwd
+
+            return flash_attention_fwd(q4, k4, v4, causal, scale, None,
+                                       qseg[None], kseg[None])[0]
+        except (KeyboardInterrupt, SystemExit):
+            raise
+        except Exception as e:
+            global _warned_fallback
+            if not _warned_fallback:
+                _warned_fallback = True
+                import warnings
+
+                warnings.warn(
+                    f"flash_attn_varlen: Pallas kernel unavailable "
+                    f"({type(e).__name__}: {e}); using XLA fallback")
+    mask = qseg[:, None] == kseg[None, :]
+    if causal:
+        mask = mask & (
+            (Tk - Tq + jnp.arange(Tq)[:, None]) >= jnp.arange(Tk)[None, :])
+    out = _sdpa_raw(q4, k4, v4, attn_mask=mask[None, None], scale=scale,
+                    dropout_p=dropout, is_causal=False,
+                    dropout_key=dropout_key)
+    return out[0]

@@ -1,0 +1,164 @@
+"""Pallas TPU decode attention: short q against a long KV cache.
+
+TPU-native replacement for the reference's CUDA decode kernels
+(reference: fluid/operators/fused/fused_multi_transformer_op.cu.h —
+the 2,023-LoC masked cache-KV decoder loop — and
+phi/kernels/fusion/gpu/block_multi_head_attention_kernel.cu, the
+paged/block KV-cache attention kernel).
+
+Design — the cache STREAMS through VMEM in blocks as the innermost grid
+dimension; nothing is ever resident at O(cache_len):
+
+- grid = (B, KV_heads, cache_blocks). Online-softmax statistics and the
+  output accumulator live in VMEM scratch, carried across the
+  sequentially-iterated cache-block axis.
+- the valid cache length (``offset`` + new tokens) is a SCALAR-PREFETCH
+  input: the BlockSpec index maps clamp the cache block index to the
+  last valid block, so blocks past the frontier are never DMA'd from
+  HBM — the TPU equivalent of the paged kernel only touching mapped
+  pages. Compute for those steps is skipped with ``pl.when``.
+- GQA is native: the q heads of one KV group form the sublane axis of a
+  single [Sq*G, D] block, so the cache is read once per KV head (the
+  dense fallback repeats it per q head).
+
+The q rows sit at absolute positions offset..offset+Sq-1 and attend to
+cache positions <= their own (causal within the freshly-appended chunk,
+everything before ``offset`` visible). This covers both decode (Sq=1)
+and chunked prefill (Sq=block).
+
+Layout: q [B, Sq, H, D], caches [B, KV, M, D] — head-major so each
+head's [M, D] plane is a contiguous Mosaic-tileable block (the
+static-shape cache layout of models/llama.py).
+"""
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import numpy as np
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+
+try:
+    from jax.experimental.pallas import tpu as pltpu
+except Exception:  # pragma: no cover
+    pltpu = None
+
+from . import is_tpu_platform, pick_block as _pick_block
+
+__all__ = ["decode_attention"]
+
+_NEG = -1e30
+_BLOCKS = (512, 256, 128, 64, 32, 16, 8)
+
+
+def _kernel(len_ref, q_ref, k_ref, v_ref, o_ref, m_s, l_s, acc_s, *,
+            scale, block_kv, nkv, Sq, G):
+    j = pl.program_id(2)
+    off = len_ref[0]                      # q rows start here
+    j_last = (off + Sq - 1) // block_kv   # last cache block with valid cols
+
+    @pl.when(j == 0)
+    def _():
+        m_s[...] = jnp.full_like(m_s, _NEG)
+        l_s[...] = jnp.zeros_like(l_s)
+        acc_s[...] = jnp.zeros_like(acc_s)
+
+    @pl.when(j <= j_last)
+    def _():
+        qb = q_ref[0, :, 0, :, :].reshape(Sq * G, -1)      # [Sq*G, D]
+        kb = k_ref[0, 0]                                   # [bkv, D]
+        vb = v_ref[0, 0]
+        s = lax.dot_general(qb, kb, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+        rows = lax.broadcasted_iota(jnp.int32, (Sq * G, block_kv), 0) // G
+        cols = j * block_kv + lax.broadcasted_iota(
+            jnp.int32, (Sq * G, block_kv), 1)
+        keep = cols <= off + rows
+        s = jnp.where(keep, s, _NEG)
+        m_prev = m_s[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, -1, keepdims=True))
+        p = jnp.where(keep, jnp.exp(s - m_new), 0.0)
+        corr = jnp.exp(m_prev - m_new)
+        l_s[:, :1] = l_s[:, :1] * corr + jnp.sum(p, -1, keepdims=True)
+        acc_s[...] = acc_s[...] * corr + lax.dot_general(
+            p.astype(vb.dtype), vb, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_s[:, :1] = m_new
+
+    @pl.when(j == nkv - 1)
+    def _():
+        l = jnp.maximum(l_s[:, :1], 1e-30)
+        o_ref[0, :, 0, :, :] = (acc_s[...] / l).reshape(
+            Sq, G, -1).astype(o_ref.dtype)
+
+
+def _compiler_params(interpret):
+    if pltpu is None or interpret:
+        return {}
+    sem = ("parallel", "parallel", "arbitrary")
+    for cls_name in ("CompilerParams", "TPUCompilerParams"):
+        cls = getattr(pltpu, cls_name, None)
+        if cls is not None:
+            try:
+                return {"compiler_params": cls(dimension_semantics=sem)}
+            except Exception:  # pragma: no cover
+                continue
+    return {}
+
+
+def supported(q_shape, cache_shape) -> bool:
+    B, Sq, H, D = q_shape
+    KV, M = cache_shape[1], cache_shape[2]
+    if H % KV or _pick_block(M, prefer=_BLOCKS) <= 0:
+        return False
+    return Sq * (H // KV) <= 2048  # q block must sit in VMEM
+
+
+def decode_attention(q, k_cache, v_cache, offset, scale=None,
+                     interpret=None):
+    """q [B,Sq,H,D] against caches [B,KV,M,D] (head-major: each head's
+    [M,D] plane is contiguous, the Mosaic-tileable layout); cache
+    positions <= offset+row are attended. offset may be traced."""
+    B, Sq, H, D = q.shape
+    KV, M = k_cache.shape[1], k_cache.shape[2]
+    G = H // KV
+    if scale is None:
+        scale = 1.0 / np.sqrt(D)
+    if interpret is None:
+        interpret = not is_tpu_platform()
+    block_kv = _pick_block(M, prefer=_BLOCKS)
+    nkv = M // block_kv
+    q5 = q.reshape(B, Sq, KV, G, D)
+    lengths = jnp.asarray(offset, jnp.int32).reshape(1)
+
+    def kv_index(b, h, j, ln):
+        return (b, h, jnp.minimum(j, (ln[0] + Sq - 1) // block_kv), 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(B, KV, nkv),
+        in_specs=[
+            pl.BlockSpec((1, Sq, 1, G, D), lambda b, h, j, ln:
+                         (b, 0, h, 0, 0)),
+            pl.BlockSpec((1, 1, block_kv, D), kv_index),
+            pl.BlockSpec((1, 1, block_kv, D), kv_index),
+        ],
+        out_specs=pl.BlockSpec((1, Sq, 1, G, D),
+                               lambda b, h, j, ln: (b, 0, h, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((Sq * G, 128), jnp.float32),
+            pltpu.VMEM((Sq * G, 128), jnp.float32),
+            pltpu.VMEM((Sq * G, D), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        partial(_kernel, scale=scale, block_kv=block_kv, nkv=nkv, Sq=Sq,
+                G=G),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, Sq, KV, G, D), q.dtype),
+        interpret=interpret,
+        **_compiler_params(interpret),
+    )(lengths, q5, k_cache, v_cache)
+    return out.reshape(B, Sq, H, D)

@@ -5,22 +5,34 @@ library (reference: phi/kernels/gpu/flash_attn_kernel.cu,
 flash_attn_grad_kernel.cu, backends/dynload/flashattn.h, python surface
 nn/functional/flash_attention.py:147).
 
-Design: classic flash — the q block lives in VMEM, k/v stream through
-VMEM blocks, online-softmax statistics (m, l) carried through a
-fori_loop so attention probabilities never hit HBM. The causal variant
-skips k/v blocks entirely above the diagonal (the loop's upper bound is
-a function of the q-block index), halving FLOPs.
+Design: K/V STREAM through VMEM as the innermost *grid* dimension (no
+full-KV VMEM pin), with the online-softmax statistics (m, l) and the
+output accumulator carried across grid steps in VMEM scratch — TPU grid
+iteration is sequential over the last axis, which is exactly the
+guarantee the recurrence needs. Causal blocks above the diagonal are
+skipped two ways: the compute is guarded by ``pl.when`` and the
+BlockSpec index map clamps to the last valid block so Pallas re-uses
+the resident block instead of issuing a DMA.
+
+Rectangular attention (seq_q != seq_kv) follows the flash-attn
+convention: the q rows are the LAST seq_q rows of the seq_kv-length
+sequence (q_offset = seq_kv - seq_q) under ``causal``.
+
+Varlen/packed sequences are expressed with integer segment ids
+(q_segment_ids [B, Sq], kv_segment_ids [B, Skv]): position pairs in
+different segments never attend. ``flash_attn_unpadded`` builds these
+from cu_seqlens (see ops/attention.py).
 
 Backward (FlashAttention-2 recurrence, the capability of the
 reference's flash_attn_grad_kernel.cu): the forward additionally emits
 the per-row logsumexp L; backward recomputes P = exp(S - L) blockwise in
-VMEM and runs TWO kernels — a dq kernel gridded over q blocks and a
-dk/dv kernel gridded over kv blocks (TPU has no atomics, so each output
-gets its own reduction loop). Residual memory is O(S) per head
-(L + delta), never O(S²).
+VMEM and runs TWO kernels — a dq kernel (grid over q blocks, kv
+streaming innermost) and a dk/dv kernel (grid over kv blocks, q
+streaming innermost); TPU has no atomics, so each output owns its
+reduction. Residual memory is O(S) per head (L + delta), never O(S²).
 
 Layout [B, S, H, D] (the paddle flash_attention layout). Grid:
-(B*H, S/block); f32 accumulation; MXU-shaped tiles (128 lanes).
+(B*H, blocks, blocks); f32 accumulation; MXU-shaped tiles (128 lanes).
 """
 from __future__ import annotations
 
@@ -45,81 +57,167 @@ from . import is_tpu_platform, pick_block as _pick_block
 __all__ = ["flash_attention_fwd"]
 
 _NEG = -1e30
+_BLOCKS = (512, 256, 128, 64, 32, 16, 8)
 
 
-def _causal_mask(qi, j, block_q, block_kv):
-    rows = qi * block_q + lax.broadcasted_iota(
-        jnp.int32, (block_q, block_kv), 0)
-    cols = j * block_kv + lax.broadcasted_iota(
-        jnp.int32, (block_q, block_kv), 1)
-    return rows >= cols
+def _compiler_params(n_parallel, interpret=False):
+    """Tell Mosaic which grid axes are parallel (the kv/q streaming axis
+    is 'arbitrary': it carries the scratch recurrence)."""
+    if pltpu is None or interpret:
+        return {}
+    sem = ("parallel",) * n_parallel + ("arbitrary",)
+    for cls_name in ("CompilerParams", "TPUCompilerParams"):
+        cls = getattr(pltpu, cls_name, None)
+        if cls is not None:
+            try:
+                return {"compiler_params": cls(dimension_semantics=sem)}
+            except Exception:  # pragma: no cover - API drift
+                continue
+    return {}
 
 
-def _kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal, block_q,
-            block_kv, seq_kv):
-    qb = q_ref[0].astype(jnp.float32) * scale            # [bq, D]
-    qi = pl.program_id(1)
-    D = qb.shape[-1]
-    nkv = seq_kv // block_kv
-
-    def body(j, carry):
-        m, l, acc = carry
-        kb = k_ref[0, pl.ds(j * block_kv, block_kv), :].astype(jnp.float32)
-        vb = v_ref[0, pl.ds(j * block_kv, block_kv), :].astype(jnp.float32)
-        s = lax.dot_general(qb, kb, (((1,), (1,)), ((), ())),
-                            preferred_element_type=jnp.float32)
-        if causal:
-            keep = _causal_mask(qi, j, block_q, block_kv)
-            s = jnp.where(keep, s, _NEG)
-        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
-        p = jnp.exp(s - m_new)
-        if causal:
-            p = jnp.where(keep, p, 0.0)
-        corr = jnp.exp(m - m_new)
-        l = l * corr + jnp.sum(p, axis=-1, keepdims=True)
-        acc = acc * corr + lax.dot_general(
-            p, vb, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        return m_new, l, acc
-
-    m0 = jnp.full((block_q, 1), _NEG, jnp.float32)
-    l0 = jnp.zeros((block_q, 1), jnp.float32)
-    acc0 = jnp.zeros((block_q, D), jnp.float32)
+def _mask(qi, j, block_q, block_kv, q_off, causal, qseg, kseg):
+    """[block_q, block_kv] keep-mask (True = attend) or None if nothing
+    is masked. qseg/kseg are VMEM blocks or None."""
+    keep = None
     if causal:
-        # blocks strictly above the diagonal contribute nothing — skip
-        upper = jnp.minimum(
-            (qi * block_q + block_q + block_kv - 1) // block_kv, nkv)
+        rows = q_off + qi * block_q + lax.broadcasted_iota(
+            jnp.int32, (block_q, block_kv), 0)
+        cols = j * block_kv + lax.broadcasted_iota(
+            jnp.int32, (block_q, block_kv), 1)
+        keep = rows >= cols
+    if qseg is not None:
+        same = qseg[0][:, None] == kseg[0][None, :]
+        keep = same if keep is None else (keep & same)
+    return keep
+
+
+def _last_kv_block(qi, block_q, block_kv, q_off, causal, nkv):
+    """Index of the last kv block any row of q-block ``qi`` attends to."""
+    if not causal:
+        return nkv - 1
+    return jnp.minimum(
+        (q_off + (qi + 1) * block_q - 1) // block_kv, nkv - 1)
+
+
+def _first_q_block(ki, block_q, block_kv, q_off, causal, nq):
+    """Index of the first q block that sees kv block ``ki`` (causal)."""
+    if not causal:
+        return 0
+    return jnp.clip((ki * block_kv - q_off) // block_q, 0, nq - 1)
+
+
+# ---------------------------------------------------------------------------
+# Forward
+# ---------------------------------------------------------------------------
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, *refs, scale, causal, block_q,
+                block_kv, q_off, nkv, has_seg):
+    if has_seg:
+        qseg_ref, kseg_ref, o_ref, lse_ref, m_s, l_s, acc_s = refs
     else:
-        upper = nkv
-    m, l, acc = lax.fori_loop(0, upper, body, (m0, l0, acc0))
-    l = jnp.maximum(l, 1e-30)
-    o_ref[0] = (acc / l).astype(o_ref.dtype)
-    lse_ref[0, 0, :] = (m + jnp.log(l))[:, 0]
+        o_ref, lse_ref, m_s, l_s, acc_s = refs
+        qseg_ref = kseg_ref = None
+    qi = pl.program_id(1)
+    j = pl.program_id(2)
+    j_last = _last_kv_block(qi, block_q, block_kv, q_off, causal, nkv)
+
+    @pl.when(j == 0)
+    def _():
+        m_s[...] = jnp.full_like(m_s, _NEG)
+        l_s[...] = jnp.zeros_like(l_s)
+        acc_s[...] = jnp.zeros_like(acc_s)
+
+    @pl.when(j <= j_last)
+    def _():
+        # matmuls run in the INPUT dtype (bf16 = native MXU mode; f32
+        # inputs stay accurate) with f32 accumulation
+        qb = q_ref[0]                                    # [bq, D]
+        kb = k_ref[0]                                    # [bkv, D]
+        vb = v_ref[0]
+        s = lax.dot_general(qb, kb, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+        keep = _mask(qi, j, block_q, block_kv, q_off, causal,
+                     qseg_ref, kseg_ref)
+        if keep is not None:
+            s = jnp.where(keep, s, _NEG)
+        m_prev = m_s[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, -1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        if keep is not None:
+            p = jnp.where(keep, p, 0.0)
+        corr = jnp.exp(m_prev - m_new)
+        l_s[:, :1] = l_s[:, :1] * corr + jnp.sum(p, -1, keepdims=True)
+        acc_s[...] = acc_s[...] * corr + lax.dot_general(
+            p.astype(vb.dtype), vb, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_s[:, :1] = m_new
+
+    @pl.when(j == nkv - 1)
+    def _():
+        l = jnp.maximum(l_s[:, :1], 1e-30)
+        o_ref[0] = (acc_s[...] / l).astype(o_ref.dtype)
+        lse_ref[0, 0, :] = (m_s[:, :1] + jnp.log(l))[:, 0]
 
 
-def _pallas_fa(q3, k3, v3, causal, scale, block_q, block_kv, interpret):
-    BH, S, D = q3.shape
+def _seg_specs(H, block_q, block_kv, kv_index, kw):
+    """BlockSpecs for [B, S]-shaped segment-id arrays; the BH grid axis
+    maps to batch via // H."""
+    qs = pl.BlockSpec((1, block_q), lambda b, i, j: (b // H, i), **kw)
+    ks = pl.BlockSpec((1, block_kv),
+                      lambda b, i, j: (b // H, kv_index(b, i, j)), **kw)
+    return qs, ks
+
+
+def _pallas_fa(q3, k3, v3, qseg, kseg, H, causal, scale, block_q, block_kv,
+               interpret):
+    BH, Sq, D = q3.shape
     Skv = k3.shape[1]
+    q_off = Skv - Sq
+    nq, nkv = Sq // block_q, Skv // block_kv
     kw = {} if _VMEM is None else {"memory_space": _VMEM}
+
+    def kv_index(b, i, j):
+        # clamp past the causal frontier: re-use the resident block, no DMA
+        return jnp.minimum(
+            j, _last_kv_block(i, block_q, block_kv, q_off, causal, nkv))
+
+    in_specs = [
+        pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0), **kw),
+        pl.BlockSpec((1, block_kv, D),
+                     lambda b, i, j: (b, kv_index(b, i, j), 0), **kw),
+        pl.BlockSpec((1, block_kv, D),
+                     lambda b, i, j: (b, kv_index(b, i, j), 0), **kw),
+    ]
+    args = [q3, k3, v3]
+    if qseg is not None:
+        qs, ks = _seg_specs(H, block_q, block_kv, kv_index, kw)
+        in_specs += [qs, ks]
+        args += [qseg, kseg]
+    kernel = partial(_fwd_kernel, scale=scale, causal=causal,
+                     block_q=block_q, block_kv=block_kv, q_off=q_off,
+                     nkv=nkv, has_seg=qseg is not None)
     return pl.pallas_call(
-        partial(_kernel, scale=scale, causal=causal, block_q=block_q,
-                block_kv=block_kv, seq_kv=Skv),
-        grid=(BH, S // block_q),
-        in_specs=[
-            pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0), **kw),
-            pl.BlockSpec((1, Skv, D), lambda b, i: (b, 0, 0), **kw),
-            pl.BlockSpec((1, Skv, D), lambda b, i: (b, 0, 0), **kw),
-        ],
+        kernel,
+        grid=(BH, nq, nkv),
+        in_specs=in_specs,
         out_specs=[
-            pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0), **kw),
-            pl.BlockSpec((1, 1, block_q), lambda b, i: (b, 0, i), **kw),
+            pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0), **kw),
+            pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i), **kw),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((BH, S, D), q3.dtype),
-            jax.ShapeDtypeStruct((BH, 1, S), jnp.float32),
+            jax.ShapeDtypeStruct((BH, Sq, D), q3.dtype),
+            jax.ShapeDtypeStruct((BH, 1, Sq), jnp.float32),
         ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, D), jnp.float32),
+        ] if pltpu is not None else [],
         interpret=interpret,
-    )(q3, k3, v3)
+        **_compiler_params(2, interpret),
+    )(*args)
 
 
 # ---------------------------------------------------------------------------
@@ -127,134 +225,200 @@ def _pallas_fa(q3, k3, v3, causal, scale, block_q, block_kv, interpret):
 # ---------------------------------------------------------------------------
 
 
-def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref, dq_ref, *,
-               scale, causal, block_q, block_kv, seq_kv):
-    qb = q_ref[0].astype(jnp.float32) * scale            # [bq, D]
-    dob = do_ref[0].astype(jnp.float32)                  # [bq, D]
-    lse = lse_ref[0, 0, :].astype(jnp.float32)[:, None]   # [bq, 1]
-    delta = dl_ref[0, 0, :].astype(jnp.float32)[:, None]  # [bq, 1]
-    qi = pl.program_id(1)
-    D = qb.shape[-1]
-    nkv = seq_kv // block_kv
-
-    def body(j, dq):
-        kb = k_ref[0, pl.ds(j * block_kv, block_kv), :].astype(jnp.float32)
-        vb = v_ref[0, pl.ds(j * block_kv, block_kv), :].astype(jnp.float32)
-        s = lax.dot_general(qb, kb, (((1,), (1,)), ((), ())),
-                            preferred_element_type=jnp.float32)
-        if causal:
-            keep = _causal_mask(qi, j, block_q, block_kv)
-            s = jnp.where(keep, s, _NEG)
-        p = jnp.exp(s - lse)                             # [bq, bkv]
-        if causal:
-            p = jnp.where(keep, p, 0.0)
-        dp = lax.dot_general(dob, vb, (((1,), (1,)), ((), ())),
-                             preferred_element_type=jnp.float32)
-        ds = p * (dp - delta)
-        return dq + lax.dot_general(ds, kb, (((1,), (0,)), ((), ())),
-                                    preferred_element_type=jnp.float32)
-
-    if causal:
-        upper = jnp.minimum(
-            (qi * block_q + block_q + block_kv - 1) // block_kv, nkv)
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref, *refs, scale,
+               causal, block_q, block_kv, q_off, nkv, has_seg):
+    if has_seg:
+        qseg_ref, kseg_ref, dq_ref, dq_s = refs
     else:
-        upper = nkv
-    dq = lax.fori_loop(0, upper, body, jnp.zeros((block_q, D), jnp.float32))
-    dq_ref[0] = (dq * scale).astype(dq_ref.dtype)
+        dq_ref, dq_s = refs
+        qseg_ref = kseg_ref = None
+    qi = pl.program_id(1)
+    j = pl.program_id(2)
+    j_last = _last_kv_block(qi, block_q, block_kv, q_off, causal, nkv)
 
+    @pl.when(j == 0)
+    def _():
+        dq_s[...] = jnp.zeros_like(dq_s)
 
-def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref, dk_ref,
-                dv_ref, *, scale, causal, block_q, block_kv, seq_q):
-    kb = k_ref[0].astype(jnp.float32)                    # [bkv, D]
-    vb = v_ref[0].astype(jnp.float32)
-    ki = pl.program_id(1)
-    D = kb.shape[-1]
-    nq = seq_q // block_q
-
-    def body(i, carry):
-        dk, dv = carry
-        qb = q_ref[0, pl.ds(i * block_q, block_q), :].astype(
-            jnp.float32) * scale
-        dob = do_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
-        lse = lse_ref[0, 0, pl.ds(i * block_q, block_q)].astype(
-            jnp.float32)[:, None]
-        delta = dl_ref[0, 0, pl.ds(i * block_q, block_q)].astype(
-            jnp.float32)[:, None]
+    @pl.when(j <= j_last)
+    def _():
+        qb = q_ref[0]
+        dob = do_ref[0]
+        lse = lse_ref[0, 0, :][:, None]
+        delta = dl_ref[0, 0, :][:, None]
+        kb = k_ref[0]
+        vb = v_ref[0]
         s = lax.dot_general(qb, kb, (((1,), (1,)), ((), ())),
-                            preferred_element_type=jnp.float32)
-        if causal:
-            keep = _causal_mask(i, ki, block_q, block_kv)
+                            preferred_element_type=jnp.float32) * scale
+        keep = _mask(qi, j, block_q, block_kv, q_off, causal,
+                     qseg_ref, kseg_ref)
+        if keep is not None:
             s = jnp.where(keep, s, _NEG)
         p = jnp.exp(s - lse)
-        if causal:
+        if keep is not None:
             p = jnp.where(keep, p, 0.0)
-        dv = dv + lax.dot_general(p, dob, (((0,), (0,)), ((), ())),
-                                  preferred_element_type=jnp.float32)
         dp = lax.dot_general(dob, vb, (((1,), (1,)), ((), ())),
                              preferred_element_type=jnp.float32)
         ds = p * (dp - delta)
-        dk = dk + lax.dot_general(ds, qb, (((0,), (0,)), ((), ())),
-                                  preferred_element_type=jnp.float32)
-        return dk, dv
+        dq_s[...] += lax.dot_general(ds.astype(kb.dtype), kb,
+                                     (((1,), (0,)), ((), ())),
+                                     preferred_element_type=jnp.float32)
 
-    # causal: q blocks strictly before this kv block see none of it
-    lower = (ki * block_kv) // block_q if causal else 0
-    z = jnp.zeros((block_kv, D), jnp.float32)
-    dk, dv = lax.fori_loop(lower, nq, body, (z, z))
-    dk_ref[0] = dk.astype(dk_ref.dtype)
-    dv_ref[0] = dv.astype(dv_ref.dtype)
+    @pl.when(j == nkv - 1)
+    def _():
+        dq_ref[0] = (dq_s[...] * scale).astype(dq_ref.dtype)
 
 
-def _pallas_fa_bwd(q3, k3, v3, do3, lse, delta, causal, scale, block_q,
-                   block_kv, interpret):
-    BH, S, D = q3.shape
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref, *refs, scale,
+                causal, block_q, block_kv, q_off, nq, has_seg):
+    if has_seg:
+        qseg_ref, kseg_ref, dk_ref, dv_ref, dk_s, dv_s = refs
+    else:
+        dk_ref, dv_ref, dk_s, dv_s = refs
+        qseg_ref = kseg_ref = None
+    ki = pl.program_id(1)
+    i = pl.program_id(2)
+    i_first = _first_q_block(ki, block_q, block_kv, q_off, causal, nq)
+
+    @pl.when(i == 0)
+    def _():
+        dk_s[...] = jnp.zeros_like(dk_s)
+        dv_s[...] = jnp.zeros_like(dv_s)
+
+    @pl.when(i >= i_first)
+    def _():
+        kb = k_ref[0]
+        vb = v_ref[0]
+        qb = q_ref[0]
+        dob = do_ref[0]
+        lse = lse_ref[0, 0, :][:, None]
+        delta = dl_ref[0, 0, :][:, None]
+        s = lax.dot_general(qb, kb, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+        keep = _mask(i, ki, block_q, block_kv, q_off, causal,
+                     qseg_ref, kseg_ref)
+        if keep is not None:
+            s = jnp.where(keep, s, _NEG)
+        p = jnp.exp(s - lse)
+        if keep is not None:
+            p = jnp.where(keep, p, 0.0)
+        dv_s[...] += lax.dot_general(p.astype(dob.dtype), dob,
+                                     (((0,), (0,)), ((), ())),
+                                     preferred_element_type=jnp.float32)
+        dp = lax.dot_general(dob, vb, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+        ds = (p * (dp - delta))
+        dk_s[...] += lax.dot_general(ds.astype(qb.dtype), qb,
+                                     (((0,), (0,)), ((), ())),
+                                     preferred_element_type=jnp.float32)
+
+    @pl.when(i == nq - 1)
+    def _():
+        dk_ref[0] = (dk_s[...] * scale).astype(dk_ref.dtype)
+        dv_ref[0] = dv_s[...].astype(dv_ref.dtype)
+
+
+def _pallas_fa_bwd(q3, k3, v3, do3, lse, delta, qseg, kseg, H, causal,
+                   scale, block_q, block_kv, interpret):
+    BH, Sq, D = q3.shape
     Skv = k3.shape[1]
+    q_off = Skv - Sq
+    nq, nkv = Sq // block_q, Skv // block_kv
     kw = {} if _VMEM is None else {"memory_space": _VMEM}
+    scratch = ([] if pltpu is None else
+               [pltpu.VMEM((block_q, D), jnp.float32)])
+
+    def kv_index(b, i, j):
+        return jnp.minimum(
+            j, _last_kv_block(i, block_q, block_kv, q_off, causal, nkv))
+
+    dq_specs = [
+        pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0), **kw),
+        pl.BlockSpec((1, block_kv, D),
+                     lambda b, i, j: (b, kv_index(b, i, j), 0), **kw),
+        pl.BlockSpec((1, block_kv, D),
+                     lambda b, i, j: (b, kv_index(b, i, j), 0), **kw),
+        pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0), **kw),
+        pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i), **kw),
+        pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i), **kw),
+    ]
+    dq_args = [q3, k3, v3, do3, lse, delta]
+    if qseg is not None:
+        qs, ks = _seg_specs(H, block_q, block_kv, kv_index, kw)
+        dq_specs += [qs, ks]
+        dq_args += [qseg, kseg]
+    dq_kernel = partial(_dq_kernel, scale=scale, causal=causal,
+                        block_q=block_q, block_kv=block_kv, q_off=q_off,
+                        nkv=nkv, has_seg=qseg is not None)
     dq = pl.pallas_call(
-        partial(_dq_kernel, scale=scale, causal=causal, block_q=block_q,
-                block_kv=block_kv, seq_kv=Skv),
-        grid=(BH, S // block_q),
-        in_specs=[
-            pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0), **kw),
-            pl.BlockSpec((1, Skv, D), lambda b, i: (b, 0, 0), **kw),
-            pl.BlockSpec((1, Skv, D), lambda b, i: (b, 0, 0), **kw),
-            pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0), **kw),
-            pl.BlockSpec((1, 1, block_q), lambda b, i: (b, 0, i), **kw),
-            pl.BlockSpec((1, 1, block_q), lambda b, i: (b, 0, i), **kw),
-        ],
-        out_specs=pl.BlockSpec((1, block_q, D), lambda b, i: (b, i, 0),
+        dq_kernel,
+        grid=(BH, nq, nkv),
+        in_specs=dq_specs,
+        out_specs=pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0),
                                **kw),
-        out_shape=jax.ShapeDtypeStruct((BH, S, D), q3.dtype),
+        out_shape=jax.ShapeDtypeStruct((BH, Sq, D), q3.dtype),
+        scratch_shapes=scratch,
         interpret=interpret,
-    )(q3, k3, v3, do3, lse, delta)
+        **_compiler_params(2, interpret),
+    )(*dq_args)
+
+    def q_index(b, j, i):
+        # clamp before the causal frontier: skip the DMA for q blocks
+        # that cannot see this kv block
+        return jnp.maximum(
+            i, _first_q_block(j, block_q, block_kv, q_off, causal, nq))
+
+    dkv_specs = [
+        pl.BlockSpec((1, block_q, D),
+                     lambda b, j, i: (b, q_index(b, j, i), 0), **kw),
+        pl.BlockSpec((1, block_kv, D), lambda b, j, i: (b, j, 0), **kw),
+        pl.BlockSpec((1, block_kv, D), lambda b, j, i: (b, j, 0), **kw),
+        pl.BlockSpec((1, block_q, D),
+                     lambda b, j, i: (b, q_index(b, j, i), 0), **kw),
+        pl.BlockSpec((1, 1, block_q),
+                     lambda b, j, i: (b, 0, q_index(b, j, i)), **kw),
+        pl.BlockSpec((1, 1, block_q),
+                     lambda b, j, i: (b, 0, q_index(b, j, i)), **kw),
+    ]
+    dkv_args = [q3, k3, v3, do3, lse, delta]
+    if qseg is not None:
+        qs = pl.BlockSpec((1, block_q),
+                          lambda b, j, i: (b // H, q_index(b, j, i)), **kw)
+        ks = pl.BlockSpec((1, block_kv), lambda b, j, i: (b // H, j), **kw)
+        dkv_specs += [qs, ks]
+        dkv_args += [qseg, kseg]
+    dkv_kernel = partial(_dkv_kernel, scale=scale, causal=causal,
+                         block_q=block_q, block_kv=block_kv, q_off=q_off,
+                         nq=nq, has_seg=qseg is not None)
+    dkv_scratch = ([] if pltpu is None else
+                   [pltpu.VMEM((block_kv, D), jnp.float32),
+                    pltpu.VMEM((block_kv, D), jnp.float32)])
     dk, dv = pl.pallas_call(
-        partial(_dkv_kernel, scale=scale, causal=causal, block_q=block_q,
-                block_kv=block_kv, seq_q=S),
-        grid=(BH, Skv // block_kv),
-        in_specs=[
-            pl.BlockSpec((1, S, D), lambda b, j: (b, 0, 0), **kw),
-            pl.BlockSpec((1, block_kv, D), lambda b, j: (b, j, 0), **kw),
-            pl.BlockSpec((1, block_kv, D), lambda b, j: (b, j, 0), **kw),
-            pl.BlockSpec((1, S, D), lambda b, j: (b, 0, 0), **kw),
-            pl.BlockSpec((1, 1, S), lambda b, j: (b, 0, 0), **kw),
-            pl.BlockSpec((1, 1, S), lambda b, j: (b, 0, 0), **kw),
-        ],
+        dkv_kernel,
+        grid=(BH, nkv, nq),
+        in_specs=dkv_specs,
         out_specs=[
-            pl.BlockSpec((1, block_kv, D), lambda b, j: (b, j, 0), **kw),
-            pl.BlockSpec((1, block_kv, D), lambda b, j: (b, j, 0), **kw),
+            pl.BlockSpec((1, block_kv, D), lambda b, j, i: (b, j, 0), **kw),
+            pl.BlockSpec((1, block_kv, D), lambda b, j, i: (b, j, 0), **kw),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((BH, Skv, D), k3.dtype),
             jax.ShapeDtypeStruct((BH, Skv, D), v3.dtype),
         ],
+        scratch_shapes=dkv_scratch,
         interpret=interpret,
-    )(q3, k3, v3, do3, lse, delta)
+        **_compiler_params(2, interpret),
+    )(*dkv_args)
     return dq, dk, dv
 
 
 def _supported(q, k) -> bool:
-    B, S, H, D = q.shape
-    return k.shape[1] == S and _pick_block(S) > 0
+    B, Sq, H, D = q.shape
+    Skv = k.shape[1]
+    if _pick_block(Sq) <= 0 or _pick_block(Skv) <= 0:
+        return False
+    # rectangular causal convention needs q to be a suffix of the kv span
+    return Skv >= Sq
 
 
 def _interpret_default() -> bool:
@@ -272,50 +436,65 @@ def _from3(x3, B, H):
 
 
 @partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
-def flash_attention_fwd(q, k, v, causal=False, scale=None,
-                        interpret=None):
+def flash_attention_fwd(q, k, v, causal=False, scale=None, interpret=None,
+                        q_segment_ids=None, kv_segment_ids=None):
     """[B, S, H, D] → [B, S, H, D]; raises ValueError when the shape
-    needs the XLA fallback (caller catches)."""
-    out, _ = _fa_fwd(q, k, v, causal, scale, interpret)
+    needs the XLA fallback (caller catches). Optional int32 segment ids
+    [B, Sq]/[B, Skv] restrict attention to equal segments (varlen)."""
+    out, _ = _fa_fwd(q, k, v, causal, scale, interpret, q_segment_ids,
+                     kv_segment_ids)
     return out
 
 
-def _fa_fwd(q, k, v, causal, scale, interpret):
+def _prep(q, k, causal, scale, interpret, qseg, kseg):
+    B, Sq, H, D = q.shape
+    if scale is None:
+        scale = 1.0 / np.sqrt(D)
+    if interpret is None:
+        interpret = _interpret_default()
+    if (qseg is None) != (kseg is None):
+        raise ValueError("flash: q/kv segment ids must be given together")
+    if qseg is not None:
+        qseg = jnp.asarray(qseg, jnp.int32)
+        kseg = jnp.asarray(kseg, jnp.int32)
+    # 512-blocks measured fastest on v5e at S=8192 (44.9 TF/s vs 9.7 at
+    # 128); smaller sizes only when the sequence doesn't divide
+    block_q = _pick_block(Sq, prefer=_BLOCKS)
+    block_kv = _pick_block(k.shape[1], prefer=_BLOCKS)
+    return scale, interpret, qseg, kseg, block_q, block_kv
+
+
+def _fa_fwd(q, k, v, causal, scale, interpret, qseg=None, kseg=None):
     if not _supported(q, k):
         raise ValueError("flash pallas kernel: unsupported shape "
                          f"{q.shape}/{k.shape}")
-    B, S, H, D = q.shape
-    if scale is None:
-        scale = 1.0 / np.sqrt(D)
-    if interpret is None:
-        interpret = _interpret_default()
-    block_q = _pick_block(S)
-    block_kv = _pick_block(k.shape[1])
-    o3, lse = _pallas_fa(_to3(q), _to3(k), _to3(v), causal, scale, block_q,
-                         block_kv, interpret)
+    B, Sq, H, D = q.shape
+    scale, interpret, qseg, kseg, block_q, block_kv = _prep(
+        q, k, causal, scale, interpret, qseg, kseg)
+    o3, lse = _pallas_fa(_to3(q), _to3(k), _to3(v), qseg, kseg, H, causal,
+                         scale, block_q, block_kv, interpret)
     out = _from3(o3, B, H)
-    return out, (q, k, v, out, lse)
+    return out, (q, k, v, out, lse, qseg, kseg)
 
 
 def _fa_bwd(causal, scale, interpret, res, g):
-    q, k, v, out, lse = res
-    B, S, H, D = q.shape
-    if scale is None:
-        scale = 1.0 / np.sqrt(D)
-    if interpret is None:
-        interpret = _interpret_default()
+    q, k, v, out, lse, qseg, kseg = res
+    B, Sq, H, D = q.shape
+    scale, interpret, qseg, kseg, block_q, block_kv = _prep(
+        q, k, causal, scale, interpret, qseg, kseg)
     q3, k3, v3 = _to3(q), _to3(k), _to3(v)
     do3, o3 = _to3(g), _to3(out)
     # delta_i = rowsum(dO ∘ O): O(S) per head, fused by XLA
     delta = jnp.sum(do3.astype(jnp.float32) * o3.astype(jnp.float32),
                     axis=-1)[:, None, :]
-    block_q = _pick_block(S)
-    block_kv = _pick_block(k.shape[1])
-    dq3, dk3, dv3 = _pallas_fa_bwd(q3, k3, v3, do3, lse, delta, causal,
-                                   scale, block_q, block_kv, interpret)
-    return (_from3(dq3, B, H), _from3(dk3, B, H), _from3(dv3, B, H))
+    dq3, dk3, dv3 = _pallas_fa_bwd(q3, k3, v3, do3, lse, delta, qseg, kseg,
+                                   H, causal, scale, block_q, block_kv,
+                                   interpret)
+    return (_from3(dq3, B, H), _from3(dk3, B, H), _from3(dv3, B, H),
+            None, None)
 
 
-flash_attention_fwd.defvjp(lambda q, k, v, causal, scale, interpret:
-                           _fa_fwd(q, k, v, causal, scale, interpret),
-                           _fa_bwd)
+flash_attention_fwd.defvjp(
+    lambda q, k, v, causal, scale, interpret, qseg=None, kseg=None:
+    _fa_fwd(q, k, v, causal, scale, interpret, qseg, kseg),
+    _fa_bwd)

@@ -159,3 +159,113 @@ def test_incubate_fused_functional():
 
     sw = FF.swiglu(x)
     assert sw.shape == [2, 6, 8]
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_attention_rectangular(causal):
+    """seq_q != seq_kv (q rows are the LAST Sq rows under causal)."""
+    rng = np.random.RandomState(2)
+    B, H, D = 2, 2, 16
+    q = jnp.asarray(rng.randn(B, 32, H, D).astype("float32"))
+    k = jnp.asarray(rng.randn(B, 128, H, D).astype("float32"))
+    v = jnp.asarray(rng.randn(B, 128, H, D).astype("float32"))
+    out = flash_attention_fwd(q, k, v, causal, None, True)
+    # dense reference with explicit rectangular causal mask
+    qf, kf, vf = (jnp.swapaxes(x, 1, 2) for x in (q, k, v))
+    s = jnp.einsum("bhsd,bhtd->bhst", qf, kf) / np.sqrt(D)
+    if causal:
+        keep = (96 + jnp.arange(32)[:, None]) >= jnp.arange(128)[None, :]
+        s = jnp.where(keep[None, None], s, -1e30)
+    ref = jnp.swapaxes(
+        jnp.einsum("bhst,bhtd->bhsd", jax.nn.softmax(s, -1), vf), 1, 2)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_flash_attention_segment_ids():
+    """Packed varlen: tokens only attend within their own segment."""
+    rng = np.random.RandomState(3)
+    B, S, H, D = 2, 64, 2, 16
+    q, k, v = (jnp.asarray(rng.randn(B, S, H, D).astype("float32"))
+               for _ in range(3))
+    seg = np.zeros((B, S), np.int32)
+    seg[:, 20:45] = 1
+    seg[:, 45:] = 2
+    seg = jnp.asarray(seg)
+    out = flash_attention_fwd(q, k, v, True, None, True, seg, seg)
+    qf, kf, vf = (jnp.swapaxes(x, 1, 2) for x in (q, k, v))
+    s = jnp.einsum("bhsd,bhtd->bhst", qf, kf) / np.sqrt(D)
+    keep = (jnp.arange(S)[:, None] >= jnp.arange(S)[None, :])[None, None]
+    keep = keep & (seg[:, None, :, None] == seg[:, None, None, :])
+    s = jnp.where(keep, s, -1e30)
+    ref = jnp.swapaxes(
+        jnp.einsum("bhst,bhtd->bhsd", jax.nn.softmax(s, -1), vf), 1, 2)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+    # grads flow through the masked kernel
+    g = jax.grad(lambda q: jnp.sum(
+        flash_attention_fwd(q, k, v, True, None, True, seg, seg) ** 2))(q)
+    assert np.isfinite(np.asarray(g)).all()
+
+
+@pytest.mark.parametrize("kv_heads", [8, 2])   # MHA and GQA
+@pytest.mark.parametrize("offset", [0, 37, 200])
+def test_decode_attention_kernel(kv_heads, offset):
+    """Streaming cache-KV decode kernel vs the dense cache attention."""
+    from paddle_tpu.models.llama import _cache_attention_dense
+    from paddle_tpu.ops.pallas.decode_attention import decode_attention
+
+    rng = np.random.RandomState(4)
+    B, Sq, H, D, M = 2, 1, 8, 32, 256
+    q = jnp.asarray(rng.randn(B, Sq, H, D).astype("float32"))
+    kc = jnp.asarray(rng.randn(B, kv_heads, M, D).astype("float32"))
+    vc = jnp.asarray(rng.randn(B, kv_heads, M, D).astype("float32"))
+    out = decode_attention(q, kc, vc, offset, interpret=True)
+    ref = _cache_attention_dense(q, kc, vc, offset, Sq)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_decode_attention_chunked_prefill():
+    """Sq>1 chunk against a partially-filled cache (chunked prefill) with
+    a traced offset under jit."""
+    from paddle_tpu.models.llama import _cache_attention_dense
+    from paddle_tpu.ops.pallas.decode_attention import decode_attention
+
+    rng = np.random.RandomState(5)
+    B, Sq, H, D, M = 1, 16, 4, 32, 128
+    q = jnp.asarray(rng.randn(B, Sq, H, D).astype("float32"))
+    kc = jnp.asarray(rng.randn(B, H, M, D).astype("float32"))
+    vc = jnp.asarray(rng.randn(B, H, M, D).astype("float32"))
+    f = jax.jit(lambda q, kc, vc, off: decode_attention(
+        q, kc, vc, off, interpret=True))
+    for off in (0, 50, M - Sq):
+        out = f(q, kc, vc, off)
+        ref = _cache_attention_dense(q, kc, vc, off, Sq)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
+
+
+def test_flash_attn_unpadded_varlen():
+    """Packed sequences attend only within their own boundaries."""
+    import paddle_tpu as paddle
+    import paddle_tpu.nn.functional as F
+
+    rng = np.random.RandomState(6)
+    lens = [24, 40, 64]
+    total, H, D = sum(lens), 2, 16
+    q = rng.randn(total, H, D).astype("float32")
+    k = rng.randn(total, H, D).astype("float32")
+    v = rng.randn(total, H, D).astype("float32")
+    cu = np.cumsum([0] + lens).astype("int32")
+    out, _ = F.flash_attn_unpadded(
+        paddle.to_tensor(q), paddle.to_tensor(k), paddle.to_tensor(v),
+        paddle.to_tensor(cu), paddle.to_tensor(cu), causal=True)
+    out = np.asarray(out._value)
+    for i in range(len(lens)):
+        a, b = cu[i], cu[i + 1]
+        ref = _sdpa.raw(jnp.asarray(q[None, a:b]), jnp.asarray(k[None, a:b]),
+                        jnp.asarray(v[None, a:b]), attn_mask=None,
+                        dropout_p=0.0, is_causal=True)[0]
+        np.testing.assert_allclose(out[a:b], np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
