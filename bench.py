@@ -1,52 +1,239 @@
-"""Benchmark: GPT-3 1.3B training on TPU (BASELINE.md config 2).
+"""Benchmarks for the BASELINE.md configs, one JSON line each.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
-metric/value = measured model FLOPs utilization (MFU = 6*N*tok_s/peak —
-recompute FLOPs excluded, so remat lowers measured MFU honestly);
-vs_baseline = MFU over the 45%-MFU north-star target (the reference
-publishes no absolute numbers — BASELINE.md). Extra keys carry
-tokens/sec/chip and the device generation for the record.
+Covered rows (BASELINE.md):
+  1. ResNet-50 single chip ............ imgs/sec            (train step)
+  2. GPT-3 1.3B Fleet TP .............. tokens/sec/chip, MFU (headline,
+     printed LAST so single-line parsers keep seeing it)
+  4. ERNIE-MoE style GPT-MoE .......... tokens/sec/chip
+  5. Llama-7B generation .............. decode tokens/sec, ms/token
+     (compiled prefill + single-XLA-program scan decode, Pallas
+     decode-attention kernel, ctx 2048)
+Row 3 (13B hybrid TP*PP*DP) needs real multi-chip hardware - TBD.
 
-On CPU (no TPU attached) runs a tiny smoke config so the bench always
-produces a line.
+MFU = 6*N*tok_s/peak (recompute FLOPs excluded, so remat lowers measured
+MFU honestly); vs_baseline for the MFU line is measured/0.45 (the
+north-star target — the reference publishes no absolute numbers,
+BASELINE.md). The decode line's vs_baseline is the fraction of the
+HBM-bandwidth roofline (params_bytes / BW per token) achieved.
+
+On CPU (no TPU attached) runs tiny smoke configs so the bench always
+produces lines.
 """
 import json
+import sys
 import time
 
 import numpy as np
 
-# Peak dense bf16 FLOPs per chip by TPU generation (public specs).
+# Peak dense bf16 FLOPs and HBM bandwidth per chip by TPU generation
+# (public specs).
 _PEAK = {
-    "v4": 275e12, "v5e": 197e12, "v5 lite": 197e12, "v5litepod": 197e12,
-    "v5p": 459e12, "v6e": 918e12, "v6 lite": 918e12,
+    "v4": (275e12, 1.2e12),
+    "v5e": (197e12, 0.819e12), "v5 lite": (197e12, 0.819e12),
+    "v5litepod": (197e12, 0.819e12),
+    "v5p": (459e12, 2.765e12),
+    "v6e": (918e12, 1.64e12), "v6 lite": (918e12, 1.64e12),
 }
 
 
-def _peak_flops(device) -> float:
+def _chip(device):
     kind = str(getattr(device, "device_kind", "")).lower()
     for k, v in _PEAK.items():
         if k in kind:
             return v
     if "tpu" in str(getattr(device, "platform", "")).lower():
-        return 459e12  # unknown generation: assume v5p
-    return 0.0  # CPU: MFU not meaningful
+        return _PEAK["v5p"]  # unknown generation: assume v5p
+    return (0.0, 0.0)  # CPU: MFU not meaningful
 
 
-def main():
-    import jax
+def _emit(payload):
+    print(json.dumps(payload), flush=True)
 
+
+# ---------------------------------------------------------------------------
+# 1. ResNet-50 (BASELINE row 1)
+# ---------------------------------------------------------------------------
+def bench_resnet(on_tpu, dev):
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed import fleet
+    from paddle_tpu.distributed.engine import ParallelEngine
+    from paddle_tpu import nn
+    from paddle_tpu.vision.models import resnet18, resnet50
+
+    if on_tpu:
+        model_fn, B, steps = resnet50, 256, 5
+    else:
+        model_fn, B, steps = resnet18, 8, 2
+
+    paddle.seed(0)
+    model = model_fn(num_classes=1000 if on_tpu else 10)
+    if on_tpu:
+        model.astype("bfloat16")
+    opt = paddle.optimizer.Momentum(learning_rate=0.1, momentum=0.9,
+                                    parameters=model.parameters())
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 1, "mp_degree": 1}
+    hcg = fleet.init(is_collective=True, strategy=strategy)
+    eng = ParallelEngine(model, opt, hcg.mesh)
+    step = eng.train_step(
+        lambda m, b: nn.functional.cross_entropy(m(b["x"]), b["y"]))
+
+    r = np.random.RandomState(0)
+    hw = 224 if on_tpu else 32
+    batch = {
+        "x": paddle.to_tensor(
+            r.rand(B, 3, hw, hw).astype(
+                "float32" if not on_tpu else "bfloat16")),
+        "y": paddle.to_tensor(r.randint(0, 1000 if on_tpu else 10, (B,))),
+    }
+    loss = step(batch)
+    float(loss)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = step(batch)
+    float(loss)
+    dt = time.perf_counter() - t0
+    imgs_s = B * steps / dt
+    _emit({
+        "metric": "resnet50_train_imgs_per_sec" if on_tpu
+        else "resnet_smoke_imgs_per_sec",
+        "value": round(imgs_s, 2),
+        "unit": "imgs/s",
+        "vs_baseline": 0.0,  # reference publishes no number (BASELINE.md)
+        "batch": B,
+        "device": str(getattr(dev, "device_kind", dev.platform)),
+    })
+
+
+# ---------------------------------------------------------------------------
+# 4. GPT-MoE (ERNIE-MoE style, BASELINE row 4)
+# ---------------------------------------------------------------------------
+def bench_moe(on_tpu, dev):
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed import fleet
+    from paddle_tpu.distributed.engine import ParallelEngine
+    from paddle_tpu.models import (GPTConfig, GPTForCausalLM,
+                                   GPTPretrainingCriterion)
+
+    if on_tpu:
+        cfg = GPTConfig(vocab_size=50304, hidden_size=1024, num_layers=12,
+                        num_heads=16, max_position_embeddings=1024,
+                        dtype="bfloat16", num_experts=8, moe_every=2)
+        B, S, steps = 8, 1024, 5
+    else:
+        from paddle_tpu.models import gpt_moe_tiny
+
+        cfg = gpt_moe_tiny()
+        B, S, steps = 4, 16, 2
+
+    paddle.seed(0)
+    model = GPTForCausalLM(cfg)
+    crit = GPTPretrainingCriterion(cfg)
+    opt = paddle.optimizer.AdamW(learning_rate=1e-4,
+                                 parameters=model.parameters(),
+                                 state_dtype="bfloat16" if on_tpu else None)
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 1, "mp_degree": 1}
+    hcg = fleet.init(is_collective=True, strategy=strategy)
+    eng = ParallelEngine(model, opt, hcg.mesh)
+    step = eng.train_step(
+        lambda m, b: crit(m(b["x"]), b["y"]) + m.aux_loss)
+
+    r = np.random.RandomState(0)
+    ids = r.randint(0, cfg.vocab_size, (B, S + 1))
+    batch = {"x": paddle.to_tensor(ids[:, :-1]),
+             "y": paddle.to_tensor(ids[:, 1:])}
+    loss = step(batch)
+    float(loss)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = step(batch)
+    float(loss)
+    dt = time.perf_counter() - t0
+    tok_s = B * S * steps / dt
+    _emit({
+        "metric": "gpt_moe_train_tokens_per_sec" if on_tpu
+        else "moe_smoke_tokens_per_sec",
+        "value": round(tok_s, 2),
+        "unit": "tokens/s",
+        "vs_baseline": 0.0,  # reference publishes no number (BASELINE.md)
+        "num_experts": cfg.num_experts,
+        "device": str(getattr(dev, "device_kind", dev.platform)),
+    })
+
+
+# ---------------------------------------------------------------------------
+# 5. Llama-7B generation (BASELINE row 5)
+# ---------------------------------------------------------------------------
+def bench_llama_decode(on_tpu, dev):
+    import paddle_tpu as paddle
+    from paddle_tpu.inference import Config, create_predictor
+    from paddle_tpu.models.llama import LlamaForCausalLM, llama_7b, \
+        llama_tiny
+
+    peak, hbm_bw = _chip(dev)
+    old_dtype = paddle.get_default_dtype()
+    if on_tpu:
+        paddle.set_default_dtype("bfloat16")
+        cfg = llama_7b(max_position_embeddings=2304, dtype="bfloat16")
+        S_ctx, n_new = 2048, 128
+    else:
+        cfg = llama_tiny()
+        S_ctx, n_new = 24, 8
+    try:
+        paddle.seed(0)
+        model = LlamaForCausalLM(cfg)
+        pred = create_predictor(Config().set_model(model))
+        r = np.random.RandomState(0)
+        prompt = paddle.to_tensor(
+            r.randint(0, cfg.vocab_size, (1, S_ctx)))
+
+        # warm both programs, then time prefill-only and prefill+decode
+        float(pred.generate(prompt, max_new_tokens=1)._value[0, -1])
+        float(pred.generate(prompt, max_new_tokens=n_new)._value[0, -1])
+        t0 = time.perf_counter()
+        out = pred.generate(prompt, max_new_tokens=1)
+        float(out._value[0, -1])
+        t_prefill = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        out = pred.generate(prompt, max_new_tokens=n_new)
+        float(out._value[0, -1])
+        t_full = time.perf_counter() - t0
+        dec_s = max(t_full - t_prefill, 1e-9)
+        tok_s = (n_new - 1) / dec_s
+        ms_tok = dec_s / (n_new - 1) * 1e3
+        # decode is HBM-bound: roofline = BW / bytes-touched-per-token
+        n_params = cfg.num_params()
+        roofline = (hbm_bw / (2.0 * n_params)) if hbm_bw else 0.0
+        _emit({
+            "metric": "llama7b_decode_tokens_per_sec" if on_tpu
+            else "llama_smoke_decode_tokens_per_sec",
+            "value": round(tok_s, 2),
+            "unit": "tokens/s",
+            "vs_baseline": round(tok_s / roofline, 4) if roofline else 0.0,
+            "ms_per_token": round(ms_tok, 2),
+            "prefill_s": round(t_prefill, 3),
+            "context": S_ctx,
+            "params": n_params,
+            "device": str(getattr(dev, "device_kind", dev.platform)),
+        })
+    finally:
+        paddle.set_default_dtype(old_dtype)
+
+
+# ---------------------------------------------------------------------------
+# 2. GPT-3 1.3B training MFU (BASELINE row 2) - the headline, printed last
+# ---------------------------------------------------------------------------
+def bench_gpt(on_tpu, dev):
     import paddle_tpu as paddle
     from paddle_tpu.distributed import fleet
     from paddle_tpu.distributed.engine import ParallelEngine
     from paddle_tpu.models import GPTConfig, GPTForCausalLM, \
         GPTPretrainingCriterion
 
-    dev = jax.devices()[0]
-    peak = _peak_flops(dev)
-    on_tpu = peak > 0
-
+    peak, _ = _chip(dev)
     if on_tpu:
-        # GPT-3 1.3B (BASELINE config: Fleet TP — degree 1 on one chip):
+        # GPT-3 1.3B (BASELINE config: Fleet TP - degree 1 on one chip):
         # hidden 2048 x 24 layers, d_head 128. bf16 params + bf16 moments
         # (AdamW math in f32) to fit the 16GB HBM of a v5e chip.
         cfg = GPTConfig(vocab_size=50304, hidden_size=2048, num_layers=24,
@@ -90,7 +277,7 @@ def main():
     n_params = cfg.num_params()
     mfu = (6.0 * n_params * tok_s / peak) if peak else 0.0
     if on_tpu:
-        print(json.dumps({
+        _emit({
             "metric": "gpt1p3b_train_mfu",
             "value": round(mfu, 4),
             "unit": "mfu",
@@ -98,15 +285,56 @@ def main():
             "tokens_per_sec_per_chip": round(tok_s, 2),
             "device": str(getattr(dev, "device_kind", dev.platform)),
             "params": n_params,
-        }))
+        })
     else:
-        print(json.dumps({
+        _emit({
             "metric": "gpt_smoke_train_tokens_per_sec",
             "value": round(tok_s, 2),
             "unit": "tokens/s",
             "vs_baseline": 0.0,
-        }))
+        })
+
+
+_BENCHES = {}
+
+
+def _run_one(name):
+    import traceback
+
+    import jax
+
+    dev = jax.devices()[0]
+    on_tpu = _chip(dev)[0] > 0
+    fn = _BENCHES[name]
+    try:
+        fn(on_tpu, dev)
+    except Exception as e:
+        _emit({"metric": fn.__name__, "value": 0.0, "unit": "error",
+               "vs_baseline": 0.0,
+               "error": f"{type(e).__name__}: {e}",
+               "trace": traceback.format_exc()[-400:]})
+
+
+def main(argv):
+    _BENCHES.update(resnet=bench_resnet, moe=bench_moe,
+                    llama_decode=bench_llama_decode, gpt=bench_gpt)
+    if len(argv) > 1 and argv[1] == "--only":
+        _run_one(argv[2])
+        return
+    # each bench runs in its OWN process: TPU HBM is only reliably
+    # released at process exit (compiled executables pin buffers), and
+    # the 7B decode + 1.3B train benches each need most of a v5e chip
+    import subprocess
+
+    for name in ("resnet", "moe", "llama_decode", "gpt"):
+        try:
+            subprocess.run([sys.executable, __file__, "--only", name],
+                           timeout=1200)
+        except Exception as e:  # a hung bench must not drop later lines
+            _emit({"metric": f"bench_{name}", "value": 0.0, "unit": "error",
+                   "vs_baseline": 0.0,
+                   "error": f"{type(e).__name__}: {e}"})
 
 
 if __name__ == "__main__":
-    main()
+    main(sys.argv)

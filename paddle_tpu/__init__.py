@@ -37,6 +37,7 @@ from . import device  # noqa: F401
 from . import framework  # noqa: F401
 from . import incubate  # noqa: F401
 from .framework.io import load, save
+from .framework.lazy_init import LazyGuard  # noqa: F401
 from . import metric  # noqa: F401
 from . import distributed  # noqa: F401
 from . import hapi  # noqa: F401
@@ -44,6 +45,7 @@ from . import profiler  # noqa: F401
 from . import static  # noqa: F401
 from . import distribution  # noqa: F401
 from . import quantization  # noqa: F401
+from . import inference  # noqa: F401
 from .hapi import Model  # noqa: F401
 
 # paddle-API aliases

@@ -4,10 +4,13 @@
 overlap between stored shards and the target distribution, point-to-point
 reads the needed pieces, reassembles per rank.)
 
-TPU-native: the stored shards are reassembled into full ndarrays and
-``jax.device_put`` with each target tensor's current NamedSharding —
-XLA places only the addressed shards on each device, which IS the
-reshard (works across any source/target dp/mp/pp/sharding layout).
+TPU-native: for a sharded target, ``jax.make_array_from_callback`` asks
+for exactly this process's addressable shard windows; each window is
+assembled from the overlapping stored shards, and storage files are
+opened lazily only when one of their shards is actually needed. Host
+bytes per process are therefore O(addressable shards + touched files),
+not O(model) — the reshard across any source/target dp/mp/pp/sharding
+layout falls out of the window/shard overlap arithmetic.
 """
 from __future__ import annotations
 
@@ -39,6 +42,44 @@ def _flatten(state: Dict, prefix=""):
     return out
 
 
+class _LazyStorages:
+    """Opens .distcp files on first use (a process only pays for the
+    files whose shards overlap its windows)."""
+
+    def __init__(self, path: str):
+        self._path = path
+        self._cache: Dict[str, Dict] = {}
+
+    def get(self, fname: str):
+        if fname not in self._cache:
+            with open(os.path.join(self._path, fname), "rb") as f:
+                self._cache[fname] = pickle.load(f)
+        return self._cache[fname]
+
+
+def _window(md, storages, key, metas, gshape, dtype, sl):
+    """Assemble the ``sl`` window of tensor ``key`` from the stored
+    shards overlapping it."""
+    shape = tuple(s.indices(d)[1] - s.indices(d)[0]
+                  for s, d in zip(sl, gshape))
+    out = np.zeros(shape, dtype=dtype)
+    starts = tuple(s.indices(d)[0] for s, d in zip(sl, gshape))
+    stops = tuple(s.indices(d)[1] for s, d in zip(sl, gshape))
+    for m in metas:
+        lo = tuple(max(o, a) for o, a in zip(m.global_offset, starts))
+        hi = tuple(min(o + s, b) for o, s, b in
+                   zip(m.global_offset, m.local_shape, stops))
+        if any(l >= h for l, h in zip(lo, hi)):
+            continue  # no overlap with this stored shard
+        sk = f"{key}@" + "_".join(str(o) for o in m.global_offset)
+        data = storages.get(md.storage_metadata[sk])[sk]
+        src = tuple(slice(l - o, h - o) for l, h, o in
+                    zip(lo, hi, m.global_offset))
+        dst = tuple(slice(l - a, h - a) for l, h, a in zip(lo, hi, starts))
+        out[dst] = data[src]
+    return out
+
+
 def load_state_dict(state_dict: Dict, path: str, process_group=None,
                     coordinator_rank: int = 0, unique_id=None,
                     offload: bool = False) -> None:
@@ -48,11 +89,7 @@ def load_state_dict(state_dict: Dict, path: str, process_group=None,
     enforce(meta_files, f"no .metadata file under {path!r}")
     with open(meta_files[0]) as f:
         md = Metadata.from_json(json.load(f))
-
-    storages = {}
-    for fn in glob.glob(os.path.join(path, "*.distcp")):
-        with open(fn, "rb") as f:
-            storages[os.path.basename(fn)] = pickle.load(f)
+    storages = _LazyStorages(path)
 
     flat = _flatten(state_dict)
     for key, (owner, k, cur) in flat.items():
@@ -61,24 +98,26 @@ def load_state_dict(state_dict: Dict, path: str, process_group=None,
         metas = md.state_dict_metadata[key]
         gshape = tuple(md.global_shape.get(
             key, metas[0].local_shape if metas else ()))
-        full = np.zeros(gshape, dtype=metas[0].dtype if metas else
-                        "float32")
-        for m in metas:
-            sk = f"{key}@" + "_".join(str(o) for o in m.global_offset)
-            fname = md.storage_metadata[sk]
-            data = storages[fname][sk]
-            sl = tuple(slice(o, o + s) for o, s in
-                       zip(m.global_offset, m.local_shape))
-            full[sl] = data
+        dtype = metas[0].dtype if metas else "float32"
+        full_sl = tuple(slice(0, d) for d in gshape)
+
         if isinstance(cur, Tensor):
             enforce(tuple(cur._value.shape) == gshape,
                     f"checkpoint tensor {key!r} has shape {gshape}, "
                     f"target expects {tuple(cur._value.shape)}")
-            arr = jnp.asarray(full, dtype=cur._value.dtype)
             sharding = getattr(cur._value, "sharding", None)
             if sharding is not None and not getattr(
                     sharding, "is_fully_replicated", True):
-                arr = jax.device_put(arr, sharding)  # reshard to target
-            cur._value = arr
+                # sharded target: assemble ONLY the addressable windows
+                cur._value = jax.make_array_from_callback(
+                    gshape, sharding,
+                    lambda sl, key=key, metas=metas, gshape=gshape:
+                    _window(md, storages, key, metas, gshape,
+                            str(cur._value.dtype), sl))
+            else:
+                full = _window(md, storages, key, metas, gshape, dtype,
+                               full_sl)
+                cur._value = jnp.asarray(full, dtype=cur._value.dtype)
         else:
-            owner[k] = full
+            owner[k] = _window(md, storages, key, metas, gshape, dtype,
+                               full_sl)

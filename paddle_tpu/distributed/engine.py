@@ -197,8 +197,49 @@ def _globalize_batch(leaf_vals, b_specs, mesh: Mesh):
     return tuple(out)
 
 
+def materialize_lazy_params(model, mesh: Optional[Mesh] = None,
+                            spec_fn=None, seed: int = 0):
+    """Materialize LazyGuard-built parameters directly at their sharding.
+
+    Each parameter's windows are generated by the keyed shard-local
+    initializer path (nn/initializer.py _generate_window): a process
+    only ever materializes its addressable shards, so host+device bytes
+    are O(shard) — the scalable replacement for full-host init +
+    global_put (reference rank-0 broadcast:
+    fleet/utils/hybrid_parallel_util.py:213). Deterministic in
+    (seed, qualified parameter name, window offsets) — identical across
+    processes with no communication.
+    """
+    import zlib
+
+    from ..framework.lazy_init import LazySpec
+    from ..nn.initializer import _generate_window
+
+    base = jax.random.PRNGKey(seed)
+    for name, p in model.named_parameters():
+        lz = p._value
+        if not isinstance(lz, LazySpec):
+            continue
+        key = jax.random.fold_in(base, zlib.crc32(name.encode()))
+        shape, dtype, init = lz.shape, lz.dtype, lz.init
+        if mesh is None:
+            window = tuple(slice(0, s) for s in shape)
+            p._value = _generate_window(init, shape, window, dtype, key)
+            continue
+        spec = spec_fn(p) if spec_fn is not None else param_spec(p)
+        sh = NamedSharding(mesh, spec)
+
+        def cb(idx, init=init, shape=shape, dtype=dtype, key=key):
+            return np.asarray(_generate_window(init, shape, idx, dtype,
+                                               key))
+
+        p._value = jax.make_array_from_callback(shape, sh, cb)
+    return model
+
+
 def shard_module_params(model, mesh: Mesh):
     """Physically shard every parameter per its dist_attr (global arrays)."""
+    materialize_lazy_params(model, mesh)
     for p in model.parameters():
         p._value = global_put(p._value, mesh, param_spec(p))
     return model
@@ -234,6 +275,11 @@ class ParallelEngine:
         self._mesh_epoch = C.mesh_epoch()
         self._compiled: Dict[Any, Callable] = {}
         self._zero = _ZeroPlan(mesh, self.trainable, optimizer)
+        # LazyGuard-built params materialize straight into their (zero3-
+        # aware) storage sharding: O(shard) bytes per process, no full-
+        # size init anywhere
+        materialize_lazy_params(model, mesh,
+                                spec_fn=self._zero.storage_spec)
         for p in self.params:
             p._value = global_put(p._value, mesh, self._zero.storage_spec(p))
 

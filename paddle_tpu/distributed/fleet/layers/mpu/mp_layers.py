@@ -18,6 +18,7 @@ from __future__ import annotations
 
 from typing import Optional
 
+import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
@@ -206,11 +207,33 @@ def parallel_cross_entropy(logits: Tensor, label: Tensor, mp_group=None,
     lv = logits._value.astype(jnp.float32)
     if lab.ndim == lv.ndim:          # [..., 1] labels accepted like paddle
         lab = lab.reshape(lab.shape[:-1])
+
+    if _engine.is_grad_enabled() and not logits.stop_gradient:
+        # tape path: one forward, residuals reused by the tape's bwd
+        loss, res = _pce_fwd_impl(lv, lab, tuple(axes), int(ignore_index))
+        out = Tensor(loss, stop_gradient=False)
+
+        def bwd(g):
+            gl, _ = _pce_bwd_impl(tuple(axes), int(ignore_index), res, g)
+            return (gl.astype(in_dtype), None)
+
+        _engine.record_custom("parallel_cross_entropy", bwd,
+                              [logits, label], [out], loss)
+        return out
+    # no-grad path (e.g. inside a jax.vjp'd pp stage-owned epilogue):
+    # the custom_vjp on _pce_raw supplies the correct gradient there
+    loss = _pce_raw(lv, lab, tuple(axes), int(ignore_index))
+    return Tensor(loss, stop_gradient=logits.stop_gradient)
+
+
+def _pce_fwd_impl(lv, lab, axes, ignore_index):
     vloc = lv.shape[-1]
     idx = C.axis_index(axes)
     off = idx * vloc
-
-    maxl = lax.pmax(jnp.max(lv, axis=-1, keepdims=True), axes)
+    # pmax input is stop_gradient'ed: the LSE max-shift is gradient-free
+    # mathematically and pmax has no differentiation rule
+    maxl = lax.pmax(
+        lax.stop_gradient(jnp.max(lv, axis=-1, keepdims=True)), axes)
     shifted = lv - maxl
     expx = jnp.exp(shifted)
     sumexp = lax.psum(jnp.sum(expx, axis=-1, keepdims=True), axes)
@@ -221,21 +244,36 @@ def parallel_cross_entropy(logits: Tensor, label: Tensor, mp_group=None,
     valid = lab != ignore_index
     loss = jnp.where(valid, jnp.log(sumexp[..., 0]) - tgt,
                      jnp.zeros((), lv.dtype))[..., None]
+    softmax = expx / sumexp
+    onehot = (jnp.arange(vloc) == local_lab[..., None]) & in_shard[..., None]
+    return loss, (softmax, onehot, valid)
 
-    out = Tensor(loss, stop_gradient=logits.stop_gradient)
-    if _engine.is_grad_enabled() and not logits.stop_gradient:
-        out.stop_gradient = False
-        softmax = expx / sumexp
-        onehot = (jnp.arange(vloc) == local_lab[..., None]) & in_shard[..., None]
 
-        def bwd(g):
-            gl = (softmax - onehot.astype(softmax.dtype)) * g
-            gl = jnp.where(valid[..., None], gl, jnp.zeros((), gl.dtype))
-            return (gl.astype(in_dtype), None)
+def _pce_bwd_impl(axes, ignore_index, res, g):
+    softmax, onehot, valid = res
+    gl = (softmax - onehot.astype(softmax.dtype)) * g
+    gl = jnp.where(valid[..., None], gl, jnp.zeros((), gl.dtype))
+    return gl, None
 
-        _engine.record_custom("parallel_cross_entropy", bwd,
-                              [logits, label], [out], loss)
-    return out
+
+from functools import partial as _partial  # noqa: E402
+
+
+@_partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def _pce_raw(lv, lab, axes, ignore_index):
+    """Value-level parallel cross entropy with its own vjp: under
+    shard_map the transpose of psum is psum, so naive autodiff would
+    multiply the replicated cotangent by the mp degree — the custom
+    rule computes the classic (softmax - onehot) locally instead.
+    (Needed when the loss is jax.vjp'd inside a pp stage-owned
+    epilogue, pp_layers.py:_owned_apply.)"""
+    return _pce_fwd_impl(lv, lab, axes, ignore_index)[0]
+
+
+_pce_raw.defvjp(
+    lambda lv, lab, axes, ignore_index:
+    _pce_fwd_impl(lv, lab, axes, ignore_index),
+    _pce_bwd_impl)
 
 
 class ParallelCrossEntropy(Layer):

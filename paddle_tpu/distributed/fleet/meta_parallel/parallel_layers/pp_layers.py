@@ -13,13 +13,35 @@ stored as *stacked* parameters with a leading layer axis sharded over the
 is a ``lax.scan`` over pipeline ticks with ``lax.ppermute`` rotating
 activations stage→stage+1 over the ICI ring (see pipeline schedule in
 ``PipelineLayer._pipe_fn``); jax.vjp of that function IS the reverse
-pipeline, so backward scheduling needs no hand-written p2p. The prologue
-(embedding) and epilogue (final norm + head) run replicated on every pp
-rank; gradient ownership is masked so that exactly one pp rank produces
-each replicated-param grad and the engine psums them over 'pp'
-(tied word embeddings then work with no special casing — stage-0 and
-last-stage contributions sum, which is what the reference's
-SharedLayerDesc allreduce does by hand).
+pipeline, so backward scheduling needs no hand-written p2p.
+
+Memory (the 1F1B question): the reference's 1F1B
+(meta_parallel/pipeline_parallel.py:455) exists to keep at most S
+microbatches of activations alive instead of M. In a single compiled
+SPMD program the fwd/bwd tick interleaving of 1F1B is not expressible
+(jax.vjp replays backward after all of forward), so the same memory
+property is achieved differently: each pipeline TICK is wrapped in
+``jax.checkpoint`` (on by default, ``tick_checkpoint=False`` to
+disable), so the only activations that survive the forward scan are the
+O(microbatch) stage-boundary carries — per-block residuals exist for
+just ONE tick at a time during backward. Cost: one extra stage-forward
+per tick (the standard remat trade). Because memory no longer scales
+with per-block residuals x M, the bubble (S-1)/(M+S-1) can be driven
+down by raising M freely — which is also why interleaved virtual
+stages are NOT implemented: their bubble advantage presupposes 1F1B's
+hand-scheduled fwd/bwd interleaving; here ``num_virtual_pipeline_stages
+> 1`` raises instead of silently degrading (reference interleave:
+pipeline_parallel.py:938).
+
+Stage ownership: the prologue (embedding) runs under ``lax.cond`` only
+on stage 0 and the epilogue (final norm + 50K-vocab head + loss) only
+on the last stage — other ranks execute the zero branch, so the
+redundant FLOPs are actually skipped at runtime, not just masked.
+Gradient ownership falls out of ``lax.cond``'s vjp (non-owners
+contribute zero cotangents) and the engine psums replicated-param
+grads over 'pp' (tied word embeddings then work with no special
+casing — stage-0 and last-stage contributions sum, which is what the
+reference's SharedLayerDesc allreduce does by hand).
 """
 from __future__ import annotations
 
@@ -170,7 +192,8 @@ class PipelineLayer(Layer):
     def __init__(self, layers, num_stages: Optional[int] = None,
                  topology=None, loss_fn=None, seg_method: str = "uniform",
                  recompute_interval: int = 0, recompute_ctx=None,
-                 num_virtual_pipeline_stages: Optional[int] = None):
+                 num_virtual_pipeline_stages: Optional[int] = None,
+                 tick_checkpoint: bool = True):
         super().__init__()
         from ... import fleet as _fleet_pkg  # noqa: F401 (cycle guard)
 
@@ -179,7 +202,19 @@ class PipelineLayer(Layer):
             num_stages = (hcg.get_pipe_parallel_world_size()
                           if hcg is not None else 1)
         self._num_stages = int(num_stages)
-        self._vpp = int(num_virtual_pipeline_stages or 1)
+        if num_virtual_pipeline_stages and num_virtual_pipeline_stages > 1:
+            raise ValueError(
+                "num_virtual_pipeline_stages > 1 (interleaved schedule) is "
+                "not supported by the compiled SPMD pipeline: interleave's "
+                "bubble win presupposes 1F1B's hand-scheduled fwd/bwd "
+                "ticks, which a single jax.vjp'd program cannot express. "
+                "Use more microbatches instead — with the default "
+                "tick_checkpoint=True, activation memory no longer scales "
+                "with per-block residuals x microbatches, so raising "
+                "accumulate_steps shrinks the bubble at O(microbatch) "
+                "memory cost (see module docstring).")
+        self._vpp = 1
+        self._tick_checkpoint = bool(tick_checkpoint)
         self._loss_fn = loss_fn
         # the stacked blocks share ONE scanned body, so recompute is
         # all-or-nothing here: every block (interval=1) or none (0) —
@@ -363,6 +398,18 @@ class PipelineLayer(Layer):
             perm = [(i, (i + 1) % self._num_stages)
                     for i in range(self._num_stages)]
 
+            def tick(x_in, seed_t, *sv):
+                with _rng.fork_traced(seed_t):
+                    return self._apply_rows(x_in, sv, n_rows)
+
+            if self._tick_checkpoint:
+                # memory-honest schedule: only the O(microbatch) stage
+                # boundary carries survive the forward scan; the blocks'
+                # residuals exist for one tick at a time during backward
+                # (recomputed), so activation memory does NOT scale with
+                # microbatch count (see module docstring)
+                tick = jax.checkpoint(tick)
+
             def body(state, t):
                 carry, out_buf = state
                 x_mb = lax.dynamic_index_in_dim(
@@ -373,8 +420,7 @@ class PipelineLayer(Layer):
                 seed_t = (base_seed * jnp.uint32(1000003)
                           + t.astype(jnp.uint32) * jnp.uint32(2654435761)
                           + stage.astype(jnp.uint32))
-                with _rng.fork_traced(seed_t):
-                    y = self._apply_rows(x_in, stacked_vals, n_rows)
+                y = tick(x_in, seed_t, *stacked_vals)
                 idx = jnp.clip(t - (S - 1), 0, M - 1)
                 write = (stage == S - 1) & (t >= S - 1)
                 cur = lax.dynamic_index_in_dim(out_buf, idx, 0,
@@ -423,31 +469,133 @@ class PipelineLayer(Layer):
                                   [x] + list(stacked), [out], out_val)
         else:
             out = Tensor(fn(x._value, *svals), stop_gradient=True)
-
-        if pp_axes is not None:
-            out = _pp_collect(out, pp_axes, self._num_stages - 1)
         return out
 
+    # -- stage-owned prologue/epilogue -----------------------------------
+    @staticmethod
+    def _reachable_params(layers, extra=()) -> List[Parameter]:
+        """Params the given layers (incl. shared-instance references and
+        e.g. a parameterized loss Layer in ``extra``) can touch — the
+        bind/vjp set for one _owned_apply call."""
+        seen: Dict[int, Parameter] = {}
+        def add(lyr):
+            for p in lyr.parameters():
+                seen.setdefault(id(p), p)
+            ref = getattr(lyr, "_shared_ref", None)
+            if ref is not None:
+                add(ref)
+        for lyr in list(layers) + [e for e in extra if isinstance(e, Layer)]:
+            add(lyr)
+        return list(seen.values())
+
+    def _owned_apply(self, fn_eager, inputs: List[Tensor], owner: int,
+                     pp_axes, own: Optional[List[Parameter]] = None
+                     ) -> Tensor:
+        """Run ``fn_eager(*inputs)`` only on pp stage ``owner`` via
+        ``lax.cond`` — the other stages execute the zero branch, so the
+        FLOPs (e.g. the 50K-vocab head) are actually skipped at
+        runtime. ``lax.cond``'s vjp hands non-owners zero cotangents,
+        which is exactly the grad-ownership masking the engine's 'pp'
+        psum expects. ``own`` scopes the bind/vjp set to the params the
+        callee can actually reach (no zero-cotangent churn for the
+        other stage's params)."""
+        if own is None:
+            sid = {id(p) for p in self._s_params}
+            own = [p for p in self.parameters() if id(p) not in sid]
+        in_vals = [t._value for t in inputs]
+        pvals = [p._value for p in own]
+        axes = tuple(pp_axes)
+
+        def pure(iv, pv):
+            with no_grad(), _bind(own, pv):
+                out = fn_eager(*[Tensor(v, stop_gradient=True)
+                                 for v in iv])
+            return out._value
+
+        out_sd = jax.eval_shape(pure, in_vals, pvals)
+
+        def fn(iv, pv):
+            stage = C.axis_index(axes)
+            return lax.cond(
+                stage == owner,
+                lambda ops_: pure(*ops_),
+                lambda ops_: jnp.zeros(out_sd.shape, out_sd.dtype),
+                (iv, pv))
+
+        needs_grad = _engine.is_grad_enabled() and (
+            any(not t.stop_gradient for t in inputs)
+            or any(p.trainable for p in own))
+        if needs_grad:
+            out_val, vjp_fn = jax.vjp(fn, in_vals, pvals)
+            out = Tensor(out_val, stop_gradient=False)
+
+            def bwd(g):
+                div, dpv = vjp_fn(g)
+                return list(div) + list(dpv)
+
+            _engine.record_custom("pp_owned", bwd, list(inputs) + own,
+                                  [out], out_val)
+        else:
+            out = Tensor(fn(in_vals, pvals), stop_gradient=True)
+        return out
+
+    def _pp_trunk(self, ins, pp_axes) -> Tensor:
+        """Stage-0-owned prologue + pipelined middle (shared by
+        forward/compute_loss under pp). Output rows are valid on the
+        last stage only."""
+        if len(self.prologue):
+            x = self._owned_apply(
+                lambda *ts: self._run_seq(
+                    self.prologue, ts if len(ts) > 1 else ts[0]),
+                list(ins), 0, pp_axes,
+                own=self._reachable_params(self.prologue))
+        else:
+            x = ins[0]
+        return self._middle(x)
+
     def forward(self, *args):
-        x = self._run_seq(self.prologue, args if len(args) > 1 else args[0])
-        enforce(isinstance(x, Tensor),
-                "the pipelined middle takes a single Tensor")
-        x = self._middle(x)
-        return self._run_seq(self.epilogue, x)
+        pp_axes = self._pp_axes() if C.in_spmd_region() else None
+        if pp_axes is None:
+            x = self._run_seq(self.prologue,
+                              args if len(args) > 1 else args[0])
+            enforce(isinstance(x, Tensor),
+                    "the pipelined middle takes a single Tensor")
+            x = self._middle(x)
+            return self._run_seq(self.epilogue, x)
+
+        S = self._num_stages
+        x = self._pp_trunk(args, pp_axes)
+        if len(self.epilogue):
+            out = self._owned_apply(
+                lambda t: self._run_seq(self.epilogue, t), [x], S - 1,
+                pp_axes, own=self._reachable_params(self.epilogue))
+        else:
+            out = x
+        return _pp_collect(out, pp_axes, S - 1)
 
     def compute_loss(self, inputs, labels) -> Tensor:
-        """forward + loss_fn + pp grad-ownership masking."""
-        out = self.forward(*inputs) if isinstance(inputs, (tuple, list)) \
-            else self.forward(inputs)
+        """forward + loss_fn; under pp the epilogue AND the loss run
+        only on the last stage (lax.cond) and the scalar is broadcast."""
         enforce(self._loss_fn is not None,
                 "PipelineLayer needs loss_fn for train_batch")
-        loss = self._loss_fn(out, *labels) if isinstance(labels,
-                                                         (tuple, list)) \
-            else self._loss_fn(out, labels)
+        ins = inputs if isinstance(inputs, (tuple, list)) else (inputs,)
+        lbs = list(labels) if isinstance(labels, (tuple, list)) else [labels]
         pp_axes = self._pp_axes() if C.in_spmd_region() else None
-        if pp_axes is not None:
-            loss = _pp_own(loss, pp_axes, self._num_stages - 1)
-        return loss
+        if pp_axes is None:
+            out = self.forward(*ins)
+            return self._loss_fn(out, *lbs)
+
+        S = self._num_stages
+        x = self._pp_trunk(ins, pp_axes)
+
+        def tail(t, *lb):
+            return self._loss_fn(self._run_seq(self.epilogue, t), *lb)
+
+        loss = self._owned_apply(
+            tail, [x] + lbs, S - 1, pp_axes,
+            own=self._reachable_params(self.epilogue,
+                                       extra=(self._loss_fn,)))
+        return _pp_collect(loss, pp_axes, S - 1)
 
     # reference API parity helpers
     def get_num_stages(self) -> int:
@@ -487,9 +635,3 @@ def _pp_collect(x: Tensor, axes, src) -> Tensor:
         _engine.record_custom("pp_collect", bwd, [x], [out], val)
     return out
 
-
-def _pp_own(x: Tensor, axes, owner) -> Tensor:
-    """Identity on the value (it is replicated over pp); backward masks
-    the cotangent to the owner stage so replicated-parameter grads are
-    produced by exactly one pp rank (then psum'd over pp by the engine)."""
-    return _pp_collect(x, axes, owner)

@@ -1,0 +1,102 @@
+"""Lazy (deferred) parameter initialization — paddle.LazyGuard analog.
+
+(reference: python/paddle/nn/initializer/lazy_init.py ``LazyGuard`` —
+there it defers initializer *ops* into a startup program so a huge model
+can be constructed without storage. TPU-native redesign: a Parameter
+built under ``LazyGuard`` carries only a :class:`LazySpec` (shape,
+dtype, initializer); ``ParallelEngine`` / ``materialize_lazy_params``
+later materializes each parameter DIRECTLY AT ITS SHARDING — every
+process generates only its addressable shard windows via the keyed
+window generation in nn/initializer.py, so host+device footprint is
+O(shard), never O(model). This replaces the reference's
+rank-0-init-then-broadcast (fleet/utils/hybrid_parallel_util.py:213)
+with zero-communication deterministic shard init.)
+
+Usage::
+
+    with paddle.LazyGuard():
+        model = GPTForCausalLM(gpt_13b())       # no storage allocated
+    eng = ParallelEngine(model, opt, hcg.mesh)  # materializes sharded
+"""
+from __future__ import annotations
+
+import threading
+
+import jax.numpy as jnp
+
+from ..core.dtype import convert_dtype
+
+__all__ = ["LazyGuard", "LazySpec", "in_lazy_mode"]
+
+_state = threading.local()
+
+
+def in_lazy_mode() -> bool:
+    return getattr(_state, "lazy", False)
+
+
+class LazyGuard:
+    def __enter__(self):
+        self._prev = in_lazy_mode()
+        _state.lazy = True
+        return self
+
+    def __exit__(self, *exc):
+        _state.lazy = self._prev
+        return False
+
+
+class LazySpec:
+    """Stands in for a Parameter's backing array until materialization.
+
+    Exposes shape/dtype/ndim/size (so dist_attr plumbing and
+    ``Layer.to(dtype=...)`` work unchanged — ``astype`` returns a
+    re-dtyped LazySpec); any attempt to read VALUES before
+    materialization raises with a pointer to the fix.
+    """
+
+    __slots__ = ("shape", "dtype", "init")
+
+    def __init__(self, shape, dtype, init):
+        self.shape = tuple(int(s) for s in shape)
+        self.dtype = jnp.dtype(convert_dtype(dtype))
+        self.init = init
+
+    @property
+    def ndim(self) -> int:
+        return len(self.shape)
+
+    @property
+    def size(self) -> int:
+        n = 1
+        for s in self.shape:
+            n *= s
+        return n
+
+    def astype(self, dtype):
+        return LazySpec(self.shape, dtype, self.init)
+
+    def __repr__(self):
+        return (f"LazySpec(shape={self.shape}, dtype={self.dtype}, "
+                f"init={type(self.init).__name__})")
+
+    def _no_value(self, what):
+        raise RuntimeError(
+            f"cannot {what} a lazy parameter: it was created under "
+            "paddle.LazyGuard and has no storage yet. Materialize it "
+            "first (ParallelEngine(...) does this automatically, or call "
+            "paddle_tpu.distributed.engine.materialize_lazy_params).")
+
+    def __array__(self, *a, **k):
+        self._no_value("read")
+
+    def __jax_array__(self):
+        self._no_value("read")
+
+    def __getitem__(self, idx):
+        self._no_value("index")
+
+    def __add__(self, other):
+        self._no_value("compute with")
+
+    __radd__ = __mul__ = __rmul__ = __sub__ = __matmul__ = __add__

@@ -187,3 +187,94 @@ def _orthogonal_rect(shape):
     if rows < cols:
         q = q.T
     return q[:rows, :cols].reshape(shape)
+
+
+# ---------------------------------------------------------------------------
+# Shard-local (windowed) keyed generation — the LazyGuard materialization
+# path (reference capability: python/paddle/nn/initializer/lazy_init.py
+# LazyGuard; here redesigned for sharded meshes: each process materializes
+# ONLY its addressable shard windows, so host/device footprint is
+# O(shard), not O(model)).
+#
+# Determinism contract: the value of a window depends only on (key,
+# window start offsets) — every process materializing the same window of
+# the same parameter produces identical bytes, with no cross-process
+# communication. iid initializers generate directly at window shape;
+# non-iid ones (Assign, Orthogonal) materialize the keyed full array and
+# slice.
+# ---------------------------------------------------------------------------
+
+
+def _win_shape(full_shape, window):
+    return tuple(s.indices(d)[1] - s.indices(d)[0]
+                 for s, d in zip(window, full_shape))
+
+
+def _win_key(key, full_shape, window):
+    for s, d in zip(window, full_shape):
+        key = jax.random.fold_in(key, s.indices(d)[0])
+    return key
+
+
+def _generate_window(init: Initializer, full_shape, window, dtype, key):
+    """Materialize ``window`` of a ``full_shape`` parameter from ``key``."""
+    full_shape = tuple(int(s) for s in full_shape)
+    window = tuple(window)
+    dt = convert_dtype(dtype)
+    ws = _win_shape(full_shape, window)
+    wk = _win_key(key, full_shape, window)
+
+    if isinstance(init, Constant):
+        return jnp.full(ws, init.value, dtype=dt)
+    if isinstance(init, Normal):
+        return (init.mean + init.std * jax.random.normal(
+            wk, ws, jnp.float32)).astype(dt)
+    if isinstance(init, TruncatedNormal):
+        z = jax.random.truncated_normal(wk, init.a, init.b, ws, jnp.float32)
+        return (init.mean + init.std * z).astype(dt)
+    if isinstance(init, Uniform):
+        return jax.random.uniform(wk, ws, jnp.float32, init.low,
+                                  init.high).astype(dt)
+    if isinstance(init, (XavierUniform, XavierNormal)):
+        fi, fo = _fans(full_shape)      # fans from the FULL shape
+        fi = init.fan_in if init.fan_in is not None else fi
+        fo = init.fan_out if init.fan_out is not None else fo
+        if isinstance(init, XavierUniform):
+            limit = init.gain * math.sqrt(6.0 / (fi + fo))
+            return jax.random.uniform(wk, ws, jnp.float32, -limit,
+                                      limit).astype(dt)
+        std = init.gain * math.sqrt(2.0 / (fi + fo))
+        return (std * jax.random.normal(wk, ws, jnp.float32)).astype(dt)
+    if isinstance(init, (KaimingUniform, KaimingNormal)):
+        fi, _ = _fans(full_shape)
+        fi = init.fan_in if init.fan_in is not None else fi
+        gain = calculate_gain(init.nonlinearity, init.negative_slope)
+        if isinstance(init, KaimingUniform):
+            limit = gain * math.sqrt(3.0 / fi)
+            return jax.random.uniform(wk, ws, jnp.float32, -limit,
+                                      limit).astype(dt)
+        std = gain / math.sqrt(fi)
+        return (std * jax.random.normal(wk, ws, jnp.float32)).astype(dt)
+    if isinstance(init, Assign):
+        from ..tensor import Tensor as _T
+
+        v = init.value
+        v = v._value if isinstance(v, _T) else v
+        return jnp.asarray(v, dtype=dt)[window]
+    if isinstance(init, Orthogonal):
+        # non-iid: keyed full materialization, then slice
+        import numpy as _np
+
+        rows = full_shape[0]
+        cols = int(_np.prod(full_shape[1:]))
+        a = jax.random.normal(key, (max(rows, cols), min(rows, cols)),
+                              jnp.float32)
+        q, r = jnp.linalg.qr(a)
+        q = q * jnp.sign(jnp.diag(r))
+        if rows < cols:
+            q = q.T
+        full = (init.gain * q[:rows, :cols].reshape(full_shape)).astype(dt)
+        return full[window]
+    raise NotImplementedError(
+        f"{type(init).__name__} has no shard-local keyed generation; "
+        "initialize eagerly (outside LazyGuard)")

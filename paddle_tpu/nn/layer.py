@@ -133,7 +133,14 @@ class Layer:
             return None
         if init is None:
             init = I.Constant(0.0) if is_bias else I.XavierUniform()
-        value = init(tuple(shape), dtype)
+        from ..framework.lazy_init import LazySpec, in_lazy_mode
+
+        if in_lazy_mode():
+            # LazyGuard: no storage — ParallelEngine materializes each
+            # param directly at its sharding (framework/lazy_init.py)
+            value = LazySpec(tuple(shape), dtype, init)
+        else:
+            value = init(tuple(shape), dtype)
         p = Parameter(value, name=name, trainable=trainable)
         return p
 

@@ -82,7 +82,11 @@ def flash_attn_varlen(q, k, v, cu_seqlens_q, cu_seqlens_k, causal=False,
     qseg = _segments_from_cu(cu_seqlens_q, Tq)
     kseg = _segments_from_cu(cu_seqlens_k, Tk)
     q4, k4, v4 = q[None], k[None], v[None]
-    if _use_pallas(q) and not dropout and Tq == Tk:
+    # the Pallas kernel's causal mask is the global row>=col frontier,
+    # which is only correct when the q and k packs share boundaries
+    same_pack = Tq == Tk and (cu_seqlens_q is cu_seqlens_k
+                              or not causal)
+    if _use_pallas(q) and not dropout and same_pack:
         try:
             from .pallas.flash_attention import flash_attention_fwd
 
@@ -101,8 +105,24 @@ def flash_attn_varlen(q, k, v, cu_seqlens_q, cu_seqlens_k, causal=False,
                     f"({type(e).__name__}: {e}); using XLA fallback")
     mask = qseg[:, None] == kseg[None, :]
     if causal:
-        mask = mask & (
-            (Tk - Tq + jnp.arange(Tq)[:, None]) >= jnp.arange(Tk)[None, :])
+        # per-sequence causal frontier: q row r of sequence s (at
+        # in-sequence position qp) sees k columns of s up to
+        # qp + (len_k(s) - len_q(s)) — the bottom-right-aligned
+        # rectangular convention applied within EACH packed sequence
+        cq = jnp.asarray(cu_seqlens_q._value if hasattr(cu_seqlens_q,
+                                                        "_value")
+                         else cu_seqlens_q, jnp.int32)
+        ck = jnp.asarray(cu_seqlens_k._value if hasattr(cu_seqlens_k,
+                                                        "_value")
+                         else cu_seqlens_k, jnp.int32)
+        qs_c = jnp.clip(qseg, 0, cq.shape[0] - 2)
+        ks_c = jnp.clip(kseg, 0, ck.shape[0] - 2)
+        q_pos = jnp.arange(Tq, dtype=jnp.int32) - cq[qs_c]
+        k_pos = jnp.arange(Tk, dtype=jnp.int32) - ck[ks_c]
+        len_q = (cq[qs_c + 1] - cq[qs_c])
+        len_k = (ck[ks_c + 1] - ck[ks_c])
+        frontier = q_pos[:, None] + (len_k[None, :] - len_q[:, None])
+        mask = mask & (frontier >= k_pos[None, :])
     out = _sdpa_raw(q4, k4, v4, attn_mask=mask[None, None], scale=scale,
                     dropout_p=dropout, is_causal=False,
                     dropout_key=dropout_key)

@@ -29,3 +29,27 @@ def pick_block(n: int, prefer=(128, 256, 512, 64, 32, 16, 8)) -> int:
         if b <= n and n % b == 0:
             return b
     return 0
+
+
+_BLOCKS_LARGE = (512, 256, 128, 64, 32, 16, 8)
+
+
+def compiler_params(n_parallel: int, interpret: bool = False) -> dict:
+    """kwargs for pallas_call telling Mosaic which grid axes are
+    parallel — the streaming axis is 'arbitrary' (it carries a scratch
+    recurrence). Probes the CompilerParams name across JAX versions."""
+    if interpret:
+        return {}
+    try:
+        from jax.experimental.pallas import tpu as pltpu
+    except Exception:  # pragma: no cover
+        return {}
+    sem = ("parallel",) * n_parallel + ("arbitrary",)
+    for cls_name in ("CompilerParams", "TPUCompilerParams"):
+        cls = getattr(pltpu, cls_name, None)
+        if cls is not None:
+            try:
+                return {"compiler_params": cls(dimension_semantics=sem)}
+            except Exception:  # pragma: no cover - API drift
+                continue
+    return {}

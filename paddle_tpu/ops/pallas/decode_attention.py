@@ -45,12 +45,12 @@ try:
 except Exception:  # pragma: no cover
     pltpu = None
 
-from . import is_tpu_platform, pick_block as _pick_block
+from . import (_BLOCKS_LARGE as _BLOCKS, compiler_params as
+               _compiler_params, is_tpu_platform, pick_block as _pick_block)
 
 __all__ = ["decode_attention"]
 
 _NEG = -1e30
-_BLOCKS = (512, 256, 128, 64, 32, 16, 8)
 
 
 def _kernel(len_ref, q_ref, k_ref, v_ref, o_ref, m_s, l_s, acc_s, *,
@@ -94,24 +94,17 @@ def _kernel(len_ref, q_ref, k_ref, v_ref, o_ref, m_s, l_s, acc_s, *,
             Sq, G, -1).astype(o_ref.dtype)
 
 
-def _compiler_params(interpret):
-    if pltpu is None or interpret:
-        return {}
-    sem = ("parallel", "parallel", "arbitrary")
-    for cls_name in ("CompilerParams", "TPUCompilerParams"):
-        cls = getattr(pltpu, cls_name, None)
-        if cls is not None:
-            try:
-                return {"compiler_params": cls(dimension_semantics=sem)}
-            except Exception:  # pragma: no cover
-                continue
-    return {}
-
-
 def supported(q_shape, cache_shape) -> bool:
+    if pltpu is None:  # no TPU pallas backend
+        return False
     B, Sq, H, D = q_shape
     KV, M = cache_shape[1], cache_shape[2]
     if H % KV or _pick_block(M, prefer=_BLOCKS) <= 0:
+        return False
+    # D must fill whole VPU lanes: the in-kernel [Sq,G,D]->[Sq*G,D]
+    # reshape with sub-lane D (e.g. tiny-model D=16) sends Mosaic into
+    # a pathological relayout (observed: compile hang on v5e)
+    if D % 128 != 0:
         return False
     return Sq * (H // KV) <= 2048  # q block must sit in VMEM
 
@@ -159,6 +152,6 @@ def decode_attention(q, k_cache, v_cache, offset, scale=None,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((B, Sq, KV, G, D), q.dtype),
         interpret=interpret,
-        **_compiler_params(interpret),
+        **_compiler_params(2, interpret),
     )(lengths, q5, k_cache, v_cache)
     return out.reshape(B, Sq, H, D)

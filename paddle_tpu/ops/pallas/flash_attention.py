@@ -52,28 +52,12 @@ except Exception:  # pragma: no cover
     pltpu = None
     _VMEM = None
 
-from . import is_tpu_platform, pick_block as _pick_block
+from . import (_BLOCKS_LARGE as _BLOCKS, compiler_params as
+               _compiler_params, is_tpu_platform, pick_block as _pick_block)
 
 __all__ = ["flash_attention_fwd"]
 
 _NEG = -1e30
-_BLOCKS = (512, 256, 128, 64, 32, 16, 8)
-
-
-def _compiler_params(n_parallel, interpret=False):
-    """Tell Mosaic which grid axes are parallel (the kv/q streaming axis
-    is 'arbitrary': it carries the scratch recurrence)."""
-    if pltpu is None or interpret:
-        return {}
-    sem = ("parallel",) * n_parallel + ("arbitrary",)
-    for cls_name in ("CompilerParams", "TPUCompilerParams"):
-        cls = getattr(pltpu, cls_name, None)
-        if cls is not None:
-            try:
-                return {"compiler_params": cls(dimension_semantics=sem)}
-            except Exception:  # pragma: no cover - API drift
-                continue
-    return {}
 
 
 def _mask(qi, j, block_q, block_kv, q_off, causal, qseg, kseg):
@@ -87,7 +71,7 @@ def _mask(qi, j, block_q, block_kv, q_off, causal, qseg, kseg):
             jnp.int32, (block_q, block_kv), 1)
         keep = rows >= cols
     if qseg is not None:
-        same = qseg[0][:, None] == kseg[0][None, :]
+        same = qseg[0, 0][:, None] == kseg[0, 0][None, :]
         keep = same if keep is None else (keep & same)
     return keep
 
@@ -162,11 +146,13 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *refs, scale, causal, block_q,
 
 
 def _seg_specs(H, block_q, block_kv, kv_index, kw):
-    """BlockSpecs for [B, S]-shaped segment-id arrays; the BH grid axis
-    maps to batch via // H."""
-    qs = pl.BlockSpec((1, block_q), lambda b, i, j: (b // H, i), **kw)
-    ks = pl.BlockSpec((1, block_kv),
-                      lambda b, i, j: (b // H, kv_index(b, i, j)), **kw)
+    """BlockSpecs for segment-id arrays reshaped to [B, 1, S] (3-D so
+    the Mosaic last-two-dims tiling rule is satisfiable for B > 1);
+    the BH grid axis maps to batch via // H."""
+    qs = pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b // H, 0, i),
+                      **kw)
+    ks = pl.BlockSpec((1, 1, block_kv),
+                      lambda b, i, j: (b // H, 0, kv_index(b, i, j)), **kw)
     return qs, ks
 
 
@@ -214,7 +200,7 @@ def _pallas_fa(q3, k3, v3, qseg, kseg, H, causal, scale, block_q, block_kv,
             pltpu.VMEM((block_q, 128), jnp.float32),
             pltpu.VMEM((block_q, 128), jnp.float32),
             pltpu.VMEM((block_q, D), jnp.float32),
-        ] if pltpu is not None else [],
+        ],
         interpret=interpret,
         **_compiler_params(2, interpret),
     )(*args)
@@ -324,9 +310,8 @@ def _pallas_fa_bwd(q3, k3, v3, do3, lse, delta, qseg, kseg, H, causal,
     Skv = k3.shape[1]
     q_off = Skv - Sq
     nq, nkv = Sq // block_q, Skv // block_kv
-    kw = {} if _VMEM is None else {"memory_space": _VMEM}
-    scratch = ([] if pltpu is None else
-               [pltpu.VMEM((block_q, D), jnp.float32)])
+    kw = {"memory_space": _VMEM}
+    scratch = [pltpu.VMEM((block_q, D), jnp.float32)]
 
     def kv_index(b, i, j):
         return jnp.minimum(
@@ -382,17 +367,18 @@ def _pallas_fa_bwd(q3, k3, v3, do3, lse, delta, qseg, kseg, H, causal,
     ]
     dkv_args = [q3, k3, v3, do3, lse, delta]
     if qseg is not None:
-        qs = pl.BlockSpec((1, block_q),
-                          lambda b, j, i: (b // H, q_index(b, j, i)), **kw)
-        ks = pl.BlockSpec((1, block_kv), lambda b, j, i: (b // H, j), **kw)
+        qs = pl.BlockSpec(
+            (1, 1, block_q),
+            lambda b, j, i: (b // H, 0, q_index(b, j, i)), **kw)
+        ks = pl.BlockSpec((1, 1, block_kv),
+                          lambda b, j, i: (b // H, 0, j), **kw)
         dkv_specs += [qs, ks]
         dkv_args += [qseg, kseg]
     dkv_kernel = partial(_dkv_kernel, scale=scale, causal=causal,
                          block_q=block_q, block_kv=block_kv, q_off=q_off,
                          nq=nq, has_seg=qseg is not None)
-    dkv_scratch = ([] if pltpu is None else
-                   [pltpu.VMEM((block_kv, D), jnp.float32),
-                    pltpu.VMEM((block_kv, D), jnp.float32)])
+    dkv_scratch = [pltpu.VMEM((block_kv, D), jnp.float32),
+                   pltpu.VMEM((block_kv, D), jnp.float32)]
     dk, dv = pl.pallas_call(
         dkv_kernel,
         grid=(BH, nkv, nq),
@@ -413,9 +399,16 @@ def _pallas_fa_bwd(q3, k3, v3, do3, lse, delta, qseg, kseg, H, causal,
 
 
 def _supported(q, k) -> bool:
+    if pltpu is None:  # no TPU pallas backend: scratch/VMEM unavailable
+        return False
     B, Sq, H, D = q.shape
     Skv = k.shape[1]
     if _pick_block(Sq) <= 0 or _pick_block(Skv) <= 0:
+        return False
+    # D must fill whole 128-wide VPU lanes ON REAL TPU: sub-lane head
+    # dims were observed to hang the Mosaic compiler on v5e (same gate
+    # as rms_norm/decode_attention); interpret mode has no such limit
+    if not _interpret_default() and D % 128 != 0:
         return False
     # rectangular causal convention needs q to be a suffix of the kv span
     return Skv >= Sq
@@ -455,8 +448,9 @@ def _prep(q, k, causal, scale, interpret, qseg, kseg):
     if (qseg is None) != (kseg is None):
         raise ValueError("flash: q/kv segment ids must be given together")
     if qseg is not None:
-        qseg = jnp.asarray(qseg, jnp.int32)
-        kseg = jnp.asarray(kseg, jnp.int32)
+        # [B, S] -> [B, 1, S] (see _seg_specs)
+        qseg = jnp.asarray(qseg, jnp.int32)[:, None, :]
+        kseg = jnp.asarray(kseg, jnp.int32)[:, None, :]
     # 512-blocks measured fastest on v5e at S=8192 (44.9 TF/s vs 9.7 at
     # 128); smaller sizes only when the sequence doesn't divide
     block_q = _pick_block(Sq, prefer=_BLOCKS)
@@ -469,11 +463,12 @@ def _fa_fwd(q, k, v, causal, scale, interpret, qseg=None, kseg=None):
         raise ValueError("flash pallas kernel: unsupported shape "
                          f"{q.shape}/{k.shape}")
     B, Sq, H, D = q.shape
-    scale, interpret, qseg, kseg, block_q, block_kv = _prep(
+    scale, interpret, qseg3, kseg3, block_q, block_kv = _prep(
         q, k, causal, scale, interpret, qseg, kseg)
-    o3, lse = _pallas_fa(_to3(q), _to3(k), _to3(v), qseg, kseg, H, causal,
-                         scale, block_q, block_kv, interpret)
+    o3, lse = _pallas_fa(_to3(q), _to3(k), _to3(v), qseg3, kseg3, H,
+                         causal, scale, block_q, block_kv, interpret)
     out = _from3(o3, B, H)
+    # residuals keep the RAW [B, S] ids — _fa_bwd re-runs _prep
     return out, (q, k, v, out, lse, qseg, kseg)
 
 

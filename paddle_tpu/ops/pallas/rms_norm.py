@@ -59,6 +59,15 @@ def rms_norm_fused(x, weight, eps=1e-6, interpret=None):
     return out
 
 
+def _mosaic_tileable(T, bt, H) -> bool:
+    """Real-TPU shape gate: the second-minor block dim must divide by 8
+    (or equal the array dim) per the Mosaic tiling rule, and H must
+    fill whole 128-wide VPU lanes — sub-lane H (tiny-model hidden 64)
+    was observed to HANG the Mosaic compiler on v5e, so those shapes
+    take the XLA path."""
+    return (bt % 8 == 0 or bt == T) and H % 128 == 0
+
+
 def _fwd(x, weight, eps, interpret):
     if interpret is None:
         interpret = _interpret_default()
@@ -66,6 +75,8 @@ def _fwd(x, weight, eps, interpret):
     x2 = x.reshape(-1, H)
     T = x2.shape[0]
     bt = _pick_block(T)
+    if not interpret and not _mosaic_tileable(T, bt, H):
+        return _rms_ref(x2, weight, eps).reshape(x.shape), (x, weight)
     kw = {} if _VMEM is None else {"memory_space": _VMEM}
     out = pl.pallas_call(
         partial(_kernel, eps=eps),

@@ -112,3 +112,43 @@ def test_dist_checkpoint_sharded_reshard(tmp_path):
                                       np.asarray(b._value), err_msg=n)
     # and the loaded weight kept its sharded placement
     assert not model2.fc1.weight._value.sharding.is_fully_replicated
+
+
+def test_load_assembles_only_addressable_windows(monkeypatch, tmp_path):
+    """Shard-local load (VERDICT item 6): a sharded target tensor is
+    filled via shard-sized windows, never a full-size host buffer."""
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed import fleet
+    from paddle_tpu.distributed.checkpoint import (load_state_dict,
+                                                   save_state_dict)
+    import importlib
+
+    L = importlib.import_module(
+        "paddle_tpu.distributed.checkpoint.load_state_dict")
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    import jax
+
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 1, "mp_degree": 8}
+    hcg = fleet.init(is_collective=True, strategy=strategy)
+
+    w = paddle.to_tensor(np.arange(64 * 32, dtype="float32").reshape(64, 32))
+    save_state_dict({"w": w}, str(tmp_path))
+
+    sh = NamedSharding(hcg.mesh, P("mp", None))
+    tgt = paddle.to_tensor(np.zeros((64, 32), "float32"))
+    tgt._value = jax.device_put(tgt._value, sh)
+
+    sizes = []
+    orig = L._window
+
+    def spy(md, storages, key, metas, gshape, dtype, sl):
+        out = orig(md, storages, key, metas, gshape, dtype, sl)
+        sizes.append(out.size)
+        return out
+
+    monkeypatch.setattr(L, "_window", spy)
+    load_state_dict({"w": tgt}, str(tmp_path))
+    np.testing.assert_array_equal(np.asarray(tgt._value),
+                                  np.asarray(w._value))
+    assert sizes and max(sizes) <= 64 * 32 // 8, sizes

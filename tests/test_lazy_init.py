@@ -1,0 +1,123 @@
+"""LazyGuard + shard-local materialization (reference:
+python/paddle/nn/initializer/lazy_init.py LazyGuard — here each process
+materializes only its addressable shard windows, O(shard) bytes)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed import fleet
+from paddle_tpu.distributed.engine import (ParallelEngine,
+                                           materialize_lazy_params)
+from paddle_tpu.framework.lazy_init import LazySpec
+from paddle_tpu.models import (GPTConfig, GPTForCausalLM,
+                               GPTPretrainingCriterion)
+
+
+def _cfg():
+    return GPTConfig(vocab_size=256, hidden_size=64, num_layers=2,
+                     num_heads=4, max_position_embeddings=64)
+
+
+def test_lazy_build_has_no_storage():
+    with paddle.LazyGuard():
+        model = GPTForCausalLM(_cfg())
+    assert all(isinstance(p._value, LazySpec) for p in model.parameters())
+    # reading values before materialization must fail loudly
+    with pytest.raises(RuntimeError, match="LazyGuard"):
+        np.asarray(model.parameters()[0]._value)
+    # shapes/dtypes visible without storage
+    p0 = model.parameters()[0]
+    assert p0._value.ndim == len(p0._value.shape)
+
+
+def test_lazy_engine_trains():
+    """LazyGuard model -> ParallelEngine materializes sharded -> loss
+    decreases (the 13B-construction path at tiny scale)."""
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 2, "mp_degree": 4}
+    hcg = fleet.init(is_collective=True, strategy=strategy)
+    cfg = _cfg()
+    with paddle.LazyGuard():
+        model = GPTForCausalLM(cfg)
+    crit = GPTPretrainingCriterion(cfg)
+    opt = paddle.optimizer.AdamW(learning_rate=3e-3,
+                                 parameters=model.parameters())
+    eng = ParallelEngine(model, opt, hcg.mesh)
+    assert not any(isinstance(p._value, LazySpec)
+                   for p in model.parameters())
+    step = eng.train_step(lambda m, b: crit(m(b["x"]), b["y"]))
+    ids = np.random.RandomState(0).randint(0, cfg.vocab_size, (8, 16))
+    batch = {"x": paddle.to_tensor(ids), "y": paddle.to_tensor(ids)}
+    first = float(step(batch))
+    for _ in range(9):
+        last = float(step(batch))
+    assert np.isfinite(first) and first - last > 0.5, (first, last)
+
+
+def test_materialize_windows_are_shard_sized(monkeypatch):
+    """The scalability property VERDICT item 6 asks for: per-process
+    host bytes for a sharded param ~ shard size, not global size."""
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 1, "mp_degree": 4}
+    hcg = fleet.init(is_collective=True, strategy=strategy)
+    with paddle.LazyGuard():
+        model = GPTForCausalLM(_cfg())
+
+    from paddle_tpu.nn import initializer as I
+    seen = []
+    orig = I._generate_window
+
+    def spy(init, full_shape, window, dtype, key):
+        out = orig(init, full_shape, window, dtype, key)
+        seen.append((tuple(full_shape), tuple(out.shape)))
+        return out
+
+    monkeypatch.setattr(I, "_generate_window", spy)
+    import paddle_tpu.distributed.engine as E
+
+    monkeypatch.setattr(E, "_generate_window", spy, raising=False)
+    materialize_lazy_params(model, hcg.mesh)
+    # mp-sharded params (e.g. qkv ColumnParallel [64, 192]) must be
+    # generated in windows of 1/4 the global size, never full size
+    sharded = [(f, w) for f, w in seen if f != w]
+    assert sharded, "expected at least one sharded-window generation"
+    for full, win in sharded:
+        full_n = int(np.prod(full))
+        win_n = int(np.prod(win))
+        assert win_n <= full_n // 4, (full, win)
+
+
+def test_materialize_deterministic():
+    with paddle.LazyGuard():
+        m1 = GPTForCausalLM(_cfg())
+    with paddle.LazyGuard():
+        m2 = GPTForCausalLM(_cfg())
+    materialize_lazy_params(m1, None, seed=7)
+    materialize_lazy_params(m2, None, seed=7)
+    for (n1, p1), (n2, p2) in zip(m1.named_parameters(),
+                                  m2.named_parameters()):
+        np.testing.assert_array_equal(np.asarray(p1._value),
+                                      np.asarray(p2._value), err_msg=n1)
+    with paddle.LazyGuard():
+        m3 = GPTForCausalLM(_cfg())
+    materialize_lazy_params(m3, None, seed=8)
+    diff = any(
+        not np.array_equal(np.asarray(a._value), np.asarray(b._value))
+        for (_, a), (_, b) in zip(m1.named_parameters(),
+                                  m3.named_parameters())
+        if a.trainable and np.asarray(a._value).std() > 0)
+    assert diff, "different seeds must give different params"
+
+
+def test_lazy_astype_flows_to_materialization():
+    """Layer.astype on a lazy model re-dtypes the LazySpecs (the llama
+    bf16-at-construction path)."""
+    with paddle.LazyGuard():
+        model = GPTForCausalLM(_cfg())
+        model.astype("bfloat16")
+    assert all(p._value.dtype == np.dtype("bfloat16") or
+               str(p._value.dtype) == "bfloat16"
+               for p in model.parameters())
+    materialize_lazy_params(model, None)
+    assert all(str(p._value.dtype) == "bfloat16"
+               for p in model.parameters())

@@ -269,3 +269,34 @@ def test_flash_attn_unpadded_varlen():
                         dropout_p=0.0, is_causal=True)[0]
         np.testing.assert_allclose(out[a:b], np.asarray(ref),
                                    rtol=2e-5, atol=2e-5)
+
+
+def test_flash_attn_unpadded_causal_unequal_packs():
+    """causal varlen with cu_seqlens_q != cu_seqlens_k: each sequence
+    gets its OWN bottom-right-aligned frontier (review finding: a global
+    Tk-Tq shift misaligned every sequence but the last)."""
+    import paddle_tpu as paddle
+    import paddle_tpu.nn.functional as F
+
+    rng = np.random.RandomState(7)
+    lens_q, lens_k = [2, 3], [4, 5]
+    Tq, Tk, H, D = sum(lens_q), sum(lens_k), 2, 16
+    q = rng.randn(Tq, H, D).astype("float32")
+    k = rng.randn(Tk, H, D).astype("float32")
+    v = rng.randn(Tk, H, D).astype("float32")
+    cq = np.cumsum([0] + lens_q).astype("int32")
+    ck = np.cumsum([0] + lens_k).astype("int32")
+    out, _ = F.flash_attn_unpadded(
+        paddle.to_tensor(q), paddle.to_tensor(k), paddle.to_tensor(v),
+        paddle.to_tensor(cq), paddle.to_tensor(ck), causal=True)
+    out = np.asarray(out._value)
+    for i in range(len(lens_q)):
+        qa, qb = cq[i], cq[i + 1]
+        ka, kb = ck[i], ck[i + 1]
+        ref = _sdpa.raw(jnp.asarray(q[None, qa:qb]),
+                        jnp.asarray(k[None, ka:kb]),
+                        jnp.asarray(v[None, ka:kb]),
+                        attn_mask=None, dropout_p=0.0, is_causal=True)[0]
+        np.testing.assert_allclose(out[qa:qb], np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5,
+                                   err_msg=f"sequence {i}")

@@ -152,3 +152,70 @@ def test_pp_eval_batch_matches_eager_loss():
     ev = float(dist_model.eval_batch(
         [paddle.to_tensor(ids), paddle.to_tensor(labels)]))
     np.testing.assert_allclose(ev, golden, rtol=2e-4)
+
+
+def _compiled_temp_bytes(model, M, ids, labels, mesh):
+    """XLA temp buffer size of the full loss+backward program at M
+    microbatches (the engine's step structure: tape inside shard_map)."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from paddle_tpu.distributed import collective as C
+    from paddle_tpu.distributed.engine import _shard_map, bind_params
+    from paddle_tpu.tensor import Tensor
+
+    model._num_microbatches = M
+    params = [p for p in model.parameters() if p.trainable]
+    pvals = tuple(p._value for p in params)
+    pspecs = tuple(
+        p.dist_attr if getattr(p, "dist_attr", None) is not None else P()
+        for p in params)
+
+    def fn(pvals, ids_v, labels_v):
+        with C.spmd_region(mesh), bind_params(params, pvals):
+            loss = model.compute_loss(
+                Tensor(ids_v, stop_gradient=True),
+                Tensor(labels_v, stop_gradient=True))
+            loss.backward()
+            grads = tuple(
+                p.grad._value if p.grad is not None
+                else jax.numpy.zeros_like(p._value) for p in params)
+            for p in params:
+                p.grad = None
+                p._grad_node = None
+        return loss._value, grads
+
+    sm = _shard_map(fn, mesh, (pspecs, P(), P()), (P(), pspecs))
+    c = jax.jit(sm).lower(pvals, ids, labels).compile()
+    return c.memory_analysis().temp_size_in_bytes
+
+
+def test_pp_activation_memory_flat_in_microbatches():
+    """With tick_checkpoint (default), activation memory must NOT scale
+    with microbatch count: only O(microbatch) boundary carries survive
+    the forward scan (VERDICT: the 1F1B memory property). M=8 vs M=2
+    within 1.35x; without tick_checkpoint the ratio must be visibly
+    worse, demonstrating what the checkpoint buys."""
+    hcg, _ = _init_fleet(dp=1, pp=2)
+    cfg = GPTConfig(vocab_size=256, hidden_size=64, num_layers=4,
+                    num_heads=4, max_position_embeddings=128)
+    ids = np.random.RandomState(0).randint(0, 256, (8, 16)).astype("int32")
+    labels = np.random.RandomState(1).randint(0, 256, (8, 16)).astype(
+        "int32")
+
+    paddle.seed(3)
+    model = GPTForCausalLMPipe(cfg)
+    m2 = _compiled_temp_bytes(model, 2, ids, labels, hcg.mesh)
+    m8 = _compiled_temp_bytes(model, 8, ids, labels, hcg.mesh)
+    assert m8 <= 1.35 * m2, (m2, m8)
+
+    paddle.seed(3)
+    model_nc = GPTForCausalLMPipe(cfg)
+    # reach into the private flag: the GPT pipe factory does not expose
+    # the PipelineLayer tick_checkpoint kwarg, and this test needs the
+    # OFF behavior only to demonstrate the contrast
+    model_nc._tick_checkpoint = False
+    n2 = _compiled_temp_bytes(model_nc, 2, ids, labels, hcg.mesh)
+    n8 = _compiled_temp_bytes(model_nc, 8, ids, labels, hcg.mesh)
+    assert n8 / n2 > m8 / max(m2, 1), \
+        f"checkpoint off should scale worse: {n2}->{n8} vs {m2}->{m8}"
