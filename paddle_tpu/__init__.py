@@ -46,6 +46,9 @@ from . import static  # noqa: F401
 from . import distribution  # noqa: F401
 from . import quantization  # noqa: F401
 from . import inference  # noqa: F401
+from . import utils  # noqa: F401
+from . import linalg  # noqa: F401
+from . import fft  # noqa: F401
 from .hapi import Model  # noqa: F401
 
 # paddle-API aliases

@@ -79,6 +79,13 @@ def apply(opdef: OpDef, args, kwargs):
     out_tensors = [Tensor(o, stop_gradient=not requires_grad) for o in out_list]
     if requires_grad:
         engine.record_op(call, in_tensors, out_tensors, outs)
+    # eager SPMD metadata propagation (reference: per-op InferSpmd) —
+    # only runs when some input carries a dist_attr annotation
+    if any(t is not None and getattr(t, "dist_attr", None) is not None
+           for t in in_tensors):
+        from ..distributed.auto_parallel import spmd_rules
+
+        spmd_rules.infer(opdef.name, in_tensors, out_tensors, args, kwargs)
     return tuple(out_tensors) if multi else out_tensors[0]
 
 

@@ -1,6 +1,8 @@
 from .api import (Partial, Placement, ProcessMesh, Replicate, Shard,
                   dtensor_from_fn, reshard, shard_layer,
                   shard_tensor)  # noqa: F401
+from .engine import Engine  # noqa: F401
 
 __all__ = ["ProcessMesh", "Placement", "Shard", "Replicate", "Partial",
-           "shard_tensor", "dtensor_from_fn", "reshard", "shard_layer"]
+           "shard_tensor", "dtensor_from_fn", "reshard", "shard_layer",
+           "Engine"]

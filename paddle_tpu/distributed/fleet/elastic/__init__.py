@@ -1,3 +1,5 @@
 from .manager import ElasticManager, ElasticStatus  # noqa: F401
+from .resume import load_train_state, save_train_state  # noqa: F401
 
-__all__ = ["ElasticManager", "ElasticStatus"]
+__all__ = ["ElasticManager", "ElasticStatus", "save_train_state",
+           "load_train_state"]

@@ -101,6 +101,21 @@ class ElasticManager:
                 if self.on_world_change:
                     self.on_world_change(list(world))
 
+    @property
+    def restart_needed(self) -> bool:
+        return self.status == ElasticStatus.RESTART
+
+    def wait_restart(self, timeout: float = 60.0) -> bool:
+        """Block until the watcher flags a world change (survivor-side
+        recovery gate: stop stepping, checkpoint is already on disk,
+        exit for the launcher to relaunch — see resume.py)."""
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            if self.restart_needed:
+                return True
+            time.sleep(self.heartbeat_interval / 2)
+        return False
+
     def wait_world(self, n: int, timeout: float = 60.0) -> bool:
         """Block until ``n`` live ranks are registered (job start gate —
         the reference's pod-ready barrier)."""

@@ -1,0 +1,93 @@
+"""Elastic recovery: checkpoint/resume train state across world changes.
+
+(reference: python/paddle/distributed/fleet/elastic/manager.py:237-264 —
+on scale in/out the manager signals the launcher, which restarts the
+job with the new world; training resumes from the last checkpoint.)
+
+TPU-native flow: a live jax runtime cannot resize, so recovery is
+restart-shaped by design —
+
+1. every rank periodically calls :func:`save_train_state` (the sharded
+   distributed checkpoint: each process writes only its addressable
+   shards, see checkpoint/save_state_dict.py);
+2. the :class:`ElasticManager` heartbeat watcher detects the world
+   change; survivors stop stepping (``wait_restart``) and exit with a
+   restart code for the launcher;
+3. the relaunched job — ANY new world size/mesh — calls
+   :func:`load_train_state`: reshard-on-load reassembles each tensor's
+   addressable windows from the old layout's shards, the optimizer
+   moments included, and training continues from the recorded step.
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, Optional
+
+import jax
+
+from ...checkpoint import load_state_dict, save_state_dict
+
+__all__ = ["save_train_state", "load_train_state"]
+
+_META = "train_meta.json"
+
+
+def save_train_state(path: str, model, optimizer=None, step: int = 0,
+                     extra: Optional[Dict[str, Any]] = None) -> None:
+    """Sharded save of model (+ optimizer moments) + scalar metadata."""
+    state = {"model": model.state_dict()}
+    meta: Dict[str, Any] = {"step": int(step)}
+    if optimizer is not None:
+        osd = optimizer.state_dict()
+        meta["opt_step_count"] = int(osd.pop("step_count", 0))
+        lrs = osd.pop("LR_Scheduler", None)
+        if lrs is not None:
+            meta["lr_scheduler"] = lrs
+        state["optim"] = osd
+    if extra:
+        meta.update(extra)
+    save_state_dict(state, path)
+    if jax.process_index() == 0:
+        with open(os.path.join(path, _META), "w") as f:
+            json.dump(meta, f)
+
+
+def load_train_state(path: str, model, optimizer=None) -> Dict[str, Any]:
+    """Fill model/optimizer from the checkpoint, resharding to the NEW
+    world's layout; returns the metadata (incl. ``step``)."""
+    with open(os.path.join(path, _META)) as f:
+        meta = json.load(f)
+    # phase 1: model params FIRST — any optimizer state materialized
+    # below (fresh multi-precision masters) must copy the LOADED
+    # weights, never the pre-load random init
+    model_t = {"model": model.state_dict()}
+    load_state_dict(model_t, path)
+    model.set_state_dict(model_t["model"])
+    if optimizer is None:
+        return meta
+
+    osd = optimizer.state_dict()
+    osd.pop("step_count", None)
+    osd.pop("LR_Scheduler", None)
+    if not osd:
+        # moments not materialized yet (fresh optimizer): allocate them
+        # so the load has shaped targets to fill
+        shapes = optimizer._state_shapes()
+        if shapes:
+            for p in optimizer._parameter_list:
+                optimizer._param_state(p, shapes)
+            osd = optimizer.state_dict()
+            osd.pop("step_count", None)
+            osd.pop("LR_Scheduler", None)
+    if osd:
+        targets = {"optim": osd}
+        load_state_dict(targets, path)
+        filled = dict(targets["optim"])
+    else:
+        filled = {}
+    filled["step_count"] = meta.get("opt_step_count", meta["step"])
+    if "lr_scheduler" in meta:
+        filled["LR_Scheduler"] = meta["lr_scheduler"]
+    optimizer.set_state_dict(filled)
+    return meta

@@ -43,6 +43,12 @@ def _parse(argv=None):
     p.add_argument("--devices", default=None,
                    help="visible device ids, comma separated")
     p.add_argument("--log_dir", default=None, help="per-rank log dir")
+    p.add_argument("--elastic_level", type=int, default=0,
+                   help="0: fail fast; 1: relaunch the pod on failure "
+                        "(trainers must resume from their checkpoint, "
+                        "see fleet.elastic.load_train_state)")
+    p.add_argument("--max_restarts", type=int, default=3,
+                   help="relaunch budget under --elastic_level 1")
     p.add_argument("training_script", help="script to run")
     p.add_argument("training_script_args", nargs=argparse.REMAINDER)
     return p.parse_args(argv)
@@ -105,25 +111,44 @@ def launch(argv=None) -> int:
         return subprocess.call(cmd, env=env)
 
     # simulation path: K ranked local processes (reference build_pod)
-    procs = []
-    world = args.nproc_per_node * world_hosts
-    master = args.master or "127.0.0.1:35127"
-    for local in range(args.nproc_per_node):
-        rank = host_rank * args.nproc_per_node + local
-        env = _base_env(args, rank, world)
-        env["PADDLE_MASTER"] = master
-        env["PADDLE_LOCAL_RANK"] = str(local)
-        stdout = None
-        if args.log_dir:
-            os.makedirs(args.log_dir, exist_ok=True)
-            stdout = open(os.path.join(args.log_dir,
-                                       f"workerlog.{rank}"), "w")
-        procs.append(subprocess.Popen(
-            [sys.executable, args.training_script,
-             *args.training_script_args],
-            env=env, stdout=stdout,
-            stderr=subprocess.STDOUT if stdout else None))
-    rc = _watch(procs)
+    def build_pod(attempt: int):
+        procs = []
+        world = args.nproc_per_node * world_hosts
+        master = args.master or "127.0.0.1:35127"
+        for local in range(args.nproc_per_node):
+            rank = host_rank * args.nproc_per_node + local
+            env = _base_env(args, rank, world)
+            env["PADDLE_MASTER"] = master
+            env["PADDLE_LOCAL_RANK"] = str(local)
+            env["PADDLE_RESTART_COUNT"] = str(attempt)
+            if attempt > 0:
+                env["PADDLE_ELASTIC_RESTART"] = "1"
+            stdout = None
+            if args.log_dir:
+                os.makedirs(args.log_dir, exist_ok=True)
+                suffix = f".{attempt}" if attempt else ""
+                stdout = open(os.path.join(
+                    args.log_dir, f"workerlog.{rank}{suffix}"), "w")
+            procs.append(subprocess.Popen(
+                [sys.executable, args.training_script,
+                 *args.training_script_args],
+                env=env, stdout=stdout,
+                stderr=subprocess.STDOUT if stdout else None))
+        return procs
+
+    # elastic relaunch loop (reference elastic/manager.py:237-264: the
+    # launcher restarts the pod on world change; trainers resume from
+    # their sharded checkpoint — fleet.elastic.load_train_state, tested
+    # end-to-end in tests/test_elastic_resume.py)
+    attempts = args.max_restarts if args.elastic_level >= 1 else 0
+    attempt = 0
+    while True:
+        rc = _watch(build_pod(attempt))
+        if rc == 0 or attempt >= attempts:
+            break
+        attempt += 1
+        print(f"launch: pod failed (rc={rc}); elastic relaunch "
+              f"{attempt}/{attempts}", file=sys.stderr)
     if rc != 0:
         print(f"launch: pod failed with exit code {rc}", file=sys.stderr)
     return rc

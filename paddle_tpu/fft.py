@@ -1,0 +1,83 @@
+"""Discrete Fourier transforms (paddle.fft analog).
+
+(reference: python/paddle/fft.py over phi fft kernels
+paddle/phi/kernels/fft_kernel.h — cuFFT/onemkl dynload; here every
+transform lowers to XLA's native FFT HLO, differentiable end to end.)
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .core.dispatch import def_op
+
+__all__ = [
+    "fft", "ifft", "rfft", "irfft", "hfft", "ihfft",
+    "fft2", "ifft2", "rfft2", "irfft2",
+    "fftn", "ifftn", "rfftn", "irfftn",
+    "fftshift", "ifftshift", "fftfreq", "rfftfreq",
+]
+
+
+def _mk1(name, fn):
+    @def_op(name)
+    def op(x, n=None, axis=-1, norm="backward"):
+        return fn(x, n=n, axis=int(axis), norm=str(norm))
+    op.__name__ = name
+    op.__qualname__ = name
+    return op
+
+
+def _mk2(name, fn):
+    @def_op(name)
+    def op(x, s=None, axes=(-2, -1), norm="backward"):
+        return fn(x, s=s, axes=tuple(axes), norm=str(norm))
+    op.__name__ = name
+    op.__qualname__ = name
+    return op
+
+
+def _mkn(name, fn):
+    @def_op(name)
+    def op(x, s=None, axes=None, norm="backward"):
+        return fn(x, s=s, axes=axes, norm=str(norm))
+    op.__name__ = name
+    op.__qualname__ = name
+    return op
+
+
+fft = _mk1("fft", jnp.fft.fft)
+ifft = _mk1("ifft", jnp.fft.ifft)
+rfft = _mk1("rfft", jnp.fft.rfft)
+irfft = _mk1("irfft", jnp.fft.irfft)
+hfft = _mk1("hfft", jnp.fft.hfft)
+ihfft = _mk1("ihfft", jnp.fft.ihfft)
+fft2 = _mk2("fft2", jnp.fft.fft2)
+ifft2 = _mk2("ifft2", jnp.fft.ifft2)
+rfft2 = _mk2("rfft2", jnp.fft.rfft2)
+irfft2 = _mk2("irfft2", jnp.fft.irfft2)
+fftn = _mkn("fftn", jnp.fft.fftn)
+ifftn = _mkn("ifftn", jnp.fft.ifftn)
+rfftn = _mkn("rfftn", jnp.fft.rfftn)
+irfftn = _mkn("irfftn", jnp.fft.irfftn)
+
+
+@def_op("fftshift")
+def fftshift(x, axes=None):
+    return jnp.fft.fftshift(x, axes=axes)
+
+
+@def_op("ifftshift")
+def ifftshift(x, axes=None):
+    return jnp.fft.ifftshift(x, axes=axes)
+
+
+@def_op("fftfreq", differentiable=False)
+def fftfreq(n, d=1.0, dtype=None):
+    out = jnp.fft.fftfreq(int(n), d=float(d))
+    return out.astype(dtype) if dtype is not None else out
+
+
+@def_op("rfftfreq", differentiable=False)
+def rfftfreq(n, d=1.0, dtype=None):
+    out = jnp.fft.rfftfreq(int(n), d=float(d))
+    return out.astype(dtype) if dtype is not None else out

@@ -1,0 +1,4 @@
+"""paddle.linalg namespace (reference: python/paddle/linalg.py)."""
+from .ops.linalg import *  # noqa: F401,F403
+from .ops.linalg import __all__  # noqa: F401
+from .ops.math import cross, dot, kron, norm, outer  # noqa: F401

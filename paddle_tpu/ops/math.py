@@ -653,3 +653,178 @@ def searchsorted(sorted_sequence, values, out_int32=False, right=False):
 @def_op("bincount", differentiable=False)
 def bincount(x, weights=None, minlength=0):
     return jnp.bincount(x, weights=weights, minlength=minlength)
+
+
+# --- round-4 surface widening (reference ops.yaml rows) -----------------
+
+@def_op("trace")
+def trace(x, offset=0, axis1=0, axis2=1):
+    return jnp.trace(x, offset=int(offset), axis1=int(axis1),
+                     axis2=int(axis2))
+
+
+@def_op("diagonal")
+def diagonal(x, offset=0, axis1=0, axis2=1):
+    return jnp.diagonal(x, offset=int(offset), axis1=int(axis1),
+                        axis2=int(axis2))
+
+
+@def_op("nansum")
+def nansum(x, axis=None, keepdim=False, dtype=None):
+    out = jnp.nansum(x, axis=axis, keepdims=bool(keepdim))
+    return out.astype(dtype) if dtype is not None else out
+
+
+@def_op("nanmean")
+def nanmean(x, axis=None, keepdim=False):
+    return jnp.nanmean(x, axis=axis, keepdims=bool(keepdim))
+
+
+@def_op("nanmedian", differentiable=False)
+def nanmedian(x, axis=None, keepdim=False):
+    return jnp.nanmedian(x, axis=axis, keepdims=bool(keepdim))
+
+
+@def_op("quantile", differentiable=False)
+def quantile(x, q, axis=None, keepdim=False, interpolation="linear"):
+    return jnp.quantile(x, jnp.asarray(q), axis=axis,
+                        keepdims=bool(keepdim), method=str(interpolation))
+
+
+@def_op("kthvalue", differentiable=False)
+def kthvalue(x, k, axis=-1, keepdim=False):
+    srt = jnp.sort(x, axis=axis)
+    idx = jnp.argsort(x, axis=axis)
+    val = jnp.take(srt, int(k) - 1, axis=axis)
+    ind = jnp.take(idx, int(k) - 1, axis=axis)
+    if keepdim:
+        val = jnp.expand_dims(val, axis)
+        ind = jnp.expand_dims(ind, axis)
+    return val, ind
+
+
+@def_op("mode", differentiable=False)
+def mode(x, axis=-1, keepdim=False):
+    import jax.scipy.stats as jst
+
+    val, _ = jst.mode(x, axis=axis, keepdims=True)
+    idx = jnp.argmax(jnp.flip(x == val, axis), axis=axis, keepdims=True)
+    idx = x.shape[axis] - 1 - idx
+    if not keepdim:
+        val = jnp.squeeze(val, axis)
+        idx = jnp.squeeze(idx, axis)
+    return val, idx
+
+
+@def_op("diff")
+def diff(x, n=1, axis=-1, prepend=None, append=None):
+    return jnp.diff(x, n=int(n), axis=int(axis), prepend=prepend,
+                    append=append)
+
+
+@def_op("trapezoid")
+def trapezoid(y, x=None, dx=None, axis=-1):
+    return jnp.trapezoid(y, x=x, dx=1.0 if dx is None else float(dx),
+                         axis=int(axis))
+
+
+@def_op("logcumsumexp")
+def logcumsumexp(x, axis=None):
+    from jax import lax as _lax
+
+    ax = -1 if axis is None else int(axis)
+    xf = x if axis is not None else x.reshape(-1)
+    m = jnp.max(xf, axis=ax, keepdims=True)
+    out = jnp.log(jnp.cumsum(jnp.exp(xf - m), axis=ax)) + m
+    return out
+
+
+@def_op("logaddexp")
+def logaddexp(x, y):
+    return jnp.logaddexp(x, y)
+
+
+@def_op("angle")
+def angle(x):
+    return jnp.angle(x)
+
+
+@def_op("conj")
+def conj(x):
+    return jnp.conj(x)
+
+
+@def_op("real")
+def real(x):
+    return jnp.real(x)
+
+
+@def_op("imag")
+def imag(x):
+    return jnp.imag(x)
+
+
+@def_op("heaviside")
+def heaviside(x, y):
+    return jnp.heaviside(x, y)
+
+
+@def_op("copysign")
+def copysign(x, y):
+    return jnp.copysign(x, y)
+
+
+@def_op("nextafter", differentiable=False)
+def nextafter(x, y):
+    return jnp.nextafter(x, y)
+
+
+@def_op("ldexp")
+def ldexp(x, y):
+    return jnp.ldexp(x, y)
+
+
+@def_op("frexp", differentiable=False)
+def frexp(x):
+    return jnp.frexp(x)
+
+
+@def_op("i0")
+def i0(x):
+    return jnp.i0(x)
+
+
+@def_op("igamma", differentiable=False)
+def igamma(a, x):
+    from jax.scipy.special import gammainc
+
+    return gammainc(a, x)
+
+
+@def_op("polygamma", differentiable=False)
+def polygamma(x, n=1):
+    from jax.scipy.special import polygamma as pg
+
+    return pg(int(n), x)
+
+
+@def_op("vander", differentiable=False)
+def vander(x, n=None, increasing=False):
+    return jnp.vander(x, N=None if n is None else int(n),
+                      increasing=bool(increasing))
+
+
+@def_op("histogram", differentiable=False)
+def histogram(x, bins=100, min=0, max=0, weight=None, density=False):
+    rng = None if (min == 0 and max == 0) else (float(min), float(max))
+    w = weight.reshape(-1) if weight is not None else None
+    h, edges = jnp.histogram(x.reshape(-1), bins=int(bins), range=rng,
+                             weights=w, density=bool(density))
+    return h
+
+
+@def_op("bucketize", differentiable=False)
+def bucketize(x, sorted_sequence, out_int32=False, right=False):
+    out = jnp.searchsorted(sorted_sequence, x,
+                           side="right" if right else "left")
+    return out.astype(jnp.int32) if out_int32 else out

@@ -213,12 +213,13 @@ class Optimizer:
                         v = state[sk]
                         st[k] = v._value if isinstance(v, Tensor) else jnp.asarray(v)
                 if st:
+                    # preserve loaded master weights stored alongside
                     self._states[id(p)] = st
                 mk = f"{key}.master_weight"
                 if mk in state:
                     v = state[mk]
                     self._master_weights[id(p)] = (
-                        v._value if isinstance(v, Tensor) else jnp.asarray(v))
+                        v._value if isinstance(v, Tensor) else jnp.asarray(v)).astype(jnp.float32)
 
 
 class SGD(Optimizer):

@@ -1,0 +1,12 @@
+"""Public utilities: the custom-op extension point + cpp_extension.
+
+(reference: python/paddle/utils/__init__.py, cpp_extension/ — and the
+C++ registration surface paddle/phi/api/ext/op_meta_info.h
+``PD_BUILD_OP``.)
+"""
+from .op_extension import (custom_op, custom_grad, custom_spmd_rule,
+                           registered_ops)  # noqa: F401
+from . import cpp_extension  # noqa: F401
+
+__all__ = ["custom_op", "custom_grad", "custom_spmd_rule",
+           "registered_ops", "cpp_extension"]

@@ -1,0 +1,79 @@
+"""JIT-compiled host-side C++ extensions.
+
+(reference: python/paddle/utils/cpp_extension/ — CppExtension /
+CUDAExtension / ``load(name, sources)`` building a .so of PD_BUILD_OP
+registrations with nvcc.)
+
+TPU-native scope: there is no user device code to compile — device
+kernels are JAX/Pallas (see utils/op_extension.py). What remains native
+is HOST-side machinery (custom data loaders, stores, codecs: the same
+role as csrc/tcp_store.cpp + shm_ring.cpp), compiled here with g++ over
+the C ABI and bound via ctypes — pybind11 is deliberately not required.
+"""
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import tempfile
+from typing import List, Optional, Sequence
+
+from ..core.enforce import enforce
+
+__all__ = ["load", "CppExtension", "get_build_directory"]
+
+
+def get_build_directory() -> str:
+    d = os.environ.get("PADDLE_TPU_EXTENSION_DIR") or os.path.join(
+        tempfile.gettempdir(), "paddle_tpu_extensions")
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+class CppExtension:
+    """Build description (reference cpp_extension.CppExtension)."""
+
+    def __init__(self, sources: Sequence[str],
+                 extra_compile_args: Optional[List[str]] = None,
+                 extra_link_args: Optional[List[str]] = None,
+                 include_dirs: Optional[List[str]] = None):
+        self.sources = list(sources)
+        self.extra_compile_args = list(extra_compile_args or [])
+        self.extra_link_args = list(extra_link_args or [])
+        self.include_dirs = list(include_dirs or [])
+
+
+def load(name: str, sources: Sequence[str],
+         extra_cxx_cflags: Optional[List[str]] = None,
+         extra_ldflags: Optional[List[str]] = None,
+         extra_include_paths: Optional[List[str]] = None,
+         build_directory: Optional[str] = None,
+         verbose: bool = False) -> ctypes.CDLL:
+    """Compile C++ sources to a shared library and ctypes-load it
+    (reference cpp_extension.load). Recompiles only when sources or
+    flags change (content-hash keyed, like the reference's version.txt
+    check)."""
+    srcs = [os.path.abspath(s) for s in sources]
+    for s in srcs:
+        enforce(os.path.exists(s), f"source not found: {s}")
+    cflags = ["-O2", "-fPIC", "-std=c++17", "-Wall"] + list(
+        extra_cxx_cflags or [])
+    ldflags = ["-shared", "-pthread"] + list(extra_ldflags or [])
+    incs = [f"-I{p}" for p in (extra_include_paths or [])]
+
+    h = hashlib.sha1()
+    for s in srcs:
+        with open(s, "rb") as f:
+            h.update(f.read())
+    h.update(" ".join(cflags + ldflags + incs).encode())
+    out_dir = build_directory or get_build_directory()
+    so = os.path.join(out_dir, f"{name}_{h.hexdigest()[:12]}.so")
+    if not os.path.exists(so):
+        cmd = (["g++"] + cflags + incs + srcs + ldflags + ["-o", so])
+        if verbose:
+            print("[cpp_extension]", " ".join(cmd))
+        proc = subprocess.run(cmd, capture_output=True, text=True)
+        enforce(proc.returncode == 0,
+                f"g++ failed for extension {name!r}:\n{proc.stderr}")
+    return ctypes.CDLL(so)

@@ -1,0 +1,88 @@
+"""Out-of-tree custom operators.
+
+(reference: paddle/phi/api/ext/op_meta_info.h ``PD_BUILD_OP`` — C++
+macro registering forward/backward/infer-meta/SPMD-rule hooks for a
+custom op; python surface python/paddle/utils/cpp_extension loading a
+compiled .so of such registrations.)
+
+TPU-native redesign: a custom op is a JAX-traceable function — pure
+jnp/lax code or a Pallas TPU kernel — registered into the SAME dispatch
+registry as every built-in op (core/registry.py). That buys, with zero
+extra machinery:
+
+- autograd: the tape differentiates through it with generic jax.vjp,
+  or an explicit backward via :func:`custom_grad` (PD_BUILD_GRAD_OP);
+- jit/to_static + the distributed engines: the op traces into compiled
+  steps like any built-in, and runs under shard_map (use
+  ``paddle_tpu.distributed.collective`` axis helpers inside for
+  explicit collectives);
+- AMP lists, the profiler and the nan/inf observer, which all hook the
+  dispatch chokepoint;
+- eager SPMD metadata via :func:`custom_spmd_rule` (the reference's
+  InferSpmdFn slot in OpMetaInfoBuilder).
+
+Example — an out-of-tree fused op with explicit grad and SPMD rule::
+
+    from paddle_tpu.utils import custom_op, custom_grad, custom_spmd_rule
+
+    @custom_op("my_swiglu")
+    def my_swiglu(gate, up):
+        return jax.nn.silu(gate) * up
+
+    @custom_grad("my_swiglu")
+    def my_swiglu_grad(in_values, out_values, out_grads):
+        g, u = in_values
+        dy = out_grads  # single-output ops get the bare cotangent
+        s = jax.nn.sigmoid(g)
+        silu = g * s
+        return (dy * u * (s + silu * (1 - s)), dy * silu)
+
+    @custom_spmd_rule("my_swiglu")
+    def my_swiglu_spmd(op, in_tensors, out_vals, args, kwargs):
+        from paddle_tpu.distributed.auto_parallel.spmd_rules import _spec_of
+        s = _spec_of(in_tensors[0])
+        return [s] if s is not None else None
+
+For host-side native code (IO, stores, data plumbing) compile C++ with
+:mod:`paddle_tpu.utils.cpp_extension` — device code is always expressed
+in JAX/Pallas, never hand-built machine kernels.
+"""
+from __future__ import annotations
+
+from typing import Callable, List
+
+from ..core.dispatch import def_grad, def_op
+from ..core import registry as _registry
+
+__all__ = ["custom_op", "custom_grad", "custom_spmd_rule",
+           "registered_ops"]
+
+
+def custom_op(name: str, differentiable: bool = True) -> Callable:
+    """Register an out-of-tree op (reference PD_BUILD_OP). The decorated
+    function takes/returns raw jax arrays; the returned public function
+    takes/returns Tensors through the dispatch chokepoint."""
+    return def_op(name, differentiable=differentiable)
+
+
+def custom_grad(name: str) -> Callable:
+    """Attach an explicit backward (reference PD_BUILD_GRAD_OP).
+    Signature: fn(in_values, out_values, out_grads, **attrs) -> tuple of
+    input cotangents (None allowed). Without it, generic jax.vjp
+    differentiates the forward."""
+    return def_grad(name)
+
+
+def custom_spmd_rule(name: str) -> Callable:
+    """Attach an eager sharding-propagation rule (reference
+    OpMetaInfoBuilder::SetInferSpmdFn). fn(op_name, in_tensors,
+    out_values, args, kwargs) -> list of PartitionSpec tuples per
+    output, or None."""
+    from ..distributed.auto_parallel.spmd_rules import register_rule
+
+    return register_rule(name)
+
+
+def registered_ops() -> List[str]:
+    """All op names in the dispatch registry (built-in + custom)."""
+    return sorted(_registry._REGISTRY.keys())

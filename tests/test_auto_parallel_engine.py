@@ -1,0 +1,155 @@
+"""Auto-parallel Engine + per-op SPMD propagation (VERDICT item 5).
+
+The reference proves its planner with program-parity tests
+(test/auto_parallel/*); here the proof is loss parity: a PLAIN dense
+GPT whose parameters were only shard_tensor'd trains identically to
+single-device eager execution — GSPMD inferred every intermediate
+sharding and inserted the collectives."""
+import numpy as np
+import pytest
+
+import jax
+from jax.sharding import PartitionSpec as P
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed.auto_parallel import (Engine, ProcessMesh,
+                                                  Replicate, Shard,
+                                                  shard_tensor)
+from paddle_tpu.models import (GPTConfig, GPTForCausalLM,
+                               GPTPretrainingCriterion)
+
+
+def _cfg():
+    return GPTConfig(vocab_size=256, hidden_size=64, num_layers=2,
+                     num_heads=4, max_position_embeddings=64)
+
+
+def _mesh():
+    return ProcessMesh(np.arange(8), dim_names=["mp"])
+
+
+def _megatron_annotate(model, mesh):
+    """Megatron-style placement by NAME ONLY — no layer rewrites."""
+    for name, p in model.named_parameters():
+        nd = p._value.ndim
+        if "qkv_proj.weight" in name or "fc1.weight" in name:
+            pl = [Shard(1)]
+        elif "out_proj.weight" in name or "fc2.weight" in name:
+            pl = [Shard(0)]
+        elif "word_embeddings.weight" in name:
+            pl = [Shard(0)]
+        elif "qkv_proj.bias" in name or "fc1.bias" in name:
+            pl = [Shard(0)]
+        else:
+            pl = [Replicate()]
+        v = shard_tensor(p, mesh, pl)
+        p._value = v._value
+        p.dist_attr = v.dist_attr
+
+
+def _eager_losses(model, crit, ids, lr, steps):
+    opt = paddle.optimizer.AdamW(learning_rate=lr,
+                                 parameters=model.parameters())
+    losses = []
+    for _ in range(steps):
+        loss = crit(model(paddle.to_tensor(ids[:, :-1])),
+                    paddle.to_tensor(ids[:, 1:]))
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss))
+    return losses
+
+
+def test_engine_matches_single_device():
+    """shard_tensor'd params + zero layer rewrites == eager golden."""
+    cfg = _cfg()
+    ids = np.random.RandomState(0).randint(0, cfg.vocab_size, (4, 17))
+    paddle.seed(21)
+    model = GPTForCausalLM(cfg)
+    crit = GPTPretrainingCriterion(cfg)
+
+    snap = [(p, p._value) for p in model.parameters()]
+    golden = _eager_losses(model, crit, ids, 1e-3, steps=3)
+    for p, v in snap:
+        p._value = v
+        p.grad = None
+        p._grad_node = None
+
+    mesh = _mesh()
+    _megatron_annotate(model, mesh)
+    opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                 parameters=model.parameters())
+    eng = Engine(model,
+                 loss_fn=lambda m, b: crit(m(b["x"]), b["y"]),
+                 optimizer=opt, mesh=mesh)
+    batch = {"x": paddle.to_tensor(ids[:, :-1]),
+             "y": paddle.to_tensor(ids[:, 1:])}
+    losses = [float(eng.train_batch(batch)) for _ in range(3)]
+    np.testing.assert_allclose(losses, golden, rtol=2e-4, atol=2e-5)
+    # params stayed physically sharded through the compiled updates
+    qkv = [p for n, p in model.named_parameters()
+           if "qkv_proj.weight" in n][0]
+    assert not qkv._value.sharding.is_fully_replicated
+
+
+def test_engine_predict_runs_sharded():
+    cfg = _cfg()
+    paddle.seed(22)
+    model = GPTForCausalLM(cfg)
+    mesh = _mesh()
+    _megatron_annotate(model, mesh)
+    eng = Engine(model, mesh=mesh)
+    x = paddle.to_tensor(
+        np.random.RandomState(1).randint(0, cfg.vocab_size, (2, 16)))
+    out = eng.predict(x)
+    assert tuple(out._value.shape) == (2, 16, cfg.vocab_size)
+
+
+def test_spmd_rules_eager_metadata():
+    """Eager dist_attr propagation through the dispatch chokepoint
+    (reference per-op InferSpmd, phi/infermeta/spmd_rules/)."""
+    mesh = _mesh()
+    a = shard_tensor(np.ones((16, 32), "float32"), mesh, [Shard(0)])
+    b = shard_tensor(np.ones((32, 8), "float32"), mesh, [Replicate()])
+
+    mm = paddle.matmul(a, b)
+    assert tuple(mm.dist_attr) == ("mp", None), mm.dist_attr
+
+    # elementwise merges; unary passes through
+    s = a + a
+    assert tuple(s.dist_attr)[0] == "mp"
+    r = paddle.nn.functional.relu(s)
+    assert tuple(r.dist_attr)[0] == "mp"
+
+    # reduction drops the reduced dim's sharding
+    m = paddle.sum(a, axis=0)
+    assert m.dist_attr is None or tuple(m.dist_attr)[0] is None
+
+    # transpose permutes
+    t = paddle.transpose(a, perm=[1, 0])
+    assert tuple(t.dist_attr) == (None, "mp")
+
+    # matmul contracted-dim sharding is dropped (partial -> replicated)
+    c = shard_tensor(np.ones((16, 32), "float32"), mesh, [Replicate()])
+    c.dist_attr = P(None, "mp")
+    d = shard_tensor(np.ones((32, 8), "float32"), mesh, [Shard(0)])
+    out = paddle.matmul(c, d)
+    assert out.dist_attr is None or all(
+        e != "mp" for e in tuple(out.dist_attr))
+
+
+def test_spmd_rules_embedding_and_reshape():
+    mesh = _mesh()
+    w = shard_tensor(np.ones((256, 64), "float32"), mesh, [Shard(0)])
+    # embedding output inherits the table's embed-dim sharding (none
+    # here: vocab dim was the sharded one)
+    ids = paddle.to_tensor(np.zeros((2, 8), "int64"))
+    emb = paddle.nn.functional.embedding(ids, w)
+    w2 = shard_tensor(np.ones((256, 64), "float32"), mesh, [Shard(1)])
+    emb2 = paddle.nn.functional.embedding(ids, w2)
+    assert tuple(emb2.dist_attr) == (None, None, "mp")
+
+    x = shard_tensor(np.ones((8, 64), "float32"), mesh, [Shard(0)])
+    y = paddle.reshape(x, (8, 8, 8))
+    assert tuple(y.dist_attr)[0] == "mp"

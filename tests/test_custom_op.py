@@ -1,0 +1,146 @@
+"""Public custom-op extension point (reference:
+paddle/phi/api/ext/op_meta_info.h PD_BUILD_OP + utils/cpp_extension —
+test/custom_op/ pattern: register out-of-tree, check fwd/grad/dist)."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu.utils import (custom_grad, custom_op, custom_spmd_rule,
+                              registered_ops)
+
+# -- out-of-tree registration (this test file IS the out-of-tree site) --
+
+
+@custom_op("testext_swiglu")
+def _swiglu(gate, up):
+    return jax.nn.silu(gate) * up
+
+
+@custom_grad("testext_swiglu")
+def _swiglu_grad(in_values, out_values, out_grads):
+    g, u = in_values
+    # single-output ops receive the bare cotangent
+    dy = out_grads if not isinstance(out_grads, (tuple, list)) \
+        else out_grads[0]
+    s = jax.nn.sigmoid(g)
+    silu = g * s
+    return (dy * u * (s + silu * (1 - s)), dy * silu)
+
+
+@custom_spmd_rule("testext_swiglu")
+def _swiglu_spmd(op, in_tensors, out_vals, args, kwargs):
+    from paddle_tpu.distributed.auto_parallel.spmd_rules import _spec_of
+
+    s = _spec_of(in_tensors[0])
+    return [s] if s is not None else None
+
+
+def test_custom_op_forward_and_registry():
+    assert "testext_swiglu" in registered_ops()
+    r = np.random.RandomState(0)
+    g = paddle.to_tensor(r.randn(4, 8).astype("float32"))
+    u = paddle.to_tensor(r.randn(4, 8).astype("float32"))
+    out = _swiglu(g, u)
+    ref = np.asarray(jax.nn.silu(g._value) * u._value)
+    np.testing.assert_allclose(np.asarray(out._value), ref, rtol=1e-6)
+
+
+def test_custom_op_explicit_grad_matches_numeric():
+    """OpTest pattern: explicit backward vs numeric differences."""
+    r = np.random.RandomState(1)
+    gv = r.randn(3, 5).astype("float64").astype("float32")
+    uv = r.randn(3, 5).astype("float32")
+    g = paddle.to_tensor(gv, stop_gradient=False)
+    u = paddle.to_tensor(uv, stop_gradient=False)
+    out = _swiglu(g, u)
+    loss = paddle.sum(out * out)
+    loss.backward()
+
+    def f(gv, uv):
+        return float(jnp.sum(jnp.square(jax.nn.silu(gv) * uv)))
+
+    eps = 1e-3
+    for t, v, other, first in ((g, gv, uv, True), (u, uv, gv, False)):
+        num = np.zeros_like(v)
+        it = np.nditer(v, flags=["multi_index"])
+        while not it.finished:
+            idx = it.multi_index
+            vp = v.copy(); vp[idx] += eps
+            vm = v.copy(); vm[idx] -= eps
+            if first:
+                num[idx] = (f(vp, other) - f(vm, other)) / (2 * eps)
+            else:
+                num[idx] = (f(other, vp) - f(other, vm)) / (2 * eps)
+            it.iternext()
+        np.testing.assert_allclose(np.asarray(t.grad._value), num,
+                                   rtol=2e-2, atol=2e-3)
+
+
+def test_custom_op_in_sharded_step():
+    """The custom op runs inside a compiled SPMD train step."""
+    from paddle_tpu import nn
+    from paddle_tpu.distributed import fleet
+    from paddle_tpu.distributed.engine import ParallelEngine
+
+    class TinySwiGLU(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc_g = nn.Linear(8, 16)
+            self.fc_u = nn.Linear(8, 16)
+            self.out = nn.Linear(16, 4)
+
+        def forward(self, x):
+            return self.out(_swiglu(self.fc_g(x), self.fc_u(x)))
+
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 2, "mp_degree": 1}
+    hcg = fleet.init(is_collective=True, strategy=strategy)
+    paddle.seed(3)
+    model = TinySwiGLU()
+    opt = paddle.optimizer.Adam(learning_rate=1e-2,
+                                parameters=model.parameters())
+    eng = ParallelEngine(model, opt, hcg.mesh)
+    step = eng.train_step(
+        lambda m, b: paddle.mean((m(b["x"]) - b["y"]) ** 2))
+    r = np.random.RandomState(0)
+    batch = {"x": paddle.to_tensor(r.randn(8, 8).astype("float32")),
+             "y": paddle.to_tensor(r.randn(8, 4).astype("float32"))}
+    first = float(step(batch))
+    for _ in range(9):
+        last = float(step(batch))
+    assert last < first, (first, last)
+
+
+def test_custom_spmd_rule_propagates():
+    from paddle_tpu.distributed.auto_parallel import (ProcessMesh, Shard,
+                                                      shard_tensor)
+
+    mesh = ProcessMesh(np.arange(8), dim_names=["mp"])
+    g = shard_tensor(np.ones((16, 8), "float32"), mesh, [Shard(0)])
+    u = paddle.to_tensor(np.ones((16, 8), "float32"))
+    out = _swiglu(g, u)
+    assert out.dist_attr is not None and tuple(out.dist_attr)[0] == "mp"
+
+
+def test_cpp_extension_load(tmp_path):
+    """Host-side native extension: compile C++ and call over the C ABI
+    (reference utils/cpp_extension.load)."""
+    from paddle_tpu.utils import cpp_extension
+
+    src = tmp_path / "ext.cpp"
+    src.write_text(
+        'extern "C" long long triple(long long x) { return 3 * x; }\n')
+    lib = cpp_extension.load("testext_triple", [str(src)],
+                             build_directory=str(tmp_path))
+    import ctypes
+
+    lib.triple.restype = ctypes.c_longlong
+    lib.triple.argtypes = [ctypes.c_longlong]
+    assert lib.triple(14) == 42
+    # cache hit: second load must not rebuild (same hash -> same file)
+    lib2 = cpp_extension.load("testext_triple", [str(src)],
+                              build_directory=str(tmp_path))
+    assert lib2.triple(1) == 3

@@ -1,0 +1,105 @@
+"""Elastic recovery end-to-end (VERDICT item 8; reference:
+fleet/elastic/manager.py:237-264 — scale-in detection -> launcher
+restart -> resume). A 2-process dp pod loses a rank mid-run; jax's
+coordination service fatally takes down the surviving rank with it, so
+recovery is launcher-shaped exactly like the reference: the launcher
+(played here by this test, in production distributed/launch/main.py's
+pod watcher or the TCPStore ElasticManager across hosts) sees the
+children die, relaunches with the new world, and the relaunched job
+reshard-loads the sharded checkpoint (params + AdamW moments + step) —
+the loss curve must CONTINUE exactly where an uninterrupted run would
+be."""
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+_REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+_WORKER = os.path.join(_REPO, "tests", "workers", "elastic_worker.py")
+
+RESTART_RC = 3
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _env(rank, world, port, extra):
+    env = dict(os.environ)
+    for k in list(env):
+        if k.startswith(("PADDLE_", "JAX_", "XLA_")):
+            del env[k]
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PADDLE_TRAINER_ID"] = str(rank)
+    env["PADDLE_TRAINERS_NUM"] = str(world)
+    env["PADDLE_MASTER"] = f"127.0.0.1:{port}"
+    env.update({k: str(v) for k, v in extra.items()})
+    return env
+
+
+def _run(world, extra, timeout=600):
+    port = _free_port()
+    procs = [subprocess.Popen(
+        [sys.executable, _WORKER],
+        env=_env(rank, world, port, extra), cwd=_REPO,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+        for rank in range(world)]
+    rcs, logs = [], []
+    for p in procs:
+        out, _ = p.communicate(timeout=timeout)
+        rcs.append(p.returncode)
+        logs.append(out.decode(errors="replace")[-3000:])
+    return rcs, logs
+
+
+def test_scale_in_detect_and_resume(tmp_path):
+    ckpt = str(tmp_path / "ckpt")
+    total, save_every, die_at = 8, 2, 4
+
+    # phase 1: 2-proc dp pod; rank 1 dies at step 4 (checkpoint at 4
+    # is already on disk); rank 0 detects and exits RESTART
+    rcs, logs = _run(2, {"CKPT_DIR": ckpt, "TOTAL_STEPS": total,
+                         "SAVE_EVERY": save_every, "DIE_AT": die_at,
+                         "TEST_OUT": str(tmp_path / "p1")})
+    assert rcs[1] == 17, logs[1]
+    assert rcs[0] != 0, logs[0]  # survivor goes down with the pod
+    with open(str(tmp_path / "p1") + ".0.log") as f:
+        p1_losses = [float(l) for l in f.read().split()]
+    assert len(p1_losses) >= die_at  # progress up to the kill is on disk
+    p1_losses = p1_losses[:die_at]
+
+    # phase 2: relaunched world=1 resumes from the checkpoint
+    rcs, logs = _run(1, {"CKPT_DIR": ckpt, "TOTAL_STEPS": total,
+                         "SAVE_EVERY": 100, "RESUME": "1",
+                         "TEST_OUT": str(tmp_path / "p2")})
+    assert rcs == [0], logs[0]
+    with open(str(tmp_path / "p2") + ".0") as f:
+        assert json.load(f)["start"] == die_at
+    with open(str(tmp_path / "p2") + ".0.log") as f:
+        p2_losses = [float(l) for l in f.read().split()]
+
+    # golden: uninterrupted world=1 run of the same schedule
+    gckpt = str(tmp_path / "gold_ckpt")
+    rcs, logs = _run(1, {"CKPT_DIR": gckpt, "TOTAL_STEPS": total,
+                         "SAVE_EVERY": 100,
+                         "TEST_OUT": str(tmp_path / "gold")})
+    assert rcs == [0], logs[0]
+    with open(str(tmp_path / "gold") + ".0.log") as f:
+        gold_losses = [float(l) for l in f.read().split()]
+
+    # pre-kill pod losses match the golden (dp2 == dp1 on the same
+    # global batch), and the resumed run CONTINUES the golden curve —
+    # params, AdamW moments and step count all survived the reshard
+    np.testing.assert_allclose(p1_losses, gold_losses[:die_at],
+                               rtol=2e-4, atol=2e-5)
+    np.testing.assert_allclose(p2_losses, gold_losses[die_at:],
+                               rtol=2e-4, atol=2e-5)

@@ -1,0 +1,196 @@
+"""linalg decompositions + fft (reference: test/legacy_test/
+test_svd_op.py, test_qr_op.py, test_eigh_op.py, test_cholesky_op.py,
+test_solve_op.py, test_lstsq_op.py, test_fft.py — the OpTest pattern:
+value parity vs numpy + gradient checks for differentiable ops)."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+
+
+def _rand(*shape, seed=0):
+    return np.random.RandomState(seed).randn(*shape).astype("float32")
+
+
+def _spd(n, seed=0):
+    a = np.random.RandomState(seed).randn(n, n).astype("float32")
+    return a @ a.T + n * np.eye(n, dtype="float32")
+
+
+def test_svd_reconstructs_and_grads():
+    x = _rand(6, 4, seed=1)
+    u, s, vh = paddle.linalg.svd(paddle.to_tensor(x))
+    rec = np.asarray(u._value) @ np.diag(np.asarray(s._value)) @ \
+        np.asarray(vh._value)
+    np.testing.assert_allclose(rec, x, rtol=1e-4, atol=1e-4)
+    # gradient flows through the singular values
+    t = paddle.to_tensor(x, stop_gradient=False)
+    _, s2, _ = paddle.linalg.svd(t)
+    paddle.sum(s2).backward()
+    # d(sum of singvals)/dx = u @ vh for distinct singvals
+    ref = np.asarray(u._value) @ np.asarray(vh._value)
+    np.testing.assert_allclose(np.asarray(t.grad._value), ref,
+                               rtol=1e-3, atol=1e-3)
+
+
+def test_qr_and_cholesky():
+    x = _rand(5, 3, seed=2)
+    q, r = paddle.linalg.qr(paddle.to_tensor(x))
+    np.testing.assert_allclose(np.asarray(q._value) @ np.asarray(r._value),
+                               x, rtol=1e-4, atol=1e-4)
+    a = _spd(4, seed=3)
+    L = paddle.linalg.cholesky(paddle.to_tensor(a))
+    Lv = np.asarray(L._value)
+    np.testing.assert_allclose(Lv @ Lv.T, a, rtol=1e-3, atol=1e-3)
+    U = paddle.linalg.cholesky(paddle.to_tensor(a), upper=True)
+    np.testing.assert_allclose(np.asarray(U._value), Lv.T, rtol=1e-5)
+
+
+def test_eigh_parity_and_grad():
+    a = _spd(5, seed=4)
+    w, v = paddle.linalg.eigh(paddle.to_tensor(a))
+    wn, vn = np.linalg.eigh(a)
+    np.testing.assert_allclose(np.asarray(w._value), wn, rtol=1e-3,
+                               atol=1e-3)
+    t = paddle.to_tensor(a, stop_gradient=False)
+    w2, _ = paddle.linalg.eigh(t)
+    paddle.sum(w2).backward()
+    # d(trace of eigvals)/dA = I for symmetric A
+    np.testing.assert_allclose(np.asarray(t.grad._value),
+                               np.eye(5, dtype="float32"), rtol=1e-3,
+                               atol=1e-3)
+
+
+def test_solve_family():
+    a = _spd(4, seed=5)
+    b = _rand(4, 2, seed=6)
+    x = paddle.linalg.solve(paddle.to_tensor(a), paddle.to_tensor(b))
+    np.testing.assert_allclose(a @ np.asarray(x._value), b, rtol=1e-3,
+                               atol=1e-3)
+    L = np.linalg.cholesky(a).astype("float32")
+    y = paddle.linalg.cholesky_solve(paddle.to_tensor(b),
+                                     paddle.to_tensor(L))
+    np.testing.assert_allclose(a @ np.asarray(y._value), b, rtol=1e-3,
+                               atol=1e-3)
+    t = paddle.linalg.triangular_solve(
+        paddle.to_tensor(L), paddle.to_tensor(b), upper=False)
+    np.testing.assert_allclose(L @ np.asarray(t._value), b, rtol=1e-3,
+                               atol=1e-3)
+
+
+def test_lstsq_and_pinv():
+    a = _rand(8, 3, seed=7)
+    b = _rand(8, 2, seed=8)
+    sol, res, rank, sv = paddle.linalg.lstsq(paddle.to_tensor(a),
+                                             paddle.to_tensor(b))
+    ref = np.linalg.lstsq(a, b, rcond=None)[0]
+    np.testing.assert_allclose(np.asarray(sol._value), ref, rtol=1e-3,
+                               atol=1e-3)
+    p = paddle.linalg.pinv(paddle.to_tensor(a))
+    np.testing.assert_allclose(np.asarray(p._value), np.linalg.pinv(a),
+                               rtol=1e-3, atol=1e-3)
+
+
+def test_det_inv_power_rank():
+    a = _spd(4, seed=9)
+    assert abs(float(paddle.linalg.det(paddle.to_tensor(a))._value)
+               - np.linalg.det(a)) / abs(np.linalg.det(a)) < 1e-3
+    sign, logdet = paddle.linalg.slogdet(paddle.to_tensor(a))
+    assert float(sign._value) == pytest.approx(1.0)
+    inv = paddle.linalg.inv(paddle.to_tensor(a))
+    np.testing.assert_allclose(a @ np.asarray(inv._value),
+                               np.eye(4), rtol=1e-3, atol=1e-3)
+    p3 = paddle.linalg.matrix_power(paddle.to_tensor(a), 3)
+    np.testing.assert_allclose(np.asarray(p3._value), a @ a @ a,
+                               rtol=1e-2)
+    r = paddle.linalg.matrix_rank(paddle.to_tensor(_rand(6, 4, seed=10)))
+    assert int(r._value) == 4
+
+
+def test_lu_and_misc():
+    a = _spd(4, seed=11)
+    lu_, piv = paddle.linalg.lu(paddle.to_tensor(a))
+    assert tuple(lu_._value.shape) == (4, 4)
+    m = paddle.linalg.multi_dot([paddle.to_tensor(_rand(3, 4, seed=1)),
+                                 paddle.to_tensor(_rand(4, 5, seed=2)),
+                                 paddle.to_tensor(_rand(5, 2, seed=3))])
+    assert tuple(m._value.shape) == (3, 2)
+    e = paddle.linalg.matrix_exp(paddle.to_tensor(
+        np.zeros((3, 3), "float32")))
+    np.testing.assert_allclose(np.asarray(e._value), np.eye(3), atol=1e-6)
+
+
+def test_fft_roundtrip_and_parity():
+    x = _rand(16, seed=12)
+    X = paddle.fft.fft(paddle.to_tensor(x))
+    np.testing.assert_allclose(np.asarray(X._value), np.fft.fft(x),
+                               rtol=1e-3, atol=1e-4)
+    back = paddle.fft.ifft(X)
+    np.testing.assert_allclose(np.asarray(back._value).real, x,
+                               rtol=1e-3, atol=1e-4)
+    r = paddle.fft.rfft(paddle.to_tensor(x))
+    np.testing.assert_allclose(np.asarray(r._value), np.fft.rfft(x),
+                               rtol=1e-3, atol=1e-4)
+    ir = paddle.fft.irfft(r, n=16)
+    np.testing.assert_allclose(np.asarray(ir._value), x, rtol=1e-3,
+                               atol=1e-4)
+    x2 = _rand(4, 8, seed=13)
+    X2 = paddle.fft.fft2(paddle.to_tensor(x2))
+    np.testing.assert_allclose(np.asarray(X2._value), np.fft.fft2(x2),
+                               rtol=1e-3, atol=1e-4)
+    f = paddle.fft.fftfreq(8, d=0.5)
+    np.testing.assert_allclose(np.asarray(f._value),
+                               np.fft.fftfreq(8, d=0.5), rtol=1e-6)
+    sh = paddle.fft.fftshift(paddle.to_tensor(x))
+    np.testing.assert_allclose(np.asarray(sh._value), np.fft.fftshift(x))
+
+
+def test_fft_grad_flows():
+    x = paddle.to_tensor(_rand(8, seed=14), stop_gradient=False)
+    X = paddle.fft.rfft(x)
+    loss = paddle.sum(paddle.real(X * paddle.conj(X)))
+    loss.backward()
+    g = np.asarray(x.grad._value)
+    assert np.isfinite(g).all() and np.abs(g).max() > 0
+    # Parseval: sum|X|^2 gradient is 2*N'*x-ish; numeric check
+    eps = 1e-3
+    xv = np.asarray(x._value).copy()
+
+    def f(v):
+        X = np.fft.rfft(v)
+        return float(np.sum(np.abs(X) ** 2))
+
+    num = np.zeros_like(xv)
+    for i in range(8):
+        vp = xv.copy(); vp[i] += eps
+        vm = xv.copy(); vm[i] -= eps
+        num[i] = (f(vp) - f(vm)) / (2 * eps)
+    np.testing.assert_allclose(g, num, rtol=2e-2, atol=1e-2)
+
+
+def test_new_math_surface():
+    x = _rand(4, 6, seed=15)
+    t = paddle.to_tensor(x)
+    assert float(paddle.trace(t)._value) == pytest.approx(
+        np.trace(x), rel=1e-5)
+    np.testing.assert_allclose(np.asarray(paddle.diagonal(t)._value),
+                               np.diagonal(x))
+    np.testing.assert_allclose(np.asarray(paddle.diff(t)._value),
+                               np.diff(x), rtol=1e-6)
+    xn = x.copy(); xn[0, 0] = np.nan
+    assert np.isfinite(float(paddle.nansum(paddle.to_tensor(xn))._value))
+    np.testing.assert_allclose(
+        float(paddle.logaddexp(paddle.to_tensor(np.float32(1.0)),
+                               paddle.to_tensor(np.float32(2.0)))._value),
+        np.logaddexp(1.0, 2.0), rtol=1e-5)
+    v, i = paddle.kthvalue(t, 2)
+    np.testing.assert_allclose(np.asarray(v._value),
+                               np.sort(x, -1)[:, 1], rtol=1e-6)
+    h = paddle.histogram(t, bins=10, min=-3, max=3)
+    assert int(np.asarray(h._value).sum()) <= x.size
+    b = paddle.bucketize(t, paddle.to_tensor(
+        np.array([-1.0, 0.0, 1.0], "float32")))
+    assert tuple(b._value.shape) == (4, 6)

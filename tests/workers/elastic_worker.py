@@ -1,0 +1,95 @@
+"""Elastic-recovery worker: train with periodic sharded checkpoints,
+optionally die mid-run (scale-in) or resume from a checkpoint with a
+DIFFERENT world size (env: PADDLE_TRAINER_ID/TRAINERS_NUM/MASTER,
+CKPT_DIR, TOTAL_STEPS, SAVE_EVERY, DIE_AT, RESUME, TEST_OUT)."""
+import json
+import os
+import sys
+
+os.environ.setdefault("XLA_FLAGS",
+                      "--xla_force_host_platform_device_count=1")
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np  # noqa: E402
+
+import paddle_tpu as paddle  # noqa: E402
+import paddle_tpu.distributed as dist  # noqa: E402
+from paddle_tpu.distributed import fleet  # noqa: E402
+from paddle_tpu.distributed.engine import ParallelEngine  # noqa: E402
+from paddle_tpu.distributed.fleet.elastic import (  # noqa: E402
+    load_train_state, save_train_state)
+from paddle_tpu.models import (GPTForCausalLM,  # noqa: E402
+                               GPTPretrainingCriterion, gpt_tiny)
+
+def global_batch(step, B, S, V):
+    r = np.random.RandomState(1000 + step)
+    ids = r.randint(0, V, (B, S + 1))
+    return ids[:, :-1], ids[:, 1:]
+
+
+def main():
+    out_path = os.environ["TEST_OUT"]
+    rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+    world = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+    ckpt = os.environ["CKPT_DIR"]
+    total = int(os.environ.get("TOTAL_STEPS", "10"))
+    save_every = int(os.environ.get("SAVE_EVERY", "2"))
+    die_at = int(os.environ.get("DIE_AT", "-1"))
+    resume = os.environ.get("RESUME", "") == "1"
+
+    dist.init_parallel_env()
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": world, "mp_degree": 1,
+                               "pp_degree": 1}
+    hcg = fleet.init(is_collective=True, strategy=strategy)
+
+    paddle.seed(42)
+    cfg = gpt_tiny()
+    model = GPTForCausalLM(cfg)
+    crit = GPTPretrainingCriterion(cfg)
+    opt = paddle.optimizer.AdamW(learning_rate=5e-3,
+                                 parameters=model.parameters())
+
+    start = 0
+    if resume:
+        meta = load_train_state(ckpt, model, opt)
+        start = int(meta["step"])
+
+    eng = ParallelEngine(model, opt, hcg.mesh)
+    step_fn = eng.train_step(lambda m, b: crit(m(b["x"]), b["y"]))
+
+    # losses stream to disk per step: when a peer dies, jax's
+    # coordination service FATALLY terminates the survivors too (the
+    # whole pod restarts — which is exactly the launcher-level recovery
+    # flow), so progress must be readable after a crash
+    log = open(f"{out_path}.{rank}.log", "w")
+
+    B, S, V = 8, 16, cfg.vocab_size
+    for step in range(start, total):
+        if die_at >= 0 and step == die_at and rank == world - 1 \
+                and world > 1:
+            os._exit(17)  # scale-in: this rank vanishes without goodbye
+        x, y = global_batch(step, B, S, V)
+        if world > 1:
+            lo, hi = rank * B // world, (rank + 1) * B // world
+            x, y = x[lo:hi], y[lo:hi]
+        loss = step_fn({"x": paddle.to_tensor(x),
+                        "y": paddle.to_tensor(y)})
+        log.write(f"{float(loss)!r}\n")
+        log.flush()
+        if (step + 1) % save_every == 0 and step + 1 < total:
+            save_train_state(ckpt, model, opt, step=step + 1)
+
+    log.close()
+    with open(f"{out_path}.{rank}", "w") as f:
+        json.dump({"rank": rank, "start": start}, f)
+
+
+if __name__ == "__main__":
+    main()
