@@ -49,6 +49,7 @@ from . import inference  # noqa: F401
 from . import utils  # noqa: F401
 from . import linalg  # noqa: F401
 from . import fft  # noqa: F401
+from . import sparse  # noqa: F401
 from .hapi import Model  # noqa: F401
 
 # paddle-API aliases

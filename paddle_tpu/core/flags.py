@@ -102,6 +102,7 @@ define_flag("check_nan_inf", False, "Scan outputs of every eager op for NaN/Inf.
 define_flag("benchmark", False, "Block on each eager op for timing accuracy.")
 define_flag("eager_op_jit_cache", True, "Cache per-op jitted executables keyed by op+attrs.")
 define_flag("use_pallas_kernels", True, "Use Pallas TPU kernels for fused hot ops when available.")
+define_flag("use_autotune", False, "Measured Pallas block-size selection with a persistent algorithm cache (one-time compile cost per new shape).")
 define_flag("allocator_strategy", "xla", "Memory management owner: always XLA on TPU.")
 define_flag("collective_timeout_s", 1800.0, "Watchdog timeout for in-flight collectives.")
 define_flag("enable_async_trace", False, "Enable collective watchdog tracing.")

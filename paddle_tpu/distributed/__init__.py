@@ -20,6 +20,7 @@ from .auto_parallel import (Partial, ProcessMesh, Replicate, Shard,  # noqa: F40
                             dtensor_from_fn, reshard, shard_layer,
                             shard_tensor)
 from . import sharding  # noqa: F401
+from . import rpc  # noqa: F401
 from . import utils  # noqa: F401
 from .engine import ParallelEngine, bind_params, shard_module_params  # noqa: F401
 from .parallel import DataParallel, ParallelEnv  # noqa: F401

@@ -13,5 +13,9 @@ from __future__ import annotations
 
 from .dygraph_optimizer import DygraphShardingOptimizer, \
     HybridParallelOptimizer
+from .extra import (DGCMomentumOptimizer, GradientMergeOptimizer,
+                    LocalSGDOptimizer)
 
-__all__ = ["HybridParallelOptimizer", "DygraphShardingOptimizer"]
+__all__ = ["HybridParallelOptimizer", "DygraphShardingOptimizer",
+           "GradientMergeOptimizer", "DGCMomentumOptimizer",
+           "LocalSGDOptimizer"]

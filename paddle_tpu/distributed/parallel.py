@@ -51,12 +51,108 @@ class ParallelEnv:
         return self.rank
 
 
+class Reducer:
+    """Bucketed fused gradient reduction (reference:
+    fluid/imperative/reducer.h:129 — group_size buckets filled in
+    reverse registration order; a bucket's allreduce fires the moment
+    its last gradient arrives, overlapping with the rest of backward).
+
+    TPU-native role: inside a compiled step XLA already fuses and
+    overlaps the per-leaf psums, so this Reducer serves the EAGER
+    multi-process path, where one fused host allreduce per ~25MB bucket
+    replaces per-tensor round trips."""
+
+    def __init__(self, params, group=None, comm_buffer_size_mb: float = 25.0,
+                 find_unused_parameters: bool = False):
+        import numpy as np
+
+        self.group = group
+        self._params = [p for p in params if p.trainable]
+        self._enabled = True
+        # reverse registration order: grads arrive roughly back-to-front.
+        # find_unused_parameters: a param that never produces a grad
+        # would leave its bucket pending forever, so degrade to
+        # per-param buckets (each hook fires its own reduce — the
+        # reference rebuilds buckets from the found-unused set instead)
+        budget = 0 if find_unused_parameters else \
+            comm_buffer_size_mb * (1 << 20)
+        self._buckets = []
+        cur, cur_bytes = [], 0
+        for p in reversed(self._params):
+            nbytes = int(np.prod(p._value.shape)) * p._value.dtype.itemsize
+            if cur and cur_bytes + nbytes > budget:
+                self._buckets.append(cur)
+                cur, cur_bytes = [], 0
+            cur.append(p)
+            cur_bytes += nbytes
+        if cur:
+            self._buckets.append(cur)
+        self._bucket_of = {id(p): bi
+                           for bi, b in enumerate(self._buckets)
+                           for p in b}
+        self._pending = [dict() for _ in self._buckets]
+        self.fused_reduce_count = 0  # observability (tests/tracing)
+
+    @property
+    def num_buckets(self) -> int:
+        return len(self._buckets)
+
+    def hook_for(self, p):
+        bi = self._bucket_of[id(p)]
+
+        def hook(grad: Tensor) -> Tensor:
+            if not self._enabled:
+                return grad
+            return self._arrive(bi, p, grad)
+
+        return hook
+
+    def _arrive(self, bi, p, grad: Tensor) -> Tensor:
+        import jax.numpy as jnp
+
+        bucket = self._buckets[bi]
+        pend = self._pending[bi]
+        pend[id(p)] = grad._value
+        if len(pend) < len(bucket):
+            return grad  # provisional; overwritten when the bucket fires
+        # bucket complete: ONE fused allreduce over the flattened grads
+        vals = [pend[id(q)] for q in bucket]
+        flat = jnp.concatenate([v.reshape(-1).astype(jnp.float32)
+                                for v in vals])
+        red = C.all_reduce_mean_value(Tensor(flat, stop_gradient=True),
+                                      group=self.group)
+        rv = red._value if isinstance(red, Tensor) else red
+        self.fused_reduce_count += 1
+        off = 0
+        out = None
+        for q, v in zip(bucket, vals):
+            n = v.size
+            piece = rv[off:off + n].reshape(v.shape).astype(v.dtype)
+            off += n
+            if q is p:
+                # hook return: the engine accumulates it onto any
+                # previously-accumulated grad itself
+                out = Tensor(piece, stop_gradient=True)
+            else:
+                # q.grad currently holds prior-accumulation + this
+                # pass's provisional local grad — swap only the
+                # provisional part for its reduced value so no_sync /
+                # multi-backward accumulation survives
+                if q.grad is not None:
+                    q.grad = Tensor(q.grad._value - v + piece,
+                                    stop_gradient=True)
+                else:
+                    q.grad = Tensor(piece, stop_gradient=True)
+        self._pending[bi] = {}
+        return out
+
+
 class DataParallel(Layer):
     """Wraps a model for data parallelism over the 'dp' axes of the mesh.
 
-    grads are averaged across the group via leaf hooks at grad-accumulation
-    time (the reference's Reducer bucket callbacks, SURVEY.md §3.2 step 4).
-    """
+    grads are averaged across the group via a bucketed Reducer attached
+    through leaf hooks (the reference's Reducer bucket callbacks,
+    SURVEY.md §3.2 step 4)."""
 
     def __init__(self, layers: Layer, strategy=None, comm_buffer_size: int = 25,
                  last_comm_buffer_size: int = 1, find_unused_parameters=False,
@@ -65,21 +161,13 @@ class DataParallel(Layer):
         self._layers = layers
         self.group = group or C.get_group(0)
         self.find_unused_parameters = find_unused_parameters
-        if C.get_world_size(self.group) > 1 or True:
-            self._register_grad_hooks()
-
-    def _register_grad_hooks(self):
-        group = self.group
-
-        def make_hook():
-            def hook(grad: Tensor) -> Tensor:
-                return C.all_reduce_mean_value(grad, group=group)
-
-            return hook
-
-        for p in self._layers.parameters():
+        self._reducer = Reducer(
+            layers.parameters(), group=self.group,
+            comm_buffer_size_mb=comm_buffer_size,
+            find_unused_parameters=find_unused_parameters)
+        for p in layers.parameters():
             if p.trainable:
-                p.register_hook(make_hook())
+                p.register_hook(self._reducer.hook_for(p))
 
     def forward(self, *inputs, **kwargs):
         return self._layers(*inputs, **kwargs)
@@ -94,10 +182,16 @@ class DataParallel(Layer):
         return loss
 
     def no_sync(self):
+        """Skip gradient sync inside the context (local accumulation —
+        reference DataParallel.no_sync)."""
         import contextlib
 
         @contextlib.contextmanager
         def guard():
-            yield
+            self._reducer._enabled = False
+            try:
+                yield
+            finally:
+                self._reducer._enabled = True
 
         return guard()

@@ -1,0 +1,201 @@
+"""RPC (paddle.distributed.rpc analog).
+
+(reference: python/paddle/distributed/rpc/__init__.py — init_rpc:40,
+rpc_sync:118, rpc_async:171, shutdown over a C++ brpc agent
+fluid/distributed/rpc/rpc_agent.cc.)
+
+TPU-native scope: device communication is XLA collectives; RPC is the
+HOST-side control/side-channel (parameter-server style coordination,
+metrics plumbing, custom orchestration). The brpc agent is replaced by
+the native TCPStore (csrc/tcp_store.cpp): each worker registers its
+name, runs a serving thread that executes pickled (fn, args, kwargs)
+requests in arrival order, and responses flow back through the store —
+same at-most-once, in-order semantics the reference agent provides per
+sender.
+"""
+from __future__ import annotations
+
+import pickle
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from .store import TCPStore, create_or_get_global_tcp_store
+
+__all__ = ["init_rpc", "rpc_sync", "rpc_async", "shutdown",
+           "get_worker_info", "get_all_worker_infos", "WorkerInfo"]
+
+_POLL = 0.01
+
+
+class WorkerInfo:
+    def __init__(self, name: str, rank: int):
+        self.name = name
+        self.rank = rank
+
+    def __repr__(self):
+        return f"WorkerInfo(name={self.name!r}, rank={self.rank})"
+
+
+class _Future:
+    """Return handle of rpc_async (reference FutureWrapper)."""
+
+    def __init__(self):
+        self._ev = threading.Event()
+        self._value = None
+        self._exc: Optional[BaseException] = None
+
+    def _set(self, value=None, exc=None):
+        self._value, self._exc = value, exc
+        self._ev.set()
+
+    def wait(self, timeout: Optional[float] = None):
+        if not self._ev.wait(timeout):
+            raise TimeoutError("rpc future timed out")
+        if self._exc is not None:
+            raise self._exc
+        return self._value
+
+
+class _RpcAgent:
+    def __init__(self, name: str, rank: int, world_size: int,
+                 store: TCPStore):
+        self.name = name
+        self.rank = rank
+        self.world = world_size
+        self.store = store
+        self._stop = threading.Event()
+        self._served = 0
+        self._send_seq: Dict[int, int] = {}
+        store.set(f"rpc/name2rank/{name}", str(rank))
+        store.set(f"rpc/rank2name/{rank}", name)
+        self._server = threading.Thread(target=self._serve, daemon=True)
+        self._server.start()
+        store.barrier("rpc/init", world_size)
+
+    # -- serving --------------------------------------------------------
+    def _serve(self):
+        while not self._stop.is_set():
+            key = f"rpc/req/{self.rank}/{self._served}"
+            if not self.store.check(key):
+                time.sleep(_POLL)
+                continue
+            src, seq, fn, args, kwargs = pickle.loads(
+                self.store.get(key))
+            try:
+                result, exc = fn(*args, **kwargs), None
+            except BaseException as e:  # delivered to the caller
+                result, exc = None, e
+            try:
+                payload = pickle.dumps((result, exc), protocol=4)
+            except Exception as pe:
+                # unpicklable result/exception must not kill the serve
+                # loop — deliver a picklable error instead
+                payload = pickle.dumps(
+                    (None, RuntimeError(
+                        f"rpc result not picklable: {pe!r}; "
+                        f"result={result!r:.200}, exc={exc!r:.200}")),
+                    protocol=4)
+            self.store.set(f"rpc/res/{src}/{self.rank}/{seq}", payload)
+            self.store.delete_key(key)
+            self._served += 1
+
+    # -- calling --------------------------------------------------------
+    def _rank_of(self, to: str) -> int:
+        return int(self.store.get(f"rpc/name2rank/{to}", timeout=30))
+
+    def call(self, to: str, fn, args, kwargs, timeout) -> _Future:
+        dst = self._rank_of(to)
+        # per-destination GLOBAL sequence via the store's atomic add —
+        # serving executes strictly in this order
+        seq = self.store.add(f"rpc/seq/{dst}", 1) - 1
+        self.store.set(f"rpc/req/{dst}/{seq}", pickle.dumps(
+            (self.rank, seq, fn, tuple(args or ()), dict(kwargs or {})),
+            protocol=4))
+        fut = _Future()
+
+        def waiter():
+            key = f"rpc/res/{self.rank}/{dst}/{seq}"
+            deadline = None if timeout is None else time.time() + timeout
+            while not self.store.check(key):
+                if deadline and time.time() > deadline:
+                    fut._set(exc=TimeoutError(
+                        f"rpc to {to!r} timed out"))
+                    return
+                time.sleep(_POLL)
+            result, exc = pickle.loads(self.store.get(key))
+            self.store.delete_key(key)
+            fut._set(result, exc)
+
+        threading.Thread(target=waiter, daemon=True).start()
+        return fut
+
+    def stop(self):
+        self._stop.set()
+        self._server.join(timeout=2)
+
+
+_agent: Optional[_RpcAgent] = None
+
+
+def init_rpc(name: str, rank: Optional[int] = None,
+             world_size: Optional[int] = None,
+             master_endpoint: Optional[str] = None) -> None:
+    """(reference rpc/__init__.py:40)"""
+    global _agent
+    import os
+
+    if rank is None:
+        rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+    if world_size is None:
+        world_size = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+    if master_endpoint:
+        os.environ.setdefault("PADDLE_MASTER", master_endpoint)
+    _agent = _RpcAgent(name, rank, world_size,
+                       create_or_get_global_tcp_store())
+
+
+def _require_agent() -> _RpcAgent:
+    if _agent is None:
+        raise RuntimeError("call init_rpc first")
+    return _agent
+
+
+def rpc_sync(to: str, fn, args=None, kwargs=None,
+             timeout: Optional[float] = 120.0):
+    """Blocking remote call (reference rpc_sync:118)."""
+    return _require_agent().call(to, fn, args, kwargs, timeout).wait(
+        timeout)
+
+
+def rpc_async(to: str, fn, args=None, kwargs=None,
+              timeout: Optional[float] = 120.0) -> _Future:
+    """Non-blocking remote call returning a Future (rpc_async:171)."""
+    return _require_agent().call(to, fn, args, kwargs, timeout)
+
+
+def get_worker_info(name: str) -> WorkerInfo:
+    a = _require_agent()
+    return WorkerInfo(name, a._rank_of(name))
+
+
+def get_all_worker_infos() -> List[WorkerInfo]:
+    a = _require_agent()
+    infos = []
+    for r in range(a.world):
+        try:
+            nm = a.store.get(f"rpc/rank2name/{r}", timeout=5).decode()
+        except Exception:
+            continue
+        infos.append(WorkerInfo(nm, r))
+    return infos
+
+
+def shutdown() -> None:
+    """Barrier + stop serving (reference shutdown)."""
+    global _agent
+    if _agent is None:
+        return
+    _agent.store.barrier("rpc/shutdown", _agent.world)
+    _agent.stop()
+    _agent = None

@@ -1,5 +1,6 @@
 """Incubating APIs (reference: python/paddle/incubate/)."""
+from . import asp  # noqa: F401
 from . import distributed  # noqa: F401
 from . import nn  # noqa: F401
 
-__all__ = ["distributed", "nn"]
+__all__ = ["asp", "distributed", "nn"]

@@ -1,0 +1,120 @@
+"""ASP — automatic structured (n:m) sparsity.
+
+(reference: python/paddle/incubate/asp/ — asp.py prune_model/decorate,
+utils.py check_mask_1d/get_mask_1d etc.; the 2:4 pattern targets sparse
+tensor cores. On TPU there is no 2:4 hardware unit — the value here is
+the PRUNING WORKFLOW parity: magnitude-based n:m masks, mask
+re-application after each optimizer step, sparsity checkers — producing
+models exportable to sparse-capable backends.)
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+import jax.numpy as jnp
+
+from ...autograd import no_grad
+from ...nn.layer import Layer
+
+__all__ = ["prune_model", "decorate", "calculate_density",
+           "check_sparsity", "reset_excluded_layers",
+           "set_excluded_layers"]
+
+_masks: Dict[int, jnp.ndarray] = {}
+_excluded: set = set()
+
+
+def calculate_density(x) -> float:
+    """(reference asp/utils.py calculate_density)"""
+    arr = np.asarray(getattr(x, "_value", x))
+    return float(np.count_nonzero(arr)) / max(arr.size, 1)
+
+
+def _nm_mask(w: np.ndarray, n: int, m: int) -> np.ndarray:
+    """Keep the ``n`` largest-magnitude entries of every group of ``m``
+    along the input dim (mask_1d of reference asp/utils.py)."""
+    shape = w.shape
+    flat = np.abs(w.reshape(-1, shape[-1]))
+    pad = (-flat.shape[1]) % m
+    if pad:
+        flat = np.pad(flat, ((0, 0), (0, pad)))
+    groups = flat.reshape(flat.shape[0], -1, m)
+    order = np.argsort(groups, axis=-1)  # ascending
+    mask = np.ones_like(groups, dtype=bool)
+    drop = order[..., :m - n]
+    np.put_along_axis(mask, drop, False, axis=-1)
+    mask = mask.reshape(flat.shape[0], -1)[:, :shape[-1]]
+    return mask.reshape(shape)
+
+
+def check_sparsity(x, n: int = 2, m: int = 4) -> bool:
+    """True iff every group of m entries along the last dim has at most
+    n nonzeros (reference check_mask_1d)."""
+    arr = np.asarray(getattr(x, "_value", x))
+    flat = arr.reshape(-1, arr.shape[-1])
+    pad = (-flat.shape[1]) % m
+    if pad:
+        flat = np.pad(flat, ((0, 0), (0, pad)))
+    groups = flat.reshape(flat.shape[0], -1, m)
+    return bool((np.count_nonzero(groups, axis=-1) <= n).all())
+
+
+def set_excluded_layers(model, layer_names: List[str]) -> None:
+    for name, sub in model.named_sublayers():
+        if name in layer_names:
+            for p in sub.parameters(include_sublayers=False):
+                _excluded.add(id(p))
+
+
+def reset_excluded_layers(model=None) -> None:
+    _excluded.clear()
+
+
+def _prunable(name: str, p) -> bool:
+    return (p is not None and p.trainable and p._value.ndim == 2
+            and id(p) not in _excluded and "weight" in name)
+
+
+@no_grad()
+def prune_model(model: Layer, n: int = 2, m: int = 4,
+                mask_algo: str = "mask_1d", with_mask: bool = True):
+    """Apply n:m magnitude pruning to every eligible 2-D weight and
+    remember the masks (reference asp.py prune_model)."""
+    masks = {}
+    for name, p in model.named_parameters():
+        if not _prunable(name, p):
+            continue
+        w = np.asarray(p._value)
+        mask = _nm_mask(w, n, m)
+        p._value = jnp.asarray(w * mask, p._value.dtype)
+        if with_mask:
+            _masks[id(p)] = jnp.asarray(mask, p._value.dtype)
+            masks[name] = mask
+    return masks
+
+
+class _ASPOptimizer:
+    """Re-applies the sparsity masks after every step (reference
+    asp.py decorate → OptimizerWithSparsityGuarantee)."""
+
+    def __init__(self, inner):
+        self._inner_opt = inner
+
+    def __getattr__(self, name):
+        return getattr(self._inner_opt, name)
+
+    @no_grad()
+    def step(self):
+        self._inner_opt.step()
+        for p in self._inner_opt._parameter_list or []:
+            mask = _masks.get(id(p))
+            if mask is not None:
+                p._value = p._value * mask
+
+    def clear_grad(self, set_to_zero: bool = False):
+        self._inner_opt.clear_grad(set_to_zero)
+
+
+def decorate(optimizer) -> _ASPOptimizer:
+    return _ASPOptimizer(optimizer)

@@ -455,6 +455,26 @@ def _prep(q, k, causal, scale, interpret, qseg, kseg):
     # 128); smaller sizes only when the sequence doesn't divide
     block_q = _pick_block(Sq, prefer=_BLOCKS)
     block_kv = _pick_block(k.shape[1], prefer=_BLOCKS)
+    from ...core import flags as _flags
+
+    if (_flags._get("use_autotune", False) and not interpret
+            and qseg is None):
+        # measured block selection, cached per shape/dtype (reference
+        # AlgorithmsCache); runs eager side-benchmarks even while an
+        # outer jit traces — block sizes are trace-time constants
+        from .autotune import autotune, measure_flash_blocks
+
+        B, Sq_, H, D = q.shape
+        key = (f"flash:{B}x{Sq_}x{H}x{D}:{k.shape[1]}:{q.dtype}:"
+               f"{bool(causal)}")
+        cands = [(bq, bk) for bq in (512, 256, 128)
+                 for bk in (512, 256, 128)
+                 if Sq_ % bq == 0 and k.shape[1] % bk == 0]
+        if len(cands) > 1:
+            block_q, block_kv = autotune(
+                key, cands,
+                measure_flash_blocks(q.shape, k.shape[1], q.dtype,
+                                     bool(causal)))
     return scale, interpret, qseg, kseg, block_q, block_kv
 
 

@@ -22,7 +22,7 @@ from ..tensor import Parameter, Tensor
 from . import lr as lr_sched
 from .lr import LRScheduler
 
-__all__ = ["Optimizer", "SGD", "Momentum", "Adam", "AdamW", "Adagrad",
+__all__ = ["Optimizer", "SGD", "Momentum", "LarsMomentum", "Adam", "AdamW", "Adagrad",
            "RMSProp", "Lamb", "lr"]
 
 lr = lr_sched
@@ -420,3 +420,53 @@ class Lamb(Optimizer):
         trust = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
         new_p = pf - lr_value * trust * r
         return new_p.astype(p.dtype), {"moment1": m, "moment2": v}
+
+
+class LarsMomentum(Momentum):
+    """LARS: layer-wise adaptive rate scaling over momentum
+    (reference: fleet/meta_optimizers/lars_optimizer.py over the phi
+    lars_momentum kernel — local_lr = lr * coeff * ||w|| /
+    (||g|| + wd * ||w|| + eps), the large-batch training rule)."""
+
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 lars_coeff=0.001, lars_weight_decay=0.0005,
+                 epsilon=1e-8, exclude_from_weight_decay=None,
+                 grad_clip=None, multi_precision=False, name=None,
+                 **kwargs):
+        super().__init__(learning_rate, momentum, parameters,
+                         weight_decay=None, grad_clip=grad_clip,
+                         multi_precision=multi_precision, name=name,
+                         **kwargs)
+        self._lars_coeff = float(lars_coeff)
+        self._lars_wd = float(lars_weight_decay)
+        self._eps = float(epsilon)
+        self._exclude = list(exclude_from_weight_decay or [])
+
+    def _param_state(self, p, shapes):
+        st = super()._param_state(p, shapes)
+        if "lars_skip" not in st:
+            # per-param exclusion travels IN the state so the fused
+            # positional update stays identity-free (name matching like
+            # the reference's exclude_from_weight_decay)
+            name = p.name or ""
+            skip = any(tok in name for tok in self._exclude)
+            st["lars_skip"] = jnp.float32(1.0 if skip else 0.0)
+        return st
+
+    def _update_rule(self, p, g, state, lr_value, step):
+        g = g.astype(jnp.float32)
+        pf = p.astype(jnp.float32)
+        skip = state.get("lars_skip", jnp.float32(0.0)) > 0
+        w_norm = jnp.sqrt(jnp.sum(pf * pf))
+        g_norm = jnp.sqrt(jnp.sum(g * g))
+        local = jnp.where(
+            (~skip) & (w_norm > 0) & (g_norm > 0),
+            self._lars_coeff * w_norm
+            / (g_norm + self._lars_wd * w_norm + self._eps),
+            jnp.float32(1.0))
+        g = g + jnp.where(skip, 0.0, self._lars_wd) * pf
+        v = self._momentum * state["velocity"] + lr_value * local * g
+        new_state = {"velocity": v}
+        if "lars_skip" in state:
+            new_state["lars_skip"] = state["lars_skip"]
+        return (p - v.astype(p.dtype)), new_state

@@ -1,0 +1,166 @@
+"""Sparse tensors (paddle.sparse analog).
+
+(reference: python/paddle/sparse/ — creation.py sparse_coo_tensor:34,
+sparse_csr_tensor:159; C++ phi/core/sparse_coo_tensor.h,
+sparse_csr_tensor.h; kernels phi/kernels/sparse/.)
+
+TPU-native: the storage/compute substrate is ``jax.experimental.sparse``
+BCOO — XLA's batched-COO format whose matmuls lower to gather/segment-
+sum programs the TPU runs well, instead of cuSPARSE dynload. A
+SparseTensor wraps one BCOO and interops with dense Tensors
+(``to_dense``/``matmul``/elementwise); CSR inputs are accepted and
+converted (BCOO is the single canonical layout on XLA — the analog of
+the reference keeping COO/CSR distinct for cuSPARSE's sake).
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+import jax.numpy as jnp
+from jax.experimental import sparse as jsparse
+
+from ..tensor import Tensor
+
+__all__ = ["SparseTensor", "sparse_coo_tensor", "sparse_csr_tensor",
+           "is_sparse", "add", "multiply", "matmul", "masked_matmul",
+           "relu", "transpose", "to_dense"]
+
+
+class SparseTensor:
+    """COO sparse tensor over jax BCOO."""
+
+    def __init__(self, bcoo: jsparse.BCOO, stop_gradient: bool = True):
+        self._bcoo = bcoo
+        self.stop_gradient = stop_gradient
+
+    # -- reference API surface -----------------------------------------
+    @property
+    def shape(self):
+        return list(self._bcoo.shape)
+
+    @property
+    def dtype(self):
+        return self._bcoo.dtype
+
+    @property
+    def nnz(self) -> int:
+        return int(self._bcoo.nse)
+
+    def indices(self) -> Tensor:
+        return Tensor(self._bcoo.indices.T, stop_gradient=True)
+
+    def values(self) -> Tensor:
+        return Tensor(self._bcoo.data, stop_gradient=True)
+
+    def to_dense(self) -> Tensor:
+        return Tensor(self._bcoo.todense(),
+                      stop_gradient=self.stop_gradient)
+
+    def is_sparse_coo(self) -> bool:
+        return True
+
+    def is_sparse_csr(self) -> bool:
+        return False
+
+    def __repr__(self):
+        return (f"SparseTensor(shape={self.shape}, nnz={self.nnz}, "
+                f"dtype={self.dtype})")
+
+
+def _dense_val(x):
+    if isinstance(x, SparseTensor):
+        return x._bcoo.todense()
+    return x._value if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def sparse_coo_tensor(indices, values, shape=None, dtype=None,
+                      place=None, stop_gradient=True) -> SparseTensor:
+    """indices: [ndim, nnz] (reference creation.py:34)."""
+    idx = np.asarray(getattr(indices, "_value", indices))
+    val = jnp.asarray(getattr(values, "_value", values))
+    if dtype is not None:
+        val = val.astype(dtype)
+    if shape is None:
+        shape = tuple(int(m) + 1 for m in idx.max(axis=1))
+    bcoo = jsparse.BCOO((val, jnp.asarray(idx.T)), shape=tuple(shape))
+    return SparseTensor(bcoo, stop_gradient=stop_gradient)
+
+
+def sparse_csr_tensor(crows, cols, values, shape, dtype=None,
+                      place=None, stop_gradient=True) -> SparseTensor:
+    """CSR input converted to the canonical BCOO layout
+    (reference creation.py:159)."""
+    crows = np.asarray(getattr(crows, "_value", crows))
+    cols = np.asarray(getattr(cols, "_value", cols))
+    rows = np.repeat(np.arange(len(crows) - 1), np.diff(crows))
+    return sparse_coo_tensor(np.stack([rows, cols]), values, shape,
+                             dtype=dtype, stop_gradient=stop_gradient)
+
+
+def is_sparse(x) -> bool:
+    return isinstance(x, SparseTensor)
+
+
+def to_dense(x) -> Tensor:
+    return x.to_dense() if isinstance(x, SparseTensor) else x
+
+
+# -- ops (reference python/paddle/sparse/binary.py, unary.py) -----------
+
+
+def add(x: SparseTensor, y) -> SparseTensor:
+    if isinstance(y, SparseTensor):
+        data = jnp.concatenate([x._bcoo.data, y._bcoo.data])
+        idx = jnp.concatenate([x._bcoo.indices, y._bcoo.indices], axis=0)
+        out = jsparse.bcoo_sum_duplicates(
+            jsparse.BCOO((data, idx), shape=x._bcoo.shape))
+        return SparseTensor(out)
+    return SparseTensor(
+        jsparse.BCOO.fromdense(x._bcoo.todense() + _dense_val(y)))
+
+
+def multiply(x: SparseTensor, y) -> SparseTensor:
+    if isinstance(y, SparseTensor):
+        return SparseTensor(jsparse.BCOO.fromdense(
+            x._bcoo.todense() * y._bcoo.todense()))
+    # dense factor: scale the stored values (sparsity preserved)
+    yv = _dense_val(y)
+    taken = yv[tuple(x._bcoo.indices.T)] if yv.ndim else yv
+    return SparseTensor(jsparse.BCOO((x._bcoo.data * taken,
+                                      x._bcoo.indices),
+                                     shape=x._bcoo.shape))
+
+
+def matmul(x, y) -> Tensor:
+    """sparse @ dense (or dense @ sparse) -> dense
+    (reference sparse/binary.py matmul over cusparse spmm)."""
+    if isinstance(x, SparseTensor) and not isinstance(y, SparseTensor):
+        return Tensor(x._bcoo @ _dense_val(y))
+    if isinstance(y, SparseTensor) and not isinstance(x, SparseTensor):
+        return Tensor(_dense_val(x) @ y._bcoo)
+    if isinstance(x, SparseTensor) and isinstance(y, SparseTensor):
+        return Tensor(x._bcoo.todense() @ y._bcoo.todense())
+    raise TypeError("matmul expects at least one SparseTensor")
+
+
+def masked_matmul(x, y, mask: SparseTensor) -> SparseTensor:
+    """dense @ dense evaluated ONLY at mask's nonzeros (reference
+    sparse/binary.py masked_matmul / cusparse SDDMM)."""
+    xv, yv = _dense_val(x), _dense_val(y)
+    idx = mask._bcoo.indices
+    rows, cols = idx[:, 0], idx[:, 1]
+    vals = jnp.einsum("nk,nk->n", xv[rows, :], yv[:, cols].T)
+    return SparseTensor(jsparse.BCOO((vals, idx), shape=mask._bcoo.shape))
+
+
+def relu(x: SparseTensor) -> SparseTensor:
+    return SparseTensor(jsparse.BCOO(
+        (jnp.maximum(x._bcoo.data, 0), x._bcoo.indices),
+        shape=x._bcoo.shape))
+
+
+def transpose(x: SparseTensor, perm: Sequence[int]) -> SparseTensor:
+    return SparseTensor(jsparse.bcoo_transpose(
+        x._bcoo, permutation=tuple(perm)))

@@ -1,0 +1,70 @@
+"""Pallas block autotune + algorithm cache (reference:
+phi/kernels/autotune/cache.h AlgorithmsCache, switch_autotune.cc)."""
+import json
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.ops.pallas.autotune import AlgoCache, autotune
+
+
+def test_autotune_picks_argmin_and_caches(tmp_path):
+    path = str(tmp_path / "algo.json")
+    cache = AlgoCache(path)
+    times = {(128, 128): 3.0, (256, 256): 1.0, (512, 512): 2.0}
+    calls = []
+
+    def measure(c):
+        calls.append(c)
+        return times[c]
+
+    best = autotune("k1", list(times), measure, cache)
+    assert best == (256, 256)
+    assert len(calls) == 3
+    # cache hit: no more measurements
+    again = autotune("k1", list(times), measure, cache)
+    assert again == (256, 256) and len(calls) == 3
+    # persisted: a NEW cache over the same file skips the search too
+    cache2 = AlgoCache(path)
+    assert autotune("k1", list(times), measure, cache2) == (256, 256)
+    assert len(calls) == 3
+    with open(path) as f:
+        assert "k1" in json.load(f)
+
+
+def test_autotune_skips_infeasible():
+    cache = AlgoCache(None)
+
+    def measure(c):
+        if c == "bad":
+            raise ValueError("no compile")
+        return {"a": 2.0, "b": 1.0}[c]
+
+    assert autotune("k", ["bad", "a", "b"], measure, cache) == "b"
+    with pytest.raises(RuntimeError):
+        autotune("none", ["bad"],
+                 lambda c: (_ for _ in ()).throw(ValueError()), cache)
+
+
+def test_flash_autotune_flag_consults_cache(monkeypatch, tmp_path):
+    """With FLAGS_use_autotune on, flash block selection goes through
+    the cache (measurements mocked — no TPU in CI)."""
+    import jax.numpy as jnp
+
+    import paddle_tpu.ops.pallas.autotune as AT
+    from paddle_tpu.ops.pallas import flash_attention as FA
+
+    cache = AT.AlgoCache(None)
+    cache.put("flash:1x256x2x128:256:float32:True", (128, 256))
+    monkeypatch.setattr(AT, "get_cache", lambda: cache)
+    paddle.set_flags({"FLAGS_use_autotune": True})
+    try:
+        q = jnp.zeros((1, 256, 2, 128), jnp.float32)
+        # interpret=False path consults the cache before any pallas call
+        scale, interp, qs, ks, bq, bkv = FA._prep(
+            q, q, True, None, False, None, None)
+        assert (bq, bkv) == (128, 256)
+    finally:
+        paddle.set_flags({"FLAGS_use_autotune": False})

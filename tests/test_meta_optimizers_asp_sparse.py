@@ -1,0 +1,201 @@
+"""Meta-optimizer family + ASP structured sparsity + sparse tensors
+(reference: fleet/meta_optimizers/{gradient_merge,lars,dgc,localsgd}_
+optimizer.py, incubate/asp/, python/paddle/sparse/ — semantics tests)."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+
+
+def _model_and_data(seed=0):
+    paddle.seed(seed)
+    m = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+    r = np.random.RandomState(0)
+    x = paddle.to_tensor(r.randn(16, 8).astype("float32"))
+    y = paddle.to_tensor(r.randn(16, 4).astype("float32"))
+    return m, x, y
+
+
+def _loss(m, x, y):
+    return paddle.mean((m(x) - y) ** 2)
+
+
+def test_gradient_merge_equals_big_batch():
+    """k accumulated micro-steps == one step on the k-x batch."""
+    from paddle_tpu.distributed.fleet.meta_optimizers import \
+        GradientMergeOptimizer
+
+    m1, x, y = _model_and_data(7)
+    snap = [np.asarray(p._value) for p in m1.parameters()]
+    opt = optimizer.SGD(learning_rate=0.1, parameters=m1.parameters())
+    gm = GradientMergeOptimizer(opt, k_steps=4)
+    for i in range(4):
+        loss = _loss(m1, x[i * 4:(i + 1) * 4], y[i * 4:(i + 1) * 4])
+        loss.backward()
+        gm.step()
+        gm.clear_grad()
+    merged = [np.asarray(p._value) for p in m1.parameters()]
+
+    m2, _, _ = _model_and_data(7)
+    for p, v in zip(m2.parameters(), snap):
+        p._value = jnp.asarray(v)
+    opt2 = optimizer.SGD(learning_rate=0.1, parameters=m2.parameters())
+    # mean over the 4 quarter-batches == mean of the 4 losses
+    loss = sum(_loss(m2, x[i * 4:(i + 1) * 4], y[i * 4:(i + 1) * 4])
+               for i in range(4)) / 4
+    loss.backward()
+    opt2.step()
+    for a, p in zip(merged, m2.parameters()):
+        np.testing.assert_allclose(a, np.asarray(p._value), rtol=1e-5,
+                                   atol=1e-6)
+
+
+def test_lars_momentum_trains_and_scales():
+    m, x, y = _model_and_data(8)
+    opt = optimizer.LarsMomentum(learning_rate=0.1, momentum=0.9,
+                                 parameters=m.parameters())
+    first = float(_loss(m, x, y))
+    for _ in range(20):
+        loss = _loss(m, x, y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    assert float(loss) < first
+
+
+def test_dgc_sparsifies_with_error_feedback():
+    from paddle_tpu.distributed.fleet.meta_optimizers import \
+        DGCMomentumOptimizer
+
+    m, x, y = _model_and_data(9)
+    opt = DGCMomentumOptimizer(learning_rate=0.05, momentum=0.9,
+                               parameters=m.parameters(), sparsity=0.75)
+    first = float(_loss(m, x, y))
+    for _ in range(30):
+        loss = _loss(m, x, y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    assert float(loss) < first  # converges despite 75% dropped entries
+    # error feedback buffers hold the dropped mass
+    assert opt._err and all(np.isfinite(np.asarray(v)).all()
+                            for v in opt._err.values())
+
+
+def test_localsgd_steps():
+    from paddle_tpu.distributed.fleet.meta_optimizers import \
+        LocalSGDOptimizer
+
+    m, x, y = _model_and_data(10)
+    opt = LocalSGDOptimizer(optimizer.SGD(learning_rate=0.1,
+                                          parameters=m.parameters()),
+                            k_steps=2)
+    first = float(_loss(m, x, y))
+    for _ in range(6):
+        loss = _loss(m, x, y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    assert float(loss) < first
+
+
+def test_asp_prune_and_guarantee():
+    from paddle_tpu.incubate import asp
+
+    m, x, y = _model_and_data(11)
+    asp.reset_excluded_layers()
+    asp.prune_model(m, n=2, m=4)
+    for name, p in m.named_parameters():
+        if p._value.ndim == 2:
+            assert asp.check_sparsity(p, n=2, m=4), name
+            assert asp.calculate_density(p) <= 0.55
+    opt = asp.decorate(optimizer.SGD(learning_rate=0.05,
+                                     parameters=m.parameters()))
+    first = float(_loss(m, x, y))
+    for _ in range(10):
+        loss = _loss(m, x, y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    assert float(loss) < first
+    # the 2:4 pattern SURVIVED the optimizer steps (the decorate
+    # contract: masks re-applied after every update)
+    for name, p in m.named_parameters():
+        if p._value.ndim == 2:
+            assert asp.check_sparsity(p, n=2, m=4), name
+
+
+def test_sparse_coo_roundtrip_and_ops():
+    import paddle_tpu.sparse as sp
+
+    dense = np.array([[0, 1, 0], [2, 0, 3]], np.float32)
+    idx = np.array([[0, 1, 1], [1, 0, 2]])
+    s = sp.sparse_coo_tensor(idx, np.array([1, 2, 3], np.float32),
+                             shape=(2, 3))
+    assert s.nnz == 3 and sp.is_sparse(s)
+    np.testing.assert_array_equal(np.asarray(s.to_dense()._value), dense)
+
+    # csr construction converges to the same layout
+    c = sp.sparse_csr_tensor([0, 1, 3], [1, 0, 2],
+                             np.array([1, 2, 3], np.float32), (2, 3))
+    np.testing.assert_array_equal(np.asarray(c.to_dense()._value), dense)
+
+    # sparse + sparse, sparse @ dense, relu, transpose
+    two = sp.add(s, s)
+    np.testing.assert_array_equal(np.asarray(two.to_dense()._value),
+                                  2 * dense)
+    d = np.random.RandomState(0).randn(3, 4).astype("float32")
+    mm = sp.matmul(s, paddle.to_tensor(d))
+    np.testing.assert_allclose(np.asarray(mm._value), dense @ d,
+                               rtol=1e-5)
+    neg = sp.sparse_coo_tensor(idx, np.array([-1, 2, -3], np.float32),
+                               shape=(2, 3))
+    np.testing.assert_array_equal(
+        np.asarray(sp.relu(neg).to_dense()._value),
+        np.maximum(np.asarray(neg.to_dense()._value), 0))
+    t = sp.transpose(s, [1, 0])
+    np.testing.assert_array_equal(np.asarray(t.to_dense()._value),
+                                  dense.T)
+
+
+def test_sparse_masked_matmul():
+    import paddle_tpu.sparse as sp
+
+    r = np.random.RandomState(1)
+    a = r.randn(4, 6).astype("float32")
+    b = r.randn(6, 5).astype("float32")
+    idx = np.array([[0, 1, 3], [2, 4, 0]])
+    mask = sp.sparse_coo_tensor(idx, np.ones(3, np.float32), (4, 5))
+    out = sp.masked_matmul(paddle.to_tensor(a), paddle.to_tensor(b), mask)
+    full = a @ b
+    got = np.asarray(out.to_dense()._value)
+    for i, j in zip(*idx):
+        np.testing.assert_allclose(got[i, j], full[i, j], rtol=1e-5)
+    assert out.nnz == 3
+
+
+def test_lars_exclude_from_weight_decay():
+    """Excluded params (by name fragment) get plain momentum — no LARS
+    scaling, no weight decay."""
+    paddle.seed(12)
+    m = nn.Linear(4, 4)
+    m.weight.name = "linear.weight"
+    m.bias.name = "linear.bias"
+    lars = optimizer.LarsMomentum(
+        learning_rate=0.1, momentum=0.0, parameters=m.parameters(),
+        lars_weight_decay=0.5, exclude_from_weight_decay=["bias"])
+    ref = optimizer.Momentum(learning_rate=0.1, momentum=0.0,
+                             parameters=[])
+    b0 = np.asarray(m.bias._value).copy()
+    x = paddle.to_tensor(np.ones((2, 4), "float32"))
+    loss = paddle.mean(m(x))
+    loss.backward()
+    g_bias = np.asarray(m.bias.grad._value).copy()
+    lars.step()
+    # excluded bias: plain SGD update (local lr 1, no decay)
+    np.testing.assert_allclose(np.asarray(m.bias._value),
+                               b0 - 0.1 * g_bias, rtol=1e-5, atol=1e-6)

@@ -1,0 +1,40 @@
+"""Host-side RPC over the native TCPStore agent (reference:
+python/paddle/distributed/rpc over the brpc agent)."""
+import json
+import os
+import socket
+import subprocess
+import sys
+
+_REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+_WORKER = os.path.join(_REPO, "tests", "workers", "rpc_worker.py")
+
+
+def test_rpc_two_workers(tmp_path):
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    procs = []
+    for rank in range(2):
+        env = dict(os.environ)
+        env["PADDLE_TRAINER_ID"] = str(rank)
+        env["PADDLE_TRAINERS_NUM"] = "2"
+        env["PADDLE_MASTER"] = f"127.0.0.1:{port}"
+        env["TEST_OUT"] = str(tmp_path / "rpc")
+        procs.append(subprocess.Popen(
+            [sys.executable, _WORKER], env=env, cwd=_REPO,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT))
+    for p in procs:
+        out, _ = p.communicate(timeout=120)
+        assert p.returncode == 0, out.decode(errors="replace")[-2000:]
+    for rank in range(2):
+        with open(str(tmp_path / "rpc") + f".{rank}") as f:
+            r = json.load(f)
+        assert r["sync"] == rank + 10
+        assert r["async"] == [0, 2, 4, 6]
+        assert r["peer_rank"] == 1 - rank
+        assert r["all"] == ["worker0", "worker1"]
+        assert r["exc"] == "remote boom"
+        # the fn executed in the PEER's process, not ours
+        assert r["self_env"] == str(1 - rank)

@@ -1,0 +1,48 @@
+"""RPC worker: one ranked process of a 2-worker RPC pod (reference
+test/legacy_test/test_rpc* pattern). Exercises rpc_sync/rpc_async/
+worker infos/remote exceptions over the TCPStore agent."""
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+from paddle_tpu.distributed import rpc  # noqa: E402
+
+
+def add(a, b):
+    return a + b
+
+
+def whoami():
+    return os.environ.get("PADDLE_TRAINER_ID")
+
+
+def boom():
+    raise ValueError("remote boom")
+
+
+def main():
+    rank = int(os.environ["PADDLE_TRAINER_ID"])
+    out = os.environ["TEST_OUT"]
+    rpc.init_rpc(name=f"worker{rank}")
+    result = {}
+    peer = f"worker{1 - rank}"
+    result["sync"] = rpc.rpc_sync(peer, add, args=(rank, 10))
+    futs = [rpc.rpc_async(peer, add, args=(i, i)) for i in range(4)]
+    result["async"] = [f.wait() for f in futs]
+    result["peer_rank"] = rpc.get_worker_info(peer).rank
+    result["all"] = sorted(w.name for w in rpc.get_all_worker_infos())
+    try:
+        rpc.rpc_sync(peer, boom)
+        result["exc"] = "none"
+    except ValueError as e:
+        result["exc"] = str(e)
+    result["self_env"] = rpc.rpc_sync(peer, whoami)
+    with open(f"{out}.{rank}", "w") as f:
+        json.dump(result, f)
+    rpc.shutdown()
+
+
+if __name__ == "__main__":
+    main()
