@@ -9,9 +9,14 @@ __all__ = ["backward", "no_grad", "enable_grad", "is_grad_enabled",
 
 def grad(outputs, inputs, grad_outputs=None, retain_graph=False,
          create_graph=False, allow_unused=False):
-    """paddle.grad — compute grads of outputs wrt inputs without touching
-    .grad of other leaves is NOT replicated exactly: we snapshot and restore
-    leaf grads, which matches observable semantics for the common cases."""
+    """paddle.grad — compute grads of outputs wrt inputs. With
+    ``create_graph=True`` the returned grads are themselves recorded on
+    the tape, so a second backward differentiates through them
+    (higher-order AD; see engine.backward_create_graph). Leaf .grad of
+    other tensors is snapshot/restored, matching observable semantics
+    for the common cases."""
+    from .engine import backward_create_graph
+
     if not isinstance(outputs, (list, tuple)):
         outputs = [outputs]
     if not isinstance(inputs, (list, tuple)):
@@ -19,9 +24,14 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=False,
     saved = [t.grad for t in inputs]
     for t in inputs:
         t.grad = None
-    backward(list(outputs), grad_outputs if isinstance(grad_outputs, (list, tuple))
-             else ([grad_outputs] * len(outputs) if grad_outputs is not None else None),
-             retain_graph=retain_graph)
+    gts = grad_outputs if isinstance(grad_outputs, (list, tuple)) else (
+        [grad_outputs] * len(outputs) if grad_outputs is not None else None)
+    if create_graph:
+        # only-inputs semantics: other leaves' .grad stays untouched
+        backward_create_graph(list(outputs), gts,
+                              leaf_filter={id(t) for t in inputs})
+    else:
+        backward(list(outputs), gts, retain_graph=retain_graph)
     grads = [t.grad for t in inputs]
     for t, s in zip(inputs, saved):
         t.grad = s

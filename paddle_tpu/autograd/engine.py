@@ -35,7 +35,25 @@ __all__ = [
     "is_grad_enabled",
     "set_grad_enabled",
     "record_op",
+    "register_backward_end_callback",
+    "unregister_backward_end_callback",
 ]
+
+# callbacks fired after every backward() completes (e.g. the bucketed
+# DataParallel Reducer flushes leftover partial buckets here — the
+# analog of the reference Reducer's finalize_backward)
+_backward_end_callbacks: List = []
+
+
+def register_backward_end_callback(cb) -> None:
+    _backward_end_callbacks.append(cb)
+
+
+def unregister_backward_end_callback(cb) -> None:
+    try:
+        _backward_end_callbacks.remove(cb)
+    except ValueError:
+        pass
 
 _state = threading.local()
 
@@ -259,6 +277,9 @@ def backward(tensors: Sequence, grad_tensors: Optional[Sequence] = None,
                 if pending[producer] == 0:
                     queue.append(producer)
 
+    for cb in list(_backward_end_callbacks):
+        cb()
+
     if not retain_graph:
         for node in processed:
             node.release()
@@ -279,3 +300,259 @@ def _accumulate_leaf(t, g) -> None:
         t.grad = Tensor(g, stop_gradient=True)
     else:
         t.grad = Tensor(t.grad._value + g, stop_gradient=True)
+
+
+# ---------------------------------------------------------------------------
+# Higher-order backward (create_graph)
+# ---------------------------------------------------------------------------
+
+
+class _TapedFnNode(GradNode):
+    """A grad-of-grad node: stores a PURE fn + operand values, so it can
+    be applied (first order) or re-taped (any higher order) — the
+    replayable analog of the reference's generated double_grad nodes."""
+
+    __slots__ = ("fn",)
+
+    def __init__(self, name, fn, in_values, out_values, edges):
+        self.name = name
+        self.fn = fn
+        self.call = None
+        self.in_values = tuple(in_values)
+        self.out_values = out_values if isinstance(out_values, tuple) \
+            else (out_values,)
+        self.edges = edges
+        self.n_outputs = len(self.out_values)
+        self._hooks = None
+
+    def apply(self, out_grads):
+        import jax
+
+        if self.fn is None:
+            raise RuntimeError(
+                f"backward through {self.name} a second time: the graph "
+                "was released; pass retain_graph=True to keep it")
+        full = tuple(
+            g if g is not None else jnp.zeros_like(v)
+            for g, v in zip(out_grads, self.out_values))
+        _, vjp_fn = jax.vjp(lambda *a: self.fn(*a), *self.in_values)
+        grads = vjp_fn(full)
+        return tuple(
+            None if (g is None or g.dtype == jax.dtypes.float0) else g
+            for g in grads)
+
+    def release(self):
+        self.fn = None
+        self.in_values = None
+        self.out_values = None
+        self.edges = ()
+
+
+def _tensor_view(val, edge):
+    """A Tensor aliasing a recorded input value, wired back into the
+    tape via its edge — gives the second-order graph a path to the
+    original producers/leaves."""
+    from ..tensor import Tensor
+
+    if edge is None:
+        return Tensor(val, stop_gradient=True)
+    if edge[0] == "leaf":
+        return edge[1]
+    t = Tensor(val, stop_gradient=False)
+    t._grad_node = edge[1]
+    t._out_idx = edge[2]
+    return t
+
+
+def backward_create_graph(tensors: Sequence,
+                          grad_tensors: Optional[Sequence] = None,
+                          leaf_filter=None) -> None:
+    """Reverse accumulation where the computed grads are THEMSELVES
+    recorded on the tape, so further ``backward``/``grad`` calls
+    differentiate through them to ANY order (reference: the double_grad
+    node generation of eager_gen — grad ops recorded like forward ops).
+
+    Per-node construction: the map (saved_inputs, out_grads) ->
+    in_grads is a pure jax function (re-running the forward ties the
+    saved outputs to the inputs), so each first-order grad is emitted
+    as a replayable :class:`_TapedFnNode` whose own grads follow the
+    same construction recursively. Supported for the registered-op
+    tape; custom-backward nodes (PyLayer, collectives, pipeline) raise.
+
+    ``leaf_filter``: optional set of tensor ids — only those leaves
+    accumulate (paddle.grad's only-inputs semantics).
+    """
+    from ..tensor import Tensor
+
+    if grad_tensors is None:
+        grad_tensors = [None] * len(tensors)
+
+    buffers = {}    # node -> per-output-slot accumulated grad TENSORS
+    pending = {}
+    roots = []
+
+    def add_grad(buf, idx, gt):
+        buf[idx] = gt if buf[idx] is None else buf[idx] + gt
+
+    def leaf_acc(t, gt):
+        if leaf_filter is not None and id(t) not in leaf_filter:
+            return
+        _accumulate_leaf_tensor(t, gt)
+
+    def seed(t, g):
+        if g is None:
+            g = Tensor(jnp.ones_like(t._value), stop_gradient=True)
+        elif not isinstance(g, Tensor):
+            g = Tensor(jnp.asarray(g), stop_gradient=True)
+        if t._grad_node is None:
+            if not t.stop_gradient:
+                leaf_acc(t, g)
+            return
+        node, idx = t._grad_node, t._out_idx
+        buf = buffers.setdefault(node, [None] * node.n_outputs)
+        add_grad(buf, idx, g)
+        roots.append(node)
+
+    for t, g in zip(tensors, grad_tensors):
+        seed(t, g)
+
+    visited = set()
+    stack = list(roots)
+    while stack:
+        node = stack.pop()
+        if id(node) in visited:
+            continue
+        visited.add(id(node))
+        pending.setdefault(node, 0)
+        for e in node.edges:
+            if e is not None and e[0] == "node":
+                pending[e[1]] = pending.get(e[1], 0) + 1
+                stack.append(e[1])
+
+    queue = deque(n for n in pending if pending[n] == 0)
+    while queue:
+        node = queue.popleft()
+        out_gts = buffers.pop(node, [None] * node.n_outputs)
+        in_gts = _apply_taped(node, out_gts)
+        if node._hooks:
+            for hook in node._hooks:
+                hook()
+        for e, gt in zip(node.edges, in_gts):
+            if e is None or gt is None:
+                continue
+            if e[0] == "leaf":
+                leaf_acc(e[1], gt)
+            else:
+                producer, idx = e[1], e[2]
+                buf = buffers.setdefault(producer,
+                                         [None] * producer.n_outputs)
+                add_grad(buf, idx, gt)
+                pending[producer] -= 1
+                if pending[producer] == 0:
+                    queue.append(producer)
+    # create_graph implies the graph stays alive (no release)
+
+
+def _node_pure_fn(node: GradNode):
+    """The node's backward as a PURE function of (operand values,
+    out-grad values) -> tuple of in-grads."""
+    import jax
+
+    from ..core.registry import run_grad as _run_grad
+
+    if isinstance(node, _TapedFnNode):
+        fn = node.fn
+
+        def pure(ivals, ogs):
+            _, vjp_fn = jax.vjp(lambda *a: fn(*a), *ivals)
+            grads = vjp_fn(tuple(ogs))
+            return tuple(
+                jnp.zeros_like(iv) if (
+                    g is None or g.dtype == jax.dtypes.float0) else g
+                for iv, g in zip(ivals, grads))
+
+        return pure
+
+    call = node.call
+    multi = node.n_outputs > 1
+
+    def pure(ivals, ogs):
+        outs = call.flat_fn(*ivals)  # re-tie outputs to inputs
+        grads = _run_grad(call, ivals, outs,
+                          tuple(ogs) if multi else ogs[0])
+        return tuple(
+            jnp.zeros_like(iv) if g is None else g
+            for iv, g in zip(ivals, grads))
+
+    return pure
+
+
+def _apply_taped(node: GradNode, out_grad_tensors):
+    """Compute a node's input grads as RECORDED Tensors whose own
+    backward is a replayable _TapedFnNode (recursion-closed: works for
+    grad-of-grad nodes too, enabling arbitrary order)."""
+    import jax
+
+    from ..tensor import Tensor
+
+    if isinstance(node, _CustomNode):
+        raise NotImplementedError(
+            f"create_graph through '{node.name}': custom-backward nodes "
+            "(PyLayer, collectives, pipeline) save value closures that "
+            "cannot be re-differentiated w.r.t. the forward inputs; "
+            "express the computation with registered ops for "
+            "higher-order gradients")
+    if node.call is None and not isinstance(node, _TapedFnNode):
+        raise RuntimeError(
+            f"backward through {node.name} a second time: the graph was "
+            "released; use retain_graph/create_graph on the first pass")
+
+    og_full = tuple(
+        (g._value if isinstance(g, Tensor) else g)
+        if g is not None else jnp.zeros_like(v)
+        for g, v in zip(out_grad_tensors, node.out_values))
+    ivals = tuple(node.in_values)
+    n_in = len(ivals)
+    pure = _node_pure_fn(node)
+
+    def flat_fn(*a):
+        return pure(a[:n_in], a[n_in:])
+
+    out_vals = flat_fn(*(ivals + og_full))
+
+    in_views = [_tensor_view(v, e) for v, e in zip(ivals, node.edges)]
+    og_tensors = [
+        g if isinstance(g, Tensor) else Tensor(v, stop_gradient=True)
+        for g, v in zip(out_grad_tensors, og_full)]
+    out_tensors = [Tensor(v, stop_gradient=False) for v in out_vals]
+
+    # record the replayable grad-of-grad node (edges like record_custom)
+    operand_tensors = in_views + og_tensors
+    edges = []
+    for t in operand_tensors:
+        if t is None or t.stop_gradient:
+            edges.append(None)
+        elif t._grad_node is not None:
+            edges.append(("node", t._grad_node, t._out_idx))
+        else:
+            edges.append(("leaf", t))
+    gnode = _TapedFnNode(f"{node.name}_grad", flat_fn,
+                         ivals + og_full, tuple(out_vals), edges)
+    for i, t in enumerate(out_tensors):
+        t._grad_node = gnode
+        t._out_idx = i
+    # inputs that don't require grad yield None (parity with apply())
+    return [t if e is not None else None
+            for t, e in zip(out_tensors, node.edges)]
+
+
+def _accumulate_leaf_tensor(t, gt) -> None:
+    if t._grad_hooks:
+        for hook in t._grad_hooks:
+            res = hook(gt)
+            if res is not None:
+                gt = res
+    if t.grad is None:
+        t.grad = gt
+    else:
+        t.grad = t.grad + gt

@@ -9,7 +9,7 @@ from . import collective  # noqa: F401
 from . import runtime  # noqa: F401
 from .collective import (  # noqa: F401
     all_gather, all_gather_object, all_reduce, all_to_all, barrier,
-    broadcast, broadcast_object_list, get_group,
+    broadcast, broadcast_object_list, gather_object, get_group,
     get_rank, get_world_size, in_spmd_region, init_parallel_env, irecv,
     isend, new_group, recv, reduce, reduce_scatter, scatter, send,
     spmd_region, ReduceOp, Group, ProcessGroup, split_group)

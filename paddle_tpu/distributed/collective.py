@@ -41,6 +41,7 @@ __all__ = [
     "reduce", "scatter", "send", "recv", "isend", "irecv", "barrier",
     "spmd_region", "in_spmd_region", "split_group", "stream",
     "all_reduce_mean_value", "wait", "ppermute", "axis_index",
+    "gather_object",
 ]
 
 
@@ -619,6 +620,14 @@ def all_gather_object(object_list, obj, group=None):
 
     object_list.extend(_rt.all_gather_object_host(obj))
     return object_list
+
+
+def gather_object(obj, dst: int = 0, group=None):
+    """Gather picklable objects on ``dst`` only (others get None) —
+    the O(world)-at-root counterpart of all_gather_object."""
+    from . import runtime as _rt
+
+    return _rt.gather_object_host(obj, dst=dst)
 
 
 def broadcast_object_list(object_list, src: int = 0, group=None):

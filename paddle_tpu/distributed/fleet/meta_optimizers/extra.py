@@ -142,20 +142,32 @@ class LocalSGDOptimizer:
             self.sync_params()
 
     def sync_params(self):
-        from ...runtime import process_world
+        """rank-0 reduce + broadcast over the host object channel —
+        O(P) traffic/memory per non-root host (an N-way all-gather of
+        every parameter would be O(N x P) on every host)."""
+        from ...runtime import process_rank, process_world
 
-        if process_world() <= 1:
+        world = process_world()
+        if world <= 1:
             return
         import numpy as np
 
-        from ... import all_gather_object
+        from ... import broadcast_object_list, gather_object
 
+        rank = process_rank()
         for p in self._inner_opt._parameter_list:
-            if p is not None and p.trainable:
-                outs = []
-                all_gather_object(outs, np.asarray(p._value))
-                p._value = jnp.asarray(
-                    np.mean(np.stack(outs), axis=0), p._value.dtype)
+            if p is None or not p.trainable:
+                continue
+            gathered = gather_object(np.asarray(p._value), dst=0)
+            if rank == 0:
+                acc = gathered[0].astype(np.float64)
+                for g in gathered[1:]:
+                    acc += g
+                mean = [(acc / world).astype(np.asarray(p._value).dtype)]
+            else:
+                mean = [None]
+            broadcast_object_list(mean, src=0)
+            p._value = jnp.asarray(mean[0], p._value.dtype)
 
     def clear_grad(self, set_to_zero: bool = False):
         self._inner_opt.clear_grad(set_to_zero)

@@ -97,6 +97,16 @@ class Reducer:
     def num_buckets(self) -> int:
         return len(self._buckets)
 
+    def flush(self):
+        """End-of-backward: reduce leftover partial buckets (reused
+        params' late partials; buckets starved by grad-less params)."""
+        if not self._enabled:
+            for pend in self._pending:
+                pend.clear()
+            return
+        for bi in range(len(self._buckets)):
+            self._reduce_pending(bi)
+
     def hook_for(self, p):
         bi = self._bucket_of[id(p)]
 
@@ -108,14 +118,29 @@ class Reducer:
         return hook
 
     def _arrive(self, bi, p, grad: Tensor) -> Tensor:
-        import jax.numpy as jnp
-
         bucket = self._buckets[bi]
         pend = self._pending[bi]
-        pend[id(p)] = grad._value
+        # ACCUMULATE: a reused parameter (tied weights) delivers several
+        # partial grads per backward; reduction is linear, so partials
+        # reduced in separate rounds still sum correctly
+        prev = pend.get(id(p))
+        pend[id(p)] = grad._value if prev is None else prev + grad._value
         if len(pend) < len(bucket):
-            return grad  # provisional; overwritten when the bucket fires
+            return grad  # provisional; swapped when the bucket fires
         # bucket complete: ONE fused allreduce over the flattened grads
+        return self._reduce_pending(bi, p, grad._value)
+
+    def _reduce_pending(self, bi, p=None, p_cur=None):
+        """Fused-reduce whatever partials are pending in bucket ``bi``
+        and swap them into the owners' .grad. Called on bucket
+        completion and from the end-of-backward flush (which covers
+        reused/unused-parameter leftovers)."""
+        import jax.numpy as jnp
+
+        pend = self._pending[bi]
+        if not pend:
+            return None
+        bucket = [q for q in self._buckets[bi] if id(q) in pend]
         vals = [pend[id(q)] for q in bucket]
         flat = jnp.concatenate([v.reshape(-1).astype(jnp.float32)
                                 for v in vals])
@@ -130,9 +155,12 @@ class Reducer:
             piece = rv[off:off + n].reshape(v.shape).astype(v.dtype)
             off += n
             if q is p:
-                # hook return: the engine accumulates it onto any
-                # previously-accumulated grad itself
-                out = Tensor(piece, stop_gradient=True)
+                # hook return: the engine adds it onto p.grad, which
+                # already holds any EARLIER provisional partials of p
+                # from this pass (v - p_cur) — subtract them so the
+                # reduced total lands exactly once
+                prior = v - p_cur
+                out = Tensor(piece - prior, stop_gradient=True)
             else:
                 # q.grad currently holds prior-accumulation + this
                 # pass's provisional local grad — swap only the
@@ -168,6 +196,12 @@ class DataParallel(Layer):
         for p in layers.parameters():
             if p.trainable:
                 p.register_hook(self._reducer.hook_for(p))
+        # end-of-backward flush: reduces leftover partials (reused
+        # params, buckets starved by grad-less params) — the reference
+        # Reducer's finalize_backward
+        from ..autograd.engine import register_backward_end_callback
+
+        register_backward_end_callback(self._reducer.flush)
 
     def forward(self, *inputs, **kwargs):
         return self._layers(*inputs, **kwargs)

@@ -65,8 +65,8 @@ class _RpcAgent:
         self.world = world_size
         self.store = store
         self._stop = threading.Event()
-        self._served = 0
-        self._send_seq: Dict[int, int] = {}
+        self._served = [0] * world_size   # next expected seq PER SENDER
+        self._send_seq: Dict[int, int] = {}  # sender-local counters
         store.set(f"rpc/name2rank/{name}", str(rank))
         store.set(f"rpc/rank2name/{rank}", name)
         self._server = threading.Thread(target=self._serve, daemon=True)
@@ -76,29 +76,36 @@ class _RpcAgent:
     # -- serving --------------------------------------------------------
     def _serve(self):
         while not self._stop.is_set():
-            key = f"rpc/req/{self.rank}/{self._served}"
-            if not self.store.check(key):
+            progressed = False
+            for src in range(self.world):
+                key = (f"rpc/req/{self.rank}/{src}/"
+                       f"{self._served[src]}")
+                if not self.store.check(key):
+                    continue
+                progressed = True
+                self._serve_one(src, key)
+            if not progressed:
                 time.sleep(_POLL)
-                continue
-            src, seq, fn, args, kwargs = pickle.loads(
-                self.store.get(key))
-            try:
-                result, exc = fn(*args, **kwargs), None
-            except BaseException as e:  # delivered to the caller
-                result, exc = None, e
-            try:
-                payload = pickle.dumps((result, exc), protocol=4)
-            except Exception as pe:
-                # unpicklable result/exception must not kill the serve
-                # loop — deliver a picklable error instead
-                payload = pickle.dumps(
-                    (None, RuntimeError(
-                        f"rpc result not picklable: {pe!r}; "
-                        f"result={result!r:.200}, exc={exc!r:.200}")),
-                    protocol=4)
-            self.store.set(f"rpc/res/{src}/{self.rank}/{seq}", payload)
-            self.store.delete_key(key)
-            self._served += 1
+
+    def _serve_one(self, src_expected, key):
+        src, seq, fn, args, kwargs = pickle.loads(self.store.get(key))
+        try:
+            result, exc = fn(*args, **kwargs), None
+        except BaseException as e:  # delivered to the caller
+            result, exc = None, e
+        try:
+            payload = pickle.dumps((result, exc), protocol=4)
+        except Exception as pe:
+            # unpicklable result/exception must not kill the serve
+            # loop — deliver a picklable error instead
+            payload = pickle.dumps(
+                (None, RuntimeError(
+                    f"rpc result not picklable: {pe!r}; "
+                    f"result={result!r:.200}, exc={exc!r:.200}")),
+                protocol=4)
+        self.store.set(f"rpc/res/{src}/{self.rank}/{seq}", payload)
+        self.store.delete_key(key)
+        self._served[src] += 1
 
     # -- calling --------------------------------------------------------
     def _rank_of(self, to: str) -> int:
@@ -106,10 +113,11 @@ class _RpcAgent:
 
     def call(self, to: str, fn, args, kwargs, timeout) -> _Future:
         dst = self._rank_of(to)
-        # per-destination GLOBAL sequence via the store's atomic add —
-        # serving executes strictly in this order
-        seq = self.store.add(f"rpc/seq/{dst}", 1) - 1
-        self.store.set(f"rpc/req/{dst}/{seq}", pickle.dumps(
+        # SENDER-LOCAL sequence: no store round-trip to allocate, and a
+        # caller dying mid-send can only stall its own stream
+        seq = self._send_seq.get(dst, 0)
+        self._send_seq[dst] = seq + 1
+        self.store.set(f"rpc/req/{dst}/{self.rank}/{seq}", pickle.dumps(
             (self.rank, seq, fn, tuple(args or ()), dict(kwargs or {})),
             protocol=4))
         fut = _Future()

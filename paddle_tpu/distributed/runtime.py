@@ -27,6 +27,7 @@ from typing import Any, List, Optional
 __all__ = [
     "ensure_initialized", "is_multiprocess", "process_rank",
     "process_world", "host_barrier", "all_gather_object_host",
+    "gather_object_host",
     "broadcast_object_host", "send_object", "recv_object",
 ]
 
@@ -109,6 +110,25 @@ def all_gather_object_host(obj: Any,
     # reusable barrier name — see host_barrier)
     store.barrier("og", world, timeout)
     store.delete_key(f"og/{gen}/{rank}")
+    return out
+
+
+def gather_object_host(obj: Any, dst: int = 0,
+                       timeout: Optional[float] = None):
+    """Gather one picklable object from every process ON ``dst`` only
+    (others return None) — O(world x obj) at the root, O(obj)
+    elsewhere, unlike all_gather."""
+    if not is_multiprocess():
+        return [obj]
+    store, gen = _store(), _next_gen()
+    rank, world = process_rank(), process_world()
+    store.set(f"go/{gen}/{rank}", pickle.dumps(obj, protocol=4))
+    out = None
+    if rank == dst:
+        out = [pickle.loads(store.get(f"go/{gen}/{r}", timeout))
+               for r in range(world)]
+    store.barrier("go", world, timeout)
+    store.delete_key(f"go/{gen}/{rank}")
     return out
 
 

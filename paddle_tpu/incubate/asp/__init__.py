@@ -21,8 +21,10 @@ __all__ = ["prune_model", "decorate", "calculate_density",
            "check_sparsity", "reset_excluded_layers",
            "set_excluded_layers"]
 
-_masks: Dict[int, jnp.ndarray] = {}
-_excluded: set = set()
+# masks hold a STRONG ref to their parameter: id() keys alone could be
+# recycled by a GC'd model and silently mask an unrelated tensor
+_masks: Dict[int, tuple] = {}     # id -> (param, mask)
+_excluded: Dict[int, object] = {}  # id -> param
 
 
 def calculate_density(x) -> float:
@@ -64,11 +66,15 @@ def set_excluded_layers(model, layer_names: List[str]) -> None:
     for name, sub in model.named_sublayers():
         if name in layer_names:
             for p in sub.parameters(include_sublayers=False):
-                _excluded.add(id(p))
+                _excluded[id(p)] = p
 
 
 def reset_excluded_layers(model=None) -> None:
-    _excluded.clear()
+    if model is None:
+        _excluded.clear()
+        return
+    for p in model.parameters():
+        _excluded.pop(id(p), None)
 
 
 def _prunable(name: str, p) -> bool:
@@ -89,7 +95,7 @@ def prune_model(model: Layer, n: int = 2, m: int = 4,
         mask = _nm_mask(w, n, m)
         p._value = jnp.asarray(w * mask, p._value.dtype)
         if with_mask:
-            _masks[id(p)] = jnp.asarray(mask, p._value.dtype)
+            _masks[id(p)] = (p, jnp.asarray(mask, p._value.dtype))
             masks[name] = mask
     return masks
 
@@ -108,9 +114,9 @@ class _ASPOptimizer:
     def step(self):
         self._inner_opt.step()
         for p in self._inner_opt._parameter_list or []:
-            mask = _masks.get(id(p))
-            if mask is not None:
-                p._value = p._value * mask
+            entry = _masks.get(id(p))
+            if entry is not None and entry[0] is p:  # identity-checked
+                p._value = p._value * entry[1]
 
     def clear_grad(self, set_to_zero: bool = False):
         self._inner_opt.clear_grad(set_to_zero)
