@@ -49,7 +49,15 @@ from . import inference  # noqa: F401
 from . import utils  # noqa: F401
 from . import linalg  # noqa: F401
 from . import fft  # noqa: F401
+from . import signal  # noqa: F401
 from . import sparse  # noqa: F401
+from . import geometric  # noqa: F401
+from . import text  # noqa: F401
+from . import audio  # noqa: F401
+from . import regularizer  # noqa: F401
+from . import sysconfig  # noqa: F401
+from . import onnx  # noqa: F401
+from . import hub  # noqa: F401
 from .hapi import Model  # noqa: F401
 
 # paddle-API aliases

@@ -1,0 +1,7 @@
+"""paddle.audio analog (reference: python/paddle/audio/ — functional
+windows + mel utilities and feature layers built on paddle.signal.stft;
+backends/datasets are file-IO helpers outside the compute scope).
+"""
+from . import features, functional  # noqa: F401
+
+__all__ = ["functional", "features"]

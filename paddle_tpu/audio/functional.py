@@ -1,0 +1,193 @@
+"""Audio functional ops (reference: python/paddle/audio/functional/
+functional.py + window.py). Pure jnp formulas — every window/filterbank
+is built on device and constant-folded into the surrounding XLA program.
+"""
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+
+from ..core.dispatch import def_op
+from ..core.enforce import enforce
+
+__all__ = ["hz_to_mel", "mel_to_hz", "mel_frequencies", "fft_frequencies",
+           "compute_fbank_matrix", "power_to_db", "create_dct",
+           "get_window"]
+
+
+def _hz_to_mel_val(freq, htk):
+    if htk:
+        return 2595.0 * jnp.log10(1.0 + jnp.asarray(freq) / 700.0)
+    f = jnp.asarray(freq, jnp.float32)
+    f_sp = 200.0 / 3
+    mels = f / f_sp
+    min_log_hz = 1000.0
+    logstep = math.log(6.4) / 27.0
+    return jnp.where(f >= min_log_hz,
+                     min_log_hz / f_sp + jnp.log(
+                         jnp.maximum(f, min_log_hz) / min_log_hz) / logstep,
+                     mels)
+
+
+def _mel_to_hz_val(mel, htk):
+    if htk:
+        return 700.0 * (10.0 ** (jnp.asarray(mel) / 2595.0) - 1.0)
+    m = jnp.asarray(mel, jnp.float32)
+    f_sp = 200.0 / 3
+    min_log_mel = 1000.0 / f_sp
+    logstep = math.log(6.4) / 27.0
+    return jnp.where(m >= min_log_mel,
+                     1000.0 * jnp.exp(logstep * (
+                         jnp.maximum(m, min_log_mel) - min_log_mel)),
+                     f_sp * m)
+
+
+@def_op("hz_to_mel", differentiable=False)
+def hz_to_mel(freq, htk=False):
+    return _hz_to_mel_val(freq, bool(htk))
+
+
+@def_op("mel_to_hz", differentiable=False)
+def mel_to_hz(mel, htk=False):
+    return _mel_to_hz_val(mel, bool(htk))
+
+
+def mel_frequencies(n_mels=64, f_min=0.0, f_max=11025.0, htk=False,
+                    dtype="float32"):
+    lo = _hz_to_mel_val(f_min, htk)
+    hi = _hz_to_mel_val(f_max, htk)
+    mels = jnp.linspace(lo, hi, int(n_mels))
+    from ..tensor import to_tensor
+
+    return to_tensor(_mel_to_hz_val(mels, htk).astype(dtype))
+
+
+def fft_frequencies(sr, n_fft, dtype="float32"):
+    from ..tensor import to_tensor
+
+    return to_tensor(jnp.linspace(
+        0.0, float(sr) / 2, 1 + int(n_fft) // 2).astype(dtype))
+
+
+def compute_fbank_matrix(sr, n_fft, n_mels=64, f_min=0.0, f_max=None,
+                         htk=False, norm="slaney", dtype="float32"):
+    """[n_mels, 1 + n_fft//2] triangular mel filterbank."""
+    if f_max is None:
+        f_max = float(sr) / 2
+    fft_f = jnp.linspace(0.0, float(sr) / 2, 1 + int(n_fft) // 2)
+    lo = _hz_to_mel_val(f_min, htk)
+    hi = _hz_to_mel_val(f_max, htk)
+    mel_f = _mel_to_hz_val(jnp.linspace(lo, hi, int(n_mels) + 2), htk)
+
+    fdiff = jnp.diff(mel_f)
+    ramps = mel_f[:, None] - fft_f[None, :]          # [n_mels+2, F]
+    lower = -ramps[:-2] / fdiff[:-1][:, None]
+    upper = ramps[2:] / fdiff[1:][:, None]
+    fb = jnp.maximum(0.0, jnp.minimum(lower, upper))  # [n_mels, F]
+    if norm == "slaney":
+        enorm = 2.0 / (mel_f[2:] - mel_f[:-2])
+        fb = fb * enorm[:, None]
+    from ..tensor import to_tensor
+
+    return to_tensor(fb.astype(dtype))
+
+
+@def_op("power_to_db")
+def power_to_db(x, ref_value=1.0, amin=1e-10, top_db=80.0):
+    enforce(float(amin) > 0, lambda: "amin must be strictly positive")
+    log_spec = 10.0 * jnp.log10(jnp.maximum(x, amin))
+    log_spec = log_spec - 10.0 * math.log10(max(float(amin),
+                                                float(ref_value)))
+    if top_db is not None:
+        enforce(float(top_db) >= 0, lambda: "top_db must be non-negative")
+        log_spec = jnp.maximum(log_spec, jnp.max(log_spec) - float(top_db))
+    return log_spec
+
+
+def create_dct(n_mfcc, n_mels, norm="ortho", dtype="float32"):
+    """[n_mels, n_mfcc] DCT-II transform matrix."""
+    n = jnp.arange(int(n_mels), dtype=jnp.float32)
+    k = jnp.arange(int(n_mfcc), dtype=jnp.float32)
+    dct = jnp.cos(math.pi / n_mels * (n[:, None] + 0.5) * k[None, :])
+    if norm == "ortho":
+        scale = jnp.full((int(n_mfcc),), math.sqrt(2.0 / n_mels))
+        scale = scale.at[0].set(math.sqrt(1.0 / n_mels))
+        dct = dct * scale[None, :]
+    else:
+        dct = dct * 2.0
+    from ..tensor import to_tensor
+
+    return to_tensor(dct.astype(dtype))
+
+
+def _window_values(name, M, fftbins, **kwargs):
+    """Periodic (fftbins=True) or symmetric window of length M."""
+    sym_len = M + 1 if fftbins else M
+    n = jnp.arange(sym_len, dtype=jnp.float32)
+    if sym_len == 1:
+        w = jnp.ones((1,))
+    elif name == "hann":
+        w = 0.5 - 0.5 * jnp.cos(2 * math.pi * n / (sym_len - 1))
+    elif name == "hamming":
+        w = 0.54 - 0.46 * jnp.cos(2 * math.pi * n / (sym_len - 1))
+    elif name == "blackman":
+        x = 2 * math.pi * n / (sym_len - 1)
+        w = 0.42 - 0.5 * jnp.cos(x) + 0.08 * jnp.cos(2 * x)
+    elif name in ("bartlett", "triang"):
+        if name == "bartlett":
+            w = 1.0 - jnp.abs(2 * n / (sym_len - 1) - 1.0)
+        else:
+            m = (sym_len + 1) // 2
+            ramp = (jnp.arange(1, m + 1) - 0.5 * ((sym_len + 1) % 2)) \
+                / ((sym_len + (sym_len % 2)) / 2.0)
+            ramp = jnp.minimum(ramp, 1.0)
+            w = jnp.concatenate(
+                [ramp, ramp[::-1][(1 if sym_len % 2 else 0):]])[:sym_len]
+    elif name == "cosine":
+        w = jnp.sin(math.pi / sym_len * (n + 0.5))
+    elif name == "bohman":
+        x = jnp.abs(2 * n / (sym_len - 1) - 1.0)
+        w = (1 - x) * jnp.cos(math.pi * x) + jnp.sin(math.pi * x) / math.pi
+    elif name == "gaussian":
+        std = kwargs.get("std", 7.0)
+        center = (sym_len - 1) / 2.0
+        w = jnp.exp(-0.5 * ((n - center) / std) ** 2)
+    elif name == "exponential":
+        tau = kwargs.get("tau", 1.0)
+        center = (sym_len - 1) / 2.0
+        w = jnp.exp(-jnp.abs(n - center) / tau)
+    elif name == "tukey":
+        alpha = kwargs.get("alpha", 0.5)
+        if alpha <= 0:
+            w = jnp.ones((sym_len,))
+        elif alpha >= 1:
+            w = 0.5 - 0.5 * jnp.cos(2 * math.pi * n / (sym_len - 1))
+        else:
+            edge = alpha * (sym_len - 1) / 2.0
+            left = 0.5 * (1 + jnp.cos(math.pi * (n / edge - 1)))
+            right = 0.5 * (1 + jnp.cos(
+                math.pi * ((n - (sym_len - 1)) / edge + 1)))
+            w = jnp.where(n < edge, left,
+                          jnp.where(n > sym_len - 1 - edge, right, 1.0))
+    else:
+        raise ValueError(f"unsupported window {name!r}")
+    return w[:M] if fftbins else w
+
+
+def get_window(window, win_length, fftbins=True, dtype="float32"):
+    """Window by name or (name, param) tuple (reference: audio/
+    functional/window.py get_window)."""
+    kwargs = {}
+    if isinstance(window, tuple):
+        name = window[0]
+        if len(window) > 1:
+            key = {"gaussian": "std", "exponential": "tau",
+                   "tukey": "alpha"}.get(name, "param")
+            kwargs[key] = window[1]
+    else:
+        name = window
+    w = _window_values(name, int(win_length), bool(fftbins), **kwargs)
+    from ..tensor import to_tensor
+
+    return to_tensor(w.astype(dtype))

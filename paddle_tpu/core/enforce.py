@@ -79,10 +79,14 @@ class FatalError(EnforceNotMet):
     pass
 
 
-def enforce(cond: bool, msg: str, err: type = PreconditionNotMetError) -> None:
-    """Analog of PADDLE_ENFORCE(cond, msg)."""
+def enforce(cond: bool, msg, err: type = PreconditionNotMetError) -> None:
+    """Analog of PADDLE_ENFORCE(cond, msg).
+
+    ``msg`` may be a zero-arg callable for messages that are costly to
+    build (evaluated only on failure).
+    """
     if not cond:
-        raise err(msg)
+        raise err(msg() if callable(msg) else msg)
 
 
 def enforce_eq(a, b, msg: str = "", err: type = InvalidArgumentError) -> None:

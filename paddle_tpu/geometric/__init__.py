@@ -1,0 +1,19 @@
+"""Graph-learning ops (paddle.geometric analog).
+
+(reference: python/paddle/geometric/ — math.py segment ops over phi
+segment_pool kernels, message_passing/send_recv.py graph_send_recv
+CUDA kernels, reindex.py, sampling/neighbors.py. Here the gather/
+scatter pairs lower to XLA scatter-add/min/max HLOs — TPU-native,
+differentiable; data-dependent sampling/reindex run host-side by
+design since their output shapes are data-dependent and cannot live
+inside a traced XLA program.)
+"""
+from .math import (segment_max, segment_mean, segment_min,  # noqa: F401
+                   segment_sum)
+from .message_passing import send_u_recv, send_ue_recv, send_uv  # noqa: F401
+from .reindex import reindex_graph  # noqa: F401
+from .sampling import sample_neighbors  # noqa: F401
+
+__all__ = ["segment_sum", "segment_mean", "segment_min", "segment_max",
+           "send_u_recv", "send_ue_recv", "send_uv", "reindex_graph",
+           "sample_neighbors"]

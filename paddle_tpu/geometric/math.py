@@ -1,0 +1,82 @@
+"""Segment reductions (reference: python/paddle/geometric/math.py over
+phi segment_pool kernels). Each lowers to one XLA scatter-combine HLO.
+The output row count is data-dependent (``max(segment_ids)+1``), so it
+is read on host before tracing and baked into the compiled program as a
+static shape — the XLA contract for data-dependent output shapes.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import def_op
+from ..core.enforce import enforce
+from ..tensor import Tensor
+
+__all__ = ["segment_sum", "segment_mean", "segment_min", "segment_max"]
+
+
+def _host_num_segments(segment_ids):
+    ids = np.asarray(segment_ids._value if isinstance(segment_ids, Tensor)
+                     else segment_ids)
+    enforce(ids.ndim == 1,
+            lambda: f"segment_ids must be 1-D, got rank {ids.ndim}")
+    return int(ids.max()) + 1 if ids.size else 0
+
+
+@def_op("segment_sum_n")
+def _segment_sum_n(data, segment_ids, n):
+    return jnp.zeros((int(n),) + data.shape[1:], data.dtype) \
+        .at[segment_ids].add(data)
+
+
+@def_op("segment_mean_n")
+def _segment_mean_n(data, segment_ids, n):
+    n = int(n)
+    total = jnp.zeros((n,) + data.shape[1:], data.dtype) \
+        .at[segment_ids].add(data)
+    count = jnp.zeros((n,), data.dtype).at[segment_ids].add(1)
+    return total / jnp.maximum(count.reshape((n,) + (1,) * (data.ndim - 1)),
+                               1)
+
+
+def _minmax(data, segment_ids, n, combine):
+    n = int(n)
+    fin = jnp.finfo(data.dtype) if jnp.issubdtype(
+        data.dtype, jnp.floating) else jnp.iinfo(data.dtype)
+    init = fin.max if combine == "min" else fin.min
+    out = jnp.full((n,) + data.shape[1:], init, data.dtype)
+    out = getattr(out.at[segment_ids], combine)(data)
+    hit = jnp.zeros((n,), bool).at[segment_ids].set(True)
+    return jnp.where(hit.reshape((n,) + (1,) * (data.ndim - 1)), out,
+                     jnp.zeros_like(out))
+
+
+@def_op("segment_min_n")
+def _segment_min_n(data, segment_ids, n):
+    return _minmax(data, segment_ids, n, "min")
+
+
+@def_op("segment_max_n")
+def _segment_max_n(data, segment_ids, n):
+    return _minmax(data, segment_ids, n, "max")
+
+
+def segment_sum(data, segment_ids, name=None):
+    return _segment_sum_n(data, segment_ids,
+                          _host_num_segments(segment_ids))
+
+
+def segment_mean(data, segment_ids, name=None):
+    return _segment_mean_n(data, segment_ids,
+                           _host_num_segments(segment_ids))
+
+
+def segment_min(data, segment_ids, name=None):
+    return _segment_min_n(data, segment_ids,
+                          _host_num_segments(segment_ids))
+
+
+def segment_max(data, segment_ids, name=None):
+    return _segment_max_n(data, segment_ids,
+                          _host_num_segments(segment_ids))

@@ -1,0 +1,67 @@
+"""Graph message passing (reference: python/paddle/geometric/
+message_passing/send_recv.py over graph_send_recv / graph_send_ue_recv
+CUDA kernels). gather(src) -> combine -> scatter(dst) as XLA HLOs.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.dispatch import def_op
+from ..core.enforce import enforce
+
+__all__ = ["send_u_recv", "send_ue_recv", "send_uv"]
+
+_MSG = {
+    "add": jnp.add,
+    "sub": jnp.subtract,
+    "mul": jnp.multiply,
+    "div": jnp.divide,
+}
+
+
+def _scatter_reduce(msg, dst_index, reduce_op, out_rows):
+    # delegate to the segment-reduction kernels (geometric/math.py) with
+    # dst_index as the segment ids — one implementation of the
+    # scatter-combine + unhit-row masking logic
+    from .math import _minmax, _segment_mean_n, _segment_sum_n
+
+    if reduce_op == "sum":
+        return _segment_sum_n.raw(msg, dst_index, out_rows)
+    if reduce_op == "mean":
+        return _segment_mean_n.raw(msg, dst_index, out_rows)
+    if reduce_op in ("min", "max"):
+        return _minmax(msg, dst_index, out_rows, reduce_op)
+    raise ValueError(f"unknown reduce_op {reduce_op!r}")
+
+
+def _check_edges(src_index, dst_index):
+    enforce(src_index.shape == dst_index.shape,
+            lambda: "src_index and dst_index must have the same shape, got "
+                    f"{src_index.shape} vs {dst_index.shape}")
+
+
+@def_op("send_u_recv")
+def send_u_recv(x, src_index, dst_index, reduce_op="sum", out_size=None):
+    """out[d] = reduce over edges (s -> d) of x[s]."""
+    _check_edges(src_index, dst_index)
+    msg = jnp.take(x, src_index, axis=0)
+    rows = int(out_size) if out_size is not None else x.shape[0]
+    return _scatter_reduce(msg, dst_index, str(reduce_op), rows)
+
+
+@def_op("send_ue_recv")
+def send_ue_recv(x, y, src_index, dst_index, message_op="add",
+                 reduce_op="sum", out_size=None):
+    """out[d] = reduce over edges e=(s -> d) of message_op(x[s], y[e])."""
+    _check_edges(src_index, dst_index)
+    msg = _MSG[str(message_op)](jnp.take(x, src_index, axis=0), y)
+    rows = int(out_size) if out_size is not None else x.shape[0]
+    return _scatter_reduce(msg, dst_index, str(reduce_op), rows)
+
+
+@def_op("send_uv")
+def send_uv(x, y, src_index, dst_index, message_op="add"):
+    """Per-edge features: message_op(x[src], y[dst])."""
+    _check_edges(src_index, dst_index)
+    return _MSG[str(message_op)](jnp.take(x, src_index, axis=0),
+                                 jnp.take(y, dst_index, axis=0))

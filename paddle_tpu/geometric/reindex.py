@@ -1,0 +1,46 @@
+"""Graph reindex (reference: python/paddle/geometric/reindex.py over the
+graph_reindex CUDA hashmap kernel). Output shape is data-dependent
+(unique node count), so this runs host-side on numpy by design — the
+result feeds the traced GNN step as regular device arrays.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..tensor import Tensor, to_tensor
+
+__all__ = ["reindex_graph"]
+
+
+def _np(x):
+    return np.asarray(x._value if isinstance(x, Tensor) else x)
+
+
+def reindex_graph(x, neighbors, count, value_buffer=None, index_buffer=None,
+                  name=None):
+    """Reindex node ids to a dense [0, num_unique) range.
+
+    Returns (reindex_src, reindex_dst, out_nodes): ``out_nodes`` is the
+    input nodes followed by first-seen-order new neighbor ids;
+    reindex_src/dst are the edge list expressed in the new ids.
+    """
+    x_np = _np(x).astype(np.int64)
+    nbr = _np(neighbors).astype(np.int64)
+    cnt = _np(count).astype(np.int64)
+    if len(np.unique(x_np)) != len(x_np):
+        # duplicates would desynchronize the positional dst ids from the
+        # value-deduplicated node table (the reference requires unique
+        # input nodes too — it just corrupts silently)
+        raise ValueError("reindex_graph requires unique ids in x")
+
+    mapping = {}
+    for v in x_np.tolist():
+        mapping.setdefault(v, len(mapping))
+    for v in nbr.tolist():
+        mapping.setdefault(v, len(mapping))
+    out_nodes = np.fromiter(mapping.keys(), np.int64, len(mapping))
+    reindex_src = np.fromiter((mapping[v] for v in nbr.tolist()), np.int64,
+                              len(nbr))
+    reindex_dst = np.repeat(np.arange(len(x_np), dtype=np.int64), cnt)
+    return (to_tensor(reindex_src), to_tensor(reindex_dst),
+            to_tensor(out_nodes))

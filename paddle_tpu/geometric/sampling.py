@@ -1,0 +1,58 @@
+"""Neighbor sampling (reference: python/paddle/geometric/sampling/
+neighbors.py over the graph_sample_neighbors CUDA kernel). Sample
+counts are data-dependent, so this runs host-side on numpy by design;
+use the returned arrays with ``reindex_graph`` then feed the traced
+GNN step.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+
+from ..core.rng import get_key
+from ..tensor import Tensor, to_tensor
+
+__all__ = ["sample_neighbors"]
+
+
+def _np(x):
+    return np.asarray(x._value if isinstance(x, Tensor) else x)
+
+
+def sample_neighbors(row, colptr, input_nodes, sample_size=-1, eids=None,
+                     return_eids=False, perm_buffer=None, name=None):
+    """Sample up to ``sample_size`` in-neighbors per input node from a
+    CSC graph (row = concatenated neighbor ids, colptr = offsets).
+
+    Returns (out_neighbors, out_count) and, with ``return_eids``, the
+    sampled edge ids as a third output.
+    """
+    row_np = _np(row).astype(np.int64)
+    colptr_np = _np(colptr).astype(np.int64)
+    nodes = _np(input_nodes).astype(np.int64)
+    eids_np = _np(eids).astype(np.int64) if eids is not None else None
+    if return_eids and eids_np is None:
+        raise ValueError("return_eids=True requires eids")
+
+    seed = int(jax.random.randint(get_key(), (), 0, 2 ** 31 - 1))
+    rng = np.random.RandomState(seed)
+    out_n, out_c, out_e = [], [], []
+    for v in nodes.tolist():
+        lo, hi = int(colptr_np[v]), int(colptr_np[v + 1])
+        deg = hi - lo
+        if sample_size < 0 or deg <= sample_size:
+            pick = np.arange(lo, hi)
+        else:
+            pick = lo + rng.choice(deg, size=sample_size, replace=False)
+        out_n.append(row_np[pick])
+        out_c.append(len(pick))
+        if eids_np is not None:
+            out_e.append(eids_np[pick])
+    neighbors = (np.concatenate(out_n) if out_n
+                 else np.zeros((0,), np.int64))
+    count = np.asarray(out_c, np.int64)
+    if return_eids:
+        e = np.concatenate(out_e) if out_e else np.zeros((0,), np.int64)
+        return to_tensor(neighbors), to_tensor(count), to_tensor(e)
+    return to_tensor(neighbors), to_tensor(count)

@@ -1,0 +1,54 @@
+"""paddle.hub analog (reference: python/paddle/hub.py — loads
+github/gitee-hosted hubconf.py entrypoints). Network fetch is
+unavailable in this environment; local-directory sources work.
+"""
+from __future__ import annotations
+
+import importlib.util
+import os
+
+__all__ = ["list", "help", "load"]
+
+_HUBCONF = "hubconf.py"
+
+
+def _load_local(repo_dir):
+    path = os.path.join(repo_dir, _HUBCONF)
+    if not os.path.isfile(path):
+        raise FileNotFoundError(f"no {_HUBCONF} in {repo_dir}")
+    spec = importlib.util.spec_from_file_location("hubconf", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _entrypoints(mod):
+    return [k for k, v in vars(mod).items()
+            if callable(v) and not k.startswith("_")]
+
+
+def list(repo_dir, source="local", force_reload=False):  # noqa: A001
+    if source != "local":
+        raise NotImplementedError(
+            "only source='local' is supported in this build (no egress)")
+    return _entrypoints(_load_local(repo_dir))
+
+
+def help(repo_dir, model, source="local", force_reload=False):  # noqa: A001
+    if source != "local":
+        raise NotImplementedError(
+            "only source='local' is supported in this build (no egress)")
+    fn = getattr(_load_local(repo_dir), model)
+    return fn.__doc__
+
+
+def load(repo_dir, model, *args, source="local", force_reload=False,
+         **kwargs):
+    if source != "local":
+        raise NotImplementedError(
+            "only source='local' is supported in this build (no egress)")
+    mod = _load_local(repo_dir)
+    if not hasattr(mod, model):
+        raise ValueError(f"entrypoint {model!r} not found; available: "
+                         f"{_entrypoints(mod)}")
+    return getattr(mod, model)(*args, **kwargs)

@@ -1,0 +1,17 @@
+"""ONNX export (reference: python/paddle/onnx/export.py, which defers
+to the external paddle2onnx package). Exporting an XLA-compiled model
+to ONNX requires an ONNX runtime/converter dependency this environment
+does not ship, so the API is present but gated; jit.save provides the
+native serialization path (StableHLO via jax.export is the TPU-world
+interchange format).
+"""
+from __future__ import annotations
+
+__all__ = ["export"]
+
+
+def export(layer, path, input_spec=None, opset_version=9, **configs):
+    raise NotImplementedError(
+        "ONNX export requires the external paddle2onnx/onnx toolchain, "
+        "which is not available in this build. Use paddle_tpu.jit.save "
+        "for native serialization (jax.export StableHLO).")

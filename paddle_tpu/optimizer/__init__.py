@@ -39,11 +39,14 @@ class Optimizer:
         self._grad_clip = grad_clip
         if isinstance(weight_decay, float) or isinstance(weight_decay, int):
             self._weight_decay = float(weight_decay)
+            self._wd_mode = "l2"
         elif weight_decay is None:
             self._weight_decay = 0.0
-        else:  # L2Decay-like object with a coeff
+            self._wd_mode = "l2"
+        else:  # L1Decay/L2Decay-like object with a coeff (+ optional mode)
             self._weight_decay = float(getattr(weight_decay, "_coeff",
                                                getattr(weight_decay, "coeff", 0.0)))
+            self._wd_mode = getattr(weight_decay, "mode", "l2")
         self._multi_precision = multi_precision
         # dtype of per-param moment buffers. f32 default (the reference's
         # AdamW); bf16 halves optimizer-state HBM on memory-bound chips
@@ -54,6 +57,14 @@ class Optimizer:
         self._step_count = 0
         self._jitted = None
         self._master_weights: Dict[int, jnp.ndarray] = {}
+
+    def _decay_term(self, pf):
+        """Weight-decay gradient term: wd*p for L2Decay, wd*sign(p) (the
+        L1 subgradient) for L1Decay (reference: python/paddle/
+        regularizer.py applied by the append_regularization_ops path)."""
+        if self._wd_mode == "l1":
+            return self._weight_decay * jnp.sign(pf)
+        return self._weight_decay * pf
 
     # -- lr handling ---------------------------------------------------
     def get_lr(self) -> float:
@@ -230,7 +241,7 @@ class SGD(Optimizer):
     def _update_rule(self, p, g, state, lr_value, step):
         g = g.astype(jnp.float32)
         if self._weight_decay:
-            g = g + self._weight_decay * p.astype(jnp.float32)
+            g = g + self._decay_term(p.astype(jnp.float32))
         return (p - (lr_value * g).astype(p.dtype)), state
 
 
@@ -249,7 +260,7 @@ class Momentum(Optimizer):
     def _update_rule(self, p, g, state, lr_value, step):
         g = g.astype(jnp.float32)
         if self._weight_decay:
-            g = g + self._weight_decay * p.astype(jnp.float32)
+            g = g + self._decay_term(p.astype(jnp.float32))
         v = self._momentum * state["velocity"] + g
         if self._nesterov:
             upd = g + self._momentum * v
@@ -281,7 +292,7 @@ class Adam(Optimizer):
         pf = p.astype(jnp.float32)
         g = g.astype(jnp.float32)
         if self._weight_decay and not self._decoupled:
-            g = g + self._weight_decay * pf
+            g = g + self._decay_term(pf)
         m = self._beta1 * state["moment1"] + (1 - self._beta1) * g
         v = self._beta2 * state["moment2"] + (1 - self._beta2) * jnp.square(g)
         t = step.astype(jnp.float32)
@@ -289,7 +300,7 @@ class Adam(Optimizer):
         vhat = v / (1 - self._beta2 ** t)
         upd = mhat / (jnp.sqrt(vhat) + self._epsilon)
         if self._weight_decay and self._decoupled:
-            upd = upd + self._weight_decay * pf
+            upd = upd + self._decay_term(pf)
         new_p = pf - lr_value * upd
         return new_p.astype(p.dtype), {"moment1": m, "moment2": v}
 
@@ -327,7 +338,7 @@ class AdamW(Adam):
         mhat = m / (1 - self._beta1 ** t)
         vhat = v / (1 - self._beta2 ** t)
         upd = mhat / (jnp.sqrt(vhat) + self._epsilon)
-        new_p = pf - lr_value * (upd + self._weight_decay * pf)
+        new_p = pf - lr_value * (upd + self._decay_term(pf))
         return new_p.astype(p.dtype), {"moment1": m, "moment2": v}
 
 
@@ -352,7 +363,7 @@ class Adagrad(Optimizer):
     def _update_rule(self, p, g, state, lr_value, step):
         g = g.astype(jnp.float32)
         if self._weight_decay:
-            g = g + self._weight_decay * p.astype(jnp.float32)
+            g = g + self._decay_term(p.astype(jnp.float32))
         acc = state["moment"] + jnp.square(g)
         new_p = p.astype(jnp.float32) - lr_value * g / (jnp.sqrt(acc) + self._epsilon)
         return new_p.astype(p.dtype), {"moment": acc}
@@ -374,7 +385,7 @@ class RMSProp(Optimizer):
     def _update_rule(self, p, g, state, lr_value, step):
         g = g.astype(jnp.float32)
         if self._weight_decay:
-            g = g + self._weight_decay * p.astype(jnp.float32)
+            g = g + self._decay_term(p.astype(jnp.float32))
         ms = self._rho * state["mean_square"] + (1 - self._rho) * jnp.square(g)
         if self._centered:
             mg = self._rho * state["mean_grad"] + (1 - self._rho) * g
@@ -414,7 +425,7 @@ class Lamb(Optimizer):
         t = step.astype(jnp.float32)
         mhat = m / (1 - self._beta1 ** t)
         vhat = v / (1 - self._beta2 ** t)
-        r = mhat / (jnp.sqrt(vhat) + self._epsilon) + self._weight_decay * pf
+        r = mhat / (jnp.sqrt(vhat) + self._epsilon) + self._decay_term(pf)
         w_norm = jnp.linalg.norm(pf)
         r_norm = jnp.linalg.norm(r)
         trust = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)

@@ -1,0 +1,32 @@
+"""Weight-decay regularizers (reference: python/paddle/regularizer.py).
+
+L2Decay feeds the optimizers' fused decoupled/coupled weight-decay path
+(optimizer/__init__.py reads ``_coeff``); L1Decay is applied as a
+subgradient term by the same path when ``mode == "l1"``.
+"""
+from __future__ import annotations
+
+__all__ = ["WeightDecayRegularizer", "L1Decay", "L2Decay"]
+
+
+class WeightDecayRegularizer:
+    mode = "l2"
+    _coeff = 0.0
+
+    def __init__(self, coeff=0.0):
+        self._coeff = float(coeff)
+
+    @property
+    def coeff(self):
+        return self._coeff
+
+    def __repr__(self):
+        return f"{type(self).__name__}(coeff={self._coeff})"
+
+
+class L1Decay(WeightDecayRegularizer):
+    mode = "l1"
+
+
+class L2Decay(WeightDecayRegularizer):
+    mode = "l2"

@@ -1,0 +1,175 @@
+"""Short-time Fourier transforms (paddle.signal analog).
+
+(reference: python/paddle/signal.py — frame/overlap_add over phi
+frame/overlap_add kernels + fft_r2c/fft_c2c/fft_c2r. Here framing is a
+gather (XLA lowers it to a strided window read), the DFTs are XLA's
+native FFT HLO, and overlap-add is a scatter-add — all differentiable
+and fusible; no dynloaded FFT library.)
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from .core.dispatch import def_op
+from .core.enforce import enforce
+
+__all__ = ["stft", "istft"]
+
+
+def _n_frames(seq_len, frame_length, hop_length):
+    return 1 + (seq_len - frame_length) // hop_length
+
+
+@def_op("frame")
+def frame(x, frame_length, hop_length, axis=-1):
+    """Slice ``x`` into overlapping frames along ``axis`` (last or first).
+
+    Output adds a ``frame_length`` axis next to ``axis``:
+    axis=-1 -> [..., frame_length, num_frames]; axis=0 ->
+    [num_frames, frame_length, ...].
+    """
+    frame_length = int(frame_length)
+    hop_length = int(hop_length)
+    axis = int(axis)
+    enforce(hop_length > 0, lambda: f"hop_length must be > 0, got {hop_length}")
+    enforce(axis in (-1, 0, x.ndim - 1),
+            lambda: "frame only supports the first or last axis")
+    seq_len = x.shape[axis]
+    enforce(frame_length <= seq_len,
+            lambda: f"frame_length ({frame_length}) > sequence length "
+                    f"({seq_len})")
+    n = _n_frames(seq_len, frame_length, hop_length)
+    # [n, frame_length] start-offset + in-frame index gather
+    idx = (np.arange(n)[:, None] * hop_length
+           + np.arange(frame_length)[None, :])
+    if axis == 0:  # first-axis framing (also the 1-D axis=0 case)
+        return jnp.take(x, jnp.asarray(idx), axis=0)  # [n, frame_length, ...]
+    out = jnp.take(x, jnp.asarray(idx), axis=x.ndim - 1)
+    # [..., n, frame_length] -> [..., frame_length, n]
+    return jnp.swapaxes(out, -1, -2)
+
+
+@def_op("overlap_add")
+def overlap_add(x, hop_length, axis=-1):
+    """Inverse of ``frame``: scatter-add overlapping frames.
+
+    axis=-1 expects [..., frame_length, num_frames]; axis=0 expects
+    [num_frames, frame_length, ...].
+    """
+    hop_length = int(hop_length)
+    axis = int(axis)
+    enforce(hop_length > 0, lambda: f"hop_length must be > 0, got {hop_length}")
+    enforce(axis in (-1, 0, x.ndim - 1),
+            lambda: "overlap_add only supports the first or last axis")
+    first = axis == 0 and x.ndim != 1
+    if first:
+        # [n, frame_length, ...] -> [..., frame_length, n]
+        x = jnp.moveaxis(jnp.moveaxis(x, 0, -1), 0, -2)
+    frame_length, n = x.shape[-2], x.shape[-1]
+    seq_len = (n - 1) * hop_length + frame_length
+    idx = (np.arange(n)[None, :] * hop_length
+           + np.arange(frame_length)[:, None])  # [frame_length, n]
+    flat = x.reshape(x.shape[:-2] + (-1,))
+    out = jnp.zeros(x.shape[:-2] + (seq_len,), x.dtype)
+    out = out.at[..., jnp.asarray(idx.reshape(-1))].add(flat)
+    if first:
+        out = jnp.moveaxis(out, -1, 0)
+    return out
+
+
+@def_op("stft")
+def stft(x, n_fft, hop_length=None, win_length=None, window=None,
+         center=True, pad_mode="reflect", normalized=False, onesided=True):
+    """Short-time Fourier transform: [..., T] -> [..., F, num_frames]."""
+    n_fft = int(n_fft)
+    hop_length = int(hop_length) if hop_length is not None else n_fft // 4
+    win_length = int(win_length) if win_length is not None else n_fft
+    enforce(x.ndim in (1, 2),
+            lambda: f"stft expects a 1-D or 2-D input, got rank {x.ndim}")
+    enforce(win_length <= n_fft,
+            lambda: f"win_length ({win_length}) > n_fft ({n_fft})")
+    enforce(not (onesided and (jnp.iscomplexobj(x) or (
+        window is not None and jnp.iscomplexobj(jnp.asarray(window))))),
+            lambda: "onesided must be False for a complex input or window: "
+                    "complex signals have no hermitian symmetry to exploit")
+    squeeze = x.ndim == 1
+    if squeeze:
+        x = x[None]
+    if window is None:
+        win = jnp.ones((win_length,), jnp.result_type(x.dtype, jnp.float32))
+    else:
+        win = jnp.asarray(window)
+        enforce(win.shape == (win_length,),
+                lambda: f"window must have shape ({win_length},), got "
+                        f"{win.shape}")
+    if win_length < n_fft:  # center-pad the window to n_fft
+        lpad = (n_fft - win_length) // 2
+        win = jnp.pad(win, (lpad, n_fft - win_length - lpad))
+    if center:
+        x = jnp.pad(x, ((0, 0), (n_fft // 2, n_fft // 2)), mode=pad_mode)
+    frames = frame.raw(x, n_fft, hop_length, -1)     # [B, n_fft, n]
+    frames = frames * win[None, :, None]
+    if jnp.iscomplexobj(frames):  # onesided=False enforced above
+        out = jnp.fft.fft(frames, axis=-2)
+    elif onesided:
+        out = jnp.fft.rfft(frames, axis=-2)
+    else:
+        out = jnp.fft.fft(frames.astype(jnp.complex64), axis=-2)
+    if normalized:
+        out = out / jnp.sqrt(jnp.asarray(n_fft, out.real.dtype))
+    return out[0] if squeeze else out
+
+
+@def_op("istft")
+def istft(x, n_fft, hop_length=None, win_length=None, window=None,
+          center=True, normalized=False, onesided=True, length=None,
+          return_complex=False):
+    """Inverse STFT (least-squares / NOLA estimate)."""
+    n_fft = int(n_fft)
+    hop_length = int(hop_length) if hop_length is not None else n_fft // 4
+    win_length = int(win_length) if win_length is not None else n_fft
+    enforce(x.ndim in (2, 3),
+            lambda: f"istft expects a 2-D or 3-D input, got rank {x.ndim}")
+    squeeze = x.ndim == 2
+    if squeeze:
+        x = x[None]
+    n_freq = n_fft // 2 + 1 if onesided else n_fft
+    enforce(x.shape[-2] == n_freq,
+            lambda: f"expected {n_freq} frequency rows, got {x.shape[-2]}")
+    if window is None:
+        win = jnp.ones((win_length,), jnp.float32)
+    else:
+        win = jnp.asarray(window)
+        enforce(win.shape == (win_length,),
+                lambda: f"window must have shape ({win_length},), got "
+                        f"{win.shape}")
+    if win_length < n_fft:
+        lpad = (n_fft - win_length) // 2
+        win = jnp.pad(win, (lpad, n_fft - win_length - lpad))
+    if normalized:
+        x = x * jnp.sqrt(jnp.asarray(n_fft, jnp.float32))
+    x = jnp.swapaxes(x, -1, -2)                       # [B, n, F]
+    if onesided and not return_complex:
+        frames = jnp.fft.irfft(x, n=n_fft, axis=-1)   # real path
+    else:
+        full = x
+        if onesided:  # rebuild hermitian half before the complex IDFT
+            mid = jnp.conj(full[..., 1:n_fft - n_fft // 2][..., ::-1])
+            full = jnp.concatenate([full, mid], axis=-1)
+        frames = jnp.fft.ifft(full, axis=-1)
+        if not return_complex:
+            frames = frames.real
+    frames = frames * win                              # [B, n, n_fft]
+    num = overlap_add.raw(jnp.swapaxes(frames, -1, -2), hop_length, -1)
+    den = overlap_add.raw(
+        jnp.broadcast_to((win * win)[:, None],
+                         (n_fft, frames.shape[1])), hop_length, -1)
+    out = num / jnp.maximum(den, 1e-11)
+    if center:
+        out = out[..., n_fft // 2:]
+        if length is None:
+            out = out[..., : out.shape[-1] - n_fft // 2]
+    if length is not None:
+        out = out[..., : int(length)]
+    return out[0] if squeeze else out

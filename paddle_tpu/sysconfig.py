@@ -1,0 +1,20 @@
+"""Build-path introspection (reference: python/paddle/sysconfig.py)."""
+from __future__ import annotations
+
+import os
+
+__all__ = ["get_include", "get_lib"]
+
+_ROOT = os.path.dirname(os.path.abspath(__file__))
+
+
+def get_include():
+    """Directory of the package's C headers (csrc ships sources; the
+    built shared objects live in lib/)."""
+    return os.path.join(_ROOT, "include")
+
+
+def get_lib():
+    """Directory containing the package's native shared libraries
+    (tcp_store / shm_ring builds)."""
+    return os.path.join(_ROOT, "lib")

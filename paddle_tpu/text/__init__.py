@@ -1,0 +1,6 @@
+"""paddle.text analog (reference: python/paddle/text/ —
+viterbi_decode.py over the phi viterbi_decode kernel; datasets are IO
+helpers outside the compute scope)."""
+from .viterbi_decode import ViterbiDecoder, viterbi_decode  # noqa: F401
+
+__all__ = ["viterbi_decode", "ViterbiDecoder"]

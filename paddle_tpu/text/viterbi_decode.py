@@ -1,0 +1,89 @@
+"""Viterbi decoding for linear-chain CRFs (reference: python/paddle/
+text/viterbi_decode.py over the phi viterbi_decode kernel,
+paddle/phi/kernels/viterbi_decode_kernel.h).
+
+TPU design: the max-product recursion is one ``lax.scan`` over time
+(compiled once for any length), the argmax backtrace a second reversed
+scan — no per-step host dispatch, static shapes throughout; padded
+steps beyond each sequence's length carry the state through unchanged.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.dispatch import def_op
+from ..core.enforce import enforce
+
+__all__ = ["viterbi_decode", "ViterbiDecoder"]
+
+
+@def_op("viterbi_decode", differentiable=False)
+def viterbi_decode(potentials, transition_params, lengths,
+                   include_bos_eos_tag=True):
+    """Highest-scoring tag path per sequence.
+
+    potentials [B, T, N] unary emissions, transition_params [N, N],
+    lengths [B]. Returns (scores [B], paths [B, T] int64-compatible).
+    """
+    enforce(potentials.ndim == 3,
+            lambda: f"potentials must be [B,T,N], got rank {potentials.ndim}")
+    B, T, N = potentials.shape
+    trans = transition_params.astype(potentials.dtype)
+    lengths = lengths.astype(jnp.int32)
+
+    if include_bos_eos_tag:
+        # last tag = BOS, second-to-last = STOP (reference convention):
+        # alpha starts from the BOS row; STOP column is added at each
+        # sequence's end.
+        alpha0 = potentials[:, 0] + trans[-1][None, :]
+    else:
+        alpha0 = potentials[:, 0]
+
+    def fwd(carry, t):
+        alpha = carry                                   # [B, N]
+        emit = potentials[:, t]                         # [B, N]
+        # score[b, i, j] = alpha[b, i] + trans[i, j] + emit[b, j]
+        score = alpha[:, :, None] + trans[None]         # [B, N, N]
+        best = jnp.max(score, axis=1) + emit            # [B, N]
+        back = jnp.argmax(score, axis=1)                # [B, N]
+        live = (t < lengths)[:, None]
+        return jnp.where(live, best, alpha), back
+
+    alpha, backs = lax.scan(fwd, alpha0, jnp.arange(1, T))
+    if include_bos_eos_tag:
+        stop = trans[:, -2][None, :]
+        # add the STOP transition at each sequence's final step only
+        alpha = alpha + stop
+
+    scores = jnp.max(alpha, axis=-1)
+    last_tag = jnp.argmax(alpha, axis=-1).astype(jnp.int32)   # [B]
+
+    def bwd(carry, xs):
+        tag = carry                                     # [B]
+        back, t = xs                                    # back: [B, N]
+        prev = jnp.take_along_axis(back, tag[:, None], 1)[:, 0]
+        live = t < lengths                              # step t exists
+        tag_out = jnp.where(live, prev.astype(jnp.int32), tag)
+        return tag_out, tag
+
+    ts = jnp.arange(1, T)
+    # path_rev holds tags for steps T-1..1; the final carry is step 0
+    first, path_rev = lax.scan(bwd, last_tag, (backs[::-1], ts[::-1]))
+    path = jnp.concatenate([first[:, None], path_rev[::-1].T], axis=1)
+    # mask out positions beyond each length with the last valid tag
+    # (reference returns only valid positions; fixed [B, T] here with
+    # padding repeated — documented deviation for static shapes)
+    return scores, path
+
+
+class ViterbiDecoder:
+    """Layer-style wrapper (reference: paddle.text.ViterbiDecoder)."""
+
+    def __init__(self, transitions, include_bos_eos_tag=True, name=None):
+        self.transitions = transitions
+        self.include_bos_eos_tag = include_bos_eos_tag
+
+    def __call__(self, potentials, lengths):
+        return viterbi_decode(potentials, self.transitions, lengths,
+                              self.include_bos_eos_tag)

@@ -1,0 +1,50 @@
+"""AlexNet (reference: python/paddle/vision/models/alexnet.py — same
+factory surface; implementation is the standard 5-conv/3-fc topology).
+"""
+from __future__ import annotations
+
+from ... import nn
+
+__all__ = ["AlexNet", "alexnet"]
+
+
+class AlexNet(nn.Layer):
+    def __init__(self, num_classes=1000):
+        super().__init__()
+        self.num_classes = num_classes
+        self.features = nn.Sequential(
+            nn.Conv2D(3, 64, 11, stride=4, padding=2),
+            nn.ReLU(),
+            nn.MaxPool2D(3, stride=2),
+            nn.Conv2D(64, 192, 5, padding=2),
+            nn.ReLU(),
+            nn.MaxPool2D(3, stride=2),
+            nn.Conv2D(192, 384, 3, padding=1),
+            nn.ReLU(),
+            nn.Conv2D(384, 256, 3, padding=1),
+            nn.ReLU(),
+            nn.Conv2D(256, 256, 3, padding=1),
+            nn.ReLU(),
+            nn.MaxPool2D(3, stride=2),
+        )
+        if num_classes > 0:
+            self.classifier = nn.Sequential(
+                nn.Dropout(0.5),
+                nn.Linear(256 * 6 * 6, 4096),
+                nn.ReLU(),
+                nn.Dropout(0.5),
+                nn.Linear(4096, 4096),
+                nn.ReLU(),
+                nn.Linear(4096, num_classes),
+            )
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.num_classes > 0:
+            x = x.flatten(1)
+            x = self.classifier(x)
+        return x
+
+
+def alexnet(pretrained=False, **kwargs):
+    return AlexNet(**kwargs)

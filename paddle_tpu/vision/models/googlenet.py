@@ -1,0 +1,103 @@
+"""GoogLeNet / Inception v1 (reference: python/paddle/vision/models/
+googlenet.py — same factory surface and (out, out1, out2) aux-head
+forward contract).
+"""
+from __future__ import annotations
+
+from ... import concat, nn
+
+__all__ = ["GoogLeNet", "googlenet"]
+
+
+class _ConvRelu(nn.Layer):
+    def __init__(self, in_ch, out_ch, k, stride=1):
+        super().__init__()
+        self.conv = nn.Conv2D(in_ch, out_ch, k, stride=stride,
+                              padding=(k - 1) // 2, bias_attr=False)
+        self.relu = nn.ReLU()
+
+    def forward(self, x):
+        return self.relu(self.conv(x))
+
+
+class _Inception(nn.Layer):
+    def __init__(self, in_ch, f1, f3r, f3, f5r, f5, proj):
+        super().__init__()
+        self.b1 = _ConvRelu(in_ch, f1, 1)
+        self.b3r = _ConvRelu(in_ch, f3r, 1)
+        self.b3 = _ConvRelu(f3r, f3, 3)
+        self.b5r = _ConvRelu(in_ch, f5r, 1)
+        self.b5 = _ConvRelu(f5r, f5, 5)
+        self.pool = nn.MaxPool2D(3, stride=1, padding=1)
+        self.proj = _ConvRelu(in_ch, proj, 1)
+
+    def forward(self, x):
+        return concat([self.b1(x), self.b3(self.b3r(x)),
+                       self.b5(self.b5r(x)), self.proj(self.pool(x))],
+                      axis=1)
+
+
+class GoogLeNet(nn.Layer):
+    def __init__(self, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+
+        self.conv = _ConvRelu(3, 64, 7, stride=2)
+        self.pool = nn.MaxPool2D(3, stride=2)
+        self.conv_1 = _ConvRelu(64, 64, 1)
+        self.conv_2 = _ConvRelu(64, 192, 3)
+
+        self.ince3a = _Inception(192, 64, 96, 128, 16, 32, 32)
+        self.ince3b = _Inception(256, 128, 128, 192, 32, 96, 64)
+        self.ince4a = _Inception(480, 192, 96, 208, 16, 48, 64)
+        self.ince4b = _Inception(512, 160, 112, 224, 24, 64, 64)
+        self.ince4c = _Inception(512, 128, 128, 256, 24, 64, 64)
+        self.ince4d = _Inception(512, 112, 144, 288, 32, 64, 64)
+        self.ince4e = _Inception(528, 256, 160, 320, 32, 128, 128)
+        self.ince5a = _Inception(832, 256, 160, 320, 32, 128, 128)
+        self.ince5b = _Inception(832, 384, 192, 384, 48, 128, 128)
+
+        if with_pool:
+            self.pool_5 = nn.AdaptiveAvgPool2D(1)
+            self.pool_o1 = nn.AvgPool2D(5, stride=3)
+            self.pool_o2 = nn.AvgPool2D(5, stride=3)
+        if num_classes > 0:
+            self.drop = nn.Dropout(0.4)
+            self.fc_out = nn.Linear(1024, num_classes)
+            self.conv_o1 = _ConvRelu(512, 128, 1)
+            self.fc_o1 = nn.Linear(1152, 1024)
+            self.drop_o1 = nn.Dropout(0.7)
+            self.out1 = nn.Linear(1024, num_classes)
+            self.conv_o2 = _ConvRelu(528, 128, 1)
+            self.fc_o2 = nn.Linear(1152, 1024)
+            self.drop_o2 = nn.Dropout(0.7)
+            self.out2 = nn.Linear(1024, num_classes)
+            self.relu = nn.ReLU()
+
+    def forward(self, x):
+        x = self.pool(self.conv(x))
+        x = self.pool(self.conv_2(self.conv_1(x)))
+        x = self.pool(self.ince3b(self.ince3a(x)))
+        ince4a = self.ince4a(x)
+        x = self.ince4c(self.ince4b(ince4a))
+        ince4d = self.ince4d(x)
+        x = self.pool(self.ince4e(ince4d))
+        out = self.ince5b(self.ince5a(x))
+        out1, out2 = ince4a, ince4d
+
+        if self.with_pool:
+            out = self.pool_5(out)
+            out1 = self.pool_o1(out1)
+            out2 = self.pool_o2(out2)
+        if self.num_classes > 0:
+            out = self.fc_out(self.drop(out).flatten(1))
+            out1 = self.relu(self.fc_o1(self.conv_o1(out1).flatten(1)))
+            out1 = self.out1(self.drop_o1(out1))
+            out2 = self.relu(self.fc_o2(self.conv_o2(out2).flatten(1)))
+            out2 = self.out2(self.drop_o2(out2))
+        return out, out1, out2
+
+
+def googlenet(pretrained=False, **kwargs):
+    return GoogLeNet(**kwargs)

@@ -1,0 +1,157 @@
+"""Inception v3 (reference: python/paddle/vision/models/inceptionv3.py —
+same factory surface; standard InceptionA-E topology, 299x299 input).
+"""
+from __future__ import annotations
+
+from ... import concat, nn
+
+__all__ = ["InceptionV3", "inception_v3"]
+
+
+class _ConvBNRelu(nn.Layer):
+    def __init__(self, in_ch, out_ch, k, stride=1, padding=0):
+        super().__init__()
+        self.conv = nn.Conv2D(in_ch, out_ch, k, stride=stride,
+                              padding=padding, bias_attr=False)
+        self.bn = nn.BatchNorm2D(out_ch)
+        self.relu = nn.ReLU()
+
+    def forward(self, x):
+        return self.relu(self.bn(self.conv(x)))
+
+
+class _InceptionA(nn.Layer):
+    def __init__(self, in_ch, pool_features):
+        super().__init__()
+        self.b1 = _ConvBNRelu(in_ch, 64, 1)
+        self.b5_1 = _ConvBNRelu(in_ch, 48, 1)
+        self.b5_2 = _ConvBNRelu(48, 64, 5, padding=2)
+        self.b3_1 = _ConvBNRelu(in_ch, 64, 1)
+        self.b3_2 = _ConvBNRelu(64, 96, 3, padding=1)
+        self.b3_3 = _ConvBNRelu(96, 96, 3, padding=1)
+        self.pool = nn.AvgPool2D(3, stride=1, padding=1)
+        self.bp = _ConvBNRelu(in_ch, pool_features, 1)
+
+    def forward(self, x):
+        return concat([self.b1(x), self.b5_2(self.b5_1(x)),
+                       self.b3_3(self.b3_2(self.b3_1(x))),
+                       self.bp(self.pool(x))], axis=1)
+
+
+class _InceptionB(nn.Layer):
+    """Grid reduction 35x35 -> 17x17."""
+
+    def __init__(self, in_ch):
+        super().__init__()
+        self.b3 = _ConvBNRelu(in_ch, 384, 3, stride=2)
+        self.bd_1 = _ConvBNRelu(in_ch, 64, 1)
+        self.bd_2 = _ConvBNRelu(64, 96, 3, padding=1)
+        self.bd_3 = _ConvBNRelu(96, 96, 3, stride=2)
+        self.pool = nn.MaxPool2D(3, stride=2)
+
+    def forward(self, x):
+        return concat([self.b3(x), self.bd_3(self.bd_2(self.bd_1(x))),
+                       self.pool(x)], axis=1)
+
+
+class _InceptionC(nn.Layer):
+    def __init__(self, in_ch, ch7):
+        super().__init__()
+        self.b1 = _ConvBNRelu(in_ch, 192, 1)
+        self.b7_1 = _ConvBNRelu(in_ch, ch7, 1)
+        self.b7_2 = _ConvBNRelu(ch7, ch7, (1, 7), padding=(0, 3))
+        self.b7_3 = _ConvBNRelu(ch7, 192, (7, 1), padding=(3, 0))
+        self.b7d_1 = _ConvBNRelu(in_ch, ch7, 1)
+        self.b7d_2 = _ConvBNRelu(ch7, ch7, (7, 1), padding=(3, 0))
+        self.b7d_3 = _ConvBNRelu(ch7, ch7, (1, 7), padding=(0, 3))
+        self.b7d_4 = _ConvBNRelu(ch7, ch7, (7, 1), padding=(3, 0))
+        self.b7d_5 = _ConvBNRelu(ch7, 192, (1, 7), padding=(0, 3))
+        self.pool = nn.AvgPool2D(3, stride=1, padding=1)
+        self.bp = _ConvBNRelu(in_ch, 192, 1)
+
+    def forward(self, x):
+        b7 = self.b7_3(self.b7_2(self.b7_1(x)))
+        b7d = self.b7d_5(self.b7d_4(self.b7d_3(self.b7d_2(self.b7d_1(x)))))
+        return concat([self.b1(x), b7, b7d, self.bp(self.pool(x))], axis=1)
+
+
+class _InceptionD(nn.Layer):
+    """Grid reduction 17x17 -> 8x8."""
+
+    def __init__(self, in_ch):
+        super().__init__()
+        self.b3_1 = _ConvBNRelu(in_ch, 192, 1)
+        self.b3_2 = _ConvBNRelu(192, 320, 3, stride=2)
+        self.b7_1 = _ConvBNRelu(in_ch, 192, 1)
+        self.b7_2 = _ConvBNRelu(192, 192, (1, 7), padding=(0, 3))
+        self.b7_3 = _ConvBNRelu(192, 192, (7, 1), padding=(3, 0))
+        self.b7_4 = _ConvBNRelu(192, 192, 3, stride=2)
+        self.pool = nn.MaxPool2D(3, stride=2)
+
+    def forward(self, x):
+        return concat([self.b3_2(self.b3_1(x)),
+                       self.b7_4(self.b7_3(self.b7_2(self.b7_1(x)))),
+                       self.pool(x)], axis=1)
+
+
+class _InceptionE(nn.Layer):
+    def __init__(self, in_ch):
+        super().__init__()
+        self.b1 = _ConvBNRelu(in_ch, 320, 1)
+        self.b3_1 = _ConvBNRelu(in_ch, 384, 1)
+        self.b3_2a = _ConvBNRelu(384, 384, (1, 3), padding=(0, 1))
+        self.b3_2b = _ConvBNRelu(384, 384, (3, 1), padding=(1, 0))
+        self.b3d_1 = _ConvBNRelu(in_ch, 448, 1)
+        self.b3d_2 = _ConvBNRelu(448, 384, 3, padding=1)
+        self.b3d_3a = _ConvBNRelu(384, 384, (1, 3), padding=(0, 1))
+        self.b3d_3b = _ConvBNRelu(384, 384, (3, 1), padding=(1, 0))
+        self.pool = nn.AvgPool2D(3, stride=1, padding=1)
+        self.bp = _ConvBNRelu(in_ch, 192, 1)
+
+    def forward(self, x):
+        b3 = self.b3_1(x)
+        b3 = concat([self.b3_2a(b3), self.b3_2b(b3)], axis=1)
+        b3d = self.b3d_2(self.b3d_1(x))
+        b3d = concat([self.b3d_3a(b3d), self.b3d_3b(b3d)], axis=1)
+        return concat([self.b1(x), b3, b3d, self.bp(self.pool(x))], axis=1)
+
+
+class InceptionV3(nn.Layer):
+    def __init__(self, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        self.stem = nn.Sequential(
+            _ConvBNRelu(3, 32, 3, stride=2),
+            _ConvBNRelu(32, 32, 3),
+            _ConvBNRelu(32, 64, 3, padding=1),
+            nn.MaxPool2D(3, stride=2),
+            _ConvBNRelu(64, 80, 1),
+            _ConvBNRelu(80, 192, 3),
+            nn.MaxPool2D(3, stride=2),
+        )
+        self.blocks = nn.Sequential(
+            _InceptionA(192, 32), _InceptionA(256, 64), _InceptionA(288, 64),
+            _InceptionB(288),
+            _InceptionC(768, 128), _InceptionC(768, 160),
+            _InceptionC(768, 160), _InceptionC(768, 192),
+            _InceptionD(768),
+            _InceptionE(1280), _InceptionE(2048),
+        )
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.dropout = nn.Dropout(0.5)
+            self.fc = nn.Linear(2048, num_classes)
+
+    def forward(self, x):
+        x = self.blocks(self.stem(x))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.fc(self.dropout(x).flatten(1))
+        return x
+
+
+def inception_v3(pretrained=False, **kwargs):
+    return InceptionV3(**kwargs)

@@ -1,0 +1,149 @@
+"""MobileNetV3 small/large (reference: python/paddle/vision/models/
+mobilenetv3.py — same factory surface; inverted residuals with
+squeeze-excitation and hardswish).
+"""
+from __future__ import annotations
+
+from ... import nn
+
+__all__ = ["MobileNetV3Small", "MobileNetV3Large", "mobilenet_v3_small",
+           "mobilenet_v3_large"]
+
+
+def _make_divisible(v, divisor=8, min_value=None):
+    min_value = min_value or divisor
+    new_v = max(min_value, int(v + divisor / 2) // divisor * divisor)
+    if new_v < 0.9 * v:
+        new_v += divisor
+    return new_v
+
+
+class _ConvBNAct(nn.Layer):
+    def __init__(self, in_ch, out_ch, k, stride=1, groups=1, act=None):
+        super().__init__()
+        self.conv = nn.Conv2D(in_ch, out_ch, k, stride=stride,
+                              padding=(k - 1) // 2, groups=groups,
+                              bias_attr=False)
+        self.bn = nn.BatchNorm2D(out_ch)
+        self.act = {"relu": nn.ReLU, "hardswish": nn.Hardswish,
+                    None: nn.Identity}[act]()
+
+    def forward(self, x):
+        return self.act(self.bn(self.conv(x)))
+
+
+class _SqueezeExcitation(nn.Layer):
+    def __init__(self, ch, squeeze_ch):
+        super().__init__()
+        self.avgpool = nn.AdaptiveAvgPool2D(1)
+        self.fc1 = nn.Conv2D(ch, squeeze_ch, 1)
+        self.fc2 = nn.Conv2D(squeeze_ch, ch, 1)
+        self.relu = nn.ReLU()
+        self.hsig = nn.Hardsigmoid()
+
+    def forward(self, x):
+        s = self.hsig(self.fc2(self.relu(self.fc1(self.avgpool(x)))))
+        return x * s
+
+
+class _InvertedResidual(nn.Layer):
+    def __init__(self, in_ch, exp_ch, out_ch, k, stride, use_se, act):
+        super().__init__()
+        self.use_res = stride == 1 and in_ch == out_ch
+        layers = []
+        if exp_ch != in_ch:
+            layers.append(_ConvBNAct(in_ch, exp_ch, 1, act=act))
+        layers.append(_ConvBNAct(exp_ch, exp_ch, k, stride=stride,
+                                 groups=exp_ch, act=act))
+        if use_se:
+            layers.append(
+                _SqueezeExcitation(exp_ch, _make_divisible(exp_ch // 4)))
+        layers.append(_ConvBNAct(exp_ch, out_ch, 1, act=None))
+        self.block = nn.Sequential(*layers)
+
+    def forward(self, x):
+        out = self.block(x)
+        return x + out if self.use_res else out
+
+
+class _MobileNetV3(nn.Layer):
+    # cfg rows: (k, exp, out, use_se, act, stride)
+    def __init__(self, cfg, last_ch, scale, num_classes, with_pool):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        in_ch = _make_divisible(16 * scale)
+        self.conv = _ConvBNAct(3, in_ch, 3, stride=2, act="hardswish")
+        blocks = []
+        for k, exp, out, use_se, act, stride in cfg:
+            exp_ch = _make_divisible(exp * scale)
+            out_ch = _make_divisible(out * scale)
+            blocks.append(_InvertedResidual(in_ch, exp_ch, out_ch, k,
+                                            stride, use_se, act))
+            in_ch = out_ch
+        self.blocks = nn.Sequential(*blocks)
+        last_conv = _make_divisible(6 * in_ch)
+        self.lastconv = _ConvBNAct(in_ch, last_conv, 1, act="hardswish")
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.classifier = nn.Sequential(
+                nn.Linear(last_conv, last_ch), nn.Hardswish(),
+                nn.Dropout(0.2), nn.Linear(last_ch, num_classes))
+
+    def forward(self, x):
+        x = self.lastconv(self.blocks(self.conv(x)))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = x.flatten(1)
+            x = self.classifier(x)
+        return x
+
+
+class MobileNetV3Small(_MobileNetV3):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        cfg = [
+            (3, 16, 16, True, "relu", 2),
+            (3, 72, 24, False, "relu", 2),
+            (3, 88, 24, False, "relu", 1),
+            (5, 96, 40, True, "hardswish", 2),
+            (5, 240, 40, True, "hardswish", 1),
+            (5, 240, 40, True, "hardswish", 1),
+            (5, 120, 48, True, "hardswish", 1),
+            (5, 144, 48, True, "hardswish", 1),
+            (5, 288, 96, True, "hardswish", 2),
+            (5, 576, 96, True, "hardswish", 1),
+            (5, 576, 96, True, "hardswish", 1),
+        ]
+        super().__init__(cfg, 1024, scale, num_classes, with_pool)
+
+
+class MobileNetV3Large(_MobileNetV3):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        cfg = [
+            (3, 16, 16, False, "relu", 1),
+            (3, 64, 24, False, "relu", 2),
+            (3, 72, 24, False, "relu", 1),
+            (5, 72, 40, True, "relu", 2),
+            (5, 120, 40, True, "relu", 1),
+            (5, 120, 40, True, "relu", 1),
+            (3, 240, 80, False, "hardswish", 2),
+            (3, 200, 80, False, "hardswish", 1),
+            (3, 184, 80, False, "hardswish", 1),
+            (3, 184, 80, False, "hardswish", 1),
+            (3, 480, 112, True, "hardswish", 1),
+            (3, 672, 112, True, "hardswish", 1),
+            (5, 672, 160, True, "hardswish", 2),
+            (5, 960, 160, True, "hardswish", 1),
+            (5, 960, 160, True, "hardswish", 1),
+        ]
+        super().__init__(cfg, 1280, scale, num_classes, with_pool)
+
+
+def mobilenet_v3_small(pretrained=False, scale=1.0, **kwargs):
+    return MobileNetV3Small(scale=scale, **kwargs)
+
+
+def mobilenet_v3_large(pretrained=False, scale=1.0, **kwargs):
+    return MobileNetV3Large(scale=scale, **kwargs)

@@ -1,0 +1,144 @@
+"""ShuffleNetV2 (reference: python/paddle/vision/models/shufflenetv2.py
+— same factory surface). Channel shuffle is a reshape/transpose pair,
+which XLA folds into the surrounding convs' layouts.
+"""
+from __future__ import annotations
+
+from ... import concat, nn, reshape, transpose
+
+__all__ = ["ShuffleNetV2", "shufflenet_v2_x0_25", "shufflenet_v2_x0_33",
+           "shufflenet_v2_x0_5", "shufflenet_v2_x1_0",
+           "shufflenet_v2_x1_5", "shufflenet_v2_x2_0",
+           "shufflenet_v2_swish"]
+
+
+def _channel_shuffle(x, groups):
+    b, c, h, w = x.shape
+    x = reshape(x, [b, groups, c // groups, h, w])
+    x = transpose(x, [0, 2, 1, 3, 4])
+    return reshape(x, [b, c, h, w])
+
+
+def _act(name):
+    return nn.Swish() if name == "swish" else nn.ReLU()
+
+
+class _ConvBNAct(nn.Layer):
+    def __init__(self, in_ch, out_ch, k, stride=1, groups=1, act="relu"):
+        super().__init__()
+        self.conv = nn.Conv2D(in_ch, out_ch, k, stride=stride,
+                              padding=(k - 1) // 2, groups=groups,
+                              bias_attr=False)
+        self.bn = nn.BatchNorm2D(out_ch)
+        self.act = _act(act) if act else nn.Identity()
+
+    def forward(self, x):
+        return self.act(self.bn(self.conv(x)))
+
+
+class _InvertedResidual(nn.Layer):
+    """Stride-1 unit: split channels, transform one half, shuffle."""
+
+    def __init__(self, ch, act):
+        super().__init__()
+        half = ch // 2
+        self.branch = nn.Sequential(
+            _ConvBNAct(half, half, 1, act=act),
+            _ConvBNAct(half, half, 3, groups=half, act=None),
+            _ConvBNAct(half, half, 1, act=act),
+        )
+
+    def forward(self, x):
+        half = x.shape[1] // 2
+        x1, x2 = x[:, :half], x[:, half:]
+        out = concat([x1, self.branch(x2)], axis=1)
+        return _channel_shuffle(out, 2)
+
+
+class _InvertedResidualDS(nn.Layer):
+    """Stride-2 (downsample) unit: both branches transform."""
+
+    def __init__(self, in_ch, out_ch, act):
+        super().__init__()
+        half = out_ch // 2
+        self.branch1 = nn.Sequential(
+            _ConvBNAct(in_ch, in_ch, 3, stride=2, groups=in_ch, act=None),
+            _ConvBNAct(in_ch, half, 1, act=act),
+        )
+        self.branch2 = nn.Sequential(
+            _ConvBNAct(in_ch, half, 1, act=act),
+            _ConvBNAct(half, half, 3, stride=2, groups=half, act=None),
+            _ConvBNAct(half, half, 1, act=act),
+        )
+
+    def forward(self, x):
+        out = concat([self.branch1(x), self.branch2(x)], axis=1)
+        return _channel_shuffle(out, 2)
+
+
+class ShuffleNetV2(nn.Layer):
+    def __init__(self, scale=1.0, act="relu", num_classes=1000,
+                 with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        stage_repeats = [4, 8, 4]
+        channels = {
+            0.25: [24, 24, 48, 96, 512], 0.33: [24, 32, 64, 128, 512],
+            0.5: [24, 48, 96, 192, 1024], 1.0: [24, 116, 232, 464, 1024],
+            1.5: [24, 176, 352, 704, 1024], 2.0: [24, 244, 488, 976, 2048],
+        }[scale]
+        self.conv1 = _ConvBNAct(3, channels[0], 3, stride=2, act=act)
+        self.maxpool = nn.MaxPool2D(3, stride=2, padding=1)
+        blocks = []
+        in_ch = channels[0]
+        for stage, repeats in enumerate(stage_repeats):
+            out_ch = channels[stage + 1]
+            blocks.append(_InvertedResidualDS(in_ch, out_ch, act))
+            for _ in range(repeats - 1):
+                blocks.append(_InvertedResidual(out_ch, act))
+            in_ch = out_ch
+        self.blocks = nn.Sequential(*blocks)
+        self.conv_last = _ConvBNAct(in_ch, channels[-1], 1, act=act)
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.fc = nn.Linear(channels[-1], num_classes)
+
+    def forward(self, x):
+        x = self.maxpool(self.conv1(x))
+        x = self.conv_last(self.blocks(x))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = x.flatten(1)
+            x = self.fc(x)
+        return x
+
+
+def shufflenet_v2_x0_25(pretrained=False, **kwargs):
+    return ShuffleNetV2(scale=0.25, **kwargs)
+
+
+def shufflenet_v2_x0_33(pretrained=False, **kwargs):
+    return ShuffleNetV2(scale=0.33, **kwargs)
+
+
+def shufflenet_v2_x0_5(pretrained=False, **kwargs):
+    return ShuffleNetV2(scale=0.5, **kwargs)
+
+
+def shufflenet_v2_x1_0(pretrained=False, **kwargs):
+    return ShuffleNetV2(scale=1.0, **kwargs)
+
+
+def shufflenet_v2_x1_5(pretrained=False, **kwargs):
+    return ShuffleNetV2(scale=1.5, **kwargs)
+
+
+def shufflenet_v2_x2_0(pretrained=False, **kwargs):
+    return ShuffleNetV2(scale=2.0, **kwargs)
+
+
+def shufflenet_v2_swish(pretrained=False, **kwargs):
+    return ShuffleNetV2(scale=1.0, act="swish", **kwargs)

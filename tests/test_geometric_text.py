@@ -1,0 +1,210 @@
+"""paddle.geometric + paddle.text (reference: python/paddle/geometric/,
+python/paddle/text/viterbi_decode.py) — numpy-reference parity."""
+import itertools
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_segment_ops_vs_numpy():
+    rng = np.random.RandomState(0)
+    data = rng.rand(10, 3).astype("float32")
+    ids = np.array([0, 0, 1, 1, 1, 3, 3, 5, 5, 5])
+    d, i = paddle.to_tensor(data), paddle.to_tensor(ids)
+
+    out = np.asarray(paddle.geometric.segment_sum(d, i)._value)
+    assert out.shape == (6, 3)
+    for s in range(6):
+        np.testing.assert_allclose(out[s], data[ids == s].sum(0)
+                                   if (ids == s).any() else 0, rtol=1e-6)
+
+    out = np.asarray(paddle.geometric.segment_mean(d, i)._value)
+    for s in range(6):
+        ref = data[ids == s].mean(0) if (ids == s).any() else np.zeros(3)
+        np.testing.assert_allclose(out[s], ref, rtol=1e-6)
+
+    out = np.asarray(paddle.geometric.segment_max(d, i)._value)
+    for s in range(6):
+        ref = data[ids == s].max(0) if (ids == s).any() else np.zeros(3)
+        np.testing.assert_allclose(out[s], ref, rtol=1e-6)
+
+    out = np.asarray(paddle.geometric.segment_min(d, i)._value)
+    for s in range(6):
+        ref = data[ids == s].min(0) if (ids == s).any() else np.zeros(3)
+        np.testing.assert_allclose(out[s], ref, rtol=1e-6)
+
+
+def test_segment_sum_grad():
+    data = paddle.to_tensor(np.ones((4, 2), dtype=np.float32))
+    data.stop_gradient = False
+    ids = paddle.to_tensor(np.array([0, 1, 1, 2]))
+    out = paddle.geometric.segment_sum(data, ids)
+    out.sum().backward()
+    np.testing.assert_allclose(np.asarray(data.grad._value),
+                               np.ones((4, 2), dtype=np.float32))
+
+
+@pytest.mark.parametrize("reduce_op", ["sum", "mean", "min", "max"])
+def test_send_u_recv(reduce_op):
+    rng = np.random.RandomState(1)
+    x = rng.rand(5, 4).astype("float32")
+    src = np.array([0, 1, 2, 0, 3])
+    dst = np.array([1, 2, 1, 0, 0])
+    out = np.asarray(paddle.geometric.send_u_recv(
+        paddle.to_tensor(x), paddle.to_tensor(src), paddle.to_tensor(dst),
+        reduce_op)._value)
+    assert out.shape == (5, 4)
+    for d in range(5):
+        msgs = x[src[dst == d]]
+        if len(msgs) == 0:
+            np.testing.assert_allclose(out[d], 0)
+        else:
+            ref = {"sum": msgs.sum(0), "mean": msgs.mean(0),
+                   "min": msgs.min(0), "max": msgs.max(0)}[reduce_op]
+            np.testing.assert_allclose(out[d], ref, rtol=1e-6)
+
+
+def test_send_ue_recv_and_uv():
+    rng = np.random.RandomState(2)
+    x = rng.rand(4, 3).astype("float32")
+    y_edge = rng.rand(5, 3).astype("float32")
+    src = np.array([0, 1, 2, 3, 0])
+    dst = np.array([1, 0, 3, 2, 2])
+    out = np.asarray(paddle.geometric.send_ue_recv(
+        paddle.to_tensor(x), paddle.to_tensor(y_edge),
+        paddle.to_tensor(src), paddle.to_tensor(dst), "mul", "sum")._value)
+    ref = np.zeros((4, 3), np.float32)
+    for e in range(5):
+        ref[dst[e]] += x[src[e]] * y_edge[e]
+    np.testing.assert_allclose(out, ref, rtol=1e-5)
+
+    uv = np.asarray(paddle.geometric.send_uv(
+        paddle.to_tensor(x), paddle.to_tensor(x),
+        paddle.to_tensor(src), paddle.to_tensor(dst), "add")._value)
+    np.testing.assert_allclose(uv, x[src] + x[dst], rtol=1e-6)
+
+
+def test_out_size():
+    x = paddle.to_tensor(np.ones((3, 2), dtype=np.float32))
+    src = paddle.to_tensor(np.array([0, 1]))
+    dst = paddle.to_tensor(np.array([0, 1]))
+    out = paddle.geometric.send_u_recv(x, src, dst, "sum", out_size=7)
+    assert out.shape == [7, 2]
+
+
+def test_edge_shape_mismatch_raises():
+    x = paddle.to_tensor(np.ones((3, 2), dtype=np.float32))
+    y = paddle.to_tensor(np.ones((3, 2), dtype=np.float32))
+    src = paddle.to_tensor(np.array([0, 1, 2]))
+    dst = paddle.to_tensor(np.array([1, 0]))
+    for fn in (lambda: paddle.geometric.send_u_recv(x, src, dst),
+               lambda: paddle.geometric.send_ue_recv(x, y, src, dst),
+               lambda: paddle.geometric.send_uv(x, x, src, dst)):
+        with pytest.raises(Exception, match="same shape"):
+            fn()
+
+
+def test_reindex_graph_reference_example():
+    # the worked example in the reference's docstring
+    # (python/paddle/geometric/reindex.py)
+    s, d, nodes = paddle.geometric.reindex_graph(
+        paddle.to_tensor(np.array([0, 1, 2])),
+        paddle.to_tensor(np.array([8, 9, 0, 4, 7, 6, 7])),
+        paddle.to_tensor(np.array([2, 3, 2])))
+    assert np.asarray(s._value).tolist() == [3, 4, 0, 5, 6, 7, 6]
+    assert np.asarray(d._value).tolist() == [0, 0, 1, 1, 1, 2, 2]
+    assert np.asarray(nodes._value).tolist() == [0, 1, 2, 8, 9, 4, 7, 6]
+
+
+def test_sample_neighbors():
+    # CSC graph: node j's in-neighbors are row[colptr[j]:colptr[j+1]]
+    row = np.array([1, 2, 3, 0, 2, 0, 1, 3, 0])
+    colptr = np.array([0, 3, 5, 8, 9])
+    paddle.seed(7)
+    nbrs, cnt = paddle.geometric.sample_neighbors(
+        paddle.to_tensor(row), paddle.to_tensor(colptr),
+        paddle.to_tensor(np.array([0, 2])), sample_size=2)
+    cnt = np.asarray(cnt._value)
+    assert cnt.tolist() == [2, 2]
+    nbrs = np.asarray(nbrs._value)
+    assert set(nbrs[:2]) <= {1, 2, 3} and set(nbrs[2:]) <= {0, 1, 3}
+    # full sampling (sample_size=-1) returns every neighbor in order
+    nbrs, cnt = paddle.geometric.sample_neighbors(
+        paddle.to_tensor(row), paddle.to_tensor(colptr),
+        paddle.to_tensor(np.array([1, 3])), sample_size=-1)
+    assert np.asarray(cnt._value).tolist() == [2, 1]
+    assert np.asarray(nbrs._value).tolist() == [0, 2, 0]
+    # eids passthrough
+    nbrs, cnt, eids = paddle.geometric.sample_neighbors(
+        paddle.to_tensor(row), paddle.to_tensor(colptr),
+        paddle.to_tensor(np.array([3])), sample_size=-1,
+        eids=paddle.to_tensor(np.arange(100, 109)), return_eids=True)
+    assert np.asarray(eids._value).tolist() == [108]
+
+
+def _brute_viterbi(pot, trans, L):
+    N = pot.shape[-1]
+    best, bp = -1e30, None
+    for p in itertools.product(range(N), repeat=int(L)):
+        s = pot[0, p[0]] + sum(pot[t, p[t]] + trans[p[t - 1], p[t]]
+                               for t in range(1, L))
+        if s > best:
+            best, bp = s, p
+    return best, list(bp)
+
+
+def test_viterbi_decode_vs_bruteforce():
+    rng = np.random.RandomState(0)
+    B, T, N = 3, 5, 4
+    pot = rng.rand(B, T, N).astype("float32")
+    trans = rng.rand(N, N).astype("float32")
+    lens = np.array([5, 3, 1])
+    sc, path = paddle.text.viterbi_decode(
+        paddle.to_tensor(pot), paddle.to_tensor(trans),
+        paddle.to_tensor(lens), include_bos_eos_tag=False)
+    sc, path = np.asarray(sc._value), np.asarray(path._value)
+    for b in range(B):
+        ref_s, ref_p = _brute_viterbi(pot[b], trans, lens[b])
+        assert abs(float(sc[b]) - ref_s) < 1e-4
+        assert path[b][:lens[b]].tolist() == ref_p
+
+
+def test_viterbi_decode_bos_eos():
+    rng = np.random.RandomState(4)
+    B, T, N = 2, 4, 5  # last two tags are stop/start per the convention
+    pot = rng.rand(B, T, N).astype("float32")
+    trans = rng.rand(N, N).astype("float32")
+    lens = np.array([4, 4])
+    sc, path = paddle.text.viterbi_decode(
+        paddle.to_tensor(pot), paddle.to_tensor(trans),
+        paddle.to_tensor(lens), include_bos_eos_tag=True)
+    # brute force with start/stop rows added
+    for b in range(B):
+        best, bp = -1e30, None
+        for p in itertools.product(range(N), repeat=T):
+            s = (trans[-1, p[0]] + pot[b, 0, p[0]]
+                 + sum(pot[b, t, p[t]] + trans[p[t - 1], p[t]]
+                       for t in range(1, T)) + trans[p[-1], -2])
+            if s > best:
+                best, bp = s, p
+        assert abs(float(np.asarray(sc._value)[b]) - best) < 1e-4
+        assert np.asarray(path._value)[b].tolist() == list(bp)
+
+
+def test_viterbi_decoder_layer():
+    trans = paddle.to_tensor(np.eye(3, dtype=np.float32))
+    dec = paddle.text.ViterbiDecoder(trans, include_bos_eos_tag=False)
+    pot = paddle.to_tensor(np.random.RandomState(5).rand(1, 3, 3)
+                           .astype("float32"))
+    sc, path = dec(pot, paddle.to_tensor(np.array([3])))
+    assert np.asarray(path._value).shape == (1, 3)
+
+
+def test_reindex_rejects_duplicate_nodes():
+    with pytest.raises(ValueError, match="unique"):
+        paddle.geometric.reindex_graph(
+            paddle.to_tensor(np.array([5, 5, 7])),
+            paddle.to_tensor(np.array([9, 9, 9])),
+            paddle.to_tensor(np.array([1, 1, 1])))

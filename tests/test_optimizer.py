@@ -92,3 +92,28 @@ class TestSemantics:
         opt = optimizer.SGD(learning_rate=0.1, parameters=[p])
         opt.clear_grad()
         assert p.grad is None
+
+
+class TestRegularizerModes:
+    def test_l2_decay_object(self):
+        v = np.array([2.0, -2.0], np.float32)
+        p = paddle.Parameter(paddle.to_tensor(v)._value)
+        p.grad = paddle.to_tensor(np.zeros(2, np.float32))
+        opt = optimizer.SGD(learning_rate=0.1, parameters=[p],
+                            weight_decay=paddle.regularizer.L2Decay(0.5))
+        opt.step()
+        # g = wd * p -> p_new = p - lr*wd*p = p * (1 - 0.05)
+        np.testing.assert_allclose(np.asarray(p._value), v * 0.95,
+                                   rtol=1e-6)
+
+    def test_l1_decay_is_subgradient(self):
+        v = np.array([2.0, -2.0], np.float32)
+        p = paddle.Parameter(paddle.to_tensor(v)._value)
+        p.grad = paddle.to_tensor(np.zeros(2, np.float32))
+        opt = optimizer.SGD(learning_rate=0.1, parameters=[p],
+                            weight_decay=paddle.regularizer.L1Decay(0.5))
+        opt.step()
+        # g = wd * sign(p) -> p_new = p - lr*wd*sign(p) = |p| - 0.05
+        np.testing.assert_allclose(np.asarray(p._value),
+                                   np.array([1.95, -1.95], np.float32),
+                                   rtol=1e-6)

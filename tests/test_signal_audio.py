@@ -1,0 +1,175 @@
+"""paddle.signal + paddle.audio (reference: python/paddle/signal.py,
+python/paddle/audio/) — stft/istft roundtrip, scipy window parity,
+feature pipeline shapes."""
+import numpy as np
+import pytest
+import scipy.signal.windows as sw
+
+import paddle_tpu as paddle
+
+
+def test_frame_overlap_add_inverse():
+    x = paddle.to_tensor(np.arange(32, dtype=np.float32))
+    f = paddle.signal.frame(x, frame_length=8, hop_length=8)
+    assert f.shape == [8, 4]
+    back = paddle.signal.overlap_add(f, hop_length=8)
+    np.testing.assert_allclose(np.asarray(back._value),
+                               np.arange(32, dtype=np.float32))
+
+
+def test_frame_first_axis():
+    x = paddle.to_tensor(np.random.rand(20, 3).astype("float32"))
+    f = paddle.signal.frame(x, frame_length=4, hop_length=2, axis=0)
+    assert f.shape == [9, 4, 3]
+
+
+def test_stft_istft_roundtrip():
+    rng = np.random.RandomState(0)
+    x = paddle.to_tensor(rng.rand(2, 800).astype("float32"))
+    S = paddle.signal.stft(x, n_fft=128, hop_length=32)
+    assert S.shape == [2, 65, 26]  # centered: 1 + (800+128-128)//32
+    assert "complex" in str(S.dtype)
+    y = paddle.signal.istft(S, n_fft=128, hop_length=32, length=800)
+    np.testing.assert_allclose(np.asarray(y._value),
+                               np.asarray(x._value), atol=1e-4)
+
+
+def test_stft_windowed_roundtrip_and_1d():
+    rng = np.random.RandomState(1)
+    x = paddle.to_tensor(rng.rand(600).astype("float32"))
+    w = paddle.audio.functional.get_window("hann", 100)
+    S = paddle.signal.stft(x, n_fft=100, hop_length=25, window=w)
+    assert S.shape[0] == 51
+    y = paddle.signal.istft(S, n_fft=100, hop_length=25, window=w,
+                            length=600)
+    np.testing.assert_allclose(np.asarray(y._value),
+                               np.asarray(x._value), atol=1e-4)
+
+
+def test_stft_not_onesided_normalized():
+    x = paddle.to_tensor(np.random.RandomState(2).rand(1, 256)
+                         .astype("float32"))
+    S = paddle.signal.stft(x, n_fft=64, hop_length=16, onesided=False,
+                           normalized=True)
+    assert S.shape == [1, 64, 17]
+
+
+@pytest.mark.parametrize("name", ["hann", "hamming", "blackman", "cosine",
+                                  "bohman", "triang", "bartlett"])
+def test_windows_match_scipy(name):
+    ours = np.asarray(paddle.audio.functional.get_window(name, 64)._value)
+    ref = sw.get_window(name, 64, fftbins=True).astype("float32")
+    np.testing.assert_allclose(ours, ref, atol=1e-5)
+
+
+def test_gaussian_tukey_windows():
+    ours = np.asarray(paddle.audio.functional.get_window(
+        ("gaussian", 7.0), 33, fftbins=False)._value)
+    ref = sw.gaussian(33, 7.0).astype("float32")
+    np.testing.assert_allclose(ours, ref, atol=1e-5)
+    ours = np.asarray(paddle.audio.functional.get_window(
+        ("tukey", 0.5), 32)._value)
+    ref = sw.get_window(("tukey", 0.5), 32, fftbins=True).astype("float32")
+    np.testing.assert_allclose(ours, ref, atol=1e-5)
+
+
+def test_mel_conversions():
+    hz = paddle.to_tensor(np.array([0.0, 440.0, 4000.0], dtype=np.float32))
+    mel = paddle.audio.functional.hz_to_mel(hz)
+    back = paddle.audio.functional.mel_to_hz(mel)
+    np.testing.assert_allclose(np.asarray(back._value),
+                               np.asarray(hz._value), rtol=1e-4, atol=1e-2)
+
+
+def test_fbank_matrix_properties():
+    fb = np.asarray(paddle.audio.functional.compute_fbank_matrix(
+        16000, 512, n_mels=40)._value)
+    assert fb.shape == (40, 257)
+    assert (fb >= 0).all()
+    # every mel filter has some support
+    assert (fb.sum(axis=1) > 0).all()
+
+
+def test_power_to_db():
+    x = paddle.to_tensor(np.array([1.0, 10.0, 100.0], dtype=np.float32))
+    db = np.asarray(paddle.audio.functional.power_to_db(x)._value)
+    np.testing.assert_allclose(db, [0.0, 10.0, 20.0], atol=1e-4)
+
+
+def test_dct_orthonormal():
+    d = np.asarray(paddle.audio.functional.create_dct(13, 40)._value)
+    # ortho-normalized DCT-II columns are orthonormal
+    gram = d.T @ d
+    np.testing.assert_allclose(gram, np.eye(13), atol=1e-4)
+
+
+def test_feature_layers_pipeline():
+    x = paddle.to_tensor(np.random.RandomState(0).rand(2, 2048)
+                         .astype("float32"))
+    spec = paddle.audio.features.Spectrogram(n_fft=256, hop_length=128)
+    s = spec(x)
+    assert s.shape == [2, 129, 17]
+    assert (np.asarray(s._value) >= 0).all()
+    mel = paddle.audio.features.MelSpectrogram(sr=16000, n_fft=256,
+                                               hop_length=128, n_mels=32)
+    m = mel(x)
+    assert m.shape == [2, 32, 17]
+    logmel = paddle.audio.features.LogMelSpectrogram(
+        sr=16000, n_fft=256, hop_length=128, n_mels=32)
+    assert logmel(x).shape == [2, 32, 17]
+    mfcc = paddle.audio.features.MFCC(sr=16000, n_mfcc=13, n_fft=256,
+                                      hop_length=128, n_mels=32)
+    assert mfcc(x).shape == [2, 13, 17]
+
+
+def test_frame_1d_axis0():
+    # paddle semantics: 1-D input with axis=0 -> [num_frames, frame_length]
+    f = paddle.signal.frame(
+        paddle.to_tensor(np.arange(32, dtype=np.float32)), 8, 8, axis=0)
+    assert f.shape == [4, 8]
+
+
+def test_stft_complex_onesided_raises():
+    x = paddle.to_tensor((np.random.rand(256)
+                          + 1j * np.random.rand(256)).astype("complex64"))
+    with pytest.raises(Exception, match="onesided"):
+        paddle.signal.stft(x, n_fft=64)
+    S = paddle.signal.stft(x, n_fft=64, onesided=False)  # full spectrum ok
+    assert S.shape == [64, 17]
+
+
+def test_istft_window_shape_validated():
+    S = paddle.signal.stft(
+        paddle.to_tensor(np.random.rand(512).astype("float32")), n_fft=64)
+    bad = paddle.audio.functional.get_window("hann", 100)
+    with pytest.raises(Exception, match="window"):
+        paddle.signal.istft(S, n_fft=64, window=bad)
+
+
+def test_mfcc_grad_flows():
+    x = paddle.to_tensor(np.random.RandomState(0).rand(1, 1024)
+                         .astype("float32"))
+    x.stop_gradient = False
+    m = paddle.audio.features.MFCC(sr=16000, n_mfcc=13, n_fft=256,
+                                   hop_length=128, n_mels=32)(x)
+    m.sum().backward()
+    g = np.asarray(x.grad._value)
+    assert np.isfinite(g).all() and np.abs(g).max() > 0
+
+
+def test_stft_grad_flows():
+    x = paddle.to_tensor(np.random.RandomState(3).rand(300)
+                         .astype("float32"))
+    x.stop_gradient = False
+    S = paddle.signal.stft(x, n_fft=64, hop_length=16)
+    loss = S.abs().sum()
+    loss.backward()
+    g = np.asarray(x.grad._value)
+    assert g.shape == (300,) and np.isfinite(g).all() and np.abs(g).max() > 0
+
+
+def test_stft_complex_window_onesided_raises():
+    x = paddle.to_tensor(np.random.rand(256).astype("float32"))
+    w = (np.ones(64) + 1j * np.ones(64)).astype("complex64")
+    with pytest.raises(Exception, match="onesided"):
+        paddle.signal.stft(x, n_fft=64, window=paddle.to_tensor(w))
