@@ -165,7 +165,7 @@ def bench_moe(on_tpu, dev):
 # ---------------------------------------------------------------------------
 # 5. Llama-7B generation (BASELINE row 5)
 # ---------------------------------------------------------------------------
-def bench_llama_decode(on_tpu, dev):
+def bench_llama_decode(on_tpu, dev, weight_only=False):
     import paddle_tpu as paddle
     from paddle_tpu.inference import Config, create_predictor
     from paddle_tpu.models.llama import LlamaForCausalLM, llama_7b, \
@@ -183,7 +183,10 @@ def bench_llama_decode(on_tpu, dev):
     try:
         paddle.seed(0)
         model = LlamaForCausalLM(cfg)
-        pred = create_predictor(Config().set_model(model))
+        conf = Config().set_model(model)
+        if weight_only:
+            conf.enable_weight_only("weight_only_int8")
+        pred = create_predictor(conf)
         r = np.random.RandomState(0)
         prompt = paddle.to_tensor(
             r.randint(0, cfg.vocab_size, (1, S_ctx)))
@@ -202,12 +205,18 @@ def bench_llama_decode(on_tpu, dev):
         dec_s = max(t_full - t_prefill, 1e-9)
         tok_s = (n_new - 1) / dec_s
         ms_tok = dec_s / (n_new - 1) * 1e3
-        # decode is HBM-bound: roofline = BW / bytes-touched-per-token
+        # decode is HBM-bound: roofline = BW / bytes-touched-per-token.
+        # vs_baseline is ALWAYS the bf16 (2-byte) roofline fraction, so
+        # the int8 line shows its win as a fraction > the fp line's
+        # (most weights are then 1 byte; the lm_head stays fp).
         n_params = cfg.num_params()
         roofline = (hbm_bw / (2.0 * n_params)) if hbm_bw else 0.0
+        name = "llama7b_decode_tokens_per_sec" if on_tpu \
+            else "llama_smoke_decode_tokens_per_sec"
+        if weight_only:
+            name += "_int8"
         _emit({
-            "metric": "llama7b_decode_tokens_per_sec" if on_tpu
-            else "llama_smoke_decode_tokens_per_sec",
+            "metric": name,
             "value": round(tok_s, 2),
             "unit": "tokens/s",
             "vs_baseline": round(tok_s / roofline, 4) if roofline else 0.0,
@@ -382,10 +391,15 @@ def _run_one(name):
                "trace": traceback.format_exc()[-400:]})
 
 
+def bench_llama_decode_int8(on_tpu, dev):
+    bench_llama_decode(on_tpu, dev, weight_only=True)
+
+
 def main(argv):
     _BENCHES.update(resnet=bench_resnet, moe=bench_moe,
                     llama_decode=bench_llama_decode, gpt=bench_gpt,
-                    kernel_parity=bench_kernel_parity)
+                    kernel_parity=bench_kernel_parity,
+                    llama_decode_int8=bench_llama_decode_int8)
     if len(argv) > 1 and argv[1] == "--only":
         _run_one(argv[2])
         return
@@ -394,7 +408,8 @@ def main(argv):
     # the 7B decode + 1.3B train benches each need most of a v5e chip
     import subprocess
 
-    for name in ("kernel_parity", "resnet", "moe", "llama_decode", "gpt"):
+    for name in ("kernel_parity", "resnet", "moe", "llama_decode",
+                 "llama_decode_int8", "gpt"):
         try:
             subprocess.run([sys.executable, __file__, "--only", name],
                            timeout=1200)

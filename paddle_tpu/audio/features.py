@@ -30,6 +30,11 @@ class Spectrogram(nn.Layer):
         spec = signal.stft(x, self.n_fft, self.hop_length, self.win_length,
                            window=self.window, center=self.center,
                            pad_mode=self.pad_mode)
+        if self.power == 2.0:  # power spectrum: skip the |.| sqrt
+            from ..ops.math import imag, real
+
+            re, im = real(spec), imag(spec)
+            return re * re + im * im
         mag = spec.abs()
         return mag if self.power == 1.0 else mag.pow(self.power)
 

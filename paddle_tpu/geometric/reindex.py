@@ -32,6 +32,10 @@ def reindex_graph(x, neighbors, count, value_buffer=None, index_buffer=None,
         # value-deduplicated node table (the reference requires unique
         # input nodes too — it just corrupts silently)
         raise ValueError("reindex_graph requires unique ids in x")
+    if int(cnt.sum()) != len(nbr):
+        raise ValueError(
+            f"count.sum() ({int(cnt.sum())}) must equal len(neighbors) "
+            f"({len(nbr)})")
 
     mapping = {}
     for v in x_np.tolist():

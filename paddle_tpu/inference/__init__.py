@@ -103,6 +103,8 @@ class Config:
         self.generation = GenerationConfig()
         self._mem_optim = True
         self._ir_optim = True
+        self._weight_only_algo: Optional[str] = None
+        self._weight_only_skip = ("lm_head",)
 
     # -- model sources --------------------------------------------------
     def set_model(self, model) -> "Config":
@@ -118,6 +120,21 @@ class Config:
 
     def set_params_file(self, path: str) -> "Config":
         self.params_file = path
+        return self
+
+    def enable_weight_only(self, algo: str = "weight_only_int8",
+                           skip=("lm_head",)) -> "Config":
+        """Serve with int8/int4 weights resident in HBM
+        (nn.quant.quantize_for_serving): decode is weight-bandwidth
+        bound, so tokens/s scales with the byte shrink. ``skip`` keeps
+        named layers (default: the LM head) in full precision."""
+        if algo not in ("weight_only_int8", "weight_only_int4"):
+            raise ValueError(
+                f"enable_weight_only supports weight_only_int8/int4, got "
+                f"{algo!r} (llm.int8 is the functional nn.quant."
+                f"llm_int8_linear, not a serving swap)")
+        self._weight_only_algo = algo
+        self._weight_only_skip = tuple(skip)
         return self
 
     # -- reference-compat knobs (XLA owns these; kept as recorded flags)
@@ -173,6 +190,11 @@ class Predictor:
             model.set_state_dict(load(path))
         if config.dtype:
             model.astype(config.dtype)
+        if config._weight_only_algo:
+            from ..nn.quant import quantize_for_serving
+
+            quantize_for_serving(model, config._weight_only_algo,
+                                 config._weight_only_skip)
         return model
 
     # ------------------------------------------------------------------

@@ -1,5 +1,6 @@
 """paddle_tpu.nn — layers + functional (paddle.nn analog)."""
 from . import functional  # noqa: F401
+from . import quant  # noqa: F401
 from . import initializer  # noqa: F401
 from .activation import *  # noqa: F401,F403
 from .common import *  # noqa: F401,F403

@@ -208,3 +208,11 @@ def test_reindex_rejects_duplicate_nodes():
             paddle.to_tensor(np.array([5, 5, 7])),
             paddle.to_tensor(np.array([9, 9, 9])),
             paddle.to_tensor(np.array([1, 1, 1])))
+
+
+def test_reindex_rejects_count_mismatch():
+    with pytest.raises(ValueError, match="count.sum"):
+        paddle.geometric.reindex_graph(
+            paddle.to_tensor(np.array([0, 1])),
+            paddle.to_tensor(np.array([5, 6, 7])),
+            paddle.to_tensor(np.array([2, 2])))

@@ -1,0 +1,103 @@
+"""Weight-only quantized serving (reference: python/paddle/nn/quant/
+quantized_linear.py — weight_quantize/weight_only_linear/
+llm_int8_linear over the fusion CUDA kernels)."""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+
+
+def _setup():
+    rng = np.random.RandomState(0)
+    w = paddle.to_tensor(rng.randn(64, 32).astype("float32") * 0.1)
+    x = paddle.to_tensor(rng.randn(4, 64).astype("float32"))
+    b = paddle.to_tensor(rng.randn(32).astype("float32"))
+    ref = np.asarray(x._value) @ np.asarray(w._value) + np.asarray(b._value)
+    return w, x, b, ref
+
+
+def test_weight_quantize_roundtrip_int8():
+    w, *_ = _setup()
+    q, s = nn.quant.weight_quantize(w, "weight_only_int8")
+    assert q.shape == [32, 64] and "int8" in str(q.dtype)
+    back = nn.quant.weight_dequantize(q, s, out_dtype="float32")
+    err = np.abs(np.asarray(back._value) - np.asarray(w._value)).max()
+    assert err <= float(np.asarray(s._value).max()) / 2 + 1e-6
+
+
+def test_weight_quantize_roundtrip_int4():
+    w, *_ = _setup()
+    q, s = nn.quant.weight_quantize(w, "weight_only_int4")
+    assert q.shape == [32, 32]  # two nibbles per byte
+    back = nn.quant.weight_dequantize(q, s, "weight_only_int4", "float32")
+    err = np.abs(np.asarray(back._value) - np.asarray(w._value)).max()
+    assert err <= float(np.asarray(s._value).max()) / 2 + 1e-6
+
+
+def test_weight_only_linear_parity():
+    w, x, b, ref = _setup()
+    q, s = nn.quant.weight_quantize(w, "weight_only_int8")
+    y = np.asarray(nn.quant.weight_only_linear(x, q, b, s, "int8")._value)
+    assert np.abs(y - ref).max() / np.abs(ref).max() < 0.02
+    q4, s4 = nn.quant.weight_quantize(w, "weight_only_int4")
+    y4 = np.asarray(nn.quant.weight_only_linear(x, q4, b, s4, "int4")._value)
+    assert np.abs(y4 - ref).max() / np.abs(ref).max() < 0.3
+
+
+def test_llm_int8_linear_parity():
+    w, x, b, ref = _setup()
+    q, s = nn.quant.weight_quantize(w, "llm.int8")
+    y = np.asarray(nn.quant.llm_int8_linear(x, q, b, s, 2.0)._value)
+    assert np.abs(y - ref).max() / np.abs(ref).max() < 0.05
+
+
+def test_weight_only_layer_and_swap():
+    paddle.seed(0)
+    m = nn.Sequential(nn.Linear(64, 32), nn.ReLU(), nn.Linear(32, 16))
+    x = paddle.to_tensor(np.random.RandomState(1).randn(2, 64)
+                         .astype("float32"))
+    ref = np.asarray(m(x)._value)
+    nn.quant.quantize_for_serving(m)
+    assert isinstance(m[0], nn.quant.WeightOnlyLinear)
+    assert isinstance(m[2], nn.quant.WeightOnlyLinear)
+    out = np.asarray(m(x)._value)
+    assert np.abs(out - ref).max() / (np.abs(ref).max() + 1e-9) < 0.05
+    # quantized weights are registered parameters (bindable buffers)
+    names = [n for n, _ in m.named_parameters()]
+    assert any("weight_quant" in n for n in names)
+
+
+def test_predictor_weight_only_greedy_parity():
+    from paddle_tpu.inference import Config, create_predictor
+    from paddle_tpu.models.llama import LlamaForCausalLM, llama_tiny
+
+    rng = np.random.RandomState(3)
+    prompt = paddle.to_tensor(rng.randint(0, 256, (1, 24)))
+    paddle.seed(0)
+    pred_fp = create_predictor(Config().set_model(
+        LlamaForCausalLM(llama_tiny())))
+    out_fp = np.asarray(pred_fp.generate(prompt, max_new_tokens=8)._value)
+    paddle.seed(0)
+    pred_q = create_predictor(Config().set_model(
+        LlamaForCausalLM(llama_tiny())).enable_weight_only())
+    out_q = np.asarray(pred_q.generate(prompt, max_new_tokens=8)._value)
+    assert (out_fp == out_q).mean() > 0.9
+
+
+def test_enable_weight_only_validates_algo():
+    from paddle_tpu.inference import Config
+
+    import pytest
+    with pytest.raises(ValueError, match="weight_only_int8"):
+        Config().enable_weight_only("llm.int8")
+
+
+def test_int4_odd_indim_warns():
+    import warnings
+
+    m = nn.Sequential(nn.Linear(7, 4))
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        nn.quant.quantize_for_serving(m, "weight_only_int4")
+    assert any("odd in_features" in str(x.message) for x in w)
+    assert isinstance(m[0], nn.Linear)  # kept fp
