@@ -1,0 +1,662 @@
+"""Detection/vision operators (paddle.vision.ops analog).
+
+(reference: python/paddle/vision/ops.py over phi roi_align / roi_pool /
+psroi_pool / nms / yolo_box / prior_box / box_coder /
+distribute_fpn_proposals / deform_conv CUDA kernels.)
+
+TPU design notes:
+- roi_align / deform_conv2d are gather + bilinear-weight compositions —
+  pure XLA HLOs, differentiable, jit/shard-compatible.
+- roi_pool / psroi_pool use exact integer-quantized bins expressed as
+  position masks with a fused where+reduce (XLA never materializes the
+  masked copies).
+- nms / distribute_fpn_proposals have data-dependent output SHAPES, so
+  they run host-side on numpy by design (same stance as
+  geometric.sampling); their outputs feed traced programs as inputs.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .. import nn
+from ..core.dispatch import def_op
+from ..core.enforce import enforce
+from ..tensor import Tensor, to_tensor
+
+__all__ = ["nms", "roi_align", "RoIAlign", "roi_pool", "RoIPool",
+           "psroi_pool", "PSRoIPool", "box_coder", "yolo_box", "prior_box",
+           "distribute_fpn_proposals", "deform_conv2d", "DeformConv2D",
+           "ConvNormActivation", "read_file", "decode_jpeg"]
+
+
+def read_file(filename, name=None):
+    """Raw file bytes as a uint8 tensor (reference: vision/ops.py:1301)."""
+    with open(filename, "rb") as f:
+        return to_tensor(np.frombuffer(f.read(), np.uint8))
+
+
+def decode_jpeg(x, mode="unchanged", name=None):
+    """JPEG decode (reference: vision/ops.py:1344 over nvjpeg). No JPEG
+    codec ships in this environment; PIL is used when present."""
+    try:
+        import io
+
+        from PIL import Image
+    except ImportError as e:  # pragma: no cover
+        raise NotImplementedError(
+            "decode_jpeg needs Pillow (no nvjpeg analog on TPU hosts); "
+            "it is not available in this build") from e
+    img = Image.open(io.BytesIO(_np(x).tobytes()))
+    if mode != "unchanged":
+        img = img.convert("L" if mode == "gray" else "RGB")
+    arr = np.asarray(img)
+    if arr.ndim == 2:
+        arr = arr[None]
+    else:
+        arr = arr.transpose(2, 0, 1)
+    return to_tensor(arr)
+
+
+def _np(x):
+    return np.asarray(x._value if isinstance(x, Tensor) else x)
+
+
+# ---------------------------------------------------------------------------
+# NMS (host-side: kept-set size is data-dependent)
+# ---------------------------------------------------------------------------
+def _iou_matrix(b):
+    x1, y1, x2, y2 = b[:, 0], b[:, 1], b[:, 2], b[:, 3]
+    area = (x2 - x1) * (y2 - y1)
+    ix1 = np.maximum(x1[:, None], x1[None, :])
+    iy1 = np.maximum(y1[:, None], y1[None, :])
+    ix2 = np.minimum(x2[:, None], x2[None, :])
+    iy2 = np.minimum(y2[:, None], y2[None, :])
+    iw = np.clip(ix2 - ix1, 0, None)
+    ih = np.clip(iy2 - iy1, 0, None)
+    inter = iw * ih
+    return inter / np.maximum(area[:, None] + area[None, :] - inter, 1e-10)
+
+
+def _nms_single(boxes, scores, thr):
+    order = np.argsort(-scores, kind="stable")
+    iou = _iou_matrix(boxes)
+    keep = []
+    alive = np.ones(len(boxes), bool)
+    for i in order:
+        if not alive[i]:
+            continue
+        keep.append(i)
+        alive &= iou[i] <= thr
+        alive[i] = False
+    return np.asarray(keep, np.int64)
+
+
+def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
+        categories=None, top_k=None):
+    """Indices of boxes kept by (optionally per-category) NMS, sorted by
+    descending score (reference: vision/ops.py:1867)."""
+    b = _np(boxes).astype(np.float64)
+    n = len(b)
+    s = (_np(scores).astype(np.float64) if scores is not None
+         else np.arange(n, 0, -1, dtype=np.float64))
+    if category_idxs is None:
+        keep = _nms_single(b, s, iou_threshold)
+    else:
+        cats = _np(category_idxs)
+        enforce(categories is not None,
+                "categories must accompany category_idxs")
+        parts = []
+        for c in categories:
+            idx = np.nonzero(cats == c)[0]
+            if len(idx):
+                parts.append(idx[_nms_single(b[idx], s[idx],
+                                             iou_threshold)])
+        keep = np.concatenate(parts) if parts else np.zeros(0, np.int64)
+        keep = keep[np.argsort(-s[keep], kind="stable")]
+    if top_k is not None:
+        keep = keep[: int(top_k)]
+    return to_tensor(keep)
+
+
+# ---------------------------------------------------------------------------
+# RoI ops (traced, differentiable)
+# ---------------------------------------------------------------------------
+def _box_to_image(boxes_num):
+    """Per-box image index from the per-image box counts (host)."""
+    bn = _np(boxes_num).astype(np.int64)
+    return np.repeat(np.arange(len(bn)), bn)
+
+
+def _pair(v):
+    return (int(v), int(v)) if np.isscalar(v) else (int(v[0]), int(v[1]))
+
+
+@def_op("roi_align_kernel")
+def _roi_align_kernel(x, boxes, box_im, ph, pw, spatial_scale,
+                      sampling_ratio, aligned):
+    N, C, H, W = x.shape
+    off = 0.5 if aligned else 0.0
+    bx = boxes.astype(jnp.float32) * spatial_scale - off
+    x1, y1, x2, y2 = bx[:, 0], bx[:, 1], bx[:, 2], bx[:, 3]
+    roi_w = x2 - x1
+    roi_h = y2 - y1
+    if not aligned:
+        roi_w = jnp.maximum(roi_w, 1.0)
+        roi_h = jnp.maximum(roi_h, 1.0)
+    sr = int(sampling_ratio)
+    # sample grid: [ph*sr] x [pw*sr] per box
+    def axis_points(start, extent, bins, s):
+        # [B, bins*s] bilinear sample coordinates
+        step = extent[:, None] / (bins * s)
+        idx = jnp.arange(bins * s, dtype=jnp.float32)[None, :]
+        return start[:, None] + (idx + 0.5) * step
+
+    ys = axis_points(y1, roi_h, ph, sr)                  # [B, ph*sr]
+    xs = axis_points(x1, roi_w, pw, sr)                  # [B, pw*sr]
+
+    def bilinear_1d(coords, size):
+        c = jnp.clip(coords, 0.0, size - 1.0)
+        lo = jnp.floor(c)
+        w_hi = c - lo
+        lo = lo.astype(jnp.int32)
+        hi = jnp.minimum(lo + 1, size - 1)
+        return lo, hi, 1.0 - w_hi, w_hi
+
+    ylo, yhi, wy0, wy1 = bilinear_1d(ys, H)
+    xlo, xhi, wx0, wx1 = bilinear_1d(xs, W)
+    feats = x[box_im]                                    # [B, C, H, W]
+
+    def gather_y(rows):                                  # rows [B, S]
+        return jnp.take_along_axis(
+            feats, rows[:, None, :, None], axis=2)       # [B, C, S, W]
+
+    def gather_xy(rows_g, cols):                         # -> [B, C, S, T]
+        return jnp.take_along_axis(
+            rows_g, cols[:, None, None, :], axis=3)
+
+    top = gather_y(ylo)
+    bot = gather_y(yhi)
+    v = (gather_xy(top, xlo) * (wy0[:, None, :, None] * wx0[:, None, None, :])
+         + gather_xy(top, xhi) * (wy0[:, None, :, None] * wx1[:, None, None, :])
+         + gather_xy(bot, xlo) * (wy1[:, None, :, None] * wx0[:, None, None, :])
+         + gather_xy(bot, xhi) * (wy1[:, None, :, None] * wx1[:, None, None, :]))
+    B = boxes.shape[0]
+    v = v.reshape(B, C, ph, sr, pw, sr)
+    return v.mean(axis=(3, 5)).astype(x.dtype)
+
+
+def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+              sampling_ratio=-1, aligned=True, name=None):
+    """RoI Align (Mask R-CNN): [num_boxes, C, ph, pw] bilinear-averaged
+    box features (reference: vision/ops.py:1640).
+
+    sampling_ratio=-1 deviation: the reference adapts the per-bin
+    sample count per box (ceil(roi/bins)); a traced program needs ONE
+    static grid, so the count is the largest box's need (host-read from
+    the box values), clamped to 8 — denser than the reference for small
+    boxes (more accurate), capped for huge ones."""
+    ph, pw = _pair(output_size)
+    box_im = _box_to_image(boxes_num)
+    sr = int(sampling_ratio)
+    if sr <= 0:
+        b = _np(boxes).astype(np.float64) * float(spatial_scale)
+        ext = np.maximum(np.maximum(b[:, 2] - b[:, 0],
+                                    b[:, 3] - b[:, 1]), 1.0)
+        sr = int(np.clip(np.ceil(ext.max() / max(ph, pw)) if len(b)
+                         else 1, 1, 8))
+    return _roi_align_kernel(x, boxes, jnp.asarray(box_im), ph, pw,
+                             float(spatial_scale), sr, bool(aligned))
+
+
+class RoIAlign(nn.Layer):
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self._output_size = output_size
+        self._spatial_scale = spatial_scale
+
+    def forward(self, x, boxes, boxes_num):
+        return roi_align(x, boxes, boxes_num, self._output_size,
+                         self._spatial_scale)
+
+
+@def_op("roi_pool_kernel")
+def _roi_pool_kernel(x, boxes, box_im, ph, pw, spatial_scale):
+    N, C, H, W = x.shape
+    bx = boxes.astype(jnp.float32) * spatial_scale
+    x1 = jnp.round(bx[:, 0]).astype(jnp.int32)
+    y1 = jnp.round(bx[:, 1]).astype(jnp.int32)
+    x2 = jnp.round(bx[:, 2]).astype(jnp.int32)
+    y2 = jnp.round(bx[:, 3]).astype(jnp.int32)
+    roi_w = jnp.maximum(x2 - x1 + 1, 1)
+    roi_h = jnp.maximum(y2 - y1 + 1, 1)
+
+    def bin_bounds(start, extent, bins, size):
+        i = jnp.arange(bins, dtype=jnp.float32)
+        lo = jnp.floor(i[None, :] * extent[:, None] / bins)
+        hi = jnp.ceil((i[None, :] + 1) * extent[:, None] / bins)
+        lo = jnp.clip(start[:, None] + lo.astype(jnp.int32), 0, size)
+        hi = jnp.clip(start[:, None] + hi.astype(jnp.int32), 0, size)
+        return lo, hi                                     # [B, bins]
+
+    hlo, hhi = bin_bounds(y1, roi_h.astype(jnp.float32), ph, H)
+    wlo, whi = bin_bounds(x1, roi_w.astype(jnp.float32), pw, W)
+    hpos = jnp.arange(H)[None, None, :]                   # [1, 1, H]
+    wpos = jnp.arange(W)[None, None, :]
+    hmask = (hpos >= hlo[:, :, None]) & (hpos < hhi[:, :, None])  # [B,ph,H]
+    wmask = (wpos >= wlo[:, :, None]) & (wpos < whi[:, :, None])  # [B,pw,W]
+    feats = x[box_im].astype(jnp.float32)                 # [B, C, H, W]
+    neg = jnp.float32(-3.4e38)
+    # fused where+max over H then W; empty bins fall back to 0
+    t = jnp.where(hmask[:, None, :, :, None], feats[:, :, None], neg)
+    t = t.max(axis=3)                                     # [B, C, ph, W]
+    t = jnp.where(wmask[:, None, None, :, :], t[:, :, :, None], neg)
+    t = t.max(axis=4)                                     # [B, C, ph, pw]
+    empty = (~hmask.any(2))[:, None, :, None] | (~wmask.any(2))[:, None, None]
+    return jnp.where(empty, 0.0, t).astype(x.dtype)
+
+
+def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+             name=None):
+    """RoI max-pool with integer-quantized bins (reference:
+    vision/ops.py:1514)."""
+    ph, pw = _pair(output_size)
+    box_im = _box_to_image(boxes_num)
+    return _roi_pool_kernel(x, boxes, jnp.asarray(box_im), ph, pw,
+                            float(spatial_scale))
+
+
+class RoIPool(nn.Layer):
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self._output_size = output_size
+        self._spatial_scale = spatial_scale
+
+    def forward(self, x, boxes, boxes_num):
+        return roi_pool(x, boxes, boxes_num, self._output_size,
+                        self._spatial_scale)
+
+
+@def_op("psroi_pool_kernel")
+def _psroi_pool_kernel(x, boxes, box_im, ph, pw, spatial_scale):
+    N, C, H, W = x.shape
+    enforce(C % (ph * pw) == 0,
+            lambda: f"psroi_pool needs channels ({C}) divisible by "
+                    f"output_size^2 ({ph * pw})")
+    out_c = C // (ph * pw)
+    bx = boxes.astype(jnp.float32) * spatial_scale
+    x1, y1, x2, y2 = bx[:, 0], bx[:, 1], bx[:, 2], bx[:, 3]
+    roi_w = jnp.maximum(x2 - x1, 0.1)
+    roi_h = jnp.maximum(y2 - y1, 0.1)
+
+    def bin_bounds(start, extent, bins, size):
+        i = jnp.arange(bins, dtype=jnp.float32)
+        lo = jnp.floor(start[:, None] + i[None, :] * extent[:, None] / bins)
+        hi = jnp.ceil(start[:, None]
+                      + (i[None, :] + 1) * extent[:, None] / bins)
+        return (jnp.clip(lo, 0, size).astype(jnp.int32),
+                jnp.clip(hi, 0, size).astype(jnp.int32))
+
+    hlo, hhi = bin_bounds(y1, roi_h, ph, H)
+    wlo, whi = bin_bounds(x1, roi_w, pw, W)
+    hpos = jnp.arange(H)[None, None, :]
+    wpos = jnp.arange(W)[None, None, :]
+    hmask = (hpos >= hlo[:, :, None]) & (hpos < hhi[:, :, None])
+    wmask = (wpos >= wlo[:, :, None]) & (wpos < whi[:, :, None])
+    B = boxes.shape[0]
+    # channel layout: channel (c_out * ph + i) * pw + j feeds bin (i, j)
+    feats = x[box_im].reshape(B, out_c, ph, pw, H, W).astype(jnp.float32)
+    m = (hmask[:, None, :, None, :, None]
+         & wmask[:, None, None, :, None, :])
+    s = jnp.where(m, feats, 0.0).sum(axis=(4, 5))
+    cnt = m.sum(axis=(4, 5)).astype(jnp.float32)
+    return (s / jnp.maximum(cnt, 1.0)).astype(x.dtype)
+
+
+def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+               name=None):
+    """Position-sensitive RoI average pool (R-FCN; reference:
+    vision/ops.py:1393)."""
+    ph, pw = _pair(output_size)
+    box_im = _box_to_image(boxes_num)
+    return _psroi_pool_kernel(x, boxes, jnp.asarray(box_im), ph, pw,
+                              float(spatial_scale))
+
+
+class PSRoIPool(nn.Layer):
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self._output_size = output_size
+        self._spatial_scale = spatial_scale
+
+    def forward(self, x, boxes, boxes_num):
+        return psroi_pool(x, boxes, boxes_num, self._output_size,
+                          self._spatial_scale)
+
+
+# ---------------------------------------------------------------------------
+# Box coding / anchors / YOLO decode (traced)
+# ---------------------------------------------------------------------------
+@def_op("box_coder")
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type="encode_center_size", box_normalized=True,
+              axis=0):
+    """Encode/decode boxes against priors (reference: vision/
+    ops.py:573; phi box_coder kernel)."""
+    pb = prior_box.astype(jnp.float32)
+    tb = target_box.astype(jnp.float32)
+    norm = 0.0 if box_normalized else 1.0
+    pw = pb[:, 2] - pb[:, 0] + norm
+    ph_ = pb[:, 3] - pb[:, 1] + norm
+    pcx = pb[:, 0] + pw * 0.5
+    pcy = pb[:, 1] + ph_ * 0.5
+    if prior_box_var is not None:
+        var = prior_box_var.astype(jnp.float32)
+        if var.ndim == 1:
+            var = jnp.broadcast_to(var[None, :], pb.shape)
+    else:
+        var = jnp.ones_like(pb)
+    if code_type == "encode_center_size":
+        tw = tb[:, 2] - tb[:, 0] + norm
+        th = tb[:, 3] - tb[:, 1] + norm
+        tcx = tb[:, 0] + tw * 0.5
+        tcy = tb[:, 1] + th * 0.5
+        out = jnp.stack([
+            (tcx[:, None] - pcx[None, :]) / pw[None, :],
+            (tcy[:, None] - pcy[None, :]) / ph_[None, :],
+            jnp.log(tw[:, None] / pw[None, :]),
+            jnp.log(th[:, None] / ph_[None, :]),
+        ], axis=-1) / var[None, :, :]
+        return out                                  # [T, P, 4]
+    # decode_center_size: target [P, 4] or [P, M, 4] deltas
+    enforce(code_type == "decode_center_size",
+            lambda: f"unknown code_type {code_type!r}")
+    t = tb if tb.ndim == 3 else tb[:, None, :]
+    if axis == 0:
+        pcx_, pcy_, pw_, ph2 = (pcx[:, None], pcy[:, None],
+                                pw[:, None], ph_[:, None])
+        v = var[:, None, :]
+    else:
+        pcx_, pcy_, pw_, ph2 = (pcx[None, :], pcy[None, :],
+                                pw[None, :], ph_[None, :])
+        v = var[None, :, :]
+    dcx = v[..., 0] * t[..., 0] * pw_ + pcx_
+    dcy = v[..., 1] * t[..., 1] * ph2 + pcy_
+    dw = jnp.exp(v[..., 2] * t[..., 2]) * pw_
+    dh = jnp.exp(v[..., 3] * t[..., 3]) * ph2
+    out = jnp.stack([dcx - dw * 0.5, dcy - dh * 0.5,
+                     dcx + dw * 0.5 - norm, dcy + dh * 0.5 - norm],
+                    axis=-1)
+    return out if tb.ndim == 3 else out[:, 0, :]
+
+
+@def_op("yolo_box")
+def yolo_box(x, img_size, anchors, class_num, conf_thresh,
+             downsample_ratio, clip_bbox=True, scale_x_y=1.0,
+             iou_aware=False, iou_aware_factor=0.5):
+    """Decode YOLOv3 head output into boxes+scores (reference: vision/
+    ops.py:266; phi yolo_box kernel). Returns (boxes [N, H*W*na, 4],
+    scores [N, H*W*na, class_num])."""
+    anchors = list(anchors)
+    na = len(anchors) // 2
+    N, C, H, W = x.shape
+    xin = x.astype(jnp.float32)
+    if iou_aware:
+        # iou-aware head layout (GetIoUIndex, yolo_box_util.h:67): the
+        # first na channels are iou logits, the rest the standard head
+        ioup = jax.nn.sigmoid(xin[:, :na])            # [N, na, H, W]
+        xin = xin[:, na:]
+    xf = xin.reshape(N, na, (C - (na if iou_aware else 0)) // na, H, W)
+    grid_x = jnp.arange(W, dtype=jnp.float32)[None, None, None, :]
+    grid_y = jnp.arange(H, dtype=jnp.float32)[None, None, :, None]
+    bx = (jax.nn.sigmoid(xf[:, :, 0]) * scale_x_y
+          - (scale_x_y - 1) / 2 + grid_x) / W
+    by = (jax.nn.sigmoid(xf[:, :, 1]) * scale_x_y
+          - (scale_x_y - 1) / 2 + grid_y) / H
+    aw = jnp.asarray(anchors[0::2], jnp.float32)[None, :, None, None]
+    ah = jnp.asarray(anchors[1::2], jnp.float32)[None, :, None, None]
+    in_w = float(downsample_ratio * W)
+    in_h = float(downsample_ratio * H)
+    bw = jnp.exp(xf[:, :, 2]) * aw / in_w
+    bh = jnp.exp(xf[:, :, 3]) * ah / in_h
+    conf = jax.nn.sigmoid(xf[:, :, 4])
+    if iou_aware:
+        # conf^(1-f) * iou^f (cpu/yolo_box_kernel.cc:85)
+        conf = (conf ** (1.0 - iou_aware_factor)) * \
+            (ioup ** iou_aware_factor)
+    probs = jax.nn.sigmoid(xf[:, :, 5:5 + class_num])
+    score = conf[:, :, None] * probs
+    keep = conf > conf_thresh
+    img_h = img_size[:, 0].astype(jnp.float32)[:, None, None, None]
+    img_w = img_size[:, 1].astype(jnp.float32)[:, None, None, None]
+    x1 = (bx - bw / 2) * img_w
+    y1 = (by - bh / 2) * img_h
+    x2 = (bx + bw / 2) * img_w
+    y2 = (by + bh / 2) * img_h
+    if clip_bbox:
+        x1 = jnp.clip(x1, 0)
+        y1 = jnp.clip(y1, 0)
+        x2 = jnp.minimum(x2, img_w - 1)
+        y2 = jnp.minimum(y2, img_h - 1)
+    boxes = jnp.stack([x1, y1, x2, y2], axis=-1)
+    boxes = jnp.where(keep[..., None], boxes, 0.0)
+    score = jnp.where(keep[:, :, None], score, 0.0)
+    # both flatten in (h, w, anchor) order so row i of boxes matches
+    # row i of scores
+    boxes = boxes.transpose(0, 2, 3, 1, 4).reshape(N, -1, 4)
+    score = score.transpose(0, 3, 4, 1, 2).reshape(N, -1, class_num)
+    return boxes, score
+
+
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
+              variance=(0.1, 0.1, 0.2, 0.2), flip=False, clip=False,
+              steps=(0.0, 0.0), offset=0.5, min_max_aspect_ratios_order=False,
+              name=None):
+    """SSD prior (anchor) boxes for one feature map (reference: vision/
+    ops.py:427). Host-built constants: anchors depend only on shapes."""
+    _, _, H, W = (input.shape if not isinstance(input, Tensor)
+                  else input._value.shape)
+    _, _, img_h, img_w = (image.shape if not isinstance(image, Tensor)
+                          else image._value.shape)
+    step_w = steps[0] or img_w / W
+    step_h = steps[1] or img_h / H
+    ars = [1.0]
+    for ar in aspect_ratios:
+        if not any(abs(ar - a) < 1e-6 for a in ars):
+            ars.append(ar)
+            if flip:
+                ars.append(1.0 / ar)
+    num_priors = len(ars) * len(min_sizes) + (len(max_sizes or []))
+    # per-prior half extents (bw, bh) in the reference's emission order
+    half_w, half_h = [], []
+    for i, ms in enumerate(min_sizes):
+        per_min = []
+        for ar in ars:
+            per_min.append((ms * np.sqrt(ar) / 2, ms / np.sqrt(ar) / 2))
+        if max_sizes is not None:
+            s = np.sqrt(ms * max_sizes[i]) / 2
+            if min_max_aspect_ratios_order:
+                # caffe order: [min, max, other ars]
+                # (cpu/prior_box_kernel.cc:77)
+                per_min = per_min[:1] + [(s, s)] + per_min[1:]
+            else:
+                per_min = per_min + [(s, s)]
+        half_w += [p[0] for p in per_min]
+        half_h += [p[1] for p in per_min]
+    hw = np.asarray(half_w, np.float32)[None, None, :]
+    hh = np.asarray(half_h, np.float32)[None, None, :]
+    cx = ((np.arange(W, dtype=np.float32) + offset)
+          * step_w)[None, :, None]
+    cy = ((np.arange(H, dtype=np.float32) + offset)
+          * step_h)[:, None, None]
+    out = np.stack(
+        np.broadcast_arrays((cx - hw) / img_w, (cy - hh) / img_h,
+                            (cx + hw) / img_w, (cy + hh) / img_h),
+        axis=-1).astype(np.float32)              # [H, W, P, 4]
+    var = np.tile(np.asarray(variance, np.float32),
+                  (H, W, num_priors, 1))
+    if clip:
+        out = np.clip(out, 0.0, 1.0)
+    return to_tensor(out), to_tensor(var)
+
+
+def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
+                             refer_scale, pixel_offset=False,
+                             rois_num=None, name=None):
+    """Split RoIs across FPN levels by scale (reference: vision/
+    ops.py:1156). Host-side: per-level counts are data-dependent."""
+    rois = _np(fpn_rois).astype(np.float64)
+    off = 1.0 if pixel_offset else 0.0
+    w = rois[:, 2] - rois[:, 0] + off
+    h = rois[:, 3] - rois[:, 1] + off
+    scale = np.sqrt(np.clip(w * h, 0, None))
+    lvl = np.floor(np.log2(scale / refer_scale + 1e-8)) + refer_level
+    lvl = np.clip(lvl, min_level, max_level).astype(np.int64)
+    multi_rois, restore_parts = [], []
+    for L in range(min_level, max_level + 1):
+        idx = np.nonzero(lvl == L)[0]
+        multi_rois.append(to_tensor(rois[idx].astype(np.float32)))
+        restore_parts.append(idx)
+    order = np.concatenate(restore_parts) if restore_parts else \
+        np.zeros(0, np.int64)
+    restore = np.empty_like(order)
+    restore[order] = np.arange(len(order))
+    rois_num_per_level = None
+    if rois_num is not None:
+        rn = _np(rois_num).astype(np.int64)
+        img_of = np.repeat(np.arange(len(rn)), rn)
+        rois_num_per_level = [
+            to_tensor(np.bincount(img_of[lvl == L], minlength=len(rn))
+                      .astype(np.int32))
+            for L in range(min_level, max_level + 1)]
+    return multi_rois, to_tensor(restore[:, None]), rois_num_per_level
+
+
+# ---------------------------------------------------------------------------
+# Deformable convolution (gather + bilinear; traced, differentiable)
+# ---------------------------------------------------------------------------
+@def_op("deform_conv2d_kernel")
+def _deform_conv2d_kernel(x, offset, weight, mask, stride, padding,
+                          dilation, deformable_groups):
+    N, C, H, W = x.shape
+    out_c, in_c_g, kh, kw = weight.shape
+    sh, sw = stride
+    ph_, pw_ = padding
+    dh, dw = dilation
+    out_h = (H + 2 * ph_ - (dh * (kh - 1) + 1)) // sh + 1
+    out_w = (W + 2 * pw_ - (dw * (kw - 1) + 1)) // sw + 1
+    xf = jnp.pad(x.astype(jnp.float32),
+                 ((0, 0), (0, 0), (ph_, ph_), (pw_, pw_)))
+    Hp, Wp = H + 2 * ph_, W + 2 * pw_
+    off = offset.astype(jnp.float32).reshape(
+        N, deformable_groups, kh * kw, 2, out_h, out_w)
+    dy = off[:, :, :, 0]                                 # [N, dg, khkw, oh, ow]
+    dx = off[:, :, :, 1]
+    k_idx = jnp.arange(kh * kw)
+    ky, kx = k_idx // kw, k_idx % kw
+    # sample positions per (n, dg, k, oh, ow)
+    pos_y = (jnp.arange(out_h) * sh)[None, None, None, :, None] \
+        + (ky * dh)[None, None, :, None, None] + dy
+    pos_x = (jnp.arange(out_w) * sw)[None, None, None, None, :] \
+        + (kx * dw)[None, None, :, None, None] + dx
+
+    y0 = jnp.floor(pos_y)
+    x0 = jnp.floor(pos_x)
+    wy1 = pos_y - y0
+    wx1 = pos_x - x0
+    y0i = jnp.clip(y0.astype(jnp.int32), 0, Hp - 1)
+    y1i = jnp.clip(y0.astype(jnp.int32) + 1, 0, Hp - 1)
+    x0i = jnp.clip(x0.astype(jnp.int32), 0, Wp - 1)
+    x1i = jnp.clip(x0.astype(jnp.int32) + 1, 0, Wp - 1)
+    inb = ((pos_y > -1) & (pos_y < Hp) & (pos_x > -1) & (pos_x < Wp)) \
+        .astype(jnp.float32)
+
+    cg = C // deformable_groups
+    xg = xf.reshape(N, deformable_groups, cg, Hp, Wp)
+    flat = xg.reshape(N, deformable_groups, cg, Hp * Wp)
+
+    def take(yi, xi):
+        lin = yi * Wp + xi                               # [N,dg,k,oh,ow]
+        lin_ = lin.reshape(N, deformable_groups, 1, -1)
+        g = jnp.take_along_axis(
+            flat, jnp.broadcast_to(lin_, (N, deformable_groups, cg,
+                                          lin_.shape[-1])), axis=3)
+        return g.reshape(N, deformable_groups, cg, kh * kw, out_h, out_w)
+
+    w00 = ((1 - wy1) * (1 - wx1))[:, :, None]
+    w01 = ((1 - wy1) * wx1)[:, :, None]
+    w10 = (wy1 * (1 - wx1))[:, :, None]
+    w11 = (wy1 * wx1)[:, :, None]
+    val = (take(y0i, x0i) * w00 + take(y0i, x1i) * w01
+           + take(y1i, x0i) * w10 + take(y1i, x1i) * w11)
+    val = val * inb[:, :, None]
+    if mask is not None:
+        m = mask.astype(jnp.float32).reshape(
+            N, deformable_groups, 1, kh * kw, out_h, out_w)
+        val = val * m
+    cols = val.reshape(N, C * kh * kw, out_h, out_w)
+    wcol = weight.astype(jnp.float32).reshape(out_c, in_c_g * kh * kw)
+    groups = C // in_c_g
+    cols = cols.reshape(N, groups, in_c_g * kh * kw, out_h, out_w)
+    wg = wcol.reshape(groups, out_c // groups, in_c_g * kh * kw)
+    out = jnp.einsum("ngkhw,gok->ngohw", cols, wg)
+    return out.reshape(N, out_c, out_h, out_w).astype(x.dtype)
+
+
+def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
+                  dilation=1, deformable_groups=1, groups=1, mask=None,
+                  name=None):
+    """Deformable convolution v1/v2 (reference: vision/ops.py:753) —
+    bilinear sampling at learned offsets then a grouped matmul; the
+    gathers and interpolation weights are all XLA HLOs."""
+    st = _pair(stride)
+    pd = _pair(padding)
+    dl = _pair(dilation)
+    out = _deform_conv2d_kernel(x, offset, weight, mask, st, pd, dl,
+                                int(deformable_groups))
+    return out if bias is None else out + bias.reshape([1, -1, 1, 1])
+
+
+class DeformConv2D(nn.Layer):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, deformable_groups=1, groups=1,
+                 weight_attr=None, bias_attr=None):
+        super().__init__()
+        kh, kw = _pair(kernel_size)
+        self._stride = stride
+        self._padding = padding
+        self._dilation = dilation
+        self._deformable_groups = deformable_groups
+        self._groups = groups
+        self.weight = self.create_parameter(
+            (out_channels, in_channels // groups, kh, kw))
+        self.bias = self.create_parameter((out_channels,), is_bias=True) \
+            if bias_attr is not False else None
+
+    def forward(self, x, offset, mask=None):
+        return deform_conv2d(x, offset, self.weight, self.bias,
+                             self._stride, self._padding, self._dilation,
+                             self._deformable_groups, self._groups, mask)
+
+
+class ConvNormActivation(nn.Sequential):
+    """Conv2D + norm + activation block (reference: vision/
+    ops.py:1810)."""
+
+    def __init__(self, in_channels, out_channels, kernel_size=3, stride=1,
+                 padding=None, groups=1, norm_layer=nn.BatchNorm2D,
+                 activation_layer=nn.ReLU, dilation=1, bias=None):
+        if padding is None:
+            padding = (kernel_size - 1) // 2 * dilation
+        if bias is None:
+            bias = norm_layer is None
+        layers = [nn.Conv2D(in_channels, out_channels, kernel_size, stride,
+                            padding, dilation=dilation, groups=groups,
+                            bias_attr=bias)]
+        if norm_layer is not None:
+            layers.append(norm_layer(out_channels))
+        if activation_layer is not None:
+            layers.append(activation_layer())
+        super().__init__(*layers)

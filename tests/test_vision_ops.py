@@ -1,0 +1,267 @@
+"""paddle.vision.ops (reference: python/paddle/vision/ops.py) —
+numpy/brute-force parity for the detection operator set."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.vision import ops as V
+
+
+def _iou(a, b):
+    ix1, iy1 = max(a[0], b[0]), max(a[1], b[1])
+    ix2, iy2 = min(a[2], b[2]), min(a[3], b[3])
+    inter = max(ix2 - ix1, 0) * max(iy2 - iy1, 0)
+    ua = (a[2] - a[0]) * (a[3] - a[1]) + (b[2] - b[0]) * (b[3] - b[1]) - inter
+    return inter / max(ua, 1e-10)
+
+
+def test_nms_vs_bruteforce():
+    rng = np.random.RandomState(0)
+    xy = rng.rand(30, 2) * 10
+    wh = rng.rand(30, 2) * 4 + 0.5
+    boxes = np.concatenate([xy, xy + wh], 1).astype("float32")
+    scores = rng.rand(30).astype("float32")
+    keep = np.asarray(V.nms(paddle.to_tensor(boxes), 0.4,
+                            paddle.to_tensor(scores))._value)
+    # greedy reference
+    order = np.argsort(-scores, kind="stable")
+    ref, alive = [], np.ones(30, bool)
+    for i in order:
+        if not alive[i]:
+            continue
+        ref.append(i)
+        for j in range(30):
+            if alive[j] and _iou(boxes[i], boxes[j]) > 0.4:
+                alive[j] = False
+    assert keep.tolist() == ref
+
+
+def test_nms_categories_and_topk():
+    boxes = np.array([[0, 0, 2, 2], [0.1, 0, 2, 2], [5, 5, 7, 7],
+                      [5.1, 5, 7, 7]], "float32")
+    scores = np.array([0.9, 0.8, 0.95, 0.1], "float32")
+    cats = np.array([0, 1, 0, 1])
+    keep = np.asarray(V.nms(paddle.to_tensor(boxes), 0.5,
+                            paddle.to_tensor(scores),
+                            paddle.to_tensor(cats), [0, 1], top_k=3)._value)
+    # per-category nms keeps all 4 (overlaps are cross-category), sorted
+    # by score -> [2, 0, 1] after top_k=3
+    assert keep.tolist() == [2, 0, 1]
+
+
+def test_roi_align_whole_image_box():
+    """aligned=True with a full-image box and sampling_ratio=1 samples
+    each bin at the exact pixel center, recovering the map."""
+    x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+    out = V.roi_align(paddle.to_tensor(x),
+                      paddle.to_tensor(np.array([[0, 0, 4, 4]], "float32")),
+                      paddle.to_tensor(np.array([1], "int32")),
+                      output_size=4, sampling_ratio=1, aligned=True)
+    got = np.asarray(out._value)[0, 0]
+    np.testing.assert_allclose(got, x[0, 0], atol=1e-5)
+    # aligned=False shifts samples by +0.5: first bin of the first row
+    # averages cells (0,0),(0,1),(1,0),(1,1)
+    out2 = V.roi_align(paddle.to_tensor(x),
+                       paddle.to_tensor(np.array([[0, 0, 4, 4]], "float32")),
+                       paddle.to_tensor(np.array([1], "int32")),
+                       output_size=4, sampling_ratio=1, aligned=False)
+    assert abs(float(np.asarray(out2._value)[0, 0, 0, 0]) - 2.5) < 1e-5
+
+
+def test_roi_align_grad_flows():
+    x = paddle.to_tensor(np.random.RandomState(0).rand(1, 2, 8, 8)
+                         .astype("float32"))
+    x.stop_gradient = False
+    out = V.roi_align(x, paddle.to_tensor(
+        np.array([[1, 1, 6, 6]], "float32")),
+        paddle.to_tensor(np.array([1], "int32")), 2)
+    out.sum().backward()
+    g = np.asarray(x.grad._value)
+    assert np.isfinite(g).all() and np.abs(g).sum() > 0
+
+
+def test_roi_pool_exact_bins():
+    x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+    out = V.roi_pool(paddle.to_tensor(x),
+                     paddle.to_tensor(np.array([[0, 0, 3, 3]], "float32")),
+                     paddle.to_tensor(np.array([1], "int32")),
+                     output_size=2)
+    got = np.asarray(out._value)[0, 0]
+    # roi spans cells 0..3 in both dims -> 2x2 max pool
+    np.testing.assert_allclose(got, [[5, 7], [13, 15]])
+
+
+def test_psroi_pool_channel_mapping():
+    # C=4, output 2x2 -> out_c=1; channel (i*2+j) feeds bin (i, j)
+    x = np.stack([np.full((4, 4), c, np.float32) for c in range(4)])[None]
+    out = V.psroi_pool(paddle.to_tensor(x),
+                       paddle.to_tensor(np.array([[0, 0, 4, 4]], "float32")),
+                       paddle.to_tensor(np.array([1], "int32")), 2)
+    got = np.asarray(out._value)[0, 0]
+    np.testing.assert_allclose(got, [[0, 1], [2, 3]])
+
+
+def test_box_coder_roundtrip():
+    rng = np.random.RandomState(1)
+    priors = np.array([[0, 0, 4, 4], [2, 2, 8, 10]], "float32")
+    gt = np.array([[1, 1, 5, 6]], "float32")
+    enc = V.box_coder(paddle.to_tensor(priors), None,
+                      paddle.to_tensor(gt), "encode_center_size")
+    assert enc.shape == [1, 2, 4]
+    dec = V.box_coder(paddle.to_tensor(priors), None,
+                      paddle.to_tensor(np.asarray(enc._value)[0]),
+                      "decode_center_size", axis=0)
+    np.testing.assert_allclose(np.asarray(dec._value),
+                               np.tile(gt, (2, 1)), atol=1e-4)
+
+
+def test_yolo_box_shapes_and_ranges():
+    N, na, cls, H, W = 2, 3, 5, 4, 4
+    x = paddle.to_tensor(np.random.RandomState(2).randn(
+        N, na * (5 + cls), H, W).astype("float32"))
+    img = paddle.to_tensor(np.full((N, 2), 32, "int32"))
+    boxes, scores = V.yolo_box(x, img, [10, 13, 16, 30, 33, 23], cls,
+                               0.01, 8)
+    assert boxes.shape == [N, H * W * na, 4]
+    assert scores.shape == [N, H * W * na, cls]
+    b = np.asarray(boxes._value)
+    assert (b >= 0).all() and (b <= 32).all()  # clipped to image
+
+
+def test_prior_box():
+    inp = paddle.to_tensor(np.zeros((1, 8, 2, 2), "float32"))
+    img = paddle.to_tensor(np.zeros((1, 3, 16, 16), "float32"))
+    boxes, var = V.prior_box(inp, img, min_sizes=[4.0], max_sizes=[8.0],
+                             aspect_ratios=[2.0], clip=True)
+    assert boxes.shape == [2, 2, 3, 4]  # 2 ars(+flip off)=2? min+ar+max=3
+    bv = np.asarray(boxes._value)
+    assert (bv >= 0).all() and (bv <= 1).all()
+    assert var.shape == [2, 2, 3, 4]
+
+
+def test_distribute_fpn_proposals():
+    rois = np.array([[0, 0, 10, 10],      # small -> low level
+                     [0, 0, 200, 200],    # large -> high level
+                     [0, 0, 24, 24]], "float32")
+    multi, restore, per_lvl = V.distribute_fpn_proposals(
+        paddle.to_tensor(rois), 2, 5, 4, 224,
+        rois_num=paddle.to_tensor(np.array([3], "int32")))
+    assert len(multi) == 4
+    total = sum(m.shape[0] for m in multi)
+    assert total == 3
+    r = np.asarray(restore._value)[:, 0]
+    cat = np.concatenate([np.asarray(m._value) for m in multi])
+    np.testing.assert_allclose(cat[r], rois)
+    counts = np.stack([np.asarray(p._value) for p in per_lvl]).sum()
+    assert counts == 3
+
+
+def test_deform_conv2d_zero_offset_equals_conv():
+    """With zero offsets and no mask, deformable conv IS a regular
+    conv — the strongest correctness anchor for the sampler."""
+    rng = np.random.RandomState(3)
+    x = rng.randn(2, 4, 9, 9).astype("float32")
+    w = rng.randn(6, 4, 3, 3).astype("float32")
+    off = np.zeros((2, 2 * 3 * 3, 9, 9), "float32")
+    out = V.deform_conv2d(paddle.to_tensor(x), paddle.to_tensor(off),
+                          paddle.to_tensor(w), stride=1, padding=1)
+    import jax
+
+    ref = jax.lax.conv_general_dilated(
+        x, w, (1, 1), [(1, 1), (1, 1)],
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    np.testing.assert_allclose(np.asarray(out._value), np.asarray(ref),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_deform_conv2d_layer_with_mask():
+    paddle.seed(0)
+    layer = V.DeformConv2D(3, 8, 3, padding=1)
+    x = paddle.to_tensor(np.random.RandomState(4).rand(1, 3, 6, 6)
+                         .astype("float32"))
+    off = paddle.to_tensor(np.random.RandomState(5).randn(1, 18, 6, 6)
+                           .astype("float32") * 0.1)
+    mask = paddle.to_tensor(np.ones((1, 9, 6, 6), "float32"))
+    out = layer(x, off, mask)
+    assert out.shape == [1, 8, 6, 6]
+    assert np.isfinite(np.asarray(out._value)).all()
+
+
+def test_conv_norm_activation():
+    block = V.ConvNormActivation(3, 16, 3, stride=2)
+    x = paddle.to_tensor(np.random.rand(1, 3, 8, 8).astype("float32"))
+    block.eval()
+    assert block(x).shape == [1, 16, 4, 4]
+
+
+def test_read_file_decode_jpeg(tmp_path):
+    try:
+        from PIL import Image
+    except ImportError:
+        import pytest
+        pytest.skip("no PIL")
+    import numpy as _np
+    p = str(tmp_path / "t.jpg")
+    Image.fromarray(_np.zeros((8, 8, 3), _np.uint8)).save(p)
+    raw = V.read_file(p)
+    assert "uint8" in str(raw.dtype) and raw.shape[0] > 0
+    img = V.decode_jpeg(raw)
+    assert img.shape == [3, 8, 8]
+
+
+def test_yolo_box_score_alignment():
+    """Boxes and scores must flatten in the same (h, w, anchor) order:
+    plant a single hot cell and check its box and score land on the
+    same row."""
+    N, na, cls, H, W = 1, 2, 3, 2, 2
+    x = np.full((N, na * (5 + cls), H, W), -10.0, "float32")
+    # anchor 1, cell (h=1, w=0): strong conf, class 2 hot, dx=+large
+    a = 1
+    base = a * (5 + cls)
+    x[0, base + 4, 1, 0] = 10.0          # conf ~ 1
+    x[0, base + 5 + 2, 1, 0] = 10.0      # class 2 ~ 1
+    img = paddle.to_tensor(np.full((N, 2), 16, "int32"))
+    boxes, scores = V.yolo_box(paddle.to_tensor(x), img,
+                               [2, 2, 4, 4], cls, 0.5, 4)
+    s = np.asarray(scores._value)[0]
+    b = np.asarray(boxes._value)[0]
+    row = int(s.max(axis=1).argmax())
+    assert row == (1 * W + 0) * na + a   # (h, w, anchor) flattening
+    assert s[row].argmax() == 2
+    assert np.abs(b[row]).sum() > 0      # the box row is the live one
+    dead = np.delete(np.arange(H * W * na), row)
+    assert np.abs(b[dead]).sum() == 0    # all other rows suppressed
+
+
+def test_yolo_box_iou_aware():
+    N, na, cls, H, W = 1, 2, 3, 2, 2
+    rng = np.random.RandomState(6)
+    body = rng.randn(N, na * (5 + cls), H, W).astype("float32")
+    iou_head = np.full((N, na, H, W), 5.0, "float32")  # sigmoid ~ 1
+    x = np.concatenate([iou_head, body], axis=1)
+    img = paddle.to_tensor(np.full((N, 2), 16, "int32"))
+    b1, s1 = V.yolo_box(paddle.to_tensor(x), img, [2, 2, 4, 4], cls,
+                        0.0, 4, iou_aware=True, iou_aware_factor=0.5)
+    b0, s0 = V.yolo_box(paddle.to_tensor(body), img, [2, 2, 4, 4], cls,
+                        0.0, 4)
+    # iou ~= 1 -> conf^(0.5) * 1: scores are the sqrt-conf version
+    s0v, s1v = np.asarray(s0._value), np.asarray(s1._value)
+    np.testing.assert_allclose(np.asarray(b1._value),
+                               np.asarray(b0._value), rtol=1e-4, atol=1e-5)
+    assert (s1v >= s0v - 1e-5).all()     # sqrt raises sub-1 confidences
+
+
+def test_prior_box_min_max_order():
+    inp = paddle.to_tensor(np.zeros((1, 8, 1, 1), "float32"))
+    img = paddle.to_tensor(np.zeros((1, 3, 16, 16), "float32"))
+    b_def, _ = V.prior_box(inp, img, min_sizes=[4.0], max_sizes=[8.0],
+                           aspect_ratios=[2.0])
+    b_caffe, _ = V.prior_box(inp, img, min_sizes=[4.0], max_sizes=[8.0],
+                             aspect_ratios=[2.0],
+                             min_max_aspect_ratios_order=True)
+    d = np.asarray(b_def._value)[0, 0]
+    c = np.asarray(b_caffe._value)[0, 0]
+    # default: [min, ar2, max]; caffe: [min, max, ar2]
+    np.testing.assert_allclose(d[0], c[0])
+    np.testing.assert_allclose(d[2], c[1])  # max moved to slot 1
+    np.testing.assert_allclose(d[1], c[2])
