@@ -78,15 +78,23 @@ def is_compiled_with_tpu() -> bool:
     return True
 
 
+_static_mode = False
+
+
 def in_dynamic_mode() -> bool:
-    return True
+    return not _static_mode
 
 
 def disable_static(place=None):
-    pass
+    global _static_mode
+    _static_mode = False
 
 
 def enable_static():
-    raise NotImplementedError(
-        "paddle_tpu is dynamic-first; use paddle_tpu.jit.to_static for "
-        "whole-graph XLA compilation")
+    """Enter the declare-then-run workflow. Unlike the reference, ops
+    only record when they touch a ``static.data`` Variable — eager
+    tensors keep working — so this just flips the mode reported by
+    ``in_dynamic_mode`` (see paddle_tpu.static for the Program/Executor
+    machinery)."""
+    global _static_mode
+    _static_mode = True

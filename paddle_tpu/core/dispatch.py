@@ -32,9 +32,26 @@ _profile_hook = None
 # dispatch (operator-stats collection, amp accuracy tooling).
 _op_observer = None
 
+# Flipped to True by paddle_tpu.static on the first Variable creation;
+# gates the static-recording scan off the eager hot path.
+_static_used = [False]
+
 
 def apply(opdef: OpDef, args, kwargs):
     from ..tensor import Tensor
+
+    # static-graph recording: an op touching a symbolic Variable appends
+    # an OpNode to its Program instead of executing (reference: static
+    # mode's OpDesc append in base/framework.py; same chokepoint here).
+    # _static_used stays False until the first static.data call, so
+    # eager-only programs never pay the per-arg scan.
+    if _static_used[0] and (
+            any(getattr(a, "_is_static_var", False) for a in args)
+            or any(getattr(v, "_is_static_var", False)
+                   for v in kwargs.values())):
+        from ..static import record_op
+
+        return record_op(opdef, args, kwargs)
 
     conv_args = []
     in_tensors = []  # aligned with OpCall.in_values order (positional, then sorted kwargs)

@@ -188,9 +188,17 @@ class Optimizer:
 
     def minimize(self, loss, startup_program=None, parameters=None,
                  no_grad_set=None):
+        """(reference: python/paddle/optimizer/optimizer.py minimize).
+        On a static Variable, records the train objective into its
+        Program — Executor.run then performs backward + the fused step;
+        on an eager Tensor, runs backward/step/clear now."""
+        if getattr(loss, "_is_static_var", False):
+            loss._program._train_objective = (loss, self)
+            return None, None
         loss.backward()
         self.step()
         self.clear_grad()
+        return None, None
 
     # -- checkpointing ---------------------------------------------------
     def state_dict(self) -> Dict:

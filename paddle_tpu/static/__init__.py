@@ -1,22 +1,34 @@
-"""Static-graph compatibility surface (reference: python/paddle/static/).
+"""Static-graph mode (reference: python/paddle/static/).
 
-The reference's static mode builds a ProgramDesc executed by the C++
-interpreter (SURVEY.md §3.4); here "static" IS jax.jit tracing, so this
-module provides the declarative pieces programs are written against —
-InputSpec for signatures — plus thin Program/Executor shims that map the
-classic ``paddle.static`` training-script shape onto traced execution.
+The reference's static mode builds a ProgramDesc run by the C++
+interpreter (SURVEY.md §3.4: Executor.run → StandaloneExecutor →
+ProgramInterpreter). TPU redesign: a ``Program`` here is a recorded op
+list — ops called on symbolic ``Variable``s (from ``static.data``)
+append ``OpNode``s through the SAME dispatch chokepoint eager uses
+(core/dispatch.apply), and ``Executor.run`` replays the graph with real
+feed arrays through the eager engine, so autograd/AMP/profiler hooks
+all apply. ``minimize`` records the train objective; the replay then
+runs loss.backward() + optimizer.step() — both already fused/jitted —
+giving the classic declare-then-run paddle.static workflow on XLA.
+
+Parameters initialize eagerly at layer construction (the reference's
+startup program runs initializer ops; here ``exe.run(startup)`` is a
+documented no-op).
 """
 from __future__ import annotations
 
-from typing import Optional, Sequence
+import contextlib
+from typing import Any, Dict, List, Optional, Sequence
 
 import numpy as np
 
 from ..core.dtype import convert_dtype
+from ..core.enforce import enforce
+from ..tensor import Parameter, Tensor, to_tensor
 
-__all__ = ["InputSpec", "Program", "default_main_program",
-           "default_startup_program", "program_guard", "Executor",
-           "name_scope"]
+__all__ = ["InputSpec", "Program", "Variable", "data",
+           "default_main_program", "default_startup_program",
+           "program_guard", "Executor", "name_scope", "CompiledProgram"]
 
 
 class InputSpec:
@@ -43,21 +55,131 @@ class InputSpec:
                 f"name={self.name})")
 
 
+class Variable(Tensor):
+    """Symbolic tensor living in a Program (reference: base/framework.py
+    Variable). Has shape/dtype metadata but no storage; any op touching
+    one records into the Program instead of executing."""
+
+    _is_static_var = True
+
+    def __init__(self, program: "Program", shape, dtype, name: str,
+                 stop_gradient: bool = True):
+        super().__init__(None, stop_gradient=stop_gradient, name=name)
+        self._program = program
+        self._shape = tuple(shape)
+        self._dtype = convert_dtype(dtype)
+        from ..core import dispatch as _dispatch
+
+        _dispatch._static_used[0] = True
+
+    @property
+    def shape(self):
+        return list(self._shape)
+
+    @property
+    def dtype(self):
+        return self._dtype
+
+    @property
+    def ndim(self):
+        return len(self._shape)
+
+    def numpy(self):
+        raise RuntimeError(
+            f"Variable '{self.name}' has no value at build time; fetch it "
+            f"through Executor.run(fetch_list=[...])")
+
+    def __repr__(self):
+        return (f"Variable(name={self.name!r}, shape={list(self._shape)}, "
+                f"dtype={self._dtype})")
+
+
+class OpNode:
+    __slots__ = ("opdef", "args", "kwargs", "outputs")
+
+    def __init__(self, opdef, args, kwargs, outputs):
+        self.opdef = opdef
+        self.args = args
+        self.kwargs = kwargs
+        self.outputs = outputs  # list[Variable]
+
+
 class Program:
-    """Placeholder program object (graphs are implicit under jit)."""
+    """Recorded op graph (reference: base/framework.py Program /
+    ProgramDesc)."""
 
     def __init__(self):
-        self._ops = []
+        self._nodes: List[OpNode] = []
+        self._feeds: Dict[str, Variable] = {}
+        self._var_count = 0
+        # set by Optimizer.minimize: (loss Variable, optimizer)
+        self._train_objective = None
 
+    # -- recording ------------------------------------------------------
+    def _new_var(self, shape, dtype, stop_gradient=True, name=None):
+        self._var_count += 1
+        name = name or f"_generated_var_{self._var_count}"
+        return Variable(self, shape, dtype, name,
+                        stop_gradient=stop_gradient)
+
+    def _record(self, opdef, args, kwargs):
+        """Append an op node; infer output metadata via jax.eval_shape
+        (falls back to unknown shape — the replay is the ground truth)."""
+        import jax
+
+        def as_spec(v):
+            if isinstance(v, Variable):
+                shape = tuple(1 if d is None or d < 0 else d
+                              for d in v._shape)
+                return jax.ShapeDtypeStruct(shape, v._dtype)
+            if isinstance(v, Tensor):
+                return v._value
+            return v
+
+        try:
+            spec_args = [as_spec(a) for a in args]
+            spec_kwargs = {k: as_spec(v) for k, v in kwargs.items()}
+            metas = jax.eval_shape(opdef.fn, *spec_args, **spec_kwargs)
+        except Exception as e:
+            # a wrong single-output guess would silently truncate
+            # multi-output ops at replay; fail loudly at build time
+            raise RuntimeError(
+                f"static mode could not infer output metadata for op "
+                f"{opdef.name!r} (ops with data-dependent host logic "
+                f"cannot be recorded): {type(e).__name__}: {e}") from e
+        multi = isinstance(metas, (tuple, list))
+        metas_list = list(metas) if multi else [metas]
+        sg = not any(isinstance(v, (Variable, Tensor))
+                     and not v.stop_gradient
+                     for v in list(args) + list(kwargs.values()))
+        outs = [self._new_var(m.shape, m.dtype, stop_gradient=sg)
+                for m in metas_list]
+        self._nodes.append(OpNode(opdef, args, kwargs, outs))
+        return tuple(outs) if multi else outs[0]
+
+    # -- paddle API surface --------------------------------------------
     def global_block(self):
         return self
 
     def clone(self, for_test: bool = False):
-        return self
+        p = Program()
+        p._nodes = list(self._nodes)
+        p._feeds = dict(self._feeds)
+        p._var_count = self._var_count
+        if not for_test:
+            p._train_objective = self._train_objective
+        return p
+
+    def __repr__(self):
+        ops = ", ".join(n.opdef.name for n in self._nodes[:8])
+        more = "..." if len(self._nodes) > 8 else ""
+        return (f"Program({len(self._nodes)} ops: {ops}{more}; "
+                f"feeds={list(self._feeds)})")
 
 
 _main = Program()
 _startup = Program()
+_guard_stack: List[Program] = []
 
 
 def default_main_program() -> Program:
@@ -68,12 +190,17 @@ def default_startup_program() -> Program:
     return _startup
 
 
-import contextlib  # noqa: E402
+def current_program() -> Program:
+    return _guard_stack[-1] if _guard_stack else _main
 
 
 @contextlib.contextmanager
 def program_guard(main_program, startup_program=None):
-    yield
+    _guard_stack.append(main_program)
+    try:
+        yield
+    finally:
+        _guard_stack.pop()
 
 
 @contextlib.contextmanager
@@ -81,20 +208,167 @@ def name_scope(prefix: str = ""):
     yield
 
 
+def data(name: str, shape, dtype="float32", lod_level=0):
+    """Declare a feed Variable (reference: paddle/static/input.py
+    data)."""
+    prog = current_program()
+    var = Variable(prog, tuple(shape), dtype, name, stop_gradient=True)
+    prog._feeds[name] = var
+    return var
+
+
+def record_op(opdef, args, kwargs):
+    """Called from core.dispatch.apply when an input is symbolic.
+
+    Records into the active ``program_guard`` program when one is open
+    (so ops appended after ``clone()`` land in the clone, matching the
+    reference's guard semantics); otherwise into the inputs' program,
+    which must then be unambiguous."""
+    if _guard_stack:
+        return _guard_stack[-1]._record(opdef, args, kwargs)
+    progs = {v._program for v in list(args) + list(kwargs.values())
+             if isinstance(v, Variable)}
+    enforce(len(progs) == 1,
+            "op mixes Variables from different Programs (open a "
+            "program_guard to choose the recording target)")
+    return next(iter(progs))._record(opdef, args, kwargs)
+
+
 class Executor:
-    """Minimal Executor shim (reference base/executor.py:1162): ``run``
-    calls a compiled callable registered as the fetch target."""
+    """Replays a Program with real feed values through the eager engine
+    (reference: base/executor.py:1162 — there an instruction interpreter;
+    here each replayed op goes through the jitted dispatch path, and the
+    recorded train objective runs backward + the fused optimizer step)."""
 
     def __init__(self, place=None):
         self.place = place
 
     def run(self, program=None, feed=None, fetch_list=None, **kw):
-        if callable(program):
+        if isinstance(program, CompiledProgram):
+            return program.run(feed or {}, fetch_list or [])
+        if callable(program) and not isinstance(program, Program):
             out = program(**(feed or {}))
             return [np.asarray(getattr(o, "_value", o))
                     for o in (out if isinstance(out, (list, tuple))
                               else [out])]
-        raise NotImplementedError(
-            "static Program execution is implicit under jit in this "
-            "framework; pass a compiled callable (paddle.jit.to_static) "
-            "or use the eager/hapi APIs")
+        if program is None:
+            program = default_main_program()
+        if program is _startup or not program._nodes:
+            return []  # startup: parameters initialized eagerly
+        feed = feed or {}
+        env: Dict[int, Tensor] = {}
+        for name, var in program._feeds.items():
+            enforce(name in feed,
+                    lambda: f"missing feed '{name}' "
+                            f"(declared via static.data)")
+            val = to_tensor(np.asarray(feed[name],
+                                       dtype=str(var._dtype)))
+            env[id(var)] = val
+
+        train = program._train_objective
+
+        def resolve(v):
+            if isinstance(v, Variable):
+                enforce(id(v) in env,
+                        lambda: f"Variable {v.name!r} used before "
+                                f"definition in the program")
+                return env[id(v)]
+            return v
+
+        from ..autograd import engine as _engine
+        from ..core import dispatch as _dispatch
+
+        loss_tensor = None
+        with _engine.enable_grad() if train else contextlib.nullcontext():
+            for node in program._nodes:
+                r_args = [resolve(a) for a in node.args]
+                r_kwargs = {k: resolve(v) for k, v in node.kwargs.items()}
+                out = _dispatch.apply(node.opdef, tuple(r_args), r_kwargs)
+                outs = list(out) if isinstance(out, tuple) else [out]
+                for var, val in zip(node.outputs, outs):
+                    env[id(var)] = val
+                    if train and var is train[0]:
+                        loss_tensor = val
+
+        if train is not None:
+            loss_var, optimizer = train
+            enforce(loss_tensor is not None,
+                    "minimize() loss was not produced by this program")
+            loss_tensor.backward()
+            optimizer.step()
+            optimizer.clear_grad()
+
+        results = []
+        for f in fetch_list or []:
+            t = resolve(f) if isinstance(f, Variable) else f
+            results.append(np.asarray(t._value if isinstance(t, Tensor)
+                                      else t))
+        return results
+
+    def close(self):
+        pass
+
+
+class CompiledProgram:
+    """Whole-graph compiled replay for inference programs (no train
+    objective): the node list traces into ONE jitted XLA program, keyed
+    on feed shapes (reference: the build_strategy/ParallelExecutor
+    surface, subsumed by jax.jit)."""
+
+    def __init__(self, program: Program, build_strategy=None):
+        enforce(program._train_objective is None,
+                "CompiledProgram compiles inference programs; training "
+                "replays run through Executor (backward needs the tape)")
+        self._program = program
+        # eager tensors (parameters/constants) captured by the graph, in
+        # deterministic order — passed as traced ARGUMENTS so weight
+        # updates after compilation are picked up, never baked in
+        consts: Dict[int, Tensor] = {}
+        for node in program._nodes:
+            for v in list(node.args) + list(node.kwargs.values()):
+                if isinstance(v, Tensor) and not isinstance(v, Variable):
+                    consts.setdefault(id(v), v)
+        self._const_tensors = list(consts.values())
+        self._cache: Dict[Any, Any] = {}
+
+    def _build(self, feed_names, fetch_ids):
+        import jax
+
+        prog = self._program
+        const_ids = [id(t) for t in self._const_tensors]
+
+        def fn(feed_values, const_values):
+            env = dict(zip(const_ids, const_values))
+            for name, val in zip(feed_names, feed_values):
+                env[id(prog._feeds[name])] = val
+
+            def resolve(v):
+                if isinstance(v, Variable):
+                    return env[id(v)]
+                if isinstance(v, Tensor):
+                    return env[id(v)]
+                return v
+
+            for node in prog._nodes:
+                out = node.opdef.fn(*[resolve(a) for a in node.args],
+                                    **{k: resolve(v)
+                                       for k, v in node.kwargs.items()})
+                outs = list(out) if isinstance(out, tuple) else [out]
+                for var, val in zip(node.outputs, outs):
+                    env[id(var)] = val
+            # only the fetched values become XLA outputs (DCE prunes the
+            # rest of the graph)
+            return [env[i] for i in fetch_ids]
+
+        return jax.jit(fn)
+
+    def run(self, feed: Dict[str, Any], fetch_list):
+        feed_names = sorted(self._program._feeds)
+        vals = [np.asarray(feed[n]) for n in feed_names]
+        fetch_ids = tuple(id(f) for f in fetch_list)
+        key = (tuple((v.shape, str(v.dtype)) for v in vals), fetch_ids)
+        if key not in self._cache:
+            self._cache[key] = self._build(feed_names, fetch_ids)
+        consts = [t._value for t in self._const_tensors]
+        outs = self._cache[key](vals, consts)
+        return [np.asarray(o) for o in outs]

@@ -1,0 +1,177 @@
+"""Static declare-then-run mode (reference: python/paddle/static/ over
+the C++ interpreter; here op recording at the dispatch chokepoint +
+eager/jit replay — see paddle_tpu/static/__init__.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, static
+
+
+def _build_regression():
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = static.data("x", [None, 13], "float32")
+        y = static.data("y", [None, 1], "float32")
+        lin = nn.Linear(13, 1)
+        pred = lin(x)
+        loss = ((pred - y) ** 2).mean()
+    return main, startup, x, y, pred, loss, lin
+
+
+def test_recording():
+    main, _, x, y, pred, loss, _ = _build_regression()
+    assert isinstance(pred, static.Variable)
+    assert isinstance(loss, static.Variable)
+    names = [n.opdef.name for n in main._nodes]
+    assert "linear" in names or "matmul" in names
+    assert "mean" in names
+    assert loss.shape == []  # scalar metadata from eval_shape
+    assert "x" in main._feeds and "y" in main._feeds
+
+
+def test_variable_has_no_value():
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [2, 2], "float32")
+    with pytest.raises(RuntimeError, match="no value at build time"):
+        x.numpy()
+
+
+def test_executor_train_loop():
+    main, startup, x, y, pred, loss, lin = _build_regression()
+    with static.program_guard(main, startup):
+        opt = paddle.optimizer.SGD(learning_rate=0.05,
+                                   parameters=lin.parameters())
+        opt.minimize(loss)
+    exe = static.Executor()
+    exe.run(startup)  # documented no-op: params init eagerly
+    rng = np.random.RandomState(0)
+    X = rng.rand(32, 13).astype("float32")
+    Y = X @ rng.rand(13, 1).astype("float32")
+    losses = [float(exe.run(main, feed={"x": X, "y": Y},
+                            fetch_list=[loss])[0])
+              for _ in range(60)]
+    assert losses[-1] < losses[0] * 0.05
+
+
+def test_fetch_intermediate_and_feed_validation():
+    main, _, x, y, pred, loss, _ = _build_regression()
+    exe = static.Executor()
+    X = np.random.rand(4, 13).astype("float32")
+    Y = np.random.rand(4, 1).astype("float32")
+    p, l = exe.run(main, feed={"x": X, "y": Y}, fetch_list=[pred, loss])
+    assert p.shape == (4, 1) and l.shape == ()
+    with pytest.raises(Exception, match="missing feed"):
+        exe.run(main, feed={"x": X}, fetch_list=[loss])
+
+
+def test_clone_for_test_drops_objective():
+    main, startup, x, y, pred, loss, lin = _build_regression()
+    with static.program_guard(main, startup):
+        paddle.optimizer.SGD(learning_rate=0.1,
+                             parameters=lin.parameters()).minimize(loss)
+    infer = main.clone(for_test=True)
+    assert infer._train_objective is None
+    assert main._train_objective is not None
+
+
+def test_compiled_program_matches_eager_replay():
+    main, _, x, y, pred, loss, _ = _build_regression()
+    exe = static.Executor()
+    X = np.random.RandomState(1).rand(8, 13).astype("float32")
+    Y = np.random.RandomState(2).rand(8, 1).astype("float32")
+    cp = static.CompiledProgram(main)
+    out1, = cp.run({"x": X, "y": Y}, [pred])
+    out2, = exe.run(main, feed={"x": X, "y": Y}, fetch_list=[pred])
+    np.testing.assert_allclose(out1, out2, atol=1e-6)
+
+
+def test_compiled_program_rejects_train():
+    main, startup, x, y, pred, loss, lin = _build_regression()
+    with static.program_guard(main, startup):
+        paddle.optimizer.SGD(learning_rate=0.1,
+                             parameters=lin.parameters()).minimize(loss)
+    with pytest.raises(Exception, match="inference"):
+        static.CompiledProgram(main)
+
+
+def test_program_isolation():
+    p1, p2 = static.Program(), static.Program()
+    with static.program_guard(p1):
+        a = static.data("a", [2], "float32")
+        _ = a + 1.0
+    with static.program_guard(p2):
+        b = static.data("b", [2], "float32")
+        _ = b * 2.0
+    assert len(p1._nodes) == 1 and len(p2._nodes) == 1
+    with pytest.raises(Exception, match="different Programs"):
+        _ = a + b
+
+
+def test_enable_disable_static_mode():
+    assert paddle.in_dynamic_mode()
+    paddle.enable_static()
+    try:
+        assert not paddle.in_dynamic_mode()
+    finally:
+        paddle.disable_static()
+    assert paddle.in_dynamic_mode()
+
+
+def test_eager_minimize_still_works():
+    paddle.seed(0)
+    lin = nn.Linear(4, 1)
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=lin.parameters())
+    x = paddle.to_tensor(np.random.RandomState(0).rand(8, 4)
+                         .astype("float32"))
+    y = paddle.to_tensor(np.zeros((8, 1), "float32"))
+    l0 = None
+    for _ in range(5):
+        loss = ((lin(x) - y) ** 2).mean()
+        opt.minimize(loss)
+        if l0 is None:
+            l0 = float(loss)
+    assert float(loss) < l0
+
+
+def test_compiled_program_sees_weight_updates():
+    main, _, x, y, pred, loss, lin = _build_regression()
+    X = np.random.RandomState(3).rand(4, 13).astype("float32")
+    Y = np.zeros((4, 1), "float32")
+    cp = static.CompiledProgram(main)
+    out1, = cp.run({"x": X, "y": Y}, [pred])
+    # mutate the weights after compilation; the cached executable must
+    # pick up the new values (params are traced args, not constants)
+    lin.weight._value = lin.weight._value * 0.0
+    out2, = cp.run({"x": X, "y": Y}, [pred])
+    assert np.abs(out1).max() > 0
+    np.testing.assert_allclose(out2, np.tile(
+        np.asarray(lin.bias._value), (4, 1)), atol=1e-6)
+
+
+def test_executor_accepts_compiled_program():
+    main, _, x, y, pred, loss, _ = _build_regression()
+    exe = static.Executor()
+    X = np.random.rand(4, 13).astype("float32")
+    Y = np.random.rand(4, 1).astype("float32")
+    cp = static.CompiledProgram(main)
+    out, = exe.run(cp, feed={"x": X, "y": Y}, fetch_list=[pred])
+    assert out.shape == (4, 1)
+
+
+def test_clone_then_guard_records_into_clone():
+    main, _, x, y, pred, loss, _ = _build_regression()
+    n_main = len(main._nodes)
+    infer = main.clone(for_test=True)
+    with static.program_guard(infer):
+        doubled = pred * 2.0
+    assert len(main._nodes) == n_main          # original untouched
+    assert len(infer._nodes) == n_main + 1
+    exe = static.Executor()
+    X = np.random.RandomState(4).rand(4, 13).astype("float32")
+    Y = np.zeros((4, 1), "float32")
+    p, d = exe.run(infer, feed={"x": X, "y": Y},
+                   fetch_list=[pred, doubled])
+    np.testing.assert_allclose(d, p * 2.0, rtol=1e-6)
