@@ -138,3 +138,204 @@ def _sequence_mask(lengths, maxlen=None, dtype="int64"):
     m = maxlen if maxlen is not None else int(lengths.max())
     ar = _jnp.arange(m)
     return (ar[None, :] < lengths[:, None]).astype(_jnp.dtype(dtype) if dtype != "int64" else _jnp.int64)
+
+
+# ---------------------------------------------------------------------------
+# spatial sampling (reference: phi grid_sample / affine_grid kernels)
+# ---------------------------------------------------------------------------
+def _gs_unnormalize(coord, size, align_corners):
+    import jax.numpy as jnp
+
+    if align_corners:
+        return (coord + 1.0) / 2.0 * (size - 1)
+    return ((coord + 1.0) * size - 1.0) / 2.0
+
+
+def _gs_reflect(coord, size, align_corners):
+    import jax.numpy as jnp
+
+    # reflect into the valid span, matching torch/paddle semantics
+    if align_corners:
+        span = 2.0 * (size - 1)
+        lo = 0.0
+    else:
+        span = 2.0 * size
+        lo = -0.5
+    if span == 0:
+        return jnp.zeros_like(coord)
+    c = jnp.abs((coord - lo) % span)
+    return jnp.where(c > span / 2, span - c, c) + lo
+
+
+def _grid_sample_kernel(x, grid, mode, padding_mode, align_corners):
+    import jax.numpy as jnp
+
+    N, C, H, W = x.shape
+    gx = _gs_unnormalize(grid[..., 0].astype(jnp.float32), W,
+                         align_corners)
+    gy = _gs_unnormalize(grid[..., 1].astype(jnp.float32), H,
+                         align_corners)
+    if padding_mode == "reflection":
+        gx = _gs_reflect(gx, W, align_corners)
+        gy = _gs_reflect(gy, H, align_corners)
+    if padding_mode in ("border", "reflection"):
+        gx = jnp.clip(gx, 0, W - 1)
+        gy = jnp.clip(gy, 0, H - 1)
+
+    def fetch(ix, iy):
+        """x[n, :, iy, ix] with zero padding outside."""
+        inb = ((ix >= 0) & (ix <= W - 1) & (iy >= 0)
+               & (iy <= H - 1))
+        ixc = jnp.clip(ix, 0, W - 1).astype(jnp.int32)
+        iyc = jnp.clip(iy, 0, H - 1).astype(jnp.int32)
+        lin = iyc * W + ixc                        # [N, Hg, Wg]
+        flat = x.reshape(N, C, H * W)
+        g = jnp.take_along_axis(
+            flat, lin.reshape(N, 1, -1).astype(jnp.int32), axis=2)
+        g = g.reshape(N, C, *lin.shape[1:])
+        return g * inb[:, None].astype(x.dtype)
+
+    if mode == "nearest":
+        return fetch(jnp.round(gx), jnp.round(gy))
+    x0 = jnp.floor(gx)
+    y0 = jnp.floor(gy)
+    wx1 = (gx - x0).astype(x.dtype)[:, None]
+    wy1 = (gy - y0).astype(x.dtype)[:, None]
+    wx0, wy0 = 1 - wx1, 1 - wy1
+    return (fetch(x0, y0) * wx0 * wy0 + fetch(x0 + 1, y0) * wx1 * wy0
+            + fetch(x0, y0 + 1) * wx0 * wy1
+            + fetch(x0 + 1, y0 + 1) * wx1 * wy1)
+
+
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=True, name=None):
+    """Sample x at normalized grid locations (reference:
+    nn/functional/vision.py grid_sample over phi grid_sample kernel) —
+    gathers + bilinear weights, differentiable, all HLOs."""
+    from ..core.dispatch import def_op as _def_op
+
+    global _grid_sample_op
+    if "_grid_sample_op" not in globals():
+        _grid_sample_op = _def_op("grid_sample")(_grid_sample_kernel)
+    return _grid_sample_op(x, grid, str(mode), str(padding_mode),
+                           bool(align_corners))
+
+
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    """2-D affine sampling grid from theta [N, 2, 3] (reference:
+    nn/functional/vision.py affine_grid)."""
+    from ..core.dispatch import def_op as _def_op
+
+    global _affine_grid_op
+    if "_affine_grid_op" not in globals():
+        import jax.numpy as jnp
+
+        def _kernel(theta, H, W, align_corners):
+            if align_corners:
+                ys = jnp.linspace(-1.0, 1.0, H)
+                xs = jnp.linspace(-1.0, 1.0, W)
+            else:
+                ys = (jnp.arange(H) * 2.0 + 1.0) / H - 1.0
+                xs = (jnp.arange(W) * 2.0 + 1.0) / W - 1.0
+            gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+            base = jnp.stack([gx, gy, jnp.ones_like(gx)], -1)  # [H,W,3]
+            out = jnp.einsum("hwk,nck->nhwc", base,
+                             theta.astype(jnp.float32))
+            return out.astype(theta.dtype)
+
+        _affine_grid_op = _def_op("affine_grid")(_kernel)
+    H, W = int(out_shape[-2]), int(out_shape[-1])
+    return _affine_grid_op(theta, H, W, bool(align_corners))
+
+
+# ---------------------------------------------------------------------------
+# CTC loss (reference: warpctc op, python nn/functional/loss.py ctc_loss)
+# ---------------------------------------------------------------------------
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean", norm_by_times=False):
+    """Connectionist Temporal Classification loss.
+
+    TPU design: the alpha (forward) recursion over the extended label
+    sequence [blank, l1, blank, l2, ...] is ONE lax.scan over time in
+    log space — no per-step host dispatch, static shapes, differentiable
+    through the scan (the reference dynloads warp-ctc CUDA:
+    paddle/phi/kernels/gpu/warpctc_kernel.cu).
+
+    log_probs: [T, B, C] log-softmax outputs; labels: [B, L] padded.
+    """
+    from ..core.dispatch import def_op as _def_op
+
+    global _ctc_op
+    if "_ctc_op" not in globals():
+        import jax.numpy as jnp
+        from jax import lax
+
+        NEG = -1e30
+
+        def _kernel(log_probs, labels, input_lengths, label_lengths,
+                    blank):
+            T, B, C = log_probs.shape
+            L = labels.shape[1]
+            S = 2 * L + 1
+            # extended sequence: blank at even positions
+            ext = jnp.full((B, S), blank, labels.dtype)
+            ext = ext.at[:, 1::2].set(labels)
+            # can skip from s-2 to s when ext[s] != blank and != ext[s-2]
+            ext_prev2 = jnp.pad(ext[:, :-2], ((0, 0), (2, 0)),
+                                constant_values=-1)
+            can_skip = (ext != blank) & (ext != ext_prev2)       # [B, S]
+
+            emit0 = jnp.take_along_axis(log_probs[0], ext, axis=1)
+            alpha0 = jnp.full((B, S), NEG)
+            alpha0 = alpha0.at[:, 0].set(emit0[:, 0])
+            alpha0 = alpha0.at[:, 1].set(
+                jnp.where(label_lengths > 0, emit0[:, 1], NEG))
+
+            def lse(*xs):
+                stacked = jnp.stack(xs)
+                m = jnp.max(stacked, axis=0)
+                dead = m <= NEG / 2
+                safe_m = jnp.where(dead, 0.0, m)
+                # double-where: zero the exp args on the dead branch so
+                # log never sees 0 and the where-VJP never sees NaN
+                args = jnp.where(dead[None], 0.0, stacked - safe_m)
+                out = safe_m + jnp.log(jnp.sum(jnp.exp(args), axis=0))
+                return jnp.where(dead, NEG, out)
+
+            def step(alpha, t):
+                emit = jnp.take_along_axis(log_probs[t], ext, axis=1)
+                a1 = jnp.pad(alpha[:, :-1], ((0, 0), (1, 0)),
+                             constant_values=NEG)
+                a2 = jnp.pad(alpha[:, :-2], ((0, 0), (2, 0)),
+                             constant_values=NEG)
+                a2 = jnp.where(can_skip, a2, NEG)
+                new = lse(alpha, a1, a2) + emit
+                # freeze past each sequence's input length
+                live = (t < input_lengths)[:, None]
+                return jnp.where(live, new, alpha), None
+
+            alpha, _ = lax.scan(step, alpha0, jnp.arange(1, T))
+            # final: last blank or last label position
+            send = 2 * label_lengths          # index of final blank
+            last_blank = jnp.take_along_axis(alpha, send[:, None],
+                                             axis=1)[:, 0]
+            last_lab = jnp.take_along_axis(
+                alpha, jnp.maximum(send - 1, 0)[:, None], axis=1)[:, 0]
+            last_lab = jnp.where(label_lengths > 0, last_lab, NEG)
+            return -lse(last_blank, last_lab)
+
+        _ctc_op = _def_op("warpctc")(_kernel)
+    from ..tensor import Tensor
+
+    il = input_lengths if isinstance(input_lengths, Tensor) else \
+        __import__("paddle_tpu").to_tensor(input_lengths)
+    ll = label_lengths if isinstance(label_lengths, Tensor) else \
+        __import__("paddle_tpu").to_tensor(label_lengths)
+    loss = _ctc_op(log_probs, labels, il, ll, int(blank))
+    if norm_by_times:
+        loss = loss / il.astype("float32")
+    if reduction == "mean":
+        return (loss / ll.astype("float32")).mean()
+    if reduction == "sum":
+        return loss.sum()
+    return loss

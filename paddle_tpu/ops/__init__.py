@@ -1,8 +1,9 @@
 """Operator library: every kernel is a pure JAX function registered in
 core/registry.py (the PHI-kernel analog). Submodules by category, mirroring
 the reference's python/paddle/tensor/ split."""
-from . import creation, linalg, manipulation, math, nn_ops  # noqa: F401
+from . import creation, extra, linalg, manipulation, math, nn_ops  # noqa: F401
 from .creation import *  # noqa: F401,F403
 from .manipulation import *  # noqa: F401,F403
 from .math import *  # noqa: F401,F403
 from .linalg import *  # noqa: F401,F403
+from .extra import *  # noqa: F401,F403

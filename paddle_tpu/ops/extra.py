@@ -1,0 +1,643 @@
+"""Long-tail op surface (reference: paddle/phi/api/yaml/ops.yaml +
+legacy_ops.yaml rows without a previous counterpart here — indexing,
+random distributions, special functions, 3-D conv/pool, shuffle/fold
+layout ops). Everything lowers to jnp/lax HLOs; ops whose OUTPUT SHAPE
+is data-dependent (masked_select, unique_consecutive, edit_distance)
+run host-side by design, like geometric.sampling.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core import rng
+from ..core.dispatch import def_op
+from ..core.dtype import convert_dtype, get_default_dtype
+from ..core.enforce import enforce
+from ..tensor import Tensor, to_tensor
+
+__all__ = [
+    "index_add", "index_put", "masked_select", "fill_diagonal",
+    "fill_diagonal_tensor", "renorm", "crop", "multiplex", "dist",
+    "shape", "increment", "reverse",
+    "broadcast_tensors", "as_complex", "as_real", "complex",
+    "tril_indices", "triu_indices", "logspace", "unique_consecutive",
+    "bitwise_left_shift", "bitwise_right_shift", "gather_tree", "cummin",
+    "channel_shuffle", "pixel_unshuffle", "fold", "max_pool2d_with_index",
+    "max_unpool2d", "edit_distance", "top_p_sampling", "i0e", "i1", "i1e",
+    "gammaln", "gammaincc", "poisson", "standard_gamma", "dirichlet",
+    "binomial", "exponential_", "conv3d", "max_pool3d",
+    "avg_pool3d", "stanh", "thresholded_relu", "maxout", "rrelu",
+    "log_sigmoid", "equal_all", "is_empty", "clip_by_norm",
+    "squared_l2_norm", "shard_index", "huber_loss",
+]
+
+
+# ---------------------------------------------------------------------------
+# indexing / manipulation
+# ---------------------------------------------------------------------------
+@def_op("index_add")
+def index_add(x, index, axis, value):
+    """x with value rows scatter-ADDED at ``index`` along ``axis``."""
+    axis = int(axis)
+    idx = [slice(None)] * x.ndim
+    idx[axis] = index
+    return x.at[tuple(idx)].add(value)
+
+
+@def_op("index_put_op")
+def _index_put(x, value, accumulate, *indices):
+    ref = x.at[tuple(indices)]
+    return ref.add(value) if accumulate else ref.set(value)
+
+
+def index_put(x, indices, value, accumulate=False, name=None):
+    """x[indices] = value (or += with accumulate) — indices is a tuple
+    of integer index arrays, numpy advanced-indexing style."""
+    idx = tuple(indices) if isinstance(indices, (list, tuple)) \
+        else (indices,)
+    return _index_put(x, value, bool(accumulate), *idx)
+
+
+def masked_select(x, mask, name=None):
+    """1-D tensor of elements where mask is True (host-side: the output
+    LENGTH is data-dependent)."""
+    xv = np.asarray(x._value if isinstance(x, Tensor) else x)
+    mv = np.asarray(mask._value if isinstance(mask, Tensor) else mask)
+    return to_tensor(xv[np.broadcast_to(mv, xv.shape)])
+
+
+@def_op("fill_diagonal")
+def fill_diagonal(x, value, offset=0, wrap=False):
+    enforce(x.ndim == 2, lambda: "fill_diagonal expects a 2-D tensor")
+    eye = jnp.eye(x.shape[0], x.shape[1], k=int(offset), dtype=bool)
+    return jnp.where(eye, jnp.asarray(value, x.dtype), x)
+
+
+@def_op("fill_diagonal_tensor")
+def fill_diagonal_tensor(x, y, offset=0, dim1=0, dim2=1):
+    enforce(x.ndim == 2 and int(dim1) == 0 and int(dim2) == 1,
+            lambda: "fill_diagonal_tensor here supports 2-D (dim1=0, "
+                    "dim2=1)")
+    n = min(x.shape[0], x.shape[1]) - abs(int(offset))
+    ii = jnp.arange(n)
+    rows = ii - min(int(offset), 0)
+    cols = ii + max(int(offset), 0)
+    return x.at[rows, cols].set(y[:n].astype(x.dtype))
+
+
+@def_op("renorm")
+def renorm(x, p, axis, max_norm):
+    """Clip each slice along ``axis`` to p-norm <= max_norm (reference:
+    renorm op)."""
+    axis = int(axis) % x.ndim
+    red = tuple(i for i in range(x.ndim) if i != axis)
+    norms = jnp.sum(jnp.abs(x) ** p, axis=red, keepdims=True) ** (1.0 / p)
+    scale = jnp.where(norms > max_norm, max_norm / (norms + 1e-7), 1.0)
+    return x * scale.astype(x.dtype)
+
+
+@def_op("crop")
+def crop(x, shape=None, offsets=None):
+    out_shape = [d if d != -1 else x.shape[i] - (offsets[i] if offsets
+                 else 0) for i, d in enumerate(shape)]
+    offs = list(offsets) if offsets is not None else [0] * x.ndim
+    return lax.dynamic_slice(x, offs, out_shape)
+
+
+@def_op("multiplex_op")
+def _multiplex(index, *inputs):
+    stacked = jnp.stack(inputs)                   # [K, B, ...]
+    idx = index.reshape((1, -1) + (1,) * (stacked.ndim - 2))
+    return jnp.take_along_axis(stacked, idx.astype(jnp.int32),
+                               axis=0)[0]
+
+
+def multiplex(inputs, index, name=None):
+    """Row-wise select among candidate tensors: out[i] =
+    inputs[index[i]][i]."""
+    return _multiplex(index, *inputs)
+
+
+@def_op("dist")
+def dist(x, y, p=2):
+    d = (x - y).reshape(-1)
+    p = float(p)
+    if p == float("inf"):
+        return jnp.max(jnp.abs(d))
+    if p == 0:
+        return jnp.sum(d != 0).astype(x.dtype)
+    return jnp.sum(jnp.abs(d) ** p) ** (1.0 / p)
+
+
+@def_op("shape_op", differentiable=False)
+def _shape(x):
+    return jnp.asarray(x.shape, jnp.int32)
+
+
+def shape(x, name=None):
+    return _shape(x)
+
+
+@def_op("increment")
+def increment(x, value=1.0):
+    return x + jnp.asarray(value, x.dtype)
+
+
+def reverse(x, axis, name=None):
+    from .manipulation import flip
+
+    return flip(x, axis)
+
+
+@def_op("broadcast_tensors_op")
+def _broadcast_tensors(*xs):
+    shape = jnp.broadcast_shapes(*[x.shape for x in xs])
+    return tuple(jnp.broadcast_to(x, shape) for x in xs)
+
+
+def broadcast_tensors(inputs, name=None):
+    return list(_broadcast_tensors(*inputs))
+
+
+@def_op("as_complex")
+def as_complex(x):
+    enforce(x.shape[-1] == 2,
+            lambda: "as_complex expects trailing dim 2 (re, im)")
+    return lax.complex(x[..., 0], x[..., 1])
+
+
+@def_op("as_real")
+def as_real(x):
+    return jnp.stack([jnp.real(x), jnp.imag(x)], axis=-1)
+
+
+@def_op("complex")
+def complex(real, imag):  # noqa: A001
+    return lax.complex(real, imag)
+
+
+def tril_indices(row, col=None, offset=0, dtype="int64"):
+    r, c = np.tril_indices(row, int(offset), col or row)
+    return to_tensor(np.stack([r, c]).astype(str(convert_dtype(dtype))))
+
+
+def triu_indices(row, col=None, offset=0, dtype="int64"):
+    r, c = np.triu_indices(row, int(offset), col or row)
+    return to_tensor(np.stack([r, c]).astype(str(convert_dtype(dtype))))
+
+
+@def_op("logspace", differentiable=False)
+def logspace(start, stop, num, base=10.0, dtype="float32"):
+    return jnp.logspace(start, stop, int(num), base=base,
+                        dtype=convert_dtype(dtype))
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False,
+                       axis=None, dtype="int64", name=None):
+    """Deduplicate consecutive repeats (host-side: output length is
+    data-dependent)."""
+    xv = np.asarray(x._value if isinstance(x, Tensor) else x)
+    enforce(axis is None, "unique_consecutive here supports axis=None")
+    flat = xv.reshape(-1)
+    if flat.size == 0:
+        keep = np.zeros(0, bool)
+    else:
+        keep = np.concatenate([[True], flat[1:] != flat[:-1]])
+    out = [to_tensor(flat[keep])]
+    if return_inverse:
+        out.append(to_tensor(np.cumsum(keep) - 1))
+    if return_counts:
+        idx = np.nonzero(keep)[0]
+        out.append(to_tensor(np.diff(np.append(idx, flat.size))))
+    return out[0] if len(out) == 1 else tuple(out)
+
+
+@def_op("bitwise_left_shift")
+def bitwise_left_shift(x, y):
+    return jnp.left_shift(x, y)
+
+
+@def_op("bitwise_right_shift")
+def bitwise_right_shift(x, y):
+    return jnp.right_shift(x, y)
+
+
+@def_op("gather_tree", differentiable=False)
+def gather_tree(ids, parents):
+    """Beam-search backtrace (reference: gather_tree op): walk parent
+    pointers from the last step — one lax.scan, TPU-resident.
+    ids/parents: [T, B, beam]."""
+    T = ids.shape[0]
+
+    def step(beam_idx, t):
+        # beam_idx [B, beam] points into step t's beams
+        tok = jnp.take_along_axis(ids[t], beam_idx, axis=-1)
+        par = jnp.take_along_axis(parents[t], beam_idx, axis=-1)
+        return par, tok
+
+    init = jnp.broadcast_to(jnp.arange(ids.shape[2]), ids.shape[1:])
+    _, toks = lax.scan(step, init, jnp.arange(T - 1, -1, -1))
+    return toks[::-1]
+
+
+@def_op("cummin")
+def cummin(x, axis=None):
+    if axis is None:
+        x = x.reshape(-1)
+        axis = 0
+    return lax.associative_scan(jnp.minimum, x, axis=int(axis))
+
+
+@def_op("channel_shuffle")
+def channel_shuffle(x, groups, data_format="NCHW"):
+    enforce(data_format == "NCHW", "channel_shuffle supports NCHW")
+    b, c, h, w = x.shape
+    return x.reshape(b, int(groups), c // int(groups), h, w) \
+        .swapaxes(1, 2).reshape(b, c, h, w)
+
+
+@def_op("pixel_unshuffle")
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW"):
+    enforce(data_format == "NCHW", "pixel_unshuffle supports NCHW")
+    r = int(downscale_factor)
+    b, c, h, w = x.shape
+    x = x.reshape(b, c, h // r, r, w // r, r)
+    return x.transpose(0, 1, 3, 5, 2, 4).reshape(b, c * r * r, h // r,
+                                                 w // r)
+
+
+@def_op("fold")
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0,
+         dilations=1):
+    """col2im — inverse of unfold (reference: fold op). x is
+    [B, C*kh*kw, L]."""
+    def pair(v):
+        return (int(v), int(v)) if np.isscalar(v) else (int(v[0]),
+                                                        int(v[1]))
+
+    oh, ow = pair(output_sizes)
+    kh, kw = pair(kernel_sizes)
+    sh, sw = pair(strides)
+    ph, pw = pair(paddings)
+    dh, dw = pair(dilations)
+    B, ckk, L = x.shape
+    C = ckk // (kh * kw)
+    nh = (oh + 2 * ph - (dh * (kh - 1) + 1)) // sh + 1
+    nw = (ow + 2 * pw - (dw * (kw - 1) + 1)) // sw + 1
+    enforce(L == nh * nw, lambda: f"fold: L={L} != {nh}*{nw}")
+    cols = x.reshape(B, C, kh, kw, nh, nw)
+    out = jnp.zeros((B, C, oh + 2 * ph, ow + 2 * pw), x.dtype)
+    for i in range(kh):          # static small loops: unrolled scatter
+        for j in range(kw):
+            ys = i * dh + sh * jnp.arange(nh)
+            xs = j * dw + sw * jnp.arange(nw)
+            out = out.at[:, :, ys[:, None], xs[None, :]].add(
+                cols[:, :, i, j])
+    return out[:, :, ph:ph + oh, pw:pw + ow]
+
+
+@def_op("max_pool2d_with_index")
+def max_pool2d_with_index(x, kernel_size, stride=None, padding=0):
+    """Max pool returning (out, flat argmax indices) — the reference's
+    max_pool2d_with_index feeding max_unpool2d."""
+    k = (kernel_size, kernel_size) if np.isscalar(kernel_size) \
+        else tuple(kernel_size)
+    s = k if stride is None else ((stride, stride) if np.isscalar(stride)
+                                  else tuple(stride))
+    p = (padding, padding) if np.isscalar(padding) else tuple(padding)
+    B, C, H, W = x.shape
+    neg = jnp.finfo(jnp.float32).min
+    xp = jnp.pad(x.astype(jnp.float32), ((0, 0), (0, 0), (p[0], p[0]),
+                                         (p[1], p[1])),
+                 constant_values=neg)
+    lin = jnp.arange(H * W, dtype=jnp.int32).reshape(1, 1, H, W)
+    lin = jnp.pad(lin, ((0, 0), (0, 0), (p[0], p[0]), (p[1], p[1])))
+    oh = (H + 2 * p[0] - k[0]) // s[0] + 1
+    ow = (W + 2 * p[1] - k[1]) // s[1] + 1
+    patches = []
+    idxs = []
+    for i in range(k[0]):
+        for j in range(k[1]):
+            patches.append(lax.slice(
+                xp, (0, 0, i, j),
+                (B, C, i + (oh - 1) * s[0] + 1, j + (ow - 1) * s[1] + 1),
+                (1, 1, s[0], s[1])))
+            idxs.append(lax.slice(
+                lin, (0, 0, i, j),
+                (1, 1, i + (oh - 1) * s[0] + 1, j + (ow - 1) * s[1] + 1),
+                (1, 1, s[0], s[1])))
+    stackv = jnp.stack(patches)                   # [kk, B, C, oh, ow]
+    stacki = jnp.stack(idxs)                      # [kk, 1, 1, oh, ow]
+    arg = jnp.argmax(stackv, axis=0)              # [B, C, oh, ow]
+    out = jnp.max(stackv, axis=0).astype(x.dtype)
+    flat_idx = jnp.take_along_axis(
+        jnp.broadcast_to(stacki, stackv.shape), arg[None], axis=0)[0]
+    return out, flat_idx.astype(jnp.int32)
+
+
+@def_op("max_unpool2d")
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
+                 output_size=None):
+    """Scatter pooled values back to their argmax positions."""
+    B, C, oh, ow = x.shape
+    if output_size is not None:
+        H, W = int(output_size[-2]), int(output_size[-1])
+    else:
+        k = kernel_size if not np.isscalar(kernel_size) \
+            else (kernel_size, kernel_size)
+        s = k if stride is None else (
+            (stride, stride) if np.isscalar(stride) else stride)
+        pd = (padding, padding) if np.isscalar(padding) else tuple(padding)
+        H = (oh - 1) * s[0] + k[0] - 2 * pd[0]
+        W = (ow - 1) * s[1] + k[1] - 2 * pd[1]
+    out = jnp.zeros((B, C, H * W), x.dtype).at[
+        jnp.arange(B)[:, None, None], jnp.arange(C)[None, :, None],
+        indices.reshape(B, C, -1)].set(x.reshape(B, C, -1))
+    return out.reshape(B, C, H, W)
+
+
+def edit_distance(hyps, refs, normalized=True, name=None):
+    """Levenshtein distance per pair (host DP: ragged, data-dependent)."""
+    def one(h, r):
+        m, n = len(h), len(r)
+        d = np.arange(n + 1, dtype=np.float32)
+        for i in range(1, m + 1):
+            prev = d.copy()
+            d[0] = i
+            for j in range(1, n + 1):
+                d[j] = min(prev[j] + 1, d[j - 1] + 1,
+                           prev[j - 1] + (h[i - 1] != r[j - 1]))
+        return d[n] / (n if normalized and n else 1)
+
+    hs = [np.asarray(h._value if isinstance(h, Tensor) else h).tolist()
+          for h in hyps]
+    rs = [np.asarray(r._value if isinstance(r, Tensor) else r).tolist()
+          for r in refs]
+    return to_tensor(np.asarray([one(h, r) for h, r in zip(hs, rs)],
+                                np.float32))
+
+
+@def_op("top_p_sampling", differentiable=False)
+def _top_p_sampling(key, logits, p):
+    # p: [B] per-row nucleus thresholds
+    srt = jnp.sort(logits, axis=-1)[:, ::-1]
+    probs = jax.nn.softmax(srt, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    cutoff_idx = jnp.sum(cum < p[:, None], axis=-1)
+    cutoff = jnp.take_along_axis(srt, cutoff_idx[:, None], axis=-1)
+    masked = jnp.where(logits < cutoff, -1e30, logits)
+    ids = jax.random.categorical(key, masked, axis=-1)
+    scores = jnp.take_along_axis(jax.nn.softmax(logits, -1), ids[:, None],
+                                 axis=-1)
+    return scores, ids[:, None]
+
+
+def top_p_sampling(x, ps, threshold=None, seed=None, name=None):
+    """(reference: top_p_sampling op — the serving nucleus sampler).
+    ``ps`` is a per-row [B] threshold tensor or a scalar; ``seed``
+    (when >= 0) makes the draw reproducible."""
+    B = (x.shape[0] if hasattr(x, "shape") else 1)
+    if np.isscalar(ps):
+        pv = jnp.full((B,), float(ps), jnp.float32)
+    else:
+        pv = jnp.asarray(ps._value if isinstance(ps, Tensor)
+                         else ps, jnp.float32).reshape(-1)
+    key = jax.random.PRNGKey(int(seed)) if (seed is not None
+                                            and int(seed) >= 0) \
+        else rng.get_key()
+    return _top_p_sampling(key, x, pv)
+
+
+# ---------------------------------------------------------------------------
+# special functions
+# ---------------------------------------------------------------------------
+@def_op("i0e")
+def i0e(x):
+    return jax.scipy.special.i0e(x)
+
+
+@def_op("i1")
+def i1(x):
+    return jax.scipy.special.i1(x)
+
+
+@def_op("i1e")
+def i1e(x):
+    return jax.scipy.special.i1e(x)
+
+
+@def_op("gammaln")
+def gammaln(x):
+    return jax.scipy.special.gammaln(x)
+
+
+@def_op("gammaincc")
+def gammaincc(x, y):
+    return jax.scipy.special.gammaincc(x, y)
+
+
+# ---------------------------------------------------------------------------
+# random distributions (key-first kernels; public fns draw from the
+# global stream, matching ops/creation.py's convention)
+# ---------------------------------------------------------------------------
+@def_op("poisson_op", differentiable=False)
+def _poisson(key, lam):
+    return jax.random.poisson(key, lam).astype(lam.dtype)
+
+
+def poisson(x, name=None):
+    return _poisson(rng.get_key(), x)
+
+
+@def_op("standard_gamma_op", differentiable=False)
+def _standard_gamma(key, alpha):
+    return jax.random.gamma(key, alpha)
+
+
+def standard_gamma(x, name=None):
+    return _standard_gamma(rng.get_key(), x)
+
+
+@def_op("dirichlet_op", differentiable=False)
+def _dirichlet(key, alpha):
+    g = jax.random.gamma(key, alpha)
+    return g / jnp.sum(g, axis=-1, keepdims=True)
+
+
+def dirichlet(alpha, name=None):
+    return _dirichlet(rng.get_key(), alpha)
+
+
+@def_op("binomial_op", differentiable=False)
+def _binomial(key, n, p, nmax):
+    # sum of Bernoulli draws via uniform comparison, vectorized over the
+    # host-read static max trial count
+    nmax = int(nmax)
+    u = jax.random.uniform(key, (nmax,) + p.shape)
+    trials = jnp.arange(nmax).reshape((nmax,) + (1,) * p.ndim)
+    live = trials < jnp.asarray(n)[None]
+    return jnp.sum((u < p) & live, axis=0).astype(jnp.int32)
+
+
+def binomial(count, prob, name=None):
+    cv = np.asarray(count._value if isinstance(count, Tensor) else count)
+    nmax = int(cv.max()) if cv.size else 0
+    return _binomial(rng.get_key(), count, prob, nmax)
+
+
+def exponential_(x, lam=1.0, name=None):
+    """In-place exponential fill (reference: exponential_ inplace op) —
+    functional value-swap here (immutable arrays)."""
+    key = rng.get_key()
+    val = jax.random.exponential(key, tuple(x.shape)) / float(lam)
+    x._value = val.astype(x._value.dtype)
+    return x
+
+
+# ---------------------------------------------------------------------------
+# 3-D conv / pool
+# ---------------------------------------------------------------------------
+@def_op("conv3d")
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1,
+           groups=1, data_format="NCDHW"):
+    enforce(data_format == "NCDHW", "conv3d supports NCDHW")
+
+    def trip(v):
+        return (int(v),) * 3 if np.isscalar(v) else tuple(int(i)
+                                                          for i in v)
+
+    out = lax.conv_general_dilated(
+        x, weight, trip(stride), [(p, p) for p in trip(padding)],
+        rhs_dilation=trip(dilation), feature_group_count=int(groups),
+        dimension_numbers=("NCDHW", "OIDHW", "NCDHW"))
+    if bias is not None:
+        out = out + bias.reshape(1, -1, 1, 1, 1)
+    return out
+
+
+def _pool3d(x, kernel, stride, padding, init, op, avg=False):
+    def trip(v):
+        return (int(v),) * 3 if np.isscalar(v) else tuple(int(i)
+                                                          for i in v)
+
+    k, s, p = trip(kernel), trip(stride or kernel), trip(padding)
+    dims = (1, 1) + k
+    strides = (1, 1) + s
+    pads = ((0, 0), (0, 0)) + tuple((pi, pi) for pi in p)
+    out = lax.reduce_window(x, init, op, dims, strides, pads)
+    if avg:
+        ones = jnp.ones_like(x)
+        cnt = lax.reduce_window(ones, 0.0, lax.add, dims, strides, pads)
+        out = out / cnt
+    return out
+
+
+@def_op("max_pool3d")
+def max_pool3d(x, kernel_size, stride=None, padding=0):
+    return _pool3d(x, kernel_size, stride, padding,
+                   -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating)
+                   else jnp.iinfo(x.dtype).min, lax.max)
+
+
+@def_op("avg_pool3d")
+def avg_pool3d(x, kernel_size, stride=None, padding=0):
+    return _pool3d(x.astype(jnp.float32), kernel_size, stride, padding,
+                   0.0, lax.add, avg=True).astype(x.dtype)
+
+
+# ---------------------------------------------------------------------------
+# activations & small losses
+# ---------------------------------------------------------------------------
+@def_op("stanh")
+def stanh(x, scale_a=0.67, scale_b=1.7159):
+    return scale_b * jnp.tanh(scale_a * x)
+
+
+@def_op("thresholded_relu")
+def thresholded_relu(x, threshold=1.0):
+    return jnp.where(x > threshold, x, jnp.zeros_like(x))
+
+
+@def_op("maxout")
+def maxout(x, groups, axis=1):
+    axis = int(axis) % x.ndim
+    c = x.shape[axis]
+    enforce(c % int(groups) == 0,
+            lambda: f"maxout: channels {c} % groups {groups} != 0")
+    new_shape = (x.shape[:axis] + (c // int(groups), int(groups))
+                 + x.shape[axis + 1:])
+    return jnp.max(x.reshape(new_shape), axis=axis + 1)
+
+
+@def_op("rrelu_op")
+def _rrelu(key, x, lower, upper, training):
+    if training:
+        a = jax.random.uniform(key, x.shape, minval=lower, maxval=upper)
+    else:
+        a = (lower + upper) / 2.0
+    return jnp.where(x >= 0, x, (a * x).astype(x.dtype))
+
+
+def rrelu(x, lower=1.0 / 8, upper=1.0 / 3, training=True, name=None):
+    return _rrelu(rng.get_key(), x, float(lower), float(upper),
+                  bool(training))
+
+
+@def_op("log_sigmoid")
+def log_sigmoid(x):
+    return jax.nn.log_sigmoid(x)
+
+
+@def_op("equal_all", differentiable=False)
+def equal_all(x, y):
+    if x.shape != y.shape:
+        return jnp.asarray(False)
+    return jnp.all(x == y)
+
+
+@def_op("is_empty", differentiable=False)
+def is_empty(x):
+    return jnp.asarray(int(np.prod(x.shape)) == 0 if x.shape else False)
+
+
+@def_op("clip_by_norm")
+def clip_by_norm(x, max_norm):
+    # clamp the sum-of-squares: sqrt'(0) is inf and would NaN the VJP
+    # even under a zero cotangent (0 * inf)
+    norm = jnp.sqrt(jnp.maximum(jnp.sum(jnp.square(x)), 1e-30))
+    safe = jnp.where(norm > max_norm, norm, jnp.ones_like(norm))
+    return jnp.where(norm > max_norm, x * (max_norm / safe), x)
+
+
+@def_op("squared_l2_norm")
+def squared_l2_norm(x):
+    return jnp.sum(jnp.square(x)).reshape(())
+
+
+@def_op("shard_index", differentiable=False)
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
+    """Map global ids to shard-local ids (reference: shard_index op —
+    the PS-era embedding sharding helper)."""
+    shard_size = (int(index_num) + int(nshards) - 1) // int(nshards)
+    lo = int(shard_id) * shard_size
+    local = input - lo
+    inside = (input >= lo) & (input < lo + shard_size)
+    return jnp.where(inside, local,
+                     jnp.asarray(ignore_value, input.dtype))
+
+
+@def_op("huber_loss")
+def huber_loss(input, label, delta=1.0, reduction="mean"):
+    d = input - label
+    ad = jnp.abs(d)
+    loss = jnp.where(ad <= delta, 0.5 * d * d,
+                     delta * (ad - 0.5 * delta))
+    if reduction == "mean":
+        return jnp.mean(loss)
+    if reduction == "sum":
+        return jnp.sum(loss)
+    return loss

@@ -1,0 +1,379 @@
+"""Long-tail op surface + grid_sample/affine_grid/ctc_loss (reference:
+paddle/phi/api/yaml ops without previous counterparts; torch CPU used
+as the numeric oracle where available)."""
+import numpy as np
+import pytest
+import torch
+
+import paddle_tpu as paddle
+from paddle_tpu.nn import functional as F
+
+rng = np.random.RandomState(0)
+
+
+def _t(x):
+    return paddle.to_tensor(x)
+
+
+def _np(t):
+    return np.asarray(t._value)
+
+
+class TestIndexing:
+    def test_index_add_put(self):
+        x = np.zeros((5, 3), "float32")
+        v = np.ones((2, 3), "float32")
+        out = _np(paddle.index_add(_t(x), _t(np.array([1, 3])), 0, _t(v)))
+        assert out[1].sum() == 3 and out[3].sum() == 3 and out[0].sum() == 0
+        out = _np(paddle.index_put(_t(x), (_t(np.array([0, 2])),),
+                                   _t(np.full((2, 3), 7.0, "float32"))))
+        assert (out[0] == 7).all() and (out[2] == 7).all()
+
+    def test_masked_select(self):
+        x = np.arange(6, dtype="float32").reshape(2, 3)
+        out = _np(paddle.masked_select(_t(x), _t(x > 2)))
+        np.testing.assert_allclose(out, [3, 4, 5])
+
+    def test_fill_diagonal(self):
+        x = np.zeros((3, 4), "float32")
+        out = _np(paddle.fill_diagonal(_t(x), 5.0))
+        np.testing.assert_allclose(np.diag(out), [5, 5, 5])
+        y = np.array([1.0, 2.0, 3.0], "float32")
+        out = _np(paddle.fill_diagonal_tensor(_t(x), _t(y)))
+        np.testing.assert_allclose(np.diag(out), y)
+
+    def test_renorm_matches_torch(self):
+        x = rng.randn(4, 5, 6).astype("float32")
+        out = _np(paddle.renorm(_t(x), 2.0, 1, 1.0))
+        ref = torch.renorm(torch.tensor(x).transpose(0, 1), 2, 0, 1.0) \
+            .transpose(0, 1).numpy()
+        np.testing.assert_allclose(out, ref, rtol=1e-4)
+
+    def test_misc_small(self):
+        x = rng.randn(3, 4).astype("float32")
+        np.testing.assert_allclose(_np(paddle.mv(_t(x), _t(x[0]))),
+                                   x @ x[0], rtol=1e-5)
+        assert int(_np(paddle.numel(_t(x)))) == 12
+        np.testing.assert_allclose(_np(paddle.ops.extra.shape(_t(x))),
+                                   [3, 4])
+        assert abs(float(paddle.dist(_t(x), _t(x * 0), 2))
+                   - np.linalg.norm(x)) < 1e-4
+        out = paddle.unbind(_t(x), axis=0)
+        assert len(out) == 3
+        a, b = paddle.broadcast_tensors([_t(np.ones((1, 4), "float32")),
+                                         _t(np.ones((3, 1), "float32"))])
+        assert a.shape == [3, 4] and b.shape == [3, 4]
+
+    def test_complex_views(self):
+        x = rng.randn(3, 2).astype("float32")
+        c = paddle.as_complex(_t(x))
+        assert "complex" in str(c.dtype)
+        back = _np(paddle.as_real(c))
+        np.testing.assert_allclose(back, x, rtol=1e-6)
+        c2 = paddle.ops.extra.complex(_t(x[:, 0]), _t(x[:, 1]))
+        np.testing.assert_allclose(_np(c2), x[:, 0] + 1j * x[:, 1])
+
+    def test_tri_indices_logspace(self):
+        r, c = _np(paddle.tril_indices(4, 4))
+        rr, cc = np.tril_indices(4)
+        np.testing.assert_allclose(r, rr)
+        np.testing.assert_allclose(c, cc)
+        ls = _np(paddle.logspace(0, 3, 4))
+        np.testing.assert_allclose(ls, [1, 10, 100, 1000], rtol=1e-4)
+
+    def test_unique_consecutive(self):
+        x = np.array([1, 1, 2, 2, 2, 3, 1, 1])
+        out, inv, cnt = paddle.unique_consecutive(
+            _t(x), return_inverse=True, return_counts=True)
+        np.testing.assert_allclose(_np(out), [1, 2, 3, 1])
+        np.testing.assert_allclose(_np(cnt), [2, 3, 1, 2])
+        np.testing.assert_allclose(_np(out)[_np(inv)], x)
+
+    def test_bit_shifts(self):
+        x = np.array([1, 2, 4], "int32")
+        np.testing.assert_allclose(
+            _np(paddle.bitwise_left_shift(_t(x), _t(np.array([1, 1, 1],
+                                                            "int32")))),
+            [2, 4, 8])
+        np.testing.assert_allclose(
+            _np(paddle.bitwise_right_shift(_t(x), _t(np.array([1, 1, 1],
+                                                             "int32")))),
+            [0, 1, 2])
+
+    def test_cummin(self):
+        x = np.array([3.0, 1.0, 2.0, 0.5], "float32")
+        np.testing.assert_allclose(_np(paddle.cummin(_t(x))),
+                                   [3, 1, 1, 0.5])
+
+
+class TestLayoutOps:
+    def test_channel_shuffle_roundtrip(self):
+        x = rng.randn(2, 6, 4, 4).astype("float32")
+        s = paddle.channel_shuffle(_t(x), 2)
+        back = _np(paddle.channel_shuffle(s, 3))
+        np.testing.assert_allclose(back, x)
+
+    def test_pixel_unshuffle_inverts_shuffle(self):
+        x = rng.randn(2, 4, 3, 3).astype("float32")
+        up = F.pixel_shuffle(_t(x), 2)
+        back = _np(paddle.pixel_unshuffle(up, 2))
+        np.testing.assert_allclose(back, x)
+
+    def test_fold_unfold_inverse(self):
+        # non-overlapping patches: fold(unfold(x)) == x
+        x = rng.randn(1, 2, 4, 6).astype("float32")
+        cols = F.unfold(_t(x), kernel_sizes=2, strides=2)
+        back = _np(paddle.fold(cols, output_sizes=(4, 6), kernel_sizes=2,
+                               strides=2))
+        np.testing.assert_allclose(back, x, rtol=1e-6)
+
+    def test_max_pool_with_index_and_unpool(self):
+        x = rng.randn(1, 1, 4, 4).astype("float32")
+        out, idx = paddle.max_pool2d_with_index(_t(x), 2, 2)
+        ref = torch.nn.functional.max_pool2d(torch.tensor(x), 2, 2).numpy()
+        np.testing.assert_allclose(_np(out), ref, rtol=1e-6)
+        restored = _np(paddle.max_unpool2d(out, idx, 2, 2))
+        # unpool scatters each max back to its argmax position
+        assert restored.shape == (1, 1, 4, 4)
+        np.testing.assert_allclose(np.sort(restored[restored != 0]),
+                                   np.sort(ref.reshape(-1)))
+
+
+class TestRandomAndSpecial:
+    def test_distributions_shapes_and_ranges(self):
+        paddle.seed(0)
+        lam = _t(np.full((1000,), 4.0, "float32"))
+        p = _np(paddle.poisson(lam))
+        assert abs(p.mean() - 4.0) < 0.5
+        g = _np(paddle.standard_gamma(_t(np.full((1000,), 2.0,
+                                                 "float32"))))
+        assert abs(g.mean() - 2.0) < 0.3
+        d = _np(paddle.dirichlet(_t(np.ones((100, 3), "float32"))))
+        np.testing.assert_allclose(d.sum(-1), np.ones(100), rtol=1e-5)
+        b = _np(paddle.binomial(_t(np.full((1000,), 10)),
+                                _t(np.full((1000,), 0.3, "float32"))))
+        assert abs(b.mean() - 3.0) < 0.4
+        t = paddle.to_tensor(np.zeros((500,), "float32"))
+        paddle.exponential_(t)
+        assert abs(_np(t).mean() - 1.0) < 0.25
+
+    def test_special_functions(self):
+        import scipy.special as sp
+
+        x = np.linspace(0.1, 5, 20).astype("float32")
+        np.testing.assert_allclose(_np(paddle.i0e(_t(x))), sp.i0e(x),
+                                   rtol=1e-4)
+        np.testing.assert_allclose(_np(paddle.i1e(_t(x))), sp.i1e(x),
+                                   rtol=1e-4)
+        np.testing.assert_allclose(_np(paddle.gammaln(_t(x))),
+                                   sp.gammaln(x), rtol=1e-4)
+        np.testing.assert_allclose(
+            _np(paddle.gammaincc(_t(x), _t(x))), sp.gammaincc(x, x),
+            rtol=1e-3)
+
+    def test_top_p_sampling(self):
+        paddle.seed(0)
+        logits = np.full((4, 10), -10.0, "float32")
+        logits[:, 3] = 10.0  # all mass on token 3
+        scores, ids = paddle.top_p_sampling(_t(logits), 0.9)
+        assert _np(ids).reshape(-1).tolist() == [3, 3, 3, 3]
+
+
+class TestConvPool3D:
+    def test_conv3d_matches_torch(self):
+        x = rng.randn(1, 2, 5, 6, 7).astype("float32")
+        w = rng.randn(4, 2, 3, 3, 3).astype("float32")
+        out = _np(paddle.ops.extra.conv3d(_t(x), _t(w), stride=1,
+                                          padding=1))
+        ref = torch.nn.functional.conv3d(torch.tensor(x),
+                                         torch.tensor(w), padding=1)
+        np.testing.assert_allclose(out, ref.numpy(), rtol=1e-3,
+                                   atol=1e-4)
+
+    def test_pool3d_matches_torch(self):
+        x = rng.randn(1, 2, 6, 6, 6).astype("float32")
+        out = _np(paddle.ops.extra.max_pool3d(_t(x), 2, 2))
+        ref = torch.nn.functional.max_pool3d(torch.tensor(x), 2, 2)
+        np.testing.assert_allclose(out, ref.numpy(), rtol=1e-6)
+        out = _np(paddle.ops.extra.avg_pool3d(_t(x), 2, 2))
+        ref = torch.nn.functional.avg_pool3d(torch.tensor(x), 2, 2)
+        np.testing.assert_allclose(out, ref.numpy(), rtol=1e-5)
+
+
+class TestActivationsLosses:
+    def test_activations(self):
+        x = rng.randn(50).astype("float32")
+        np.testing.assert_allclose(_np(paddle.stanh(_t(x))),
+                                   1.7159 * np.tanh(0.67 * x), rtol=1e-5)
+        np.testing.assert_allclose(
+            _np(paddle.thresholded_relu(_t(x), 0.5)),
+            np.where(x > 0.5, x, 0), rtol=1e-6)
+        np.testing.assert_allclose(
+            _np(paddle.log_sigmoid(_t(x))),
+            torch.nn.functional.logsigmoid(torch.tensor(x)).numpy(),
+            rtol=1e-5)
+        m = rng.randn(2, 6, 3).astype("float32")
+        out = _np(paddle.maxout(_t(m), 2, axis=1))
+        assert out.shape == (2, 3, 3)
+        paddle.seed(1)
+        r = _np(paddle.rrelu(_t(x), training=False))
+        a = (1 / 8 + 1 / 3) / 2
+        np.testing.assert_allclose(r, np.where(x >= 0, x, a * x),
+                                   rtol=1e-5)
+
+    def test_huber_loss_matches_torch(self):
+        x = rng.randn(20).astype("float32")
+        y = rng.randn(20).astype("float32")
+        ours = float(paddle.ops.extra.huber_loss(_t(x), _t(y), 1.0))
+        ref = float(torch.nn.functional.huber_loss(
+            torch.tensor(x), torch.tensor(y), delta=1.0))
+        assert abs(ours - ref) < 1e-5
+
+    def test_clip_by_norm_and_squared_l2(self):
+        x = np.array([3.0, 4.0], "float32")
+        np.testing.assert_allclose(_np(paddle.clip_by_norm(_t(x), 1.0)),
+                                   [0.6, 0.8], rtol=1e-5)
+        assert float(paddle.squared_l2_norm(_t(x))) == 25.0
+
+    def test_shard_index(self):
+        x = np.array([1, 6, 12, 19], "int64")
+        out = _np(paddle.shard_index(_t(x), 20, 2, 0))
+        np.testing.assert_allclose(out, [1, 6, -1, -1])
+        out = _np(paddle.shard_index(_t(x), 20, 2, 1))
+        np.testing.assert_allclose(out, [-1, -1, 2, 9])
+
+
+class TestGridSampleCTC:
+    def test_grid_sample_matches_torch(self):
+        x = rng.randn(2, 3, 5, 7).astype("float32")
+        grid = (rng.rand(2, 4, 6, 2).astype("float32") * 2.2 - 1.1)
+        for mode in ("bilinear", "nearest"):
+            for pad in ("zeros", "border", "reflection"):
+                ours = _np(F.grid_sample(_t(x), _t(grid), mode, pad,
+                                         True))
+                ref = torch.nn.functional.grid_sample(
+                    torch.tensor(x), torch.tensor(grid), mode=mode,
+                    padding_mode=pad, align_corners=True).numpy()
+                np.testing.assert_allclose(ours, ref, atol=2e-5,
+                                           err_msg=f"{mode}/{pad}")
+
+    def test_grid_sample_grad(self):
+        x = _t(rng.rand(1, 1, 4, 4).astype("float32"))
+        x.stop_gradient = False
+        grid = _t((rng.rand(1, 3, 3, 2).astype("float32") - 0.5))
+        F.grid_sample(x, grid).sum().backward()
+        g = _np(x.grad)
+        assert np.isfinite(g).all() and np.abs(g).sum() > 0
+
+    def test_affine_grid_matches_torch(self):
+        theta = rng.randn(2, 2, 3).astype("float32")
+        for ac in (True, False):
+            ours = _np(F.affine_grid(_t(theta), [2, 3, 4, 5], ac))
+            ref = torch.nn.functional.affine_grid(
+                torch.tensor(theta), [2, 3, 4, 5],
+                align_corners=ac).numpy()
+            np.testing.assert_allclose(ours, ref, atol=1e-5)
+
+    def test_ctc_loss_matches_torch(self):
+        T, B, C, L = 12, 3, 6, 4
+        logits = rng.randn(T, B, C).astype("float32")
+        logp = torch.log_softmax(torch.tensor(logits), -1)
+        labels = rng.randint(1, C, (B, L))
+        in_lens = np.array([12, 10, 7])
+        lab_lens = np.array([4, 3, 2])
+        ref = torch.nn.functional.ctc_loss(
+            logp, torch.tensor(labels), torch.tensor(in_lens),
+            torch.tensor(lab_lens), blank=0, reduction="none").numpy()
+        ours = _np(F.ctc_loss(_t(logp.numpy()), _t(labels), _t(in_lens),
+                              _t(lab_lens), reduction="none"))
+        np.testing.assert_allclose(ours, ref, atol=1e-3)
+
+    def test_ctc_loss_grad_matches_torch_through_logsoftmax(self):
+        # torch's raw log_probs-grad has logits semantics (documented
+        # quirk); through log_softmax both frameworks agree exactly
+        T, B, C, L = 10, 2, 5, 3
+        logits_np = rng.randn(T, B, C).astype("float32")
+        labels = rng.randint(1, C, (B, L))
+        in_lens = np.array([10, 8])
+        lab_lens = np.array([3, 2])
+        tl = torch.tensor(logits_np, requires_grad=True)
+        torch.nn.functional.ctc_loss(
+            torch.log_softmax(tl, -1), torch.tensor(labels),
+            torch.tensor(in_lens), torch.tensor(lab_lens),
+            reduction="sum").backward()
+        pl = _t(logits_np)
+        pl.stop_gradient = False
+        F.ctc_loss(F.log_softmax(pl, axis=-1), _t(labels), _t(in_lens),
+                   _t(lab_lens), reduction="sum").backward()
+        np.testing.assert_allclose(_np(pl.grad), tl.grad.numpy(),
+                                   atol=1e-4)
+
+    def test_gather_tree(self):
+        # 2 steps, 1 batch, 2 beams: final beam 0 came from step-0 beam 1
+        ids = np.array([[[5, 6]], [[7, 8]]])
+        parents = np.array([[[0, 0]], [[1, 0]]])
+        out = _np(paddle.gather_tree(_t(ids), _t(parents)))
+        assert out[1, 0].tolist() == [7, 8]
+        assert out[0, 0].tolist() == [6, 5]  # backtraced parents
+
+    def test_edit_distance(self):
+        d = _np(paddle.edit_distance(
+            [_t(np.array([1, 2, 3]))], [_t(np.array([1, 3, 3, 4]))],
+            normalized=False))
+        assert d[0] == 2.0  # substitute + insert
+
+
+class TestReviewRegressions:
+    def test_max_unpool2d_with_padding_shape(self):
+        x = rng.randn(1, 1, 4, 4).astype("float32")
+        out, idx = paddle.max_pool2d_with_index(_t(x), 2, 2, padding=1)
+        restored = paddle.max_unpool2d(out, idx, 2, 2, padding=1)
+        assert restored.shape == [1, 1, 4, 4]
+
+    def test_rrelu_grad_flows(self):
+        x = _t(rng.randn(10).astype("float32"))
+        x.stop_gradient = False
+        out = paddle.rrelu(x, training=False)
+        assert not out.stop_gradient
+        out.sum().backward()
+        assert np.isfinite(_np(x.grad)).all()
+
+    def test_top_p_per_row_and_seed(self):
+        logits = np.zeros((2, 5), "float32")
+        logits[0, 1] = 10.0   # row 0: all mass on token 1
+        logits[1] = np.array([2.0, 1.9, 1.8, -10, -10])
+        _, ids1 = paddle.top_p_sampling(_t(logits),
+                                        _t(np.array([0.5, 0.99],
+                                                    "float32")), seed=3)
+        _, ids2 = paddle.top_p_sampling(_t(logits),
+                                        _t(np.array([0.5, 0.99],
+                                                    "float32")), seed=3)
+        assert _np(ids1)[0, 0] == 1           # row-0 nucleus is {1}
+        assert _np(ids1).tolist() == _np(ids2).tolist()  # seeded
+
+    def test_ctc_norm_by_times(self):
+        T, B, C, L = 8, 2, 5, 3
+        logits = rng.randn(T, B, C).astype("float32")
+        lp = torch.log_softmax(torch.tensor(logits), -1).numpy()
+        labels = rng.randint(1, C, (B, L))
+        il, ll = np.array([8, 6]), np.array([3, 2])
+        raw = _np(F.ctc_loss(_t(lp), _t(labels), _t(il), _t(ll),
+                             reduction="none"))
+        nbt = _np(F.ctc_loss(_t(lp), _t(labels), _t(il), _t(ll),
+                             reduction="none", norm_by_times=True))
+        np.testing.assert_allclose(nbt, raw / il, rtol=1e-6)
+
+    def test_clip_by_norm_zero_grad(self):
+        x = _t(np.zeros(3, "float32"))
+        x.stop_gradient = False
+        paddle.clip_by_norm(x, 1.0).sum().backward()
+        assert np.isfinite(_np(x.grad)).all()
+
+    def test_no_duplicate_ops(self):
+        # mv/numel/unbind live in math/manipulation only
+        from paddle_tpu.ops import extra
+        assert not hasattr(extra, "mv")
+        assert not hasattr(extra, "numel")
+        assert not hasattr(extra, "unbind")
+        assert callable(paddle.mv) and callable(paddle.numel)
+        assert callable(paddle.unbind)
