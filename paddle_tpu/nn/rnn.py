@@ -1,0 +1,435 @@
+"""Recurrent layers (reference: python/paddle/nn/layer/rnn.py —
+SimpleRNNCell:697, LSTMCell:876, GRUCell:1074, RNN:1268, RNNBase:1426,
+SimpleRNN/LSTM/GRU:1724/1846/1972 over the phi rnn kernel, which
+dynloads cuDNN RNN descriptors on GPU).
+
+TPU design: each (layer, direction) pass is ONE ``lax.scan`` over time
+— compiled once for any length, differentiable through the scan, no
+per-step dispatch. The input-to-hidden projection for ALL timesteps is
+hoisted out of the scan as a single [T*B, in] x [in, gates*h] matmul
+(MXU-shaped), so the recurrence only carries the [B, h] state GEMMs.
+Gate order matches the reference (LSTM: i,f,g,o; GRU: r,z,c), which is
+also cuDNN/torch order — state dicts port over directly.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.dispatch import def_op
+from ..core.enforce import enforce
+from ..tensor import Tensor
+from .container import LayerList
+from .layer import Layer
+
+__all__ = ["SimpleRNNCell", "LSTMCell", "GRUCell", "RNN", "SimpleRNN",
+           "LSTM", "GRU", "BiRNN"]
+
+
+def _act(name):
+    return jnp.tanh if name == "tanh" else (lambda x: jnp.maximum(x, 0))
+
+
+# ---------------------------------------------------------------------------
+# scan kernels: x is TIME-MAJOR [T, B, in] inside the kernel
+# ---------------------------------------------------------------------------
+def _order(x, lens, reverse):
+    """Per-row time order: reversed rows flip only their VALID prefix
+    (padded steps stay in place), so both directions share the same
+    freeze-past-length recurrence."""
+    T = x.shape[0]
+    if not reverse:
+        return x
+    if lens is None:
+        return x[::-1]
+    t = jnp.arange(T)[:, None]                      # [T, 1]
+    idx = jnp.where(t < lens[None, :], lens[None, :] - 1 - t, t)  # [T, B]
+    return jnp.take_along_axis(x, idx[:, :, None], axis=0)
+
+
+def _live_mask(lens, T):
+    if lens is None:
+        return None
+    return jnp.arange(T)[:, None] < lens[None, :]    # [T, B]
+
+
+@def_op("rnn_scan")
+def _rnn_scan(x, h0, w_ih, w_hh, b_ih, b_hh, reverse, activation,
+              lens=None):
+    act = _act(activation)
+    T = x.shape[0]
+    xt = _order(x, lens, reverse)
+    i2h = xt @ w_ih.T + (b_ih if b_ih is not None else 0.0)
+    live = _live_mask(lens, T)
+
+    def step(h, inp):
+        i2h_t, live_t = inp
+        hn = act(i2h_t + h @ w_hh.T + (b_hh if b_hh is not None else 0.0))
+        if live_t is not None:
+            hn = jnp.where(live_t[:, None], hn, h)
+            out = jnp.where(live_t[:, None], hn, jnp.zeros_like(hn))
+        else:
+            out = hn
+        return hn, out
+
+    hN, ys = lax.scan(step, h0, (i2h, live))
+    return _order(ys, lens, reverse), hN
+
+
+@def_op("lstm_scan")
+def _lstm_scan(x, h0, c0, w_ih, w_hh, b_ih, b_hh, reverse, lens=None):
+    T = x.shape[0]
+    xt = _order(x, lens, reverse)
+    i2h = xt @ w_ih.T + (b_ih if b_ih is not None else 0.0)
+    H = h0.shape[-1]
+    live = _live_mask(lens, T)
+
+    def step(carry, inp):
+        h, c = carry
+        i2h_t, live_t = inp
+        g = i2h_t + h @ w_hh.T + (b_hh if b_hh is not None else 0.0)
+        i = jax.nn.sigmoid(g[:, 0 * H:1 * H])
+        f = jax.nn.sigmoid(g[:, 1 * H:2 * H])
+        gg = jnp.tanh(g[:, 2 * H:3 * H])
+        o = jax.nn.sigmoid(g[:, 3 * H:4 * H])
+        cn = f * c + i * gg
+        hn = o * jnp.tanh(cn)
+        if live_t is not None:
+            hn = jnp.where(live_t[:, None], hn, h)
+            cn = jnp.where(live_t[:, None], cn, c)
+            out = jnp.where(live_t[:, None], hn, jnp.zeros_like(hn))
+        else:
+            out = hn
+        return (hn, cn), out
+
+    (hN, cN), ys = lax.scan(step, (h0, c0), (i2h, live))
+    return _order(ys, lens, reverse), hN, cN
+
+
+@def_op("gru_scan")
+def _gru_scan(x, h0, w_ih, w_hh, b_ih, b_hh, reverse, lens=None):
+    T = x.shape[0]
+    xt = _order(x, lens, reverse)
+    i2h = xt @ w_ih.T + (b_ih if b_ih is not None else 0.0)
+    H = h0.shape[-1]
+    live = _live_mask(lens, T)
+
+    def step(h, inp):
+        i2h_t, live_t = inp
+        hg = h @ w_hh.T + (b_hh if b_hh is not None else 0.0)
+        r = jax.nn.sigmoid(i2h_t[:, :H] + hg[:, :H])
+        z = jax.nn.sigmoid(i2h_t[:, H:2 * H] + hg[:, H:2 * H])
+        c = jnp.tanh(i2h_t[:, 2 * H:] + r * hg[:, 2 * H:])
+        hn = (h - c) * z + c         # == z*h + (1-z)*c (reference form)
+        if live_t is not None:
+            hn = jnp.where(live_t[:, None], hn, h)
+            out = jnp.where(live_t[:, None], hn, jnp.zeros_like(hn))
+        else:
+            out = hn
+        return hn, out
+
+    hN, ys = lax.scan(step, h0, (i2h, live))
+    return _order(ys, lens, reverse), hN
+
+
+# ---------------------------------------------------------------------------
+# cells (single-step API, reference rnn.py:697/876/1074)
+# ---------------------------------------------------------------------------
+class RNNCellBase(Layer):
+    def __init__(self, input_size, hidden_size, gates,
+                 weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        std = 1.0 / math.sqrt(hidden_size)
+        from .initializer import Uniform
+
+        init = Uniform(-std, std)
+        self.weight_ih = self.create_parameter(
+            (gates * hidden_size, input_size), attr=weight_ih_attr,
+            default_initializer=init)
+        self.weight_hh = self.create_parameter(
+            (gates * hidden_size, hidden_size), attr=weight_hh_attr,
+            default_initializer=init)
+        # attr=False -> no bias (the scan kernels handle None)
+        self.bias_ih = None if bias_ih_attr is False else \
+            self.create_parameter((gates * hidden_size,), is_bias=True,
+                                  attr=bias_ih_attr,
+                                  default_initializer=init)
+        self.bias_hh = None if bias_hh_attr is False else \
+            self.create_parameter((gates * hidden_size,), is_bias=True,
+                                  attr=bias_hh_attr,
+                                  default_initializer=init)
+
+    def _zeros(self, inputs, n):
+        B = inputs.shape[0]
+        z = jnp.zeros((B, self.hidden_size), inputs._value.dtype)
+        if n == 1:
+            return Tensor(z, stop_gradient=True)
+        return tuple(Tensor(z, stop_gradient=True) for _ in range(n))
+
+
+class SimpleRNNCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, activation="tanh",
+                 weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__(input_size, hidden_size, 1, weight_ih_attr,
+                         weight_hh_attr, bias_ih_attr, bias_hh_attr)
+        enforce(activation in ("tanh", "relu"),
+                lambda: f"activation must be tanh/relu, got {activation}")
+        self.activation = activation
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self._zeros(inputs, 1)
+        ys, _ = _rnn_scan(_expand_t(inputs), states, self.weight_ih,
+                          self.weight_hh, self.bias_ih, self.bias_hh,
+                          False, self.activation)
+        h = _squeeze_t(ys)
+        return h, h
+
+
+class LSTMCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__(input_size, hidden_size, 4, weight_ih_attr,
+                         weight_hh_attr, bias_ih_attr, bias_hh_attr)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self._zeros(inputs, 2)
+        h0, c0 = states
+        ys, hN, cN = _lstm_scan(_expand_t(inputs), h0, c0, self.weight_ih,
+                                self.weight_hh, self.bias_ih,
+                                self.bias_hh, False)
+        h = _squeeze_t(ys)
+        return h, (h, cN)
+
+
+class GRUCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__(input_size, hidden_size, 3, weight_ih_attr,
+                         weight_hh_attr, bias_ih_attr, bias_hh_attr)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self._zeros(inputs, 1)
+        ys, hN = _gru_scan(_expand_t(inputs), states, self.weight_ih,
+                           self.weight_hh, self.bias_ih, self.bias_hh,
+                           False)
+        h = _squeeze_t(ys)
+        return h, h
+
+
+def _expand_t(x):
+    """[B, in] -> [1, B, in] for the scan kernels."""
+    from ..ops.manipulation import unsqueeze
+
+    return unsqueeze(x, 0)
+
+
+def _squeeze_t(x):
+    from ..ops.manipulation import squeeze
+
+    return squeeze(x, 0)
+
+
+# ---------------------------------------------------------------------------
+# sequence runners
+# ---------------------------------------------------------------------------
+class RNN(Layer):
+    """Run a cell over a sequence (reference rnn.py:1268). The whole
+    sequence runs in the cell's scan kernel when the cell is one of the
+    builtin cells; custom cells fall back to a python loop over time
+    (traceable under jit.to_static)."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None,
+                **kwargs):
+        x = inputs if self.time_major else inputs.transpose([1, 0, 2])
+        lens = sequence_length
+        # exact-type checks: a SUBCLASS with an overridden forward must
+        # take the custom-cell path, not the parent's fused equations
+        if type(self.cell) is LSTMCell:
+            if initial_states is None:
+                initial_states = self.cell._zeros(x[0], 2)
+            h0, c0 = initial_states
+            ys, hN, cN = _lstm_scan(x, h0, c0, self.cell.weight_ih,
+                                    self.cell.weight_hh,
+                                    self.cell.bias_ih, self.cell.bias_hh,
+                                    self.is_reverse, lens=lens)
+            out = ys if self.time_major else ys.transpose([1, 0, 2])
+            return out, (hN, cN)
+        if type(self.cell) is GRUCell:
+            if initial_states is None:
+                initial_states = self.cell._zeros(x[0], 1)
+            ys, hN = _gru_scan(x, initial_states, self.cell.weight_ih,
+                               self.cell.weight_hh, self.cell.bias_ih,
+                               self.cell.bias_hh, self.is_reverse,
+                               lens=lens)
+            return (ys if self.time_major
+                    else ys.transpose([1, 0, 2])), hN
+        if type(self.cell) is SimpleRNNCell:
+            if initial_states is None:
+                initial_states = self.cell._zeros(x[0], 1)
+            ys, hN = _rnn_scan(x, initial_states, self.cell.weight_ih,
+                               self.cell.weight_hh, self.cell.bias_ih,
+                               self.cell.bias_hh, self.is_reverse,
+                               self.cell.activation, lens=lens)
+            return (ys if self.time_major
+                    else ys.transpose([1, 0, 2])), hN
+        # custom cell: python time loop
+        enforce(lens is None,
+                "sequence_length with a custom cell is not supported; "
+                "mask outputs manually")
+        T = x.shape[0]
+        states = initial_states
+        outs = []
+        ts = range(T - 1, -1, -1) if self.is_reverse else range(T)
+        for t in ts:
+            y, states = self.cell(x[t], states)
+            outs.append(y)
+        if self.is_reverse:
+            outs = outs[::-1]
+        from ..ops.manipulation import stack
+
+        ys = stack(outs, axis=0)
+        return (ys if self.time_major else ys.transpose([1, 0, 2])), states
+
+
+class BiRNN(Layer):
+    """Forward + backward cells over one sequence (reference
+    rnn.py:1352)."""
+
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.rnn_fw = RNN(cell_fw, False, time_major)
+        self.rnn_bw = RNN(cell_bw, True, time_major)
+
+    def forward(self, inputs, initial_states=None, sequence_length=None,
+                **kwargs):
+        s_fw = s_bw = None
+        if initial_states is not None:
+            s_fw, s_bw = initial_states
+        y_fw, st_fw = self.rnn_fw(inputs, s_fw,
+                                  sequence_length=sequence_length)
+        y_bw, st_bw = self.rnn_bw(inputs, s_bw,
+                                  sequence_length=sequence_length)
+        from ..ops.manipulation import concat
+
+        return concat([y_fw, y_bw], axis=-1), (st_fw, st_bw)
+
+
+class RNNBase(LayerList):
+    """Stacked multi-layer (optionally bidirectional) recurrence
+    (reference rnn.py:1426)."""
+
+    def __init__(self, mode, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None, activation="tanh"):
+        super().__init__()
+        enforce(direction in ("forward", "bidirect", "bidirectional"),
+                lambda: f"unknown direction {direction!r}")
+        self.mode = mode
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.time_major = time_major
+        self.dropout = dropout
+        self.num_directions = 1 if direction == "forward" else 2
+        self.state_components = 2 if mode == "LSTM" else 1
+
+        def make_cell(in_sz):
+            if mode == "LSTM":
+                return LSTMCell(in_sz, hidden_size)
+            if mode == "GRU":
+                return GRUCell(in_sz, hidden_size)
+            return SimpleRNNCell(in_sz, hidden_size, activation)
+
+        for i in range(num_layers):
+            in_sz = input_size if i == 0 \
+                else hidden_size * self.num_directions
+            if self.num_directions == 1:
+                self.append(RNN(make_cell(in_sz), False, time_major))
+            else:
+                self.append(BiRNN(make_cell(in_sz), make_cell(in_sz),
+                                  time_major))
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from ..ops.manipulation import stack
+        from .functional import dropout as _dropout
+
+        B_axis = 1 if self.time_major else 0
+        L, D = self.num_layers, self.num_directions
+        states_in = None
+        if initial_states is not None:
+            # [L*D, B, H] (or a (h, c) tuple of those for LSTM)
+            if self.state_components == 2:
+                h_all, c_all = initial_states
+                states_in = [(h_all[i], c_all[i]) for i in range(L * D)]
+            else:
+                states_in = [initial_states[i] for i in range(L * D)]
+
+        x = inputs
+        h_outs, c_outs = [], []
+        for li, layer in enumerate(self):
+            if states_in is None:
+                st = None
+            elif D == 1:
+                st = states_in[li]
+            else:
+                st = (states_in[2 * li], states_in[2 * li + 1])
+            x, st_out = layer(x, st, sequence_length=sequence_length)
+            if D == 1:
+                st_list = [st_out]
+            else:
+                st_list = list(st_out)
+            for s in st_list:
+                if self.state_components == 2:
+                    h_outs.append(s[0])
+                    c_outs.append(s[1])
+                else:
+                    h_outs.append(s)
+            if self.dropout and li < len(self._sub_layers) - 1:
+                x = _dropout(x, p=self.dropout, training=self.training)
+        if self.state_components == 2:
+            return x, (stack(h_outs, axis=0), stack(c_outs, axis=0))
+        return x, stack(h_outs, axis=0)
+
+
+class SimpleRNN(RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 activation="tanh", **kwargs):
+        super().__init__("RNN", input_size, hidden_size, num_layers,
+                         direction, time_major, dropout,
+                         activation=activation)
+
+
+class LSTM(RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 **kwargs):
+        super().__init__("LSTM", input_size, hidden_size, num_layers,
+                         direction, time_major, dropout)
+
+
+class GRU(RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 **kwargs):
+        super().__init__("GRU", input_size, hidden_size, num_layers,
+                         direction, time_major, dropout)

@@ -1,0 +1,217 @@
+"""Recurrent layers (reference: python/paddle/nn/layer/rnn.py) — torch
+parity across modes/layers/directions; the scan kernels share cuDNN
+gate order so weights port directly."""
+import numpy as np
+import torch
+
+import jax
+import paddle_tpu as paddle
+from paddle_tpu import nn
+
+rng = np.random.RandomState(0)
+B, T, IN, H = 3, 7, 5, 6
+
+
+def _cells(pl_rnn):
+    cells = []
+    for layer in pl_rnn:
+        if hasattr(layer, "cell"):
+            cells.append(layer.cell)
+        else:
+            cells.append(layer.rnn_fw.cell)
+            cells.append(layer.rnn_bw.cell)
+    return cells
+
+
+def _copy_weights(pl_rnn, th_rnn, D):
+    for i, cell in enumerate(_cells(pl_rnn)):
+        layer, d = divmod(i, D)
+        sfx = f"_l{layer}" + ("_reverse" if d else "")
+        for ours, theirs in [("weight_ih", "weight_ih"),
+                             ("weight_hh", "weight_hh"),
+                             ("bias_ih", "bias_ih"),
+                             ("bias_hh", "bias_hh")]:
+            getattr(cell, ours)._value = jax.numpy.asarray(
+                getattr(th_rnn, f"{theirs}{sfx}").detach().numpy())
+
+
+def _check(mode, pl_cls, th_cls, num_layers, direction):
+    D = 2 if direction != "forward" else 1
+    paddle.seed(0)
+    pl = pl_cls(IN, H, num_layers=num_layers, direction=direction)
+    th = th_cls(IN, H, num_layers=num_layers, batch_first=True,
+                bidirectional=(D == 2))
+    _copy_weights(pl, th, D)
+    x = rng.randn(B, T, IN).astype("float32")
+    out_p, st_p = pl(paddle.to_tensor(x))
+    out_t, st_t = th(torch.tensor(x))
+    np.testing.assert_allclose(np.asarray(out_p._value),
+                               out_t.detach().numpy(), atol=1e-5)
+    if mode == "LSTM":
+        np.testing.assert_allclose(np.asarray(st_p[0]._value),
+                                   st_t[0].detach().numpy(), atol=1e-5)
+        np.testing.assert_allclose(np.asarray(st_p[1]._value),
+                                   st_t[1].detach().numpy(), atol=1e-5)
+    else:
+        np.testing.assert_allclose(np.asarray(st_p._value),
+                                   st_t.detach().numpy(), atol=1e-5)
+
+
+def test_lstm_matches_torch():
+    for L in (1, 2):
+        for d in ("forward", "bidirect"):
+            _check("LSTM", nn.LSTM, torch.nn.LSTM, L, d)
+
+
+def test_gru_matches_torch():
+    for L in (1, 2):
+        for d in ("forward", "bidirect"):
+            _check("GRU", nn.GRU, torch.nn.GRU, L, d)
+
+
+def test_simple_rnn_matches_torch():
+    for L in (1, 2):
+        for d in ("forward", "bidirect"):
+            _check("RNN", nn.SimpleRNN, torch.nn.RNN, L, d)
+
+
+def test_initial_states_roundtrip():
+    paddle.seed(1)
+    lstm = nn.LSTM(IN, H, num_layers=2)
+    x = paddle.to_tensor(rng.randn(B, T, IN).astype("float32"))
+    out1, (h1, c1) = lstm(x)
+    # feeding the final states back continues the sequence exactly
+    out2, _ = lstm(x, (h1, c1))
+    full, _ = lstm(paddle.to_tensor(np.concatenate(
+        [np.asarray(x._value)] * 2, axis=1)))
+    np.testing.assert_allclose(np.asarray(out2._value),
+                               np.asarray(full._value)[:, T:], atol=1e-5)
+
+
+def test_time_major():
+    paddle.seed(2)
+    gru_bm = nn.GRU(IN, H)
+    gru_tm = nn.GRU(IN, H, time_major=True)
+    for c_dst, c_src in zip(_cells(gru_tm), _cells(gru_bm)):
+        for w in ("weight_ih", "weight_hh", "bias_ih", "bias_hh"):
+            getattr(c_dst, w)._value = getattr(c_src, w)._value
+    x = rng.randn(B, T, IN).astype("float32")
+    o1, _ = gru_bm(paddle.to_tensor(x))
+    o2, _ = gru_tm(paddle.to_tensor(x.transpose(1, 0, 2)))
+    np.testing.assert_allclose(np.asarray(o1._value),
+                               np.asarray(o2._value).transpose(1, 0, 2),
+                               atol=1e-6)
+
+
+def test_gradients_flow_and_train():
+    paddle.seed(3)
+    lstm = nn.LSTM(IN, H, num_layers=1)
+    head = nn.Linear(H, 2)
+    params = list(lstm.parameters()) + list(head.parameters())
+    opt = paddle.optimizer.AdamW(learning_rate=5e-3, parameters=params)
+    x = paddle.to_tensor(rng.randn(8, T, IN).astype("float32"))
+    y = paddle.to_tensor(np.arange(8) % 2)
+    first = None
+    for _ in range(15):
+        out, (h, _) = lstm(x)
+        loss = nn.functional.cross_entropy(head(h[-1]), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        if first is None:
+            first = float(loss)
+    assert float(loss) < first * 0.8
+
+
+def test_cells_single_step():
+    paddle.seed(4)
+    cell = nn.LSTMCell(IN, H)
+    x = paddle.to_tensor(rng.randn(B, IN).astype("float32"))
+    h, (h2, c2) = cell(x)
+    assert h.shape == [B, H] and c2.shape == [B, H]
+    cell_g = nn.GRUCell(IN, H)
+    h, hn = cell_g(x)
+    assert h.shape == [B, H]
+    cell_s = nn.SimpleRNNCell(IN, H, activation="relu")
+    h, hn = cell_s(x)
+    assert (np.asarray(h._value) >= 0).all()
+
+
+def test_custom_cell_through_rnn():
+    class Doubler(nn.Layer):
+        def forward(self, x, states=None):
+            s = states if states is not None else x * 0
+            out = x + s
+            return out, out
+
+    runner = nn.RNN(Doubler())
+    x = paddle.to_tensor(np.ones((2, 4, 3), "float32"))
+    out, st = runner(x)
+    # cumulative sum over time: 1, 2, 3, 4
+    np.testing.assert_allclose(np.asarray(out._value)[0, :, 0],
+                               [1, 2, 3, 4])
+
+
+def test_birnn_wrapper():
+    paddle.seed(5)
+    bi = nn.BiRNN(nn.GRUCell(IN, H), nn.GRUCell(IN, H))
+    x = paddle.to_tensor(rng.randn(B, T, IN).astype("float32"))
+    out, (st_f, st_b) = bi(x)
+    assert out.shape == [B, T, 2 * H]
+
+
+def test_dropout_between_layers_trains_only():
+    paddle.seed(6)
+    lstm = nn.LSTM(IN, H, num_layers=2, dropout=0.5)
+    x = paddle.to_tensor(rng.randn(B, T, IN).astype("float32"))
+    lstm.eval()
+    o1, _ = lstm(x)
+    o2, _ = lstm(x)
+    np.testing.assert_allclose(np.asarray(o1._value),
+                               np.asarray(o2._value))  # eval: no dropout
+
+
+def test_sequence_length_matches_torch_packed():
+    paddle.seed(7)
+    D = 2
+    pl = nn.LSTM(IN, H, direction="bidirect")
+    th = torch.nn.LSTM(IN, H, batch_first=True, bidirectional=True)
+    _copy_weights(pl, th, D)
+    x = rng.randn(B, T, IN).astype("float32")
+    lens = np.array([7, 4, 2])
+    out_p, (h_p, c_p) = pl(paddle.to_tensor(x),
+                           sequence_length=paddle.to_tensor(lens))
+    packed = torch.nn.utils.rnn.pack_padded_sequence(
+        torch.tensor(x), torch.tensor(lens), batch_first=True,
+        enforce_sorted=False)
+    out_t, (h_t, c_t) = th(packed)
+    out_t, _ = torch.nn.utils.rnn.pad_packed_sequence(out_t,
+                                                      batch_first=True)
+    np.testing.assert_allclose(np.asarray(out_p._value),
+                               out_t.detach().numpy(), atol=1e-5)
+    np.testing.assert_allclose(np.asarray(h_p._value),
+                               h_t.detach().numpy(), atol=1e-5)
+    np.testing.assert_allclose(np.asarray(c_p._value),
+                               c_t.detach().numpy(), atol=1e-5)
+
+
+def test_bias_attr_false():
+    cell = nn.GRUCell(IN, H, bias_ih_attr=False, bias_hh_attr=False)
+    assert cell.bias_ih is None and cell.bias_hh is None
+    x = paddle.to_tensor(rng.randn(B, IN).astype("float32"))
+    h, _ = cell(x)
+    assert h.shape == [B, H]
+
+
+def test_subclassed_cell_uses_custom_forward():
+    class ConstCell(nn.GRUCell):
+        def forward(self, x, states=None):
+            out = (x[:, :1] * 0 + 5.0).expand([x.shape[0],
+                                               self.hidden_size])
+            return out, out
+
+    runner = nn.RNN(ConstCell(IN, H))
+    x = paddle.to_tensor(rng.randn(2, 3, IN).astype("float32"))
+    out, _ = runner(x)
+    np.testing.assert_allclose(np.asarray(out._value),
+                               np.full((2, 3, H), 5.0, "float32"))
