@@ -1,0 +1,855 @@
+"""Long-tail layer classes (reference: python/paddle/nn/layer/* rows
+previously absent here — 1-D/3-D pooling and convs, padding layers,
+distance/similarity, the loss-zoo tail, unpool/fold wrappers,
+SpectralNorm). Thin compositions over the op registry; each docstring
+names its reference class.
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.dispatch import def_op
+from ..core.enforce import enforce
+from ..ops import extra as _extra
+from ..tensor import Tensor
+from . import functional as F
+from .layer import Layer
+
+__all__ = [
+    "AvgPool1D", "MaxPool1D", "AvgPool3D", "MaxPool3D",
+    "AdaptiveAvgPool1D", "AdaptiveMaxPool1D", "AdaptiveAvgPool3D",
+    "AdaptiveMaxPool3D", "MaxUnPool1D", "MaxUnPool2D",
+    "Conv3D", "Conv1DTranspose", "Conv3DTranspose",
+    "Dropout3D", "AlphaDropout",
+    "Pad1D", "Pad2D", "Pad3D", "ZeroPad2D",
+    "Bilinear", "CosineSimilarity", "PairwiseDistance",
+    "LogSigmoid", "Maxout", "RReLU", "ThresholdedReLU", "Softmax2D",
+    "ChannelShuffle", "PixelUnshuffle", "Fold", "Unfold", "Unflatten",
+    "UpsamplingNearest2D", "SpectralNorm",
+    "InstanceNorm1D", "InstanceNorm3D",
+    "CTCLoss", "HuberLoss", "CosineEmbeddingLoss", "GaussianNLLLoss",
+    "HingeEmbeddingLoss", "MultiLabelSoftMarginLoss", "MultiMarginLoss",
+    "PoissonNLLLoss", "SoftMarginLoss", "TripletMarginLoss",
+    "TripletMarginWithDistanceLoss", "HSigmoidLoss",
+]
+
+
+def _pair(v, n=2):
+    return (int(v),) * n if np.isscalar(v) else tuple(int(i) for i in v)
+
+
+def _reduce(loss, reduction):
+    if reduction == "mean":
+        return loss.mean()
+    if reduction == "sum":
+        return loss.sum()
+    return loss
+
+
+# ---------------------------------------------------------------------------
+# 1-D / 3-D pooling (reference: nn/layer/pooling.py)
+# ---------------------------------------------------------------------------
+class _Pool1D(Layer):
+    def __init__(self, kernel_size, stride, padding, mode,
+                 ceil_mode=False):
+        super().__init__()
+        enforce(not ceil_mode, "ceil_mode is not supported here")
+        self.k = kernel_size
+        self.s = stride or kernel_size
+        self.p = padding
+        self.mode = mode
+
+    def forward(self, x):
+        from ..ops.manipulation import squeeze, unsqueeze
+
+        x4 = unsqueeze(x, 2)  # [B, C, 1, L]
+        fn = F.max_pool2d if self.mode == "max" else F.avg_pool2d
+        out = fn(x4, (1, self.k), stride=(1, self.s),
+                 padding=(0, self.p))
+        return squeeze(out, 2)
+
+
+class MaxPool1D(_Pool1D):
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 ceil_mode=False, **kw):
+        super().__init__(kernel_size, stride, padding, "max", ceil_mode)
+
+
+class AvgPool1D(_Pool1D):
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 ceil_mode=False, **kw):
+        super().__init__(kernel_size, stride, padding, "avg", ceil_mode)
+
+
+class MaxPool3D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0, **kw):
+        super().__init__()
+        self.k, self.s, self.p = kernel_size, stride, padding
+
+    def forward(self, x):
+        return _extra.max_pool3d(x, self.k, self.s, self.p)
+
+
+class AvgPool3D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0, **kw):
+        super().__init__()
+        self.k, self.s, self.p = kernel_size, stride, padding
+
+    def forward(self, x):
+        return _extra.avg_pool3d(x, self.k, self.s, self.p)
+
+
+@def_op("adaptive_pool_nd")
+def _adaptive_pool_nd(x, out_sizes, mode):
+    """Adaptive pool over the trailing len(out_sizes) spatial dims:
+    each output cell reduces its floor/ceil-bounded input window
+    (matches the reference's bin math)."""
+    spatial0 = x.ndim - len(out_sizes)
+    out = x
+    for i, osz in enumerate(out_sizes):
+        ax = spatial0 + i
+        isz = out.shape[ax]
+        osz = int(osz)
+        starts = [int(np.floor(j * isz / osz)) for j in range(osz)]
+        ends = [int(np.ceil((j + 1) * isz / osz)) for j in range(osz)]
+        slabs = []
+        for st, en in zip(starts, ends):
+            sl = lax.slice_in_dim(out, st, en, axis=ax)
+            red = jnp.max(sl, axis=ax, keepdims=True) if mode == "max" \
+                else jnp.mean(sl, axis=ax, keepdims=True)
+            slabs.append(red)
+        out = jnp.concatenate(slabs, axis=ax)
+    return out
+
+
+class _AdaptivePool(Layer):
+    def __init__(self, output_size, nd, mode):
+        super().__init__()
+        self.out_sizes = _pair(output_size, nd)
+        self.mode = mode
+
+    def forward(self, x):
+        return _adaptive_pool_nd(x, self.out_sizes, self.mode)
+
+
+class AdaptiveAvgPool1D(_AdaptivePool):
+    def __init__(self, output_size, **kw):
+        super().__init__(output_size, 1, "avg")
+
+
+class AdaptiveMaxPool1D(_AdaptivePool):
+    def __init__(self, output_size, **kw):
+        super().__init__(output_size, 1, "max")
+
+
+class AdaptiveAvgPool3D(_AdaptivePool):
+    def __init__(self, output_size, **kw):
+        super().__init__(output_size, 3, "avg")
+
+
+class AdaptiveMaxPool3D(_AdaptivePool):
+    def __init__(self, output_size, **kw):
+        super().__init__(output_size, 3, "max")
+
+
+class MaxUnPool2D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0, **kw):
+        super().__init__()
+        self.k, self.s, self.p = kernel_size, stride, padding
+
+    def forward(self, x, indices, output_size=None):
+        return _extra.max_unpool2d(x, indices, self.k, self.s, self.p,
+                                   output_size)
+
+
+class MaxUnPool1D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0, **kw):
+        super().__init__()
+        self.k, self.s, self.p = kernel_size, stride, padding
+
+    def forward(self, x, indices, output_size=None):
+        from ..ops.manipulation import squeeze, unsqueeze
+
+        out = _extra.max_unpool2d(
+            unsqueeze(x, 2), unsqueeze(indices, 2), (1, self.k),
+            (1, self.s or self.k), (0, self.p),
+            None if output_size is None
+            else (1, int(output_size[-1])))
+        return squeeze(out, 2)
+
+
+# ---------------------------------------------------------------------------
+# convs (reference: nn/layer/conv.py)
+# ---------------------------------------------------------------------------
+class Conv3D(Layer):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, padding_mode="zeros",
+                 weight_attr=None, bias_attr=None, data_format="NCDHW"):
+        super().__init__()
+        enforce(padding_mode == "zeros",
+                "Conv3D here supports padding_mode='zeros'")
+        k = _pair(kernel_size, 3)
+        self.stride, self.padding, self.dilation = stride, padding, dilation
+        self.groups = groups
+        fan_in = in_channels * int(np.prod(k))
+        from .initializer import Uniform
+
+        bound = 1.0 / math.sqrt(fan_in)
+        self.weight = self.create_parameter(
+            (out_channels, in_channels // groups) + k,
+            default_initializer=Uniform(-bound, bound))
+        self.bias = None if bias_attr is False else self.create_parameter(
+            (out_channels,), is_bias=True,
+            default_initializer=Uniform(-bound, bound))
+
+    def forward(self, x):
+        return _extra.conv3d(x, self.weight, self.bias, self.stride,
+                             self.padding, self.dilation, self.groups)
+
+
+@def_op("conv_transpose_nd")
+def _conv_transpose_nd(x, w, bias, stride, padding, nd, dilation=1,
+                       output_padding=0):
+    """Gradient-of-conv transposed convolution (reference: phi
+    conv2d_transpose-family kernels). w is [in, out//groups, *k]."""
+    stride = _pair(stride, nd)
+    padding = _pair(padding, nd)
+    dilation = _pair(dilation, nd)
+    out_pad = _pair(output_padding, nd)
+    dn_in = "NC" + "DHW"[3 - nd:]
+    # paddle's [in, out, *k] weight IS the forward conv's OIW kernel
+    # (the forward conv maps out_ch -> in_ch); transpose_kernel=True
+    # makes conv_transpose compute that conv's input-VJP. The paddle/
+    # torch "padding" p trims the output — in lax terms each side pads
+    # d*(k-1) - p; output_padding extends the RIGHT side only.
+    dims = lax.conv_dimension_numbers(
+        x.shape, w.shape, (dn_in, "OI" + "DHW"[3 - nd:], dn_in))
+    pads = []
+    for i in range(nd):
+        eff = dilation[i] * (w.shape[2 + i] - 1)
+        pads.append((eff - padding[i],
+                     eff - padding[i] + out_pad[i]))
+    out = lax.conv_transpose(
+        x, w, stride, pads, rhs_dilation=dilation,
+        dimension_numbers=dims, transpose_kernel=True)
+    if bias is not None:
+        out = out + bias.reshape((1, -1) + (1,) * nd)
+    return out
+
+
+class Conv1DTranspose(Layer):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, output_padding=0, groups=1, dilation=1,
+                 bias_attr=None, **kw):
+        super().__init__()
+        enforce(groups == 1, "Conv1DTranspose here supports groups=1")
+        self.stride, self.padding = stride, padding
+        self.dilation, self.output_padding = dilation, output_padding
+        from .initializer import Uniform
+
+        bound = 1.0 / math.sqrt(in_channels * int(kernel_size))
+        self.weight = self.create_parameter(
+            (in_channels, out_channels, int(kernel_size)),
+            default_initializer=Uniform(-bound, bound))
+        self.bias = None if bias_attr is False else self.create_parameter(
+            (out_channels,), is_bias=True,
+            default_initializer=Uniform(-bound, bound))
+
+    def forward(self, x):
+        return _conv_transpose_nd(x, self.weight, self.bias, self.stride,
+                                  self.padding, 1, self.dilation,
+                                  self.output_padding)
+
+
+class Conv3DTranspose(Layer):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, output_padding=0, groups=1, dilation=1,
+                 bias_attr=None, **kw):
+        super().__init__()
+        enforce(groups == 1, "Conv3DTranspose here supports groups=1")
+        k = _pair(kernel_size, 3)
+        self.stride, self.padding = stride, padding
+        self.dilation, self.output_padding = dilation, output_padding
+        from .initializer import Uniform
+
+        bound = 1.0 / math.sqrt(in_channels * int(np.prod(k)))
+        self.weight = self.create_parameter(
+            (in_channels, out_channels) + k,
+            default_initializer=Uniform(-bound, bound))
+        self.bias = None if bias_attr is False else self.create_parameter(
+            (out_channels,), is_bias=True,
+            default_initializer=Uniform(-bound, bound))
+
+    def forward(self, x):
+        return _conv_transpose_nd(x, self.weight, self.bias, self.stride,
+                                  self.padding, 3, self.dilation,
+                                  self.output_padding)
+
+
+# ---------------------------------------------------------------------------
+# dropout variants / padding / shapes (reference: nn/layer/common.py)
+# ---------------------------------------------------------------------------
+class Dropout3D(Layer):
+    """Drops ENTIRE [D, H, W] channel slabs (reference: nn/layer/
+    common.py Dropout3D) — a broadcastable [N, C, 1, 1, 1] mask."""
+
+    def __init__(self, p=0.5, data_format="NCDHW", name=None):
+        super().__init__()
+        self.p = p
+
+    def forward(self, x):
+        if not self.training or not self.p:
+            return x
+        return _channel_dropout(x, float(self.p), _key_scalar())
+
+
+@def_op("channel_dropout")
+def _channel_dropout(x, p, key):
+    keep = jax.random.bernoulli(key, 1.0 - p, x.shape[:2])
+    keep = keep.reshape(x.shape[:2] + (1,) * (x.ndim - 2))
+    return jnp.where(keep, x / (1.0 - p), 0.0).astype(x.dtype)
+
+
+class AlphaDropout(Layer):
+    """SELU-consistent dropout (reference: nn/layer/common.py
+    AlphaDropout): dropped units take -alpha' and the output is
+    rescaled to preserve self-normalizing statistics."""
+
+    def __init__(self, p=0.5, name=None):
+        super().__init__()
+        self.p = p
+
+    def forward(self, x):
+        if not self.training or not self.p:
+            return x
+        return _alpha_dropout(x, float(self.p), _key_scalar())
+
+
+def _key_scalar():
+    from ..core import rng as _rng
+
+    return _rng.get_key()
+
+
+@def_op("alpha_dropout")
+def _alpha_dropout(x, p, key):
+    alpha = 1.6732632423543772
+    scale = 1.0507009873554805
+    alpha_p = -alpha * scale
+    keep = jax.random.bernoulli(key, 1.0 - p, x.shape)
+    a = (1.0 / ((1 - p) * (1 + p * alpha_p ** 2)) ** 0.5)
+    b = -a * alpha_p * p
+    return (a * jnp.where(keep, x, alpha_p) + b).astype(x.dtype)
+
+
+class _PadNd(Layer):
+    def __init__(self, padding, mode, value, nd):
+        super().__init__()
+        self.padding = [int(padding)] * (2 * nd) if np.isscalar(padding) \
+            else [int(p) for p in padding]
+        self.mode = mode
+        self.value = value
+
+    def forward(self, x):
+        return F.pad(x, self.padding, mode=self.mode, value=self.value)
+
+
+class Pad1D(_PadNd):
+    def __init__(self, padding, mode="constant", value=0.0,
+                 data_format="NCL", name=None):
+        super().__init__(padding, mode, value, 1)
+
+
+class Pad2D(_PadNd):
+    def __init__(self, padding, mode="constant", value=0.0,
+                 data_format="NCHW", name=None):
+        super().__init__(padding, mode, value, 2)
+
+
+class Pad3D(_PadNd):
+    def __init__(self, padding, mode="constant", value=0.0,
+                 data_format="NCDHW", name=None):
+        super().__init__(padding, mode, value, 3)
+
+
+class ZeroPad2D(Pad2D):
+    def __init__(self, padding, data_format="NCHW", name=None):
+        super().__init__(padding, "constant", 0.0, data_format)
+
+
+class Unflatten(Layer):
+    def __init__(self, axis, shape, name=None):
+        super().__init__()
+        self.axis, self.out_shape = axis, list(shape)
+
+    def forward(self, x):
+        from ..ops.manipulation import reshape
+
+        shp = x.shape
+        ax = self.axis % len(shp)
+        return reshape(x, shp[:ax] + self.out_shape + shp[ax + 1:])
+
+
+class ChannelShuffle(Layer):
+    def __init__(self, groups, data_format="NCHW", name=None):
+        super().__init__()
+        self.groups = groups
+
+    def forward(self, x):
+        return _extra.channel_shuffle(x, self.groups)
+
+
+class PixelUnshuffle(Layer):
+    def __init__(self, downscale_factor, data_format="NCHW", name=None):
+        super().__init__()
+        self.factor = downscale_factor
+
+    def forward(self, x):
+        return _extra.pixel_unshuffle(x, self.factor)
+
+
+class Fold(Layer):
+    def __init__(self, output_sizes, kernel_sizes, strides=1, paddings=0,
+                 dilations=1, name=None):
+        super().__init__()
+        self.a = (output_sizes, kernel_sizes, strides, paddings, dilations)
+
+    def forward(self, x):
+        return _extra.fold(x, *self.a)
+
+
+class Unfold(Layer):
+    def __init__(self, kernel_sizes, strides=1, paddings=0, dilations=1,
+                 name=None):
+        super().__init__()
+        self.a = (kernel_sizes, strides, paddings, dilations)
+
+    def forward(self, x):
+        return F.unfold(x, *self.a)
+
+
+class UpsamplingNearest2D(Layer):
+    def __init__(self, size=None, scale_factor=None,
+                 data_format="NCHW", name=None):
+        super().__init__()
+        self.size, self.scale = size, scale_factor
+
+    def forward(self, x):
+        return F.interpolate(x, size=self.size, scale_factor=self.scale,
+                             mode="nearest")
+
+
+# ---------------------------------------------------------------------------
+# activations / similarity (reference: nn/layer/activation.py, distance.py)
+# ---------------------------------------------------------------------------
+class LogSigmoid(Layer):
+    def forward(self, x):
+        return _extra.log_sigmoid(x)
+
+
+class Maxout(Layer):
+    def __init__(self, groups, axis=1, name=None):
+        super().__init__()
+        self.groups, self.axis = groups, axis
+
+    def forward(self, x):
+        return _extra.maxout(x, self.groups, self.axis)
+
+
+class RReLU(Layer):
+    def __init__(self, lower=1.0 / 8, upper=1.0 / 3, name=None):
+        super().__init__()
+        self.lower, self.upper = lower, upper
+
+    def forward(self, x):
+        return _extra.rrelu(x, self.lower, self.upper, self.training)
+
+
+class ThresholdedReLU(Layer):
+    def __init__(self, threshold=1.0, name=None):
+        super().__init__()
+        self.threshold = threshold
+
+    def forward(self, x):
+        return _extra.thresholded_relu(x, self.threshold)
+
+
+class Softmax2D(Layer):
+    """Softmax over channels for each spatial position (reference:
+    nn/layer/activation.py Softmax2D)."""
+
+    def forward(self, x):
+        enforce(x.ndim in (3, 4), "Softmax2D expects a 3-D/4-D input")
+        return F.softmax(x, axis=-3)
+
+
+class Bilinear(Layer):
+    """out[b, o] = x1[b]^T W[o] x2[b] + bias (reference: nn/layer/
+    common.py Bilinear over the phi bilinear kernel)."""
+
+    def __init__(self, in1_features, in2_features, out_features,
+                 weight_attr=None, bias_attr=None, name=None):
+        super().__init__()
+        from .initializer import Uniform
+
+        bound = 1.0 / math.sqrt(in1_features)
+        self.weight = self.create_parameter(
+            (out_features, in1_features, in2_features),
+            default_initializer=Uniform(-bound, bound))
+        self.bias = None if bias_attr is False else self.create_parameter(
+            (out_features,), is_bias=True,
+            default_initializer=Uniform(-bound, bound))
+
+    def forward(self, x1, x2):
+        return _bilinear(x1, x2, self.weight, self.bias)
+
+
+@def_op("bilinear")
+def _bilinear(x1, x2, w, bias):
+    out = jnp.einsum("bi,oij,bj->bo", x1, w, x2)
+    if bias is not None:
+        out = out + bias
+    return out.astype(x1.dtype)
+
+
+class CosineSimilarity(Layer):
+    def __init__(self, axis=1, eps=1e-8):
+        super().__init__()
+        self.axis, self.eps = axis, eps
+
+    def forward(self, x1, x2):
+        return F.cosine_similarity(x1, x2, axis=self.axis, eps=self.eps)
+
+
+class PairwiseDistance(Layer):
+    def __init__(self, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+        super().__init__()
+        self.p, self.eps, self.keepdim = p, epsilon, keepdim
+
+    def forward(self, x, y):
+        return _pairwise_distance(x, y, float(self.p), float(self.eps),
+                                  bool(self.keepdim))
+
+
+@def_op("pairwise_distance")
+def _pairwise_distance(x, y, p, eps, keepdim):
+    d = x - y + eps
+    out = jnp.sum(jnp.abs(d) ** p, axis=-1) ** (1.0 / p)
+    return out[..., None] if keepdim else out
+
+
+class SpectralNorm(Layer):
+    """Power-iteration spectral normalization of a weight tensor
+    (reference: nn/layer/norm.py SpectralNorm over the phi
+    spectral_norm kernel). Stateless per call: n_power_iterations run
+    inside the traced op (a small lax.fori-style unroll)."""
+
+    def __init__(self, weight_shape, dim=0, power_iters=1, eps=1e-12,
+                 name=None):
+        super().__init__()
+        self.dim, self.power_iters, self.eps = dim, power_iters, eps
+        w = int(np.prod(weight_shape)) // int(weight_shape[dim])
+        from .initializer import Normal
+
+        self.weight_u = self.create_parameter(
+            (int(weight_shape[dim]),), default_initializer=Normal(0, 1))
+        self.weight_u.stop_gradient = True
+        self.weight_v = self.create_parameter(
+            (w,), default_initializer=Normal(0, 1))
+        self.weight_v.stop_gradient = True
+
+    def forward(self, weight):
+        out, u, v = _spectral_norm(weight, self.weight_u, self.weight_v,
+                                   int(self.dim), int(self.power_iters),
+                                   float(self.eps))
+        # persist the power-iteration state (reference keeps u/v
+        # buffers, so one iteration per step converges over training)
+        self.weight_u._value = u._value
+        self.weight_v._value = v._value
+        return out
+
+
+@def_op("spectral_norm")
+def _spectral_norm(w, u, v, dim, power_iters, eps):
+    mat = jnp.moveaxis(w, dim, 0).reshape(w.shape[dim], -1)
+
+    def norm(x):
+        return x / (jnp.linalg.norm(x) + eps)
+
+    for _ in range(max(power_iters, 1)):
+        v = norm(mat.T @ u)
+        u = norm(mat @ v)
+    sigma = u @ mat @ v
+    return w / sigma, lax.stop_gradient(u), lax.stop_gradient(v)
+
+
+class _InstanceNormNd(Layer):
+    """(reference: nn/layer/norm.py InstanceNorm1D/3D — the functional
+    instance_norm is rank-generic, normalizing over dims 2..ndim)."""
+
+    def __init__(self, num_features, epsilon=1e-5, momentum=0.9,
+                 weight_attr=None, bias_attr=None, data_format=None,
+                 name=None):
+        super().__init__()
+        from .initializer import Constant
+
+        self._epsilon = epsilon
+        self.scale = self.create_parameter(
+            (num_features,), default_initializer=Constant(1.0))
+        self.bias = self.create_parameter((num_features,), is_bias=True)
+
+    def forward(self, x):
+        return F.instance_norm(x, self.scale, self.bias,
+                               epsilon=float(self._epsilon))
+
+
+class InstanceNorm1D(_InstanceNormNd):
+    pass
+
+
+class InstanceNorm3D(_InstanceNormNd):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# loss zoo (reference: nn/layer/loss.py)
+# ---------------------------------------------------------------------------
+class CTCLoss(Layer):
+    def __init__(self, blank=0, reduction="mean"):
+        super().__init__()
+        self.blank, self.reduction = blank, reduction
+
+    def forward(self, log_probs, labels, input_lengths, label_lengths,
+                norm_by_times=False):
+        return F.ctc_loss(log_probs, labels, input_lengths,
+                          label_lengths, self.blank, self.reduction,
+                          norm_by_times)
+
+
+class HuberLoss(Layer):
+    def __init__(self, reduction="mean", delta=1.0, name=None):
+        super().__init__()
+        self.reduction, self.delta = reduction, delta
+
+    def forward(self, input, label):
+        return _extra.huber_loss(input, label, self.delta, self.reduction)
+
+
+class CosineEmbeddingLoss(Layer):
+    def __init__(self, margin=0.0, reduction="mean", name=None):
+        super().__init__()
+        self.margin, self.reduction = margin, reduction
+
+    def forward(self, input1, input2, label):
+        cos = F.cosine_similarity(input1, input2, axis=-1)
+        pos = 1.0 - cos
+        neg = (cos - self.margin).clip(min=0.0)
+        loss = pos * (label == 1).astype(cos.dtype) \
+            + neg * (label == -1).astype(cos.dtype)
+        return _reduce(loss, self.reduction)
+
+
+class GaussianNLLLoss(Layer):
+    def __init__(self, full=False, epsilon=1e-6, reduction="mean",
+                 name=None):
+        super().__init__()
+        self.full, self.eps, self.reduction = full, epsilon, reduction
+
+    def forward(self, input, label, variance):
+        var = variance.clip(min=self.eps)
+        loss = 0.5 * (var.log() + (input - label) ** 2 / var)
+        if self.full:
+            loss = loss + 0.5 * math.log(2 * math.pi)
+        return _reduce(loss, self.reduction)
+
+
+class HingeEmbeddingLoss(Layer):
+    def __init__(self, margin=1.0, reduction="mean", name=None):
+        super().__init__()
+        self.margin, self.reduction = margin, reduction
+
+    def forward(self, input, label):
+        pos = input * (label == 1).astype(input.dtype)
+        neg = (self.margin - input).clip(min=0.0) \
+            * (label == -1).astype(input.dtype)
+        return _reduce(pos + neg, self.reduction)
+
+
+class MultiLabelSoftMarginLoss(Layer):
+    def __init__(self, weight=None, reduction="mean", name=None):
+        super().__init__()
+        self.weight, self.reduction = weight, reduction
+
+    def forward(self, input, label):
+        from ..ops.extra import log_sigmoid
+
+        loss = -(label * log_sigmoid(input)
+                 + (1.0 - label) * log_sigmoid(-input))
+        if self.weight is not None:
+            loss = loss * self.weight
+        return _reduce(loss.mean(axis=-1), self.reduction)
+
+
+class MultiMarginLoss(Layer):
+    def __init__(self, p=1, margin=1.0, weight=None, reduction="mean",
+                 name=None):
+        super().__init__()
+        self.p, self.margin, self.reduction = p, margin, reduction
+        self.weight = weight
+
+    def forward(self, input, label):
+        return _reduce(_multi_margin(input, label, self.weight,
+                                     int(self.p), float(self.margin)),
+                       self.reduction)
+
+
+@def_op("multi_margin_loss")
+def _multi_margin(x, label, weight, p, margin):
+    C = x.shape[1]
+    true = jnp.take_along_axis(x, label[:, None], axis=1)
+    m = jnp.maximum(margin - true + x, 0.0) ** p
+    if weight is not None:          # per-class weight of the TRUE class
+        m = m * weight[label][:, None]
+    mask = 1.0 - jax.nn.one_hot(label, C, dtype=x.dtype)
+    return (m * mask).sum(axis=1) / C
+
+
+class PoissonNLLLoss(Layer):
+    def __init__(self, log_input=True, full=False, epsilon=1e-8,
+                 reduction="mean", name=None):
+        super().__init__()
+        self.log_input, self.full = log_input, full
+        self.eps, self.reduction = epsilon, reduction
+
+    def forward(self, input, label):
+        if self.log_input:
+            loss = input.exp() - label * input
+        else:
+            loss = input - label * (input + self.eps).log()
+        if self.full:
+            # Stirling approximation for the label! term; clip the log
+            # argument BEFORE multiplying so label=0 rows don't produce
+            # 0 * -inf = NaN (masked out afterwards anyway)
+            safe = label.clip(min=1.0)
+            big = safe * safe.log() - safe \
+                + 0.5 * (2 * math.pi * safe).log()
+            loss = loss + big * (label > 1).astype(loss.dtype)
+        return _reduce(loss, self.reduction)
+
+
+class SoftMarginLoss(Layer):
+    def __init__(self, reduction="mean", name=None):
+        super().__init__()
+        self.reduction = reduction
+
+    def forward(self, input, label):
+        loss = (1.0 + (-label * input).exp()).log()
+        return _reduce(loss, self.reduction)
+
+
+class TripletMarginLoss(Layer):
+    def __init__(self, margin=1.0, p=2.0, epsilon=1e-6, swap=False,
+                 reduction="mean", name=None):
+        super().__init__()
+        self.margin, self.p, self.eps = margin, p, epsilon
+        self.swap, self.reduction = swap, reduction
+
+    def forward(self, input, positive, negative):
+        dp = _pairwise_distance(input, positive, float(self.p),
+                                float(self.eps), False)
+        dn = _pairwise_distance(input, negative, float(self.p),
+                                float(self.eps), False)
+        if self.swap:
+            dn2 = _pairwise_distance(positive, negative, float(self.p),
+                                     float(self.eps), False)
+            dn = dn.minimum(dn2)
+        loss = (dp - dn + self.margin).clip(min=0.0)
+        return _reduce(loss, self.reduction)
+
+
+class TripletMarginWithDistanceLoss(Layer):
+    def __init__(self, distance_function=None, margin=1.0, swap=False,
+                 reduction="mean", name=None):
+        super().__init__()
+        self.fn = distance_function or (
+            lambda a, b: _pairwise_distance(a, b, 2.0, 1e-6, False))
+        self.margin, self.swap, self.reduction = margin, swap, reduction
+
+    def forward(self, input, positive, negative):
+        dp = self.fn(input, positive)
+        dn = self.fn(input, negative)
+        if self.swap:
+            dn = dn.minimum(self.fn(positive, negative))
+        loss = (dp - dn + self.margin).clip(min=0.0)
+        return _reduce(loss, self.reduction)
+
+
+class HSigmoidLoss(Layer):
+    """Hierarchical sigmoid over the default complete binary tree
+    (reference: nn/layer/loss.py HSigmoidLoss over the phi
+    hsigmoid_loss kernel; custom paths unsupported here). Each class
+    maps to a leaf; the loss is the sum of binary logistic losses
+    along its root path — O(log C) effective parameters touched per
+    example, trained via dense masked matmuls."""
+
+    def __init__(self, feature_size, num_classes, weight_attr=None,
+                 bias_attr=None, is_custom=False, is_sparse=False,
+                 name=None):
+        super().__init__()
+        enforce(not is_custom, "custom trees are not supported here")
+        enforce(num_classes >= 2, "num_classes must be >= 2")
+        self.num_classes = num_classes
+        D = num_classes - 1          # internal nodes
+        from .initializer import Uniform
+
+        bound = 1.0 / math.sqrt(feature_size)
+        self.weight = self.create_parameter(
+            (D, feature_size), default_initializer=Uniform(-bound, bound))
+        self.bias = None if bias_attr is False else self.create_parameter(
+            (D,), is_bias=True, default_initializer=Uniform(-bound, bound))
+        # precompute per-class (node index, sign) paths on host
+        codes = np.zeros((num_classes, _tree_depth(num_classes)), np.int32)
+        signs = np.zeros_like(codes, np.float32)
+        mask = np.zeros_like(codes, np.float32)
+        for c in range(num_classes):
+            node = c + num_classes  # leaves start at num_classes
+            path = []
+            while node > 1:
+                parent = node // 2
+                path.append((parent - 1, 1.0 if node % 2 == 0 else -1.0))
+                node = parent
+            for d, (idx, sgn) in enumerate(reversed(path)):
+                codes[c, d] = idx
+                signs[c, d] = sgn
+                mask[c, d] = 1.0
+        self._codes = jnp.asarray(codes)
+        self._signs = jnp.asarray(signs)
+        self._mask = jnp.asarray(mask)
+
+    def forward(self, input, label):
+        return _hsigmoid_loss(input, label, self.weight, self.bias,
+                              self._codes, self._signs, self._mask)
+
+
+def _tree_depth(num_classes):
+    return int(math.ceil(math.log2(max(num_classes, 2)))) + 1
+
+
+@def_op("hsigmoid_loss")
+def _hsigmoid_loss(x, label, w, bias, codes, signs, mask):
+    idx = codes[label]                       # [B, D]
+    sgn = signs[label]
+    msk = mask[label]
+    wn = w[idx]                              # [B, D, F]
+    logit = jnp.einsum("bdf,bf->bd", wn, x)
+    if bias is not None:
+        logit = logit + bias[idx]
+    # sum of -log sigmoid(sign * logit) along the path
+    loss = -jax.nn.log_sigmoid(sgn * logit) * msk
+    return loss.sum(axis=1, keepdims=True)
