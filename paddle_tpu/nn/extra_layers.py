@@ -813,24 +813,8 @@ class HSigmoidLoss(Layer):
             (D, feature_size), default_initializer=Uniform(-bound, bound))
         self.bias = None if bias_attr is False else self.create_parameter(
             (D,), is_bias=True, default_initializer=Uniform(-bound, bound))
-        # precompute per-class (node index, sign) paths on host
-        codes = np.zeros((num_classes, _tree_depth(num_classes)), np.int32)
-        signs = np.zeros_like(codes, np.float32)
-        mask = np.zeros_like(codes, np.float32)
-        for c in range(num_classes):
-            node = c + num_classes  # leaves start at num_classes
-            path = []
-            while node > 1:
-                parent = node // 2
-                path.append((parent - 1, 1.0 if node % 2 == 0 else -1.0))
-                node = parent
-            for d, (idx, sgn) in enumerate(reversed(path)):
-                codes[c, d] = idx
-                signs[c, d] = sgn
-                mask[c, d] = 1.0
-        self._codes = jnp.asarray(codes)
-        self._signs = jnp.asarray(signs)
-        self._mask = jnp.asarray(mask)
+        self._codes, self._signs, self._mask = _build_tree_paths(
+            num_classes)
 
     def forward(self, input, label):
         return _hsigmoid_loss(input, label, self.weight, self.bias,
@@ -839,6 +823,31 @@ class HSigmoidLoss(Layer):
 
 def _tree_depth(num_classes):
     return int(math.ceil(math.log2(max(num_classes, 2)))) + 1
+
+
+import functools
+
+
+@functools.lru_cache(maxsize=32)
+def _build_tree_paths(num_classes):
+    """Per-class (node index, sign, mask) arrays for the complete
+    binary tree (shared by the HSigmoidLoss layer and the functional
+    form; cached — the functional form calls per step)."""
+    codes = np.zeros((num_classes, _tree_depth(num_classes)), np.int32)
+    signs = np.zeros_like(codes, np.float32)
+    mask = np.zeros_like(codes, np.float32)
+    for c in range(num_classes):
+        node = c + num_classes  # leaves start at num_classes
+        path = []
+        while node > 1:
+            parent = node // 2
+            path.append((parent - 1, 1.0 if node % 2 == 0 else -1.0))
+            node = parent
+        for d, (idx, sgn) in enumerate(reversed(path)):
+            codes[c, d] = idx
+            signs[c, d] = sgn
+            mask[c, d] = 1.0
+    return jnp.asarray(codes), jnp.asarray(signs), jnp.asarray(mask)
 
 
 @def_op("hsigmoid_loss")

@@ -339,3 +339,7 @@ def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
     if reduction == "sum":
         return loss.sum()
     return loss
+
+
+# functional tail (delegations + transducer/focal/gumbel math)
+from .functional_extra import *  # noqa: F401,F403,E402

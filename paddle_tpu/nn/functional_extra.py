@@ -1,0 +1,547 @@
+"""Functional tail (reference: python/paddle/nn/functional/* names
+without a previous counterpart). Mostly thin functional forms of the
+layer classes in extra_layers.py; real new math: rnnt_loss (transducer
+DP as nested lax.scans), gumbel_softmax, sigmoid_focal_loss, dice_loss,
+fractional max-pooling, class_center_sample.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core import rng as _rng
+from ..core.dispatch import def_op
+from ..core.enforce import enforce
+from ..tensor import Tensor, to_tensor
+
+__all__ = [
+    "avg_pool1d", "max_pool1d", "adaptive_avg_pool1d",
+    "adaptive_max_pool1d", "adaptive_avg_pool3d", "adaptive_max_pool3d",
+    "max_unpool1d", "max_unpool2d", "conv1d_transpose", "conv3d_transpose",
+    "alpha_dropout", "dropout3d", "bilinear", "zeropad2d", "upsample",
+    "pairwise_distance", "pdist", "local_response_norm",
+    "cosine_embedding_loss", "gaussian_nll_loss", "hinge_embedding_loss",
+    "multi_label_soft_margin_loss", "multi_margin_loss",
+    "poisson_nll_loss", "soft_margin_loss", "triplet_margin_loss",
+    "triplet_margin_with_distance_loss", "margin_ranking_loss",
+    "sigmoid_focal_loss", "dice_loss", "npair_loss", "gumbel_softmax",
+    "hsigmoid_loss", "rnnt_loss", "fractional_max_pool2d",
+    "fractional_max_pool3d", "class_center_sample",
+    "relu_", "tanh_", "softmax_", "elu_", "hardtanh_", "leaky_relu_",
+    "thresholded_relu_",
+]
+
+
+# ---------------------------------------------------------------------------
+# delegations to the layer implementations
+# ---------------------------------------------------------------------------
+def avg_pool1d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               **kw):
+    from .extra_layers import AvgPool1D
+
+    return AvgPool1D(kernel_size, stride, padding, ceil_mode)(x)
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               **kw):
+    from .extra_layers import MaxPool1D
+
+    return MaxPool1D(kernel_size, stride, padding, ceil_mode)(x)
+
+
+def adaptive_avg_pool1d(x, output_size, name=None):
+    from .extra_layers import AdaptiveAvgPool1D
+
+    return AdaptiveAvgPool1D(output_size)(x)
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    from .extra_layers import AdaptiveMaxPool1D
+
+    enforce(not return_mask, "return_mask is not supported here")
+    return AdaptiveMaxPool1D(output_size)(x)
+
+
+def adaptive_avg_pool3d(x, output_size, name=None):
+    from .extra_layers import AdaptiveAvgPool3D
+
+    return AdaptiveAvgPool3D(output_size)(x)
+
+
+def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    from .extra_layers import AdaptiveMaxPool3D
+
+    enforce(not return_mask, "return_mask is not supported here")
+    return AdaptiveMaxPool3D(output_size)(x)
+
+
+def max_unpool1d(x, indices, kernel_size, stride=None, padding=0,
+                 output_size=None, name=None):
+    from .extra_layers import MaxUnPool1D
+
+    return MaxUnPool1D(kernel_size, stride, padding)(x, indices,
+                                                     output_size)
+
+
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
+                 output_size=None, name=None):
+    from ..ops.extra import max_unpool2d as _unpool
+
+    return _unpool(x, indices, kernel_size, stride, padding, output_size)
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, dilation=1, groups=1, name=None):
+    from .extra_layers import _conv_transpose_nd
+
+    enforce(groups == 1, "conv1d_transpose here supports groups=1")
+    return _conv_transpose_nd(x, weight, bias, stride, padding, 1,
+                              dilation, output_padding)
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, dilation=1, groups=1, name=None):
+    from .extra_layers import _conv_transpose_nd
+
+    enforce(groups == 1, "conv3d_transpose here supports groups=1")
+    return _conv_transpose_nd(x, weight, bias, stride, padding, 3,
+                              dilation, output_padding)
+
+
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    if not training or not p:
+        return x
+    from .extra_layers import _alpha_dropout
+
+    return _alpha_dropout(x, float(p), _rng.get_key())
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
+    if not training or not p:
+        return x
+    from .extra_layers import _channel_dropout
+
+    return _channel_dropout(x, float(p), _rng.get_key())
+
+
+def bilinear(x1, x2, weight, bias=None, name=None):
+    from .extra_layers import _bilinear
+
+    return _bilinear(x1, x2, weight, bias)
+
+
+def zeropad2d(x, padding, data_format="NCHW", name=None):
+    from .functional import pad as _pad
+
+    p = [int(padding)] * 4 if np.isscalar(padding) \
+        else [int(v) for v in padding]
+    return _pad(x, p, mode="constant", value=0.0,
+                data_format=data_format)
+
+
+def upsample(x, size=None, scale_factor=None, mode="nearest",
+             align_corners=False, **kw):
+    from .functional import interpolate
+
+    return interpolate(x, size=size, scale_factor=scale_factor,
+                       mode=mode, align_corners=align_corners)
+
+
+def pairwise_distance(x, y, p=2.0, epsilon=1e-6, keepdim=False,
+                      name=None):
+    from .extra_layers import _pairwise_distance
+
+    return _pairwise_distance(x, y, float(p), float(epsilon),
+                              bool(keepdim))
+
+
+@def_op("pdist")
+def pdist(x, p=2.0):
+    """Condensed pairwise distances of rows (reference: functional
+    distance.py pdist)."""
+    n = x.shape[0]
+    d = jnp.sum(jnp.abs(x[:, None] - x[None, :]) ** p, axis=-1) \
+        ** (1.0 / p)
+    iu = jnp.triu_indices(n, k=1)
+    return d[iu]
+
+
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0,
+                        data_format="NCHW", name=None):
+    from . import LocalResponseNorm
+
+    return LocalResponseNorm(size, alpha, beta, k)(x)
+
+
+def cosine_embedding_loss(input1, input2, label, margin=0.0,
+                          reduction="mean", name=None):
+    from .extra_layers import CosineEmbeddingLoss
+
+    return CosineEmbeddingLoss(margin, reduction)(input1, input2, label)
+
+
+def gaussian_nll_loss(input, label, variance, full=False, epsilon=1e-6,
+                      reduction="mean", name=None):
+    from .extra_layers import GaussianNLLLoss
+
+    return GaussianNLLLoss(full, epsilon, reduction)(input, label,
+                                                     variance)
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean",
+                         name=None):
+    from .extra_layers import HingeEmbeddingLoss
+
+    return HingeEmbeddingLoss(margin, reduction)(input, label)
+
+
+def multi_label_soft_margin_loss(input, label, weight=None,
+                                 reduction="mean", name=None):
+    from .extra_layers import MultiLabelSoftMarginLoss
+
+    return MultiLabelSoftMarginLoss(weight, reduction)(input, label)
+
+
+def multi_margin_loss(input, label, p=1, margin=1.0, weight=None,
+                      reduction="mean", name=None):
+    from .extra_layers import MultiMarginLoss
+
+    return MultiMarginLoss(p, margin, weight, reduction)(input, label)
+
+
+def poisson_nll_loss(input, label, log_input=True, full=False,
+                     epsilon=1e-8, reduction="mean", name=None):
+    from .extra_layers import PoissonNLLLoss
+
+    return PoissonNLLLoss(log_input, full, epsilon, reduction)(input,
+                                                               label)
+
+
+def soft_margin_loss(input, label, reduction="mean", name=None):
+    from .extra_layers import SoftMarginLoss
+
+    return SoftMarginLoss(reduction)(input, label)
+
+
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0,
+                        epsilon=1e-6, swap=False, reduction="mean",
+                        name=None):
+    from .extra_layers import TripletMarginLoss
+
+    return TripletMarginLoss(margin, p, epsilon, swap, reduction)(
+        input, positive, negative)
+
+
+def triplet_margin_with_distance_loss(input, positive, negative,
+                                      distance_function=None, margin=1.0,
+                                      swap=False, reduction="mean",
+                                      name=None):
+    from .extra_layers import TripletMarginWithDistanceLoss
+
+    return TripletMarginWithDistanceLoss(distance_function, margin, swap,
+                                         reduction)(input, positive,
+                                                    negative)
+
+
+def hsigmoid_loss(input, label, num_classes, weight, bias=None,
+                  path_table=None, path_code=None, is_sparse=False,
+                  name=None):
+    """Functional hsigmoid over caller-supplied parameters (reference:
+    functional/loss.py hsigmoid_loss; default complete-binary-tree
+    paths, custom path tables unsupported)."""
+    from .extra_layers import _build_tree_paths, _hsigmoid_loss
+
+    enforce(path_table is None and path_code is None,
+            "custom path tables are not supported here")
+    codes, signs, mask = _build_tree_paths(int(num_classes))
+    return _hsigmoid_loss(input, label, weight, bias, codes, signs, mask)
+
+
+# ---------------------------------------------------------------------------
+# new math
+# ---------------------------------------------------------------------------
+@def_op("margin_ranking_loss")
+def margin_ranking_loss(input, other, label, margin=0.0,
+                        reduction="mean"):
+    loss = jnp.maximum(-label * (input - other) + margin, 0.0)
+    if reduction == "mean":
+        return jnp.mean(loss)
+    if reduction == "sum":
+        return jnp.sum(loss)
+    return loss
+
+
+@def_op("sigmoid_focal_loss")
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25,
+                       gamma=2.0, reduction="sum"):
+    """(reference: functional/loss.py sigmoid_focal_loss — RetinaNet
+    focal loss over logits)."""
+    p = jax.nn.sigmoid(logit)
+    ce = -(label * jax.nn.log_sigmoid(logit)
+           + (1 - label) * jax.nn.log_sigmoid(-logit))
+    p_t = p * label + (1 - p) * (1 - label)
+    loss = ce * ((1 - p_t) ** gamma)
+    if alpha >= 0:
+        a_t = alpha * label + (1 - alpha) * (1 - label)
+        loss = a_t * loss
+    if normalizer is not None:
+        loss = loss / normalizer
+    if reduction == "mean":
+        return jnp.mean(loss)
+    if reduction == "sum":
+        return jnp.sum(loss)
+    return loss
+
+
+@def_op("dice_loss")
+def dice_loss(input, label, epsilon=1e-5):
+    """(reference: functional/loss.py dice_loss): input [..., C]
+    probabilities, integer label [..., 1]."""
+    C = input.shape[-1]
+    lab = jax.nn.one_hot(label[..., 0], C, dtype=input.dtype)
+    red = tuple(range(1, input.ndim))
+    inter = jnp.sum(input * lab, axis=red)
+    union = jnp.sum(input, axis=red) + jnp.sum(lab, axis=red)
+    return jnp.mean(1.0 - (2.0 * inter + epsilon) / (union + epsilon))
+
+
+@def_op("npair_loss")
+def npair_loss(anchor, positive, labels, l2_reg=0.002):
+    """(reference: functional/loss.py npair_loss)."""
+    reg = l2_reg * (jnp.mean(jnp.sum(jnp.square(anchor), 1))
+                    + jnp.mean(jnp.sum(jnp.square(positive), 1))) * 0.25
+    sim = anchor @ positive.T                       # [B, B]
+    lab = (labels[:, None] == labels[None, :]).astype(sim.dtype)
+    lab = lab / jnp.sum(lab, axis=1, keepdims=True)
+    xent = -jnp.sum(jax.nn.log_softmax(sim, axis=1) * lab, axis=1)
+    return jnp.mean(xent) + reg
+
+
+@def_op("gumbel_softmax_op", differentiable=True)
+def _gumbel_softmax(x, key, temperature, hard, axis):
+    g = -jnp.log(-jnp.log(
+        jax.random.uniform(key, x.shape, minval=1e-20, maxval=1.0)))
+    y = jax.nn.softmax((x + g) / temperature, axis=axis)
+    if hard:
+        idx = jnp.argmax(y, axis=axis, keepdims=True)
+        onehot = jnp.put_along_axis(jnp.zeros_like(y), idx, 1.0,
+                                    axis=axis, inplace=False)
+        # straight-through: hard forward, soft backward
+        y = lax.stop_gradient(onehot - y) + y
+    return y
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
+    return _gumbel_softmax(x, _rng.get_key(), float(temperature),
+                           bool(hard), int(axis))
+
+
+@def_op("rnnt_loss_op")
+def _rnnt_loss(logits, labels, input_lengths, label_lengths, blank):
+    """RNN-Transducer loss (reference: warprnnt_op): forward-alpha DP
+    over the [T, U+1] lattice, scan over t with an inner scan over u —
+    all in log space, differentiable through both scans.
+
+    logits: [B, T, U+1, V] log-probs (log_softmax applied here),
+    labels: [B, U]."""
+    B, T, U1, V = logits.shape
+    U = U1 - 1
+    lp = jax.nn.log_softmax(logits, axis=-1)
+    # blank/emit lattices
+    lp_blank = lp[..., blank]                       # [B, T, U+1]
+    emit_idx = jnp.concatenate(
+        [labels, jnp.full((B, 1), blank, labels.dtype)], 1)  # pad col
+    lp_emit = jnp.take_along_axis(
+        lp, emit_idx[:, None, :, None], axis=3)[..., 0]      # [B,T,U+1]
+    NEG = -1e30
+
+    def row_step(carry_row, t):
+        # carry_row: alpha[t-1, :] for all b -> [B, U+1]
+        prev = carry_row
+
+        def inner(carry_u, u):
+            # alpha[t, u] = logaddexp(prev[u] + blank(t-1, u),
+            #                         alpha[t, u-1] + emit(t, u-1))
+            a_left = carry_u                         # alpha[t, u-1]
+            from_top = jnp.where(
+                t > 0, prev[:, u] + lp_blank[:, jnp.maximum(t - 1, 0), u],
+                jnp.where(u == 0, 0.0, NEG))
+            from_left = jnp.where(
+                u > 0,
+                a_left + lp_emit[:, t, jnp.maximum(u - 1, 0)], NEG)
+            m = jnp.maximum(from_top, from_left)
+            safe = jnp.where(m <= NEG / 2, 0.0, m)
+            val = safe + jnp.log(
+                jnp.exp(jnp.where(m <= NEG / 2, 0.0, from_top - safe))
+                + jnp.exp(jnp.where(m <= NEG / 2, NEG, from_left - safe)
+                          ))
+            val = jnp.where(m <= NEG / 2, NEG, val)
+            # t=0, u=0 -> 0 (log 1)
+            val = jnp.where((t == 0) & (u == 0), 0.0, val)
+            return val, val
+
+        _, row = lax.scan(inner, jnp.full((B,), NEG), jnp.arange(U1))
+        row = row.T                                  # [B, U+1]
+        return row, row
+
+    _, alphas = lax.scan(row_step, jnp.full((B, U1), NEG),
+                         jnp.arange(T))              # [T, B, U+1]
+    alphas = alphas.transpose(1, 0, 2)               # [B, T, U+1]
+    t_last = input_lengths - 1
+    u_last = label_lengths
+    a_last = alphas[jnp.arange(B), t_last, u_last]
+    final_blank = lp_blank[jnp.arange(B), t_last, u_last]
+    return -(a_last + final_blank)
+
+
+def rnnt_loss(input, label, input_lengths, label_lengths, blank=0,
+              fastemit_lambda=0.0, reduction="mean", name=None):
+    """(reference: functional/loss.py rnnt_loss over warprnnt).
+    FastEmit regularization is not implemented — a nonzero
+    fastemit_lambda raises rather than silently diverging."""
+    enforce(not fastemit_lambda,
+            "fastemit_lambda is not supported here (pass 0.0)")
+    loss = _rnnt_loss(input, label, input_lengths, label_lengths,
+                      int(blank))
+    if reduction == "mean":
+        return loss.mean()
+    if reduction == "sum":
+        return loss.sum()
+    return loss
+
+
+def fractional_max_pool2d(x, output_size, kernel_size=None,
+                          random_u=None, return_mask=False, name=None):
+    """Fractional max pooling (reference: functional/pooling.py
+    fractional_max_pool2d; Graham 2014 pseudo-random bin edges from a
+    single u). Disjoint bins only — overlapping kernel_size raises."""
+    enforce(kernel_size is None,
+            "explicit kernel_size (overlapping windows) unsupported")
+    enforce(not return_mask, "return_mask is not supported here")
+    # α-based fractional bins degrade gracefully to adaptive max bins
+    # when u is None (paddle draws u ~ U(0,1) then derives edges)
+    if random_u is None:
+        random_u = float(jax.random.uniform(_rng.get_key(), ()))
+    out_hw = ((output_size, output_size) if np.isscalar(output_size)
+              else tuple(output_size))
+    return _fractional_pool(x, out_hw, float(random_u), 2)
+
+
+def fractional_max_pool3d(x, output_size, kernel_size=None,
+                          random_u=None, return_mask=False, name=None):
+    enforce(kernel_size is None,
+            "explicit kernel_size (overlapping windows) unsupported")
+    enforce(not return_mask, "return_mask is not supported here")
+    if random_u is None:
+        random_u = float(jax.random.uniform(_rng.get_key(), ()))
+    out = ((output_size,) * 3 if np.isscalar(output_size)
+           else tuple(output_size))
+    return _fractional_pool(x, out, float(random_u), 3)
+
+
+@def_op("fractional_pool")
+def _fractional_pool(x, out_sizes, u, nd):
+    spatial0 = x.ndim - nd
+    out = x
+    for i, osz in enumerate(out_sizes):
+        ax = spatial0 + i
+        isz = out.shape[ax]
+        alpha = isz / osz
+        # Graham's pseudo-random increments: ceil(alpha*(j+u)) edges
+        edges = [int(np.ceil(alpha * (j + u))) - int(np.ceil(alpha * u))
+                 for j in range(osz + 1)]
+        edges[-1] = isz
+        slabs = []
+        for j in range(osz):
+            lo = min(edges[j], isz - 1)
+            hi = max(min(edges[j + 1], isz), lo + 1)
+            sl = lax.slice_in_dim(out, lo, hi, axis=ax)
+            slabs.append(jnp.max(sl, axis=ax, keepdims=True))
+        out = jnp.concatenate(slabs, axis=ax)
+    return out
+
+
+def class_center_sample(label, num_classes, num_samples, group=None):
+    """Sample negative class centers (reference: functional/common.py
+    class_center_sample for PartialFC). Host-side: the sampled set is
+    data-dependent."""
+    lab = np.asarray(label._value if isinstance(label, Tensor)
+                     else label)
+    pos = np.unique(lab)
+    if len(pos) >= num_samples:
+        sampled = pos
+    else:
+        rest = np.setdiff1d(np.arange(num_classes), pos)
+        key = _rng.get_key()
+        perm = np.asarray(jax.random.permutation(key, len(rest)))
+        sampled = np.concatenate(
+            [pos, rest[perm[: num_samples - len(pos)]]])
+    sampled = np.sort(sampled)
+    remap = -np.ones(num_classes, np.int64)
+    remap[sampled] = np.arange(len(sampled))
+    return to_tensor(remap[lab]), to_tensor(sampled)
+
+
+# ---------------------------------------------------------------------------
+# inplace variants: value-swap on the tensor (immutable arrays under the
+# hood — the reference's foo_ ops mutate storage; here the Tensor's
+# _value is replaced and the result is returned, matching user-visible
+# semantics for leaf tensors outside autograd)
+# ---------------------------------------------------------------------------
+def _inplace(fn):
+    def wrapper(x, *a, **kw):
+        out = fn(x, *a, **kw)
+        # mirror tensor_methods._make_inplace: _out_idx must follow the
+        # node (multi-output producers), stop_gradient only loosens
+        x._value = out._value
+        x._grad_node = out._grad_node
+        x._out_idx = out._out_idx
+        if not out.stop_gradient:
+            x.stop_gradient = False
+        return x
+    return wrapper
+
+
+def relu_(x, name=None):
+    from .functional import relu
+
+    return _inplace(relu)(x)
+
+
+def tanh_(x, name=None):
+    from .functional import tanh
+
+    return _inplace(tanh)(x)
+
+
+def softmax_(x, axis=-1, name=None):
+    from .functional import softmax
+
+    return _inplace(softmax)(x, axis=axis)
+
+
+def elu_(x, alpha=1.0, name=None):
+    from .functional import elu
+
+    return _inplace(elu)(x, alpha=alpha)
+
+
+def hardtanh_(x, min=-1.0, max=1.0, name=None):  # noqa: A002
+    from .functional import hardtanh
+
+    return _inplace(hardtanh)(x, min, max)
+
+
+def leaky_relu_(x, negative_slope=0.01, name=None):
+    from .functional import leaky_relu
+
+    return _inplace(leaky_relu)(x, negative_slope)
+
+
+def thresholded_relu_(x, threshold=1.0, name=None):
+    from ..ops.extra import thresholded_relu
+
+    return _inplace(thresholded_relu)(x, threshold)

@@ -1,0 +1,202 @@
+"""Functional tail (reference: python/paddle/nn/functional/*) — brute
+force / torch oracles for the new math; smoke for delegations."""
+import numpy as np
+import pytest
+import torch
+
+import paddle_tpu as paddle
+from paddle_tpu.nn import functional as F
+
+rng = np.random.RandomState(0)
+
+
+def _t(x):
+    return paddle.to_tensor(x)
+
+
+def _np(t):
+    return np.asarray(t._value)
+
+
+class TestRNNT:
+    def test_t1_u0(self):
+        logits = rng.randn(1, 1, 1, 4).astype("float32")
+        ll = F.rnnt_loss(_t(logits), _t(np.zeros((1, 0), "int64")),
+                         _t(np.array([1])), _t(np.array([0])),
+                         reduction="none")
+        ref = -np.log(np.exp(logits[0, 0, 0, 0])
+                      / np.exp(logits[0, 0, 0]).sum())
+        assert abs(float(_np(ll)[0]) - ref) < 1e-5
+
+    def test_t2_u1_bruteforce(self):
+        T, U, V = 2, 1, 3
+        lg = rng.randn(1, T, U + 1, V).astype("float32")
+        lp = np.log(np.exp(lg) / np.exp(lg).sum(-1, keepdims=True))
+        lab = np.array([[1]])
+        p1 = lp[0, 0, 0, 1] + lp[0, 0, 1, 0] + lp[0, 1, 1, 0]
+        p2 = lp[0, 0, 0, 0] + lp[0, 1, 0, 1] + lp[0, 1, 1, 0]
+        ref = -np.logaddexp(p1, p2)
+        ours = float(_np(F.rnnt_loss(
+            _t(lg), _t(lab), _t(np.array([T])), _t(np.array([U])),
+            reduction="none"))[0])
+        assert abs(ours - ref) < 1e-4
+
+    def test_t3_u2_bruteforce(self):
+        T, U, V = 3, 2, 4
+        lg = rng.randn(1, T, U + 1, V).astype("float32")
+        lp = np.log(np.exp(lg) / np.exp(lg).sum(-1, keepdims=True))
+        lab = np.array([[2, 1]])
+
+        # enumerate all monotone paths from (0,0) to (T-1, U) + final blank
+        import itertools
+        total = -np.inf
+        # a path is a sequence of moves: T-1 blanks (t+1) and U emits (u+1)
+        for moves in set(itertools.permutations(
+                "b" * (T - 1) + "e" * U)):
+            t = u = 0
+            s = 0.0
+            ok = True
+            for m in moves:
+                if m == "b":
+                    s += lp[0, t, u, 0]
+                    t += 1
+                else:
+                    s += lp[0, t, u, lab[0, u]]
+                    u += 1
+            s += lp[0, T - 1, U, 0]  # final blank
+            total = np.logaddexp(total, s)
+        ours = float(_np(F.rnnt_loss(
+            _t(lg), _t(lab), _t(np.array([T])), _t(np.array([U])),
+            reduction="none"))[0])
+        assert abs(ours - (-total)) < 1e-4
+
+    def test_batched_lengths_and_grad(self):
+        B, T, U, V = 2, 4, 2, 5
+        lg = _t(rng.randn(B, T, U + 1, V).astype("float32"))
+        lg.stop_gradient = False
+        loss = F.rnnt_loss(lg, _t(rng.randint(1, V, (B, U))),
+                           _t(np.array([4, 3])), _t(np.array([2, 1])))
+        loss.backward()
+        g = _np(lg.grad)
+        assert np.isfinite(g).all() and np.abs(g).max() > 0
+
+
+class TestNewMath:
+    def test_sigmoid_focal_loss(self):
+        lgt = rng.randn(6).astype("float32")
+        lab = (rng.rand(6) > 0.5).astype("float32")
+        ours = float(F.sigmoid_focal_loss(_t(lgt), _t(lab),
+                                          reduction="sum"))
+        p = 1 / (1 + np.exp(-lgt))
+        ce = -(lab * np.log(p) + (1 - lab) * np.log(1 - p))
+        pt = p * lab + (1 - p) * (1 - lab)
+        ref = ((0.25 * lab + 0.75 * (1 - lab)) * ce * (1 - pt) ** 2).sum()
+        assert abs(ours - ref) < 1e-4
+
+    def test_margin_ranking_loss(self):
+        a = rng.randn(5).astype("float32")
+        b = rng.randn(5).astype("float32")
+        y = np.sign(rng.randn(5)).astype("float32")
+        ours = float(F.margin_ranking_loss(_t(a), _t(b), _t(y),
+                                           margin=0.3))
+        ref = float(torch.nn.functional.margin_ranking_loss(
+            torch.tensor(a), torch.tensor(b), torch.tensor(y),
+            margin=0.3))
+        assert abs(ours - ref) < 1e-5
+
+    def test_dice_loss_perfect_prediction(self):
+        lab = rng.randint(0, 3, (4, 6, 1))
+        onehot = np.eye(3, dtype="float32")[lab[..., 0]]
+        loss = float(_np(F.dice_loss(_t(onehot), _t(lab))))
+        assert loss < 1e-3
+
+    def test_gumbel_softmax(self):
+        paddle.seed(0)
+        x = _t(rng.randn(4, 6).astype("float32"))
+        soft = _np(F.gumbel_softmax(x))
+        np.testing.assert_allclose(soft.sum(-1), np.ones(4), rtol=1e-5)
+        hard = _np(F.gumbel_softmax(x, hard=True))
+        assert ((hard == 0) | (hard == 1)).all()
+        assert (hard.sum(-1) == 1).all()
+
+    def test_gumbel_hard_straight_through_grad(self):
+        paddle.seed(1)
+        x = _t(rng.randn(3, 5).astype("float32"))
+        x.stop_gradient = False
+        F.gumbel_softmax(x, hard=True).sum().backward()
+        assert np.isfinite(_np(x.grad)).all()
+
+    def test_pdist(self):
+        x = rng.randn(5, 3).astype("float32")
+        ours = _np(F.pdist(_t(x)))
+        ref = torch.nn.functional.pdist(torch.tensor(x)).numpy()
+        np.testing.assert_allclose(ours, ref, rtol=1e-4)
+
+    def test_npair_loss_finite(self):
+        a = rng.randn(6, 4).astype("float32")
+        p = rng.randn(6, 4).astype("float32")
+        lab = rng.randint(0, 3, 6)
+        assert np.isfinite(float(_np(F.npair_loss(_t(a), _t(p),
+                                                  _t(lab)))))
+
+    def test_fractional_max_pool(self):
+        x = rng.randn(1, 2, 9, 9).astype("float32")
+        out = F.fractional_max_pool2d(_t(x), 4, random_u=0.3)
+        assert out.shape == [1, 2, 4, 4]
+        # every output equals the max of SOME input window
+        assert np.isin(_np(out), x).all()
+        out3 = F.fractional_max_pool3d(
+            _t(rng.randn(1, 1, 6, 6, 6).astype("float32")), 2,
+            random_u=0.7)
+        assert out3.shape == [1, 1, 2, 2, 2]
+
+    def test_class_center_sample(self):
+        paddle.seed(2)
+        lab = _t(np.array([1, 5, 5, 9]))
+        remapped, sampled = F.class_center_sample(lab, 20, 8)
+        s = _np(sampled)
+        assert len(s) == 8 and {1, 5, 9} <= set(s.tolist())
+        r = _np(remapped)
+        assert (s[r] == np.array([1, 5, 5, 9])).all()
+
+
+class TestDelegationsAndInplace:
+    def test_functional_pooling(self):
+        x = rng.randn(1, 2, 8).astype("float32")
+        assert F.avg_pool1d(_t(x), 2, 2).shape == [1, 2, 4]
+        assert F.max_pool1d(_t(x), 2, 2).shape == [1, 2, 4]
+        assert F.adaptive_avg_pool1d(_t(x), 3).shape == [1, 2, 3]
+        x3 = rng.randn(1, 2, 4, 4, 4).astype("float32")
+        assert F.adaptive_max_pool3d(_t(x3), 2).shape == [1, 2, 2, 2, 2]
+
+    def test_functional_losses_smoke(self):
+        a = rng.randn(4, 6).astype("float32")
+        b = rng.randn(4, 6).astype("float32")
+        assert np.isfinite(float(F.cosine_embedding_loss(
+            _t(a), _t(b), _t(np.array([1, -1, 1, -1])))))
+        assert np.isfinite(float(F.soft_margin_loss(
+            _t(a), _t(np.sign(b)))))
+        assert np.isfinite(float(F.triplet_margin_loss(
+            _t(a), _t(b), _t(b[::-1].copy()))))
+
+    def test_hsigmoid_functional(self):
+        out = F.hsigmoid_loss(_t(rng.randn(3, 8).astype("float32")),
+                              _t(rng.randint(0, 10, 3)), 10,
+                              _t(rng.randn(9, 8).astype("float32")))
+        assert out.shape == [3, 1]
+
+    def test_inplace_variants(self):
+        x = _t(np.array([-1.0, 2.0], "float32"))
+        y = F.relu_(x)
+        assert y is x and _np(x).tolist() == [0.0, 2.0]
+        x2 = _t(np.array([0.0, 100.0], "float32"))
+        F.tanh_(x2)
+        assert abs(_np(x2)[1] - 1.0) < 1e-6
+        x3 = _t(np.array([1.0, 3.0], "float32"))
+        F.softmax_(x3)
+        assert abs(_np(x3).sum() - 1.0) < 1e-5
+
+    def test_upsample_and_zeropad(self):
+        x = rng.randn(1, 2, 3, 3).astype("float32")
+        assert F.upsample(_t(x), scale_factor=2).shape == [1, 2, 6, 6]
+        assert F.zeropad2d(_t(x), [1, 1, 2, 2]).shape == [1, 2, 7, 5]
