@@ -492,16 +492,10 @@ def class_center_sample(label, num_classes, num_samples, group=None):
 # semantics for leaf tensors outside autograd)
 # ---------------------------------------------------------------------------
 def _inplace(fn):
+    from ..tensor import inplace_swap
+
     def wrapper(x, *a, **kw):
-        out = fn(x, *a, **kw)
-        # mirror tensor_methods._make_inplace: _out_idx must follow the
-        # node (multi-output producers), stop_gradient only loosens
-        x._value = out._value
-        x._grad_node = out._grad_node
-        x._out_idx = out._out_idx
-        if not out.stop_gradient:
-            x.stop_gradient = False
-        return x
+        return inplace_swap(x, fn(x, *a, **kw))
     return wrapper
 
 

@@ -2,8 +2,10 @@
 core/registry.py (the PHI-kernel analog). Submodules by category, mirroring
 the reference's python/paddle/tensor/ split."""
 from . import creation, extra, linalg, manipulation, math, nn_ops  # noqa: F401
+from . import api_tail  # noqa: F401  (after math/extra: generates foo_ over them)
 from .creation import *  # noqa: F401,F403
 from .manipulation import *  # noqa: F401,F403
 from .math import *  # noqa: F401,F403
 from .linalg import *  # noqa: F401,F403
 from .extra import *  # noqa: F401,F403
+from .api_tail import *  # noqa: F401,F403

@@ -1,0 +1,413 @@
+"""Top-level API tail (reference: python/paddle/__init__.py exports
+without a previous counterpart — tensor predicates, math leftovers,
+stack/split variants, scatter-into-view ops, and the ``foo_`` inplace
+family generated over existing ops).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..core.dispatch import def_op
+from ..core.enforce import enforce
+from ..tensor import Tensor, to_tensor
+
+__all__ = [
+    "is_tensor", "is_complex", "is_floating_point", "is_integer", "rank",
+    "gcd", "lcm", "multigammaln", "nanquantile", "polar",
+    "deg2rad", "rad2deg", "sgn", "signbit", "take", "tensordot",
+    "tensor_split", "vsplit", "hsplit", "vstack", "hstack", "row_stack",
+    "column_stack", "dstack", "scatter_nd", "select_scatter",
+    "slice_scatter", "masked_scatter", "mm", "standard_normal",
+    "randint_like", "unflatten", "view", "view_as", "tolist",
+    "set_printoptions", "summary", "where_",
+]
+
+
+# ---------------------------------------------------------------------------
+# predicates
+# ---------------------------------------------------------------------------
+def is_tensor(x):
+    return isinstance(x, Tensor)
+
+
+def is_complex(x):
+    return jnp.issubdtype(x.dtype, jnp.complexfloating)
+
+
+def is_floating_point(x):
+    return jnp.issubdtype(x.dtype, jnp.floating)
+
+
+def is_integer(x):
+    return jnp.issubdtype(x.dtype, jnp.integer)
+
+
+def rank(input, name=None):
+    return to_tensor(np.asarray(input.ndim, np.int32))
+
+
+# ---------------------------------------------------------------------------
+# math tail
+# ---------------------------------------------------------------------------
+@def_op("gcd", differentiable=False)
+def gcd(x, y):
+    return jnp.gcd(x, y)
+
+
+@def_op("lcm", differentiable=False)
+def lcm(x, y):
+    return jnp.lcm(x, y)
+
+
+@def_op("multigammaln")
+def multigammaln(x, p):
+    from jax.scipy.special import gammaln
+
+    p = int(p)
+    i = jnp.arange(1, p + 1, dtype=x.dtype)
+    const = p * (p - 1) / 4.0 * jnp.log(jnp.asarray(jnp.pi, x.dtype))
+    return const + jnp.sum(gammaln(x[..., None] + (1.0 - i) / 2.0),
+                           axis=-1)
+
+
+@def_op("nanquantile")
+def nanquantile(x, q, axis=None, keepdim=False):
+    return jnp.nanquantile(x, q, axis=axis, keepdims=bool(keepdim))
+
+
+@def_op("polar")
+def polar(abs, angle):  # noqa: A002
+    from jax import lax
+
+    return lax.complex(abs * jnp.cos(angle), abs * jnp.sin(angle))
+
+
+@def_op("deg2rad")
+def deg2rad(x):
+    return jnp.deg2rad(x)
+
+
+@def_op("rad2deg")
+def rad2deg(x):
+    return jnp.rad2deg(x)
+
+
+@def_op("sgn")
+def sgn(x):
+    """sign for real; x/|x| (unit phasor, 0 at 0) for complex."""
+    if jnp.issubdtype(x.dtype, jnp.complexfloating):
+        mag = jnp.abs(x)
+        return jnp.where(mag == 0, 0.0 + 0.0j, x / jnp.maximum(mag, 1e-30))
+    return jnp.sign(x)
+
+
+@def_op("signbit", differentiable=False)
+def signbit(x):
+    return jnp.signbit(x)
+
+
+@def_op("take")
+def take(x, index, mode="raise"):
+    """Flat-index gather (reference: tensor/math.py take): 'raise'
+    wraps negatives python-style, 'wrap' is modular, 'clip' clamps to
+    [0, n-1] (negatives go to 0, numpy semantics)."""
+    flat = x.reshape(-1)
+    n = flat.shape[0]
+    idx = index.astype(jnp.int32)
+    if mode == "wrap":
+        idx = idx % n
+    elif mode == "clip":
+        idx = jnp.clip(idx, 0, n - 1)
+    else:
+        idx = jnp.where(idx < 0, idx + n, idx)
+        idx = jnp.clip(idx, 0, n - 1)
+    return flat[idx]
+
+
+@def_op("tensordot")
+def tensordot(x, y, axes=2):
+    if isinstance(axes, (list, tuple)):
+        axes = tuple(tuple(a) if isinstance(a, (list, tuple)) else a
+                     for a in axes)
+    return jnp.tensordot(x, y, axes=axes)
+
+
+def tensor_split(x, num_or_indices, axis=0, name=None):
+    from .manipulation import split
+
+    ax = int(axis)
+    n = x.shape[ax]
+    if np.isscalar(num_or_indices):
+        k = int(num_or_indices)
+        # numpy semantics: first n % k chunks get one extra element
+        base, extra = divmod(n, k)
+        sizes = [base + 1] * extra + [base] * (k - extra)
+        return split(x, sizes, axis=ax)
+    idx = [0] + [int(i) for i in num_or_indices] + [n]
+    sizes = [b - a for a, b in zip(idx[:-1], idx[1:])]
+    return split(x, sizes, axis=ax)
+
+
+def vsplit(x, num_or_indices, name=None):
+    enforce(x.ndim >= 2, "vsplit expects rank >= 2")
+    return tensor_split(x, num_or_indices, axis=0)
+
+
+def hsplit(x, num_or_indices, name=None):
+    enforce(x.ndim >= 1, "hsplit expects rank >= 1")
+    return tensor_split(x, num_or_indices, axis=1 if x.ndim > 1 else 0)
+
+
+@def_op("vstack_op")
+def _vstack(*xs):
+    return jnp.vstack(xs)
+
+
+def vstack(inputs, name=None):
+    return _vstack(*inputs)
+
+
+row_stack = vstack
+
+
+@def_op("hstack_op")
+def _hstack(*xs):
+    return jnp.hstack(xs)
+
+
+def hstack(inputs, name=None):
+    return _hstack(*inputs)
+
+
+@def_op("column_stack_op")
+def _column_stack(*xs):
+    return jnp.column_stack(xs)
+
+
+def column_stack(inputs, name=None):
+    return _column_stack(*inputs)
+
+
+@def_op("dstack_op")
+def _dstack(*xs):
+    return jnp.dstack(xs)
+
+
+def dstack(inputs, name=None):
+    return _dstack(*inputs)
+
+
+@def_op("scatter_nd")
+def scatter_nd(index, updates, shape):
+    out = jnp.zeros(tuple(int(s) for s in shape), updates.dtype)
+    return out.at[tuple(index[..., i] for i in range(index.shape[-1]))] \
+        .add(updates)
+
+
+@def_op("select_scatter")
+def select_scatter(x, values, axis, index):
+    idx = [slice(None)] * x.ndim
+    idx[int(axis)] = int(index)
+    return x.at[tuple(idx)].set(values)
+
+
+@def_op("slice_scatter")
+def slice_scatter(x, value, axes, starts, ends, strides):
+    idx = [slice(None)] * x.ndim
+    for ax, st, en, sd in zip(axes, starts, ends, strides):
+        idx[int(ax)] = slice(int(st), int(en), int(sd))
+    return x.at[tuple(idx)].set(value)
+
+
+@def_op("masked_scatter")
+def masked_scatter(x, mask, value):
+    """Fill True positions of mask with consecutive elements of value
+    (reference: tensor/manipulation.py masked_scatter). Static-shape
+    form: position k in row-major order takes value.flat[#True before
+    k]."""
+    m = jnp.broadcast_to(mask, x.shape).reshape(-1)
+    xf = x.reshape(-1)
+    vf = value.reshape(-1)
+    pos = jnp.cumsum(m.astype(jnp.int32)) - 1
+    gathered = vf[jnp.clip(pos, 0, vf.shape[0] - 1)]
+    return jnp.where(m, gathered, xf).reshape(x.shape)
+
+
+def mm(input, mat2, name=None):
+    from .math import matmul
+
+    return matmul(input, mat2)
+
+
+def standard_normal(shape, dtype=None, name=None):
+    from .creation import randn
+
+    return randn(shape, dtype=dtype)
+
+
+def randint_like(x, low=0, high=None, dtype=None, name=None):
+    """Uniform integers with x's shape; dtype defaults to x.dtype
+    (floating dtypes receive integer VALUES cast to that dtype, the
+    reference behavior)."""
+    from .creation import randint
+
+    target = str(dtype) if dtype is not None else str(x.dtype)
+    if jnp.issubdtype(jnp.dtype(target), jnp.integer):
+        return randint(low, high, shape=tuple(x.shape), dtype=target)
+    return randint(low, high, shape=tuple(x.shape),
+                   dtype="int32").astype(target)
+
+
+def unflatten(x, axis, shape, name=None):
+    from .manipulation import reshape
+
+    shp = x.shape
+    ax = int(axis) % len(shp)
+    return reshape(x, list(shp[:ax]) + list(shape) + list(shp[ax + 1:]))
+
+
+def view(x, shape_or_dtype, name=None):
+    """Zero-copy reinterpret (reference: tensor/manipulation.py view):
+    a shape view is reshape; a dtype view reinterprets the bytes."""
+    from .manipulation import reshape
+
+    if isinstance(shape_or_dtype, (list, tuple)):
+        return reshape(x, list(shape_or_dtype))
+    return _view_dtype(x, str(shape_or_dtype))
+
+
+@def_op("view_dtype")
+def _view_dtype(x, dtype):
+    from ..core.dtype import convert_dtype
+
+    return x.view(convert_dtype(dtype))
+
+
+def view_as(x, other, name=None):
+    from .manipulation import reshape
+
+    return reshape(x, other.shape)
+
+
+def tolist(x):
+    return np.asarray(x._value if isinstance(x, Tensor) else x).tolist()
+
+
+def set_printoptions(precision=None, threshold=None, edgeitems=None,
+                     sci_mode=None, linewidth=None):
+    """(reference: tensor/to_string.py set_printoptions) — numpy's
+    printer renders Tensor reprs here, so forward to it."""
+    kw = {}
+    if precision is not None:
+        kw["precision"] = int(precision)
+    if threshold is not None:
+        kw["threshold"] = int(threshold)
+    if edgeitems is not None:
+        kw["edgeitems"] = int(edgeitems)
+    if linewidth is not None:
+        kw["linewidth"] = int(linewidth)
+    if sci_mode is not None:
+        kw["suppress"] = not sci_mode
+    np.set_printoptions(**kw)
+
+
+def summary(net, input_size=None, dtypes=None, input=None):
+    """Layer-table summary (reference: python/paddle/hapi/
+    model_summary.py summary): prints per-layer output shapes and
+    parameter counts from a dry forward."""
+    rows = []
+    hooks = []
+
+    def mk_hook(name, layer):
+        def hook(layer, inputs, outputs):
+            out = outputs[0] if isinstance(outputs, (tuple, list)) \
+                else outputs
+            shape = list(getattr(out, "shape", [])) \
+                if hasattr(out, "shape") else "?"
+            n_params = sum(
+                int(np.prod(p.shape))
+                for p in layer._parameters.values() if p is not None)
+            rows.append((name, type(layer).__name__, shape, n_params))
+        return hook
+
+    for name, sub in net.named_sublayers():
+        hooks.append(sub.register_forward_post_hook(mk_hook(name, sub)))
+    try:
+        if input is None:
+            enforce(input_size is not None,
+                    "summary needs input_size or input")
+            sizes = input_size if isinstance(input_size, list) \
+                else [input_size]
+            dts = dtypes or ["float32"] * len(sizes)
+            args = [to_tensor(np.zeros(s, dt))
+                    for s, dt in zip(sizes, dts)]
+            net(*args)
+        else:
+            net(input)
+    finally:
+        for h in hooks:
+            h.remove()
+    total = sum(r[3] for r in rows)
+    lines = [f"{'Layer':<30}{'Type':<22}{'Output shape':<20}{'Params':>10}"]
+    lines.append("-" * 82)
+    for name, typ, shape, n in rows:
+        lines.append(f"{name:<30}{typ:<22}{str(shape):<20}{n:>10}")
+    lines.append("-" * 82)
+    lines.append(f"Total params: {total:,}")
+    out = "\n".join(lines)
+    print(out)
+    return {"total_params": total, "layers": len(rows)}
+
+
+# ---------------------------------------------------------------------------
+# the foo_ inplace family: generated over existing public ops with the
+# same value-swap contract as tensor_methods._make_inplace
+# ---------------------------------------------------------------------------
+def where_(condition, x, y, name=None):
+    """In-place where: writes the selected values INTO x (reference:
+    tensor/search.py where_ — the generic generator would wrongly
+    mutate the condition argument)."""
+    from ..tensor import inplace_swap
+    from .math import where as _where
+
+    return inplace_swap(x, _where(condition, x, y))
+
+
+def _gen_inplace():
+    from . import creation, extra, manipulation, math as math_ops
+
+    from ..tensor import inplace_swap
+
+    def make(fn):
+        def inplace(x, *args, **kwargs):
+            return inplace_swap(x, fn(x, *args, **kwargs))
+        return inplace
+
+    import sys
+
+    mod = sys.modules[__name__]
+    sources = {}
+    for m in (math_ops, manipulation, extra, creation, mod):
+        for n in dir(m):
+            if not n.startswith("_") and callable(getattr(m, n)):
+                sources.setdefault(n, getattr(m, n))
+    names = [
+        "lcm", "ldexp", "less_equal", "less_than", "lgamma", "log10",
+        "log1p", "log2", "log", "logical_and", "logical_not",
+        "logical_or", "logical_xor", "logit", "masked_fill", "mod",
+        "multiply", "nan_to_num", "neg", "not_equal", "polygamma",
+        "pow", "remainder", "renorm", "reshape", "scatter", "sin",
+        "sinh", "square", "squeeze", "t", "tan", "tril", "triu",
+        "trunc", "unsqueeze", "masked_scatter", "gcd",
+    ]
+    made = []
+    for n in names:
+        if n in sources:
+            setattr(mod, n + "_", make(sources[n]))
+            made.append(n + "_")
+    mod.__all__ = list(mod.__all__) + made
+
+
+_gen_inplace()

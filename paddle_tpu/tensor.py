@@ -20,7 +20,20 @@ import jax.numpy as jnp
 
 from .core.dtype import convert_dtype, get_default_dtype
 
-__all__ = ["Tensor", "Parameter", "to_tensor"]
+__all__ = ["Tensor", "Parameter", "to_tensor", "inplace_swap"]
+
+
+def inplace_swap(target: "Tensor", out: "Tensor") -> "Tensor":
+    """The single definition of the ``foo_`` in-place contract: swap the
+    functional result into ``target`` (value + autograd producer +
+    output slot; stop_gradient only loosens). Used by tensor_methods,
+    nn.functional inplace variants, and the top-level foo_ family."""
+    target._value = out._value
+    target._grad_node = out._grad_node
+    target._out_idx = out._out_idx
+    if not out.stop_gradient:
+        target.stop_gradient = False
+    return target
 
 
 class Tensor:

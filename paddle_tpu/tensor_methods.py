@@ -85,15 +85,11 @@ def _patch():
         s, perm=tuple(range(s.ndim))[::-1]))
 
     # -- in-place variants (functional under the hood) -----------------
+    from .tensor import inplace_swap
+
     def _make_inplace(fn):
         def method(self, *args, **kwargs):
-            out = fn(self, *args, **kwargs)
-            self._value = out._value
-            self._grad_node = out._grad_node
-            self._out_idx = out._out_idx
-            if not out.stop_gradient:
-                self.stop_gradient = False
-            return self
+            return inplace_swap(self, fn(self, *args, **kwargs))
         return method
 
     for name, fn in [

@@ -1,0 +1,177 @@
+"""Top-level API tail (reference: python/paddle/__init__.py exports) —
+predicates, math leftovers, scatter views, inplace family, summary."""
+import numpy as np
+import pytest
+import scipy.special as sp
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+
+rng = np.random.RandomState(0)
+
+
+def _t(x):
+    return paddle.to_tensor(x)
+
+
+def _np(t):
+    return np.asarray(t._value)
+
+
+def test_predicates():
+    assert paddle.is_tensor(_t(np.zeros(2))) and not paddle.is_tensor(3)
+    assert paddle.is_floating_point(_t(np.zeros(2, "float32")))
+    assert paddle.is_integer(_t(np.zeros(2, "int32")))
+    assert paddle.is_complex(_t(np.zeros(2, "complex64")))
+    assert int(paddle.rank(_t(np.zeros((2, 3)))).numpy()) == 2
+
+
+def test_math_tail():
+    np.testing.assert_allclose(
+        _np(paddle.gcd(_t(np.array([12])), _t(np.array([18])))), [6])
+    np.testing.assert_allclose(
+        _np(paddle.lcm(_t(np.array([4])), _t(np.array([6])))), [12])
+    x = np.array([3.0, 4.0], "float32")
+    np.testing.assert_allclose(_np(paddle.multigammaln(_t(x), 2)),
+                               sp.multigammaln(x, 2), rtol=1e-4)
+    pol = _np(paddle.polar(_t(np.array([2.0], "float32")),
+                           _t(np.array([np.pi], "float32"))))
+    assert abs(pol[0].real + 2) < 1e-5
+    np.testing.assert_allclose(
+        _np(paddle.sgn(_t(np.array([-3.0, 0.0, 2.0], "float32")))),
+        [-1, 0, 1])
+    c = paddle.sgn(_t(np.array([3 + 4j], "complex64")))
+    np.testing.assert_allclose(_np(c), [0.6 + 0.8j], rtol=1e-5)
+    assert _np(paddle.signbit(_t(np.array([-1.0, 1.0])))).tolist() == \
+        [True, False]
+    np.testing.assert_allclose(
+        _np(paddle.deg2rad(_t(np.array([180.0], "float32")))),
+        [np.pi], rtol=1e-6)
+    nq = paddle.nanquantile(
+        _t(np.array([1.0, np.nan, 3.0], "float32")), 0.5)
+    assert abs(float(nq) - 2.0) < 1e-6
+
+
+def test_take_and_tensordot():
+    tk = paddle.take(_t(np.arange(12).reshape(3, 4)),
+                     _t(np.array([-1, 0, 5])))
+    np.testing.assert_allclose(_np(tk), [11, 0, 5])
+    a = rng.rand(3, 4).astype("float32")
+    b = rng.rand(4, 5).astype("float32")
+    np.testing.assert_allclose(
+        _np(paddle.tensordot(_t(a), _t(b), axes=1)), a @ b, rtol=1e-5)
+
+
+def test_splits_and_stacks():
+    parts = paddle.tensor_split(_t(np.arange(10)), 3)
+    assert [p.shape[0] for p in parts] == [4, 3, 3]
+    parts = paddle.tensor_split(_t(np.arange(10)), [3, 7])
+    assert [p.shape[0] for p in parts] == [3, 4, 3]
+    v = paddle.vsplit(_t(np.zeros((4, 2))), 2)
+    assert len(v) == 2 and v[0].shape == [2, 2]
+    assert paddle.vstack([_t(np.ones((2, 3))),
+                          _t(np.ones((1, 3)))]).shape == [3, 3]
+    assert paddle.hstack([_t(np.ones((2, 2))),
+                          _t(np.ones((2, 1)))]).shape == [2, 3]
+    assert paddle.row_stack is paddle.vstack
+    assert paddle.column_stack([_t(np.ones(3)),
+                                _t(np.ones(3))]).shape == [3, 2]
+
+
+def test_scatter_views():
+    sn = _np(paddle.scatter_nd(_t(np.array([[0, 1], [2, 3]])),
+                               _t(np.array([9.0, 8.0], "float32")),
+                               [3, 4]))
+    assert sn[0, 1] == 9 and sn[2, 3] == 8
+    ss = _np(paddle.select_scatter(_t(np.zeros((3, 4), "float32")),
+                                   _t(np.ones(4, "float32")), 0, 1))
+    assert ss[1].sum() == 4 and ss[0].sum() == 0
+    sl = _np(paddle.slice_scatter(_t(np.zeros((4, 4), "float32")),
+                                  _t(np.ones((2, 4), "float32")),
+                                  [0], [1], [3], [1]))
+    assert sl[1:3].sum() == 8 and sl[0].sum() == 0
+    ms = _np(paddle.masked_scatter(
+        _t(np.zeros(5, "float32")),
+        _t(np.array([True, False, True, False, True])),
+        _t(np.array([1.0, 2.0, 3.0], "float32"))))
+    np.testing.assert_allclose(ms, [1, 0, 2, 0, 3])
+
+
+def test_shapes_and_views():
+    assert paddle.mm(_t(rng.rand(2, 3).astype("float32")),
+                     _t(rng.rand(3, 2).astype("float32"))).shape == [2, 2]
+    assert paddle.view(_t(np.zeros((2, 6), "float32")),
+                       [3, 4]).shape == [3, 4]
+    assert paddle.view_as(_t(np.zeros((2, 6))),
+                          _t(np.zeros((12,)))).shape == [12]
+    assert paddle.unflatten(_t(np.zeros((4, 6))), 1,
+                            [2, 3]).shape == [4, 2, 3]
+    assert paddle.tolist(_t(np.array([1, 2]))) == [1, 2]
+    assert paddle.standard_normal([3, 2]).shape == [3, 2]
+    rl = paddle.randint_like(_t(np.zeros((2, 3), "int64")), 0, 10)
+    assert rl.shape == [2, 3]
+
+
+def test_inplace_family():
+    x = _t(np.array([1.0, 4.0], "float32"))
+    y = paddle.log_(x)
+    assert y is x
+    np.testing.assert_allclose(_np(x), np.log([1.0, 4.0]), rtol=1e-6)
+    xr = _t(np.arange(6, dtype="float32"))
+    paddle.reshape_(xr, [2, 3])
+    assert xr.shape == [2, 3]
+    xs = _t(np.array([[1.0, 2.0]], "float32"))
+    paddle.squeeze_(xs, 0)
+    assert xs.shape == [2]
+    xt = _t(np.eye(3, dtype="float32") * 5)
+    paddle.tril_(xt, -1)
+    assert _np(xt).sum() == 0
+    xw = _t(np.array([1.0, -1.0], "float32"))
+    paddle.multiply_(xw, _t(np.array([2.0, 2.0], "float32")))
+    np.testing.assert_allclose(_np(xw), [2, -2])
+
+
+def test_inplace_grad_flows():
+    x = _t(np.array([1.0, 2.0], "float32"))
+    x.stop_gradient = False
+    y = x * 2.0
+    paddle.log_(y)
+    y.sum().backward()
+    np.testing.assert_allclose(_np(x.grad), [1.0, 0.5], rtol=1e-5)
+
+
+def test_set_printoptions():
+    paddle.set_printoptions(precision=3)
+    try:
+        s = repr(_t(np.array([1.23456789], "float32")))
+        assert "1.235" in s
+    finally:
+        np.set_printoptions(precision=8)
+
+
+def test_summary():
+    net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    info = paddle.summary(net, (1, 4))
+    assert info["total_params"] == 4 * 8 + 8 + 8 * 2 + 2
+    assert info["layers"] >= 3
+
+
+def test_where_inplace_mutates_x():
+    cond = _t(np.array([True, False]))
+    x = _t(np.array([1.0, 2.0], "float32"))
+    y = _t(np.array([9.0, 9.0], "float32"))
+    out = paddle.where_(cond, x, y)
+    assert out is x
+    np.testing.assert_allclose(_np(x), [1.0, 9.0])
+    assert _np(cond).dtype == np.bool_  # condition untouched
+
+
+def test_randint_like_matches_dtype():
+    f = paddle.randint_like(_t(np.zeros((2, 2), "float32")), 0, 5)
+    assert "float32" in str(f.dtype)
+
+
+def test_take_clip_negative_goes_to_zero():
+    out = paddle.take(_t(np.arange(5)), _t(np.array([-1, 10])),
+                      mode="clip")
+    np.testing.assert_allclose(_np(out), [0, 4])
