@@ -372,3 +372,9 @@ def _kl_bernoulli(p, q):
 @register_kl(Uniform, Uniform)
 def _kl_uniform(p, q):
     return Tensor(jnp.log((q.high - q.low) / (p.high - p.low)))
+
+
+# distribution tail: transforms + Gamma/Poisson/Binomial/... (extra.py)
+from .extra import *  # noqa: F401,F403,E402
+from . import extra as transform  # noqa: F401,E402  (paddle.distribution.transform module alias)
+__all__ = __all__ + list(transform.__all__)  # noqa: E402

@@ -1,0 +1,543 @@
+"""Distribution tail (reference: python/paddle/distribution/ — the
+transform family transform.py, Gamma/Poisson/Binomial/Geometric/Cauchy/
+ContinuousBernoulli/MultivariateNormal distributions,
+TransformedDistribution, Independent). Same conventions as __init__:
+global-RNG sampling, jnp densities usable inside compiled steps.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import numpy as np
+import jax.numpy as jnp
+
+from ..core import rng as _rng
+from ..core.enforce import enforce
+from ..tensor import Tensor
+from . import Distribution, _key, _val
+
+__all__ = [
+    "ExponentialFamily", "Gamma", "Poisson", "Binomial", "Geometric",
+    "Cauchy", "ContinuousBernoulli", "MultivariateNormal", "Independent",
+    "TransformedDistribution",
+    "Transform", "AbsTransform", "AffineTransform", "ChainTransform",
+    "ExpTransform", "IndependentTransform", "PowerTransform",
+    "ReshapeTransform", "SigmoidTransform", "SoftmaxTransform",
+    "StackTransform", "StickBreakingTransform", "TanhTransform",
+]
+
+
+class ExponentialFamily(Distribution):
+    """Base marker for exponential-family members (reference:
+    distribution/exponential_family.py; entropy via Bregman identity is
+    specialized in subclasses here)."""
+
+
+class Gamma(ExponentialFamily):
+    def __init__(self, concentration, rate):
+        self.concentration = _val(concentration)
+        self.rate = _val(rate)
+        super().__init__(jnp.broadcast_shapes(self.concentration.shape,
+                                              self.rate.shape))
+
+    def sample(self, shape=()):
+        shp = tuple(shape) + self._batch_shape
+        g = jax.random.gamma(_key(), jnp.broadcast_to(
+            self.concentration, shp))
+        return Tensor(g / self.rate)
+
+    rsample = sample
+
+    def log_prob(self, value):
+        from jax.scipy.special import gammaln
+
+        v = _val(value)
+        a, b = self.concentration, self.rate
+        return Tensor(a * jnp.log(b) + (a - 1) * jnp.log(v) - b * v
+                      - gammaln(a))
+
+    def entropy(self):
+        from jax.scipy.special import digamma, gammaln
+
+        a, b = self.concentration, self.rate
+        return Tensor(a - jnp.log(b) + gammaln(a)
+                      + (1 - a) * digamma(a)
+                      + jnp.zeros(self._batch_shape))
+
+    @property
+    def mean(self):
+        return Tensor(jnp.broadcast_to(self.concentration / self.rate,
+                                       self._batch_shape))
+
+    @property
+    def variance(self):
+        return Tensor(jnp.broadcast_to(
+            self.concentration / self.rate ** 2, self._batch_shape))
+
+
+class Poisson(ExponentialFamily):
+    def __init__(self, rate):
+        self.rate = _val(rate)
+        super().__init__(self.rate.shape)
+
+    def sample(self, shape=()):
+        shp = tuple(shape) + self._batch_shape
+        return Tensor(jax.random.poisson(
+            _key(), jnp.broadcast_to(self.rate, shp)).astype(jnp.float32))
+
+    def log_prob(self, value):
+        from jax.scipy.special import gammaln
+
+        v = _val(value)
+        return Tensor(v * jnp.log(self.rate) - self.rate
+                      - gammaln(v + 1.0))
+
+    @property
+    def mean(self):
+        return Tensor(jnp.broadcast_to(self.rate, self._batch_shape))
+
+    @property
+    def variance(self):
+        return Tensor(jnp.broadcast_to(self.rate, self._batch_shape))
+
+
+class Binomial(Distribution):
+    def __init__(self, total_count, probs):
+        self.total_count = _val(total_count)
+        self.probs = _val(probs)
+        super().__init__(jnp.broadcast_shapes(
+            jnp.shape(self.total_count), self.probs.shape))
+
+    def sample(self, shape=()):
+        from ..ops.extra import _binomial
+
+        shp = tuple(shape) + self._batch_shape
+        n = jnp.broadcast_to(self.total_count, shp)
+        p = jnp.broadcast_to(self.probs, shp)
+        nmax = int(np.asarray(self.total_count).max())
+        return Tensor(_binomial.raw(_key(), n, p, nmax)
+                      .astype(jnp.float32))
+
+    def log_prob(self, value):
+        from jax.scipy.special import gammaln
+
+        v = _val(value)
+        n, p = self.total_count, self.probs
+        logc = gammaln(n + 1) - gammaln(v + 1) - gammaln(n - v + 1)
+        return Tensor(logc + v * jnp.log(p) + (n - v) * jnp.log1p(-p))
+
+    @property
+    def mean(self):
+        return Tensor(jnp.broadcast_to(self.total_count * self.probs,
+                                       self._batch_shape))
+
+    @property
+    def variance(self):
+        return Tensor(jnp.broadcast_to(
+            self.total_count * self.probs * (1 - self.probs),
+            self._batch_shape))
+
+
+class Geometric(Distribution):
+    """P(X=k) = (1-p)^k p, k = 0, 1, ... (failures before success)."""
+
+    def __init__(self, probs):
+        self.probs = _val(probs)
+        super().__init__(self.probs.shape)
+
+    def sample(self, shape=()):
+        shp = tuple(shape) + self._batch_shape
+        u = jax.random.uniform(_key(), shp, minval=1e-20)
+        return Tensor(jnp.floor(jnp.log(u)
+                                / jnp.log1p(-self.probs)))
+
+    def log_prob(self, value):
+        v = _val(value)
+        return Tensor(v * jnp.log1p(-self.probs) + jnp.log(self.probs))
+
+    @property
+    def mean(self):
+        return Tensor(jnp.broadcast_to((1 - self.probs) / self.probs,
+                                       self._batch_shape))
+
+    @property
+    def variance(self):
+        return Tensor(jnp.broadcast_to(
+            (1 - self.probs) / self.probs ** 2, self._batch_shape))
+
+
+class Cauchy(Distribution):
+    def __init__(self, loc, scale):
+        self.loc = _val(loc)
+        self.scale = _val(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape,
+                                              self.scale.shape))
+
+    def sample(self, shape=()):
+        shp = tuple(shape) + self._batch_shape
+        return Tensor(self.loc + self.scale
+                      * jax.random.cauchy(_key(), shp))
+
+    rsample = sample
+
+    def log_prob(self, value):
+        v = _val(value)
+        z = (v - self.loc) / self.scale
+        return Tensor(-jnp.log(math.pi * self.scale * (1 + z ** 2)))
+
+    def entropy(self):
+        return Tensor(jnp.log(4 * math.pi * self.scale)
+                      + jnp.zeros(self._batch_shape))
+
+
+class ContinuousBernoulli(Distribution):
+    """(reference: distribution/continuous_bernoulli.py): density
+    C(p) p^x (1-p)^(1-x) on [0, 1]."""
+
+    def __init__(self, probs, lims=(0.499, 0.501)):
+        self.probs = _val(probs)
+        self._lims = lims
+        super().__init__(self.probs.shape)
+
+    def _log_const(self):
+        p = self.probs
+        # log C(p); the p ~ 0.5 limit is log 2 (series expansion)
+        near = (p > self._lims[0]) & (p < self._lims[1])
+        # safe replaces the near-0.5 band with 0.25, so 1-2*safe is
+        # never ~0; arctanh(1-2p)/(1-2p) is positive for all p != 0.5
+        safe = jnp.where(near, 0.25, p)
+        c = jnp.log(2 * jnp.arctanh(1 - 2 * safe) / (1 - 2 * safe))
+        return jnp.where(near, math.log(2.0), c)
+
+    def log_prob(self, value):
+        v = _val(value)
+        return Tensor(self._log_const() + v * jnp.log(self.probs)
+                      + (1 - v) * jnp.log1p(-self.probs))
+
+    def sample(self, shape=()):
+        # inverse CDF: F^-1(u) = log1p((2p-1)u/(1-p)) / log(p/(1-p));
+        # the p ~ 0.5 limit is the uniform distribution
+        shp = tuple(shape) + self._batch_shape
+        u = jax.random.uniform(_key(), shp, minval=1e-7, maxval=1 - 1e-7)
+        p = jnp.broadcast_to(self.probs, shp)
+        near = (p > self._lims[0]) & (p < self._lims[1])
+        safe = jnp.where(near, 0.25, p)
+        x = jnp.log1p((2 * safe - 1) * u / (1 - safe)) \
+            / jnp.log(safe / (1 - safe))
+        return Tensor(jnp.where(near, u, jnp.clip(x, 0.0, 1.0)))
+
+
+class MultivariateNormal(Distribution):
+    def __init__(self, loc, covariance_matrix=None, scale_tril=None):
+        self.loc = _val(loc)
+        enforce((covariance_matrix is None) != (scale_tril is None),
+                "give exactly one of covariance_matrix / scale_tril")
+        if scale_tril is not None:
+            self._tril = _val(scale_tril)
+        else:
+            self._tril = jnp.linalg.cholesky(_val(covariance_matrix))
+        d = self.loc.shape[-1]
+        super().__init__(self.loc.shape[:-1], (d,))
+
+    def sample(self, shape=()):
+        shp = tuple(shape) + self._batch_shape + self._event_shape
+        eps = jax.random.normal(_key(), shp)
+        return Tensor(self.loc + jnp.einsum("...ij,...j->...i",
+                                            self._tril, eps))
+
+    rsample = sample
+
+    def log_prob(self, value):
+        v = _val(value)
+        d = self._event_shape[0]
+        diff = v - self.loc
+        sol = jax.scipy.linalg.solve_triangular(
+            self._tril, diff[..., None], lower=True)[..., 0]
+        logdet = jnp.sum(jnp.log(jnp.abs(
+            jnp.diagonal(self._tril, axis1=-2, axis2=-1))), -1)
+        return Tensor(-0.5 * jnp.sum(sol ** 2, -1) - logdet
+                      - 0.5 * d * math.log(2 * math.pi))
+
+    def entropy(self):
+        d = self._event_shape[0]
+        logdet = jnp.sum(jnp.log(jnp.abs(
+            jnp.diagonal(self._tril, axis1=-2, axis2=-1))), -1)
+        return Tensor(0.5 * d * (1 + math.log(2 * math.pi)) + logdet
+                      + jnp.zeros(self._batch_shape))
+
+    @property
+    def mean(self):
+        return Tensor(self.loc)
+
+
+class Independent(Distribution):
+    """Reinterprets trailing batch dims as event dims (reference:
+    distribution/independent.py)."""
+
+    def __init__(self, base, reinterpreted_batch_rank):
+        self.base = base
+        self._r = int(reinterpreted_batch_rank)
+        b = base.batch_shape
+        super().__init__(b[: len(b) - self._r],
+                         b[len(b) - self._r:] + base.event_shape)
+
+    def sample(self, shape=()):
+        return self.base.sample(shape)
+
+    def log_prob(self, value):
+        lp = self.base.log_prob(value)._value
+        return Tensor(jnp.sum(lp, axis=tuple(range(-self._r, 0))))
+
+    def entropy(self):
+        e = self.base.entropy()._value
+        return Tensor(jnp.sum(e, axis=tuple(range(-self._r, 0))))
+
+
+# ---------------------------------------------------------------------------
+# transforms (reference: python/paddle/distribution/transform.py)
+# ---------------------------------------------------------------------------
+class Transform:
+    """Bijection with log|det J| (reference transform.py Transform)."""
+
+    def forward(self, x):
+        return Tensor(self._forward(_val(x)))
+
+    def inverse(self, y):
+        return Tensor(self._inverse(_val(y)))
+
+    def forward_log_det_jacobian(self, x):
+        return Tensor(self._fldj(_val(x)))
+
+    def inverse_log_det_jacobian(self, y):
+        return Tensor(-self._fldj(self._inverse(_val(y))))
+
+    def __call__(self, x):
+        return self.forward(x)
+
+
+class ExpTransform(Transform):
+    def _forward(self, x):
+        return jnp.exp(x)
+
+    def _inverse(self, y):
+        return jnp.log(y)
+
+    def _fldj(self, x):
+        return x
+
+
+class AbsTransform(Transform):
+    def _forward(self, x):
+        return jnp.abs(x)
+
+    def _inverse(self, y):
+        return y  # one branch (reference returns the positive preimage)
+
+    def _fldj(self, x):
+        return jnp.zeros_like(x)
+
+
+class AffineTransform(Transform):
+    def __init__(self, loc, scale):
+        self.loc = _val(loc)
+        self.scale = _val(scale)
+
+    def _forward(self, x):
+        return self.loc + self.scale * x
+
+    def _inverse(self, y):
+        return (y - self.loc) / self.scale
+
+    def _fldj(self, x):
+        return jnp.broadcast_to(jnp.log(jnp.abs(self.scale)), x.shape)
+
+
+class PowerTransform(Transform):
+    def __init__(self, power):
+        self.power = _val(power)
+
+    def _forward(self, x):
+        return jnp.power(x, self.power)
+
+    def _inverse(self, y):
+        return jnp.power(y, 1.0 / self.power)
+
+    def _fldj(self, x):
+        return jnp.log(jnp.abs(self.power * jnp.power(x, self.power - 1)))
+
+
+class SigmoidTransform(Transform):
+    def _forward(self, x):
+        return jax.nn.sigmoid(x)
+
+    def _inverse(self, y):
+        return jnp.log(y) - jnp.log1p(-y)
+
+    def _fldj(self, x):
+        return jax.nn.log_sigmoid(x) + jax.nn.log_sigmoid(-x)
+
+
+class TanhTransform(Transform):
+    def _forward(self, x):
+        return jnp.tanh(x)
+
+    def _inverse(self, y):
+        return jnp.arctanh(jnp.clip(y, -1 + 1e-6, 1 - 1e-6))
+
+    def _fldj(self, x):
+        # log(1 - tanh(x)^2) = 2 (log 2 - x - softplus(-2x))
+        return 2.0 * (math.log(2.0) - x - jax.nn.softplus(-2.0 * x))
+
+
+class SoftmaxTransform(Transform):
+    """Normalizes the last axis (not bijective; pseudo-inverse = log)."""
+
+    def _forward(self, x):
+        return jax.nn.softmax(x, axis=-1)
+
+    def _inverse(self, y):
+        return jnp.log(y)
+
+    def _fldj(self, x):
+        raise NotImplementedError(
+            "SoftmaxTransform is not a bijection; no log-det")
+
+
+class StickBreakingTransform(Transform):
+    """R^{d} -> interior of the d-simplex (reference transform.py)."""
+
+    def _forward(self, x):
+        offset = x.shape[-1] - jnp.cumsum(
+            jnp.ones_like(x), axis=-1) + 1
+        z = jax.nn.sigmoid(x - jnp.log(offset))
+        zc = jnp.cumprod(1 - z, axis=-1)
+        lead = jnp.concatenate(
+            [jnp.ones_like(zc[..., :1]), zc[..., :-1]], -1)
+        first = z * lead
+        return jnp.concatenate([first, zc[..., -1:]], -1)
+
+    def _inverse(self, y):
+        ycum = jnp.cumsum(y[..., :-1], -1)
+        rest = 1 - jnp.concatenate(
+            [jnp.zeros_like(ycum[..., :1]), ycum[..., :-1]], -1)
+        z = y[..., :-1] / rest
+        offset = z.shape[-1] - jnp.cumsum(
+            jnp.ones_like(z), axis=-1) + 1
+        return jnp.log(z / (1 - z)) + jnp.log(offset)
+
+    def _fldj(self, x):
+        offset = x.shape[-1] - jnp.cumsum(
+            jnp.ones_like(x), axis=-1) + 1
+        z = jax.nn.sigmoid(x - jnp.log(offset))
+        zc = jnp.cumprod(1 - z, axis=-1)
+        lead = jnp.concatenate(
+            [jnp.ones_like(zc[..., :1]), zc[..., :-1]], -1)
+        return jnp.sum(jnp.log(z) + jnp.log1p(-z) + jnp.log(lead), -1)
+
+
+class ChainTransform(Transform):
+    def __init__(self, transforms):
+        self.transforms = list(transforms)
+
+    def _forward(self, x):
+        for t in self.transforms:
+            x = t._forward(x)
+        return x
+
+    def _inverse(self, y):
+        for t in reversed(self.transforms):
+            y = t._inverse(y)
+        return y
+
+    def _fldj(self, x):
+        total = 0.0
+        for t in self.transforms:
+            total = total + t._fldj(x)
+            x = t._forward(x)
+        return total
+
+
+class IndependentTransform(Transform):
+    def __init__(self, base, reinterpreted_batch_rank):
+        self.base = base
+        self._r = int(reinterpreted_batch_rank)
+
+    def _forward(self, x):
+        return self.base._forward(x)
+
+    def _inverse(self, y):
+        return self.base._inverse(y)
+
+    def _fldj(self, x):
+        return jnp.sum(self.base._fldj(x),
+                       axis=tuple(range(-self._r, 0)))
+
+
+class ReshapeTransform(Transform):
+    def __init__(self, in_event_shape, out_event_shape):
+        self.in_event_shape = tuple(in_event_shape)
+        self.out_event_shape = tuple(out_event_shape)
+
+    def _forward(self, x):
+        lead = x.shape[: x.ndim - len(self.in_event_shape)]
+        return x.reshape(lead + self.out_event_shape)
+
+    def _inverse(self, y):
+        lead = y.shape[: y.ndim - len(self.out_event_shape)]
+        return y.reshape(lead + self.in_event_shape)
+
+    def _fldj(self, x):
+        lead = x.shape[: x.ndim - len(self.in_event_shape)]
+        return jnp.zeros(lead)
+
+
+class StackTransform(Transform):
+    """Applies a list of transforms to slices along ``axis``."""
+
+    def __init__(self, transforms, axis=0):
+        self.transforms = list(transforms)
+        self.axis = int(axis)
+
+    def _apply(self, x, attr):
+        parts = jnp.split(x, len(self.transforms), axis=self.axis)
+        out = [getattr(t, attr)(p.squeeze(self.axis))
+               for t, p in zip(self.transforms, parts)]
+        return jnp.stack(out, axis=self.axis)
+
+    def _forward(self, x):
+        return self._apply(x, "_forward")
+
+    def _inverse(self, y):
+        return self._apply(y, "_inverse")
+
+    def _fldj(self, x):
+        return self._apply(x, "_fldj")
+
+
+class TransformedDistribution(Distribution):
+    """(reference: distribution/transformed_distribution.py)."""
+
+    def __init__(self, base, transforms):
+        self.base = base
+        self.transforms = (list(transforms)
+                           if isinstance(transforms, (list, tuple))
+                           else [transforms])
+        super().__init__(base.batch_shape, base.event_shape)
+
+    def sample(self, shape=()):
+        x = self.base.sample(shape)._value
+        for t in self.transforms:
+            x = t._forward(x)
+        return Tensor(x)
+
+    rsample = sample
+
+    def log_prob(self, value):
+        y = _val(value)
+        ldj = 0.0
+        for t in reversed(self.transforms):
+            x = t._inverse(y)
+            ldj = ldj + t._fldj(x)
+            y = x
+        return Tensor(self.base.log_prob(Tensor(y))._value - ldj)

@@ -99,7 +99,8 @@ def sgn(x):
     """sign for real; x/|x| (unit phasor, 0 at 0) for complex."""
     if jnp.issubdtype(x.dtype, jnp.complexfloating):
         mag = jnp.abs(x)
-        return jnp.where(mag == 0, 0.0 + 0.0j, x / jnp.maximum(mag, 1e-30))
+        return jnp.where(mag == 0, 0.0 + 0.0j,
+                         x / jnp.where(mag == 0, 1.0, mag))
     return jnp.sign(x)
 
 
@@ -113,6 +114,8 @@ def take(x, index, mode="raise"):
     """Flat-index gather (reference: tensor/math.py take): 'raise'
     wraps negatives python-style, 'wrap' is modular, 'clip' clamps to
     [0, n-1] (negatives go to 0, numpy semantics)."""
+    enforce(mode in ("raise", "wrap", "clip"),
+            lambda: f"take mode must be raise/wrap/clip, got {mode!r}")
     flat = x.reshape(-1)
     n = flat.shape[0]
     idx = index.astype(jnp.int32)
@@ -120,7 +123,8 @@ def take(x, index, mode="raise"):
         idx = idx % n
     elif mode == "clip":
         idx = jnp.clip(idx, 0, n - 1)
-    else:
+    else:  # 'raise': python-style negatives; cannot raise inside a
+        # traced program, so out-of-range clamps (documented)
         idx = jnp.where(idx < 0, idx + n, idx)
         idx = jnp.clip(idx, 0, n - 1)
     return flat[idx]
@@ -145,8 +149,12 @@ def tensor_split(x, num_or_indices, axis=0, name=None):
         base, extra = divmod(n, k)
         sizes = [base + 1] * extra + [base] * (k - extra)
         return split(x, sizes, axis=ax)
-    idx = [0] + [int(i) for i in num_or_indices] + [n]
-    sizes = [b - a for a, b in zip(idx[:-1], idx[1:])]
+    # numpy semantics: negative indices count from the end, out-of-
+    # range clips (possibly yielding empty chunks)
+    norm = [min(max(int(i) + n, 0) if int(i) < 0 else min(int(i), n), n)
+            for i in num_or_indices]
+    idx = [0] + norm + [n]
+    sizes = [max(b - a, 0) for a, b in zip(idx[:-1], idx[1:])]
     return split(x, sizes, axis=ax)
 
 
@@ -221,8 +229,8 @@ def slice_scatter(x, value, axes, starts, ends, strides):
     return x.at[tuple(idx)].set(value)
 
 
-@def_op("masked_scatter")
-def masked_scatter(x, mask, value):
+@def_op("masked_scatter_op")
+def _masked_scatter(x, mask, value):
     """Fill True positions of mask with consecutive elements of value
     (reference: tensor/manipulation.py masked_scatter). Static-shape
     form: position k in row-major order takes value.flat[#True before
@@ -233,6 +241,20 @@ def masked_scatter(x, mask, value):
     pos = jnp.cumsum(m.astype(jnp.int32)) - 1
     gathered = vf[jnp.clip(pos, 0, vf.shape[0] - 1)]
     return jnp.where(m, gathered, xf).reshape(x.shape)
+
+
+def masked_scatter(x, mask, value, name=None):
+    import jax as _jax
+
+    mv = mask._value if isinstance(mask, Tensor) else mask
+    vv = value._value if isinstance(value, Tensor) else value
+    if not isinstance(mv, _jax.core.Tracer):  # eager: validate like paddle
+        need = int(np.asarray(mv).sum())
+        have = int(np.prod(np.asarray(vv).shape))
+        enforce(have >= need,
+                lambda: f"masked_scatter needs value.numel ({have}) >= "
+                        f"mask True count ({need})")
+    return _masked_scatter(x, mask, value)
 
 
 def mm(input, mat2, name=None):
@@ -292,7 +314,8 @@ def view_as(x, other, name=None):
 
 
 def tolist(x):
-    return np.asarray(x._value if isinstance(x, Tensor) else x).tolist()
+    return x.tolist() if isinstance(x, Tensor) \
+        else np.asarray(x).tolist()
 
 
 def set_printoptions(precision=None, threshold=None, edgeitems=None,
@@ -329,7 +352,8 @@ def summary(net, input_size=None, dtypes=None, input=None):
             n_params = sum(
                 int(np.prod(p.shape))
                 for p in layer._parameters.values() if p is not None)
-            rows.append((name, type(layer).__name__, shape, n_params))
+            rows.append((name, type(layer).__name__, shape, n_params,
+                         id(layer)))
         return hook
 
     for name, sub in net.named_sublayers():
@@ -349,10 +373,17 @@ def summary(net, input_size=None, dtypes=None, input=None):
     finally:
         for h in hooks:
             h.remove()
-    total = sum(r[3] for r in rows)
+    # count each layer INSTANCE once (hooks fire per call; weight
+    # sharing must not double-count)
+    seen_ids = set()
+    total = 0
+    for name, typ, shape, n, lid in rows:
+        if lid not in seen_ids:
+            seen_ids.add(lid)
+            total += n
     lines = [f"{'Layer':<30}{'Type':<22}{'Output shape':<20}{'Params':>10}"]
     lines.append("-" * 82)
-    for name, typ, shape, n in rows:
+    for name, typ, shape, n, _lid in rows:
         lines.append(f"{name:<30}{typ:<22}{str(shape):<20}{n:>10}")
     lines.append("-" * 82)
     lines.append(f"Total params: {total:,}")

@@ -175,3 +175,41 @@ def test_take_clip_negative_goes_to_zero():
     out = paddle.take(_t(np.arange(5)), _t(np.array([-1, 10])),
                       mode="clip")
     np.testing.assert_allclose(_np(out), [0, 4])
+
+
+def test_tensor_split_negative_and_oob_indices():
+    parts = paddle.tensor_split(_t(np.arange(10)), [-3])
+    assert [p.shape[0] for p in parts] == [7, 3]
+    parts = paddle.tensor_split(_t(np.arange(5)), [3, 10])
+    assert [p.shape[0] for p in parts] == [3, 2, 0]
+
+
+def test_summary_shared_layer_counts_once():
+    class Twice(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.lin = nn.Linear(4, 4)
+
+        def forward(self, x):
+            return self.lin(self.lin(x))
+
+    info = paddle.summary(Twice(), (1, 4))
+    assert info["total_params"] == 4 * 4 + 4  # one instance, not two
+
+
+def test_masked_scatter_too_few_values_raises():
+    with pytest.raises(Exception, match="numel"):
+        paddle.masked_scatter(
+            _t(np.zeros(5, "float32")),
+            _t(np.array([True, True, True, False, False])),
+            _t(np.array([1.0, 2.0], "float32")))
+
+
+def test_take_bad_mode_raises():
+    with pytest.raises(Exception, match="mode"):
+        paddle.take(_t(np.arange(5)), _t(np.array([0])), mode="clamp")
+
+
+def test_sgn_tiny_complex():
+    out = _np(paddle.sgn(_t(np.array([1e-35 + 0j], "complex64"))))
+    assert abs(out[0] - 1.0) < 1e-5
