@@ -63,6 +63,10 @@ from .hapi import Model  # noqa: F401
 # paddle-API aliases
 bool = bool_  # noqa: A001
 
+# bind the remaining reference Tensor methods now that the full
+# function surface exists (reference: tensor/__init__.py method list)
+_tensor_methods.patch_namespace_methods(globals())
+
 __version__ = "0.1.0"
 
 

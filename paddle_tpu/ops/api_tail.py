@@ -407,7 +407,8 @@ def where_(condition, x, y, name=None):
 
 
 def _gen_inplace():
-    from . import creation, extra, manipulation, math as math_ops
+    from . import creation, extra, manipulation, math as math_ops, \
+        nn_ops
 
     from ..tensor import inplace_swap
 
@@ -420,7 +421,7 @@ def _gen_inplace():
 
     mod = sys.modules[__name__]
     sources = {}
-    for m in (math_ops, manipulation, extra, creation, mod):
+    for m in (math_ops, manipulation, extra, creation, nn_ops, mod):
         for n in dir(m):
             if not n.startswith("_") and callable(getattr(m, n)):
                 sources.setdefault(n, getattr(m, n))
@@ -431,7 +432,17 @@ def _gen_inplace():
         "multiply", "nan_to_num", "neg", "not_equal", "polygamma",
         "pow", "remainder", "renorm", "reshape", "scatter", "sin",
         "sinh", "square", "squeeze", "t", "tan", "tril", "triu",
-        "trunc", "unsqueeze", "masked_scatter", "gcd",
+        "trunc", "unsqueeze", "masked_scatter", "gcd", "tanh", "abs",
+        "acos", "acosh", "asin", "asinh", "atan", "atanh",
+        "bitwise_and", "bitwise_not", "bitwise_or", "bitwise_xor",
+        "bitwise_left_shift", "bitwise_right_shift", "addmm", "add_n",
+        "cast", "ceil", "copysign", "cos", "cosh", "cumprod", "cumsum",
+        "digamma", "equal", "erfinv", "flatten", "floor",
+        "floor_divide", "floor_mod", "frac", "gammainc", "gammaincc",
+        "gammaln", "greater_equal", "greater_than", "hypot", "i0",
+        "index_fill", "index_put", "lerp", "multigammaln",
+        "put_along_axis", "reciprocal", "round", "rsqrt", "sigmoid",
+        "transpose",
     ]
     made = []
     for n in names:
@@ -440,5 +451,323 @@ def _gen_inplace():
             made.append(n + "_")
     mod.__all__ = list(mod.__all__) + made
 
+
+# ---------------------------------------------------------------------------
+# second tail batch: reference Tensor-method names with no function yet
+# ---------------------------------------------------------------------------
+def broadcast_shape(x_shape, y_shape):
+    return list(np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+@def_op("cdist")
+def cdist(x, y, p=2.0):
+    """Pairwise distances between row sets: [..., M, D] x [..., N, D]
+    -> [..., M, N] (reference: tensor/linalg.py cdist)."""
+    d = x[..., :, None, :] - y[..., None, :, :]
+    if p == 2.0:
+        return jnp.sqrt(jnp.maximum(jnp.sum(d * d, -1), 1e-30))
+    return jnp.sum(jnp.abs(d) ** p, -1) ** (1.0 / p)
+
+
+@def_op("count_nonzero", differentiable=False)
+def count_nonzero(x, axis=None, keepdim=False):
+    return jnp.sum((x != 0), axis=axis, keepdims=bool(keepdim))
+
+
+@def_op("cumulative_trapezoid")
+def cumulative_trapezoid(y, x=None, dx=1.0, axis=-1):
+    axis = int(axis) % y.ndim
+    sl0 = [slice(None)] * y.ndim
+    sl1 = [slice(None)] * y.ndim
+    sl0[axis] = slice(None, -1)
+    sl1[axis] = slice(1, None)
+    mid = (y[tuple(sl0)] + y[tuple(sl1)]) / 2.0
+    if x is not None:
+        step = jnp.diff(x, axis=axis) if x.ndim == y.ndim \
+            else jnp.diff(x).reshape(
+                (1,) * axis + (-1,) + (1,) * (y.ndim - axis - 1))
+        mid = mid * step
+    else:
+        mid = mid * dx
+    return jnp.cumsum(mid, axis=axis)
+
+
+@def_op("diagonal_scatter")
+def diagonal_scatter(x, y, offset=0, axis1=0, axis2=1):
+    enforce(x.ndim == 2 and int(axis1) == 0 and int(axis2) == 1,
+            "diagonal_scatter here supports 2-D (axis1=0, axis2=1)")
+    m, ncol = x.shape
+    off = int(offset)
+    # rectangular-correct diagonal length
+    n = max(min(m + min(off, 0), ncol - max(off, 0)), 0)
+    ii = jnp.arange(n)
+    rows = ii - min(off, 0)
+    cols = ii + max(off, 0)
+    return x.at[rows, cols].set(y)
+
+
+def dsplit(x, num_or_indices, name=None):
+    enforce(x.ndim >= 3, "dsplit expects rank >= 3")
+    return tensor_split(x, num_or_indices, axis=2)
+
+
+def floor_mod(x, y, name=None):
+    from .math import mod
+
+    return mod(x, y)
+
+
+def gammainc(x, y, name=None):
+    from .math import igamma
+
+    return igamma(x, y)
+
+
+@def_op("histogramdd_op", differentiable=False)
+def _histogramdd(x, bins=10, ranges=None, density=False, weights=None):
+    h, edges = jnp.histogramdd(x, bins=bins, range=ranges,
+                               density=bool(density), weights=weights)
+    return (h,) + tuple(edges)
+
+
+def histogramdd(x, bins=10, ranges=None, density=False, weights=None,
+                name=None):
+    """(reference: tensor/linalg.py histogramdd) -> (hist, edges_list)."""
+    out = _histogramdd(x, bins, ranges, density, weights)
+    return out[0], list(out[1:])
+
+
+@def_op("index_fill")
+def index_fill(x, index, axis, value):
+    idx = [slice(None)] * x.ndim
+    idx[int(axis)] = index
+    return x.at[tuple(idx)].set(jnp.asarray(value, x.dtype))
+
+
+def inverse(x, name=None):
+    from .linalg import inv
+
+    return inv(x)
+
+
+@def_op("lu_unpack")
+def lu_unpack(lu_data, lu_pivots, unpack_ludata=True, unpack_pivots=True):
+    """Unpack jax/LAPACK-style packed LU (reference: lu_unpack op).
+    2-D only; returns (P, L, U) with identity placeholders when a
+    component's unpack flag is off."""
+    enforce(lu_data.ndim == 2,
+            "lu_unpack here supports unbatched 2-D input")
+    m, n = lu_data.shape[-2:]
+    k = min(m, n)
+    L = jnp.tril(lu_data[..., :, :k], -1) + jnp.eye(m, k, dtype=lu_data.dtype)
+    U = jnp.triu(lu_data[..., :k, :])
+    # pivots (1-based sequential row swaps) -> permutation matrix
+    piv = lu_pivots.astype(jnp.int32) - 1
+    perm = jnp.arange(m)
+    for i in range(piv.shape[-1]):
+        j = piv[..., i]
+        pi, pj = perm[i], perm[j]
+        perm = perm.at[i].set(pj).at[j].set(pi)
+    P = jnp.eye(m, dtype=lu_data.dtype)[perm].T
+    if not unpack_ludata:
+        L = jnp.eye(m, k, dtype=lu_data.dtype)
+        U = jnp.eye(k, n, dtype=lu_data.dtype)
+    if not unpack_pivots:
+        P = jnp.eye(m, dtype=lu_data.dtype)
+    return P, L, U
+
+
+def sigmoid(x, name=None):
+    from .nn_ops import sigmoid as _sig
+
+    return _sig(x)
+
+
+@def_op("tensor_unfold")
+def tensor_unfold(x, axis, size, step):
+    """Sliding windows along ``axis`` (reference: Tensor.unfold —
+    DIFFERENT from nn.functional.unfold/im2col): appends a window dim."""
+    axis = int(axis) % x.ndim
+    size, step = int(size), int(step)
+    n = (x.shape[axis] - size) // step + 1
+    idx = (np.arange(n)[:, None] * step
+           + np.arange(size)[None, :])           # [n, size]
+    out = jnp.take(x, jnp.asarray(idx.reshape(-1)), axis=axis)
+    new_shape = (x.shape[:axis] + (n, size) + x.shape[axis + 1:])
+    out = out.reshape(new_shape)
+    # paddle places the window dim LAST
+    return jnp.moveaxis(out, axis + 1, -1)
+
+
+def pca_lowrank(x, q=None, center=True, niter=2, name=None):
+    """Randomized PCA (reference: tensor/linalg.py pca_lowrank)."""
+    from ..core import rng as _rng
+    import jax as _jax
+
+    xv = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+    m, n = xv.shape[-2:]
+    q = q or min(6, m, n)
+    if center:
+        xv = xv - xv.mean(axis=-2, keepdims=True)
+    # randomized range finder + SVD of the projected matrix
+    omega = _jax.random.normal(_rng.get_key(), xv.shape[:-2] + (n, q),
+                               xv.dtype)
+    y = xv @ omega
+    for _ in range(int(niter)):
+        y = xv @ (xv.swapaxes(-1, -2) @ y)
+    qmat, _ = jnp.linalg.qr(y)
+    b = qmat.swapaxes(-1, -2) @ xv
+    u_b, s, vT = jnp.linalg.svd(b, full_matrices=False)
+    u = qmat @ u_b
+    return Tensor(u), Tensor(s), Tensor(vT.swapaxes(-1, -2))
+
+
+def normal_(x, mean=0.0, std=1.0, name=None):
+    import jax as _jax
+
+    from ..core import rng as _rng
+
+    x._value = (mean + std * _jax.random.normal(
+        _rng.get_key(), tuple(x.shape))).astype(x._value.dtype)
+    return x
+
+
+def uniform_(x, min=-1.0, max=1.0, seed=0, name=None):  # noqa: A002
+    import jax as _jax
+
+    from ..core import rng as _rng
+
+    x._value = _jax.random.uniform(
+        _rng.get_key(), tuple(x.shape), minval=min,
+        maxval=max).astype(x._value.dtype)
+    return x
+
+
+def cauchy_(x, loc=0.0, scale=1.0, name=None):
+    import jax as _jax
+
+    from ..core import rng as _rng
+
+    x._value = (loc + scale * _jax.random.cauchy(
+        _rng.get_key(), tuple(x.shape))).astype(x._value.dtype)
+    return x
+
+
+def geometric_(x, probs, name=None):
+    import jax as _jax
+
+    from ..core import rng as _rng
+
+    # reference geometric_ (creation.py:2911) fills the CONTINUOUS
+    # value log(u)/log1p(-p) without flooring
+    u = _jax.random.uniform(_rng.get_key(), tuple(x.shape), minval=1e-20)
+    x._value = (jnp.log(u) / jnp.log1p(-probs)).astype(x._value.dtype)
+    return x
+
+
+__all__ = list(__all__) + [
+    "broadcast_shape", "cdist", "count_nonzero", "cumulative_trapezoid",
+    "diagonal_scatter", "dsplit", "floor_mod", "gammainc", "histogramdd",
+    "index_fill", "inverse", "lu_unpack", "sigmoid", "tensor_unfold",
+    "pca_lowrank", "normal_", "uniform_", "cauchy_", "geometric_",
+]
+
+
+@def_op("add_n_op")
+def _add_n(*xs):
+    out = xs[0]
+    for x in xs[1:]:
+        out = out + x
+    return out
+
+
+def add_n(inputs, name=None):
+    """Elementwise sum of a tensor list (reference: tensor/math.py
+    add_n)."""
+    if isinstance(inputs, Tensor):
+        return inputs
+    return _add_n(*inputs)
+
+
+@def_op("atleast_nd")
+def _atleast(x, nd):
+    """Reference placement (manipulation.py atleast_*): 1d: scalars ->
+    (1,); 2d: (N,) -> (1, N); 3d: (N,) -> (1, N, 1), (M, N) ->
+    (M, N, 1)."""
+    if nd == 1:
+        return x.reshape(1) if x.ndim == 0 else x
+    if nd == 2:
+        if x.ndim == 0:
+            return x.reshape(1, 1)
+        if x.ndim == 1:
+            return x[None, :]
+        return x
+    # nd == 3
+    if x.ndim == 0:
+        return x.reshape(1, 1, 1)
+    if x.ndim == 1:
+        return x[None, :, None]
+    if x.ndim == 2:
+        return x[:, :, None]
+    return x
+
+
+def atleast_1d(*inputs, name=None):
+    out = [_atleast(x, 1) for x in inputs]
+    return out[0] if len(out) == 1 else out
+
+
+def atleast_2d(*inputs, name=None):
+    out = [_atleast(x, 2) for x in inputs]
+    return out[0] if len(out) == 1 else out
+
+
+def atleast_3d(*inputs, name=None):
+    out = [_atleast(x, 3) for x in inputs]
+    return out[0] if len(out) == 1 else out
+
+
+@def_op("as_strided")
+def as_strided(x, shape, stride, offset=0):
+    """Strided view (reference: tensor/manipulation.py as_strided) —
+    expressed as a flat gather with the given element strides."""
+    idx = np.zeros(tuple(int(s) for s in shape), np.int64) + int(offset)
+    for d, (sz, st) in enumerate(zip(shape, stride)):
+        ar = np.arange(int(sz)) * int(st)
+        idx = idx + ar.reshape((1,) * d + (-1,)
+                               + (1,) * (len(shape) - d - 1))
+    return x.reshape(-1)[jnp.asarray(idx)]
+
+
+def create_tensor(dtype, name=None, persistable=False):
+    """(reference: tensor/creation.py create_tensor — a typed empty
+    slot in static graphs; eagerly, an empty tensor.)"""
+    from ..core.dtype import convert_dtype
+
+    return Tensor(jnp.zeros((0,), convert_dtype(dtype)))
+
+
+def create_parameter(shape, dtype, name=None, attr=None,
+                     is_bias=False, default_initializer=None):
+    """(reference: tensor/creation.py create_parameter)."""
+    from ..core.dtype import convert_dtype
+    from ..core import rng as _rng
+    import jax as _jax
+
+    if default_initializer is not None:
+        from ..tensor import Parameter
+
+        p = Parameter(jnp.zeros(tuple(shape), convert_dtype(dtype)))
+        default_initializer(p)
+        return p
+    from ..tensor import Parameter
+
+    val = 0.02 * _jax.random.normal(_rng.get_key(), tuple(shape))
+    return Parameter(val.astype(convert_dtype(dtype)))
+
+
+__all__ = list(__all__) + ["add_n", "atleast_1d", "atleast_2d",
+                           "atleast_3d", "as_strided", "create_tensor",
+                           "create_parameter"]
 
 _gen_inplace()

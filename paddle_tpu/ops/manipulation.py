@@ -398,7 +398,7 @@ def unique(x, return_index=False, return_inverse=False, return_counts=False, axi
     return jnp.asarray(res)
 
 
-@def_op("as_strided")
+@def_op("numel_op")
 def numel_op(x):
     return jnp.asarray(x.size, dtype=jnp.int64)
 

@@ -104,3 +104,95 @@ def _patch():
 
 
 _patch()
+
+
+def patch_namespace_methods(ns):
+    """Bind remaining reference Tensor methods from the top-level
+    namespace (reference: python/paddle/tensor/__init__.py
+    tensor_method_func — there the pybind monkey-patch does the same
+    job). Called at the end of package __init__, when the full function
+    surface exists; only names not already bound are added, each
+    delegating to the namespace function with the tensor as first arg.
+    """
+    from .tensor import Tensor as T
+
+    probe = T.__dict__  # only skip names bound directly on Tensor
+
+    def bind(name, fn):
+        def method(self, *args, **kwargs):
+            return fn(self, *args, **kwargs)
+        method.__name__ = name
+        setattr(T, name, method)
+
+    # only names ABSENT from the reference method list below (extras
+    # this framework also exposes as methods)
+    names = [
+        "crop", "increment", "logspace", "strided_slice", "dist",
+        "equal_all", "is_empty", "clip_by_norm", "multiplex",
+        "shard_index", "stanh", "i0e", "i1", "i1e",
+    ]
+    _REFERENCE_METHOD_NAMES = """
+abs abs_ acos acos_ acosh acosh_ add add_ add_n addmm addmm_ all
+allclose amax amin angle any argmax argmin argsort as_complex
+as_real as_strided asin asin_ asinh asinh_ atan atan2 atan_ atanh
+atanh_ atleast_1d atleast_2d atleast_3d bincount bitwise_and
+bitwise_and_ bitwise_left_shift bitwise_left_shift_ bitwise_not
+bitwise_not_ bitwise_or bitwise_or_ bitwise_right_shift
+bitwise_right_shift_ bitwise_xor bitwise_xor_ bmm broadcast_shape
+broadcast_tensors broadcast_to bucketize cast cast_ cauchy_ cdist
+ceil ceil_ cholesky cholesky_solve chunk clip clip_ concat cond conj
+copysign copysign_ corrcoef cos cos_ cosh cosh_ count_nonzero cov
+create_parameter create_tensor cross cummax cummin cumprod cumprod_
+cumsum cumsum_ cumulative_trapezoid deg2rad diag diag_embed diagflat
+diagonal diagonal_scatter diff digamma digamma_ dist divide divide_
+dot dsplit eig eigvals eigvalsh equal equal_ equal_all erf erfinv
+erfinv_ exp exp_ expand expand_as expm1 exponential_ flatten
+flatten_ flip floor floor_ floor_divide floor_divide_ floor_mod
+floor_mod_ fmax fmin frac frac_ frexp gammainc gammainc_ gammaincc
+gammaincc_ gammaln gammaln_ gather gather_nd gcd gcd_ geometric_
+greater_equal greater_equal_ greater_than greater_than_ heaviside
+histogram histogramdd householder_product hsplit hypot hypot_ i0 i0_
+i0e i1 i1e imag increment index_add index_fill index_fill_ index_put
+index_put_ index_sample index_select inner inverse is_complex
+is_empty is_floating_point is_integer is_tensor isclose isfinite
+isinf isnan istft kron kthvalue lcm lcm_ ldexp ldexp_ lerp lerp_
+less_equal less_equal_ less_than less_than_ lgamma lgamma_ log log10
+log10_ log1p log1p_ log2 log2_ log_ logaddexp logcumsumexp
+logical_and logical_and_ logical_not logical_not_ logical_or
+logical_or_ logical_xor logical_xor_ logit logit_ logsumexp lstsq lu
+lu_unpack masked_fill masked_fill_ masked_scatter masked_scatter_
+masked_select matmul matrix_power max maximum mean median min
+minimum mm mod mod_ moveaxis multi_dot multigammaln multigammaln_
+multinomial multiplex multiply multiply_ mv nan_to_num nan_to_num_
+nanmean nanmedian nanquantile nansum neg neg_ nextafter nonzero norm
+normal_ not_equal not_equal_ numel outer pca_lowrank pinv polar
+polygamma polygamma_ pow pow_ prod put_along_axis put_along_axis_ qr
+quantile rad2deg rank real reciprocal reciprocal_ remainder
+remainder_ renorm renorm_ repeat_interleave reshape reshape_ reverse
+roll rot90 round round_ rsqrt rsqrt_ scale scale_ scatter scatter_
+scatter_nd scatter_nd_add select_scatter sgn shape shard_index
+sigmoid sigmoid_ sign signbit sin sin_ sinh sinh_ slice
+slice_scatter solve sort split sqrt sqrt_ square squeeze squeeze_
+stack stanh std stft strided_slice subtract subtract_ sum t t_ take
+take_along_axis tan tan_ tanh tanh_ tensor_split tensordot tile
+top_p_sampling topk trace transpose transpose_ trapezoid
+triangular_solve tril tril_ triu triu_ trunc trunc_ unbind unflatten
+unfold uniform_ unique unique_consecutive unsqueeze unsqueeze_
+unstack vander var view view_as vsplit where where_
+""".split()
+    for name in names + _REFERENCE_METHOD_NAMES:
+        if name in probe or hasattr(T, name):
+            continue
+        fn = ns.get(name)
+        if callable(fn):
+            bind(name, fn)
+    sig = ns.get("signal")
+    if sig is not None:
+        for name in ("stft", "istft"):
+            if name not in probe:
+                bind(name, getattr(sig, name))
+    from .ops.api_tail import tensor_unfold as _tu
+
+    if "unfold" not in probe:
+        bind("unfold", _tu)
+
