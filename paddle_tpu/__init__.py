@@ -47,7 +47,12 @@ from . import distribution  # noqa: F401
 from . import quantization  # noqa: F401
 from . import inference  # noqa: F401
 from . import utils  # noqa: F401
-from . import linalg  # noqa: F401
+import importlib as _importlib
+
+# ops star-import binds ops.linalg onto the package under the name
+# 'linalg', which would make `from . import linalg` short-circuit to
+# the wrong module — import the top-level namespace module explicitly
+linalg = _importlib.import_module(".linalg", __name__)
 from . import fft  # noqa: F401
 from . import signal  # noqa: F401
 from . import sparse  # noqa: F401

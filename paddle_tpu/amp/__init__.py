@@ -284,3 +284,13 @@ class GradScaler:
 
 
 from . import debugging  # noqa: E402,F401
+
+
+def is_bfloat16_supported(device=None):
+    """bf16 is the native TPU matmul dtype (always true here)."""
+    return True
+
+
+def is_float16_supported(device=None):
+    """fp16 compute is emulated on TPU; XLA supports the dtype."""
+    return True

@@ -12,7 +12,8 @@ from .core.dispatch import def_op
 
 __all__ = [
     "fft", "ifft", "rfft", "irfft", "hfft", "ihfft",
-    "fft2", "ifft2", "rfft2", "irfft2",
+    "fft2", "ifft2", "rfft2", "irfft2", "hfft2", "ihfft2",
+    "hfftn", "ihfftn",
     "fftn", "ifftn", "rfftn", "irfftn",
     "fftshift", "ifftshift", "fftfreq", "rfftfreq",
 ]
@@ -81,3 +82,47 @@ def fftfreq(n, d=1.0, dtype=None):
 def rfftfreq(n, d=1.0, dtype=None):
     out = jnp.fft.rfftfreq(int(n), d=float(d))
     return out.astype(dtype) if dtype is not None else out
+
+
+def _mk_h2(name, base1d):
+    @def_op(name)
+    def op(x, s=None, axes=(-2, -1), norm="backward"):
+        axes = tuple(axes)
+        ns = [None] * len(axes) if s is None else list(s)
+        if base1d is jnp.fft.hfft:
+            # complex input: fft the leading axes, hermitian-fft last
+            out = x
+            for ax, n in zip(axes[:-1], ns[:-1]):
+                out = jnp.fft.fft(out, n=n, axis=int(ax), norm=str(norm))
+            return base1d(out, n=ns[-1], axis=int(axes[-1]),
+                          norm=str(norm))
+        # ihfft needs the REAL input on the last axis first, then the
+        # remaining axes get complex inverse ffts
+        out = base1d(x, n=ns[-1], axis=int(axes[-1]), norm=str(norm))
+        for ax, n in zip(axes[:-1], ns[:-1]):
+            out = jnp.fft.ifft(out, n=n, axis=int(ax), norm=str(norm))
+        return out
+    op.__name__ = name
+    op.__qualname__ = name
+    return op
+
+
+hfft2 = _mk_h2("hfft2", jnp.fft.hfft)
+ihfft2 = _mk_h2("ihfft2", jnp.fft.ihfft)
+
+
+def _hn_axes(x, s, axes):
+    if axes is not None:
+        return tuple(axes)
+    # numpy/reference semantics: with s given, the LAST len(s) axes
+    if s is not None:
+        return tuple(range(-len(tuple(s)), 0))
+    return tuple(range(-x.ndim, 0))
+
+
+def hfftn(x, s=None, axes=None, norm="backward", name=None):
+    return hfft2(x, s=s, axes=_hn_axes(x, s, axes), norm=norm)
+
+
+def ihfftn(x, s=None, axes=None, norm="backward", name=None):
+    return ihfft2(x, s=s, axes=_hn_axes(x, s, axes), norm=norm)

@@ -296,3 +296,110 @@ class DataLoader:
                     raise err[0]
                 return
             yield item
+
+
+class ChainDataset(IterableDataset):
+    """Chain iterable datasets end to end (reference: io/dataset.py
+    ChainDataset)."""
+
+    def __init__(self, datasets):
+        self.datasets = list(datasets)
+
+    def __iter__(self):
+        for ds in self.datasets:
+            yield from ds
+
+    def __len__(self):
+        # TypeError (not NotImplementedError) so list()/iteration
+        # protocols treat it as unsized
+        raise TypeError("ChainDataset has no len()")
+
+
+class ComposeDataset(Dataset):
+    """Zip map-style datasets field-wise (reference: io/dataset.py
+    ComposeDataset)."""
+
+    def __init__(self, datasets):
+        self.datasets = list(datasets)
+        lens = {len(d) for d in self.datasets}
+        if len(lens) != 1:
+            raise ValueError("ComposeDataset datasets must share length")
+
+    def __len__(self):
+        return len(self.datasets[0])
+
+    def __getitem__(self, idx):
+        out = []
+        for ds in self.datasets:
+            item = ds[idx]
+            out.extend(item if isinstance(item, (tuple, list)) else [item])
+        return tuple(out)
+
+
+class SubsetRandomSampler(Sampler):
+    """Random permutation over a fixed index subset (reference:
+    io/sampler.py SubsetRandomSampler)."""
+
+    def __init__(self, indices):
+        self.indices = list(indices)
+
+    def __iter__(self):
+        import numpy as _np
+
+        perm = _np.random.permutation(len(self.indices))
+        return iter([self.indices[i] for i in perm])
+
+    def __len__(self):
+        return len(self.indices)
+
+
+class WeightedRandomSampler(Sampler):
+    """Sample indices proportionally to weights (reference:
+    io/sampler.py WeightedRandomSampler)."""
+
+    def __init__(self, weights, num_samples, replacement=True):
+        import numpy as _np
+
+        self.weights = _np.asarray(weights,
+                                   dtype=_np.float64).reshape(-1)
+        if (self.weights < 0).any():
+            raise ValueError("weights must be non-negative")
+        if self.weights.sum() == 0:
+            raise ValueError("weights must not be all zero")
+        self.num_samples = int(num_samples)
+        self.replacement = bool(replacement)
+        if not self.replacement and self.num_samples > len(self.weights):
+            raise ValueError("num_samples > population without replacement")
+
+    def __iter__(self):
+        import numpy as _np
+
+        p = self.weights / self.weights.sum()
+        idx = _np.random.choice(len(p), size=self.num_samples,
+                                replace=self.replacement, p=p)
+        return iter(idx.tolist())
+
+    def __len__(self):
+        return self.num_samples
+
+
+def get_worker_info():
+    """(reference: io/dataloader/worker.py get_worker_info) — worker
+    context inside multiprocess DataLoader workers; None in the main
+    process."""
+    import os as _os
+
+    wid = _os.environ.get("PADDLE_TPU_WORKER_ID")
+    if wid is None:
+        return None
+
+    class _Info:
+        id = int(wid)
+        num_workers = int(_os.environ.get("PADDLE_TPU_NUM_WORKERS", 1))
+
+    return _Info()
+
+
+__all__ = __all__ + ["ChainDataset", "ComposeDataset",
+                     "SubsetRandomSampler", "WeightedRandomSampler",
+                     "get_worker_info"]

@@ -57,6 +57,9 @@ def _worker_main(ring_name: bytes, dataset, batches, collate_fn,
     h = lib.shmring_attach(ring_name)
     if not h:
         os._exit(1)
+    # worker context for paddle.io.get_worker_info() inside the fork
+    os.environ["PADDLE_TPU_WORKER_ID"] = str(worker_id)
+    os.environ["PADDLE_TPU_NUM_WORKERS"] = str(num_workers)
     try:
         if init_fn is not None:
             init_fn(worker_id)

@@ -1,4 +1,28 @@
 """paddle.linalg namespace (reference: python/paddle/linalg.py)."""
 from .ops.linalg import *  # noqa: F401,F403
-from .ops.linalg import __all__  # noqa: F401
+from .ops.linalg import __all__ as _ops_all
 from .ops.math import cross, dot, kron, norm, outer  # noqa: F401
+from .ops.api_tail import lu_unpack, pca_lowrank  # noqa: F401
+
+
+def vector_norm(x, p=2.0, axis=None, keepdim=False, name=None):
+    """(reference: tensor/linalg.py vector_norm) — always the
+    ELEMENTWISE vector norm: with axis=None the tensor flattens first
+    (ops.math.norm would route p=inf on 2-D into the matrix norm)."""
+    from .ops import math as _m
+
+    if axis is None and x.ndim > 1:
+        x = x.reshape([-1])
+    return _m.norm(x, p=float(p), axis=axis, keepdim=keepdim)
+
+
+def matrix_norm(x, p="fro", axis=(-2, -1), keepdim=False, name=None):
+    """(reference: tensor/linalg.py matrix_norm)."""
+    from .ops import math as _m
+
+    return _m.norm(x, p=p, axis=tuple(axis), keepdim=keepdim)
+
+
+__all__ = list(_ops_all) + ["cross", "dot", "kron", "norm", "outer",
+                            "lu_unpack", "pca_lowrank", "vector_norm",
+                            "matrix_norm"]

@@ -290,3 +290,100 @@ def test_review_regressions_tail2():
     g.geometric_(0.5)
     vals = _np(g)
     assert (np.abs(vals - np.round(vals)) > 1e-6).any()
+
+
+def test_namespace_tails():
+    # paddle.linalg must be the namespace module, not ops.linalg
+    assert "ops" not in paddle.linalg.__file__
+    assert float(paddle.linalg.vector_norm(
+        _t(np.array([3.0, 4.0], "float32"))).numpy()) == 5.0
+    m = _t(np.eye(3, dtype="float32") * 2)
+    assert abs(float(paddle.linalg.matrix_norm(m).numpy())
+               - np.sqrt(12)) < 1e-5
+    assert callable(paddle.linalg.lu_unpack)
+    assert callable(paddle.linalg.pca_lowrank)
+    assert paddle.amp.is_bfloat16_supported()
+    assert paddle.amp.is_float16_supported()
+    h = paddle.fft.hfft2(_t((np.random.rand(4, 8)
+                             + 1j * np.random.rand(4, 8))
+                            .astype("complex64")))
+    assert h.shape == [4, 14]
+    assert "complex" in str(paddle.fft.ihfft2(
+        _t(np.random.rand(4, 8).astype("float32"))).dtype)
+
+
+def test_io_tails():
+    from paddle_tpu.io import (ChainDataset, ComposeDataset,
+                               SubsetRandomSampler, TensorDataset,
+                               WeightedRandomSampler, get_worker_info)
+
+    d1 = TensorDataset([_t(np.arange(4))])
+    d2 = TensorDataset([_t(np.arange(4) * 10)])
+    comp = ComposeDataset([d1, d2])
+    assert len(comp) == 4 and len(comp[1]) == 2
+    assert sorted(list(SubsetRandomSampler([1, 3]))) == [1, 3]
+    assert list(WeightedRandomSampler([0.0, 1.0, 0.0], 5)) == [1] * 5
+    with pytest.raises(ValueError, match="non-negative"):
+        WeightedRandomSampler([-1.0, 1.0], 2)
+    assert get_worker_info() is None
+
+    class It(paddle.io.IterableDataset):
+        def __init__(self, n):
+            self.n = n
+
+        def __iter__(self):
+            return iter(range(self.n))
+
+    assert list(ChainDataset([It(2), It(3)])) == [0, 1, 0, 1, 2]
+
+
+def test_namespace_tail_regressions():
+    # hfft2 honors s on the leading axis too
+    x = _t((np.random.RandomState(0).rand(8, 8)
+            + 1j * np.random.RandomState(1).rand(8, 8))
+           .astype("complex64"))
+    out = paddle.fft.hfft2(x, s=(4, 6))
+    assert out.shape == [4, 6]
+    # hfftn infers the last len(s) axes
+    x3 = _t((np.random.rand(3, 8, 8) + 0j).astype("complex64"))
+    assert paddle.fft.hfftn(x3, s=(4, 6)).shape == [3, 4, 6]
+    # vector_norm inf on 2-D is max|x|, not the matrix norm
+    m = _t(np.array([[1.0, -5.0], [2.0, 3.0]], "float32"))
+    assert float(paddle.linalg.vector_norm(m, p=float("inf"))
+                 .numpy()) == 5.0
+    # new names exported via __all__
+    assert "vector_norm" in paddle.linalg.__all__
+    import paddle_tpu.io as io_mod
+
+    assert "WeightedRandomSampler" in io_mod.__all__
+    with pytest.raises(ValueError, match="all zero"):
+        io_mod.WeightedRandomSampler([0.0, 0.0], 2)
+
+
+def test_get_worker_info_in_workers():
+    """The shm multiprocess path must expose worker context."""
+    import paddle_tpu.io as io_mod
+    from paddle_tpu.io import shm_loader
+
+    if not shm_loader.available():
+        pytest.skip("native shm ring unavailable")
+
+    class DS(io_mod.Dataset):
+        def __getitem__(self, i):
+            return np.float32(i)
+
+        def __len__(self):
+            return 8
+
+    def collate(items):
+        info = io_mod.get_worker_info()
+        return (info.id if info else -1,
+                info.num_workers if info else -1,
+                np.stack(items))
+
+    seen = []
+    for wid, nw, batch in shm_loader.iter_multiprocess(
+            DS(), [[0, 1], [2, 3], [4, 5], [6, 7]], collate, 2):
+        seen.append((wid, nw))
+    assert all(nw == 2 for _, nw in seen)
+    assert {w for w, _ in seen} == {0, 1}
