@@ -36,3 +36,8 @@ __all__ = [
     "split_group", "sharding", "group_sharded_parallel",
     "save_group_sharded_model",
 ]
+
+# API tail (aliases, semi-auto helpers, gated PS-era entries)
+from .compat import *  # noqa: F401,F403,E402
+from . import launch  # noqa: F401,E402
+from . import checkpoint as io  # noqa: F401,E402  (paddle.distributed.io analog)

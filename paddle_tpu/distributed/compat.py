@@ -1,0 +1,388 @@
+"""Distributed API tail (reference: python/paddle/distributed/
+__init__.py exports without a previous counterpart — aliases, semi-auto
+helpers, enums, and gated PS-era entries).
+"""
+from __future__ import annotations
+
+from ..core.enforce import enforce
+
+__all__ = [
+    "alltoall", "alltoall_single", "gather", "scatter_object_list",
+    "destroy_process_group", "get_backend", "is_available",
+    "is_initialized", "wait", "split", "spawn",
+    "Strategy", "DistAttr", "ReduceType", "ParallelMode",
+    "ShardingStage1", "ShardingStage2", "ShardingStage3",
+    "DistModel", "to_static", "shard_optimizer", "shard_scaler",
+    "shard_dataloader", "unshard_dtensor",
+    "InMemoryDataset", "QueueDataset", "CountFilterEntry",
+    "ProbabilityEntry", "ShowClickEntry",
+    "load_state_dict", "save_state_dict", "gloo_init_parallel_env",
+    "gloo_barrier", "gloo_release",
+]
+
+
+# -- collective aliases ----------------------------------------------------
+def alltoall(out_tensor_list, in_tensor_list=None, group=None,
+             sync_op=True):
+    """(reference: communication/all_to_all.py alltoall). Matches the
+    reference's out/in list order; also accepts (in, out) omitted form
+    returning the list."""
+    from .collective import all_to_all
+
+    return all_to_all(out_tensor_list, in_tensor_list, group=group)
+
+
+def alltoall_single(out_tensor, in_tensor, in_split_sizes=None,
+                    out_split_sizes=None, group=None, sync_op=True):
+    """Single-tensor all_to_all: rows split across ranks (reference:
+    communication/all_to_all.py alltoall_single) — expressed over the
+    list form."""
+    from . import get_world_size
+    from ..ops.manipulation import concat, split as _split
+    from .collective import all_to_all
+
+    n = get_world_size()
+    enforce(out_split_sizes is None,
+            "uneven out_split_sizes are not supported here; pass None "
+            "(equal splits) or use alltoall with explicit tensors")
+    ins = _split(in_tensor, in_split_sizes
+                 if in_split_sizes is not None else n, axis=0)
+    outs = []
+    all_to_all(outs, list(ins), group=group)
+    result = concat(outs, axis=0)
+    if out_tensor is not None and hasattr(out_tensor, "_value"):
+        out_tensor._value = result._value
+        return out_tensor
+    return result
+
+
+def gather(tensor, gather_list=None, dst=0, group=None, sync_op=True):
+    """Gather tensors to dst (reference: communication/gather.py) —
+    built on all_gather; non-dst ranks receive nothing."""
+    from . import get_rank
+    from .collective import all_gather
+
+    out = []
+    all_gather(out, tensor, group=group)
+    if get_rank() == dst and gather_list is not None:
+        gather_list.extend(out)
+    return out if get_rank() == dst else None
+
+
+def scatter_object_list(out_object_list, in_object_list=None, src=0,
+                        group=None):
+    """(reference: communication/scatter.py scatter_object_list) over
+    the host object collectives."""
+    from . import get_rank
+    from .runtime import broadcast_object_host
+
+    objs = broadcast_object_host(
+        in_object_list if get_rank() == src else None, src=src)
+    from . import get_world_size
+
+    n = get_world_size()
+    enforce(objs is not None and len(objs) % n == 0,
+            lambda: f"scatter_object_list needs len(in_object_list) "
+                    f"({len(objs or [])}) divisible by world size ({n})")
+    per = len(objs) // n
+    chunk = objs[get_rank() * per:(get_rank() + 1) * per]
+    out_object_list.clear()
+    out_object_list.extend(chunk)
+
+
+def destroy_process_group(group=None):
+    """(reference: collective.py destroy_process_group) — XLA owns
+    communicators; host-side store state is released."""
+    from . import runtime
+
+    if hasattr(runtime, "shutdown"):
+        runtime.shutdown()
+
+
+def get_backend(group=None):
+    return "XLA"  # the ICI/DCN collectives are XLA HLOs
+
+
+def is_available():
+    return True
+
+
+def is_initialized():
+    from . import collective
+
+    return collective._world.default_group is not None
+
+
+def wait(tensor, group=None, use_calc_stream=True):
+    """(reference: collective.py wait) — XLA orders collectives by data
+    dependence; block the host until the value is ready."""
+    import jax
+
+    v = tensor._value if hasattr(tensor, "_value") else tensor
+    jax.block_until_ready(v)
+    return tensor
+
+
+def split(x, size, operation, axis=0, num_partitions=1, gather_out=True,
+          weight_attr=None, bias_attr=None, name=None):
+    """Model-parallel split of an embedding/linear operation
+    (reference: collective.py split -> mpu layers). Returns the
+    corresponding parallel layer applied to x."""
+    from .fleet.layers.mpu import (ColumnParallelLinear,
+                                   RowParallelLinear,
+                                   VocabParallelEmbedding)
+
+    enforce(operation in ("linear", "embedding"),
+            lambda: f"unsupported split operation {operation!r}")
+    if operation == "embedding":
+        layer = VocabParallelEmbedding(size[0], size[1],
+                                       weight_attr=weight_attr)
+        return layer(x)
+    if axis == 0:
+        layer = RowParallelLinear(size[0], size[1],
+                                  weight_attr=weight_attr,
+                                  has_bias=bias_attr is not False,
+                                  input_is_parallel=False)
+    else:
+        layer = ColumnParallelLinear(size[0], size[1],
+                                     weight_attr=weight_attr,
+                                     has_bias=bias_attr is not False,
+                                     gather_output=gather_out)
+    return layer(x)
+
+
+def spawn(func, args=(), nprocs=-1, join=True, daemon=False, **options):
+    """(reference: spawn.py) — fork nprocs processes running func(rank).
+    The single-controller SPMD engine usually replaces this; provided
+    for API parity with host-side workloads."""
+    import multiprocessing as mp
+    import os
+
+    if nprocs <= 0:
+        nprocs = int(os.environ.get("PADDLE_TPU_NPROCS", "1"))
+    # fork: closures need no pickling and children inherit the env
+    ctx = mp.get_context("fork")
+    procs = []
+    for rank in range(nprocs):
+        def runner(r=rank):
+            os.environ.update(PADDLE_TRAINER_ID=str(r),
+                              PADDLE_TRAINERS_NUM=str(nprocs))
+            func(*args)
+
+        p = ctx.Process(target=runner, daemon=daemon)
+        p.start()
+        procs.append(p)
+    if join:
+        for p in procs:
+            p.join()
+    return procs
+
+
+# -- host (gloo-analog) helpers -------------------------------------------
+def gloo_init_parallel_env(rank_id, rank_num, server_endpoint):
+    """(reference: parallel_with_gloo.py) — the TCPStore-backed host
+    collectives initialize through init_parallel_env here."""
+    from . import init_parallel_env
+
+    return init_parallel_env()
+
+
+def gloo_barrier():
+    from .runtime import host_barrier
+
+    return host_barrier()
+
+
+def gloo_release():
+    return destroy_process_group()
+
+
+# -- semi-auto helpers ------------------------------------------------------
+class ReduceType:
+    kRedSum = 0
+    kRedMax = 1
+    kRedMin = 2
+    kRedProd = 3
+    kRedAvg = 4
+    kRedAny = 5
+    kRedAll = 6
+
+
+class ParallelMode:
+    DATA_PARALLEL = 0
+    TENSOR_PARALLEL = 1
+    PIPELINE_PARALLEL = 2
+    SHARDING_PARALLEL = 3
+
+
+class ShardingStage1:
+    """Marker for Strategy.sharding (reference: auto_parallel/api.py
+    ShardingStage1)."""
+    stage = 1
+
+
+class ShardingStage2:
+    stage = 2
+
+
+class ShardingStage3:
+    stage = 3
+
+
+class DistAttr:
+    """(reference: DistAttr — mesh + dims_mapping pair)."""
+
+    def __init__(self, mesh=None, sharding_specs=None):
+        self.process_mesh = mesh
+        self.sharding_specs = sharding_specs
+
+
+class Strategy:
+    """Semi-auto training strategy (reference: auto_parallel/api.py
+    Strategy): knob container consumed by DistModel/to_static."""
+
+    def __init__(self, config=None):
+        config = config or {}
+        self.sharding = config.get("sharding")
+        self.fused_passes = config.get("fused_passes")
+        self.gradient_merge = config.get("gradient_merge")
+        self.pipeline = config.get("pipeline")
+
+
+class DistModel:
+    """(reference: auto_parallel/api.py DistModel — the to_static
+    result): wraps the auto-parallel Engine's compiled step behind
+    train()/eval()/predict() mode switches."""
+
+    def __init__(self, layer, loader, loss=None, optimizer=None,
+                 strategy=None):
+        from . import fleet as _fleet
+        from .auto_parallel.engine import Engine
+
+        if _fleet.get_hybrid_communicate_group() is None:
+            # default single-axis data-parallel mesh over all devices
+            _fleet.init(is_collective=True)
+        # adapt the reference's loss(out, label) contract to the
+        # Engine's loss_fn(model, batch): the LAST batch element is the
+        # label, the rest feed the model
+        engine_loss = None
+        if loss is not None:
+            def engine_loss(m, batch):
+                inputs = batch[:-1] if isinstance(batch, (tuple, list)) \
+                    else (batch,)
+                return loss(m(*inputs), batch[-1])
+        self._engine = Engine(layer, loss_fn=engine_loss,
+                              optimizer=optimizer)
+        self._layer = layer
+        self._loader = loader
+        self._mode = "train"
+
+    def train(self):
+        self._mode = "train"
+
+    def eval(self):
+        self._mode = "eval"
+
+    def predict(self):
+        self._mode = "predict"
+
+    def __call__(self, *inputs):
+        if self._mode == "train":
+            batch = inputs[0] if len(inputs) == 1 else tuple(inputs)
+            return self._engine.train_batch(batch)
+        from ..autograd import no_grad
+
+        with no_grad():
+            return self._layer(*inputs)
+
+
+def to_static(layer, loader=None, loss=None, optimizer=None,
+              strategy=None):
+    """(reference: auto_parallel/api.py to_static)."""
+    return DistModel(layer, loader, loss=loss, optimizer=optimizer,
+                     strategy=strategy)
+
+
+def shard_optimizer(optimizer, shard_fn=None):
+    """(reference: auto_parallel/api.py shard_optimizer) — with the
+    ParallelEngine, optimizer states shard via the engine's ZeRO plan;
+    this marks the optimizer for state sharding."""
+    optimizer._shard_states = True
+    return optimizer
+
+
+def shard_scaler(scaler):
+    """(reference: auto_parallel/api.py shard_scaler) — found_inf is
+    already pmax-synced inside the compiled engine step."""
+    return scaler
+
+
+def shard_dataloader(dataloader, meshes=None, shard_dims=None,
+                     is_dataset_splitted=False):
+    """(reference: auto_parallel/api.py shard_dataloader) — the single-
+    controller engine feeds global batches; per-mesh input sharding is
+    applied by the engine, so the loader passes through."""
+    return dataloader
+
+
+def unshard_dtensor(dist_tensor):
+    """Gather a sharded tensor to a replicated one (reference:
+    auto_parallel/api.py unshard_dtensor)."""
+    import jax
+
+    from ..tensor import Tensor
+
+    v = dist_tensor._value if isinstance(dist_tensor, Tensor) \
+        else dist_tensor
+    gathered = jax.device_get(v)
+    out = Tensor(gathered,
+                 stop_gradient=getattr(dist_tensor, "stop_gradient",
+                                       True))
+    out.dist_attr = None
+    return out
+
+
+# -- PS-era datasets (out of TPU scope; loud gates, reference:
+#    fleet/dataset/dataset.py InMemoryDataset/QueueDataset) -----------------
+def _ps_gate(name):
+    raise NotImplementedError(
+        f"{name} belongs to the brpc parameter-server data path, which "
+        f"is out of scope for the TPU framework (SURVEY §7); use "
+        f"paddle_tpu.io.DataLoader")
+
+
+class InMemoryDataset:
+    def __init__(self, *a, **k):
+        _ps_gate("InMemoryDataset")
+
+
+class QueueDataset:
+    def __init__(self, *a, **k):
+        _ps_gate("QueueDataset")
+
+
+class CountFilterEntry:
+    def __init__(self, *a, **k):
+        _ps_gate("CountFilterEntry")
+
+
+class ProbabilityEntry:
+    def __init__(self, *a, **k):
+        _ps_gate("ProbabilityEntry")
+
+
+class ShowClickEntry:
+    def __init__(self, *a, **k):
+        _ps_gate("ShowClickEntry")
+
+
+def load_state_dict(state_dict, path, **kw):
+    from .checkpoint.load_state_dict import load_state_dict as _load
+
+    return _load(state_dict, path, **kw)
+
+
+def save_state_dict(state_dict, path, **kw):
+    from .checkpoint.save_state_dict import save_state_dict as _save
+
+    return _save(state_dict, path, **kw)

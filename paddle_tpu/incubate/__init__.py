@@ -4,3 +4,59 @@ from . import distributed  # noqa: F401
 from . import nn  # noqa: F401
 
 __all__ = ["asp", "distributed", "nn"]
+
+# incubate API tail (reference: python/paddle/incubate/__init__.py)
+from ..geometric import (segment_max, segment_mean, segment_min,  # noqa: F401,E402
+                         segment_sum)
+from ..geometric import reindex_graph as graph_reindex  # noqa: F401,E402
+from ..geometric import sample_neighbors as graph_sample_neighbors  # noqa: F401,E402
+from ..geometric import send_u_recv as graph_send_recv  # noqa: F401,E402
+
+
+def identity_loss(x, reduction="none"):
+    """(reference: incubate/operators/identity_loss — marks a loss for
+    IPU pipelines; numerically identity with optional reduction)."""
+    if reduction in ("mean", 1):
+        return x.mean()
+    if reduction in ("sum", 0):
+        return x.sum()
+    return x
+
+
+def softmax_mask_fuse(x, mask, name=None):
+    """softmax(x + mask) fused (reference:
+    incubate/operators/softmax_mask_fuse.py — XLA fuses the add into
+    the softmax)."""
+    from ..nn import functional as F
+
+    return F.softmax(x + mask, axis=-1)
+
+
+def softmax_mask_fuse_upper_triangle(x):
+    """Causal-masked softmax (reference: softmax_mask_fuse_upper_
+    triangle.py): positions above the diagonal are masked out."""
+    from ..core.dispatch import def_op as _def_op
+
+    global _smfut
+    if "_smfut" not in globals():
+        import jax
+        import jax.numpy as jnp
+
+        def _kernel(x):
+            S = x.shape[-1]
+            keep = jnp.tril(jnp.ones((x.shape[-2], S), bool))
+            masked = jnp.where(keep, x, jnp.asarray(-1e30, x.dtype))
+            return jax.nn.softmax(masked, axis=-1)
+
+        _smfut = _def_op("fused_softmax_mask_upper_triangle")(_kernel)
+    return _smfut(x)
+
+
+from .optimizer import LookAhead, ModelAverage  # noqa: F401,E402
+
+__all__ = __all__ + [
+    "segment_sum", "segment_mean", "segment_min", "segment_max",
+    "graph_reindex", "graph_sample_neighbors", "graph_send_recv",
+    "identity_loss", "softmax_mask_fuse",
+    "softmax_mask_fuse_upper_triangle", "LookAhead", "ModelAverage",
+]

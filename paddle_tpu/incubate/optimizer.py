@@ -1,0 +1,93 @@
+"""Incubate optimizers (reference: python/paddle/incubate/optimizer/
+lookahead.py, modelaverage.py)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..optimizer import Optimizer
+
+
+class LookAhead(Optimizer):
+    """Lookahead wrapper (Zhang et al. 2019): every k inner steps, the
+    slow weights move alpha toward the fast weights and both sync
+    (reference: incubate/optimizer/lookahead.py)."""
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5, name=None):
+        self.inner_optimizer = inner_optimizer
+        self.alpha = float(alpha)
+        self.k = int(k)
+        self._step_num = 0
+        self._parameter_list = inner_optimizer._parameter_list
+        # slow weights snapshot the INITIAL fast weights (the first
+        # sync must pull back toward w0, matching the reference)
+        self._slow = {id(p): p._value
+                      for p in (self._parameter_list or [])
+                      if p is not None}
+
+    def step(self):
+        self.inner_optimizer.step()
+        self._step_num += 1
+        if self._step_num % self.k:
+            return
+        for p in self._parameter_list or []:
+            if p is None:
+                continue
+            slow = self._slow[id(p)]
+            slow = slow + self.alpha * (p._value - slow)
+            self._slow[id(p)] = slow
+            p._value = slow
+
+    def clear_grad(self):
+        self.inner_optimizer.clear_grad()
+
+    def minimize(self, loss, **kw):
+        loss.backward()
+        self.step()
+        self.clear_grad()
+        return None, None
+
+    def state_dict(self):
+        return {"inner": self.inner_optimizer.state_dict(),
+                "step_num": self._step_num}
+
+    def set_state_dict(self, sd):
+        self.inner_optimizer.set_state_dict(sd["inner"])
+        self._step_num = sd.get("step_num", 0)
+
+
+class ModelAverage(Optimizer):
+    """Running parameter average for evaluation (reference:
+    incubate/optimizer/modelaverage.py): accumulates weights each step;
+    apply() swaps the average in, restore() swaps back."""
+
+    def __init__(self, average_window_rate=0.15, parameters=None,
+                 min_average_window=10000, max_average_window=10000,
+                 name=None):
+        self._parameter_list = list(parameters) if parameters else []
+        self._sum = {id(p): jnp.zeros_like(p._value)
+                     for p in self._parameter_list}
+        self._num = 0
+        self._backup = None
+
+    def step(self):
+        for p in self._parameter_list:
+            self._sum[id(p)] = self._sum[id(p)] + p._value
+        self._num += 1
+
+    def clear_grad(self):
+        pass
+
+    def apply(self, executor=None, need_restore=True):
+        if not self._num:
+            return
+        self._backup = {id(p): p._value for p in self._parameter_list}
+        for p in self._parameter_list:
+            p._value = (self._sum[id(p)] / self._num).astype(
+                p._value.dtype)
+
+    def restore(self, executor=None):
+        if self._backup is None:
+            return
+        for p in self._parameter_list:
+            p._value = self._backup[id(p)]
+        self._backup = None

