@@ -67,15 +67,16 @@ def _np(x):
 # ---------------------------------------------------------------------------
 # NMS (host-side: kept-set size is data-dependent)
 # ---------------------------------------------------------------------------
-def _iou_matrix(b):
+def _iou_matrix(b, normalized=True):
+    off = 0.0 if normalized else 1.0  # pixel-coordinate +1 convention
     x1, y1, x2, y2 = b[:, 0], b[:, 1], b[:, 2], b[:, 3]
-    area = (x2 - x1) * (y2 - y1)
+    area = (x2 - x1 + off) * (y2 - y1 + off)
     ix1 = np.maximum(x1[:, None], x1[None, :])
     iy1 = np.maximum(y1[:, None], y1[None, :])
     ix2 = np.minimum(x2[:, None], x2[None, :])
     iy2 = np.minimum(y2[:, None], y2[None, :])
-    iw = np.clip(ix2 - ix1, 0, None)
-    ih = np.clip(iy2 - iy1, 0, None)
+    iw = np.clip(ix2 - ix1 + off, 0, None)
+    ih = np.clip(iy2 - iy1 + off, 0, None)
     inter = iw * ih
     return inter / np.maximum(area[:, None] + area[None, :] - inter, 1e-10)
 
@@ -660,3 +661,143 @@ class ConvNormActivation(nn.Sequential):
         if activation_layer is not None:
             layers.append(activation_layer())
         super().__init__(*layers)
+
+
+def matrix_nms(bboxes, scores, score_threshold, post_threshold,
+               nms_top_k, keep_top_k, use_gaussian=False,
+               gaussian_sigma=2.0, background_label=0, normalized=True,
+               return_index=False, return_rois_num=True, name=None):
+    """Matrix NMS (SOLOv2; reference: vision/ops.py:2236 over the phi
+    matrix_nms kernel). Decay-based soft suppression: each candidate's
+    score decays by the IoU with every higher-scored same-class box.
+    Host-side: kept count is data-dependent.
+
+    bboxes [N, M, 4], scores [N, C, M]. Returns Out [K, 6] rows of
+    (label, score, x1, y1, x2, y2) (+ index / rois_num like the
+    reference).
+    """
+    bx = _np(bboxes).astype(np.float64)
+    sc = _np(scores).astype(np.float64)
+    N, C, M = sc.shape
+    all_rows, all_idx, rois_num = [], [], []
+    for n in range(N):
+        rows, idxs = [], []
+        for c in range(C):
+            if c == background_label:
+                continue
+            s = sc[n, c]
+            keep = np.nonzero(s > score_threshold)[0]
+            if len(keep) == 0:
+                continue
+            order = keep[np.argsort(-s[keep], kind="stable")]
+            if nms_top_k > -1:
+                order = order[:nms_top_k]
+            b = bx[n, order]
+            sv = s[order].copy()
+            iou = _iou_matrix(b)
+            # decay[i] = min over higher-scored j of f(iou_ij)/f(max
+            # iou of j with anything above it)
+            K = len(order)
+            iou_u = np.triu(iou, 1)
+            # comp[i] = the SUPPRESSOR i's own max overlap with boxes
+            # scored above it (matrix-nms compensation term)
+            comp = iou_u.max(axis=0)
+            if use_gaussian:
+                # reference kernel (matrix_nms_kernel.cc:70):
+                # exp((comp^2 - iou^2) * sigma) — multiplied, not
+                # divided (deviates from the SOLOv2 paper's /sigma)
+                decay = np.exp((comp[:, None] ** 2 - iou_u ** 2)
+                               * gaussian_sigma)
+            else:
+                decay = (1 - iou_u) / np.maximum(1 - comp[:, None], 1e-10)
+            decay = np.where(iou_u > 0, decay, 1.0)
+            decay_min = decay.min(axis=0)
+            sv = sv * decay_min
+            ok = sv > post_threshold
+            for i in np.nonzero(ok)[0]:
+                rows.append([c, sv[i], *b[i]])
+                idxs.append(n * M + order[i])
+        if rows:
+            rows = np.asarray(rows, np.float32)
+            o = np.argsort(-rows[:, 1], kind="stable")
+            if keep_top_k > -1:
+                o = o[:keep_top_k]
+            rows = rows[o]
+            idxs = np.asarray(idxs, np.int64)[o]
+        else:
+            rows = np.zeros((0, 6), np.float32)
+            idxs = np.zeros((0,), np.int64)
+        all_rows.append(rows)
+        all_idx.append(idxs)
+        rois_num.append(len(rows))
+    out = to_tensor(np.concatenate(all_rows) if all_rows
+                    else np.zeros((0, 6), np.float32))
+    result = [out]
+    if return_index:
+        result.append(to_tensor(np.concatenate(all_idx)))
+    if return_rois_num:
+        result.append(to_tensor(np.asarray(rois_num, np.int32)))
+    return tuple(result) if len(result) > 1 else out
+
+
+def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, eta=1.0,
+                       pixel_offset=False, return_rois_num=False,
+                       name=None):
+    """RPN proposal generation (reference: vision/ops.py:2038 over the
+    phi generate_proposals kernel): decode deltas against anchors, clip
+    to the image, drop tiny boxes, NMS. Host-side like nms."""
+    sc = _np(scores).astype(np.float64)        # [N, A, H, W]
+    dl = _np(bbox_deltas).astype(np.float64)   # [N, 4A, H, W]
+    im = _np(img_size).astype(np.float64)      # [N, 2] (h, w)
+    an = _np(anchors).astype(np.float64).reshape(-1, 4)
+    var = _np(variances).astype(np.float64).reshape(-1, 4)
+    enforce(eta >= 1.0, "adaptive NMS (eta < 1) is not supported here")
+    N, A, H, W = sc.shape
+    off = 1.0 if pixel_offset else 0.0
+    outs, out_scores, nums = [], [], []
+    for n in range(N):
+        s = sc[n].transpose(1, 2, 0).reshape(-1)          # [H*W*A]
+        d = dl[n].reshape(A, 4, H, W).transpose(2, 3, 0, 1) \
+            .reshape(-1, 4)
+        order = np.argsort(-s, kind="stable")[:pre_nms_top_n]
+        s, d, a, v = s[order], d[order], an[order], var[order]
+        aw = a[:, 2] - a[:, 0] + off
+        ah = a[:, 3] - a[:, 1] + off
+        acx = a[:, 0] + aw * 0.5
+        acy = a[:, 1] + ah * 0.5
+        cx = v[:, 0] * d[:, 0] * aw + acx
+        cy = v[:, 1] * d[:, 1] * ah + acy
+        clip = np.log(1000.0 / 16.0)  # kBBoxClipDefault
+        w = np.exp(np.minimum(v[:, 2] * d[:, 2], clip)) * aw
+        h = np.exp(np.minimum(v[:, 3] * d[:, 3], clip)) * ah
+        boxes = np.stack([cx - w / 2, cy - h / 2,
+                          cx + w / 2 - off, cy + h / 2 - off], 1)
+        ih, iw = im[n]
+        boxes[:, 0::2] = boxes[:, 0::2].clip(0, iw - off)
+        boxes[:, 1::2] = boxes[:, 1::2].clip(0, ih - off)
+        ws = boxes[:, 2] - boxes[:, 0] + off
+        hs = boxes[:, 3] - boxes[:, 1] + off
+        msz = max(float(min_size), 1.0)  # reference clamps to >= 1
+        big = (ws >= msz) & (hs >= msz)
+        if pixel_offset:
+            # reference additionally requires the box CENTER in-image
+            cxs = boxes[:, 0] + ws / 2
+            cys = boxes[:, 1] + hs / 2
+            big &= (cxs <= iw) & (cys <= ih)
+        boxes, s = boxes[big], s[big]
+        keep = _nms_single(boxes, s, nms_thresh)[:post_nms_top_n]
+        outs.append(boxes[keep].astype(np.float32))
+        out_scores.append(s[keep].astype(np.float32))
+        nums.append(len(keep))
+    rois = to_tensor(np.concatenate(outs) if outs
+                     else np.zeros((0, 4), np.float32))
+    rois_scores = to_tensor(np.concatenate(out_scores) if out_scores
+                            else np.zeros((0,), np.float32))
+    if return_rois_num:
+        return rois, rois_scores, to_tensor(np.asarray(nums, np.int32))
+    return rois, rois_scores
+
+
+__all__ = __all__ + ["matrix_nms", "generate_proposals"]

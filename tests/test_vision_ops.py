@@ -265,3 +265,83 @@ def test_prior_box_min_max_order():
     np.testing.assert_allclose(d[0], c[0])
     np.testing.assert_allclose(d[2], c[1])  # max moved to slot 1
     np.testing.assert_allclose(d[1], c[2])
+
+
+def test_matrix_nms():
+    # two overlapping high-score boxes + one isolated: the overlapped
+    # second box decays below post_threshold, the isolated one survives
+    boxes = np.array([[[0, 0, 10, 10], [0.5, 0, 10, 10],
+                       [50, 50, 60, 60]]], "float32")
+    scores = np.zeros((1, 2, 3), "float32")
+    scores[0, 1] = [0.9, 0.85, 0.8]
+    out, idx, num = V.matrix_nms(paddle.to_tensor(boxes),
+                                 paddle.to_tensor(scores),
+                                 score_threshold=0.1, post_threshold=0.5,
+                                 nms_top_k=-1, keep_top_k=-1,
+                                 return_index=True)
+    o = np.asarray(out._value)
+    assert int(np.asarray(num._value)[0]) == o.shape[0]
+    kept_scores = o[:, 1]
+    assert 0.9 in np.round(kept_scores, 4)        # top box undecayed
+    assert (kept_scores > 0.5).all()
+    # the heavily-overlapped 0.85 box must have decayed away
+    assert not np.isclose(kept_scores, 0.85).any()
+
+
+def test_generate_proposals():
+    N, A, H, W = 1, 2, 4, 4
+    rng2 = np.random.RandomState(0)
+    scores = rng2.rand(N, A, H, W).astype("float32")
+    deltas = (rng2.rand(N, 4 * A, H, W).astype("float32") - 0.5) * 0.1
+    # simple anchor grid
+    anchors = np.zeros((H, W, A, 4), "float32")
+    for y in range(H):
+        for x in range(W):
+            anchors[y, x, 0] = [x * 8, y * 8, x * 8 + 16, y * 8 + 16]
+            anchors[y, x, 1] = [x * 8, y * 8, x * 8 + 24, y * 8 + 24]
+    variances = np.ones_like(anchors)
+    rois, roi_scores, num = V.generate_proposals(
+        paddle.to_tensor(scores), paddle.to_tensor(deltas),
+        paddle.to_tensor(np.array([[32, 32]], "float32")),
+        paddle.to_tensor(anchors), paddle.to_tensor(variances),
+        pre_nms_top_n=20, post_nms_top_n=5, nms_thresh=0.5,
+        min_size=1.0, return_rois_num=True)
+    r = np.asarray(rois._value)
+    assert r.shape[1] == 4 and r.shape[0] <= 5
+    assert int(np.asarray(num._value)[0]) == r.shape[0]
+    assert (r[:, 0] >= 0).all() and (r[:, 2] <= 32).all()
+
+
+def test_matrix_nms_gaussian_matches_reference_formula():
+    # duplicate box under gaussian decay must suppress per the
+    # kernel's exp((comp^2 - iou^2) * sigma) (MULTIPLIED by sigma)
+    boxes = np.array([[[0, 0, 10, 10], [0, 0, 10, 10.5]]], "float32")
+    scores = np.zeros((1, 2, 2), "float32")
+    scores[0, 1] = [0.9, 0.85]
+    out = V.matrix_nms(paddle.to_tensor(boxes), paddle.to_tensor(scores),
+                       0.1, post_threshold=0.0, nms_top_k=-1,
+                       keep_top_k=-1, use_gaussian=True,
+                       gaussian_sigma=2.0, return_rois_num=False)
+    o = np.asarray(out._value)
+    iou = 10.0 / 10.5
+    expect = 0.85 * np.exp(-(iou ** 2) * 2.0)
+    assert np.isclose(o[:, 1], expect, rtol=1e-3).any()
+
+
+def test_generate_proposals_returns_real_scores():
+    N, A, H, W = 1, 1, 2, 2
+    scores = np.array([[[[0.9, 0.1], [0.2, 0.8]]]], "float32")
+    deltas = np.zeros((N, 4, H, W), "float32")
+    anchors = np.zeros((H, W, A, 4), "float32")
+    for y in range(H):
+        for x in range(W):
+            anchors[y, x, 0] = [x * 16, y * 16, x * 16 + 15, y * 16 + 15]
+    rois, roi_scores = V.generate_proposals(
+        paddle.to_tensor(scores), paddle.to_tensor(deltas),
+        paddle.to_tensor(np.array([[32, 32]], "float32")),
+        paddle.to_tensor(anchors),
+        paddle.to_tensor(np.ones_like(anchors)),
+        nms_thresh=0.5, min_size=1.0)
+    rs = np.asarray(roi_scores._value)
+    assert rs.max() > 0.89  # real scores, not zeros
+    assert (np.sort(rs)[::-1] == rs).all()  # sorted by NMS order
