@@ -373,11 +373,57 @@ def bench_gpt(on_tpu, dev):
 
 _BENCHES = {}
 
+# Per-bench subprocess timeouts. gpt (the headline) gets the largest
+# budget; everything else is short so a single hang can't eat the
+# driver's budget (the round-4 blackout: kernel_parity first + 1200s
+# each + headline printed last = one hang, zero lines).
+_TIMEOUTS = {"gpt": 900, "llama_decode": 420, "llama_decode_int8": 420,
+             "resnet": 300, "moe": 300, "kernel_parity": 240}
+_ORDER = ("gpt", "llama_decode", "llama_decode_int8", "resnet", "moe",
+          "kernel_parity")
 
-def _run_one(name):
+
+def _run_one(name, deadline_s=None):
+    import os
     import traceback
 
-    import jax
+    # The watchdog must be armed BEFORE any jax backend init: when the
+    # axon tunnel is down, jax.devices() blocks forever in C code, and
+    # only os._exit from another thread (or a parent kill) escapes.
+    # Direct `--only` runs (bench_experiments.py) get the same bound.
+    deadline_s = deadline_s or _TIMEOUTS.get(name, 600)
+    if deadline_s > 0:
+        import faulthandler
+        import threading
+
+        # Stack dump (to stderr; the parent re-prints stderr on
+        # failure) fires BEFORE _die so the hang location is captured,
+        # then _die emits the machine-readable line and exits.
+        faulthandler.dump_traceback_later(max(deadline_s - 30, 3),
+                                          exit=False)
+
+        def _die():
+            _emit({"metric": f"bench_{name}", "value": 0.0,
+                   "unit": "error", "vs_baseline": 0.0,
+                   "error": f"watchdog: exceeded {deadline_s - 15}s "
+                            "(stack on stderr)"})
+            os._exit(3)
+
+        t = threading.Timer(max(deadline_s - 15, 5), _die)
+        t.daemon = True
+        t.start()
+
+    if os.environ.get("BENCH_FORCE_CPU"):
+        # The sitecustomize force-selects the hanging 'axon' platform via
+        # jax.config, so the env var JAX_PLATFORMS alone is NOT enough
+        # (tests/conftest.py has the same note) - update jax.config
+        # before any backend initialises.
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    else:
+        import jax
 
     dev = jax.devices()[0]
     on_tpu = _chip(dev)[0] > 0
@@ -395,28 +441,106 @@ def bench_llama_decode_int8(on_tpu, dev):
     bench_llama_decode(on_tpu, dev, weight_only=True)
 
 
+_PROBE_SRC = """
+import jax, jax.numpy as jnp
+x = jnp.ones((128, 128), jnp.bfloat16)
+y = (x @ x).block_until_ready()
+d = jax.devices()[0]
+print("CHIP_OK", float(jnp.asarray(y, jnp.float32)[0, 0]),
+      getattr(d, "device_kind", d.platform), flush=True)
+"""
+
+
+def _probe_chip():
+    """Decide on_tpu WITHOUT touching jax in this process.
+
+    Root cause of the round-4 bench blackout: when the axon TPU tunnel
+    is down, PJRT client creation (make_c_api_client) blocks forever in
+    C code - jax.devices() itself hangs, before any bench logic runs.
+    Only a killable subprocess can probe safely. One 45s try, one 120s
+    retry (first client creation can be slow), else fall back to CPU so
+    every bench still emits its smoke line.
+    """
+    import subprocess
+
+    for tmo in (45, 120):
+        t0 = time.perf_counter()
+        try:
+            r = subprocess.run([sys.executable, "-c", _PROBE_SRC],
+                               capture_output=True, text=True, timeout=tmo)
+        except subprocess.TimeoutExpired:
+            continue
+        if "CHIP_OK" in (r.stdout or ""):
+            kind = r.stdout.split("CHIP_OK", 1)[1].split()[1:]
+            _emit({"metric": "chip_probe", "value": 1.0, "unit": "ok",
+                   "vs_baseline": 1.0, "probe_s": round(
+                       time.perf_counter() - t0, 1),
+                   "device": " ".join(kind)})
+            return True
+    _emit({"metric": "chip_probe", "value": 0.0, "unit": "ok",
+           "vs_baseline": 0.0,
+           "error": "TPU client creation hung/failed twice; "
+                    "benches fall back to CPU smoke configs"})
+    return False
+
+
 def main(argv):
     _BENCHES.update(resnet=bench_resnet, moe=bench_moe,
                     llama_decode=bench_llama_decode, gpt=bench_gpt,
                     kernel_parity=bench_kernel_parity,
                     llama_decode_int8=bench_llama_decode_int8)
     if len(argv) > 1 and argv[1] == "--only":
-        _run_one(argv[2])
+        dl = int(argv[3]) if len(argv) > 3 else None
+        _run_one(argv[2], dl)
         return
-    # each bench runs in its OWN process: TPU HBM is only reliably
+    # Each bench runs in its OWN process: TPU HBM is only reliably
     # released at process exit (compiled executables pin buffers), and
-    # the 7B decode + 1.3B train benches each need most of a v5e chip
+    # the 7B decode + 1.3B train benches each need most of a v5e chip.
+    # The parent NEVER imports jax (see _probe_chip).
+    import os
     import subprocess
 
-    for name in ("kernel_parity", "resnet", "moe", "llama_decode",
-                 "llama_decode_int8", "gpt"):
+    on_tpu = _probe_chip()
+    env = dict(os.environ)
+    if not on_tpu:
+        env["BENCH_FORCE_CPU"] = "1"
+
+    headline_lines = []
+    for name in _ORDER:
+        tmo = _TIMEOUTS[name]
+        out, err, synth = "", "", None
         try:
-            subprocess.run([sys.executable, __file__, "--only", name],
-                           timeout=1200)
+            r = subprocess.run(
+                [sys.executable, __file__, "--only", name, str(tmo)],
+                capture_output=True, text=True, timeout=tmo, env=env)
+            out, err = r.stdout or "", r.stderr or ""
+        except subprocess.TimeoutExpired as e:
+            def _s(x):
+                return (x.decode() if isinstance(x, bytes) else x) or ""
+            out, err = _s(e.stdout), _s(e.stderr)
+            synth = {"metric": f"bench_{name}", "value": 0.0,
+                     "unit": "error", "vs_baseline": 0.0,
+                     "error": f"timeout after {tmo}s (parent kill)"}
         except Exception as e:  # a hung bench must not drop later lines
-            _emit({"metric": f"bench_{name}", "value": 0.0, "unit": "error",
-                   "vs_baseline": 0.0,
-                   "error": f"{type(e).__name__}: {e}"})
+            synth = {"metric": f"bench_{name}", "value": 0.0,
+                     "unit": "error", "vs_baseline": 0.0,
+                     "error": f"{type(e).__name__}: {e}"}
+        if synth is not None:
+            _emit(synth)
+        if out:
+            print(out, end="" if out.endswith("\n") else "\n", flush=True)
+        if err.strip():  # watchdog stack dumps / crash tracebacks
+            sys.stderr.write(err[-4000:])
+            sys.stderr.flush()
+        if name == "gpt":
+            headline_lines = [ln for ln in out.splitlines()
+                              if '"metric"' in ln]
+            if not headline_lines and synth is not None:
+                headline_lines = [json.dumps(synth)]
+    # The headline runs FIRST (so a later hang can't kill it) but
+    # single-line parsers take the LAST line - re-emit it at the end.
+    for ln in headline_lines:
+        print(ln, flush=True)
 
 
 if __name__ == "__main__":
