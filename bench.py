@@ -391,7 +391,8 @@ def _run_one(name, deadline_s=None):
     # axon tunnel is down, jax.devices() blocks forever in C code, and
     # only os._exit from another thread (or a parent kill) escapes.
     # Direct `--only` runs (bench_experiments.py) get the same bound.
-    deadline_s = deadline_s or _TIMEOUTS.get(name, 600)
+    if deadline_s is None:  # explicit 0 disables the watchdog
+        deadline_s = _TIMEOUTS.get(name, 600)
     if deadline_s > 0:
         import faulthandler
         import threading

@@ -261,7 +261,12 @@ def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
     through the scan (the reference dynloads warp-ctc CUDA:
     paddle/phi/kernels/gpu/warpctc_kernel.cu).
 
-    log_probs: [T, B, C] log-softmax outputs; labels: [B, L] padded.
+    log_probs: [T, B, C] unscaled logits ("unscaled probability
+    sequence", the reference warpctc contract — it integrates a native
+    softmax); labels: [B, L] padded. A log_softmax is applied inside the
+    kernel, so already-normalized log-probabilities (the torch
+    convention) are ALSO accepted unchanged: log_softmax is exactly
+    idempotent on them (logsumexp of log-probs is 0).
     """
     from ..core.dispatch import def_op as _def_op
 
@@ -274,6 +279,11 @@ def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
 
         def _kernel(log_probs, labels, input_lengths, label_lengths,
                     blank):
+            import jax
+
+            # Reference contract: inputs are unscaled logits (warp-ctc
+            # integrates the softmax). No-op for normalized log-probs.
+            log_probs = jax.nn.log_softmax(log_probs, axis=-1)
             T, B, C = log_probs.shape
             L = labels.shape[1]
             S = 2 * L + 1

@@ -110,12 +110,7 @@ def signbit(x):
 
 
 @def_op("take")
-def take(x, index, mode="raise"):
-    """Flat-index gather (reference: tensor/math.py take): 'raise'
-    wraps negatives python-style, 'wrap' is modular, 'clip' clamps to
-    [0, n-1] (negatives go to 0, numpy semantics)."""
-    enforce(mode in ("raise", "wrap", "clip"),
-            lambda: f"take mode must be raise/wrap/clip, got {mode!r}")
+def _take_op(x, index, mode="raise"):
     flat = x.reshape(-1)
     n = flat.shape[0]
     idx = index.astype(jnp.int32)
@@ -123,11 +118,38 @@ def take(x, index, mode="raise"):
         idx = idx % n
     elif mode == "clip":
         idx = jnp.clip(idx, 0, n - 1)
-    else:  # 'raise': python-style negatives; cannot raise inside a
-        # traced program, so out-of-range clamps (documented)
+    else:  # 'raise': python-style negatives; under tracing a raise is
+        # impossible, so out-of-range clamps (validated eagerly below)
         idx = jnp.where(idx < 0, idx + n, idx)
         idx = jnp.clip(idx, 0, n - 1)
     return flat[idx]
+
+
+def take(x, index, mode="raise", name=None):
+    """Flat-index gather (reference: tensor/math.py take): 'raise'
+    errors on out-of-range (negatives python-style), 'wrap' is modular,
+    'clip' clamps to [0, n-1] (negatives go to 0, numpy semantics).
+
+    The 'raise' bounds check runs HERE, pre-dispatch: the kernel body
+    executes under vjp tracing even eagerly, where values are abstract
+    and a data-dependent raise is impossible.
+    """
+    enforce(mode in ("raise", "wrap", "clip"),
+            lambda: f"take mode must be raise/wrap/clip, got {mode!r}")
+    if mode == "raise":
+        xs = x.shape if not isinstance(x, Tensor) else x._value.shape
+        n = 1
+        for s in xs:
+            n *= int(s)
+        iv = index._value if isinstance(index, Tensor) else index
+        import jax
+
+        if not isinstance(iv, jax.core.Tracer):
+            ia = np.asarray(iv)
+            enforce(not bool(((ia < -n) | (ia >= n)).any()),
+                    lambda: "take(mode='raise'): index out of range "
+                            f"for tensor of {n} elements")
+    return _take_op(x, index, mode)
 
 
 @def_op("tensordot")
@@ -622,14 +644,24 @@ def pca_lowrank(x, q=None, center=True, niter=2, name=None):
     return Tensor(u), Tensor(s), Tensor(vT.swapaxes(-1, -2))
 
 
+def _random_fill(x, val):
+    """Route the in-place random fills through the foo_ contract: the
+    fresh value has NO producer, so the stale _grad_node/_out_idx from a
+    previous tracked op must be cleared (an autograd consistency bug
+    otherwise: backward through x would use the old producer with the
+    new value)."""
+    from ..tensor import Tensor, inplace_swap
+
+    return inplace_swap(x, Tensor(val.astype(x._value.dtype)))
+
+
 def normal_(x, mean=0.0, std=1.0, name=None):
     import jax as _jax
 
     from ..core import rng as _rng
 
-    x._value = (mean + std * _jax.random.normal(
-        _rng.get_key(), tuple(x.shape))).astype(x._value.dtype)
-    return x
+    return _random_fill(x, mean + std * _jax.random.normal(
+        _rng.get_key(), tuple(x.shape)))
 
 
 def uniform_(x, min=-1.0, max=1.0, seed=0, name=None):  # noqa: A002
@@ -637,10 +669,8 @@ def uniform_(x, min=-1.0, max=1.0, seed=0, name=None):  # noqa: A002
 
     from ..core import rng as _rng
 
-    x._value = _jax.random.uniform(
-        _rng.get_key(), tuple(x.shape), minval=min,
-        maxval=max).astype(x._value.dtype)
-    return x
+    return _random_fill(x, _jax.random.uniform(
+        _rng.get_key(), tuple(x.shape), minval=min, maxval=max))
 
 
 def cauchy_(x, loc=0.0, scale=1.0, name=None):
@@ -648,9 +678,8 @@ def cauchy_(x, loc=0.0, scale=1.0, name=None):
 
     from ..core import rng as _rng
 
-    x._value = (loc + scale * _jax.random.cauchy(
-        _rng.get_key(), tuple(x.shape))).astype(x._value.dtype)
-    return x
+    return _random_fill(x, loc + scale * _jax.random.cauchy(
+        _rng.get_key(), tuple(x.shape)))
 
 
 def geometric_(x, probs, name=None):
@@ -661,8 +690,7 @@ def geometric_(x, probs, name=None):
     # reference geometric_ (creation.py:2911) fills the CONTINUOUS
     # value log(u)/log1p(-p) without flooring
     u = _jax.random.uniform(_rng.get_key(), tuple(x.shape), minval=1e-20)
-    x._value = (jnp.log(u) / jnp.log1p(-probs)).astype(x._value.dtype)
-    return x
+    return _random_fill(x, jnp.log(u) / jnp.log1p(-probs))
 
 
 __all__ = list(__all__) + [

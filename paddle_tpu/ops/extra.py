@@ -73,8 +73,17 @@ def masked_select(x, mask, name=None):
 @def_op("fill_diagonal")
 def fill_diagonal(x, value, offset=0, wrap=False):
     enforce(x.ndim == 2, lambda: "fill_diagonal expects a 2-D tensor")
-    eye = jnp.eye(x.shape[0], x.shape[1], k=int(offset), dtype=bool)
-    return jnp.where(eye, jnp.asarray(value, x.dtype), x)
+    R, C = x.shape
+    if wrap and R > C:
+        # numpy/reference wrap semantics: the filled flat indices are
+        # offset + k*(C+1), i.e. (row*C + col) = offset mod C+1, which
+        # with C = -1 mod C+1 reduces to col = (row + offset) mod C+1
+        rows = jnp.arange(R)[:, None]
+        cols = jnp.arange(C)[None, :]
+        mask = (rows + int(offset)) % (C + 1) == cols
+    else:
+        mask = jnp.eye(R, C, k=int(offset), dtype=bool)
+    return jnp.where(mask, jnp.asarray(value, x.dtype), x)
 
 
 @def_op("fill_diagonal_tensor")
@@ -492,10 +501,11 @@ def binomial(count, prob, name=None):
 def exponential_(x, lam=1.0, name=None):
     """In-place exponential fill (reference: exponential_ inplace op) —
     functional value-swap here (immutable arrays)."""
+    from .api_tail import _random_fill
+
     key = rng.get_key()
-    val = jax.random.exponential(key, tuple(x.shape)) / float(lam)
-    x._value = val.astype(x._value.dtype)
-    return x
+    return _random_fill(
+        x, jax.random.exponential(key, tuple(x.shape)) / float(lam))
 
 
 # ---------------------------------------------------------------------------

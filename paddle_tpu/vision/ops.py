@@ -444,10 +444,12 @@ def yolo_box(x, img_size, anchors, class_num, conf_thresh,
     boxes = jnp.stack([x1, y1, x2, y2], axis=-1)
     boxes = jnp.where(keep[..., None], boxes, 0.0)
     score = jnp.where(keep[:, :, None], score, 0.0)
-    # both flatten in (h, w, anchor) order so row i of boxes matches
-    # row i of scores
-    boxes = boxes.transpose(0, 2, 3, 1, 4).reshape(N, -1, 4)
-    score = score.transpose(0, 3, 4, 1, 2).reshape(N, -1, class_num)
+    # both flatten anchor-major, i.e. (anchor, h, w) row order — the
+    # reference kernel's box_idx = ((i*box_num + j)*stride + k*w + l)
+    # with j=anchor — so row i here pairs with the reference's row i
+    # (index-based consumers, exported postprocessing)
+    boxes = boxes.reshape(N, -1, 4)                       # [N,na,H,W,4]
+    score = score.transpose(0, 1, 3, 4, 2).reshape(N, -1, class_num)
     return boxes, score
 
 

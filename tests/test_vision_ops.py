@@ -226,7 +226,9 @@ def test_yolo_box_score_alignment():
     s = np.asarray(scores._value)[0]
     b = np.asarray(boxes._value)[0]
     row = int(s.max(axis=1).argmax())
-    assert row == (1 * W + 0) * na + a   # (h, w, anchor) flattening
+    # anchor-major (anchor, h, w) flattening — the reference kernel's
+    # box_idx = ((i*box_num + j)*stride + k*w + l) row order
+    assert row == (a * H + 1) * W + 0
     assert s[row].argmax() == 2
     assert np.abs(b[row]).sum() > 0      # the box row is the live one
     dead = np.delete(np.arange(H * W * na), row)
