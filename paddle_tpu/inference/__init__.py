@@ -19,9 +19,17 @@ Serving design (the fused_multi_transformer decode loop, XLA style):
     garbage cache rows past the longest true length are never attended —
     decode masks by absolute position — and are overwritten as decoding
     advances); last-token logits are gathered at each row's true length.
-    Ragged batches decode in lockstep, so multi-token generation
-    requires equal lengths (ragged rows support first-token scoring
-    only; per-row-offset continuous batching is future work).
+  * RAGGED batches decode at PER-ROW offsets: each row's rope
+    positions, cache-write slot, and attention frontier advance from
+    its own true length, with optional per-row EOS stopping
+    (GenerationConfig.eos_token_id) — the continuous-batching
+    decode semantics of the reference's block_multi_head_attention.
+  * PAGED KV (Config.enable_paged_kv): physical [page, D] pages in a
+    shared pool + per-row block tables; pages are allocated per row
+    for len+new tokens only, so a ragged batch pays sum(len_i), not
+    B*max_len, of HBM (reference: phi/kernels/fusion/gpu/
+    block_multi_head_attention_kernel.cu — there CUDA threads chase
+    the table; here the Pallas BlockSpec index map does).
   * DECODE: the WHOLE token loop is ONE compiled XLA program — a
     ``lax.scan`` over steps carrying (token, caches, rng) with donated
     cache buffers, sampling (greedy/temperature/top-k/top-p) fused in.
@@ -80,6 +88,8 @@ class GenerationConfig:
     top_k: int = 0                 # 0 = off
     top_p: float = 1.0             # 1 = off
     seed: int = 0
+    eos_token_id: Optional[int] = None  # per-row stop; post-EOS tokens
+    #                                     are filled with eos_token_id
 
 
 class Config:
@@ -105,6 +115,7 @@ class Config:
         self._ir_optim = True
         self._weight_only_algo: Optional[str] = None
         self._weight_only_skip = ("lm_head",)
+        self._kv_page_size: Optional[int] = None
 
     # -- model sources --------------------------------------------------
     def set_model(self, model) -> "Config":
@@ -135,6 +146,19 @@ class Config:
                 f"llm_int8_linear, not a serving swap)")
         self._weight_only_algo = algo
         self._weight_only_skip = tuple(skip)
+        return self
+
+    def enable_paged_kv(self, page_size: int = 64) -> "Config":
+        """Serve with a paged (block-table) KV cache (reference:
+        block_multi_head_attention / enable_block_attn): physical pages
+        are allocated per row for ceil((len+new)/page) tokens instead of
+        B*max_len rows, so ragged batches don't pay max-length HBM. The
+        attention is the block-table Pallas kernel on TPU
+        (ops/pallas/decode_attention.py paged_decode_attention)."""
+        if page_size < 8 or page_size % 8:
+            raise ValueError("page_size must be a multiple of 8 (TPU "
+                             f"sublane tiling), got {page_size}")
+        self._kv_page_size = int(page_size)
         return self
 
     # -- reference-compat knobs (XLA owns these; kept as recorded flags)
@@ -244,7 +268,7 @@ class Predictor:
         return min(cap, _bucket(need)) if cap else _bucket(need)
 
     def _prefill_fn(self, B, Sb, M):
-        key = (B, Sb, M)
+        key = (B, Sb, M, self.config._kv_page_size)
         if key in self._prefill_fns:
             return self._prefill_fns[key]
         model, params = self._model, self._params
@@ -265,17 +289,23 @@ class Predictor:
         self._prefill_fns[key] = jax.jit(prefill, donate_argnums=(2,))
         return self._prefill_fns[key]
 
-    def _decode_fn(self, B, M, n_new, gen: GenerationConfig):
-        key = (B, M, n_new, gen.temperature, gen.top_k, gen.top_p)
+    def _decode_fn(self, B, M, n_new, gen: GenerationConfig, ragged,
+                   paged):
+        key = (B, M, n_new, gen.temperature, gen.top_k, gen.top_p,
+               gen.eos_token_id, ragged, paged)
         if key in self._decode_fns:
             return self._decode_fns[key]
         model, params = self._model, self._params
+        eos = gen.eos_token_id
         from ..autograd import no_grad
         from ..distributed.engine import bind_params
 
         def decode(pvals, tok0, caches, pos0, rng):
+            done0 = (tok0 == eos) if eos is not None \
+                else jnp.zeros((B,), bool)
+
             def body(carry, _):
-                tok, caches, pos, rng = carry
+                tok, caches, pos, rng, done = carry
                 with no_grad(), bind_params(params, pvals):
                     logits, caches = model.forward(
                         Tensor(tok[:, None], stop_gradient=True),
@@ -284,21 +314,53 @@ class Predictor:
                       else logits)
                 rng, sub = jax.random.split(rng)
                 nxt = _sample(lv[:, -1], sub, gen)
-                return (nxt, caches, pos + 1, rng), nxt
+                if eos is not None:  # per-row stop: freeze at eos
+                    nxt = jnp.where(done, jnp.asarray(eos, nxt.dtype),
+                                    nxt)
+                    done = done | (nxt == eos)
+                return (nxt, caches, pos + 1, rng, done), nxt
 
-            (tok, caches, _, _), toks = lax.scan(
-                body, (tok0, caches, pos0, rng), None, length=n_new)
+            (tok, caches, _, _, _), toks = lax.scan(
+                body, (tok0, caches, pos0, rng, done0), None,
+                length=n_new)
             return jnp.swapaxes(toks, 0, 1), caches  # [B, n_new]
 
         self._decode_fns[key] = jax.jit(decode, donate_argnums=(2,))
         return self._decode_fns[key]
 
+    # -- paged KV-cache pool (reference: block_multi_head_attention's
+    #    block tables; here a host-side bump allocator + trash page) ---
+    def _paged_caches(self, lengths, n_new, M, page, dtype):
+        """Allocate per-row physical pages for len+n_new tokens. Logical
+        pages a row does not own map to one shared TRASH page, so
+        prefill's right-pad writes land harmlessly (they are never
+        attended: the mask stops at each row's frontier)."""
+        cfg = self._model.config
+        B = len(lengths)
+        npages = -(-M // page)
+        need = [-(-(int(l) + n_new) // page) for l in lengths]
+        P = sum(need) + 1                     # +1 trash page (id P-1)
+        trash = P - 1
+        table = np.full((B, npages), trash, np.int32)
+        nxt = 0
+        for b, nb in enumerate(need):
+            table[b, :nb] = np.arange(nxt, nxt + nb)
+            nxt += nb
+        shape = (P, cfg.num_kv_heads, page, cfg.head_dim)
+        # one table copy per layer: the cache pytree is DONATED to the
+        # compiled step, and XLA rejects donating one buffer twice
+        return [(jnp.zeros(shape, dtype), jnp.zeros(shape, dtype),
+                 jnp.asarray(table))
+                for _ in range(cfg.num_layers)], P
+
     def generate(self, input_ids, max_new_tokens: Optional[int] = None,
                  lengths=None, **overrides):
         """Batched generation; one compiled prefill + ONE compiled
         decode program for the whole token loop. ``lengths`` gives the
-        true per-row prompt lengths for right-padded ragged batches
-        (rows decode in lockstep from max(lengths); see module doc)."""
+        true per-row prompt lengths for right-padded ragged batches;
+        ragged rows decode at per-row offsets (own rope positions,
+        cache slots, and attention frontier), stopping per row at
+        ``eos_token_id`` when set (later slots filled with eos)."""
         gen = GenerationConfig(**{
             **self.config.generation.__dict__,
             **({"max_new_tokens": max_new_tokens}
@@ -315,16 +377,7 @@ class Predictor:
         # bucket never past the cache: a 90-token prompt with
         # max_length=100 must prefill at Sb=100, not bucket 128
         Sb = min(_bucket(S0), M)
-        if n_new > 1 and int(lengths.min()) != int(lengths.max()):
-            # decode runs all rows in lockstep from max(lengths): shorter
-            # rows would attend their pad-token cache rows and take wrong
-            # RoPE positions from the second token on. Correct ragged
-            # decode needs per-row offsets through rope/cache-write/mask
-            # (continuous batching) — not implemented yet.
-            raise NotImplementedError(
-                "ragged prompt lengths support max_new_tokens=1 only "
-                "(first-token scoring); pad to equal lengths or batch "
-                "rows of equal length for multi-token decode")
+        ragged = int(lengths.min()) != int(lengths.max())
         from ..core.enforce import enforce
 
         enforce(int(lengths.max()) + n_new <= M,
@@ -333,7 +386,12 @@ class Predictor:
         model = self._model
         p_dtype = self._params[0]._value.dtype
         pvals = tuple(p._value for p in self._params)
-        caches = model._empty_caches(B, M, p_dtype)
+        page = self.config._kv_page_size
+        if page:
+            caches, _ = self._paged_caches(lengths, n_new, M, page,
+                                           p_dtype)
+        else:
+            caches = model._empty_caches(B, M, p_dtype)
 
         ids_p = np.zeros((B, Sb), ids.dtype)
         ids_p[:, :S0] = ids
@@ -344,9 +402,13 @@ class Predictor:
         rng = jax.random.PRNGKey(gen.seed)
         rng, sub = jax.random.split(rng)
         # first sampled token (same rule as the compiled loop)
-        decode = self._decode_fn(B, M, n_new - 1, gen) if n_new > 1 else None
+        decode = self._decode_fn(B, M, n_new - 1, gen, ragged,
+                                 bool(page)) if n_new > 1 else None
         tok0 = _sample(last, sub, gen)
-        pos0 = int(lengths.max())
+        # ragged rows decode at PER-ROW offsets: each row's rope
+        # positions, cache-write slot, and attention frontier advance
+        # from its own true length (no lockstep from max(lengths))
+        pos0 = jnp.asarray(lengths) if ragged else int(lengths.max())
         if decode is not None:
             toks, caches = decode(pvals, tok0, caches, pos0, rng)
             all_new = jnp.concatenate([tok0[:, None], toks], axis=1)

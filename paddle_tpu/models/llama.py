@@ -100,11 +100,47 @@ from ..ops.nn_ops import rotate_half as _rot_half  # noqa: E402
 
 
 def _apply_rope(x, cos, sin, offset):
-    """x: [B, S, H, D] values; cos/sin: [max, D]; offset: traced or int."""
+    """x: [B, S, H, D] values; cos/sin: [max, D]; offset: traced or int,
+    or a PER-ROW vector [B] (ragged batches: each row rotates at its own
+    absolute positions)."""
     S = x.shape[1]
-    c = lax.dynamic_slice_in_dim(cos, offset, S, axis=0)[None, :, None, :]
-    s = lax.dynamic_slice_in_dim(sin, offset, S, axis=0)[None, :, None, :]
+    off = jnp.asarray(offset, jnp.int32)
+    if off.ndim:
+        pos = off[:, None] + jnp.arange(S, dtype=jnp.int32)[None]  # [B,S]
+        c = cos[pos][:, :, None, :]                          # [B,S,1,D]
+        s = sin[pos][:, :, None, :]
+    else:
+        c = lax.dynamic_slice_in_dim(cos, offset, S, axis=0)[None, :,
+                                                             None, :]
+        s = lax.dynamic_slice_in_dim(sin, offset, S, axis=0)[None, :,
+                                                             None, :]
     return x * c.astype(x.dtype) + _rot_half(x) * s.astype(x.dtype)
+
+
+_kernel_warned: set = set()
+
+
+def _dispatch_kernel(name, supported, kernel, fallback):
+    """Pallas-kernel dispatch policy, shared by the cache/paged
+    attention paths: try the kernel when the flag + shape gate + TPU
+    backend allow, warn ONCE PER KERNEL on failure, fall back to XLA."""
+    from ..core import flags as _flags
+
+    if (_flags._get("use_pallas_kernels", True) and supported()
+            and (jax.default_backend() != "cpu")):
+        try:
+            return kernel()
+        except (KeyboardInterrupt, SystemExit):
+            raise
+        except Exception as e:
+            if name not in _kernel_warned:
+                _kernel_warned.add(name)
+                import warnings
+
+                warnings.warn(f"{name}: Pallas kernel unavailable "
+                              f"({type(e).__name__}: {e}); using dense "
+                              "XLA fallback")
+    return fallback()
 
 
 def _cache_attention(q, k_cache, v_cache, offset, S):
@@ -114,49 +150,40 @@ def _cache_attention(q, k_cache, v_cache, offset, S):
     cache streamed in blocks, DMA stops at the valid frontier, GQA
     grouped natively (ops/pallas/decode_attention.py); the portable
     path is a full-cache matmul + length mask in XLA."""
-    from ..core import flags as _flags
     from ..ops.pallas import decode_attention as _da
 
-    if (_flags._get("use_pallas_kernels", True)
-            and _da.supported(q.shape, k_cache.shape)
-            and (jax.default_backend() != "cpu")):
-        try:
-            return _da.decode_attention(q, k_cache, v_cache, offset)
-        except (KeyboardInterrupt, SystemExit):
-            raise
-        except Exception as e:
-            global _decode_warned
-            if not _decode_warned:
-                _decode_warned = True
-                import warnings
-
-                warnings.warn(f"decode_attention: Pallas kernel "
-                              f"unavailable ({type(e).__name__}: {e}); "
-                              "using dense XLA fallback")
-    return _cache_attention_dense(q, k_cache, v_cache, offset, S)
+    return _dispatch_kernel(
+        "decode_attention",
+        lambda: _da.supported(q.shape, k_cache.shape),
+        lambda: _da.decode_attention(q, k_cache, v_cache, offset),
+        lambda: _cache_attention_dense(q, k_cache, v_cache, offset, S))
 
 
-_decode_warned = False
+def _paged_attention(q, k_pool, v_pool, tables, lengths, S):
+    """Paged-cache attention dispatch: Pallas block-table kernel on TPU
+    (reference capability: block_multi_head_attention_kernel.cu), XLA
+    gather + ragged dense mask elsewhere."""
+    from ..ops.pallas import decode_attention as _da
+
+    return _dispatch_kernel(
+        "paged_decode_attention",
+        lambda: _da.paged_supported(q.shape, k_pool.shape),
+        lambda: _da.paged_decode_attention(q, k_pool, v_pool, tables,
+                                           lengths),
+        lambda: _da.paged_attention_dense(q, k_pool, v_pool, tables,
+                                          lengths))
 
 
 def _cache_attention_dense(q, k_cache, v_cache, offset, S):
-    """Caches are head-major [B, KV, M, D]."""
-    B, _, H, D = q.shape
-    KV, M = k_cache.shape[1], k_cache.shape[2]
-    if KV != H:
-        k_cache = jnp.repeat(k_cache, H // KV, axis=1)
-        v_cache = jnp.repeat(v_cache, H // KV, axis=1)
-    qf = jnp.swapaxes(q, 1, 2).astype(jnp.float32)       # B,H,S,D
-    kf = k_cache.astype(jnp.float32)                     # B,H,M,D
-    vf = v_cache.astype(jnp.float32)
-    scores = jnp.einsum("bhsd,bhmd->bhsm", qf, kf) / np.sqrt(D)
-    q_pos = offset + jnp.arange(S)                        # [S]
-    kv_pos = jnp.arange(M)                                # [M]
-    keep = kv_pos[None, :] <= q_pos[:, None]              # causal+length
-    scores = jnp.where(keep[None, None], scores, -1e30)
-    probs = jax.nn.softmax(scores, axis=-1)
-    out = jnp.einsum("bhsm,bhmd->bhsd", probs, vf)
-    return jnp.swapaxes(out, 1, 2).astype(q.dtype)
+    """Caches are head-major [B, KV, M, D]; offset scalar or [B]. The
+    math lives in ops/pallas/decode_attention._dense_ragged (shared
+    with the paged fallback)."""
+    from ..ops.pallas.decode_attention import _dense_ragged
+
+    B = q.shape[0]
+    off = jnp.broadcast_to(jnp.asarray(offset, jnp.int32).reshape(-1),
+                           (B,))
+    return _dense_ragged(q, k_cache, v_cache, off)
 
 
 class LlamaAttention(Layer):
@@ -211,13 +238,40 @@ class LlamaAttention(Layer):
         kv_ = _apply_rope(kv_, cos, sin, offset)
 
         if cache is not None:
+            if len(cache) == 3:         # paged: (k_pool, v_pool, tables)
+                k_pool, v_pool, tables = cache
+                page = k_pool.shape[2]
+                off = jnp.broadcast_to(
+                    jnp.asarray(offset, jnp.int32).reshape(-1), (B,))
+                pos = off[:, None] + jnp.arange(S, dtype=jnp.int32)[None]
+                pid = jnp.take_along_axis(tables, pos // page, axis=1)
+                slot = pos % page        # [B,S]
+                # advanced-index scatter: [B,S] page ids + slots land
+                # the new [B,S,KV,D] kv rows in their physical pages
+                # (rows a row does not own are mapped to the trash page
+                # by the table, see inference paged allocator)
+                k_pool = k_pool.at[pid, :, slot, :].set(
+                    kv_.astype(k_pool.dtype))
+                v_pool = v_pool.at[pid, :, slot, :].set(
+                    vv.astype(v_pool.dtype))
+                ov = _paged_attention(qv, k_pool, v_pool, tables, off, S)
+                out = Tensor(ov.reshape(B, S, n_local * D),
+                             stop_gradient=True)
+                return self.o_proj(out), (k_pool, v_pool, tables)
             k_cache, v_cache = cache    # head-major [B, KV, M, D]
-            k_cache = lax.dynamic_update_slice_in_dim(
-                k_cache, jnp.swapaxes(kv_, 1, 2).astype(k_cache.dtype),
-                offset, axis=2)
-            v_cache = lax.dynamic_update_slice_in_dim(
-                v_cache, jnp.swapaxes(vv, 1, 2).astype(v_cache.dtype),
-                offset, axis=2)
+            off = jnp.asarray(offset, jnp.int32)
+            k_new = jnp.swapaxes(kv_, 1, 2).astype(k_cache.dtype)
+            v_new = jnp.swapaxes(vv, 1, 2).astype(v_cache.dtype)
+            if off.ndim:                # ragged: per-row write positions
+                dus = lambda c, u, o: lax.dynamic_update_slice_in_dim(
+                    c, u, o, axis=1)    # [KV,M,D] <- [KV,S,D] @ row off
+                k_cache = jax.vmap(dus)(k_cache, k_new, off)
+                v_cache = jax.vmap(dus)(v_cache, v_new, off)
+            else:
+                k_cache = lax.dynamic_update_slice_in_dim(
+                    k_cache, k_new, offset, axis=2)
+                v_cache = lax.dynamic_update_slice_in_dim(
+                    v_cache, v_new, offset, axis=2)
             ov = _cache_attention(qv, k_cache, v_cache, offset, S)
             out = Tensor(ov.reshape(B, S, n_local * D), stop_gradient=True)
             return self.o_proj(out), (k_cache, v_cache)

@@ -48,7 +48,8 @@ except Exception:  # pragma: no cover
 from . import (_BLOCKS_LARGE as _BLOCKS, compiler_params as
                _compiler_params, is_tpu_platform, pick_block as _pick_block)
 
-__all__ = ["decode_attention"]
+__all__ = ["decode_attention", "paged_decode_attention",
+           "paged_attention_dense", "paged_supported"]
 
 _NEG = -1e30
 
@@ -56,7 +57,7 @@ _NEG = -1e30
 def _kernel(len_ref, q_ref, k_ref, v_ref, o_ref, m_s, l_s, acc_s, *,
             scale, block_kv, nkv, Sq, G):
     j = pl.program_id(2)
-    off = len_ref[0]                      # q rows start here
+    off = len_ref[pl.program_id(0)]       # this row's q start (ragged)
     j_last = (off + Sq - 1) // block_kv   # last cache block with valid cols
 
     @pl.when(j == 0)
@@ -113,7 +114,9 @@ def decode_attention(q, k_cache, v_cache, offset, scale=None,
                      interpret=None):
     """q [B,Sq,H,D] against caches [B,KV,M,D] (head-major: each head's
     [M,D] plane is contiguous, the Mosaic-tileable layout); cache
-    positions <= offset+row are attended. offset may be traced."""
+    positions <= offset+row are attended. offset may be traced, and may
+    be a PER-ROW vector [B] (ragged batches: each row's frontier clamps
+    its own DMA + mask independently)."""
     B, Sq, H, D = q.shape
     KV, M = k_cache.shape[1], k_cache.shape[2]
     G = H // KV
@@ -124,10 +127,11 @@ def decode_attention(q, k_cache, v_cache, offset, scale=None,
     block_kv = _pick_block(M, prefer=_BLOCKS)
     nkv = M // block_kv
     q5 = q.reshape(B, Sq, KV, G, D)
-    lengths = jnp.asarray(offset, jnp.int32).reshape(1)
+    lengths = jnp.broadcast_to(jnp.asarray(offset, jnp.int32).reshape(-1),
+                               (B,))
 
     def kv_index(b, h, j, ln):
-        return (b, h, jnp.minimum(j, (ln[0] + Sq - 1) // block_kv), 0)
+        return (b, h, jnp.minimum(j, (ln[b] + Sq - 1) // block_kv), 0)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
@@ -155,3 +159,155 @@ def decode_attention(q, k_cache, v_cache, offset, scale=None,
         **_compiler_params(2, interpret),
     )(lengths, q5, k_cache, v_cache)
     return out.reshape(B, Sq, H, D)
+
+
+# ---------------------------------------------------------------------------
+# Paged (block-table) KV cache attention
+# ---------------------------------------------------------------------------
+def _paged_kernel(len_ref, tbl_ref, q_ref, k_ref, v_ref, o_ref, m_s, l_s,
+                  acc_s, *, scale, page, npages, Sq, G):
+    j = pl.program_id(2)
+    off = len_ref[pl.program_id(0)]
+    j_last = (off + Sq - 1) // page
+
+    @pl.when(j == 0)
+    def _():
+        m_s[...] = jnp.full_like(m_s, _NEG)
+        l_s[...] = jnp.zeros_like(l_s)
+        acc_s[...] = jnp.zeros_like(acc_s)
+
+    @pl.when(j <= j_last)
+    def _():
+        qb = q_ref[0, :, 0, :, :].reshape(Sq * G, -1)      # [Sq*G, D]
+        kb = k_ref[0, 0]                                   # [page, D]
+        vb = v_ref[0, 0]
+        s = lax.dot_general(qb, kb, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+        rows = lax.broadcasted_iota(jnp.int32, (Sq * G, page), 0) // G
+        cols = j * page + lax.broadcasted_iota(
+            jnp.int32, (Sq * G, page), 1)
+        keep = cols <= off + rows
+        s = jnp.where(keep, s, _NEG)
+        m_prev = m_s[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, -1, keepdims=True))
+        p = jnp.where(keep, jnp.exp(s - m_new), 0.0)
+        corr = jnp.exp(m_prev - m_new)
+        l_s[:, :1] = l_s[:, :1] * corr + jnp.sum(p, -1, keepdims=True)
+        acc_s[...] = acc_s[...] * corr + lax.dot_general(
+            p.astype(vb.dtype), vb, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_s[:, :1] = m_new
+
+    @pl.when(j == npages - 1)
+    def _():
+        l = jnp.maximum(l_s[:, :1], 1e-30)
+        o_ref[0, :, 0, :, :] = (acc_s[...] / l).reshape(
+            Sq, G, -1).astype(o_ref.dtype)
+
+
+def paged_supported(q_shape, pool_shape) -> bool:
+    if pltpu is None:
+        return False
+    B, Sq, H, D = q_shape
+    P, KV, page = pool_shape[0], pool_shape[1], pool_shape[2]
+    if H % KV or D % 128 != 0:
+        return False
+    if page % 8 or page < 8:  # sublane-tileable page
+        return False
+    return Sq * (H // KV) <= 2048
+
+
+def paged_decode_attention(q, k_pool, v_pool, block_tables, lengths,
+                           scale=None, interpret=None):
+    """Block-table KV attention (the TPU redesign of the reference's
+    paged cache kernel: phi/kernels/fusion/gpu/
+    block_multi_head_attention_kernel.cu + block_attn.h — there, CUDA
+    threads chase the block table; here the BLOCKSPEC INDEX MAP does:
+    the physical page id is gathered from a scalar-prefetched table, so
+    the DMA engine fetches exactly the pages a row owns and never
+    touches pages past its frontier).
+
+    q            [B, Sq, H, D]  rows at absolute positions
+                                lengths[b]..lengths[b]+Sq-1
+    k/v_pool     [P, KV, page, D]  shared physical page pool, head-major
+                                pages (each [page, D] plane contiguous)
+    block_tables [B, npages]    logical->physical page map per row
+    lengths      [B]            tokens already in cache per row (ragged)
+    """
+    B, Sq, H, D = q.shape
+    P, KV, page = k_pool.shape[0], k_pool.shape[1], k_pool.shape[2]
+    npages = block_tables.shape[1]
+    G = H // KV
+    if scale is None:
+        scale = 1.0 / np.sqrt(D)
+    if interpret is None:
+        interpret = not is_tpu_platform()
+    q5 = q.reshape(B, Sq, KV, G, D)
+    lengths = jnp.asarray(lengths, jnp.int32).reshape(B)
+    tbl = jnp.asarray(block_tables, jnp.int32).reshape(B * npages)
+
+    def pool_index(b, h, j, ln, tb):
+        jc = jnp.minimum(j, (ln[b] + Sq - 1) // page)
+        return (tb[b * npages + jc], h, 0, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B, KV, npages),
+        in_specs=[
+            pl.BlockSpec((1, Sq, 1, G, D), lambda b, h, j, ln, tb:
+                         (b, 0, h, 0, 0)),
+            pl.BlockSpec((1, 1, page, D), pool_index),
+            pl.BlockSpec((1, 1, page, D), pool_index),
+        ],
+        out_specs=pl.BlockSpec((1, Sq, 1, G, D),
+                               lambda b, h, j, ln, tb: (b, 0, h, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((Sq * G, 128), jnp.float32),
+            pltpu.VMEM((Sq * G, 128), jnp.float32),
+            pltpu.VMEM((Sq * G, D), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        partial(_paged_kernel, scale=scale, page=page, npages=npages,
+                Sq=Sq, G=G),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, Sq, KV, G, D), q.dtype),
+        interpret=interpret,
+        **_compiler_params(2, interpret),
+    )(lengths, tbl, q5, k_pool, v_pool)
+    return out.reshape(B, Sq, H, D)
+
+
+def paged_attention_dense(q, k_pool, v_pool, block_tables, lengths):
+    """XLA reference/fallback: gather the pages into a contiguous view,
+    then run the (ragged-aware) dense cache attention."""
+    B, Sq, H, D = q.shape
+    page = k_pool.shape[2]
+    npages = block_tables.shape[1]
+    # [B, npages, KV, page, D] -> [B, KV, npages*page, D]
+    def gather(pool):
+        g = pool[block_tables]                       # [B, npages, KV, page, D]
+        g = jnp.swapaxes(g, 1, 2)                     # [B, KV, npages, page, D]
+        return g.reshape(B, pool.shape[1], npages * page, D)
+
+    return _dense_ragged(q, gather(k_pool), gather(v_pool), lengths)
+
+
+def _dense_ragged(q, k_cache, v_cache, lengths):
+    """Dense cache attention with per-row offsets (ragged)."""
+    B, S, H, D = q.shape
+    KV, M = k_cache.shape[1], k_cache.shape[2]
+    if KV != H:
+        k_cache = jnp.repeat(k_cache, H // KV, axis=1)
+        v_cache = jnp.repeat(v_cache, H // KV, axis=1)
+    qf = jnp.swapaxes(q, 1, 2).astype(jnp.float32)
+    kf = k_cache.astype(jnp.float32)
+    vf = v_cache.astype(jnp.float32)
+    scores = jnp.einsum("bhsd,bhmd->bhsm", qf, kf) / np.sqrt(D)
+    off = jnp.asarray(lengths, jnp.int32).reshape(B)
+    q_pos = off[:, None] + jnp.arange(S)[None, :]          # [B, S]
+    keep = jnp.arange(M)[None, None, :] <= q_pos[:, :, None]
+    scores = jnp.where(keep[:, None], scores, _NEG)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhsm,bhmd->bhsd", probs, vf)
+    return jnp.swapaxes(out, 1, 2).astype(q.dtype)
