@@ -95,6 +95,20 @@ def _canon_static(v: Any) -> Any:
     return v
 
 
+def _type_sig(v: Any) -> Any:
+    """Type signature of a static arg, part of the executable-cache key:
+    the cache is an ``==``-keyed lru_cache and ``1 == 1.0 == True`` hash
+    alike in Python, but the closed-over scalar's TYPE changes jnp
+    promotion (x + 1 is int32, x + 1.0 float32) — so same-valued,
+    differently-typed statics must not share an executable."""
+    if isinstance(v, (list, tuple)):
+        return ("seq",) + tuple(_type_sig(x) for x in v)
+    if isinstance(v, dict):
+        return ("map",) + tuple(sorted((k, _type_sig(x))
+                                       for k, x in v.items()))
+    return type(v).__name__
+
+
 class OpCall:
     """A fully-bound op invocation: tensor slots split from static attrs.
 
@@ -114,7 +128,7 @@ class OpCall:
                 spec.append("T")
                 in_values.append(a)
             else:
-                spec.append(("S", _canon_static(a)))
+                spec.append(("S", _canon_static(a), _type_sig(a)))
         kw_spec = []
         for k in sorted(kwargs):
             v = kwargs[k]
@@ -122,7 +136,7 @@ class OpCall:
                 kw_spec.append((k, "T"))
                 in_values.append(v)
             else:
-                kw_spec.append((k, ("S", _canon_static(v))))
+                kw_spec.append((k, ("S", _canon_static(v), _type_sig(v))))
         self.key = (opdef.name, tuple(spec), tuple(kw_spec))
         self.flat_fn = _flat_fn_cache(self.key, opdef.fn)
         self.in_values = in_values

@@ -119,8 +119,19 @@ class TracedStep:
         seed = rng.get_key()
         seed32 = jax.random.randint(seed, (), 0, 2**31 - 1, jnp.int32).astype(
             jnp.uint32)
-        out_vals, new_state, new_opt_states = self._compiled[key](
-            state_values, opt_states, seed32, arg_values)
+        try:
+            out_vals, new_state, new_opt_states = self._compiled[key](
+                state_values, opt_states, seed32, arg_values)
+        except (jax.errors.TracerBoolConversionError,
+                jax.errors.ConcretizationTypeError) as e:
+            raise TypeError(
+                "to_static cannot trace Python control flow over a "
+                "TENSOR VALUE (an `if tensor:` / `while tensor:` / "
+                "`int(tensor)` inside the compiled function). Rewrite "
+                "the branch with paddle_tpu.static.nn.cond / while_loop "
+                "/ switch_case (lax-backed, traceable), or move the "
+                "data-dependent branch outside the compiled step. "
+                f"Original error: {e}") from e
         for t, v in zip(state_tensors, new_state):
             t._value = v
         for o, s in zip(opts, new_opt_states):

@@ -28,7 +28,10 @@ from ..tensor import Parameter, Tensor, to_tensor
 
 __all__ = ["InputSpec", "Program", "Variable", "data",
            "default_main_program", "default_startup_program",
-           "program_guard", "Executor", "name_scope", "CompiledProgram"]
+           "program_guard", "Executor", "name_scope", "CompiledProgram",
+           "nn"]
+
+from . import nn  # noqa: E402,F401  (lax-backed control flow: cond/while_loop/case/switch_case)
 
 
 class InputSpec:

@@ -1,0 +1,215 @@
+"""Data-dependent control flow (reference: python/paddle/static/nn/
+control_flow.py — cond:1166, While/while_loop:1380, case:2310,
+switch_case:2517; capability also covered there by the SOT bytecode
+tracer, python/paddle/jit/sot/).
+
+TPU redesign: the reference lowers these to ConditionalBlock /
+While ops interpreted by the C++ executor. Here they lower DIRECTLY to
+``lax.cond`` / ``lax.while_loop`` / ``lax.switch`` — XLA's native
+control-flow HLOs — so they work identically in eager execution (the
+predicate is concrete and the branch just runs) and under
+``paddle.jit.to_static`` tracing (the branch becomes a compiled HLO
+region; this is what makes tensor-valued Python ``if``/``while`` —
+which CANNOT trace — expressible).
+
+Branch/body functions run under ``no_grad``: gradients do not flow
+through these constructs (use masked ``where`` selects for trainable
+branching). XLA requires both branches/iterations to carry identical
+structures, shapes, and dtypes; mismatches raise with the offending
+leaf named.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+import jax.numpy as jnp
+from jax import lax
+
+from ...core.enforce import enforce
+from ...tensor import Tensor
+
+__all__ = ["cond", "while_loop", "case", "switch_case"]
+
+
+def _unwrap(tree):
+    return jax.tree_util.tree_map(
+        lambda x: x._value if isinstance(x, Tensor) else jnp.asarray(x),
+        tree, is_leaf=lambda x: isinstance(x, Tensor))
+
+
+def _wrap(tree):
+    return jax.tree_util.tree_map(
+        lambda v: Tensor(v, stop_gradient=True), tree)
+
+
+def _scalar_pred(pred, api):
+    pv = pred._value if isinstance(pred, Tensor) else jnp.asarray(pred)
+    enforce(int(np.prod(pv.shape)) == 1,
+            lambda: f"{api} predicate must have exactly one element, "
+                    f"got shape {tuple(pv.shape)}")
+    return pv.reshape(()).astype(bool)
+
+
+def _run_branch(fn, api, args=()):
+    """Run a user branch/body fn on wrapped Tensors, return the unwrapped
+    value pytree (no_grad: see module doc)."""
+    from ...autograd import no_grad
+
+    with no_grad():
+        out = fn(*_wrap(args)) if args else fn()
+    return _unwrap(out)
+
+
+def _check_match(a, b, api, names=("true_fn", "false_fn")):
+    ta = jax.tree_util.tree_structure(a)
+    tb = jax.tree_util.tree_structure(b)
+    enforce(ta == tb,
+            lambda: f"{api}: {names[0]} and {names[1]} must return the "
+                    f"same structure, got {ta} vs {tb}")
+    for la, lb in zip(jax.tree_util.tree_leaves(a),
+                      jax.tree_util.tree_leaves(b)):
+        enforce(la.shape == lb.shape and la.dtype == lb.dtype,
+                lambda: f"{api}: branch outputs must match in shape and "
+                        f"dtype (XLA control flow), got "
+                        f"{la.shape}/{la.dtype} vs {lb.shape}/{lb.dtype}")
+
+
+def cond(pred, true_fn: Optional[Callable] = None,
+         false_fn: Optional[Callable] = None, name=None,
+         return_names=None):
+    """Run ``true_fn()`` if ``pred`` else ``false_fn()`` — as a
+    ``lax.cond`` HLO, so a TENSOR-VALUED predicate works under
+    ``to_static`` tracing (reference: static/nn/control_flow.py:1166).
+    """
+    enforce(true_fn is not None or false_fn is not None,
+            "cond needs at least one of true_fn/false_fn")
+    if true_fn is None or false_fn is None:
+        # single-branch form returns nothing; only runnable with a
+        # concrete predicate (a traced one needs both branches)
+        pv = _scalar_pred(pred, "cond")
+        enforce(not isinstance(pv, jax.core.Tracer),
+                "cond with a single branch needs a concrete predicate; "
+                "under to_static tracing pass BOTH true_fn and false_fn")
+        if bool(pv) == (true_fn is not None):
+            out = _run_branch(true_fn or false_fn, "cond")
+            enforce(not jax.tree_util.tree_leaves(out),
+                    "cond with a single branch cannot return tensors "
+                    "(the missing branch has nothing to return)")
+        return None
+    pv = _scalar_pred(pred, "cond")
+
+    # probe both branches once for structure/shape agreement (cheap at
+    # trace time; gives the named error instead of an XLA type clash)
+    ta = jax.eval_shape(lambda: _run_branch(true_fn, "cond"))
+    fa = jax.eval_shape(lambda: _run_branch(false_fn, "cond"))
+    _check_match(ta, fa, "cond")
+
+    out = lax.cond(pv, lambda: _run_branch(true_fn, "cond"),
+                   lambda: _run_branch(false_fn, "cond"))
+    return _wrap(out)
+
+
+def while_loop(cond: Callable, body: Callable, loop_vars: Sequence,
+               is_test: bool = False, name=None) -> List:
+    """``while cond(*vars): vars = body(*vars)`` as a
+    ``lax.while_loop`` HLO (reference: static/nn/control_flow.py:1380).
+    Loop-carried shapes/dtypes must be invariant across iterations."""
+    enforce(len(loop_vars) > 0, "while_loop needs at least one loop var")
+    init = tuple(_unwrap(list(loop_vars)))
+
+    def c(vs):
+        return _scalar_pred(Tensor(_cond_val(vs)), "while_loop")
+
+    def _cond_val(vs):
+        from ...autograd import no_grad
+
+        with no_grad():
+            out = cond(*_wrap(list(vs)))
+        return out._value if isinstance(out, Tensor) else jnp.asarray(out)
+
+    def b(vs):
+        out = _run_branch(body, "while_loop", args=list(vs))
+        out = tuple(out) if isinstance(out, (list, tuple)) else (out,)
+        enforce(len(out) == len(vs),
+                lambda: f"while_loop body returned {len(out)} vars, "
+                        f"expected {len(vs)}")
+        for i, (o, v) in enumerate(zip(out, vs)):
+            enforce(o.shape == v.shape and o.dtype == v.dtype,
+                    lambda: f"while_loop var {i} changed "
+                            f"shape/dtype {v.shape}/{v.dtype} -> "
+                            f"{o.shape}/{o.dtype}; loop-carried values "
+                            "must be invariant (XLA while)")
+        return out
+
+    out = lax.while_loop(c, b, init)
+    return [Tensor(v, stop_gradient=True) for v in out]
+
+
+def case(pred_fn_pairs: Sequence[Tuple[Any, Callable]],
+         default: Optional[Callable] = None, name=None):
+    """First pair whose pred is True runs; else ``default`` (reference:
+    static/nn/control_flow.py:2310). Lowered as nested ``lax.cond``."""
+    enforce(len(pred_fn_pairs) > 0, "case needs at least one (pred, fn)")
+    pairs = list(pred_fn_pairs)
+    if default is None:
+        default = pairs[-1][1]
+        pairs = pairs[:-1]
+        enforce(len(pairs) > 0,
+                "case without default needs >= 2 pairs (the last "
+                "becomes the default, reference semantics)")
+
+    shapes = [jax.eval_shape(lambda f=f: _run_branch(f, "case"))
+              for _, f in pairs] + \
+             [jax.eval_shape(lambda: _run_branch(default, "case"))]
+    for s in shapes[1:]:
+        _check_match(shapes[0], s, "case", ("branch 0", "a later branch"))
+
+    def build(i):
+        if i == len(pairs):
+            return lambda: _run_branch(default, "case")
+        pred, fn = pairs[i]
+        pv = _scalar_pred(pred, "case")
+        nxt = build(i + 1)
+        return lambda: lax.cond(pv, lambda: _run_branch(fn, "case"), nxt)
+
+    return _wrap(build(0)())
+
+
+def switch_case(branch_index, branch_fns, default: Optional[Callable] = None,
+                name=None):
+    """Run ``branch_fns[branch_index]`` as a ``lax.switch`` HLO
+    (reference: static/nn/control_flow.py:2517). ``branch_fns`` is a
+    list of fns, or (index, fn) pairs; out-of-range indices take
+    ``default`` (appended as the last switch branch, clamp-mapped)."""
+    if isinstance(branch_fns, dict):
+        items = sorted(branch_fns.items())
+    elif branch_fns and isinstance(branch_fns[0], (tuple, list)):
+        items = sorted((int(i), f) for i, f in branch_fns)
+    else:
+        items = list(enumerate(branch_fns))
+    keys = [k for k, _ in items]
+    fns = [f for _, f in items]
+    enforce(len(set(keys)) == len(keys),
+            "switch_case branch indices must be unique")
+    if default is None:
+        default = fns[-1]
+
+    shapes = [jax.eval_shape(lambda f=f: _run_branch(f, "switch_case"))
+              for f in fns + [default]]
+    for s in shapes[1:]:
+        _check_match(shapes[0], s, "switch_case",
+                     ("branch 0", "a later branch"))
+
+    iv = branch_index._value if isinstance(branch_index, Tensor) \
+        else jnp.asarray(branch_index)
+    iv = iv.reshape(()).astype(jnp.int32)
+    # map sparse keys -> dense positions; unmatched -> default (last)
+    pos = len(fns)
+    sel = jnp.asarray(pos, jnp.int32)
+    for p, k in enumerate(keys):
+        sel = jnp.where(iv == k, jnp.asarray(p, jnp.int32), sel)
+    branches = [(lambda f=f: _run_branch(f, "switch_case"))
+                for f in fns + [default]]
+    return _wrap(lax.switch(sel, branches))

@@ -1,0 +1,140 @@
+"""Data-dependent control flow: static.nn.cond / while_loop / case /
+switch_case (reference: python/paddle/static/nn/control_flow.py —
+cond:1166, while_loop:1380, case:2310, switch_case:2517; the same
+capability the reference's dy2static/SOT tracer provides for implicit
+Python branching, python/paddle/jit/sot/).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.static import nn as snn
+
+
+def _np(t):
+    return np.asarray(t._value)
+
+
+class TestCond:
+    def test_basic_branch(self):
+        x = paddle.to_tensor(np.float32(3.0))
+        out = snn.cond(x > 2.0, lambda: x * 2.0, lambda: x - 1.0)
+        assert float(_np(out)) == 6.0
+        out = snn.cond(x > 5.0, lambda: x * 2.0, lambda: x - 1.0)
+        assert float(_np(out)) == 2.0
+
+    def test_nested_structure(self):
+        a = paddle.to_tensor(np.arange(4, dtype="float32"))
+        out = snn.cond(paddle.to_tensor(True),
+                       lambda: (a + 1.0, {"k": a * 2.0}),
+                       lambda: (a - 1.0, {"k": a / 2.0}))
+        assert (_np(out[0]) == np.arange(4) + 1).all()
+        assert (_np(out[1]["k"]) == np.arange(4) * 2).all()
+
+    def test_mismatched_branches_raise(self):
+        x = paddle.to_tensor(np.float32(1.0))
+        with pytest.raises(Exception, match="same structure|shape"):
+            snn.cond(x > 0, lambda: (x, x), lambda: x)
+
+    def test_single_branch_concrete(self):
+        hits = []
+        snn.cond(paddle.to_tensor(True), lambda: hits.append(1))
+        snn.cond(paddle.to_tensor(False), lambda: hits.append(2))
+        assert hits == [1]
+
+
+class TestWhileLoop:
+    def test_counter(self):
+        i = paddle.to_tensor(np.int32(0))
+        s = paddle.to_tensor(np.float32(0.0))
+        i_out, s_out = snn.while_loop(
+            lambda i, s: i < 10,
+            lambda i, s: (i + 1, s + 2.0), [i, s])
+        assert int(_np(i_out)) == 10
+        assert float(_np(s_out)) == 20.0
+
+    def test_tensor_carried_shape(self):
+        x = paddle.to_tensor(np.ones((2, 3), "float32"))
+        n = paddle.to_tensor(np.int32(0))
+        n_out, x_out = snn.while_loop(
+            lambda n, x: n < 4,
+            lambda n, x: (n + 1, x * 2.0), [n, x])
+        assert (_np(x_out) == 16.0).all()
+
+    def test_shape_change_raises(self):
+        x = paddle.to_tensor(np.ones((3,), "float32"))
+        n = paddle.to_tensor(np.int32(0))
+        with pytest.raises(Exception, match="invariant|shape"):
+            snn.while_loop(lambda n, x: n < 2,
+                           lambda n, x: (n + 1, paddle.concat([x, x])),
+                           [n, x])
+
+
+class TestCaseSwitch:
+    def test_case_first_true_wins(self):
+        x = paddle.to_tensor(np.float32(0.3))
+        out = snn.case([(x < 0.1, lambda: x * 1.0),
+                        (x < 0.5, lambda: x * 10.0)],
+                       default=lambda: x * 100.0)
+        assert abs(float(_np(out)) - 3.0) < 1e-6
+
+    def test_case_default(self):
+        x = paddle.to_tensor(np.float32(0.9))
+        out = snn.case([(x < 0.1, lambda: x * 1.0),
+                        (x < 0.5, lambda: x * 10.0)],
+                       default=lambda: x * 100.0)
+        assert abs(float(_np(out)) - 90.0) < 1e-4
+
+    def test_switch_case(self):
+        one = paddle.to_tensor(np.float32(1.0))
+        fns = {1: lambda: one * 10.0, 3: lambda: one * 30.0}
+        out = snn.switch_case(paddle.to_tensor(np.int32(3)), fns,
+                              default=lambda: one * -1.0)
+        assert float(_np(out)) == 30.0
+        out = snn.switch_case(paddle.to_tensor(np.int32(7)), fns,
+                              default=lambda: one * -1.0)
+        assert float(_np(out)) == -1.0
+
+
+class TestUnderToStatic:
+    """The dy2static scenario: tensor-valued loops/branches INSIDE a
+    compiled function (reference test style: dygraph_to_static loop
+    tests)."""
+
+    def test_while_loop_traces(self):
+        @paddle.jit.to_static
+        def collatz_steps(x):
+            n = paddle.to_tensor(np.int32(0))
+            def body(v, n):
+                nxt = snn.cond((v % 2) == 0,
+                               lambda: v // 2, lambda: 3 * v + 1)
+                return nxt, n + 1
+            v, n = snn.while_loop(lambda v, n: v > 1, body,
+                                  [x, n])
+            return n
+
+        out = collatz_steps(paddle.to_tensor(np.int32(6)))
+        # 6 -> 3 -> 10 -> 5 -> 16 -> 8 -> 4 -> 2 -> 1 : 8 steps
+        assert int(_np(out)) == 8
+
+    def test_python_branch_error_points_to_cond(self):
+        @paddle.jit.to_static
+        def bad(x):
+            if x > 0:           # Python branch on a traced tensor
+                return x * 2.0
+            return x
+
+        with pytest.raises(TypeError, match="static.nn.cond"):
+            bad(paddle.to_tensor(np.float32(1.0)))
+
+    def test_cond_inside_compiled_step(self):
+        @paddle.jit.to_static
+        def clipped_double(x):
+            return snn.cond(x.sum() > 0.0,
+                            lambda: x * 2.0,
+                            lambda: x * 0.0)
+
+        x = paddle.to_tensor(np.array([1.0, 2.0], "float32"))
+        assert (_np(clipped_double(x)) == [2.0, 4.0]).all()
+        y = paddle.to_tensor(np.array([-1.0, -2.0], "float32"))
+        assert (_np(clipped_double(y)) == [0.0, 0.0]).all()
