@@ -287,10 +287,30 @@ def backward(tensors: Sequence, grad_tensors: Optional[Sequence] = None,
 
 def _accumulate_leaf(t, g) -> None:
     from ..tensor import Tensor
+    from ..framework.selected_rows import SelectedRows
 
+    if isinstance(g, SelectedRows):
+        # row-sparse leaf gradient (sparse embedding): stays sparse
+        # while possible — concat on sparse+sparse, densify on mixing
+        # with a dense grad or with grad hooks (hooks see dense Tensors)
+        if t._grad_hooks:
+            g = g.to_dense_value()
+        elif t.grad is None:
+            t.grad = g
+            return
+        elif isinstance(t.grad, SelectedRows):
+            t.grad = SelectedRows(
+                jnp.concatenate([t.grad.rows, g.rows]),
+                jnp.concatenate([t.grad.values, g.values]), g.height)
+            return
+        else:
+            t.grad = Tensor(t.grad._value + g.to_dense_value(),
+                            stop_gradient=True)
+            return
+    elif isinstance(t.grad, SelectedRows):
+        t.grad = Tensor(t.grad.to_dense_value(), stop_gradient=True)
     if t._grad_hooks:
-        from ..tensor import Tensor as _T
-        gt = _T(g, stop_gradient=True)
+        gt = Tensor(g, stop_gradient=True)
         for hook in t._grad_hooks:
             res = hook(gt)
             if res is not None:

@@ -4,6 +4,7 @@
 """
 from . import io  # noqa: F401
 from .param_attr import ParamAttr  # noqa: F401
+from .selected_rows import SelectedRows, merge_selected_rows  # noqa: F401
 from ..core.rng import seed  # noqa: F401
 
 

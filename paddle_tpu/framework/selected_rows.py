@@ -1,0 +1,84 @@
+"""SelectedRows: a row-sparse gradient (reference:
+paddle/phi/core/selected_rows.h:32 — rows_ + value_ + height_; produced
+by the sparse embedding-gradient kernel
+phi/kernels/cpu|gpu/embedding_sparse_grad_kernel.cc and consumed by the
+optimizers' sparse update kernels, e.g. adam's lazy_mode row updates).
+
+TPU redesign: the value is a dense [n_rows, dim...] jax array + an int32
+row-id vector — the pair stays on device and flows through the autograd
+engine as a leaf gradient; the optimizer applies it as an XLA scatter
+over only the touched rows (plus lazy per-row moment updates for Adam),
+so a step on a small batch never materializes (vocab, dim) gradients.
+"""
+from __future__ import annotations
+
+import jax
+import numpy as np
+import jax.numpy as jnp
+
+__all__ = ["SelectedRows", "merge_selected_rows"]
+
+
+class SelectedRows:
+    """rows: int32 [n]; values: [n, ...]; height: size of dim 0 of the
+    dense equivalent. Duplicate row ids are allowed (accumulated on
+    merge/to_dense, matching the reference's MergeAdd semantics)."""
+
+    __slots__ = ("rows", "values", "height")
+
+    def __init__(self, rows, values, height: int):
+        self.rows = jnp.asarray(rows, jnp.int32).reshape(-1)
+        self.values = jnp.asarray(values)
+        self.height = int(height)
+
+    @property
+    def shape(self):
+        return [self.height] + list(self.values.shape[1:])
+
+    @property
+    def dtype(self):
+        return self.values.dtype
+
+    def to_dense_value(self) -> jax.Array:
+        out = jnp.zeros((self.height,) + self.values.shape[1:],
+                        self.values.dtype)
+        return out.at[self.rows].add(self.values)
+
+    @property
+    def _value(self) -> jax.Array:
+        """Dense view for generic Tensor-shaped consumers (grad clip,
+        user inspection). The optimizer checks isinstance(...,
+        SelectedRows) FIRST and never takes this densifying path."""
+        return self.to_dense_value()
+
+    def numpy(self):
+        return np.asarray(self.to_dense_value())
+
+    def is_selected_rows(self) -> bool:
+        return True
+
+    def merge(self) -> "SelectedRows":
+        return merge_selected_rows(self)
+
+    def __repr__(self):
+        return (f"SelectedRows(height={self.height}, "
+                f"n_rows={self.values.shape[0]}, "
+                f"value_shape={list(self.values.shape[1:])})")
+
+
+def merge_selected_rows(sr: SelectedRows) -> SelectedRows:
+    """Accumulate duplicate row ids (reference: merge_selected_rows op /
+    MergeAdd functor). Static-shaped: the output keeps n slots with
+    unique ids first (segment-sum by first-occurrence index); the freed
+    duplicate slots get row id = height, which is OUT OF BOUNDS: XLA
+    drops out-of-bounds scatter updates, so those slots are inert for
+    every scatter consumer without any dynamic shaping; the zero value
+    keeps any gather-based consumer harmless too."""
+    rows = np.asarray(sr.rows)
+    uniq, inv = np.unique(rows, return_inverse=True)
+    n = sr.values.shape[0]
+    seg = jnp.zeros((n,) + sr.values.shape[1:], sr.values.dtype)
+    seg = seg.at[jnp.asarray(inv)].add(sr.values)
+    out_rows = np.full(n, sr.height, np.int32)
+    out_rows[:len(uniq)] = uniq
+    return SelectedRows(out_rows, seg, sr.height)

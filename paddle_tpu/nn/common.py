@@ -43,6 +43,7 @@ class Embedding(Layer):
         self._embedding_dim = embedding_dim
         self._padding_idx = (padding_idx if padding_idx is None or padding_idx >= 0
                              else num_embeddings + padding_idx)
+        self._sparse = bool(sparse)
         attr = ParamAttr._to_attr(weight_attr)
         self.weight = self.create_parameter(
             (num_embeddings, embedding_dim), attr=attr,
@@ -51,6 +52,10 @@ class Embedding(Layer):
             self.weight._value = self.weight._value.at[self._padding_idx].set(0.0)
 
     def forward(self, x):
+        if self._sparse:
+            from .sparse_embedding import sparse_embedding
+
+            return sparse_embedding(x, self.weight, self._padding_idx)
         return F.embedding(x, self.weight, padding_idx=self._padding_idx)
 
     def extra_repr(self):

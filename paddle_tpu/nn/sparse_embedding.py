@@ -1,0 +1,48 @@
+"""Sparse-gradient embedding lookup (reference: nn.Embedding(sparse=
+True) → phi/kernels/cpu|gpu/embedding_sparse_grad_kernel.cc — the
+weight gradient comes back as a SelectedRows of only the looked-up
+rows, not a dense (vocab, dim) tensor).
+
+TPU design: the forward is a plain gather; the backward hands the
+autograd engine a ``SelectedRows(rows=ids, values=upstream_grad)``
+directly — O(batch·dim) instead of O(vocab·dim) — which the engine
+accumulates leaf-side and the optimizer applies as a row scatter
+(lazy per-row moments for Adam). Only valid for a LEAF weight
+(a Parameter): SelectedRows cannot flow through further grad kernels.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..autograd import engine
+from ..core.enforce import enforce
+from ..framework.selected_rows import SelectedRows
+from ..tensor import Tensor
+
+__all__ = ["sparse_embedding"]
+
+
+def sparse_embedding(ids, weight, padding_idx=None):
+    iv = ids._value if isinstance(ids, Tensor) else jnp.asarray(ids)
+    out_val = weight._value[iv]
+    out = Tensor(out_val, stop_gradient=True)
+    if engine.is_grad_enabled() and not weight.stop_gradient:
+        enforce(weight._grad_node is None,
+                "Embedding(sparse=True) requires a leaf weight "
+                "(a Parameter): a SelectedRows gradient cannot flow "
+                "through upstream ops (e.g. an amp cast); use "
+                "sparse=False there")
+        out.stop_gradient = False
+        height, dim = weight.shape[0], weight.shape[1]
+
+        def backward_fn(gout):
+            rows = iv.reshape(-1)
+            vals = gout.reshape(-1, dim)
+            if padding_idx is not None:
+                vals = jnp.where((rows == padding_idx)[:, None],
+                                 jnp.zeros_like(vals), vals)
+            return (SelectedRows(rows, vals, height),)
+
+        engine.record_custom("sparse_embedding", backward_fn,
+                             [weight], [out], out_val)
+    return out

@@ -23,6 +23,7 @@ from . import lr as lr_sched
 from .lr import LRScheduler
 
 __all__ = ["Optimizer", "SGD", "Momentum", "LarsMomentum", "Adam", "AdamW", "Adagrad",
+           "Adadelta", "Adamax", "ASGD", "Rprop",
            "RMSProp", "Lamb", "lr"]
 
 lr = lr_sched
@@ -106,12 +107,54 @@ class Optimizer:
                   if p is not None and p.grad is not None and p.trainable]
         return params
 
+    # -- sparse (SelectedRows) gradients --------------------------------
+    def _sparse_update(self, p, pf, sr, state, lr_value, step):
+        """Apply a merged SelectedRows grad. Default: densify (exact,
+        same numerics as a dense grad); SGD/Adam override with row-wise
+        scatter updates (reference: the optimizers'
+        *DenseParamSparseGradKernel family)."""
+        return self._update_rule(pf, sr.to_dense_value(), state,
+                                 lr_value, step)
+
+    def _apply_sparse(self, p, lr_value, step_value, shapes):
+        from ..framework.selected_rows import merge_selected_rows
+
+        sr = merge_selected_rows(p.grad)
+        state = self._param_state(p, shapes)
+        pf = self._master_weights.get(id(p), p._value)
+        new_p, new_s = self._sparse_update(p, pf, sr,
+                                           self._cast_state_in(state),
+                                           lr_value, step_value)
+        if id(p) in self._master_weights:
+            self._master_weights[id(p)] = new_p
+            p._value = new_p.astype(p._value.dtype)
+        else:
+            p._value = new_p
+        self._states[id(p)] = self._cast_state_out(new_s)
+
     @no_grad()
     def step(self):
-        params = self._collect()
-        if not params:
+        from ..framework.selected_rows import SelectedRows
+
+        all_params = self._collect()
+        if not all_params:
             return
         self._step_count += 1
+        sparse = [p for p in all_params
+                  if isinstance(p.grad, SelectedRows)]
+        params = [p for p in all_params
+                  if not isinstance(p.grad, SelectedRows)]
+        if sparse:
+            # sparse grads bypass grad_clip (clipping would need the
+            # dense norm; reference optimizers likewise apply sparse
+            # updates unclipped)
+            shapes = self._state_shapes()
+            lr_v = jnp.asarray(self.get_lr(), jnp.float32)
+            st_v = jnp.asarray(self._step_count, jnp.int32)
+            for p in sparse:
+                self._apply_sparse(p, lr_v, st_v, shapes)
+        if not params:
+            return
         shapes = self._state_shapes()
         states = [self._param_state(p, shapes) for p in params]
         pvals = [self._master_weights.get(id(p), p._value) for p in params]
@@ -252,6 +295,16 @@ class SGD(Optimizer):
             g = g + self._decay_term(p.astype(jnp.float32))
         return (p - (lr_value * g).astype(p.dtype)), state
 
+    def _sparse_update(self, p, pf, sr, state, lr_value, step):
+        """Row-wise SGD: touch only the looked-up rows (weight decay,
+        when set, applies to those rows)."""
+        rows = sr.rows
+        g = sr.values.astype(jnp.float32)
+        if self._weight_decay:
+            g = g + self._decay_term(pf[rows].astype(jnp.float32))
+        upd = (lr_value * g).astype(pf.dtype)
+        return pf.at[rows].add(-upd), state
+
 
 class Momentum(Optimizer):
     def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
@@ -292,9 +345,37 @@ class Adam(Optimizer):
         self._beta2 = beta2
         self._epsilon = epsilon
         self._decoupled = False
+        self._lazy_mode = bool(lazy_mode)
 
     def _state_shapes(self):
         return {"moment1": None, "moment2": None}
+
+    def _sparse_update(self, p, pf, sr, state, lr_value, step):
+        """SelectedRows grad (reference: AdamDenseParamSparseGradKernel).
+        lazy_mode=True updates moments/param ONLY at the touched rows
+        (the reference's lazy path, exact for row-disjoint steps);
+        lazy_mode=False keeps exact dense semantics by densifying."""
+        if not self._lazy_mode:
+            return super()._sparse_update(p, pf, sr, state, lr_value,
+                                          step)
+        rows = sr.rows
+        g = sr.values.astype(jnp.float32)
+        pf32 = pf.astype(jnp.float32)
+        if self._weight_decay and not self._decoupled:
+            g = g + self._decay_term(pf32[rows])
+        m_r = self._beta1 * state["moment1"][rows] + (1 - self._beta1) * g
+        v_r = self._beta2 * state["moment2"][rows] \
+            + (1 - self._beta2) * jnp.square(g)
+        t = step.astype(jnp.float32)
+        mhat = m_r / (1 - self._beta1 ** t)
+        vhat = v_r / (1 - self._beta2 ** t)
+        upd = mhat / (jnp.sqrt(vhat) + self._epsilon)
+        if self._weight_decay and self._decoupled:
+            upd = upd + self._decay_term(pf32[rows])
+        new_p = pf.at[rows].add((-lr_value * upd).astype(pf.dtype))
+        new_s = {"moment1": state["moment1"].at[rows].set(m_r),
+                 "moment2": state["moment2"].at[rows].set(v_r)}
+        return new_p, new_s
 
     def _update_rule(self, p, g, state, lr_value, step):
         pf = p.astype(jnp.float32)
@@ -405,6 +486,146 @@ class RMSProp(Optimizer):
         new_p = p.astype(jnp.float32) - mom
         return new_p.astype(p.dtype), {"mean_square": ms, "mean_grad": mg,
                                        "momentum": mom}
+
+
+class Adadelta(Optimizer):
+    """(reference: python/paddle/optimizer/adadelta.py over the phi
+    adadelta kernel — accumulated-gradient/accumulated-update rule.)"""
+
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, rho=0.95,
+                 parameters=None, weight_decay=None, grad_clip=None,
+                 name=None, **kwargs):
+        super().__init__(learning_rate, parameters, weight_decay,
+                         grad_clip, name)
+        self._epsilon = epsilon
+        self._rho = rho
+
+    def _state_shapes(self):
+        return {"avg_squared_grad": None, "avg_squared_update": None}
+
+    def _update_rule(self, p, g, state, lr_value, step):
+        g = g.astype(jnp.float32)
+        if self._weight_decay:
+            g = g + self._decay_term(p.astype(jnp.float32))
+        asg = self._rho * state["avg_squared_grad"] \
+            + (1 - self._rho) * jnp.square(g)
+        upd = g * jnp.sqrt(
+            (state["avg_squared_update"] + self._epsilon)
+            / (asg + self._epsilon))
+        asu = self._rho * state["avg_squared_update"] \
+            + (1 - self._rho) * jnp.square(upd)
+        new_p = p.astype(jnp.float32) - lr_value * upd
+        return new_p.astype(p.dtype), {"avg_squared_grad": asg,
+                                       "avg_squared_update": asu}
+
+
+class Adamax(Optimizer):
+    """(reference: python/paddle/optimizer/adamax.py — infinity-norm
+    Adam variant.)"""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None, **kwargs):
+        super().__init__(learning_rate, parameters, weight_decay,
+                         grad_clip, name)
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._epsilon = epsilon
+
+    def _state_shapes(self):
+        return {"moment": None, "inf_norm": None}
+
+    def _update_rule(self, p, g, state, lr_value, step):
+        g = g.astype(jnp.float32)
+        if self._weight_decay:
+            g = g + self._decay_term(p.astype(jnp.float32))
+        m = self._beta1 * state["moment"] + (1 - self._beta1) * g
+        u = jnp.maximum(self._beta2 * state["inf_norm"], jnp.abs(g))
+        t = step.astype(jnp.float32)
+        lr_t = lr_value / (1 - self._beta1 ** t)
+        new_p = p.astype(jnp.float32) - lr_t * m / (u + self._epsilon)
+        return new_p.astype(p.dtype), {"moment": m, "inf_norm": u}
+
+
+class ASGD(Optimizer):
+    """(reference: python/paddle/optimizer/asgd.py over the phi asgd
+    kernel — averaged SGD: keeps a running window-mean of the last N
+    gradients; here the mean is the standard exponential form d/N.)"""
+
+    def __init__(self, learning_rate=0.001, batch_num=1, parameters=None,
+                 weight_decay=None, grad_clip=None, multi_precision=False,
+                 name=None, **kwargs):
+        super().__init__(learning_rate, parameters, weight_decay,
+                         grad_clip, name, multi_precision)
+        self._n = max(int(batch_num), 1)
+
+    def _state_shapes(self):
+        return {}  # shapes built directly in _param_state (hist is 3-D)
+
+    def _param_state(self, p, shapes):
+        st = self._states.get(id(p))
+        if st is None:
+            st = {"d": jnp.zeros(p._value.shape, jnp.float32),
+                  "hist": jnp.zeros((self._n,) + tuple(p._value.shape),
+                                    jnp.float32)}
+            if self._multi_precision and p._value.dtype != jnp.float32:
+                self._master_weights[id(p)] = p._value.astype(jnp.float32)
+            self._states[id(p)] = st
+        return st
+
+    def _update_rule(self, p, g, state, lr_value, step):
+        g = g.astype(jnp.float32)
+        if self._weight_decay:
+            g = g + self._decay_term(p.astype(jnp.float32))
+        # d holds the sum of the last n gradients: rotate out the
+        # oldest history slot, rotate in g (the reference's d/y buffers)
+        idx = (step.astype(jnp.int32) - 1) % self._n
+        oldest = state["hist"][idx]
+        d = state["d"] - oldest + g
+        hist = state["hist"].at[idx].set(g)
+        new_p = p.astype(jnp.float32) - lr_value * d / self._n
+        return new_p.astype(p.dtype), {"d": d, "hist": hist}
+
+
+class Rprop(Optimizer):
+    """(reference: python/paddle/optimizer/rprop.py — resilient
+    backprop: per-weight step sizes grown/shrunk by gradient sign
+    agreement; gradients' magnitudes are ignored.)"""
+
+    def __init__(self, learning_rate=0.001, learning_rate_range=(1e-5, 50.0),
+                 parameters=None, etas=(0.5, 1.2), grad_clip=None,
+                 multi_precision=False, name=None, **kwargs):
+        super().__init__(learning_rate, parameters, None, grad_clip,
+                         name, multi_precision)
+        self._lr_min, self._lr_max = learning_rate_range
+        self._eta_neg, self._eta_pos = etas
+
+    def _state_shapes(self):
+        return {"prev_grad": None, "lr_w": None}
+
+    def _param_state(self, p, shapes):
+        st = self._states.get(id(p))
+        if st is None:
+            st = {"prev_grad": jnp.zeros(p._value.shape, jnp.float32),
+                  "lr_w": jnp.full(p._value.shape, float(self.get_lr()),
+                                   jnp.float32)}
+            if self._multi_precision and p._value.dtype != jnp.float32:
+                self._master_weights[id(p)] = p._value.astype(jnp.float32)
+            self._states[id(p)] = st
+        return st
+
+    def _update_rule(self, p, g, state, lr_value, step):
+        g = g.astype(jnp.float32)
+        sign = jnp.sign(g * state["prev_grad"])
+        lr_w = jnp.clip(
+            jnp.where(sign > 0, state["lr_w"] * self._eta_pos,
+                      jnp.where(sign < 0, state["lr_w"] * self._eta_neg,
+                                state["lr_w"])),
+            self._lr_min, self._lr_max)
+        # sign-disagreement steps are skipped (grad treated as 0)
+        g_eff = jnp.where(sign < 0, 0.0, g)
+        new_p = p.astype(jnp.float32) - lr_w * jnp.sign(g_eff)
+        return new_p.astype(p.dtype), {"prev_grad": g_eff, "lr_w": lr_w}
 
 
 class Lamb(Optimizer):

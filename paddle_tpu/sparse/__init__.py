@@ -23,9 +23,9 @@ from jax.experimental import sparse as jsparse
 
 from ..tensor import Tensor
 
-__all__ = ["SparseTensor", "sparse_coo_tensor", "sparse_csr_tensor",
-           "is_sparse", "add", "multiply", "matmul", "masked_matmul",
-           "relu", "transpose", "to_dense"]
+__all__ = ["SparseTensor", "CsrTensor", "sparse_coo_tensor",
+           "sparse_csr_tensor", "is_sparse", "add", "multiply", "matmul",
+           "masked_matmul", "relu", "transpose", "to_dense"]
 
 
 class SparseTensor:
@@ -64,6 +64,14 @@ class SparseTensor:
     def is_sparse_csr(self) -> bool:
         return False
 
+    def to_sparse_coo(self, sparse_dim=None) -> "SparseTensor":
+        return self
+
+    def to_sparse_csr(self) -> "CsrTensor":
+        return CsrTensor(jsparse.BCSR.from_bcoo(
+            jsparse.bcoo_sum_duplicates(self._bcoo)),
+            stop_gradient=self.stop_gradient)
+
     def __repr__(self):
         return (f"SparseTensor(shape={self.shape}, nnz={self.nnz}, "
                 f"dtype={self.dtype})")
@@ -72,6 +80,8 @@ class SparseTensor:
 def _dense_val(x):
     if isinstance(x, SparseTensor):
         return x._bcoo.todense()
+    if isinstance(x, CsrTensor):
+        return x._bcsr.todense()
     return x._value if isinstance(x, Tensor) else jnp.asarray(x)
 
 
@@ -88,23 +98,79 @@ def sparse_coo_tensor(indices, values, shape=None, dtype=None,
     return SparseTensor(bcoo, stop_gradient=stop_gradient)
 
 
+class CsrTensor:
+    """CSR sparse matrix over jax BCSR (reference:
+    phi/core/sparse_csr_tensor.h:32 — crows/cols/values; kernels
+    phi/kernels/sparse/ csr family). BCSR's dot_general lowers to the
+    same gather/segment-sum XLA programs as BCOO, so CSR here is a
+    first-class LAYOUT (row-slice friendly, the reference's
+    crows()/cols() surface) rather than a distinct kernel backend."""
+
+    def __init__(self, bcsr: "jsparse.BCSR", stop_gradient: bool = True):
+        self._bcsr = bcsr
+        self.stop_gradient = stop_gradient
+
+    @property
+    def shape(self):
+        return list(self._bcsr.shape)
+
+    @property
+    def dtype(self):
+        return self._bcsr.dtype
+
+    @property
+    def nnz(self) -> int:
+        return int(self._bcsr.nse)
+
+    def crows(self) -> Tensor:
+        return Tensor(self._bcsr.indptr, stop_gradient=True)
+
+    def cols(self) -> Tensor:
+        return Tensor(self._bcsr.indices, stop_gradient=True)
+
+    def values(self) -> Tensor:
+        return Tensor(self._bcsr.data, stop_gradient=True)
+
+    def to_dense(self) -> Tensor:
+        return Tensor(self._bcsr.todense(),
+                      stop_gradient=self.stop_gradient)
+
+    def to_sparse_coo(self, sparse_dim=None) -> SparseTensor:
+        return SparseTensor(self._bcsr.to_bcoo(),
+                            stop_gradient=self.stop_gradient)
+
+    def is_sparse_coo(self) -> bool:
+        return False
+
+    def is_sparse_csr(self) -> bool:
+        return True
+
+    def to_sparse_csr(self) -> "CsrTensor":
+        return self
+
+    def __repr__(self):
+        return (f"CsrTensor(shape={self.shape}, nnz={self.nnz}, "
+                f"dtype={self.dtype})")
+
+
 def sparse_csr_tensor(crows, cols, values, shape, dtype=None,
-                      place=None, stop_gradient=True) -> SparseTensor:
-    """CSR input converted to the canonical BCOO layout
-    (reference creation.py:159)."""
-    crows = np.asarray(getattr(crows, "_value", crows))
-    cols = np.asarray(getattr(cols, "_value", cols))
-    rows = np.repeat(np.arange(len(crows) - 1), np.diff(crows))
-    return sparse_coo_tensor(np.stack([rows, cols]), values, shape,
-                             dtype=dtype, stop_gradient=stop_gradient)
+                      place=None, stop_gradient=True) -> CsrTensor:
+    """CSR from components (reference creation.py:159)."""
+    crows = jnp.asarray(getattr(crows, "_value", crows), jnp.int32)
+    cols = jnp.asarray(getattr(cols, "_value", cols), jnp.int32)
+    val = jnp.asarray(getattr(values, "_value", values))
+    if dtype is not None:
+        val = val.astype(dtype)
+    bcsr = jsparse.BCSR((val, cols, crows), shape=tuple(shape))
+    return CsrTensor(bcsr, stop_gradient=stop_gradient)
 
 
 def is_sparse(x) -> bool:
-    return isinstance(x, SparseTensor)
+    return isinstance(x, (SparseTensor, CsrTensor))
 
 
 def to_dense(x) -> Tensor:
-    return x.to_dense() if isinstance(x, SparseTensor) else x
+    return x.to_dense() if isinstance(x, (SparseTensor, CsrTensor)) else x
 
 
 # -- ops (reference python/paddle/sparse/binary.py, unary.py) -----------
@@ -134,21 +200,36 @@ def multiply(x: SparseTensor, y) -> SparseTensor:
 
 
 def matmul(x, y) -> Tensor:
-    """sparse @ dense (or dense @ sparse) -> dense
-    (reference sparse/binary.py matmul over cusparse spmm)."""
-    if isinstance(x, SparseTensor) and not isinstance(y, SparseTensor):
-        return Tensor(x._bcoo @ _dense_val(y))
-    if isinstance(y, SparseTensor) and not isinstance(x, SparseTensor):
+    """sparse @ dense (or dense @ sparse) -> dense (reference
+    sparse/binary.py matmul over cusparse spmm/spgemm; COO and CSR)."""
+    xs, ys = is_sparse(x), is_sparse(y)
+    if xs and not ys:
+        op = x._bcsr if isinstance(x, CsrTensor) else x._bcoo
+        return Tensor(op @ _dense_val(y))
+    if ys and not xs:
+        if isinstance(y, CsrTensor):
+            # dense @ csr through the structured BCOO dot (no
+            # densification of the sparse operand)
+            return Tensor(_dense_val(x) @ y._bcsr.to_bcoo())
         return Tensor(_dense_val(x) @ y._bcoo)
-    if isinstance(x, SparseTensor) and isinstance(y, SparseTensor):
-        return Tensor(x._bcoo.todense() @ y._bcoo.todense())
-    raise TypeError("matmul expects at least one SparseTensor")
+    if xs and ys:
+        return Tensor(_dense_val(x) @ _dense_val(y))
+    raise TypeError("matmul expects at least one sparse tensor")
 
 
-def masked_matmul(x, y, mask: SparseTensor) -> SparseTensor:
+def masked_matmul(x, y, mask):
     """dense @ dense evaluated ONLY at mask's nonzeros (reference
-    sparse/binary.py masked_matmul / cusparse SDDMM)."""
+    sparse/binary.py masked_matmul / cusparse SDDMM). The output takes
+    the mask's layout (COO mask -> COO out, CSR mask -> CSR out)."""
     xv, yv = _dense_val(x), _dense_val(y)
+    if isinstance(mask, CsrTensor):
+        crows, cols = mask._bcsr.indptr, mask._bcsr.indices
+        rows = jnp.repeat(jnp.arange(len(crows) - 1),
+                          jnp.diff(crows),
+                          total_repeat_length=int(mask._bcsr.nse))
+        vals = jnp.einsum("nk,nk->n", xv[rows, :], yv[:, cols].T)
+        return CsrTensor(jsparse.BCSR((vals, cols, crows),
+                                      shape=tuple(mask.shape)))
     idx = mask._bcoo.indices
     rows, cols = idx[:, 0], idx[:, 1]
     vals = jnp.einsum("nk,nk->n", xv[rows, :], yv[:, cols].T)
