@@ -38,9 +38,16 @@ class TCPStore:
         self.timeout_ms = int(timeout * 1000)
         if is_master:
             bound = ctypes.c_int(0)
-            self._server = self._lib.tcpstore_server_start(
-                port, ctypes.byref(bound))
-            enforce(self._server, f"TCPStore: cannot bind port {port}")
+            # bind can transiently fail even on an OS-probed free port
+            # (TOCTOU reuse / TIME_WAIT under loaded CI) — retry briefly
+            for attempt in range(20):
+                self._server = self._lib.tcpstore_server_start(
+                    port, ctypes.byref(bound))
+                if self._server:
+                    break
+                time.sleep(0.25)
+            enforce(self._server, f"TCPStore: cannot bind port {port} "
+                                  "(20 attempts)")
             port = bound.value
         self.host, self.port = host, port
         deadline = time.time() + timeout

@@ -13,10 +13,14 @@ from ....ops import nn_ops as _nn
 from ....ops.nn_ops import fused_rope as _fused_rope
 from ....tensor import Tensor
 
+import jax
+import jax.numpy as jnp
+
 __all__ = [
     "fused_rms_norm", "fused_layer_norm",
     "fused_rotary_position_embedding", "fused_bias_act",
     "fused_dropout_add", "swiglu", "fused_linear",
+    "fused_multi_transformer", "masked_multihead_attention",
 ]
 
 
@@ -114,3 +118,182 @@ def fused_linear(x, weight, bias=None, transpose_weight=False, **kw):
     if bias is not None:
         out = out + bias
     return out
+
+
+def masked_multihead_attention(x, cache_kv=None, bias=None, src_mask=None,
+                               cum_offsets=None, sequence_lengths=None,
+                               rotary_tensor=None, beam_cache_offset=None,
+                               qkv_out_scale=None, out_shift=None,
+                               out_smooth=None, seq_len=1,
+                               rotary_emb_dims=0,
+                               use_neox_rotary_style=False,
+                               compute_dtype="default", out_scale=-1,
+                               quant_round_type=1, quant_max_bound=127.0,
+                               quant_min_bound=-127.0):
+    """One fused decode step of cache-KV attention (reference:
+    incubate/nn/functional/masked_multihead_attention.py:19 over
+    masked_multihead_attention_kernel.cu).
+
+    x: [B, 3*H*D] fused qkv of the new token; cache_kv: [2, B, H, M, D];
+    sequence_lengths: [B, 1] per-row write/attend offsets (the ragged
+    primitive of ops/pallas/decode_attention.py). Returns
+    (out [B, H*D], updated cache_kv). Quant knobs are accepted for API
+    parity; the TPU serving path quantizes via nn.quant instead."""
+    from ....ops.pallas.decode_attention import _dense_ragged
+    from ....core.enforce import enforce as _enf
+
+    xv = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+    cv = cache_kv._value if isinstance(cache_kv, Tensor) \
+        else jnp.asarray(cache_kv)
+    _enf(cv.ndim == 5 and cv.shape[0] == 2,
+         "cache_kv must be [2, B, H, max_seq, D]")
+    B = xv.shape[0]
+    _, _, H, M, D = cv.shape
+    qkv = xv.reshape(B, 3, H, D)
+    if bias is not None:
+        bv = bias._value if isinstance(bias, Tensor) else jnp.asarray(bias)
+        qkv = qkv + bv.reshape(1, 3, H, D)
+    q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]          # [B, H, D]
+    if sequence_lengths is not None:
+        sl = sequence_lengths._value if isinstance(
+            sequence_lengths, Tensor) else jnp.asarray(sequence_lengths)
+        off = sl.reshape(B).astype(jnp.int32)
+    else:
+        off = jnp.zeros((B,), jnp.int32)
+    from ....core.enforce import enforce as _enf2
+    _enf2(rotary_emb_dims == 0,
+          "masked_multihead_attention: apply rotary embeddings at the "
+          "model level (ops/nn_ops.fused_rope); the fused in-kernel "
+          "rotary path is not provided here")
+    k_cache = cv[0].at[jnp.arange(B), :, off, :].set(
+        k.astype(cv.dtype))
+    v_cache = cv[1].at[jnp.arange(B), :, off, :].set(
+        v.astype(cv.dtype))
+    out = _dense_ragged(q[:, None], k_cache, v_cache, off)
+    # (src_mask: positions beyond each row's offset are already masked
+    # by the per-row frontier inside _dense_ragged)
+    new_cache = jnp.stack([k_cache, v_cache])
+    return (Tensor(out.reshape(B, H * D), stop_gradient=True),
+            Tensor(new_cache, stop_gradient=True))
+
+
+def fused_multi_transformer(x, ln_scales, ln_biases, qkv_weights,
+                            qkv_biases, linear_weights, linear_biases,
+                            ffn_ln_scales, ffn_ln_biases, ffn1_weights,
+                            ffn1_biases, ffn2_weights, ffn2_biases,
+                            pre_layer_norm=True, epsilon=1e-5,
+                            cache_kvs=None, pre_caches=None, seq_lens=None,
+                            rotary_embs=None, time_step=None,
+                            attn_mask=None, dropout_rate=0.0,
+                            rotary_emb_dims=0, activation="gelu",
+                            training=False, mode="upscale_in_train",
+                            trans_qkvw=True, ring_id=-1, name=None,
+                            num_heads=None):
+    """Stateless functional form of the FusedMultiTransformer stack
+    (num_heads: required with 2-D [h, 3h] qkv weights; inferred from
+    the reference 4-D layout or the caches otherwise).
+    (reference: incubate/nn/functional/fused_transformer.py:964 over
+    fused_multi_transformer_op.cu.h — here the same math as
+    incubate.nn.FusedMultiTransformer._layer, with caller-owned weight
+    lists). qkv_weights: per layer [3*h, h] when trans_qkvw (reference
+    default) else [h, 3*h]. Returns out, or (out, cache_kvs) when
+    caches are passed."""
+    from ....nn import functional as F
+    from ....ops import manipulation as M
+    from ....nn.functional import flash_attention
+    from ....models.llama import _cache_attention
+
+    def val(t):
+        return t._value if isinstance(t, Tensor) else jnp.asarray(t)
+
+    xv = x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
+    B, S = xv.shape[0], xv.shape[1]
+    offset = 0
+    if time_step is not None:
+        offset = (time_step._value if isinstance(time_step, Tensor)
+                  else time_step)
+    act = {"relu": F.relu, "gelu": F.gelu, "silu": F.silu}[activation]
+    n_layers = len(qkv_weights)
+    new_caches = []
+    h = xv
+    for i in range(n_layers):
+        residual = h
+        if pre_layer_norm:
+            h = F.layer_norm(h, ln_scales[i], ln_biases[i],
+                             epsilon=epsilon)
+        qw = val(qkv_weights[i])
+        embed_dim = residual.shape[-1]
+        # reference qkv weight: [3, num_head, head_dim, h] when
+        # trans_qkvw (default) else [h, 3, num_head, head_dim]
+        if qw.ndim == 4:
+            Hn = qw.shape[1] if trans_qkvw else qw.shape[2]
+        elif num_heads is not None:
+            Hn = int(num_heads)
+        elif cache_kvs is not None:
+            Hn = (cache_kvs[i][0].shape[1]
+                  if isinstance(cache_kvs[i], (tuple, list))
+                  else val(cache_kvs[i]).shape[2])
+        else:
+            from ....core.enforce import enforce as _enf3
+
+            _enf3(False,
+                  "fused_multi_transformer: with 2-D qkv weights pass "
+                  "num_heads= (the reference's 4-D [3, num_head, "
+                  "head_dim, h] layout carries it implicitly)")
+        Dh = embed_dim // Hn
+        if trans_qkvw:
+            qw = qw.reshape(-1, qw.shape[-1]).T     # [h, 3h]
+        else:
+            qw = qw.reshape(qw.shape[0], -1)
+        qkv_v = h._value @ qw.astype(h._value.dtype)
+        if qkv_biases is not None and qkv_biases[i] is not None:
+            qkv_v = qkv_v + val(qkv_biases[i]).reshape(-1)
+        if val(qkv_weights[i]).ndim == 4:
+            # reference layout: qkv-major (q all heads, k, v)
+            qkv5 = qkv_v.reshape(B, S, 3, Hn, Dh)
+            q = Tensor(qkv5[:, :, 0])
+            k = Tensor(qkv5[:, :, 1])
+            v = Tensor(qkv5[:, :, 2])
+        else:
+            # 2-D [h, 3*h] layer convention: head-major, qkv within
+            qkv4 = M.reshape(Tensor(qkv_v), (B, S, Hn, 3 * Dh))
+            q, k, v = M.split(qkv4, 3, axis=-1)
+        if cache_kvs is not None:
+            c = cache_kvs[i]
+            if not isinstance(c, (tuple, list)):
+                cv = val(c)
+                c = (cv[0], cv[1])
+            k_cache = jax.lax.dynamic_update_slice_in_dim(
+                c[0], jnp.swapaxes(k._value, 1, 2).astype(c[0].dtype),
+                offset, axis=2)
+            v_cache = jax.lax.dynamic_update_slice_in_dim(
+                c[1], jnp.swapaxes(v._value, 1, 2).astype(c[1].dtype),
+                offset, axis=2)
+            ov = _cache_attention(q._value, k_cache, v_cache, offset, S)
+            out = Tensor(ov.reshape(B, S, embed_dim), stop_gradient=True)
+            new_caches.append((k_cache, v_cache))
+        else:
+            out = flash_attention(q, k, v, causal=True)[0]
+            out = M.reshape(out, (B, S, embed_dim))
+        out = F.linear(out, linear_weights[i], linear_biases[i])
+        h = residual + out
+        if not pre_layer_norm:
+            # post-LN: the attention block's LayerNorm applies AFTER
+            # its residual (reference pseudo-code, fused_transformer.py)
+            h = F.layer_norm(h, ln_scales[i], ln_biases[i],
+                             epsilon=epsilon)
+        residual = h
+        if pre_layer_norm:
+            f = F.layer_norm(h, ffn_ln_scales[i], ffn_ln_biases[i],
+                             epsilon=epsilon)
+        else:
+            f = h
+        f = act(F.linear(f, ffn1_weights[i], ffn1_biases[i]))
+        f = F.linear(f, ffn2_weights[i], ffn2_biases[i])
+        h = residual + f
+        if not pre_layer_norm:
+            h = F.layer_norm(h, ffn_ln_scales[i], ffn_ln_biases[i],
+                             epsilon=epsilon)
+    if cache_kvs is not None:
+        return h, new_caches
+    return h

@@ -32,7 +32,53 @@ __all__ = [
     "fractional_max_pool3d", "class_center_sample",
     "relu_", "tanh_", "softmax_", "elu_", "hardtanh_", "leaky_relu_",
     "thresholded_relu_",
+    "max_pool3d", "avg_pool3d", "max_unpool3d", "rrelu", "log_sigmoid",
+    "swiglu", "margin_cross_entropy",
 ]
+
+from ..ops.pool3d import avg_pool3d, max_pool3d, max_unpool3d  # noqa: E402,F401
+from ..ops.extra import log_sigmoid, rrelu  # noqa: E402,F401
+from ..incubate.nn.functional import swiglu  # noqa: E402,F401
+
+
+
+
+def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5,
+                         margin3=0.0, scale=64.0, group=None,
+                         return_softmax=False, reduction="mean"):
+    """ArcFace/CosFace-family margin softmax loss (reference:
+    python/paddle/nn/functional/common.py margin_cross_entropy over the
+    margin_cross_entropy kernel): logits are COSINES; the target class
+    logit becomes cos(m1*theta + m2) - m3, everything scaled by s.
+    The model-parallel form (group=) is served by
+    mp_layers.ParallelCrossEntropy over vocab-sharded logits."""
+    enforce(group is None or group is False,
+            "margin_cross_entropy(group=...) model-parallel form: use "
+            "paddle_tpu.distributed.fleet.meta_parallel.ParallelCross"
+            "Entropy on the vocab-sharded logits instead")
+    return _margin_ce(logits, label, float(margin1), float(margin2),
+                      float(margin3), float(scale), bool(return_softmax),
+                      reduction)
+
+
+@def_op("margin_cross_entropy")
+def _margin_ce(logits, label, m1, m2, m3, s, return_softmax, reduction):
+    lg = jnp.clip(logits.astype(jnp.float32), -1.0, 1.0)
+    N, C = lg.shape
+    lab = label.reshape(-1).astype(jnp.int32)
+    onehot = jax.nn.one_hot(lab, C, dtype=jnp.float32)
+    theta = jnp.arccos(lg)
+    target = jnp.cos(m1 * theta + m2) - m3
+    adj = jnp.where(onehot > 0, target, lg) * s
+    logp = jax.nn.log_softmax(adj, axis=-1)
+    loss = -jnp.sum(onehot * logp, axis=-1, keepdims=True)
+    if reduction == "mean":
+        loss = jnp.mean(loss)
+    elif reduction == "sum":
+        loss = jnp.sum(loss)
+    if return_softmax:
+        return loss, jnp.exp(logp).astype(logits.dtype)
+    return loss
 
 
 # ---------------------------------------------------------------------------

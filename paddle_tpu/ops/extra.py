@@ -529,34 +529,21 @@ def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1,
     return out
 
 
-def _pool3d(x, kernel, stride, padding, init, op, avg=False):
-    def trip(v):
-        return (int(v),) * 3 if np.isscalar(v) else tuple(int(i)
-                                                          for i in v)
-
-    k, s, p = trip(kernel), trip(stride or kernel), trip(padding)
-    dims = (1, 1) + k
-    strides = (1, 1) + s
-    pads = ((0, 0), (0, 0)) + tuple((pi, pi) for pi in p)
-    out = lax.reduce_window(x, init, op, dims, strides, pads)
-    if avg:
-        ones = jnp.ones_like(x)
-        cnt = lax.reduce_window(ones, 0.0, lax.add, dims, strides, pads)
-        out = out / cnt
-    return out
 
 
-@def_op("max_pool3d")
+# max_pool3d / avg_pool3d moved to ops/pool3d.py (full reference
+# surface: return_mask, max_unpool3d, exclusive/divisor_override);
+# thin delegations kept for the MaxPool3D/AvgPool3D layer classes
 def max_pool3d(x, kernel_size, stride=None, padding=0):
-    return _pool3d(x, kernel_size, stride, padding,
-                   -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating)
-                   else jnp.iinfo(x.dtype).min, lax.max)
+    from .pool3d import max_pool3d as _mp3
+
+    return _mp3(x, kernel_size, stride, padding)
 
 
-@def_op("avg_pool3d")
 def avg_pool3d(x, kernel_size, stride=None, padding=0):
-    return _pool3d(x.astype(jnp.float32), kernel_size, stride, padding,
-                   0.0, lax.add, avg=True).astype(x.dtype)
+    from .pool3d import avg_pool3d as _ap3
+
+    return _ap3(x, kernel_size, stride, padding, exclusive=True)
 
 
 # ---------------------------------------------------------------------------
@@ -593,6 +580,9 @@ def _rrelu(key, x, lower, upper, training):
 
 
 def rrelu(x, lower=1.0 / 8, upper=1.0 / 3, training=True, name=None):
+    enforce(0 <= lower <= upper <= 1,
+            lambda: f"rrelu needs 0 <= lower <= upper <= 1, got "
+                    f"{lower}, {upper}")
     return _rrelu(rng.get_key(), x, float(lower), float(upper),
                   bool(training))
 

@@ -1,0 +1,120 @@
+"""3-D pooling family (reference: phi/kernels/pool_kernel.cc pool3d,
+max_pool3d_with_index, unpool3d; python nn/functional/pooling.py
+max_pool3d:1241 / avg_pool3d:1108 / max_unpool3d:964 /
+adaptive_*_pool3d). XLA reduce_window handles N-d windows natively, so
+the 3-D family is the same lax program as 2-D with a depth axis."""
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.dispatch import def_op
+from ..core.enforce import enforce
+
+__all__ = ["max_pool3d", "avg_pool3d", "max_unpool3d"]
+# (adaptive_*_pool3d live in nn/functional_extra.py)
+
+
+def _t3(v):
+    return (int(v),) * 3 if np.isscalar(v) else tuple(int(i) for i in v)
+
+
+@def_op("max_pool3d")
+def _max_pool3d_op(x, kernel_size=2, stride=None, padding=0,
+                   ceil_mode=False, data_format="NCDHW"):
+    k = _t3(kernel_size)
+    s = _t3(stride if stride is not None else kernel_size)
+    p = _t3(padding)
+    init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) \
+        else jnp.iinfo(x.dtype).min
+    return lax.reduce_window(
+        x, init, lax.max, (1, 1) + k, (1, 1) + s,
+        ((0, 0), (0, 0), (p[0], p[0]), (p[1], p[1]), (p[2], p[2])))
+
+
+def max_pool3d(x, kernel_size=2, stride=None, padding=0, ceil_mode=False,
+               return_mask=False, data_format="NCDHW"):
+    enforce(data_format == "NCDHW", "max_pool3d supports NCDHW")
+    out = _max_pool3d_op(x, kernel_size, stride, padding, ceil_mode,
+                         data_format)
+    if not return_mask:
+        return out
+    return out, _max_pool3d_mask(x, kernel_size, stride, padding)
+
+
+@def_op("max_pool3d_mask", differentiable=False)
+def _max_pool3d_mask(x, kernel_size=2, stride=None, padding=0):
+    # flat argmax indices over the D*H*W volume (feeds max_unpool3d)
+    k = _t3(kernel_size)
+    s = _t3(stride if stride is not None else kernel_size)
+    p = _t3(padding)
+    B, C, D, H, W = x.shape
+    neg = jnp.finfo(jnp.float32).min
+    xp = jnp.pad(x.astype(jnp.float32),
+                 ((0, 0), (0, 0), (p[0], p[0]), (p[1], p[1]),
+                  (p[2], p[2])), constant_values=neg)
+    lin = jnp.arange(D * H * W, dtype=jnp.int32).reshape(1, 1, D, H, W)
+    lin = jnp.pad(lin, ((0, 0), (0, 0), (p[0], p[0]), (p[1], p[1]),
+                        (p[2], p[2])))
+    od = (D + 2 * p[0] - k[0]) // s[0] + 1
+    oh = (H + 2 * p[1] - k[1]) // s[1] + 1
+    ow = (W + 2 * p[2] - k[2]) // s[2] + 1
+    vals, idxs = [], []
+    for a in range(k[0]):
+        for b in range(k[1]):
+            for c in range(k[2]):
+                lim = (B, C, a + (od - 1) * s[0] + 1,
+                       b + (oh - 1) * s[1] + 1, c + (ow - 1) * s[2] + 1)
+                st = (1, 1, s[0], s[1], s[2])
+                vals.append(lax.slice(xp, (0, 0, a, b, c), lim, st))
+                idxs.append(lax.slice(lin, (0, 0, a, b, c),
+                                      (1, 1) + lim[2:], st))
+    sv = jnp.stack(vals)
+    si = jnp.stack(idxs)
+    arg = jnp.argmax(sv, axis=0)
+    flat = jnp.take_along_axis(jnp.broadcast_to(si, sv.shape),
+                               arg[None], axis=0)[0]
+    return flat.astype(jnp.int32)
+
+
+@def_op("avg_pool3d")
+def avg_pool3d(x, kernel_size=2, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None,
+               data_format="NCDHW"):
+    enforce(data_format == "NCDHW", "avg_pool3d supports NCDHW")
+    k = _t3(kernel_size)
+    s = _t3(stride if stride is not None else kernel_size)
+    p = _t3(padding)
+    pads = ((0, 0), (0, 0), (p[0], p[0]), (p[1], p[1]), (p[2], p[2]))
+    summed = lax.reduce_window(x, 0.0, lax.add, (1, 1) + k, (1, 1) + s,
+                               pads)
+    if divisor_override:
+        return summed / float(divisor_override)
+    if exclusive and any(p):
+        counts = lax.reduce_window(jnp.ones_like(x), 0.0, lax.add,
+                                   (1, 1) + k, (1, 1) + s, pads)
+        return summed / counts
+    return summed / float(np.prod(k))
+
+
+@def_op("max_unpool3d")
+def max_unpool3d(x, indices, kernel_size, stride=None, padding=0,
+                 output_size=None, data_format="NCDHW"):
+    enforce(data_format == "NCDHW", "max_unpool3d supports NCDHW")
+    B, C, od, oh, ow = x.shape
+    if output_size is not None:
+        D, H, W = (int(output_size[-3]), int(output_size[-2]),
+                   int(output_size[-1]))
+    else:
+        k = _t3(kernel_size)
+        s = _t3(stride if stride is not None else kernel_size)
+        p = _t3(padding)
+        D = (od - 1) * s[0] + k[0] - 2 * p[0]
+        H = (oh - 1) * s[1] + k[1] - 2 * p[1]
+        W = (ow - 1) * s[2] + k[2] - 2 * p[2]
+    out = jnp.zeros((B, C, D * H * W), x.dtype).at[
+        jnp.arange(B)[:, None, None], jnp.arange(C)[None, :, None],
+        indices.reshape(B, C, -1)].set(x.reshape(B, C, -1))
+    return out.reshape(B, C, D, H, W)

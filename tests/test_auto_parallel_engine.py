@@ -18,6 +18,8 @@ from paddle_tpu.distributed.auto_parallel import (Engine, ProcessMesh,
 from paddle_tpu.models import (GPTConfig, GPTForCausalLM,
                                GPTPretrainingCriterion)
 
+pytestmark = pytest.mark.slow  # multi-process / long-convergence; quick suite = -m 'not slow'
+
 
 def _cfg():
     return GPTConfig(vocab_size=256, hidden_size=64, num_layers=2,

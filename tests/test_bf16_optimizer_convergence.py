@@ -5,6 +5,7 @@ The TPU bench trains with AdamW moments stored bfloat16 (state_dtype=
 optimizer/__init__.py _cast_state_in). This guards that the loss curve
 stays inside a tolerance band of f32 moments over 200 steps — if this
 ever fails, flip the bench default or add stochastic rounding."""
+import pytest
 import numpy as np
 
 import paddle_tpu as paddle
@@ -12,6 +13,8 @@ from paddle_tpu.distributed import fleet
 from paddle_tpu.distributed.engine import ParallelEngine
 from paddle_tpu.models import (GPTConfig, GPTForCausalLM,
                                GPTPretrainingCriterion)
+
+pytestmark = pytest.mark.slow  # multi-process / long-convergence; quick suite = -m 'not slow'
 
 
 def _run(state_dtype, steps=200):

@@ -7,6 +7,8 @@ from scipy import stats as sps
 import paddle_tpu as paddle
 from paddle_tpu import distribution as D
 
+pytestmark = pytest.mark.slow  # multi-process / long-convergence; quick suite = -m 'not slow'
+
 
 def setup_function(_):
     paddle.seed(0)

@@ -1,10 +1,13 @@
 """End-to-end slice: ResNet training decreases loss; to_static compiled
 step matches eager (SURVEY.md §7 step 3 milestone)."""
+import pytest
 import numpy as np
 
 import paddle_tpu as paddle
 from paddle_tpu import nn, optimizer
 from paddle_tpu.vision.models import resnet18
+
+pytestmark = pytest.mark.slow  # multi-process / long-convergence; quick suite = -m 'not slow'
 
 
 def _data(n=8):

@@ -18,6 +18,9 @@ import sys
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow  # multi-process / long-convergence; quick suite = -m 'not slow'
+
+
 _REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
 _WORKER = os.path.join(_REPO, "tests", "workers", "elastic_worker.py")
 
@@ -39,6 +42,8 @@ def _env(rank, world, port, extra):
             del env[k]
     env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
     env["JAX_PLATFORMS"] = "cpu"
+    env["OMP_NUM_THREADS"] = "1"
+    env["OPENBLAS_NUM_THREADS"] = "1"
     env["PADDLE_TRAINER_ID"] = str(rank)
     env["PADDLE_TRAINERS_NUM"] = str(world)
     env["PADDLE_MASTER"] = f"127.0.0.1:{port}"

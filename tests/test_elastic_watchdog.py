@@ -10,6 +10,8 @@ from paddle_tpu.core import native
 from paddle_tpu.distributed.watchdog import (CommTaskManager,
                                              TimeoutError_, watch)
 
+pytestmark = pytest.mark.slow  # multi-process / long-convergence; quick suite = -m 'not slow'
+
 
 def test_watchdog_passes_fast_steps():
     mgr = CommTaskManager(timeout=5.0, poll_interval=0.05)

@@ -9,6 +9,8 @@ from paddle_tpu.distributed.engine import ParallelEngine
 from paddle_tpu.incubate.distributed.models.moe import (
     GShardGate, MoELayer, NaiveGate, SwitchGate)
 
+pytestmark = pytest.mark.slow  # multi-process / long-convergence; quick suite = -m 'not slow'
+
 
 def test_single_expert_equals_ffn():
     """E=1 top-1 MoE is exactly the dense FFN (all tokens, gate=1)."""

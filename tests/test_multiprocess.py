@@ -18,6 +18,9 @@ import sys
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow  # multi-process / long-convergence; quick suite = -m 'not slow'
+
+
 _REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
 _WORKER = os.path.join(_REPO, "tests", "workers", "mp_gpt_worker.py")
 
@@ -44,8 +47,14 @@ def _run_pod(world, dp, ndev_per_proc, out, timeout=600):
     for rank in range(world):
         env = _clean_env()
         env["XLA_FLAGS"] = (f"--xla_force_host_platform_device_count="
-                            f"{ndev_per_proc}")
+                            f"{ndev_per_proc} "
+                            "--xla_cpu_multi_thread_eigen=false "
+                            "intra_op_parallelism_threads=1")
         env["JAX_PLATFORMS"] = "cpu"
+        # thread caps: world x ndev XLA runtimes on a shared CI box
+        # oversubscribe wildly otherwise (round-4 flake source)
+        env["OMP_NUM_THREADS"] = "1"
+        env["OPENBLAS_NUM_THREADS"] = "1"
         env["PADDLE_TRAINER_ID"] = str(rank)
         env["PADDLE_TRAINERS_NUM"] = str(world)
         env["PADDLE_MASTER"] = f"127.0.0.1:{port}"

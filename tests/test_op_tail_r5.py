@@ -1,0 +1,150 @@
+"""Round-5 op-surface tail: 3-D pooling, rrelu, margin_cross_entropy,
+Adadelta/Adamax/ASGD/Rprop optimizers, functional
+fused_multi_transformer / masked_multihead_attention.
+
+Reference parity targets: phi pool3d/unpool3d kernels (torch as the
+numeric oracle), nn/functional/activation.py rrelu, functional/common
+margin_cross_entropy, python/paddle/optimizer/{adadelta,adamax,asgd,
+rprop}.py, incubate/nn/functional/fused_transformer.py:964 +
+masked_multihead_attention.py:19.
+"""
+import numpy as np
+import pytest
+import torch
+
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from paddle_tpu import nn
+
+
+def _np(t):
+    return np.asarray(t._value)
+
+
+class TestPool3D:
+    def setup_method(self, _):
+        self.x = np.random.RandomState(0).randn(2, 3, 8, 10, 12) \
+            .astype("float32")
+
+    def test_max_pool3d(self):
+        o = F.max_pool3d(paddle.to_tensor(self.x), 2, 2)
+        t = torch.nn.functional.max_pool3d(torch.tensor(self.x), 2, 2)
+        assert np.allclose(_np(o), t.numpy(), atol=1e-6)
+
+    def test_avg_pool3d_exclusive(self):
+        o = F.avg_pool3d(paddle.to_tensor(self.x), 3, 2, 1)
+        t = torch.nn.functional.avg_pool3d(torch.tensor(self.x), 3, 2, 1,
+                                           count_include_pad=False)
+        assert np.allclose(_np(o), t.numpy(), atol=1e-5)
+
+    def test_unpool3d_roundtrip(self):
+        o, idx = F.max_pool3d(paddle.to_tensor(self.x), 2, 2,
+                              return_mask=True)
+        u = F.max_unpool3d(o, idx, 2, 2)
+        tt, tidx = torch.nn.functional.max_pool3d(
+            torch.tensor(self.x), 2, 2, return_indices=True)
+        tu = torch.nn.functional.max_unpool3d(tt, tidx, 2, 2)
+        assert np.allclose(_np(u), tu.numpy())
+
+
+class TestActivationsLosses:
+    def test_rrelu(self):
+        x = np.random.RandomState(1).randn(64).astype("float32")
+        o = _np(F.rrelu(paddle.to_tensor(x), training=False))
+        assert np.allclose(o, np.where(x >= 0, x, x * (1 / 8 + 1 / 3) / 2),
+                           atol=1e-6)
+        ot = _np(F.rrelu(paddle.to_tensor(x), training=True))
+        neg = x < 0
+        assert (ot[~neg] == x[~neg]).all()
+        ratio = ot[neg] / x[neg]
+        assert (ratio >= 1 / 8 - 1e-6).all() and (ratio <= 1 / 3 + 1e-6).all()
+
+    def test_margin_cross_entropy_reduces_to_softmax_ce(self):
+        r = np.random.RandomState(2)
+        cos = np.clip(r.randn(4, 10) / 3, -1, 1).astype("float32")
+        lab = r.randint(0, 10, (4,))
+        ours = float(_np(F.margin_cross_entropy(
+            paddle.to_tensor(cos), paddle.to_tensor(lab),
+            margin1=1.0, margin2=0.0, margin3=0.0, scale=10.0)))
+        ref = torch.nn.functional.cross_entropy(
+            torch.tensor(cos) * 10.0, torch.tensor(lab))
+        assert abs(ours - float(ref)) < 1e-5
+
+    def test_margin_cross_entropy_arcface_margin_raises_loss(self):
+        r = np.random.RandomState(3)
+        cos = np.clip(r.randn(4, 10) / 3, -1, 1).astype("float32")
+        lab = r.randint(0, 10, (4,))
+        plain = float(_np(F.margin_cross_entropy(
+            paddle.to_tensor(cos), paddle.to_tensor(lab),
+            margin1=1.0, margin2=0.0, margin3=0.0)))
+        arc = float(_np(F.margin_cross_entropy(
+            paddle.to_tensor(cos), paddle.to_tensor(lab),
+            margin1=1.0, margin2=0.5, margin3=0.0)))
+        assert arc > plain  # margin makes the target harder
+
+
+@pytest.mark.parametrize("cls", ["Adadelta", "Adamax", "ASGD", "Rprop"])
+def test_optimizer_tail_converges(cls):
+    paddle.seed(0)
+    r = np.random.RandomState(4)
+    m = nn.Linear(4, 2)
+    opt = getattr(paddle.optimizer, cls)(learning_rate=0.05,
+                                         parameters=m.parameters())
+    X = paddle.to_tensor(r.randn(16, 4).astype("float32"))
+    y = paddle.to_tensor(r.randint(0, 2, (16,)))
+    l0 = None
+    for _ in range(30):
+        loss = nn.functional.cross_entropy(m(X), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        l0 = l0 or float(loss.numpy())
+    assert float(loss.numpy()) < l0
+
+
+class TestIncubateFunctional:
+    def test_fused_multi_transformer_matches_layer(self):
+        import paddle_tpu.incubate.nn.functional as IF
+        from paddle_tpu.incubate.nn import FusedMultiTransformer
+
+        paddle.seed(0)
+        E, H, FF, L = 32, 4, 64, 2
+        layer = FusedMultiTransformer(E, H, FF, num_layers=L)
+        x = paddle.to_tensor(
+            np.random.RandomState(0).randn(2, 6, E).astype("float32"))
+        ref = layer(x)
+        out = IF.fused_multi_transformer(
+            x, layer.ln_scales, layer.ln_biases,
+            layer.qkv_weights, layer.qkv_biases,
+            layer.linear_weights, layer.linear_biases,
+            layer.ffn_ln_scales, layer.ffn_ln_biases,
+            layer.ffn1_weights, layer.ffn1_biases,
+            layer.ffn2_weights, layer.ffn2_biases,
+            trans_qkvw=False, num_heads=H)
+        assert np.abs(_np(out) - _np(ref)).max() < 1e-5
+
+    def test_masked_multihead_attention_step(self):
+        import paddle_tpu.incubate.nn.functional as IF
+        from paddle_tpu.ops.pallas.decode_attention import _dense_ragged
+
+        r = np.random.RandomState(1)
+        B, H, M, D = 2, 4, 16, 8
+        lens = np.array([[3], [5]], np.int32)
+        ckv = jnp.stack([jnp.asarray(r.randn(B, H, M, D), jnp.float32),
+                         jnp.asarray(r.randn(B, H, M, D), jnp.float32)])
+        xq = r.randn(B, 3 * H * D).astype("float32")
+        out, new_ckv = IF.masked_multihead_attention(
+            paddle.to_tensor(xq), paddle.to_tensor(ckv),
+            sequence_lengths=paddle.to_tensor(lens))
+        q = xq.reshape(B, 3, H, D)[:, 0]
+        kn, vn = _np(new_ckv)[0], _np(new_ckv)[1]
+        ref = _dense_ragged(jnp.asarray(q)[:, None], jnp.asarray(kn),
+                            jnp.asarray(vn),
+                            jnp.asarray(lens.reshape(-1)))
+        assert np.abs(_np(out).reshape(B, 1, H, D)
+                      - np.asarray(ref)).max() < 1e-5
+        # the new kv landed at each row's own position (ragged write)
+        assert np.allclose(kn[0, :, 3, :], xq.reshape(B, 3, H, D)[0, 1])
+        assert np.allclose(kn[1, :, 5, :], xq.reshape(B, 3, H, D)[1, 1])

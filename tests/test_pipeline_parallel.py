@@ -14,6 +14,8 @@ from paddle_tpu.distributed.fleet.meta_parallel import (LayerDesc,
 from paddle_tpu.models import GPTForCausalLMPipe
 from paddle_tpu.models.gpt import GPTConfig
 
+pytestmark = pytest.mark.slow  # multi-process / long-convergence; quick suite = -m 'not slow'
+
 
 def gpt_tiny4():
     return GPTConfig(vocab_size=256, hidden_size=64, num_layers=4,

@@ -9,6 +9,8 @@ import pytest
 
 import paddle_tpu as paddle
 
+pytestmark = pytest.mark.slow  # multi-process / long-convergence; quick suite = -m 'not slow'
+
 
 def test_profiler_records_ops_and_exports(tmp_path):
     import paddle_tpu.profiler as profiler

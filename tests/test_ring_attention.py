@@ -12,6 +12,8 @@ from paddle_tpu.ops.attention import flash_attention
 from paddle_tpu.ops.ring_attention import ring_attention, \
     ring_flash_attention
 
+pytestmark = pytest.mark.slow  # multi-process / long-convergence; quick suite = -m 'not slow'
+
 
 def test_ring_equals_flash_single_device():
     """axes=() ring (one block) reproduces plain causal attention."""

@@ -1,10 +1,14 @@
 """Host-side RPC over the native TCPStore agent (reference:
 python/paddle/distributed/rpc over the brpc agent)."""
+import pytest
 import json
 import os
 import socket
 import subprocess
 import sys
+
+pytestmark = pytest.mark.slow  # multi-process / long-convergence; quick suite = -m 'not slow'
+
 
 _REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
 _WORKER = os.path.join(_REPO, "tests", "workers", "rpc_worker.py")
@@ -28,6 +32,8 @@ def _run_rpc_pair(tmp_path):
     procs = []
     for rank in range(2):
         env = dict(os.environ)
+        env["OMP_NUM_THREADS"] = "1"
+        env["OPENBLAS_NUM_THREADS"] = "1"
         env["PADDLE_TRAINER_ID"] = str(rank)
         env["PADDLE_TRAINERS_NUM"] = "2"
         env["PADDLE_MASTER"] = f"127.0.0.1:{port}"

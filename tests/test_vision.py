@@ -13,6 +13,8 @@ from paddle_tpu.vision import datasets, transforms as T
 from paddle_tpu.vision.models import (LeNet, MobileNetV2, mobilenet_v2,
                                       vgg11)
 
+pytestmark = pytest.mark.slow  # multi-process / long-convergence; quick suite = -m 'not slow'
+
 
 def test_transforms_pipeline():
     img = np.random.RandomState(0).randint(0, 256, (40, 60, 3),

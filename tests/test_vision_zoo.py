@@ -7,6 +7,8 @@ import pytest
 import paddle_tpu as paddle
 from paddle_tpu.vision import models as M
 
+pytestmark = pytest.mark.slow  # multi-process / long-convergence; quick suite = -m 'not slow'
+
 
 def _run(model, hw):
     model.eval()

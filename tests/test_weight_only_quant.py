@@ -6,6 +6,10 @@ import numpy as np
 import paddle_tpu as paddle
 from paddle_tpu import nn
 
+import pytest
+
+pytestmark = pytest.mark.slow  # multi-process / long-convergence; quick suite = -m 'not slow'
+
 
 def _setup():
     rng = np.random.RandomState(0)
