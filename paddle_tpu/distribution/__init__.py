@@ -24,6 +24,39 @@ __all__ = ["Distribution", "Normal", "Uniform", "Categorical", "Bernoulli",
            "register_kl"]
 
 
+def _tape_through(name, fn, *args):
+    """Run a pure-jnp fn over mixed Tensor/array args, recording a
+    replayable tape node so ``backward()`` flows into the Tensor args.
+
+    Uses the engine's _TapedFnNode (the pure-fn/vjp-at-apply node): it
+    filters jax float0 cotangents (integer-valued inputs, e.g. a
+    Categorical's values) and supports create_graph re-taping, so
+    higher-order gradients through densities/transforms work."""
+    from ..autograd import engine
+
+    tensors = [a if isinstance(a, Tensor) else None for a in args]
+    vals = [a._value if isinstance(a, Tensor) else jnp.asarray(a)
+            for a in args]
+    out_val = fn(*vals)
+    track = engine.is_grad_enabled() and any(
+        t is not None and not t.stop_gradient for t in tensors)
+    out = Tensor(out_val, stop_gradient=not track)
+    if track:
+        edges = []
+        for t in tensors:
+            if t is None or t.stop_gradient:
+                edges.append(None)
+            elif t._grad_node is not None:
+                edges.append(("node", t._grad_node, t._out_idx))
+            else:
+                edges.append(("leaf", t))
+        node = engine._TapedFnNode(name, lambda *a: (fn(*a),), vals,
+                                   (out_val,), edges)
+        out._grad_node = node
+        out._out_idx = 0
+    return out
+
+
 def _val(x):
     if isinstance(x, Tensor):
         return x._value
@@ -56,8 +89,38 @@ class Distribution:
     def log_prob(self, value):
         raise NotImplementedError
 
+    def __init_subclass__(cls, **kw):
+        """Make every family's ``log_prob`` tape-differentiable w.r.t.
+        ``value`` in ONE place: the subclass impls are pure jnp math
+        (scipy-parity), so a requires-grad input routes through
+        ``_tape_through`` (jax.vjp recorded as a custom tape node) and
+        ``loss.backward()`` through log_prob works — the score-matching
+        / VAE-reconstruction path of the reference's op-built
+        distributions. Gradients w.r.t. distribution PARAMETERS require
+        parameters kept as live network outputs (reference dygraph);
+        here constructor params are frozen arrays by design."""
+        super().__init_subclass__(**kw)
+        impl = cls.__dict__.get("log_prob")
+        if impl is not None:
+            def log_prob(self, value, _impl=impl, _cls=cls):
+                from ..autograd import engine as _eng
+
+                if (isinstance(value, Tensor) and not value.stop_gradient
+                        and _eng.is_grad_enabled()):
+                    return _tape_through(
+                        f"{_cls.__name__}_log_prob",
+                        lambda v: _impl(self, Tensor(
+                            v, stop_gradient=True))._value,
+                        value)
+                return _impl(self, value)
+
+            cls.log_prob = log_prob
+
     def prob(self, value):
-        return Tensor(jnp.exp(self.log_prob(value)._value))
+        # dispatched exp keeps the taped log_prob's gradient path alive
+        from ..ops import math as _m
+
+        return _m.exp(self.log_prob(value))
 
     def entropy(self):
         raise NotImplementedError

@@ -298,19 +298,35 @@ class Independent(Distribution):
 # transforms (reference: python/paddle/distribution/transform.py)
 # ---------------------------------------------------------------------------
 class Transform:
-    """Bijection with log|det J| (reference transform.py Transform)."""
+    """Bijection with log|det J| (reference transform.py Transform).
+
+    The four public methods route requires-grad inputs through the
+    autograd tape (``_tape_through``): subclasses implement pure-jnp
+    ``_forward/_inverse/_fldj`` and gradients w.r.t. the VALUE come for
+    free (normalizing-flow training), matching the reference's op-built
+    transforms."""
+
+    def _taped(self, name, impl, x):
+        from . import _tape_through
+
+        return _tape_through(f"{type(self).__name__}.{name}", impl, x)
 
     def forward(self, x):
-        return Tensor(self._forward(_val(x)))
+        return self._taped("forward", self._forward,
+                           x if isinstance(x, Tensor) else _val(x))
 
     def inverse(self, y):
-        return Tensor(self._inverse(_val(y)))
+        return self._taped("inverse", self._inverse,
+                           y if isinstance(y, Tensor) else _val(y))
 
     def forward_log_det_jacobian(self, x):
-        return Tensor(self._fldj(_val(x)))
+        return self._taped("fldj", self._fldj,
+                           x if isinstance(x, Tensor) else _val(x))
 
     def inverse_log_det_jacobian(self, y):
-        return Tensor(-self._fldj(self._inverse(_val(y))))
+        return self._taped("ildj",
+                           lambda v: -self._fldj(self._inverse(v)),
+                           y if isinstance(y, Tensor) else _val(y))
 
     def __call__(self, x):
         return self.forward(x)

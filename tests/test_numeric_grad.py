@@ -1,0 +1,135 @@
+"""OpTest-style numeric-gradient checks for families that previously
+had forward-only coverage (round-4 verdict item 10): vision ops
+(roi_align, deform_conv2d, grid_sample, affine_grid) and distribution
+transforms (log-prob / log-det-jacobian gradients).
+
+Method mirrors the reference's OpTest.check_grad (test/legacy_test/
+op_test.py): central finite differences on a scalar projection of the
+op output vs the autograd gradient.
+"""
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from paddle_tpu.tensor import Tensor
+
+EPS = 1e-3
+
+
+def _num_grad(fn, x, eps=EPS):
+    """Central-difference gradient of scalar fn at numpy point x."""
+    g = np.zeros_like(x, dtype=np.float64)
+    flat = x.reshape(-1)
+    gf = g.reshape(-1)
+    for i in range(flat.size):
+        old = flat[i]
+        flat[i] = old + eps
+        fp = fn(x)
+        flat[i] = old - eps
+        fm = fn(x)
+        flat[i] = old
+        gf[i] = (fp - fm) / (2 * eps)
+    return g
+
+
+def _auto_grad(op, x_np, *rest):
+    x = Tensor(paddle.to_tensor(x_np)._value, stop_gradient=False)
+    out = op(x, *rest)
+    (out.sum()).backward()
+    return np.asarray(x.grad._value)
+
+
+def _check(op, x_np, *rest, rtol=5e-2, atol=5e-3):
+    def scalar(v):
+        with paddle.no_grad():
+            return float(np.asarray(
+                op(paddle.to_tensor(v.astype("float32")),
+                   *rest).sum()._value))
+
+    num = _num_grad(scalar, x_np.astype(np.float64).copy())
+    auto = _auto_grad(op, x_np.astype("float32"), *rest)
+    np.testing.assert_allclose(auto, num, rtol=rtol, atol=atol)
+
+
+class TestVisionOpGrads:
+    def test_roi_align_input_grad(self):
+        from paddle_tpu.vision.ops import roi_align
+
+        r = np.random.RandomState(0)
+        x = r.randn(1, 2, 8, 8).astype(np.float64)
+        boxes = paddle.to_tensor(
+            np.array([[1.0, 1.0, 6.0, 6.0]], "float32"))
+        bn = paddle.to_tensor(np.array([1], "int32"))
+        _check(lambda t: roi_align(t, boxes, bn, 2, spatial_scale=1.0),
+               x)
+
+    def test_deform_conv2d_grads(self):
+        from paddle_tpu.vision.ops import deform_conv2d
+
+        r = np.random.RandomState(1)
+        x = r.randn(1, 2, 5, 5).astype(np.float64) * 0.5
+        # 3x3 kernel -> offset channels 2*3*3
+        off = paddle.to_tensor(
+            (r.randn(1, 18, 3, 3) * 0.1).astype("float32"))
+        w = paddle.to_tensor(r.randn(3, 2, 3, 3).astype("float32") * 0.3)
+        _check(lambda t: deform_conv2d(t, off, w), x)
+
+    def test_grid_sample_grads_wrt_input_and_grid(self):
+        r = np.random.RandomState(2)
+        x = r.randn(1, 2, 4, 4).astype(np.float64)
+        grid_np = (r.rand(1, 3, 3, 2) * 1.6 - 0.8).astype(np.float64)
+        grid_t = paddle.to_tensor(grid_np.astype("float32"))
+        _check(lambda t: F.grid_sample(t, grid_t, align_corners=True), x)
+
+        # grad w.r.t. the GRID (the bilinear sampling positions)
+        x_t = paddle.to_tensor(x.astype("float32"))
+
+        def scalar(gv):
+            with paddle.no_grad():
+                return float(np.asarray(F.grid_sample(
+                    x_t, paddle.to_tensor(gv.astype("float32")),
+                    align_corners=True).sum()._value))
+
+        num = _num_grad(scalar, grid_np.copy())
+        g = Tensor(paddle.to_tensor(grid_np.astype("float32"))._value,
+                   stop_gradient=False)
+        F.grid_sample(x_t, g, align_corners=True).sum().backward()
+        np.testing.assert_allclose(np.asarray(g.grad._value), num,
+                                   rtol=5e-2, atol=5e-3)
+
+    def test_affine_grid_grad(self):
+        r = np.random.RandomState(3)
+        theta = r.randn(1, 2, 3).astype(np.float64) * 0.5
+        _check(lambda t: F.affine_grid(t, [1, 1, 3, 3],
+                                       align_corners=True), theta)
+
+
+class TestDistributionGrads:
+    def test_normal_log_prob_grad_wrt_value(self):
+        from paddle_tpu.distribution import Normal
+
+        d = Normal(loc=0.5, scale=1.3)
+        x = np.array([0.1, -0.4, 1.2], np.float64)
+        _check(lambda t: d.log_prob(t), x)
+
+    def test_transformed_log_det_jacobian_grads(self):
+        from paddle_tpu.distribution.extra import (AffineTransform,
+                                                   SigmoidTransform)
+
+        r = np.random.RandomState(4)
+        x = r.randn(5).astype(np.float64)
+        aff = AffineTransform(paddle.to_tensor(np.float32(0.3)),
+                              paddle.to_tensor(np.float32(1.7)))
+        _check(lambda t: aff.forward_log_det_jacobian(t) + aff.forward(t),
+               x)
+        sig = SigmoidTransform()
+        _check(lambda t: sig.forward_log_det_jacobian(t) + sig.forward(t),
+               x)
+
+    def test_gamma_log_prob_grad(self):
+        from paddle_tpu.distribution import Gamma
+
+        d = Gamma(paddle.to_tensor(np.float32(2.0)),
+                  paddle.to_tensor(np.float32(1.5)))
+        x = np.array([0.4, 1.1, 2.5], np.float64)
+        _check(lambda t: d.log_prob(t), x)
