@@ -35,7 +35,10 @@ os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
 
 import jax
 
-if jax.default_backend() not in ("tpu",):
+# default to the CPU mesh; PP_MEASURE_TPU=1 opts into real hardware
+# (probing jax.default_backend() would initialize — and hang/fail on —
+# the axon backend when the tunnel is down)
+if os.environ.get("PP_MEASURE_TPU") != "1":
     jax.config.update("jax_platforms", "cpu")
 
 import numpy as np
