@@ -27,7 +27,8 @@ from ..core.enforce import enforce
 from ..tensor import Tensor, to_tensor
 
 __all__ = ["nms", "roi_align", "RoIAlign", "roi_pool", "RoIPool",
-           "psroi_pool", "PSRoIPool", "box_coder", "yolo_box", "prior_box",
+           "psroi_pool", "PSRoIPool", "box_coder", "yolo_box",
+           "yolo_loss", "prior_box",
            "distribute_fpn_proposals", "deform_conv2d", "DeformConv2D",
            "ConvNormActivation", "read_file", "decode_jpeg"]
 
@@ -803,3 +804,143 @@ def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,
 
 
 __all__ = __all__ + ["matrix_nms", "generate_proposals"]
+
+
+@def_op("yolo_loss")
+def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+              ignore_thresh, downsample_ratio, gt_score=None,
+              use_label_smooth=True, name=None, scale_x_y=1.0):
+    """YOLOv3 training loss (reference: vision/ops.py:58 yolo_loss over
+    phi yolo_loss kernel). TPU redesign: the per-gt anchor assignment
+    and target scatter are vectorized jnp (scatter into [N,S,H,W]
+    target maps) instead of the kernel's per-box loops; the three parts
+    (sigmoid-CE xy + weighted L1 wh, objectness with IoU-ignore, and
+    per-class sigmoid CE with label smoothing) match the reference
+    formulation. Returns the per-image loss [N]."""
+    anchors = [float(a) for a in anchors]
+    amask = [int(m) for m in anchor_mask]
+    S = len(amask)
+    N, C, H, W = x.shape
+    Bb = gt_box.shape[1]
+    Cn = int(class_num)
+    enforce(C == S * (5 + Cn),
+            lambda: f"yolo_loss: C={C} != len(anchor_mask)*(5+class_num)"
+                    f"={S * (5 + Cn)}")
+    in_w = float(downsample_ratio * W)
+    in_h = float(downsample_ratio * H)
+    aw_all = jnp.asarray(anchors[0::2], jnp.float32)      # [A]
+    ah_all = jnp.asarray(anchors[1::2], jnp.float32)
+    aw = aw_all[jnp.asarray(amask)]                        # [S]
+    ah = ah_all[jnp.asarray(amask)]
+
+    xf = x.astype(jnp.float32).reshape(N, S, 5 + Cn, H, W)
+    tx, ty = xf[:, :, 0], xf[:, :, 1]                      # [N,S,H,W]
+    tw, th = xf[:, :, 2], xf[:, :, 3]
+    tobj = xf[:, :, 4]
+    tcls = xf[:, :, 5:]                                    # [N,S,Cn,H,W]
+
+    gb = gt_box.astype(jnp.float32)                        # [N,B,4] cx cy w h
+    gl = gt_label.astype(jnp.int32)
+    valid = gb[..., 2] > 0                                 # [N,B]
+    gs = (gt_score.astype(jnp.float32) if gt_score is not None
+          else jnp.ones((N, Bb), jnp.float32))
+
+    # best anchor per gt over ALL anchors: IoU of origin-centered (w,h)
+    gw_pix = gb[..., 2] * in_w                             # [N,B]
+    gh_pix = gb[..., 3] * in_h
+    inter = jnp.minimum(gw_pix[..., None], aw_all) * \
+        jnp.minimum(gh_pix[..., None], ah_all)             # [N,B,A]
+    union = gw_pix[..., None] * gh_pix[..., None] + \
+        aw_all * ah_all - inter
+    an_iou = inter / jnp.maximum(union, 1e-10)
+    best = jnp.argmax(an_iou, axis=-1)                     # [N,B]
+    # slot within this head (or -1 if the best anchor belongs elsewhere)
+    slot = jnp.full((N, Bb), -1, jnp.int32)
+    for si, a in enumerate(amask):
+        slot = jnp.where(best == a, si, slot)
+    assigned = valid & (slot >= 0)                         # [N,B]
+
+    gi = jnp.clip((gb[..., 0] * W).astype(jnp.int32), 0, W - 1)
+    gj = jnp.clip((gb[..., 1] * H).astype(jnp.int32), 0, H - 1)
+    n_idx = jnp.broadcast_to(jnp.arange(N)[:, None], (N, Bb))
+    s_g = jnp.clip(slot, 0)
+
+    # PER-GT accumulation (gather, not scatter): two gts sharing a cell
+    # each contribute their own xy/wh/cls terms, exactly like the
+    # reference kernel's per-box loop
+    def sce(logit, target):
+        # sigmoid cross entropy, numerically stable
+        return jnp.maximum(logit, 0) - logit * target + \
+            jnp.log1p(jnp.exp(-jnp.abs(logit)))
+
+    wpos = jnp.where(assigned,
+                     gs * (2.0 - gb[..., 2] * gb[..., 3]), 0.0)  # [N,B]
+    txg = tx[n_idx, s_g, gj, gi]
+    tyg = ty[n_idx, s_g, gj, gi]
+    twg = tw[n_idx, s_g, gj, gi]
+    thg = th[n_idx, s_g, gj, gi]
+    loss_xy = (sce(txg, gb[..., 0] * W - gi)
+               + sce(tyg, gb[..., 1] * H - gj)) * wpos
+    loss_wh = (jnp.abs(twg - jnp.log(jnp.maximum(
+        gw_pix / jnp.maximum(aw[s_g], 1e-10), 1e-10)))
+        + jnp.abs(thg - jnp.log(jnp.maximum(
+            gh_pix / jnp.maximum(ah[s_g], 1e-10), 1e-10)))) * wpos
+
+    smooth_pos = 1.0 - 1.0 / Cn if (use_label_smooth and Cn > 1) else 1.0
+    smooth_neg = 1.0 / Cn if (use_label_smooth and Cn > 1) else 0.0
+    tclsg = tcls[n_idx[..., None], s_g[..., None],
+                 jnp.arange(Cn)[None, None, :], gj[..., None],
+                 gi[..., None]]                            # [N,B,Cn]
+    cls_t = jnp.where(jnp.arange(Cn)[None, None] == gl[..., None],
+                      smooth_pos, smooth_neg)
+    loss_cls = jnp.sum(sce(tclsg, cls_t), axis=-1) \
+        * jnp.where(assigned, gs, 0.0)
+
+    # objectness target map (cell-level, set: a cell is positive once)
+    s_idx = jnp.where(assigned, slot, S)   # OOB -> dropped by scatter
+
+    def scat(vals):
+        return jnp.zeros((N, S, H, W), jnp.float32) \
+            .at[n_idx, s_idx, gj, gi].set(vals)
+
+    obj_t = scat(jnp.ones((N, Bb), jnp.float32))
+    score_t = scat(gs)
+
+    # objectness: decode predictions, ignore where best IoU vs any gt
+    # exceeds ignore_thresh (and the cell has no assigned gt)
+    grid_x = jnp.arange(W, dtype=jnp.float32)[None, None, None, :]
+    grid_y = jnp.arange(H, dtype=jnp.float32)[None, None, :, None]
+    px = (jax.nn.sigmoid(tx) * scale_x_y - (scale_x_y - 1) / 2
+          + grid_x) / W
+    py = (jax.nn.sigmoid(ty) * scale_x_y - (scale_x_y - 1) / 2
+          + grid_y) / H
+    pw = jnp.exp(tw) * aw[None, :, None, None] / in_w
+    ph = jnp.exp(th) * ah[None, :, None, None] / in_h
+
+    def c2e(cx, cy, w, h):
+        return cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2
+
+    px1, py1, px2, py2 = c2e(px, py, pw, ph)               # [N,S,H,W]
+    gx1, gy1, gx2, gy2 = c2e(gb[..., 0], gb[..., 1], gb[..., 2],
+                             gb[..., 3])                   # [N,B]
+    ew = jnp.maximum(
+        jnp.minimum(px2[:, :, :, :, None], gx2[:, None, None, None])
+        - jnp.maximum(px1[:, :, :, :, None], gx1[:, None, None, None]),
+        0.0)
+    eh = jnp.maximum(
+        jnp.minimum(py2[:, :, :, :, None], gy2[:, None, None, None])
+        - jnp.maximum(py1[:, :, :, :, None], gy1[:, None, None, None]),
+        0.0)
+    inter_p = ew * eh                                      # [N,S,H,W,B]
+    area_p = (pw * ph)[:, :, :, :, None]
+    area_g = (gb[..., 2] * gb[..., 3])[:, None, None, None]
+    iou_p = inter_p / jnp.maximum(area_p + area_g - inter_p, 1e-10)
+    iou_p = jnp.where(valid[:, None, None, None], iou_p, 0.0)
+    ignore = (jnp.max(iou_p, axis=-1) > float(ignore_thresh)) \
+        & (obj_t == 0)
+    loss_obj = sce(tobj, obj_t) * jnp.where(
+        obj_t > 0, score_t, jnp.where(ignore, 0.0, 1.0))
+
+    per_img = jnp.sum(loss_xy + loss_wh + loss_cls, axis=1) \
+        + jnp.sum(loss_obj, axis=(1, 2, 3))
+    return per_img.astype(x.dtype)

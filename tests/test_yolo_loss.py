@@ -1,0 +1,169 @@
+"""yolo_loss parity vs an independent naive-loop numpy reference
+(reference op: python/paddle/vision/ops.py:58 over the phi yolo_loss
+kernel; formulation from the YOLOv3 loss definition in the reference
+docstring: sigmoid-CE xy + weighted L1 wh at assigned anchors,
+objectness with IoU-ignore, per-class sigmoid CE with label smoothing).
+"""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.tensor import Tensor
+from paddle_tpu.vision.ops import yolo_loss
+
+
+def _sce(logit, target):
+    return np.maximum(logit, 0) - logit * target + \
+        np.log1p(np.exp(-np.abs(logit)))
+
+
+def _sig(v):
+    return 1.0 / (1.0 + np.exp(-v))
+
+
+def naive_yolo_loss(x, gt_box, gt_label, anchors, amask, Cn,
+                    ignore_thresh, ds, gt_score=None, smooth=True,
+                    scale_x_y=1.0):
+    N, C, H, W = x.shape
+    S = len(amask)
+    B = gt_box.shape[1]
+    in_w, in_h = ds * W, ds * H
+    xf = x.reshape(N, S, 5 + Cn, H, W).astype(np.float64)
+    gs = gt_score if gt_score is not None else np.ones((N, B))
+    aw = np.asarray(anchors[0::2], float)
+    ah = np.asarray(anchors[1::2], float)
+    out = np.zeros(N)
+    for n in range(N):
+        obj_t = np.zeros((S, H, W))
+        score_t = np.zeros((S, H, W))
+        ignore = np.zeros((S, H, W), bool)
+        loss = 0.0
+        # per-gt assignment
+        for b in range(B):
+            cx, cy, w, h = gt_box[n, b]
+            if w <= 0:
+                continue
+            gw, gh = w * in_w, h * in_h
+            inter = np.minimum(gw, aw) * np.minimum(gh, ah)
+            iou = inter / (gw * gh + aw * ah - inter)
+            best = int(np.argmax(iou))
+            if best not in amask:
+                continue
+            s = amask.index(best)
+            gi, gj = min(int(cx * W), W - 1), min(int(cy * H), H - 1)
+            obj_t[s, gj, gi] = 1.0
+            score_t[s, gj, gi] = gs[n, b]
+            bw = 2.0 - w * h
+            wgt = gs[n, b] * bw
+            tx, ty = xf[n, s, 0, gj, gi], xf[n, s, 1, gj, gi]
+            tw, th = xf[n, s, 2, gj, gi], xf[n, s, 3, gj, gi]
+            loss += (_sce(tx, cx * W - gi) + _sce(ty, cy * H - gj)) * wgt
+            loss += (abs(tw - np.log(gw / anchors[2 * best]))
+                     + abs(th - np.log(gh / anchors[2 * best + 1]))) * wgt
+            # classification at the assigned cell
+            pos = 1.0 - 1.0 / Cn if (smooth and Cn > 1) else 1.0
+            neg = 1.0 / Cn if (smooth and Cn > 1) else 0.0
+            for c in range(Cn):
+                t = pos if c == gt_label[n, b] else neg
+                loss += _sce(xf[n, s, 5 + c, gj, gi], t) * gs[n, b]
+        # objectness with IoU-ignore over decoded predictions
+        for s in range(S):
+            a = amask[s]
+            for gj in range(H):
+                for gi in range(W):
+                    px = (_sig(xf[n, s, 0, gj, gi]) * scale_x_y
+                          - (scale_x_y - 1) / 2 + gi) / W
+                    py = (_sig(xf[n, s, 1, gj, gi]) * scale_x_y
+                          - (scale_x_y - 1) / 2 + gj) / H
+                    pw = np.exp(xf[n, s, 2, gj, gi]) * aw[a] / in_w
+                    ph = np.exp(xf[n, s, 3, gj, gi]) * ah[a] / in_h
+                    best_iou = 0.0
+                    for b in range(B):
+                        cx, cy, w, h = gt_box[n, b]
+                        if w <= 0:
+                            continue
+                        ix = max(min(px + pw / 2, cx + w / 2)
+                                 - max(px - pw / 2, cx - w / 2), 0)
+                        iy = max(min(py + ph / 2, cy + h / 2)
+                                 - max(py - ph / 2, cy - h / 2), 0)
+                        inter = ix * iy
+                        best_iou = max(best_iou, inter /
+                                       (pw * ph + w * h - inter + 1e-10))
+                    if obj_t[s, gj, gi] > 0:
+                        loss += _sce(xf[n, s, 4, gj, gi], 1.0) \
+                            * score_t[s, gj, gi]
+                    elif best_iou <= ignore_thresh:
+                        loss += _sce(xf[n, s, 4, gj, gi], 0.0)
+        out[n] = loss
+    return out
+
+
+def _case(seed=0, gt_score=False, smooth=True, scale_x_y=1.0):
+    r = np.random.RandomState(seed)
+    N, Cn, H, W = 2, 4, 4, 4
+    anchors = [10, 13, 16, 30, 33, 23, 30, 61, 62, 45, 59, 119]
+    amask = [0, 1, 2]
+    S, ds = len(amask), 32
+    x = (r.randn(N, S * (5 + Cn), H, W) * 0.2).astype("float32")
+    gt = np.zeros((N, 3, 4), "float32")
+    gt[0, 0] = [0.3, 0.4, 0.1, 0.15]
+    gt[0, 1] = [0.8, 0.7, 0.05, 0.08]
+    gt[1, 0] = [0.6, 0.2, 0.25, 0.2]
+    gl = np.zeros((N, 3), "int32")
+    gl[0, 0], gl[0, 1], gl[1, 0] = 2, 1, 3
+    gs = (r.rand(N, 3).astype("float32") * 0.5 + 0.5) if gt_score \
+        else None
+    ours = np.asarray(yolo_loss(
+        paddle.to_tensor(x), paddle.to_tensor(gt), paddle.to_tensor(gl),
+        anchors, amask, Cn, 0.7, ds,
+        gt_score=paddle.to_tensor(gs) if gs is not None else None,
+        use_label_smooth=smooth, scale_x_y=scale_x_y)._value)
+    ref = naive_yolo_loss(x, gt, gl, anchors, amask, Cn, 0.7, ds,
+                          gt_score=gs, smooth=smooth,
+                          scale_x_y=scale_x_y)
+    np.testing.assert_allclose(ours, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_matches_naive_reference():
+    _case(0)
+
+
+def test_with_mixup_scores_and_no_smooth():
+    _case(1, gt_score=True, smooth=False)
+
+
+def test_scale_x_y():
+    _case(2, scale_x_y=1.05)
+
+
+def test_two_gts_in_one_cell_both_count():
+    """Two gts sharing cell AND best anchor: per-gt accumulation means
+    both contribute (the scatter-set formulation would drop one)."""
+    r = np.random.RandomState(9)
+    N, Cn, H, W = 1, 4, 4, 4
+    anchors = [10, 13, 16, 30, 33, 23]
+    amask = [0, 1, 2]
+    x = (r.randn(N, 3 * (5 + Cn), H, W) * 0.2).astype("float32")
+    gt = np.zeros((N, 2, 4), "float32")
+    gt[0, 0] = [0.3, 0.3, 0.10, 0.12]   # same cell (1,1), similar size
+    gt[0, 1] = [0.32, 0.33, 0.11, 0.13]  # -> same best anchor
+    gl = np.array([[1, 2]], "int32")
+    ours = np.asarray(yolo_loss(
+        paddle.to_tensor(x), paddle.to_tensor(gt), paddle.to_tensor(gl),
+        anchors, amask, Cn, 0.7, 32)._value)
+    ref = naive_yolo_loss(x, gt, gl, anchors, amask, Cn, 0.7, 32)
+    np.testing.assert_allclose(ours, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_gradient_flows():
+    r = np.random.RandomState(3)
+    anchors = [10, 13, 16, 30, 33, 23]
+    x = Tensor(paddle.to_tensor(
+        (r.randn(1, 3 * 9, 4, 4) * 0.2).astype("float32"))._value,
+        stop_gradient=False)
+    gt = np.zeros((1, 2, 4), "float32")
+    gt[0, 0] = [0.4, 0.4, 0.2, 0.2]
+    yolo_loss(x, paddle.to_tensor(gt),
+              paddle.to_tensor(np.zeros((1, 2), "int32")),
+              anchors, [0, 1, 2], 4, 0.7, 32).sum().backward()
+    g = np.asarray(x.grad._value)
+    assert np.isfinite(g).all() and np.abs(g).max() > 0
