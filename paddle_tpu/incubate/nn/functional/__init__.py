@@ -21,6 +21,7 @@ __all__ = [
     "fused_rotary_position_embedding", "fused_bias_act",
     "fused_dropout_add", "swiglu", "fused_linear",
     "fused_multi_transformer", "masked_multihead_attention",
+    "block_multihead_attention",
 ]
 
 
@@ -297,3 +298,121 @@ def fused_multi_transformer(x, ln_scales, ln_biases, qkv_weights,
     if cache_kvs is not None:
         return h, new_caches
     return h
+
+
+def block_multihead_attention(qkv, key_cache, value_cache,
+                              seq_lens_encoder, seq_lens_decoder,
+                              seq_lens_this_time, padding_offsets,
+                              cum_offsets, cu_seqlens_q, cu_seqlens_k,
+                              block_tables, pre_key_cache=None,
+                              pre_value_cache=None,
+                              cache_k_quant_scales=None,
+                              cache_v_quant_scales=None,
+                              cache_k_dequant_scales=None,
+                              cache_v_dequant_scales=None,
+                              qkv_out_scale=None, qkv_bias=None,
+                              out_shift=None, out_smooth=None,
+                              rope_emb=None, mask=None, tgt_mask=None,
+                              max_seq_len=-1, block_size=64,
+                              use_neox_style=False,
+                              use_dynamic_cachekv_quant=False,
+                              quant_round_type=1, quant_max_bound=127.0,
+                              quant_min_bound=-127.0, out_scale=-1,
+                              compute_dtype="default"):
+    """Paged (block-table) KV-cache attention, decode phase (reference:
+    incubate/nn/functional/block_multihead_attention.py:19 over
+    phi/kernels/fusion/gpu/block_multi_head_attention_kernel.cu).
+
+    The TPU redesign of the paged cache lives in
+    ops/pallas/decode_attention.paged_decode_attention (the physical
+    page id is gathered from a scalar-prefetched block table inside the
+    BlockSpec index map); this wrapper serves the reference surface for
+    the DECODE phase: one new token per row (seq_lens_this_time == 1),
+    per-row write position = seq_lens_decoder, ragged frontiers. The
+    encoder/prefill phase, cache quantization, in-kernel rope, and
+    pre-caches are served by the Predictor paged path (inference/
+    __init__.py) and nn.quant — pass those knobs there.
+
+    Returns (out [B, H*D], qkv, key_cache, value_cache) with the
+    caches functionally updated (immutable arrays: returned, the
+    reference updates in place).
+    """
+    from ....core.enforce import enforce as _enf
+    from ....ops.pallas.decode_attention import (paged_attention_dense,
+                                                 paged_supported,
+                                                 paged_decode_attention)
+    from ....core import flags as _flags
+
+    for knob, name in ((pre_key_cache, "pre_key_cache"),
+                       (pre_value_cache, "pre_value_cache"),
+                       (cache_k_quant_scales, "cache_k_quant_scales"),
+                       (cache_v_quant_scales, "cache_v_quant_scales"),
+                       (cache_k_dequant_scales, "cache_k_dequant_scales"),
+                       (cache_v_dequant_scales, "cache_v_dequant_scales"),
+                       (qkv_out_scale, "qkv_out_scale"),
+                       (out_shift, "out_shift"),
+                       (out_smooth, "out_smooth"),
+                       (rope_emb, "rope_emb"),
+                       (mask, "mask"), (tgt_mask, "tgt_mask")):
+        _enf(knob is None,
+             f"block_multihead_attention: {name} is served by the "
+             "Predictor paged path / nn.quant on TPU, not in-kernel")
+    _enf(not use_dynamic_cachekv_quant and out_scale in (-1, None)
+         and compute_dtype == "default",
+         "block_multihead_attention: cache-kv quantization / output "
+         "quant are served by nn.quant on TPU, not in-kernel")
+    qv = qkv._value if isinstance(qkv, Tensor) else jnp.asarray(qkv)
+    kp = key_cache._value if isinstance(key_cache, Tensor) \
+        else jnp.asarray(key_cache)
+    vp = value_cache._value if isinstance(value_cache, Tensor) \
+        else jnp.asarray(value_cache)
+    tbl = block_tables._value if isinstance(block_tables, Tensor) \
+        else jnp.asarray(block_tables)
+    sld = seq_lens_decoder._value if isinstance(seq_lens_decoder,
+                                                Tensor) \
+        else jnp.asarray(seq_lens_decoder)
+    B = tbl.shape[0]
+    P, KV, page, D = kp.shape
+    _enf(qv.shape[0] == B and qv.ndim == 2,
+         "decode phase: qkv is [batchsize, 3*num_head*head_dim] "
+         "(one new token per row; ragged prefill is the Predictor "
+         "paged path)")
+    # GQA layout (reference): qkv packs (H + 2*KV) head planes of D
+    total_heads = qv.shape[1] // D
+    _enf(qv.shape[1] % D == 0 and total_heads > 2 * KV,
+         lambda: "block_multihead_attention: qkv width "
+                 f"{qv.shape[1]} is not (num_q_heads + 2*{KV})*{D}")
+    H = total_heads - 2 * KV
+    if qkv_bias is not None:
+        bv = qkv_bias._value if isinstance(qkv_bias, Tensor) \
+            else jnp.asarray(qkv_bias)
+        qv = qv + bv.reshape(1, -1)
+    heads = qv.reshape(B, total_heads, D)
+    q = heads[:, :H]                                       # [B, H, D]
+    kw = heads[:, H:H + KV]                                # [B, KV, D]
+    vw = heads[:, H + KV:]
+    off = sld.reshape(B).astype(jnp.int32)
+    import numpy as _np
+
+    if not isinstance(off, jax.core.Tracer):
+        _enf(bool((_np.asarray(off) < tbl.shape[1] * page).all()),
+             lambda: "block_multihead_attention: a row's "
+                     "seq_lens_decoder exceeds its block table "
+                     f"({tbl.shape[1]} pages x {page}); allocate more "
+                     "pages")
+    pid = jnp.take_along_axis(tbl.astype(jnp.int32),
+                              (off // page)[:, None], axis=1)[:, 0]
+    slot = off % page
+    kp = kp.at[pid, :, slot, :].set(kw.astype(kp.dtype))
+    vp = vp.at[pid, :, slot, :].set(vw.astype(vp.dtype))
+    q4 = q[:, None]                                        # [B,1,H,D]
+    if (_flags._get("use_pallas_kernels", True)
+            and paged_supported(q4.shape, kp.shape)
+            and jax.default_backend() != "cpu"):
+        out = paged_decode_attention(q4, kp, vp, tbl, off)
+    else:
+        out = paged_attention_dense(q4, kp, vp, tbl, off)
+    return (Tensor(out.reshape(B, H * D), stop_gradient=True),
+            Tensor(qv, stop_gradient=True),
+            Tensor(kp, stop_gradient=True),
+            Tensor(vp, stop_gradient=True))

@@ -153,3 +153,81 @@ class TestRaggedGenerate:
                  for t in tables[b, :-(-(L + n_new) // 8)]]
         assert len(owned) == len(set(owned))
         assert (tables.max() == P - 1)  # trash page referenced
+
+
+def test_block_multihead_attention_reference_surface():
+    """The reference's exact python API name over the paged kernel
+    (reference: incubate/nn/functional/block_multihead_attention.py:19)
+    — decode phase: per-row write at seq_lens_decoder, ragged attend."""
+    import paddle_tpu.incubate.nn.functional as IF
+    from paddle_tpu.ops.pallas.decode_attention import \
+        paged_attention_dense
+
+    r = np.random.RandomState(0)
+    B, H, D, page, npages = 2, 4, 8, 8, 4
+    P = B * npages + 1
+    kp = jnp.asarray(r.randn(P, H, page, D), jnp.float32)
+    vp = jnp.asarray(r.randn(P, H, page, D), jnp.float32)
+    tbl = jnp.asarray(r.permutation(P - 1)[:B * npages]
+                      .reshape(B, npages), jnp.int32)
+    lens = np.array([[5], [13]], np.int32)
+    qkv = r.randn(B, 3 * H * D).astype("float32")
+    z = paddle.to_tensor(np.zeros((B, 1), "int32"))
+    out, _, kc, vc = IF.block_multihead_attention(
+        paddle.to_tensor(qkv), paddle.to_tensor(kp), paddle.to_tensor(vp),
+        z, paddle.to_tensor(lens),
+        paddle.to_tensor(np.ones((B, 1), "int32")),
+        None, None, None, None, paddle.to_tensor(tbl), block_size=page)
+    q = qkv.reshape(B, 3, H, D)[:, 0]
+    kn, vn = np.asarray(kc._value), np.asarray(vc._value)
+    ref = paged_attention_dense(jnp.asarray(q)[:, None], jnp.asarray(kn),
+                                jnp.asarray(vn), tbl,
+                                jnp.asarray(lens.reshape(-1)))
+    assert np.abs(np.asarray(out._value).reshape(B, 1, H, D)
+                  - np.asarray(ref)).max() < 1e-5
+    for b, L in enumerate([5, 13]):
+        p_id, s = int(tbl[b, L // page]), L % page
+        assert np.allclose(kn[p_id, :, s, :],
+                           qkv.reshape(B, 3, H, D)[b, 1])
+
+
+def test_block_multihead_attention_gqa_layout():
+    """Reference GQA qkv layout: (H + 2*KV)*D consecutive head planes;
+    kv heads land in the KV-head cache and q attends grouped."""
+    import paddle_tpu.incubate.nn.functional as IF
+    from paddle_tpu.ops.pallas.decode_attention import \
+        paged_attention_dense
+
+    r = np.random.RandomState(1)
+    B, H, KV, D, page, npages = 2, 8, 2, 8, 8, 4
+    P = B * npages + 1
+    kp = jnp.asarray(r.randn(P, KV, page, D), jnp.float32)
+    vp = jnp.asarray(r.randn(P, KV, page, D), jnp.float32)
+    tbl = jnp.asarray(r.permutation(P - 1)[:B * npages]
+                      .reshape(B, npages), jnp.int32)
+    lens = np.array([[5], [13]], np.int32)
+    qkv = r.randn(B, (H + 2 * KV) * D).astype("float32")
+    z = paddle.to_tensor(np.zeros((B, 1), "int32"))
+    out, _, kc, vc = IF.block_multihead_attention(
+        paddle.to_tensor(qkv), paddle.to_tensor(kp), paddle.to_tensor(vp),
+        z, paddle.to_tensor(lens),
+        paddle.to_tensor(np.ones((B, 1), "int32")),
+        None, None, None, None, paddle.to_tensor(tbl), block_size=page)
+    heads = qkv.reshape(B, H + 2 * KV, D)
+    ref = paged_attention_dense(
+        jnp.asarray(heads[:, :H])[:, None], jnp.asarray(kc._value),
+        jnp.asarray(vc._value), tbl, jnp.asarray(lens.reshape(-1)))
+    assert np.abs(np.asarray(out._value).reshape(B, 1, H, D)
+                  - np.asarray(ref)).max() < 1e-5
+    kn = np.asarray(kc._value)
+    p_id, s = int(tbl[0, 5 // page]), 5 % page
+    assert np.allclose(kn[p_id, :, s, :], heads[0, H:H + KV])
+    # seq_lens_decoder beyond the table must refuse loudly
+    with pytest.raises(Exception, match="block table"):
+        IF.block_multihead_attention(
+            paddle.to_tensor(qkv), paddle.to_tensor(kp),
+            paddle.to_tensor(vp), z,
+            paddle.to_tensor(np.array([[32], [1]], "int32")),
+            paddle.to_tensor(np.ones((B, 1), "int32")),
+            None, None, None, None, paddle.to_tensor(tbl),
+            block_size=page)
