@@ -738,14 +738,25 @@ def main(argv):
             sys.stderr.write(err[-4000:])
             sys.stderr.flush()
         if name == "gpt":
+            def _valid(ln):
+                # a timed-out child can leave a truncated final line;
+                # only well-formed JSON may become the headline
+                try:
+                    json.loads(ln)
+                    return True
+                except ValueError:
+                    return False
             headline_lines = [ln for ln in out.splitlines()
-                              if '"metric"' in ln]
+                              if '"metric"' in ln and _valid(ln)]
             if not headline_lines and synth is not None:
                 headline_lines = [json.dumps(synth)]
-    # The headline runs FIRST (so a later hang can't kill it) but
-    # single-line parsers take the LAST line - re-emit it at the end.
-    for ln in headline_lines:
-        print(ln, flush=True)
+        # The headline runs FIRST (so a later hang can't kill it) but
+        # single-line parsers take the LAST line - re-emit it after
+        # EVERY bench (including right after gpt: its own stdout can
+        # end in stray WARNING lines), so a driver-level kill at any
+        # point leaves the headline as the last complete line.
+        for ln in headline_lines:
+            print(ln, flush=True)
 
 
 if __name__ == "__main__":
