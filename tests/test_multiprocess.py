@@ -83,10 +83,19 @@ def _run_pod(world, dp, ndev_per_proc, out, timeout=600):
 
 
 def test_two_process_dp_loss_parity(tmp_path):
-    ref = _run_pod(world=1, dp=2, ndev_per_proc=2,
-                   out=str(tmp_path / "ref"))
-    two = _run_pod(world=2, dp=2, ndev_per_proc=1,
-                   out=str(tmp_path / "two"))
+    # one retry PER POD: the 2-proc bootstrap can starve past the
+    # worker timeout (or die on an internal bootstrap timeout, which
+    # surfaces as the worker-failure AssertionError) when the shared CI
+    # box runs several suites at once — observed clean alone, one
+    # timeout in 10 under 4-way load; same guard test_rpc uses
+    def pod_with_retry(tag, **kw):
+        try:
+            return _run_pod(out=str(tmp_path / tag), **kw)
+        except (subprocess.TimeoutExpired, AssertionError):
+            return _run_pod(out=str(tmp_path / (tag + "_retry")), **kw)
+
+    ref = pod_with_retry("ref", world=1, dp=2, ndev_per_proc=2)
+    two = pod_with_retry("two", world=2, dp=2, ndev_per_proc=1)
     ref_losses = ref[0]["losses"]
     for rank in (0, 1):
         np.testing.assert_allclose(two[rank]["losses"], ref_losses,
