@@ -1,0 +1,43 @@
+"""Image classification with the vision zoo + the hapi high-level API.
+
+    python examples/vision_classify.py
+"""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import hapi, nn
+from paddle_tpu.metric import Accuracy
+from paddle_tpu.vision.models import resnet18
+
+
+class RandomImages:
+    """Stand-in dataset: 2-class random images (swap in
+    paddle.vision.datasets + transforms for real data)."""
+
+    def __init__(self, n=64):
+        r = np.random.RandomState(0)
+        self.x = r.rand(n, 3, 32, 32).astype("float32")
+        self.y = (self.x.mean(axis=(1, 2, 3)) > 0.5).astype("int64")
+
+    def __len__(self):
+        return len(self.x)
+
+    def __getitem__(self, i):
+        return self.x[i], self.y[i]
+
+
+def main():
+    paddle.seed(0)
+    model = hapi.Model(resnet18(num_classes=2))
+    model.prepare(
+        optimizer=paddle.optimizer.Momentum(
+            learning_rate=0.005, momentum=0.9,
+            parameters=model.network.parameters()),
+        loss=nn.CrossEntropyLoss(),
+        metrics=Accuracy())
+    model.fit(RandomImages(), epochs=3, batch_size=16, verbose=1)
+    print(model.evaluate(RandomImages(32), batch_size=16, verbose=1))
+
+
+if __name__ == "__main__":
+    main()

@@ -70,6 +70,11 @@ def _run_pod(world, dp, ndev_per_proc, out, timeout=600):
         except subprocess.TimeoutExpired:
             for q in procs:
                 q.kill()
+            for q in procs:   # reap: no zombies/open pipes on retry
+                try:
+                    q.communicate(timeout=10)
+                except Exception:
+                    pass
             raise
         if p.returncode != 0:
             fail.append((rank, p.returncode,
