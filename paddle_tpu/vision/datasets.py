@@ -19,7 +19,8 @@ import numpy as np
 
 from ..io import Dataset
 
-__all__ = ["MNIST", "FashionMNIST", "Cifar10", "Cifar100", "FakeData"]
+__all__ = ["MNIST", "FashionMNIST", "Cifar10", "Cifar100", "FakeData",
+           "DatasetFolder", "ImageFolder", "Flowers", "VOC2012"]
 
 
 class FakeData(Dataset):
@@ -162,3 +163,184 @@ class Cifar10(_CifarBase):
 class Cifar100(_CifarBase):
     def _label_key(self, mode):
         return b"fine_labels"
+
+
+def _decode_image(data, convert_rgb=True):
+    """Decode encoded image bytes via Pillow (the one PIL chokepoint:
+    label masks pass convert_rgb=False to keep palette indices)."""
+    try:
+        import io as _io
+
+        from PIL import Image
+    except ImportError as e:  # pragma: no cover
+        raise NotImplementedError(
+            "image decoding needs Pillow (no nvjpeg analog on TPU "
+            "hosts); .npy arrays load without it") from e
+    img = Image.open(_io.BytesIO(data))
+    return np.asarray(img.convert("RGB") if convert_rgb else img)
+
+
+def _default_loader(path):
+    """npy loads directly; encoded images via Pillow when present."""
+    if path.endswith(".npy"):
+        return np.load(path)
+    with open(path, "rb") as f:
+        return _decode_image(f.read())
+
+
+IMG_EXTENSIONS = (".jpg", ".jpeg", ".png", ".ppm", ".bmp", ".pgm",
+                  ".tif", ".tiff", ".webp", ".npy")
+
+
+class DatasetFolder(Dataset):
+    """Directory-per-class dataset (reference: vision/datasets/
+    folder.py DatasetFolder): root/<class_name>/<file> discovered and
+    mapped to contiguous class ids."""
+
+    def __init__(self, root, loader=None, extensions=None,
+                 transform=None, is_valid_file=None):
+        self.root = root
+        self.loader = loader or _default_loader
+        self.transform = transform
+        exts = tuple(e.lower() for e in (extensions or IMG_EXTENSIONS))
+        classes = sorted(d for d in os.listdir(root)
+                         if os.path.isdir(os.path.join(root, d)))
+        if not classes:
+            raise RuntimeError(f"no class folders found under {root!r}")
+        self.classes = classes
+        self.class_to_idx = {c: i for i, c in enumerate(classes)}
+        self.samples = []
+        for c in classes:
+            cdir = os.path.join(root, c)
+            for base, _, files in sorted(os.walk(cdir)):
+                for fn in sorted(files):
+                    p = os.path.join(base, fn)
+                    ok = (is_valid_file(p) if is_valid_file
+                          else fn.lower().endswith(exts))
+                    if ok:
+                        self.samples.append((p, self.class_to_idx[c]))
+        if not self.samples:
+            raise RuntimeError(
+                f"no files with extensions {exts} under {root!r}")
+
+    def __getitem__(self, idx):
+        path, target = self.samples[idx]
+        img = self.loader(path)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, target
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class ImageFolder(Dataset):
+    """Flat/recursive unlabeled image folder (reference: folder.py
+    ImageFolder): every matching file, no labels."""
+
+    def __init__(self, root, loader=None, extensions=None,
+                 transform=None, is_valid_file=None):
+        self.root = root
+        self.loader = loader or _default_loader
+        self.transform = transform
+        exts = tuple(e.lower() for e in (extensions or IMG_EXTENSIONS))
+        self.samples = []
+        for base, _, files in sorted(os.walk(root)):
+            for fn in sorted(files):
+                p = os.path.join(base, fn)
+                ok = (is_valid_file(p) if is_valid_file
+                      else fn.lower().endswith(exts))
+                if ok:
+                    self.samples.append(p)
+        if not self.samples:
+            raise RuntimeError(
+                f"no files with extensions {exts} under {root!r}")
+
+    def __getitem__(self, idx):
+        img = self.loader(self.samples[idx])
+        if self.transform is not None:
+            img = self.transform(img)
+        return [img]
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class Flowers(Dataset):
+    """Flowers-102 from LOCAL copies of the official files (reference:
+    vision/datasets/flowers.py; zero-egress: pass data_file/label_file/
+    setid_file paths; no downloading)."""
+
+    def __init__(self, data_file=None, label_file=None, setid_file=None,
+                 mode="train", transform=None, backend=None):
+        from ..core.enforce import enforce
+
+        enforce(data_file and label_file and setid_file,
+                "Flowers needs local data_file (102flowers.tgz), "
+                "label_file (imagelabels.mat) and setid_file "
+                "(setid.mat); this environment does not download")
+        try:
+            from scipy.io import loadmat
+        except ImportError as e:  # pragma: no cover
+            raise NotImplementedError(
+                "Flowers label parsing needs scipy (.mat files)") from e
+        key = {"train": "trnid", "valid": "valid", "test": "tstid"}[mode]
+        self.indexes = loadmat(setid_file)[key].ravel()
+        self.labels = loadmat(label_file)["labels"].ravel()
+        self.transform = transform
+        self._tar = tarfile.open(data_file)
+        self._names = {os.path.basename(n): n
+                       for n in self._tar.getnames()
+                       if n.endswith(".jpg")}
+
+    def __getitem__(self, idx):
+        flower_id = int(self.indexes[idx])
+        name = f"image_{flower_id:05d}.jpg"
+        data = self._tar.extractfile(self._names[name]).read()
+        img = _decode_image(data)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, np.int64(self.labels[flower_id - 1] - 1)
+
+    def __len__(self):
+        return len(self.indexes)
+
+
+class VOC2012(Dataset):
+    """VOC2012 segmentation pairs from a LOCAL VOCtrainval tar
+    (reference: vision/datasets/voc2012.py; zero-egress: pass
+    data_file; no downloading)."""
+
+    _BASE = "VOCdevkit/VOC2012"
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 backend=None):
+        from ..core.enforce import enforce
+
+        enforce(data_file, "VOC2012 needs a local data_file "
+                           "(VOCtrainval tar); this environment does "
+                           "not download")
+        self._tar = tarfile.open(data_file)
+        split = {"train": "train", "valid": "val", "test": "val",
+                 "trainval": "trainval"}[mode]
+        lst = self._tar.extractfile(
+            f"{self._BASE}/ImageSets/Segmentation/{split}.txt")
+        self.ids = [ln.strip() for ln in
+                    lst.read().decode().splitlines() if ln.strip()]
+        self.transform = transform
+
+    def _img(self, path):
+        # label masks keep their palette indices (convert_rgb=False)
+        return _decode_image(self._tar.extractfile(path).read(),
+                             convert_rgb=not path.endswith(".jpg"))
+
+    def __getitem__(self, idx):
+        name = self.ids[idx]
+        img = self._img(f"{self._BASE}/JPEGImages/{name}.jpg")
+        lab = self._img(f"{self._BASE}/SegmentationClass/{name}.png")
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, lab
+
+    def __len__(self):
+        return len(self.ids)

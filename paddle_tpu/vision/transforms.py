@@ -18,6 +18,8 @@ __all__ = [
     "CenterCrop", "RandomCrop", "RandomHorizontalFlip",
     "RandomVerticalFlip", "Transpose", "Pad", "to_tensor", "normalize",
     "resize", "hflip", "vflip", "center_crop", "crop", "pad",
+    "ColorJitter", "RandomRotation", "rotate", "adjust_brightness",
+    "adjust_contrast", "adjust_saturation", "adjust_hue",
 ]
 
 
@@ -230,3 +232,188 @@ class Pad(BaseTransform):
 
     def _apply_image(self, img):
         return pad(img, self.padding, self.fill, self.padding_mode)
+
+
+# ---------------------------------------------------------------------------
+# photometric / geometric functional ops (reference: vision/transforms/
+# functional.py adjust_brightness:341, adjust_contrast:381,
+# adjust_saturation:421, adjust_hue:462, rotate:720)
+# ---------------------------------------------------------------------------
+def adjust_brightness(img, brightness_factor: float) -> np.ndarray:
+    arr = _as_hwc(img).astype(np.float32)
+    hi = 255.0 if np.asarray(img).dtype == np.uint8 else 1.0
+    out = np.clip(arr * float(brightness_factor), 0, hi)
+    return out.astype(np.asarray(img).dtype)
+
+
+def adjust_contrast(img, contrast_factor: float) -> np.ndarray:
+    arr = _as_hwc(img).astype(np.float32)
+    hi = 255.0 if np.asarray(img).dtype == np.uint8 else 1.0
+    # reference blends toward the mean of the grayscale image
+    gray = arr @ np.asarray([0.299, 0.587, 0.114], np.float32) \
+        if arr.shape[-1] == 3 else arr[..., 0]
+    mean = float(gray.mean())
+    out = np.clip(mean + float(contrast_factor) * (arr - mean), 0, hi)
+    return out.astype(np.asarray(img).dtype)
+
+
+def adjust_saturation(img, saturation_factor: float) -> np.ndarray:
+    arr = _as_hwc(img).astype(np.float32)
+    hi = 255.0 if np.asarray(img).dtype == np.uint8 else 1.0
+    if arr.shape[-1] != 3:
+        return _as_hwc(img)
+    gray = (arr @ np.asarray([0.299, 0.587, 0.114],
+                             np.float32))[..., None]
+    out = np.clip(gray + float(saturation_factor) * (arr - gray), 0, hi)
+    return out.astype(np.asarray(img).dtype)
+
+
+def adjust_hue(img, hue_factor: float) -> np.ndarray:
+    """hue_factor in [-0.5, 0.5]: shift the H channel in HSV space."""
+    if not -0.5 <= hue_factor <= 0.5:
+        raise ValueError(f"hue_factor {hue_factor} not in [-0.5, 0.5]")
+    src = np.asarray(img)
+    arr = _as_hwc(img).astype(np.float32)
+    if arr.shape[-1] != 3:
+        return _as_hwc(img)
+    hi = 255.0 if src.dtype == np.uint8 else 1.0
+    arr = arr / hi
+    r, g, b = arr[..., 0], arr[..., 1], arr[..., 2]
+    mx = arr.max(-1)
+    mn = arr.min(-1)
+    d = mx - mn + 1e-12
+    h = np.zeros_like(mx)
+    sel = mx == r
+    h[sel] = (((g - b) / d) % 6)[sel]
+    sel = mx == g
+    h[sel] = ((b - r) / d + 2)[sel]
+    sel = mx == b
+    h[sel] = ((r - g) / d + 4)[sel]
+    h = (h / 6.0 + hue_factor) % 1.0
+    s = np.where(mx > 0, d / (mx + 1e-12), 0)
+    v = mx
+    # hsv -> rgb
+    i = np.floor(h * 6.0)
+    f = h * 6.0 - i
+    p = v * (1 - s)
+    q = v * (1 - f * s)
+    t = v * (1 - (1 - f) * s)
+    i = (i.astype(np.int32) % 6)[..., None]
+    out = np.select(
+        [i == 0, i == 1, i == 2, i == 3, i == 4, i == 5],
+        [np.stack([v, t, p], -1), np.stack([q, v, p], -1),
+         np.stack([p, v, t], -1), np.stack([p, q, v], -1),
+         np.stack([t, p, v], -1), np.stack([v, p, q], -1)])
+    return (np.clip(out, 0, 1) * hi).astype(src.dtype)
+
+
+def rotate(img, angle: float, interpolation="nearest", expand=False,
+           center=None, fill=0) -> np.ndarray:
+    """Rotate counter-clockwise by ``angle`` degrees (inverse affine
+    map + nearest/bilinear sampling, the reference's cv2/PIL path)."""
+    arr = _as_hwc(img).astype(np.float32)
+    h, w = arr.shape[:2]
+    cy, cx = ((h - 1) / 2.0, (w - 1) / 2.0) if center is None \
+        else (center[1], center[0])
+    a = np.deg2rad(angle)
+    cos_a, sin_a = np.cos(a), np.sin(a)
+    if expand:
+        # epsilon guard: sin(pi/2) etc. leave ~1e-16 dust that would
+        # ceil a 3.0-wide canvas up to 4
+        nw = int(np.ceil(abs(w * cos_a) + abs(h * sin_a) - 1e-9))
+        nh = int(np.ceil(abs(w * sin_a) + abs(h * cos_a) - 1e-9))
+    else:
+        nh, nw = h, w
+    yy, xx = np.meshgrid(np.arange(nh, dtype=np.float32),
+                         np.arange(nw, dtype=np.float32), indexing="ij")
+    ocy, ocx = (nh - 1) / 2.0, (nw - 1) / 2.0
+    # inverse rotation: output pixel -> source location (PIL/reference
+    # convention: positive angle = counter-clockwise on screen, which
+    # in y-down pixel coordinates is the clockwise matrix)
+    sx = cos_a * (xx - ocx) - sin_a * (yy - ocy) + cx
+    sy = sin_a * (xx - ocx) + cos_a * (yy - ocy) + cy
+    if interpolation == "bilinear":
+        x0 = np.floor(sx).astype(np.int64)
+        y0 = np.floor(sy).astype(np.int64)
+        wx = sx - x0
+        wy = sy - y0
+        out = np.zeros((nh, nw, arr.shape[2]), np.float32)
+        for dy in (0, 1):
+            for dx in (0, 1):
+                xi = np.clip(x0 + dx, 0, w - 1)
+                yi = np.clip(y0 + dy, 0, h - 1)
+                wgt = (wx if dx else 1 - wx) * (wy if dy else 1 - wy)
+                out += arr[yi, xi] * wgt[..., None]
+        inside = (sx >= -0.5) & (sx <= w - 0.5) & (sy >= -0.5) \
+            & (sy <= h - 0.5)
+    else:
+        xi = np.clip(np.round(sx).astype(np.int64), 0, w - 1)
+        yi = np.clip(np.round(sy).astype(np.int64), 0, h - 1)
+        out = arr[yi, xi]
+        inside = (np.round(sx) >= 0) & (np.round(sx) <= w - 1) \
+            & (np.round(sy) >= 0) & (np.round(sy) <= h - 1)
+    out = np.where(inside[..., None], out, np.float32(fill))
+    return out.astype(np.asarray(img).dtype)
+
+
+class ColorJitter(BaseTransform):
+    """Random brightness/contrast/saturation/hue in random order
+    (reference: vision/transforms/transforms.py ColorJitter)."""
+
+    def __init__(self, brightness=0.0, contrast=0.0, saturation=0.0,
+                 hue=0.0, keys=None):
+        def rng(v, name, center=1.0, lo=0.0):
+            if isinstance(v, numbers.Number):
+                if v < 0:
+                    raise ValueError(f"{name} must be non-negative, "
+                                     f"got {v}")
+                v = [max(center - v, lo), center + v] if v else None
+            if v is not None:
+                v = tuple(v)
+                if not lo - 1e-9 <= v[0] <= v[1]:
+                    raise ValueError(f"{name} range {v} invalid "
+                                     f"(need {lo} <= lo <= hi)")
+            return v
+
+        self.brightness = rng(brightness, "brightness")
+        self.contrast = rng(contrast, "contrast")
+        self.saturation = rng(saturation, "saturation")
+        self.hue = rng(hue, "hue", center=0.0, lo=-0.5)
+        if self.hue and self.hue[1] > 0.5:
+            raise ValueError(f"hue range {self.hue} exceeds [-0.5, 0.5]")
+
+    def _apply_image(self, img):
+        ops = []
+        for bounds, fn in ((self.brightness, adjust_brightness),
+                           (self.contrast, adjust_contrast),
+                           (self.saturation, adjust_saturation),
+                           (self.hue, adjust_hue)):
+            if bounds:
+                # default-arg binding: each op keeps ITS OWN factor
+                ops.append(lambda im, f=random.uniform(*bounds),
+                           fn=fn: fn(im, f))
+        random.shuffle(ops)
+        out = _as_hwc(img)
+        for op in ops:
+            out = op(out)
+        return out
+
+
+class RandomRotation(BaseTransform):
+    """Rotate by a random angle from ``degrees`` (reference:
+    transforms.py RandomRotation)."""
+
+    def __init__(self, degrees, interpolation="nearest", expand=False,
+                 center=None, fill=0, keys=None):
+        if isinstance(degrees, numbers.Number):
+            degrees = (-abs(degrees), abs(degrees))
+        self.degrees = tuple(degrees)
+        self.interpolation = interpolation
+        self.expand = expand
+        self.center = center
+        self.fill = fill
+
+    def _apply_image(self, img):
+        angle = random.uniform(*self.degrees)
+        return rotate(img, angle, self.interpolation, self.expand,
+                      self.center, self.fill)

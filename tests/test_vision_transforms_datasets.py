@@ -1,0 +1,124 @@
+"""ColorJitter/RandomRotation + photometric functional transforms and
+folder datasets (reference: vision/transforms/functional.py
+adjust_brightness:341/adjust_contrast:381/adjust_saturation:421/
+adjust_hue:462/rotate:720; vision/datasets/folder.py DatasetFolder/
+ImageFolder)."""
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+from paddle_tpu.vision import transforms as T
+from paddle_tpu.vision.datasets import DatasetFolder, ImageFolder
+
+
+@pytest.fixture
+def img():
+    return np.random.RandomState(0).randint(0, 256, (16, 20, 3),
+                                            np.uint8)
+
+
+class TestPhotometric:
+    def test_brightness(self, img):
+        b = T.adjust_brightness(img, 1.5)
+        assert b.dtype == np.uint8
+        assert np.allclose(
+            b.astype(int),
+            np.clip(img.astype(float) * 1.5, 0, 255).astype(int),
+            atol=1)
+
+    def test_saturation_zero_is_grayscale(self, img):
+        s = T.adjust_saturation(img, 0.0)
+        assert np.allclose(s[..., 0].astype(int),
+                           s[..., 1].astype(int), atol=1)
+        assert np.allclose(s[..., 1].astype(int),
+                           s[..., 2].astype(int), atol=1)
+
+    def test_contrast_one_is_identity(self, img):
+        c = T.adjust_contrast(img, 1.0)
+        assert np.abs(c.astype(int) - img.astype(int)).max() <= 1
+
+    def test_hue_roundtrip(self, img):
+        h0 = T.adjust_hue(img, 0.0)
+        assert np.abs(h0.astype(int) - img.astype(int)).max() <= 2
+        h = T.adjust_hue(img, 0.25)
+        assert np.abs(h.astype(int) - img.astype(int)).max() > 5
+        with pytest.raises(ValueError):
+            T.adjust_hue(img, 0.7)
+
+    def test_color_jitter_runs_and_preserves_shape(self, img):
+        out = T.ColorJitter(0.4, 0.4, 0.4, 0.1)(img)
+        assert out.shape == img.shape
+
+
+class TestRotate:
+    def test_rotate_90_equals_rot90(self):
+        sq = np.random.RandomState(1).randint(0, 255, (9, 9, 3),
+                                              np.uint8)
+        # PIL/reference convention: positive angle = counter-clockwise
+        # on screen = np.rot90(+1) in array terms; pinned so a sign
+        # error in the inverse affine map cannot slip through
+        assert (T.rotate(sq, 90) == np.rot90(sq, 1)).all()
+        assert (T.rotate(sq, -90) == np.rot90(sq, -1)).all()
+
+    def test_rotate_360_identity(self):
+        sq = np.random.RandomState(2).randint(0, 255, (8, 8, 3),
+                                              np.uint8)
+        assert (T.rotate(sq, 360) == sq).all()
+
+    def test_expand_grows_canvas(self):
+        sq = np.zeros((10, 20, 3), np.uint8)
+        out = T.rotate(sq, 45, expand=True)
+        assert out.shape[0] > 10 and out.shape[1] > 20
+
+    def test_pil_parity_expand(self):
+        from PIL import Image
+
+        a = np.random.RandomState(5).randint(0, 255, (16, 24), np.uint8)
+        for ang in (90, -90):
+            pil = np.asarray(Image.fromarray(a).rotate(ang, expand=True))
+            ours = T.rotate(a[:, :, None], ang, expand=True)[:, :, 0]
+            assert pil.shape == ours.shape and (pil == ours).all()
+
+    def test_random_rotation(self):
+        img = np.random.RandomState(3).randint(0, 255, (12, 12, 3),
+                                               np.uint8)
+        assert T.RandomRotation(30)(img).shape == img.shape
+
+
+class TestFolderDatasets:
+    def _tree(self, d):
+        for cls in ("cat", "dog"):
+            os.makedirs(os.path.join(d, cls))
+            for i in range(3):
+                np.save(os.path.join(d, cls, f"{i}.npy"),
+                        np.full((4, 4, 3), i, np.uint8))
+
+    def test_dataset_folder(self):
+        with tempfile.TemporaryDirectory() as d:
+            self._tree(d)
+            ds = DatasetFolder(d)
+            assert len(ds) == 6
+            assert ds.classes == ["cat", "dog"]
+            assert ds.class_to_idx == {"cat": 0, "dog": 1}
+            img0, y0 = ds[0]
+            assert img0.shape == (4, 4, 3) and y0 == 0
+            _, y5 = ds[5]
+            assert y5 == 1
+            # transform applies
+            ds2 = DatasetFolder(d, transform=lambda im: im.astype(
+                np.float32) / 255.0)
+            assert ds2[0][0].dtype == np.float32
+
+    def test_image_folder(self):
+        with tempfile.TemporaryDirectory() as d:
+            self._tree(d)
+            flat = ImageFolder(d)
+            assert len(flat) == 6
+            assert flat[0][0].shape == (4, 4, 3)
+
+    def test_empty_raises(self):
+        with tempfile.TemporaryDirectory() as d:
+            with pytest.raises(RuntimeError):
+                DatasetFolder(d)
