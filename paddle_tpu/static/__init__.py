@@ -220,6 +220,13 @@ def data(name: str, shape, dtype="float32", lod_level=0):
     return var
 
 
+# static/nn control-flow branches set this while they run: a symbolic
+# Variable reaching dispatch inside a branch would otherwise silently
+# record the branch body into the live Program (region-less op list)
+# and then crash opaquely on the Variable's absent value
+_in_control_flow = [0]
+
+
 def record_op(opdef, args, kwargs):
     """Called from core.dispatch.apply when an input is symbolic.
 
@@ -227,6 +234,13 @@ def record_op(opdef, args, kwargs):
     (so ops appended after ``clone()`` land in the clone, matching the
     reference's guard semantics); otherwise into the inputs' program,
     which must then be unambiguous."""
+    enforce(not _in_control_flow[0],
+            "a static-graph Variable reached a static.nn control-flow "
+            "branch/body: cond/while_loop/case/switch_case cannot be "
+            "recorded into a declare-then-run Program (the replayed op "
+            "list has no sub-block regions). Run the model under "
+            "paddle.jit.to_static instead, where they lower to lax "
+            "control-flow HLOs.")
     if _guard_stack:
         return _guard_stack[-1]._record(opdef, args, kwargs)
     progs = {v._program for v in list(args) + list(kwargs.values())

@@ -44,7 +44,43 @@ def _wrap(tree):
         lambda v: Tensor(v, stop_gradient=True), tree)
 
 
+def _no_program_recording(api, *values):
+    """Program-recording mode replays a flat op list; control-flow
+    REGIONS (sub-blocks) are not recordable there — gate loudly with
+    the working alternative instead of an opaque AttributeError.
+    Checks every LEAF (matching what _unwrap will touch); Variables
+    captured in branch closures are caught by the _cf_guard sentinel
+    that static.record_op consults."""
+    for v in jax.tree_util.tree_leaves(
+            list(values), is_leaf=lambda x: isinstance(x, Tensor)):
+        if getattr(v, "_is_static_var", False):
+            enforce(False,
+                    f"static.nn.{api} cannot be recorded into a "
+                    "declare-then-run Program (the replayed op list has "
+                    "no sub-block regions). Run the model under "
+                    "paddle.jit.to_static instead - cond/while_loop/"
+                    "case/switch_case lower to lax control-flow HLOs "
+                    "there (and in eager mode).")
+
+
+import contextlib  # noqa: E402
+
+
+@contextlib.contextmanager
+def _cf_guard():
+    """While a branch/body runs, a symbolic Variable reaching dispatch
+    raises the clear static-mode message (see static.record_op)."""
+    from .. import _in_control_flow
+
+    _in_control_flow[0] += 1
+    try:
+        yield
+    finally:
+        _in_control_flow[0] -= 1
+
+
 def _scalar_pred(pred, api):
+    _no_program_recording(api, pred)
     pv = pred._value if isinstance(pred, Tensor) else jnp.asarray(pred)
     enforce(int(np.prod(pv.shape)) == 1,
             lambda: f"{api} predicate must have exactly one element, "
@@ -57,7 +93,7 @@ def _run_branch(fn, api, args=()):
     value pytree (no_grad: see module doc)."""
     from ...autograd import no_grad
 
-    with no_grad():
+    with no_grad(), _cf_guard():
         out = fn(*_wrap(args)) if args else fn()
     return _unwrap(out)
 
@@ -117,6 +153,7 @@ def while_loop(cond: Callable, body: Callable, loop_vars: Sequence,
     ``lax.while_loop`` HLO (reference: static/nn/control_flow.py:1380).
     Loop-carried shapes/dtypes must be invariant across iterations."""
     enforce(len(loop_vars) > 0, "while_loop needs at least one loop var")
+    _no_program_recording("while_loop", *loop_vars)
     init = tuple(_unwrap(list(loop_vars)))
 
     def c(vs):
@@ -125,7 +162,7 @@ def while_loop(cond: Callable, body: Callable, loop_vars: Sequence,
     def _cond_val(vs):
         from ...autograd import no_grad
 
-        with no_grad():
+        with no_grad(), _cf_guard():
             out = cond(*_wrap(list(vs)))
         return out._value if isinstance(out, Tensor) else jnp.asarray(out)
 
@@ -183,6 +220,7 @@ def switch_case(branch_index, branch_fns, default: Optional[Callable] = None,
     (reference: static/nn/control_flow.py:2517). ``branch_fns`` is a
     list of fns, or (index, fn) pairs; out-of-range indices take
     ``default`` (appended as the last switch branch, clamp-mapped)."""
+    _no_program_recording("switch_case", branch_index)
     if isinstance(branch_fns, dict):
         items = sorted(branch_fns.items())
     elif branch_fns and isinstance(branch_fns[0], (tuple, list)):

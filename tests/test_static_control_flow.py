@@ -138,3 +138,42 @@ class TestUnderToStatic:
         assert (_np(clipped_double(x)) == [2.0, 4.0]).all()
         y = paddle.to_tensor(np.array([-1.0, -2.0], "float32"))
         assert (_np(clipped_double(y)) == [0.0, 0.0]).all()
+
+
+class TestProgramRecordingGate:
+    """Declare-then-run Programs replay a flat op list — control-flow
+    regions cannot be recorded; every entry path must fail LOUDLY
+    (pointing at to_static) and must not corrupt the live Program."""
+
+    def test_symbolic_predicate(self):
+        from paddle_tpu import static
+
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            x = static.data("x", [4], "float32")
+            with pytest.raises(Exception, match="to_static"):
+                snn.cond(x.sum() > 0, lambda: x * 2.0, lambda: x * 0.0)
+
+    def test_closure_captured_variable(self):
+        """Concrete predicate + Variables only inside branch closures —
+        the common static-mode pattern — must also gate, without
+        recording branch ops into the Program."""
+        from paddle_tpu import static
+
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            x = static.data("x", [4], "float32")
+            with pytest.raises(Exception, match="to_static"):
+                snn.cond(paddle.to_tensor(True),
+                         lambda: x * 2.0, lambda: x * 0.0)
+            assert len(main._nodes) == 0  # no corruption
+
+    def test_nested_loop_var(self):
+        from paddle_tpu import static
+
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            x = static.data("x", [4], "float32")
+            with pytest.raises(Exception, match="to_static"):
+                snn.while_loop(lambda i: i < 2, lambda i: (i + 1,),
+                               [[x]])
