@@ -66,13 +66,9 @@ def _run_once(world, extra, timeout):
             logs.append(out.decode(errors="replace")[-3000:])
         return rcs, logs
     except subprocess.TimeoutExpired:
-        for q in procs:
-            q.kill()
-        for q in procs:   # reap before any retry
-            try:
-                q.communicate(timeout=10)
-            except Exception:
-                pass
+        from utils import kill_and_reap
+
+        kill_and_reap(procs)
         raise
 
 
@@ -81,10 +77,9 @@ def _run(world, extra, timeout=600):
     # dead peer's coordination channel past the worker timeout instead
     # of failing fast (observed once in 10 loaded runs); each phase is
     # self-contained, so a clean re-run is equivalent
-    try:
-        return _run_once(world, extra, timeout)
-    except subprocess.TimeoutExpired:
-        return _run_once(world, extra, timeout)
+    from utils import retry_once
+
+    return retry_once(lambda: _run_once(world, extra, timeout))
 
 
 def test_scale_in_detect_and_resume(tmp_path):

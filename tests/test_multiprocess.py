@@ -68,13 +68,9 @@ def _run_pod(world, dp, ndev_per_proc, out, timeout=600):
         try:
             out_bytes, _ = p.communicate(timeout=timeout)
         except subprocess.TimeoutExpired:
-            for q in procs:
-                q.kill()
-            for q in procs:   # reap: no zombies/open pipes on retry
-                try:
-                    q.communicate(timeout=10)
-                except Exception:
-                    pass
+            from utils import kill_and_reap
+
+            kill_and_reap(procs)
             raise
         if p.returncode != 0:
             fail.append((rank, p.returncode,
