@@ -2,6 +2,6 @@
 windows + mel utilities and feature layers built on paddle.signal.stft;
 backends/datasets are file-IO helpers outside the compute scope).
 """
-from . import features, functional  # noqa: F401
+from . import datasets, features, functional  # noqa: F401
 
-__all__ = ["functional", "features"]
+__all__ = ["functional", "features", "datasets"]

@@ -1,0 +1,132 @@
+"""Audio datasets (reference: python/paddle/audio/datasets/ — TESS:36,
+ESC50:41 download-based loaders).
+
+Zero-egress environment: both parse LOCAL copies of the official
+archives (pass the archive/directory path); no downloading. Waveform
+decoding covers RIFF/WAV PCM16 natively (numpy); other codecs need an
+external decoder and gate loudly.
+"""
+from __future__ import annotations
+
+import os
+import struct
+import zipfile
+from typing import Callable, Optional
+
+import numpy as np
+
+from ...io import Dataset
+
+__all__ = ["TESS", "ESC50"]
+
+
+def _read_wav(data: bytes):
+    """Minimal RIFF/WAVE PCM16 parser -> (waveform float32 [-1,1], sr)."""
+    if data[:4] != b"RIFF" or data[8:12] != b"WAVE":
+        raise NotImplementedError(
+            "only RIFF/WAVE PCM files decode natively here")
+    pos, sr, bits, n_ch, raw = 12, None, None, 1, None
+    while pos + 8 <= len(data):
+        cid = data[pos:pos + 4]
+        size = struct.unpack("<I", data[pos + 4:pos + 8])[0]
+        body = data[pos + 8:pos + 8 + size]
+        if cid == b"fmt ":
+            fmt, n_ch, sr = struct.unpack("<HHI", body[:8])
+            bits = struct.unpack("<H", body[14:16])[0]
+            if fmt != 1 or bits != 16:
+                raise NotImplementedError(
+                    f"WAV fmt={fmt} bits={bits}: only PCM16 decodes "
+                    "natively")
+        elif cid == b"data":
+            raw = body
+        pos += 8 + size + (size & 1)
+    if sr is None or raw is None:
+        raise ValueError("malformed WAV: missing fmt/data chunk")
+    wav = np.frombuffer(raw, "<i2").astype(np.float32) / 32768.0
+    if n_ch > 1:
+        wav = wav.reshape(-1, n_ch).mean(axis=1)
+    return wav, sr
+
+
+class _WavFolderBase(Dataset):
+    def __init__(self, path, transform: Optional[Callable] = None):
+        from ...core.enforce import enforce
+
+        enforce(path and os.path.exists(path),
+                f"{type(self).__name__} needs a LOCAL copy of the "
+                "official archive/directory (this environment does not "
+                "download); got " + repr(path))
+        self.transform = transform
+        self._zip = None
+        self._files = []
+        if os.path.isdir(path):
+            for base, _, files in sorted(os.walk(path)):
+                for fn in sorted(files):
+                    if fn.lower().endswith(".wav"):
+                        self._files.append(os.path.join(base, fn))
+        else:
+            self._zip = zipfile.ZipFile(path)
+            self._files = sorted(n for n in self._zip.namelist()
+                                 if n.lower().endswith(".wav"))
+
+    def _wav(self, name):
+        data = (self._zip.read(name) if self._zip
+                else open(name, "rb").read())
+        return _read_wav(data)
+
+    def __len__(self):
+        return len(self._files)
+
+
+class TESS(_WavFolderBase):
+    """Toronto Emotional Speech Set (reference audio/datasets/tess.py):
+    label = the emotion encoded in the file name's last underscore
+    field."""
+
+    EMOTIONS = ["angry", "disgust", "fear", "happy", "neutral", "ps",
+                "sad"]
+
+    def __init__(self, path, transform=None):
+        super().__init__(path, transform)
+        self._files = [f for f in self._files
+                       if os.path.splitext(os.path.basename(f))[0]
+                       .split("_")[-1].lower() in self.EMOTIONS]
+
+    def __getitem__(self, idx):
+        name = self._files[idx]
+        stem = os.path.splitext(os.path.basename(name))[0]
+        emotion = stem.split("_")[-1].lower()
+        label = self.EMOTIONS.index(emotion)
+        wav, sr = self._wav(name)
+        if self.transform is not None:
+            wav = self.transform(wav)
+        return wav, np.int64(label)
+
+
+class ESC50(_WavFolderBase):
+    """ESC-50 environmental sounds (reference audio/datasets/esc50.py):
+    file name format {fold}-{id}-{take}-{target}.wav; split by fold
+    (mode='train' keeps folds != split_fold, 'dev' keeps == )."""
+
+    def __init__(self, path, mode: str = "train", split_fold: int = 5,
+                 transform: Optional[Callable] = None):
+        super().__init__(path, transform)
+        keep = []
+        for f in self._files:
+            stem = os.path.splitext(os.path.basename(f))[0]
+            parts = stem.split("-")
+            if len(parts) != 4 or not parts[0].isdigit() \
+                    or not parts[-1].isdigit():
+                continue    # not an ESC-50 clip name; skip
+            if (int(parts[0]) != split_fold) == (mode == "train"):
+                keep.append(f)
+        self._files = keep
+
+    def __getitem__(self, idx):
+        name = self._files[idx]
+        stem = os.path.splitext(os.path.basename(name))[0]
+        label = int(stem.split("-")[-1])
+        wav, sr = self._wav(name)
+        if self.transform is not None:
+            wav = self.transform(wav)
+        return wav, np.int64(label)

@@ -21,6 +21,8 @@ from .auto_parallel import (Partial, ProcessMesh, Replicate, Shard,  # noqa: F40
                             shard_tensor)
 from . import sharding  # noqa: F401
 from . import rpc  # noqa: F401
+from . import stream  # noqa: F401
+from .collective import P2POp, batch_isend_irecv  # noqa: F401
 from . import utils  # noqa: F401
 from .engine import ParallelEngine, bind_params, shard_module_params  # noqa: F401
 from .parallel import DataParallel, ParallelEnv  # noqa: F401
@@ -32,6 +34,7 @@ __all__ = [
     "get_group", "get_rank", "get_world_size", "init_parallel_env",
     "new_group", "recv", "reduce", "reduce_scatter", "scatter", "send",
     "isend", "irecv", "ReduceOp", "Group", "ProcessGroup", "fleet",
+    "stream", "P2POp", "batch_isend_irecv",
     "DataParallel", "ParallelEnv", "spmd_region", "in_spmd_region",
     "split_group", "sharding", "group_sharded_parallel",
     "save_group_sharded_model",

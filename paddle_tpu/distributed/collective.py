@@ -649,3 +649,31 @@ class stream:
     alltoall = staticmethod(alltoall)
     broadcast = staticmethod(broadcast)
     reduce = staticmethod(reduce)
+
+
+class P2POp:
+    """One batched point-to-point operation (reference:
+    communication/batch_isend_irecv.py P2POp): op is ``isend`` or
+    ``irecv``, bound to a tensor and a peer rank."""
+
+    def __init__(self, op, tensor, peer, group=None):
+        enforce(op in (isend, irecv),
+                "P2POp op must be paddle.distributed.isend or irecv")
+        self.op = op
+        self.tensor = tensor
+        self.peer = peer
+        self.group = group
+
+
+def batch_isend_irecv(p2p_op_list):
+    """Issue a batch of isend/irecv (reference:
+    communication/batch_isend_irecv.py). On TPU the sends/receives are
+    XLA-ordered host-transport ops, so 'batching' is issuing them in
+    list order; returns one task per op."""
+    enforce(len(p2p_op_list) > 0, "batch_isend_irecv needs >= 1 P2POp")
+    tasks = []
+    for p in p2p_op_list:
+        enforce(isinstance(p, P2POp),
+                "batch_isend_irecv takes a list of P2POp")
+        tasks.append(p.op(p.tensor, p.peer, p.group))
+    return tasks

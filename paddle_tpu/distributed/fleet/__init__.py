@@ -21,6 +21,13 @@ __all__ = ["init", "DistributedStrategy", "HybridCommunicateGroup",
 _fleet_state = {"initialized": False, "hcg": None, "strategy": None}
 
 
+from .base.role_maker import (PaddleCloudRoleMaker,  # noqa: F401
+                              Role, RoleMakerBase, UserDefinedRoleMaker,
+                              UtilBase)
+
+util = UtilBase()  # fleet.util (reference: fleet.util property)
+
+
 def init(role_maker=None, is_collective: bool = True,
          strategy: Optional[DistributedStrategy] = None, log_level="INFO"):
     """fleet.init analog: builds the hybrid mesh + HCG from

@@ -1,0 +1,135 @@
+"""Role makers (reference: python/paddle/distributed/fleet/base/
+role_maker.py — PaddleCloudRoleMaker:654, UserDefinedRoleMaker:1163).
+
+TPU stance: roles come from the launcher environment
+(PADDLE_TRAINER_ID / PADDLE_TRAINERS_NUM / PADDLE_MASTER, the same
+variables distributed/launch/main.py sets); the parameter-server role
+split (servers/heter workers) is a PS-era concept the SPMD runtime does
+not have — every process is a collective worker. The classes exist so
+reference code `fleet.init(role_maker=PaddleCloudRoleMaker(
+is_collective=True))` runs unchanged.
+"""
+from __future__ import annotations
+
+import os
+
+__all__ = ["Role", "RoleMakerBase", "PaddleCloudRoleMaker",
+           "UserDefinedRoleMaker"]
+
+
+class Role:
+    WORKER = 1
+    SERVER = 2
+    HETER_WORKER = 3
+    ALL = 4
+    COORDINATOR = 5
+
+
+class RoleMakerBase:
+    def __init__(self, is_collective: bool = True, **kwargs):
+        self._is_collective = bool(is_collective)
+
+    def _worker_index(self) -> int:
+        return int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+
+    def _worker_num(self) -> int:
+        return int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+
+    # -- reference API surface -----------------------------------------
+    def worker_index(self) -> int:
+        return self._worker_index()
+
+    def worker_num(self) -> int:
+        return self._worker_num()
+
+    def is_worker(self) -> bool:
+        return True
+
+    def is_server(self) -> bool:
+        return False  # no parameter servers in the SPMD runtime
+
+    def is_first_worker(self) -> bool:
+        return self._worker_index() == 0
+
+    def role_id(self) -> int:
+        return self._worker_index()
+
+    def worker_endpoints(self, to_string=False):
+        eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
+        lst = [e for e in eps.split(",") if e]
+        return ",".join(lst) if to_string else lst
+
+    def server_endpoints(self, to_string=False):
+        return "" if to_string else []
+
+
+class PaddleCloudRoleMaker(RoleMakerBase):
+    """Roles from the launcher environment (reference role_maker.py:654
+    reads the same PADDLE_* variables)."""
+
+
+class UserDefinedRoleMaker(RoleMakerBase):
+    """Explicit role assignment (reference role_maker.py:1163): takes
+    current_id / role / worker_num and overrides the environment."""
+
+    def __init__(self, is_collective: bool = True, current_id: int = 0,
+                 role=Role.WORKER, worker_num: int = 1,
+                 server_endpoints=None, **kwargs):
+        super().__init__(is_collective=is_collective)
+        self._current_id = int(current_id)
+        self._role = role
+        self._num = int(worker_num)
+
+    def _worker_index(self) -> int:
+        return self._current_id
+
+    def _worker_num(self) -> int:
+        return self._num
+
+
+class UtilBase:
+    """fleet.util (reference: fleet/base/util_factory.py UtilBase) —
+    host-side helpers over the TCPStore collectives."""
+
+    def all_reduce(self, input, mode="sum", comm_world="worker"):
+        import numpy as np
+
+        from ... import runtime as _rt
+
+        vals = _rt.all_gather_object_host(np.asarray(input))
+        stacked = np.stack([np.asarray(v) for v in vals])
+        if mode == "sum":
+            return stacked.sum(axis=0)
+        if mode == "max":
+            return stacked.max(axis=0)
+        if mode == "min":
+            return stacked.min(axis=0)
+        raise ValueError(f"all_reduce mode {mode!r} not in sum/max/min")
+
+    def all_gather(self, input, comm_world="worker"):
+        from ... import runtime as _rt
+
+        return _rt.all_gather_object_host(input)
+
+    def barrier(self, comm_world="worker"):
+        from ... import runtime as _rt
+
+        _rt.host_barrier("fleet_util_barrier")
+
+    def get_file_shard(self, files):
+        """Split a file list contiguously across workers (reference
+        util_factory.get_file_shard: first len%n workers get one
+        extra)."""
+        import os
+
+        rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+        n = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+        base, extra = divmod(len(files), n)
+        start = rank * base + min(rank, extra)
+        return list(files[start:start + base + (1 if rank < extra else 0)])
+
+    def print_on_rank(self, message, rank_id=0):
+        import os
+
+        if int(os.environ.get("PADDLE_TRAINER_ID", "0")) == int(rank_id):
+            print(message)

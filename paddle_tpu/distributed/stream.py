@@ -1,0 +1,86 @@
+"""paddle.distributed.stream namespace (reference:
+python/paddle/distributed/communication/stream/ — the stream-variant
+collectives taking sync_op/use_calc_stream and returning task handles).
+
+TPU stance: XLA owns streams and ordering — every collective here is
+issued into the one compiled/async PJRT stream, so the stream variants
+delegate to the standard collectives and return the same completed-task
+handles (`task.wait()` is a no-op barrier on an already-ordered op).
+``use_calc_stream`` is accepted and ignored by design: there is no
+separate comm stream to pick on TPU.
+"""
+from __future__ import annotations
+
+from . import collective as _c
+
+__all__ = ["all_reduce", "all_gather", "reduce_scatter", "alltoall",
+           "alltoall_single", "broadcast", "reduce", "scatter", "send",
+           "recv"]
+
+
+def _drop_stream_kw(kw):
+    kw.pop("use_calc_stream", None)
+    return kw
+
+
+def all_reduce(tensor, op=None, group=None, sync_op=True, **kw):
+    args = {} if op is None else {"op": op}
+    return _c.all_reduce(tensor, group=group, sync_op=sync_op,
+                         **args, **_drop_stream_kw(kw))
+
+
+def all_gather(tensor_or_list, tensor, group=None, sync_op=True, **kw):
+    return _c.all_gather(tensor_or_list, tensor, group=group,
+                         sync_op=sync_op, **_drop_stream_kw(kw))
+
+
+def reduce_scatter(tensor, tensor_or_list, op=None, group=None,
+                   sync_op=True, **kw):
+    args = {} if op is None else {"op": op}
+    return _c.reduce_scatter(tensor, tensor_or_list, group=group,
+                             sync_op=sync_op, **args,
+                             **_drop_stream_kw(kw))
+
+
+def alltoall(out_tensor_list, in_tensor_list, group=None, sync_op=True,
+             **kw):
+    from . import compat as _compat
+
+    return _compat.alltoall(out_tensor_list, in_tensor_list,
+                            group=group, sync_op=sync_op)
+
+
+def alltoall_single(out_tensor, in_tensor, out_split_sizes=None,
+                    in_split_sizes=None, group=None, sync_op=True, **kw):
+    from . import compat as _compat
+
+    return _compat.alltoall_single(out_tensor, in_tensor,
+                                   in_split_sizes, out_split_sizes,
+                                   group=group, sync_op=sync_op)
+
+
+def broadcast(tensor, src=0, group=None, sync_op=True, **kw):
+    return _c.broadcast(tensor, src, group=group, sync_op=sync_op,
+                        **_drop_stream_kw(kw))
+
+
+def reduce(tensor, dst=0, op=None, group=None, sync_op=True, **kw):
+    args = {} if op is None else {"op": op}
+    return _c.reduce(tensor, dst, group=group, sync_op=sync_op, **args,
+                     **_drop_stream_kw(kw))
+
+
+def scatter(tensor, tensor_or_list=None, src=0, group=None,
+            sync_op=True, **kw):
+    return _c.scatter(tensor, tensor_or_list, src, group=group,
+                      **_drop_stream_kw(kw))
+
+
+def send(tensor, dst=0, group=None, sync_op=True, **kw):
+    return _c.send(tensor, dst, group=group, sync_op=sync_op,
+                   **_drop_stream_kw(kw))
+
+
+def recv(tensor, src=0, group=None, sync_op=True, **kw):
+    return _c.recv(tensor, src, group=group, sync_op=sync_op,
+                   **_drop_stream_kw(kw))

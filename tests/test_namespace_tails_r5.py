@@ -1,0 +1,108 @@
+"""Round-5 namespace tails: distributed.stream, P2POp/batch_isend_irecv,
+fleet role makers + fleet.util, audio.datasets (TESS/ESC50 with a
+native PCM16 WAV parser).
+
+Reference: communication/stream/*, communication/batch_isend_irecv.py,
+fleet/base/role_maker.py:654/1163 + util_factory.py,
+audio/datasets/{tess.py:36, esc50.py:41}.
+"""
+import os
+import struct
+import tempfile
+import zipfile
+
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+from paddle_tpu.distributed import fleet
+
+
+def _wav_bytes(sr=16000, n=100, amp=20000):
+    pcm = (np.sin(np.linspace(0, 10, n)) * amp).astype("<i2").tobytes()
+    hdr = b"RIFF" + struct.pack("<I", 36 + len(pcm)) + b"WAVE"
+    fmt = b"fmt " + struct.pack("<IHHIIHH", 16, 1, 1, sr, sr * 2, 2, 16)
+    return hdr + fmt + b"data" + struct.pack("<I", len(pcm)) + pcm
+
+
+class TestDistributedTails:
+    def test_stream_namespace(self):
+        x = paddle.to_tensor(np.ones(4, "float32"))
+        out = dist.stream.all_reduce(x, use_calc_stream=True)
+        assert out is not None
+        # single process: broadcast/reduce are identities
+        assert np.allclose(
+            np.asarray(dist.stream.broadcast(x, src=0)._value), 1.0)
+
+    def test_p2pop_batch(self):
+        x = paddle.to_tensor(np.arange(4, dtype="float32"))
+        ops = [dist.P2POp(dist.isend, x, 0),
+               dist.P2POp(dist.irecv, x, 0)]
+        tasks = dist.batch_isend_irecv(ops)
+        assert len(tasks) == 2
+        for t in tasks:
+            t.wait()
+        import pytest
+
+        with pytest.raises(Exception):
+            dist.P2POp(dist.all_reduce, x, 0)  # only isend/irecv
+
+    def test_role_makers(self):
+        rm = fleet.PaddleCloudRoleMaker(is_collective=True)
+        assert rm.is_worker() and not rm.is_server()
+        assert rm.worker_index() == int(
+            os.environ.get("PADDLE_TRAINER_ID", "0"))
+        u = fleet.UserDefinedRoleMaker(current_id=3, worker_num=8)
+        assert u.worker_index() == 3 and u.worker_num() == 8
+        assert not u.is_first_worker()
+        fleet.init(role_maker=rm, is_collective=True)
+
+    def test_fleet_util(self):
+        assert isinstance(fleet.util, fleet.UtilBase)
+        files = ["a", "b", "c", "d", "e"]
+        assert fleet.util.get_file_shard(files) == files  # world=1
+        assert np.allclose(fleet.util.all_reduce(np.ones(3), "sum"),
+                           np.ones(3))
+
+
+class TestAudioDatasets:
+    def test_wav_parser_and_tess(self):
+        from paddle_tpu.audio.datasets import TESS
+
+        with tempfile.TemporaryDirectory() as d:
+            for nm in ("OAF_back_angry.wav", "YAF_dog_happy.wav",
+                       "notes.txt"):
+                with open(os.path.join(d, nm), "wb") as f:
+                    f.write(_wav_bytes() if nm.endswith(".wav")
+                            else b"x")
+            ds = TESS(d)
+            assert len(ds) == 2
+            w, y = ds[0]
+            assert w.dtype == np.float32
+            assert abs(float(np.abs(w).max()) - 20000 / 32768) < 0.05
+            assert int(y) == TESS.EMOTIONS.index("angry")
+
+    def test_esc50_folds_and_zip(self):
+        from paddle_tpu.audio.datasets import ESC50
+
+        with tempfile.TemporaryDirectory() as d:
+            for nm in ("1-100032-A-0.wav", "5-9032-A-14.wav"):
+                with open(os.path.join(d, nm), "wb") as f:
+                    f.write(_wav_bytes())
+            tr = ESC50(d, mode="train")
+            dv = ESC50(d, mode="dev")
+            assert len(tr) == 1 and int(tr[0][1]) == 0
+            assert len(dv) == 1 and int(dv[0][1]) == 14
+            zp = os.path.join(d, "esc.zip")
+            with zipfile.ZipFile(zp, "w") as z:
+                z.writestr("audio/1-1-A-3.wav", _wav_bytes())
+            z2 = ESC50(zp, mode="train")
+            assert len(z2) == 1 and int(z2[0][1]) == 3
+
+    def test_non_pcm_gates(self):
+        from paddle_tpu.audio.datasets import _read_wav
+
+        import pytest
+
+        with pytest.raises(NotImplementedError):
+            _read_wav(b"OggS" + b"\x00" * 40)
