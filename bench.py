@@ -302,6 +302,92 @@ def bench_llama_decode_ragged(on_tpu, dev):
 
 
 # ---------------------------------------------------------------------------
+# 5c. Continuous-batching serving engine over the ragged paged KV cache:
+# a mixed-length request stream through ServingEngine (admission /
+# eviction / backfill, one shared decode program) vs the same stream
+# served sequentially, one request per Predictor.generate. The JSON
+# line carries the compile-cache counters: after warmup on one length
+# mix, the streamed mixes must add ZERO compiles (program reuse is the
+# tracked metric, not just tokens/s).
+# ---------------------------------------------------------------------------
+def bench_serving_mixed(on_tpu, dev):
+    import paddle_tpu as paddle
+    from paddle_tpu.inference import Config, ServingEngine, \
+        create_predictor
+    from paddle_tpu.models.llama import LlamaForCausalLM, llama_7b, \
+        llama_tiny
+
+    old_dtype = paddle.get_default_dtype()
+    if on_tpu:
+        paddle.set_default_dtype("bfloat16")
+        cfg = llama_7b(max_position_embeddings=2304, dtype="bfloat16")
+        warm_mix = [512, 768]
+        mixes = [[1024, 896, 640], [512, 384], [768, 320, 256, 640],
+                 [896]]
+        n_new, page, B, chunk = 64, 128, 8, 8
+    else:
+        cfg = llama_tiny()
+        warm_mix = [7, 12]
+        mixes = [[24, 17, 11], [9, 5], [30, 2, 14, 8], [13]]
+        n_new, page, B, chunk = 8, 8, 4, 4
+    try:
+        paddle.seed(0)
+        model = LlamaForCausalLM(cfg)
+        conf = Config().set_model(model).enable_paged_kv(page_size=page)
+        if on_tpu:
+            conf.enable_weight_only("weight_only_int8")
+        pred = create_predictor(conf)
+        r = np.random.RandomState(0)
+
+        def prompts(lens):
+            return [r.randint(1, cfg.vocab_size, (L,)) for L in lens]
+
+        eng = ServingEngine(pred, max_batch=B, decode_chunk=chunk)
+        for p in prompts(warm_mix):                      # warmup mix
+            eng.submit(p, max_new_tokens=n_new)
+        eng.run()
+        compiles_warm = eng.stats.compiles
+        stream = [p for mix in mixes for p in prompts(mix)]
+        t0 = time.perf_counter()
+        rids = [eng.submit(p, max_new_tokens=n_new) for p in stream]
+        done = eng.run()
+        dt = max(time.perf_counter() - t0, 1e-4)
+        n_tok = sum(len(done[rid].new_tokens) for rid in rids)
+        tok_s = n_tok / dt
+
+        # sequential per-request Predictor baseline on the SAME stream
+        seq_pred = create_predictor(conf)
+        for p in prompts(warm_mix):                      # warm its programs
+            seq_pred.generate(paddle.to_tensor(p[None]),
+                              max_new_tokens=n_new)
+        t0 = time.perf_counter()
+        for p in stream:
+            out = seq_pred.generate(paddle.to_tensor(p[None]),
+                                    max_new_tokens=n_new)
+        float(out._value[0, -1])
+        seq_dt = max(time.perf_counter() - t0, 1e-4)
+        seq_tok_s = len(stream) * n_new / seq_dt
+
+        _emit({
+            "metric": "serving_mixed_traffic_tokens_per_sec" if on_tpu
+            else "serving_smoke_mixed_traffic_tokens_per_sec",
+            "value": round(tok_s, 2),
+            "unit": "tokens/s",
+            # the gate: continuous batching must beat sequential serving
+            "vs_baseline": round(tok_s / seq_tok_s, 4),
+            "sequential_tokens_per_sec": round(seq_tok_s, 2),
+            "compiles": eng.stats.compiles,
+            "cache_hits": eng.stats.cache_hits,
+            "recompiles_after_warmup": eng.stats.compiles - compiles_warm,
+            "batch": B, "page_size": page, "decode_chunk": chunk,
+            "requests": len(stream), "tokens": n_tok,
+            "device": str(getattr(dev, "device_kind", dev.platform)),
+        })
+    finally:
+        paddle.set_default_dtype(old_dtype)
+
+
+# ---------------------------------------------------------------------------
 # 3. GPT-13B hybrid TP x PP x DP + GroupSharded stage2 (BASELINE row 3).
 # Needs >= 8 chips; on one chip it reports the requirement cleanly, and
 # on the CPU harness it runs the FULL hybrid code path on tiny shapes
@@ -566,11 +652,11 @@ _BENCHES = {}
 # driver's budget (the round-4 blackout: kernel_parity first + 1200s
 # each + headline printed last = one hang, zero lines).
 _TIMEOUTS = {"gpt": 900, "llama_decode": 420, "llama_decode_int8": 420,
-             "llama_decode_ragged": 420, "resnet": 300, "moe": 300,
-             "gpt13b_hybrid": 420, "kernel_parity": 240}
+             "llama_decode_ragged": 420, "serving": 420, "resnet": 300,
+             "moe": 300, "gpt13b_hybrid": 420, "kernel_parity": 240}
 _ORDER = ("gpt", "llama_decode", "llama_decode_int8",
-          "llama_decode_ragged", "resnet", "moe", "gpt13b_hybrid",
-          "kernel_parity")
+          "llama_decode_ragged", "serving", "resnet", "moe",
+          "gpt13b_hybrid", "kernel_parity")
 # benches that need a virtual multi-device mesh on the CPU fallback
 _NEEDS_VDEV = {"gpt13b_hybrid": 8}
 
@@ -693,6 +779,7 @@ def main(argv):
                     kernel_parity=bench_kernel_parity,
                     llama_decode_int8=bench_llama_decode_int8,
                     llama_decode_ragged=bench_llama_decode_ragged,
+                    serving=bench_serving_mixed,
                     gpt13b_hybrid=bench_gpt13b_hybrid)
     if len(argv) > 1 and argv[1] == "--only":
         dl = int(argv[3]) if len(argv) > 3 else None

@@ -1,16 +1,22 @@
-"""Serve an LLM with the paged KV cache and ragged batching.
+"""Serve an LLM with the paged KV cache, ragged batching, and the
+continuous-batching ServingEngine.
 
-One compiled prefill + the WHOLE decode loop as one XLA program;
-mixed-length prompts decode at per-row offsets, stop per row at EOS,
-and the KV cache is a paged pool (pages allocated per row, block-table
-indirection inside the Pallas kernel on TPU).
+Static batch: one compiled prefill + the WHOLE decode loop as one XLA
+program; mixed-length prompts decode at per-row offsets, stop per row
+at EOS, and the KV cache is a paged pool (pages allocated per row,
+block-table indirection inside the Pallas kernel on TPU).
+
+Traffic: ServingEngine admits a request STREAM into an in-flight
+batch — per-arrival bucketed prefill, one shared decode step, early
+rows evicted (pages back on the free list) and backfilled from the
+queue, all on a fixed program lattice (zero recompiles after warmup).
 
     python examples/serve_llama_paged.py          # tiny model, CPU ok
 """
 import numpy as np
 
 import paddle_tpu as paddle
-from paddle_tpu.inference import Config, create_predictor
+from paddle_tpu.inference import Config, ServingEngine, create_predictor
 from paddle_tpu.models.llama import LlamaForCausalLM, llama_tiny
 
 
@@ -23,7 +29,7 @@ def main():
     # conf.enable_weight_only("weight_only_int8")   # int8 weights in HBM
     pred = create_predictor(conf)
 
-    # three prompts of different lengths, right-padded
+    # --- static ragged batch: one generate() call -----------------------
     r = np.random.RandomState(0)
     lens = [11, 24, 17]
     ids = np.zeros((3, max(lens)), np.int64)
@@ -35,6 +41,18 @@ def main():
     for b, L in enumerate(lens):
         print(f"prompt[{b}] len={L:2d} -> new tokens:",
               out.numpy()[b, max(lens):].tolist())
+
+    # --- continuous batching: a request stream --------------------------
+    eng = ServingEngine(pred, max_batch=2, decode_chunk=4)
+    rids = [eng.submit(r.randint(1, model.config.vocab_size, (L,)),
+                       max_new_tokens=6)
+            for L in (7, 19, 4, 13, 9)]      # more requests than slots
+    done = eng.run()                          # evict + backfill inside
+    for rid in rids:
+        req = done[rid]
+        print(f"request {rid} len={len(req.prompt):2d} -> ",
+              req.new_tokens)
+    print("compile telemetry:", eng.stats.as_dict())
 
 
 if __name__ == "__main__":

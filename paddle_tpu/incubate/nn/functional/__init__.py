@@ -138,11 +138,33 @@ def masked_multihead_attention(x, cache_kv=None, bias=None, src_mask=None,
     x: [B, 3*H*D] fused qkv of the new token; cache_kv: [2, B, H, M, D];
     sequence_lengths: [B, 1] per-row write/attend offsets (the ragged
     primitive of ops/pallas/decode_attention.py). Returns
-    (out [B, H*D], updated cache_kv). Quant knobs are accepted for API
-    parity; the TPU serving path quantizes via nn.quant instead."""
+    (out [B, H*D], updated cache_kv). src_mask/cum_offsets/
+    beam_cache_offset and the quant knobs are NOT served here (the TPU
+    path masks by the per-row frontier, packs via the Predictor, and
+    quantizes via nn.quant) — they are enforced to their defaults so
+    divergence is loud, mirroring block_multihead_attention."""
     from ....ops.pallas.decode_attention import _dense_ragged
     from ....core.enforce import enforce as _enf
 
+    for knob, name in ((src_mask, "src_mask"),
+                       (cum_offsets, "cum_offsets"),
+                       (beam_cache_offset, "beam_cache_offset"),
+                       (qkv_out_scale, "qkv_out_scale"),
+                       (out_shift, "out_shift"),
+                       (out_smooth, "out_smooth")):
+        _enf(knob is None,
+             f"masked_multihead_attention: {name} is not served by the "
+             "TPU decode step (masking is the per-row frontier, "
+             "packing is the Predictor serving path, quantization is "
+             "nn.quant) — pass None")
+    _enf(out_scale in (-1, None) and compute_dtype == "default"
+         and quant_round_type == 1 and quant_max_bound == 127.0
+         and quant_min_bound == -127.0,
+         "masked_multihead_attention: output/cache quantization is "
+         "served by nn.quant on TPU, not in-kernel — leave the quant "
+         "knobs at their defaults")
+    _enf(seq_len == 1, "masked_multihead_attention decodes one token "
+                       "per row (seq_len must be 1)")
     xv = x._value if isinstance(x, Tensor) else jnp.asarray(x)
     cv = cache_kv._value if isinstance(cache_kv, Tensor) \
         else jnp.asarray(cache_kv)
@@ -171,8 +193,6 @@ def masked_multihead_attention(x, cache_kv=None, bias=None, src_mask=None,
     v_cache = cv[1].at[jnp.arange(B), :, off, :].set(
         v.astype(cv.dtype))
     out = _dense_ragged(q[:, None], k_cache, v_cache, off)
-    # (src_mask: positions beyond each row's offset are already masked
-    # by the per-row frontier inside _dense_ragged)
     new_cache = jnp.stack([k_cache, v_cache])
     return (Tensor(out.reshape(B, H * D), stop_gradient=True),
             Tensor(new_cache, stop_gradient=True))

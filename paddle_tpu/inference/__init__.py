@@ -50,7 +50,8 @@ from jax import lax
 
 from ..tensor import Tensor
 
-__all__ = ["Config", "Predictor", "create_predictor", "GenerationConfig"]
+__all__ = ["Config", "Predictor", "create_predictor", "GenerationConfig",
+           "CompileStats", "ServingEngine", "ServingRequest"]
 
 
 def _bucket(n: int, lo: int = 64) -> int:
@@ -58,6 +59,52 @@ def _bucket(n: int, lo: int = 64) -> int:
     while b < n:
         b *= 2
     return b
+
+
+class CompileStats:
+    """Compile-cache telemetry for the serving path.
+
+    Every compiled-program launch site notes its FULL shape signature
+    (including the paged-pool size P — the shape jax.jit actually keys
+    on, even when the host-side fn cache key doesn't). A new signature
+    is an XLA compile; a repeated one is a cache hit, so after warmup a
+    healthy serving path shows ``compiles`` flat and ``cache_hits``
+    growing under arbitrary traffic mixes."""
+
+    def __init__(self):
+        self.compiles = 0
+        self.cache_hits = 0
+        self.tokens = 0
+        self.bucket_tokens: Dict[Any, int] = {}
+        self._seen = set()
+
+    def note(self, kind: str, sig) -> bool:
+        """Record one compiled-program launch; True if it compiles."""
+        key = (kind, sig)
+        if key in self._seen:
+            self.cache_hits += 1
+            return False
+        self._seen.add(key)
+        self.compiles += 1
+        return True
+
+    def count_tokens(self, bucket, n: int):
+        self.tokens += int(n)
+        self.bucket_tokens[bucket] = self.bucket_tokens.get(bucket, 0) \
+            + int(n)
+
+    def tokens_per_sec(self, elapsed_s: float) -> float:
+        return self.tokens / elapsed_s if elapsed_s > 0 else 0.0
+
+    def as_dict(self) -> Dict[str, Any]:
+        return {"compiles": self.compiles, "cache_hits": self.cache_hits,
+                "tokens": self.tokens,
+                "bucket_tokens": {str(k): v
+                                  for k, v in self.bucket_tokens.items()}}
+
+    def __repr__(self):
+        return (f"CompileStats(compiles={self.compiles}, "
+                f"cache_hits={self.cache_hits}, tokens={self.tokens})")
 
 
 def _sample(logits, key, gen: "GenerationConfig"):
@@ -191,6 +238,7 @@ class Predictor:
         self._prefill_fns: Dict[Any, Any] = {}
         self._last_outputs: List[np.ndarray] = []
         self._input_names = ["input_ids"]
+        self.stats = CompileStats()
 
     @staticmethod
     def _build_model(config: Config):
@@ -237,6 +285,7 @@ class Predictor:
         vals = [x._value if isinstance(x, Tensor) else jnp.asarray(x)
                 for x in inputs]
         key = tuple((v.shape, str(v.dtype)) for v in vals)
+        self.stats.note("run", key)
         if key not in self._run_fns:
             model, params = self._model, self._params
             from ..autograd import no_grad
@@ -334,12 +383,20 @@ class Predictor:
         """Allocate per-row physical pages for len+n_new tokens. Logical
         pages a row does not own map to one shared TRASH page, so
         prefill's right-pad writes land harmlessly (they are never
-        attended: the mask stops at each row's frontier)."""
+        attended: the mask stops at each row's frontier).
+
+        The physical pool size P is BUCKETED to a power of two exactly
+        like S: jax.jit keys compiled programs on the pool shape, so an
+        exact ``sum(need)+1`` pool would recompile prefill AND the fused
+        decode scan on nearly every distinct batch length-mix. On the
+        bucket lattice, every mix whose page demand lands in the same
+        bucket reuses the same compiled programs (the extra pages are
+        never referenced by any table entry below the trash id)."""
         cfg = self._model.config
         B = len(lengths)
         npages = -(-M // page)
         need = [-(-(int(l) + n_new) // page) for l in lengths]
-        P = sum(need) + 1                     # +1 trash page (id P-1)
+        P = _bucket(sum(need) + 1, lo=8)      # +1 trash page (id P-1)
         trash = P - 1
         table = np.full((B, npages), trash, np.int32)
         nxt = 0
@@ -388,14 +445,17 @@ class Predictor:
         pvals = tuple(p._value for p in self._params)
         page = self.config._kv_page_size
         if page:
-            caches, _ = self._paged_caches(lengths, n_new, M, page,
+            caches, P = self._paged_caches(lengths, n_new, M, page,
                                            p_dtype)
         else:
             caches = model._empty_caches(B, M, p_dtype)
+            P = 0
 
         ids_p = np.zeros((B, Sb), ids.dtype)
         ids_p[:, :S0] = ids
         prefill = self._prefill_fn(B, Sb, M)
+        self.stats.note("prefill", (B, Sb, M, page, P, str(ids_p.dtype),
+                                    str(p_dtype)))
         last, caches = prefill(pvals, jnp.asarray(ids_p), caches,
                                jnp.asarray(lengths))
 
@@ -404,6 +464,12 @@ class Predictor:
         # first sampled token (same rule as the compiled loop)
         decode = self._decode_fn(B, M, n_new - 1, gen, ragged,
                                  bool(page)) if n_new > 1 else None
+        if decode is not None:
+            self.stats.note("decode", (B, M, n_new - 1, gen.temperature,
+                                       gen.top_k, gen.top_p,
+                                       gen.eos_token_id, ragged, page, P,
+                                       str(p_dtype)))
+        self.stats.count_tokens(("generate", B, Sb, P), B * n_new)
         tok0 = _sample(last, sub, gen)
         # ragged rows decode at PER-ROW offsets: each row's rope
         # positions, cache-write slot, and attention frontier advance
@@ -416,3 +482,6 @@ class Predictor:
             all_new = tok0[:, None]
         out = jnp.concatenate([jnp.asarray(ids), all_new], axis=1)
         return Tensor(out, stop_gradient=True)
+
+
+from .serving import ServingEngine, ServingRequest  # noqa: E402

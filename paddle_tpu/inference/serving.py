@@ -1,0 +1,311 @@
+"""Continuous-batching serving engine over the ragged paged KV cache.
+
+The ``Predictor`` serves one batch per ``generate()`` call: every row
+starts and finishes together (static batching), and the physical page
+pool is sized per call. This module adds the traffic-grade layer the
+reference serves with block_multi_head_attention + its serving runtime
+(reference capability: llm.predictor / fused blha continuous batching;
+design per the Ragged Paged Attention paper in PAPERS.md — ONE compiled
+program for arbitrary length mixes):
+
+- ``ServingEngine`` owns ONE fixed-size physical page pool (its shape
+  never changes for the engine's lifetime) plus a host-side page free
+  list. Requests are admitted into B slots of an in-flight batch; a
+  request's pages are popped from the free list at admission and pushed
+  back at completion — eviction + backfill, not drain-and-refill.
+- PREFILL runs per arrival at [1, Sb] with Sb on the same power-of-two
+  bucket lattice as the Predictor, writing straight into the arrival's
+  pages through its block-table row (right-pad writes land in the
+  shared trash page).
+- DECODE is one shared compiled step for the whole batch: [B, 1] tokens
+  at per-row offsets against the shared pool. Free slots ride along
+  with an all-trash table row (their writes land in the trash page,
+  their outputs are ignored) so the program shape is ALWAYS
+  (B, pool_bucket) — admissions and evictions never change a compiled
+  shape. ``decode_chunk`` fuses that many decode steps into one
+  ``lax.scan`` launch; admission/eviction happens at chunk boundaries.
+
+Compile stability: every program is keyed on the small fixed lattice
+(batch B, seq bucket Sb, pool bucket P). After one warmup mix, a stream
+with arbitrary length mixes triggers ZERO additional XLA compiles —
+asserted via the shared ``CompileStats`` counters (``engine.stats``).
+"""
+from __future__ import annotations
+
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+import jax
+import numpy as np
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.enforce import enforce
+from ..tensor import Tensor
+
+__all__ = ["ServingEngine", "ServingRequest"]
+
+
+@dataclass
+class ServingRequest:
+    """One serving request and (once finished) its result."""
+
+    rid: int
+    prompt: np.ndarray                   # [L] int prompt tokens
+    max_new_tokens: int
+    eos_token_id: Optional[int] = None
+    new_tokens: List[int] = field(default_factory=list)
+
+    @property
+    def output_ids(self) -> np.ndarray:
+        """prompt + generated tokens (the Predictor.generate layout)."""
+        return np.concatenate([np.asarray(self.prompt, np.int64),
+                               np.asarray(self.new_tokens, np.int64)])
+
+
+class _Slot:
+    """Host-side state of one in-flight batch row."""
+
+    __slots__ = ("req", "pages", "pos")
+
+    def __init__(self, req: ServingRequest, pages: List[int]):
+        self.req = req
+        self.pages = pages
+        # cache position the NEXT decode input token is written at
+        self.pos = len(req.prompt)
+
+
+class ServingEngine:
+    """Continuous batching over a Predictor with a paged KV cache.
+
+    >>> pred = create_predictor(Config().set_model(m).enable_paged_kv(64))
+    >>> eng = ServingEngine(pred, max_batch=8)
+    >>> rid = eng.submit(prompt_ids, max_new_tokens=64)
+    >>> done = eng.run()          # {rid: ServingRequest}
+    >>> done[rid].output_ids
+
+    ``submit`` only queues; ``step()`` runs one admission + decode round
+    (the unit a serving loop would tick), ``run()`` drains everything.
+    """
+
+    def __init__(self, predictor, max_batch: Optional[int] = None,
+                 pool_pages: Optional[int] = None, decode_chunk: int = 1):
+        from . import _bucket
+
+        cfg = predictor.config
+        enforce(cfg._kv_page_size,
+                "ServingEngine serves over the paged KV cache; call "
+                "Config.enable_paged_kv(page_size) before "
+                "create_predictor")
+        self.pred = predictor
+        self.page = int(cfg._kv_page_size)
+        mcfg = predictor._model.config
+        self.M = int(cfg.max_length or mcfg.max_position_embeddings)
+        self.npages = -(-self.M // self.page)
+        self.B = int(max_batch or cfg.max_batch_size)
+        enforce(self.B >= 1 and decode_chunk >= 1,
+                "max_batch and decode_chunk must be >= 1")
+        self.chunk = int(decode_chunk)
+        # one pool for the engine's whole lifetime, on the same bucket
+        # lattice as Predictor._paged_caches: the compiled programs are
+        # keyed on this shape and NEVER change it
+        want = pool_pages or (self.B * self.npages + 1)
+        self.P = _bucket(int(want), lo=8)
+        self.trash = self.P - 1
+        self._free_pages = list(range(self.P - 1))
+        self._dtype = predictor._params[0]._value.dtype
+        shape = (self.P, mcfg.num_kv_heads, self.page, mcfg.head_dim)
+        self.pools = [(jnp.zeros(shape, self._dtype),
+                       jnp.zeros(shape, self._dtype))
+                      for _ in range(mcfg.num_layers)]
+        self.tables = np.full((self.B, self.npages), self.trash, np.int32)
+        self.slots: List[Optional[_Slot]] = [None] * self.B
+        self.queue: deque = deque()
+        self.finished: Dict[int, ServingRequest] = {}
+        self.stats = predictor.stats      # shared compile telemetry
+        self.gen = cfg.generation
+        self._rng = jax.random.PRNGKey(self.gen.seed)
+        self._step_fns: Dict[Any, Any] = {}
+        self._next_rid = 0
+
+    # -- admission -------------------------------------------------------
+    def submit(self, prompt, max_new_tokens: Optional[int] = None,
+               eos_token_id: Optional[int] = None) -> int:
+        """Queue one request; returns its rid (admission happens inside
+        step()/run(), when a slot and enough free pages exist)."""
+        ids = np.asarray(prompt._value if isinstance(prompt, Tensor)
+                         else prompt).reshape(-1).astype(np.int64)
+        n_new = int(max_new_tokens if max_new_tokens is not None
+                    else self.gen.max_new_tokens)
+        eos = eos_token_id if eos_token_id is not None \
+            else self.gen.eos_token_id
+        L = len(ids)
+        enforce(L >= 1 and n_new >= 1, "empty prompt / max_new_tokens")
+        enforce(L + n_new <= self.M,
+                f"prompt ({L}) + max_new_tokens ({n_new}) exceeds cache "
+                f"length {self.M}; raise Config.max_length")
+        enforce(self._pages_needed(L, n_new) <= self.P - 1,
+                f"request needs {self._pages_needed(L, n_new)} pages but "
+                f"the pool only has {self.P - 1}; raise pool_pages")
+        rid = self._next_rid
+        self._next_rid += 1
+        self.queue.append(ServingRequest(rid, ids, n_new, eos))
+        return rid
+
+    def _pages_needed(self, L: int, n_new: int) -> int:
+        return -(-(L + n_new) // self.page)
+
+    def _pvals(self):
+        return tuple(p._value for p in self.pred._params)
+
+    def _admit(self):
+        """FIFO-admit queued requests into free slots while pages last;
+        each admission runs one bucketed prefill into the shared pool."""
+        while self.queue:
+            free = [b for b in range(self.B) if self.slots[b] is None]
+            if not free:
+                return
+            req = self.queue[0]
+            need = self._pages_needed(len(req.prompt), req.max_new_tokens)
+            if need > len(self._free_pages):
+                return                    # head-of-line waits for evictions
+            self.queue.popleft()
+            b = free[0]
+            pages = [self._free_pages.pop() for _ in range(need)]
+            self.tables[b, :] = self.trash
+            self.tables[b, :need] = pages
+            self.slots[b] = _Slot(req, pages)
+            self._prefill(b)
+
+    def _prefill(self, b: int):
+        from . import _bucket, _sample
+
+        slot = self.slots[b]
+        req = slot.req
+        L = len(req.prompt)
+        Sb = min(_bucket(L), self.M)
+        ids = np.zeros((1, Sb), np.int32)
+        ids[0, :L] = req.prompt
+        caches = [(kp, vp, jnp.asarray(self.tables[b:b + 1]))
+                  for kp, vp in self.pools]
+        fn = self.pred._prefill_fn(1, Sb, self.M)
+        self.stats.note("prefill", (1, Sb, self.M, self.page, self.P,
+                                    str(ids.dtype), str(self._dtype)))
+        last, caches = fn(self._pvals(), jnp.asarray(ids), caches,
+                          jnp.asarray([L], jnp.int32))
+        self.pools = [(c[0], c[1]) for c in caches]
+        self._rng, sub = jax.random.split(self._rng)
+        tok0 = int(np.asarray(_sample(last, sub, self.gen))[0])
+        req.new_tokens.append(tok0)
+        self.stats.count_tokens(("prefill", Sb, self.P), 1)
+        if len(req.new_tokens) >= req.max_new_tokens or \
+                (req.eos_token_id is not None and tok0 == req.eos_token_id):
+            self._finish(b)
+
+    # -- decode ----------------------------------------------------------
+    def _decode_step_fn(self):
+        """One shared compiled decode program for the whole in-flight
+        batch: [B] tokens at per-row offsets against the fixed pool,
+        ``chunk`` steps fused in one lax.scan. Keyed ONLY on lattice
+        constants — admissions/evictions never change its shape."""
+        gen = self.gen
+        key = (self.B, self.M, self.chunk, gen.temperature, gen.top_k,
+               gen.top_p)
+        if key in self._step_fns:
+            return self._step_fns[key]
+        model, params = self.pred._model, self.pred._params
+        chunk = self.chunk
+        from . import _sample
+        from ..autograd import no_grad
+        from ..distributed.engine import bind_params
+
+        def step(pvals, tok0, caches, pos0, rng):
+            def body(carry, _):
+                tok, caches, pos, rng = carry
+                with no_grad(), bind_params(params, pvals):
+                    logits, caches = model.forward(
+                        Tensor(tok[:, None], stop_gradient=True),
+                        caches=caches, offset=pos)
+                lv = (logits._value if isinstance(logits, Tensor)
+                      else logits)
+                rng, sub = jax.random.split(rng)
+                nxt = _sample(lv[:, -1], sub, gen)
+                return (nxt, caches, pos + 1, rng), nxt
+
+            (_, caches, _, _), toks = lax.scan(
+                body, (tok0, caches, pos0, rng), None, length=chunk)
+            return jnp.swapaxes(toks, 0, 1), caches     # [B, chunk]
+
+        self._step_fns[key] = jax.jit(step, donate_argnums=(2,))
+        return self._step_fns[key]
+
+    def _decode_round(self):
+        active = [b for b in range(self.B) if self.slots[b] is not None]
+        if not active:
+            return
+        tok = np.zeros((self.B,), np.int32)
+        pos = np.zeros((self.B,), np.int32)
+        for b in active:
+            s = self.slots[b]
+            tok[b] = s.req.new_tokens[-1]
+            pos[b] = s.pos + len(s.req.new_tokens) - 1
+        # free slots ride along at pos 0 with an all-trash table row:
+        # their writes hit the trash page, their outputs are ignored
+        caches = [(kp, vp, jnp.asarray(self.tables))
+                  for kp, vp in self.pools]
+        fn = self._decode_step_fn()
+        self.stats.note("serve_decode",
+                        (self.B, self.M, self.chunk, self.P,
+                         self.gen.temperature, self.gen.top_k,
+                         self.gen.top_p, str(self._dtype)))
+        self._rng, sub = jax.random.split(self._rng)
+        toks, caches = fn(self._pvals(), jnp.asarray(tok), caches,
+                          jnp.asarray(pos), sub)
+        self.pools = [(c[0], c[1]) for c in caches]
+        toks = np.asarray(toks)
+        emitted = 0
+        for b in active:
+            req = self.slots[b].req
+            for t in toks[b]:
+                t = int(t)
+                req.new_tokens.append(t)
+                emitted += 1
+                if len(req.new_tokens) >= req.max_new_tokens or \
+                        (req.eos_token_id is not None
+                         and t == req.eos_token_id):
+                    self._finish(b)
+                    break               # rest of the chunk is discarded
+        self.stats.count_tokens(("decode", self.B, self.chunk, self.P),
+                                emitted)
+
+    def _finish(self, b: int):
+        """Evict a finished row: pages back on the free list, table row
+        to all-trash, slot open for backfill."""
+        slot = self.slots[b]
+        self._free_pages.extend(slot.pages)
+        self.tables[b, :] = self.trash
+        self.slots[b] = None
+        self.finished[slot.req.rid] = slot.req
+
+    # -- driving ---------------------------------------------------------
+    @property
+    def num_active(self) -> int:
+        return sum(s is not None for s in self.slots)
+
+    def step(self):
+        """One serving tick: admit arrivals (each prefilled into the
+        pool), then one shared decode round for the in-flight batch."""
+        self._admit()
+        self._decode_round()
+
+    def run(self, max_steps: Optional[int] = None
+            ) -> Dict[int, ServingRequest]:
+        """Drain the queue + in-flight batch; returns {rid: request}."""
+        steps = 0
+        while self.queue or self.num_active:
+            self.step()
+            steps += 1
+            if max_steps is not None and steps >= max_steps:
+                break
+        return self.finished

@@ -24,12 +24,23 @@ class ClipGradByGlobalNorm(ClipGradBase):
                  auto_skip_clip: bool = False):
         self.clip_norm = float(clip_norm)
 
-    def apply_values(self, grads):
+    def apply_values(self, grads, extra_sq=0.0):
+        """extra_sq: squared-norm contribution of gradients clipped
+        elsewhere under the SAME global norm (the optimizer's merged
+        SelectedRows grads — reference: ClipGradByGlobalNorm merges
+        sparse grads into the global norm before scaling)."""
         sq = sum(jnp.sum(jnp.square(g.astype(jnp.float32))) for g in grads)
+        sq = sq + extra_sq
         global_norm = jnp.sqrt(sq)
         scale = jnp.minimum(self.clip_norm / jnp.maximum(global_norm, 1e-6),
                             1.0)
         return [(g * scale).astype(g.dtype) for g in grads], global_norm
+
+    def coefficient(self, global_norm):
+        """Scale factor for a given global norm (shared with the sparse
+        path so both sides clip by the identical coefficient)."""
+        return jnp.minimum(
+            self.clip_norm / jnp.maximum(global_norm, 1e-6), 1.0)
 
     def __repr__(self):
         return f"ClipGradByGlobalNorm(clip_norm={self.clip_norm})"

@@ -534,16 +534,17 @@ def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1,
 # max_pool3d / avg_pool3d moved to ops/pool3d.py (full reference
 # surface: return_mask, max_unpool3d, exclusive/divisor_override);
 # thin delegations kept for the MaxPool3D/AvgPool3D layer classes
-def max_pool3d(x, kernel_size, stride=None, padding=0):
+def max_pool3d(x, kernel_size, stride=None, padding=0, **kw):
     from .pool3d import max_pool3d as _mp3
 
-    return _mp3(x, kernel_size, stride, padding)
+    return _mp3(x, kernel_size, stride, padding, **kw)
 
 
-def avg_pool3d(x, kernel_size, stride=None, padding=0):
+def avg_pool3d(x, kernel_size, stride=None, padding=0, **kw):
     from .pool3d import avg_pool3d as _ap3
 
-    return _ap3(x, kernel_size, stride, padding, exclusive=True)
+    kw.setdefault("exclusive", True)
+    return _ap3(x, kernel_size, stride, padding, **kw)
 
 
 # ---------------------------------------------------------------------------

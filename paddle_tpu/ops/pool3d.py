@@ -21,6 +21,25 @@ def _t3(v):
     return (int(v),) * 3 if np.isscalar(v) else tuple(int(i) for i in v)
 
 
+def _spatial_pads(dims, k, s, p, ceil_mode):
+    """Per-spatial-dim (lo, hi) pads. ceil_mode adds ASYMMETRIC right
+    padding so reduce_window emits the ceil-division output size; a
+    window that would start entirely in the right pad is dropped (the
+    reference/caffe rule: the last window must start inside the input
+    or its left padding)."""
+    pads = []
+    for d, kk, ss, pp in zip(dims, k, s, p):
+        if ceil_mode:
+            od = -(-(d + 2 * pp - kk) // ss) + 1
+            if (od - 1) * ss >= d + pp:
+                od -= 1
+        else:
+            od = (d + 2 * pp - kk) // ss + 1
+        extra = max(0, (od - 1) * ss + kk - (d + 2 * pp))
+        pads.append((pp, pp + extra))
+    return pads
+
+
 @def_op("max_pool3d")
 def _max_pool3d_op(x, kernel_size=2, stride=None, padding=0,
                    ceil_mode=False, data_format="NCDHW"):
@@ -29,9 +48,12 @@ def _max_pool3d_op(x, kernel_size=2, stride=None, padding=0,
     p = _t3(padding)
     init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) \
         else jnp.iinfo(x.dtype).min
+    pads = _spatial_pads(x.shape[2:], k, s, p, ceil_mode)
+    # reduce_window pads with `init`, so the ceil-mode right pad is
+    # transparent to the max
     return lax.reduce_window(
         x, init, lax.max, (1, 1) + k, (1, 1) + s,
-        ((0, 0), (0, 0), (p[0], p[0]), (p[1], p[1]), (p[2], p[2])))
+        ((0, 0), (0, 0)) + tuple(pads))
 
 
 def max_pool3d(x, kernel_size=2, stride=None, padding=0, ceil_mode=False,
@@ -41,26 +63,27 @@ def max_pool3d(x, kernel_size=2, stride=None, padding=0, ceil_mode=False,
                          data_format)
     if not return_mask:
         return out
-    return out, _max_pool3d_mask(x, kernel_size, stride, padding)
+    return out, _max_pool3d_mask(x, kernel_size, stride, padding,
+                                 ceil_mode)
 
 
 @def_op("max_pool3d_mask", differentiable=False)
-def _max_pool3d_mask(x, kernel_size=2, stride=None, padding=0):
+def _max_pool3d_mask(x, kernel_size=2, stride=None, padding=0,
+                     ceil_mode=False):
     # flat argmax indices over the D*H*W volume (feeds max_unpool3d)
     k = _t3(kernel_size)
     s = _t3(stride if stride is not None else kernel_size)
     p = _t3(padding)
     B, C, D, H, W = x.shape
     neg = jnp.finfo(jnp.float32).min
+    pads = _spatial_pads((D, H, W), k, s, p, ceil_mode)
     xp = jnp.pad(x.astype(jnp.float32),
-                 ((0, 0), (0, 0), (p[0], p[0]), (p[1], p[1]),
-                  (p[2], p[2])), constant_values=neg)
+                 ((0, 0), (0, 0)) + tuple(pads), constant_values=neg)
     lin = jnp.arange(D * H * W, dtype=jnp.int32).reshape(1, 1, D, H, W)
-    lin = jnp.pad(lin, ((0, 0), (0, 0), (p[0], p[0]), (p[1], p[1]),
-                        (p[2], p[2])))
-    od = (D + 2 * p[0] - k[0]) // s[0] + 1
-    oh = (H + 2 * p[1] - k[1]) // s[1] + 1
-    ow = (W + 2 * p[2] - k[2]) // s[2] + 1
+    lin = jnp.pad(lin, ((0, 0), (0, 0)) + tuple(pads))
+    od = (D + sum(pads[0]) - k[0]) // s[0] + 1
+    oh = (H + sum(pads[1]) - k[1]) // s[1] + 1
+    ow = (W + sum(pads[2]) - k[2]) // s[2] + 1
     vals, idxs = [], []
     for a in range(k[0]):
         for b in range(k[1]):
@@ -87,12 +110,15 @@ def avg_pool3d(x, kernel_size=2, stride=None, padding=0, ceil_mode=False,
     k = _t3(kernel_size)
     s = _t3(stride if stride is not None else kernel_size)
     p = _t3(padding)
-    pads = ((0, 0), (0, 0), (p[0], p[0]), (p[1], p[1]), (p[2], p[2]))
+    sp = _spatial_pads(x.shape[2:], k, s, p, ceil_mode)
+    pads = ((0, 0), (0, 0)) + tuple(sp)
     summed = lax.reduce_window(x, 0.0, lax.add, (1, 1) + k, (1, 1) + s,
                                pads)
     if divisor_override:
         return summed / float(divisor_override)
-    if exclusive and any(p):
+    if exclusive and (any(p) or any(hi > lo for lo, hi in sp)):
+        # exclusive: divide by the count of REAL elements per window
+        # (padding — symmetric and the ceil-mode right pad — excluded)
         counts = lax.reduce_window(jnp.ones_like(x), 0.0, lax.add,
                                    (1, 1) + k, (1, 1) + s, pads)
         return summed / counts

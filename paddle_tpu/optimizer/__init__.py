@@ -116,10 +116,7 @@ class Optimizer:
         return self._update_rule(pf, sr.to_dense_value(), state,
                                  lr_value, step)
 
-    def _apply_sparse(self, p, lr_value, step_value, shapes):
-        from ..framework.selected_rows import merge_selected_rows
-
-        sr = merge_selected_rows(p.grad)
+    def _apply_sparse(self, p, sr, lr_value, step_value, shapes):
         state = self._param_state(p, shapes)
         pf = self._master_weights.get(id(p), p._value)
         new_p, new_s = self._sparse_update(p, pf, sr,
@@ -134,7 +131,8 @@ class Optimizer:
 
     @no_grad()
     def step(self):
-        from ..framework.selected_rows import SelectedRows
+        from ..framework.selected_rows import (SelectedRows,
+                                               merge_selected_rows)
 
         all_params = self._collect()
         if not all_params:
@@ -144,15 +142,35 @@ class Optimizer:
                   if isinstance(p.grad, SelectedRows)]
         params = [p for p in all_params
                   if not isinstance(p.grad, SelectedRows)]
+        extra_sq = None
         if sparse:
-            # sparse grads bypass grad_clip (clipping would need the
-            # dense norm; reference optimizers likewise apply sparse
-            # updates unclipped)
             shapes = self._state_shapes()
             lr_v = jnp.asarray(self.get_lr(), jnp.float32)
             st_v = jnp.asarray(self._step_count, jnp.int32)
-            for p in sparse:
-                self._apply_sparse(p, lr_v, st_v, shapes)
+            merged = [merge_selected_rows(p.grad) for p in sparse]
+            if isinstance(self._grad_clip, ClipGradByGlobalNorm):
+                # reference semantics (ClipGradByGlobalNorm): merged
+                # SelectedRows grads join the global norm, and their
+                # values scale by the same coefficient as the dense
+                # grads (whose jitted clip sees the sparse sum via
+                # extra_sq)
+                sparse_sq = sum(
+                    jnp.sum(jnp.square(sr.values.astype(jnp.float32)))
+                    for sr in merged)
+                dense_sq = sum(
+                    jnp.sum(jnp.square(p.grad._value.astype(jnp.float32)))
+                    for p in params)
+                coef = self._grad_clip.coefficient(
+                    jnp.sqrt(sparse_sq + dense_sq))
+                from ..framework.selected_rows import SelectedRows as _SR
+
+                merged = [_SR(sr.rows,
+                              (sr.values * coef).astype(sr.values.dtype),
+                              sr.height)
+                          for sr in merged]
+                extra_sq = sparse_sq
+            for p, sr in zip(sparse, merged):
+                self._apply_sparse(p, sr, lr_v, st_v, shapes)
         if not params:
             return
         shapes = self._state_shapes()
@@ -163,7 +181,8 @@ class Optimizer:
         step_value = jnp.asarray(self._step_count, jnp.int32)
 
         new_pvals, new_states = self._fused_update(
-            tuple(pvals), tuple(gvals), tuple(states), lr_value, step_value)
+            tuple(pvals), tuple(gvals), tuple(states), lr_value, step_value,
+            extra_sq)
 
         for p, nv, ns in zip(params, new_pvals, new_states):
             if id(p) in self._master_weights:
@@ -189,16 +208,29 @@ class Optimizer:
                 if jnp.issubdtype(v.dtype, jnp.floating) else v
                 for k, v in s.items()}
 
-    def _fused_update(self, pvals, gvals, states, lr_value, step_value):
+    def _fused_update(self, pvals, gvals, states, lr_value, step_value,
+                      extra_sq=None):
         # One jitted executable updating every parameter (multi-tensor
         # fused path — FusedAdam analog). jax.jit caches on pytree
-        # structure + shapes.
-        if self._jitted is None:
-            clip = self._grad_clip
+        # structure + shapes. extra_sq: squared norm of the merged
+        # sparse grads, folded into the global-norm clip so dense and
+        # sparse sides scale by the same coefficient.
+        if extra_sq is None:
+            extra_sq = jnp.asarray(0.0, jnp.float32)
 
-            def update_all(pvals, gvals, states, lr_value, step_value):
-                if clip is not None:
-                    gvals, _ = clip.apply_values(list(gvals))
+        def _clipped(gvals, extra_sq):
+            clip = self._grad_clip
+            if clip is None:
+                return gvals
+            if isinstance(clip, ClipGradByGlobalNorm):
+                return clip.apply_values(list(gvals), extra_sq)[0]
+            return clip.apply_values(list(gvals))[0]
+
+        if self._jitted is None:
+
+            def update_all(pvals, gvals, states, lr_value, step_value,
+                           extra_sq):
+                gvals = _clipped(gvals, extra_sq)
                 out_p, out_s = [], []
                 for p, g, s in zip(pvals, gvals, states):
                     np_, ns_ = self._update_rule(
@@ -211,15 +243,14 @@ class Optimizer:
         if any(isinstance(v, jax.core.Tracer) for v in pvals) or any(
                 isinstance(v, jax.core.Tracer) for v in gvals):
             # already inside an enclosing trace (to_static train step)
-            clip = self._grad_clip
-            if clip is not None:
-                gvals, _ = clip.apply_values(list(gvals))
+            gvals = _clipped(gvals, extra_sq)
             out = [(lambda np_, ns_: (np_, self._cast_state_out(ns_)))(
                 *self._update_rule(p, g, self._cast_state_in(s), lr_value,
                                    step_value))
                    for p, g, s in zip(pvals, gvals, states)]
             return tuple(o[0] for o in out), tuple(o[1] for o in out)
-        return self._jitted(pvals, gvals, states, lr_value, step_value)
+        return self._jitted(pvals, gvals, states, lr_value, step_value,
+                            extra_sq)
 
     def clear_grad(self, set_to_zero: bool = False):
         if self._parameter_list:

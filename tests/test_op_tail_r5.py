@@ -148,3 +148,32 @@ class TestIncubateFunctional:
         # the new kv landed at each row's own position (ragged write)
         assert np.allclose(kn[0, :, 3, :], xq.reshape(B, 3, H, D)[0, 1])
         assert np.allclose(kn[1, :, 5, :], xq.reshape(B, 3, H, D)[1, 1])
+
+    def test_masked_multihead_attention_refuses_unserved_knobs(self):
+        """src_mask/cum_offsets/beam_cache_offset and the quant knobs
+        are not served on TPU — they must refuse loudly, not silently
+        ignore (mirrors block_multihead_attention)."""
+        import pytest
+
+        import paddle_tpu.incubate.nn.functional as IF
+
+        r = np.random.RandomState(2)
+        B, H, M, D = 2, 4, 16, 8
+        ckv = jnp.stack([jnp.asarray(r.randn(B, H, M, D), jnp.float32),
+                         jnp.asarray(r.randn(B, H, M, D), jnp.float32)])
+        xq = paddle.to_tensor(r.randn(B, 3 * H * D).astype("float32"))
+        lens = paddle.to_tensor(np.array([[3], [5]], np.int32))
+        for kw in ({"src_mask": paddle.to_tensor(np.zeros((B, 1, 1, M),
+                                                          "float32"))},
+                   {"cum_offsets": paddle.to_tensor(
+                       np.zeros((B, 1), "int32"))},
+                   {"beam_cache_offset": paddle.to_tensor(
+                       np.zeros((B, 1), "int32"))},
+                   {"qkv_out_scale": paddle.to_tensor(
+                       np.ones((3 * H * D,), "float32"))},
+                   {"out_scale": 0.5},
+                   {"compute_dtype": "fp16"}):
+            with pytest.raises(Exception):
+                IF.masked_multihead_attention(
+                    xq, paddle.to_tensor(ckv), sequence_lengths=lens,
+                    **kw)

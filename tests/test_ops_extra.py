@@ -199,6 +199,36 @@ class TestConvPool3D:
         ref = torch.nn.functional.avg_pool3d(torch.tensor(x), 2, 2)
         np.testing.assert_allclose(out, ref.numpy(), rtol=1e-5)
 
+    def test_pool3d_ceil_mode_matches_torch(self):
+        """ceil_mode via asymmetric right-padding in the reduce_window
+        pads (max: -inf pad; avg exclusive: real-element divisor)."""
+        x = rng.randn(2, 3, 5, 7, 6).astype("float32")
+        t = torch.tensor(x)
+        for k, s, p in [(2, 2, 0), (3, 2, 1), (2, 3, 0)]:
+            out = _np(paddle.ops.extra.max_pool3d(
+                _t(x), k, s, p, ceil_mode=True))
+            ref = torch.nn.functional.max_pool3d(
+                t, k, s, p, ceil_mode=True).numpy()
+            assert out.shape == ref.shape, (k, s, p)
+            np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+            out = _np(paddle.ops.extra.avg_pool3d(
+                _t(x), k, s, p, ceil_mode=True))
+            ref = torch.nn.functional.avg_pool3d(
+                t, k, s, p, ceil_mode=True,
+                count_include_pad=False).numpy()
+            assert out.shape == ref.shape, (k, s, p)
+            np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+        # return_mask: ceil-mode indices match torch's
+        out, mask = paddle.ops.extra.max_pool3d(_t(x), 2, 2, 0,
+                                                ceil_mode=True,
+                                                return_mask=True)
+        ro, ri = torch.nn.functional.max_pool3d(t, 2, 2, 0,
+                                                ceil_mode=True,
+                                                return_indices=True)
+        np.testing.assert_allclose(_np(out), ro.numpy(), rtol=1e-5,
+                                   atol=1e-6)
+        np.testing.assert_array_equal(_np(mask), ri.numpy())
+
 
 class TestActivationsLosses:
     def test_activations(self):

@@ -64,6 +64,42 @@ class TestSemantics:
         # grad norm 20 -> clipped to 1 -> each component 0.5
         np.testing.assert_allclose(p.numpy(), -np.full(4, 0.5), rtol=1e-5)
 
+    def test_grad_clip_global_norm_includes_sparse(self):
+        """SelectedRows grads join the global norm and scale by the
+        same coefficient as the dense grads (reference:
+        ClipGradByGlobalNorm merges + clips sparse grads)."""
+        from paddle_tpu.framework.selected_rows import SelectedRows
+
+        pd = paddle.Parameter(
+            paddle.to_tensor(np.zeros(4, np.float32))._value)
+        pd.grad = paddle.to_tensor(np.full(4, 3.0, np.float32))
+        pe = paddle.Parameter(
+            paddle.to_tensor(np.zeros((8, 4), np.float32))._value)
+        # duplicate row ids: merged (accumulated) BEFORE the norm
+        pe.grad = SelectedRows([1, 3, 1], np.full((3, 4), 2.0,
+                                                  np.float32), 8)
+        opt = optimizer.SGD(learning_rate=1.0, parameters=[pd, pe],
+                            grad_clip=nn.ClipGradByGlobalNorm(1.0))
+        opt.step()
+        # merged sparse: row1=4.0, row3=2.0 ->
+        # gn = sqrt(4*9 + 4*16 + 4*4) = sqrt(116)
+        gn = np.sqrt(116.0)
+        np.testing.assert_allclose(pd.numpy(), -np.full(4, 3.0 / gn),
+                                   rtol=1e-5)
+        ref = np.zeros((8, 4), np.float32)
+        ref[1] = -4.0 / gn
+        ref[3] = -2.0 / gn
+        np.testing.assert_allclose(pe.numpy(), ref, rtol=1e-5)
+        # below the threshold nothing scales
+        pd.grad = paddle.to_tensor(np.full(4, 3.0, np.float32))
+        pe.grad = SelectedRows([2], np.full((1, 4), 2.0, np.float32), 8)
+        opt2 = optimizer.SGD(learning_rate=1.0, parameters=[pd, pe],
+                             grad_clip=nn.ClipGradByGlobalNorm(100.0))
+        before = pe.numpy().copy()
+        opt2.step()
+        np.testing.assert_allclose(pe.numpy()[2], before[2] - 2.0,
+                                   rtol=1e-5)
+
     def test_lr_scheduler(self):
         sched = optimizer.lr.StepDecay(0.1, step_size=2, gamma=0.5)
         p = paddle.Parameter(paddle.to_tensor(np.zeros(1, np.float32))._value)
