@@ -31,6 +31,11 @@ def fused_rms_norm(x, norm_weight, norm_bias=None, epsilon=1e-6,
     """(reference: incubate/nn/functional/fused_rms_norm.py →
     phi/kernels/gpu/rms_norm_kernel.cu). Returns (out, residual_out) like
     the reference when a residual is supplied, else out."""
+    from ....core.enforce import enforce as _enf
+
+    _enf(quant_scale in (-1, None),
+         "fused_rms_norm: in-kernel output quantization is served by "
+         "nn.quant on TPU — leave quant_scale at -1")
     if bias is not None:
         x = x + bias
     if residual is not None:
@@ -66,6 +71,13 @@ def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
     → phi/kernels/fusion/gpu/fused_rope_kernel.cu; SPMD rule
     spmd_rules/fused_rope.cc). q/k: [B, S, H, D]; returns the same tuple
     arity as the reference (q, k, v)."""
+    from ....core.enforce import enforce as _enf
+
+    _enf(use_neox_rotary_style,
+         "fused_rotary_position_embedding: only the neox (rotate-half) "
+         "style is served on TPU (ops/nn_ops.fused_rope); the GPT-J "
+         "interleaved style is not implemented — pass "
+         "use_neox_rotary_style=True")
     outs = _fused_rope(q, q if k is None else k, cos, sin,
                        position_ids=position_ids)
     q_out, k_out = outs if isinstance(outs, (tuple, list)) else (outs, None)
@@ -149,6 +161,7 @@ def masked_multihead_attention(x, cache_kv=None, bias=None, src_mask=None,
     for knob, name in ((src_mask, "src_mask"),
                        (cum_offsets, "cum_offsets"),
                        (beam_cache_offset, "beam_cache_offset"),
+                       (rotary_tensor, "rotary_tensor"),
                        (qkv_out_scale, "qkv_out_scale"),
                        (out_shift, "out_shift"),
                        (out_smooth, "out_smooth")):
@@ -184,10 +197,11 @@ def masked_multihead_attention(x, cache_kv=None, bias=None, src_mask=None,
     else:
         off = jnp.zeros((B,), jnp.int32)
     from ....core.enforce import enforce as _enf2
-    _enf2(rotary_emb_dims == 0,
+    _enf2(rotary_emb_dims == 0 and not use_neox_rotary_style,
           "masked_multihead_attention: apply rotary embeddings at the "
           "model level (ops/nn_ops.fused_rope); the fused in-kernel "
-          "rotary path is not provided here")
+          "rotary path (rotary_emb_dims/use_neox_rotary_style) is not "
+          "provided here")
     k_cache = cv[0].at[jnp.arange(B), :, off, :].set(
         k.astype(cv.dtype))
     v_cache = cv[1].at[jnp.arange(B), :, off, :].set(
@@ -223,6 +237,26 @@ def fused_multi_transformer(x, ln_scales, ln_biases, qkv_weights,
     from ....ops import manipulation as M
     from ....nn.functional import flash_attention
     from ....models.llama import _cache_attention
+    from ....core.enforce import enforce as _enf
+
+    for knob, kname in ((pre_caches, "pre_caches"),
+                        (seq_lens, "seq_lens"),
+                        (rotary_embs, "rotary_embs"),
+                        (attn_mask, "attn_mask")):
+        _enf(knob is None,
+             f"fused_multi_transformer: {kname} is not served by this "
+             "functional form (ragged/packed prefill is the Predictor "
+             "serving path, rotary embeddings apply at the model level "
+             "via ops/nn_ops.fused_rope, masking is causal+frontier) — "
+             "pass None")
+    _enf(rotary_emb_dims == 0,
+         "fused_multi_transformer: in-kernel rotary "
+         "(rotary_emb_dims != 0) is not served; apply "
+         "ops/nn_ops.fused_rope at the model level")
+    _enf(ring_id == -1,
+         "fused_multi_transformer: ring_id tensor-parallelism is the "
+         "distributed engine's job (distributed/engine.py shards the "
+         "weights); pass ring_id=-1")
 
     def val(t):
         return t._value if isinstance(t, Tensor) else jnp.asarray(t)
@@ -297,6 +331,11 @@ def fused_multi_transformer(x, ln_scales, ln_biases, qkv_weights,
             out = flash_attention(q, k, v, causal=True)[0]
             out = M.reshape(out, (B, S, embed_dim))
         out = F.linear(out, linear_weights[i], linear_biases[i])
+        if dropout_rate:
+            # reference: residual + dropout(attn_out) (fused_transformer
+            # pseudo-code); same placement after the ffn below
+            out = F.dropout(out, p=dropout_rate, training=training,
+                            mode=mode)
         h = residual + out
         if not pre_layer_norm:
             # post-LN: the attention block's LayerNorm applies AFTER
@@ -311,6 +350,9 @@ def fused_multi_transformer(x, ln_scales, ln_biases, qkv_weights,
             f = h
         f = act(F.linear(f, ffn1_weights[i], ffn1_biases[i]))
         f = F.linear(f, ffn2_weights[i], ffn2_biases[i])
+        if dropout_rate:
+            f = F.dropout(f, p=dropout_rate, training=training,
+                          mode=mode)
         h = residual + f
         if not pre_layer_norm:
             h = F.layer_norm(h, ffn_ln_scales[i], ffn_ln_biases[i],
@@ -378,9 +420,22 @@ def block_multihead_attention(qkv, key_cache, value_cache,
              f"block_multihead_attention: {name} is served by the "
              "Predictor paged path / nn.quant on TPU, not in-kernel")
     _enf(not use_dynamic_cachekv_quant and out_scale in (-1, None)
-         and compute_dtype == "default",
+         and compute_dtype == "default" and quant_round_type == 1
+         and quant_max_bound == 127.0 and quant_min_bound == -127.0,
          "block_multihead_attention: cache-kv quantization / output "
-         "quant are served by nn.quant on TPU, not in-kernel")
+         "quant are served by nn.quant on TPU, not in-kernel — leave "
+         "the quant knobs at their defaults")
+    for knob, kname in ((padding_offsets, "padding_offsets"),
+                        (cum_offsets, "cum_offsets"),
+                        (cu_seqlens_q, "cu_seqlens_q"),
+                        (cu_seqlens_k, "cu_seqlens_k")):
+        _enf(knob is None,
+             f"block_multihead_attention: {kname} is ragged-prefill "
+             "packing metadata, served by the Predictor paged path "
+             "(inference/__init__.py) — pass None in the decode phase")
+    _enf(not use_neox_style,
+         "block_multihead_attention: in-kernel neox rope is not served "
+         "(rope applies at the model level via ops/nn_ops.fused_rope)")
     qv = qkv._value if isinstance(qkv, Tensor) else jnp.asarray(qkv)
     kp = key_cache._value if isinstance(key_cache, Tensor) \
         else jnp.asarray(key_cache)
@@ -393,6 +448,34 @@ def block_multihead_attention(qkv, key_cache, value_cache,
         else jnp.asarray(seq_lens_decoder)
     B = tbl.shape[0]
     P, KV, page, D = kp.shape
+    _enf(block_size == page,
+         lambda: f"block_multihead_attention: block_size ({block_size}) "
+                 f"does not match the physical cache page size ({page}) "
+                 "— the page size is fixed by the cache layout "
+                 "[P, KV, page, D], it cannot be re-specified per call")
+    _enf(max_seq_len in (-1, tbl.shape[1] * page),
+         lambda: f"block_multihead_attention: max_seq_len "
+                 f"({max_seq_len}) disagrees with the block-table "
+                 f"capacity ({tbl.shape[1]} pages x {page}); pass -1 "
+                 "(the capacity is fixed by the table shape)")
+    import numpy as _np
+
+    def _host(v):
+        a = v._value if isinstance(v, Tensor) else v
+        return None if isinstance(a, jax.core.Tracer) else _np.asarray(a)
+
+    if seq_lens_encoder is not None:
+        enc = _host(seq_lens_encoder)
+        _enf(enc is None or bool((enc == 0).all()),
+             "block_multihead_attention: this wrapper serves the DECODE "
+             "phase only (seq_lens_encoder must be all zero); the "
+             "encoder/prefill phase is the Predictor paged path")
+    if seq_lens_this_time is not None:
+        this = _host(seq_lens_this_time)
+        _enf(this is None or bool((this == 1).all()),
+             "block_multihead_attention: decode phase writes ONE new "
+             "token per row (seq_lens_this_time must be all one); "
+             "ragged prefill is the Predictor paged path")
     _enf(qv.shape[0] == B and qv.ndim == 2,
          "decode phase: qkv is [batchsize, 3*num_head*head_dim] "
          "(one new token per row; ragged prefill is the Predictor "
@@ -412,8 +495,6 @@ def block_multihead_attention(qkv, key_cache, value_cache,
     kw = heads[:, H:H + KV]                                # [B, KV, D]
     vw = heads[:, H + KV:]
     off = sld.reshape(B).astype(jnp.int32)
-    import numpy as _np
-
     if not isinstance(off, jax.core.Tracer):
         _enf(bool((_np.asarray(off) < tbl.shape[1] * page).all()),
              lambda: "block_multihead_attention: a row's "

@@ -453,6 +453,9 @@ class Predictor:
 
         ids_p = np.zeros((B, Sb), ids.dtype)
         ids_p[:, :S0] = ids
+        # B is the caller's batch by contract (one program per batch
+        # size); the ServingEngine pins B for traffic-grade serving
+        # tpulint: disable=recompile-hazard
         prefill = self._prefill_fn(B, Sb, M)
         self.stats.note("prefill", (B, Sb, M, page, P, str(ids_p.dtype),
                                     str(p_dtype)))
@@ -462,6 +465,8 @@ class Predictor:
         rng = jax.random.PRNGKey(gen.seed)
         rng, sub = jax.random.split(rng)
         # first sampled token (same rule as the compiled loop)
+        # B: static per-call batch, same contract as prefill above
+        # tpulint: disable=recompile-hazard
         decode = self._decode_fn(B, M, n_new - 1, gen, ragged,
                                  bool(page)) if n_new > 1 else None
         if decode is not None:

@@ -28,7 +28,12 @@ program for arbitrary length mixes):
 Compile stability: every program is keyed on the small fixed lattice
 (batch B, seq bucket Sb, pool bucket P). After one warmup mix, a stream
 with arbitrary length mixes triggers ZERO additional XLA compiles —
-asserted via the shared ``CompileStats`` counters (``engine.stats``).
+asserted via the shared ``CompileStats`` counters (``engine.stats``),
+and statically by ``tools/tpulint`` (host-sync-in-jit +
+recompile-hazard): every int reaching a ``*_fn`` factory here is either
+``_bucket``-quantized (Sb, P) or an engine-lifetime constant (B, M,
+chunk), and the host syncs (first-token sample, chunk readback) sit
+outside the compiled scan.
 """
 from __future__ import annotations
 

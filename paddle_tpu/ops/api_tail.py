@@ -665,12 +665,16 @@ def normal_(x, mean=0.0, std=1.0, name=None):
 
 
 def uniform_(x, min=-1.0, max=1.0, seed=0, name=None):  # noqa: A002
+    """seed=0 (the reference default) draws from the global generator;
+    a non-zero seed gives a deterministic per-call stream (reference:
+    uniform_'s seed attribute on the kernel)."""
     import jax as _jax
 
     from ..core import rng as _rng
 
+    key = _jax.random.PRNGKey(seed) if seed else _rng.get_key()
     return _random_fill(x, _jax.random.uniform(
-        _rng.get_key(), tuple(x.shape), minval=min, maxval=max))
+        key, tuple(x.shape), minval=min, maxval=max))
 
 
 def cauchy_(x, loc=0.0, scale=1.0, name=None):
@@ -767,6 +771,9 @@ def as_strided(x, shape, stride, offset=0):
     return x.reshape(-1)[jnp.asarray(idx)]
 
 
+# persistable only means something to the static-graph executor's scope
+# reuse; the reference's dygraph path ignores it identically.
+# tpulint: disable=unused-knob
 def create_tensor(dtype, name=None, persistable=False):
     """(reference: tensor/creation.py create_tensor — a typed empty
     slot in static graphs; eagerly, an empty tensor.)"""
@@ -777,21 +784,33 @@ def create_tensor(dtype, name=None, persistable=False):
 
 def create_parameter(shape, dtype, name=None, attr=None,
                      is_bias=False, default_initializer=None):
-    """(reference: tensor/creation.py create_parameter)."""
+    """(reference: tensor/creation.py create_parameter — LayerHelper
+    semantics: a ParamAttr initializer wins, then the explicit
+    default_initializer, then zeros for biases / a small normal for
+    weights; attr=False yields no parameter)."""
     from ..core.dtype import convert_dtype
     from ..core import rng as _rng
+    from ..framework.param_attr import ParamAttr
+    from ..tensor import Parameter
     import jax as _jax
 
-    if default_initializer is not None:
-        from ..tensor import Parameter
-
-        p = Parameter(jnp.zeros(tuple(shape), convert_dtype(dtype)))
-        default_initializer(p)
-        return p
-    from ..tensor import Parameter
-
-    val = 0.02 * _jax.random.normal(_rng.get_key(), tuple(shape))
-    return Parameter(val.astype(convert_dtype(dtype)))
+    init = default_initializer
+    trainable = True
+    if attr is False:
+        return None
+    if isinstance(attr, ParamAttr):
+        if attr.initializer is not None:
+            init = attr.initializer
+        trainable = attr.trainable
+    if init is not None:
+        val = jnp.asarray(init(tuple(shape), dtype))
+    elif is_bias:
+        val = jnp.zeros(tuple(shape))
+    else:
+        val = 0.02 * _jax.random.normal(_rng.get_key(), tuple(shape))
+    p = Parameter(val.astype(convert_dtype(dtype)))
+    p.stop_gradient = not trainable
+    return p
 
 
 __all__ = list(__all__) + ["add_n", "atleast_1d", "atleast_2d",

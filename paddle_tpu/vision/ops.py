@@ -615,6 +615,15 @@ def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
     """Deformable convolution v1/v2 (reference: vision/ops.py:753) —
     bilinear sampling at learned offsets then a grouped matmul; the
     gathers and interpolation weights are all XLA HLOs."""
+    C = (x._value if isinstance(x, Tensor) else x).shape[1]
+    in_c_g = (weight._value if isinstance(weight, Tensor)
+              else weight).shape[1]
+    enforce(int(groups) * in_c_g == C,
+            lambda: f"deform_conv2d: groups ({groups}) disagrees with "
+                    f"the weight layout — in_channels ({C}) / "
+                    f"weight.shape[1] ({in_c_g}) = {C // in_c_g} groups "
+                    "(the kernel derives its grouping from the shapes, "
+                    "so a mismatched knob would be silently ignored)")
     st = _pair(stride)
     pd = _pair(padding)
     dl = _pair(dilation)
@@ -635,8 +644,10 @@ class DeformConv2D(nn.Layer):
         self._deformable_groups = deformable_groups
         self._groups = groups
         self.weight = self.create_parameter(
-            (out_channels, in_channels // groups, kh, kw))
-        self.bias = self.create_parameter((out_channels,), is_bias=True) \
+            (out_channels, in_channels // groups, kh, kw),
+            attr=weight_attr)
+        self.bias = self.create_parameter((out_channels,), attr=bias_attr,
+                                          is_bias=True) \
             if bias_attr is not False else None
 
     def forward(self, x, offset, mask=None):
@@ -697,7 +708,7 @@ def matrix_nms(bboxes, scores, score_threshold, post_threshold,
                 order = order[:nms_top_k]
             b = bx[n, order]
             sv = s[order].copy()
-            iou = _iou_matrix(b)
+            iou = _iou_matrix(b, normalized)
             # decay[i] = min over higher-scored j of f(iou_ij)/f(max
             # iou of j with anything above it)
             K = len(order)

@@ -1,0 +1,131 @@
+"""tpulint CLI: ``python -m tools.tpulint [paths] [options]``.
+
+Exit codes: 0 = clean (every finding baselined or none), 1 = new
+violations, 2 = usage error. ``--json`` emits one machine-readable
+report on stdout (bench/verdict rounds track ``baseline_size`` /
+``new`` from it).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+from typing import List, Optional
+
+from .core import (Finding, iter_py_files, lint_paths, load_baseline,
+                   relpath_for, split_by_baseline, write_baseline)
+from .rules import ALL_RULES, select_rules
+
+DEFAULT_BASELINE = Path(__file__).resolve().parent / "baseline.json"
+
+
+def build_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.tpulint",
+        description="trace-safety & API-fidelity static analyzer for "
+                    "paddle_tpu")
+    ap.add_argument("paths", nargs="*", default=["paddle_tpu"],
+                    help="files or directories to lint "
+                         "(default: paddle_tpu)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit a machine-readable JSON report")
+    ap.add_argument("--baseline", type=Path, default=None,
+                    help=f"baseline file (default: {DEFAULT_BASELINE})")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="ignore the baseline (report everything as new)")
+    ap.add_argument("--select", default="",
+                    help="comma-separated rule ids to run "
+                         "(default: all)")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="rewrite the baseline from current findings "
+                         "and exit 0")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the rule catalog and exit")
+    ap.add_argument("--root", type=Path, default=None,
+                    help="root for relative paths (default: cwd)")
+    return ap
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = build_parser()
+    try:
+        args = ap.parse_args(argv)
+    except SystemExit as e:
+        return 2 if e.code not in (0, None) else 0
+
+    if args.list_rules:
+        for r in ALL_RULES:
+            print(f"{r.id:<22} {r.description}")
+        return 0
+
+    try:
+        rules = select_rules(
+            [r.strip() for r in args.select.split(",") if r.strip()])
+    except KeyError as e:
+        print(f"tpulint: {e.args[0]}", file=sys.stderr)
+        return 2
+
+    paths = [Path(p) for p in args.paths]
+    missing = [p for p in paths if not p.exists()]
+    if missing:
+        print(f"tpulint: no such path: "
+              f"{', '.join(str(p) for p in missing)}", file=sys.stderr)
+        return 2
+
+    findings = lint_paths(paths, rules, root=args.root)
+
+    baseline_path = args.baseline or DEFAULT_BASELINE
+    if args.write_baseline:
+        write_baseline(baseline_path, findings)
+        print(f"tpulint: wrote {len(findings)} baseline entries to "
+              f"{baseline_path}")
+        return 0
+
+    baseline = []
+    if not args.no_baseline and baseline_path.exists():
+        baseline = load_baseline(baseline_path)
+        # when linting a subtree, baseline entries for files outside it
+        # are out of scope — neither matchable nor stale
+        root = (args.root or Path.cwd()).resolve()
+        linted = {relpath_for(p, root) for p in iter_py_files(paths)}
+        baseline = [e for e in baseline if e["path"] in linted]
+    new, matched, stale = split_by_baseline(findings, baseline)
+
+    if args.as_json:
+        counts = {}
+        for f in new:
+            counts[f.rule] = counts.get(f.rule, 0) + 1
+        report = {
+            "version": 1,
+            "rules": [r.id for r in rules],
+            "total": len(findings),
+            "new": len(new),
+            "baselined": len(matched),
+            "baseline_size": len(baseline),
+            "baseline_stale": stale,
+            "counts": counts,
+            "findings": [f.as_dict(baselined=False) for f in new]
+            + [f.as_dict(baselined=True) for f in matched],
+        }
+        print(json.dumps(report, indent=1))
+        return 1 if new else 0
+
+    for f in new:
+        print(f"{f.path}:{f.line}:{f.col + 1}: {f.rule}: {f.message} "
+              f"[{f.symbol}]")
+    if stale:
+        print(f"\ntpulint: {len(stale)} stale baseline entr"
+              f"{'y' if len(stale) == 1 else 'ies'} (fixed or moved — "
+              "shrink the baseline with --write-baseline):")
+        for e in stale:
+            print(f"  {e['rule']}: {e['path']} [{e['symbol']}] "
+                  f"{e['line_text'][:60]}")
+    print(f"\ntpulint: {len(findings)} finding(s): {len(new)} new, "
+          f"{len(matched)} baselined"
+          + (f", {len(stale)} stale baseline" if stale else ""))
+    return 1 if new else 0
+
+
+if __name__ == "__main__":  # pragma: no cover
+    sys.exit(main())

@@ -1,0 +1,508 @@
+"""tpulint core: the per-file analysis model shared by all rules.
+
+A ``ModuleInfo`` wraps one parsed source file and lazily computes the
+two module-wide analyses every trace-safety rule needs:
+
+- *traced reachability*: which function defs execute under a jax trace
+  (decorated with ``jax.jit``/``def_op``/..., passed to ``jax.jit`` /
+  ``lax.scan`` / ``pallas_call`` / ..., nested inside such a function,
+  or called from one — a transitive closure over same-module calls by
+  simple name);
+- *value taint*: per function, which local names hold traced array
+  values (assigned from ``jnp.``/``jax.``/``lax.``-rooted expressions,
+  or parameters that are passed straight into such calls). Shape-like
+  accesses (``.shape``/``.ndim``/``.dtype``/``len()``) never taint —
+  those are static under tracing.
+
+Both are heuristics tuned for this repo's idiom (name-based, no cross-
+file resolution); the baseline file and ``# tpulint: disable=<rule>``
+pragmas absorb the residue, which is the design point of the tool.
+"""
+from __future__ import annotations
+
+import ast
+import io
+import json
+import re
+import tokenize
+from dataclasses import dataclass
+from pathlib import Path
+from typing import Any, Dict, Iterable, Iterator, List, Optional, Set, Tuple
+
+PRAGMA_RE = re.compile(
+    r"tpulint:\s*(disable|disable-file)\s*=\s*([\w, \-]+)")
+
+# leftmost roots of attribute chains that produce traced values
+JAX_ROOT_RE = re.compile(r"^_?(jnp|jax|lax|pl|pltpu)\d?$")
+
+# wrappers whose function-valued arguments run under a jax trace
+TRACE_WRAPPERS = {
+    "jit", "pjit", "shard_map", "scan", "while_loop", "fori_loop",
+    "cond", "switch", "vmap", "pmap", "grad", "value_and_grad",
+    "checkpoint", "remat", "pallas_call", "custom_jvp", "custom_vjp",
+}
+
+# decorators that make the decorated body run under a jax trace.
+# def_op: this repo's dispatch — kernel bodies re-execute under vjp
+# tracing even on the eager path (core/dispatch.py).
+TRACED_DECORATORS = {"jit", "pjit", "def_op", "vmap", "custom_jvp",
+                     "custom_vjp", "checkpoint", "remat"}
+
+# attribute accesses that are static under tracing (never taint)
+STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "itemsize", "name"}
+
+# jnp calls whose first argument is a static scalar/extent being
+# PROMOTED to an array (not evidence that the argument was an array)
+PROMOTING_JAX_CALLS = {"asarray", "array", "arange", "full", "zeros",
+                       "ones", "PRNGKey", "float32", "int32", "int64",
+                       "bfloat16"}
+
+# jnp/jax calls whose results are static metadata, not traced values
+# (dtype predicates, mesh/topology queries, backend introspection)
+STATIC_JAX_CALLS = {"issubdtype", "isdtype", "result_type", "dtype",
+                    "iinfo", "finfo", "broadcast_shapes",
+                    "iscomplexobj", "isrealobj", "isscalar",
+                    "default_backend", "devices", "device_count",
+                    "local_device_count", "process_index",
+                    "axis_size", "axis_index"}
+
+
+def func_root(node: ast.expr) -> Optional[str]:
+    """Leftmost Name id of an attribute chain (``jax.nn.softmax`` →
+    ``jax``); None for anything else."""
+    while isinstance(node, ast.Attribute):
+        node = node.value
+    return node.id if isinstance(node, ast.Name) else None
+
+
+def func_simple_name(node: ast.expr) -> Optional[str]:
+    """Rightmost component of a call target (``jax.jit`` → ``jit``)."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def is_jax_call(node: ast.AST) -> bool:
+    """Call whose target chains off a jax-family module alias and is
+    not a static metadata helper."""
+    if not isinstance(node, ast.Call):
+        return False
+    root = func_root(node.func)
+    if root is None or not JAX_ROOT_RE.match(root):
+        return False
+    return func_simple_name(node.func) not in STATIC_JAX_CALLS
+
+
+@dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str                 # posix path relative to the lint root
+    line: int
+    col: int
+    symbol: str               # enclosing def qualname or "<module>"
+    message: str
+    line_text: str = ""
+
+    def fingerprint(self) -> Tuple[str, str, str, str]:
+        """Line-number-free identity used for baseline matching (stable
+        across unrelated edits that shift lines)."""
+        return (self.rule, self.path, self.symbol, self.line_text.strip())
+
+    def as_dict(self, baselined: bool) -> Dict[str, Any]:
+        return {"rule": self.rule, "path": self.path, "line": self.line,
+                "col": self.col, "symbol": self.symbol,
+                "message": self.message, "baselined": baselined}
+
+
+class Rule:
+    """Base class for tpulint rules. Subclasses set ``id`` /
+    ``description`` and yield Findings from ``check``."""
+
+    id: str = ""
+    description: str = ""
+
+    def check(self, mod: "ModuleInfo") -> Iterator[Finding]:
+        raise NotImplementedError
+
+    def finding(self, mod: "ModuleInfo", node: ast.AST,
+                message: str) -> Finding:
+        line = getattr(node, "lineno", 1)
+        return Finding(
+            rule=self.id, path=mod.relpath, line=line,
+            col=getattr(node, "col_offset", 0),
+            symbol=mod.qualname_of(node), message=message,
+            line_text=mod.line(line))
+
+
+class ModuleInfo:
+    def __init__(self, source: str, relpath: str):
+        self.source = source
+        self.relpath = relpath
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source)
+        self._parents: Dict[int, ast.AST] = {}
+        for parent in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(parent):
+                self._parents[id(child)] = parent
+        self._funcs: List[ast.AST] = [
+            n for n in ast.walk(self.tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+        self._qualnames: Dict[int, str] = {}
+        for fn in self._funcs:
+            self._qualnames[id(fn)] = self._compute_qualname(fn)
+        self._comments = self._collect_comments(source)
+        self._file_disabled = self._collect_file_pragmas()
+        self._traced_ids: Optional[Set[int]] = None
+        self._taint_cache: Dict[int, Set[str]] = {}
+        self._sanitizers: Optional[Set[str]] = None
+
+    # -- plumbing --------------------------------------------------------
+    def line(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1]
+        return ""
+
+    def parent(self, node: ast.AST) -> Optional[ast.AST]:
+        return self._parents.get(id(node))
+
+    def functions(self) -> List[ast.AST]:
+        return list(self._funcs)
+
+    def enclosing_function(self, node: ast.AST) -> Optional[ast.AST]:
+        cur = self.parent(node)
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return cur
+            cur = self.parent(cur)
+        return None
+
+    def _compute_qualname(self, fn: ast.AST) -> str:
+        parts = [fn.name]
+        cur = self.parent(fn)
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.ClassDef)):
+                parts.append(cur.name)
+            cur = self.parent(cur)
+        return ".".join(reversed(parts))
+
+    def qualname_of(self, node: ast.AST) -> str:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return self._qualnames[id(node)]
+        fn = self.enclosing_function(node)
+        return self._qualnames[id(fn)] if fn is not None else "<module>"
+
+    # -- suppressions ----------------------------------------------------
+    @staticmethod
+    def _collect_comments(source: str) -> Dict[int, str]:
+        out: Dict[int, str] = {}
+        try:
+            toks = tokenize.generate_tokens(io.StringIO(source).readline)
+            for tok in toks:
+                if tok.type == tokenize.COMMENT:
+                    out[tok.start[0]] = tok.string
+        except (tokenize.TokenError, IndentationError):  # pragma: no cover
+            pass
+        return out
+
+    def _pragma_rules(self, lineno: int, kind: str) -> Set[str]:
+        text = self._comments.get(lineno, "")
+        m = PRAGMA_RE.search(text)
+        if not m or m.group(1) != kind:
+            return set()
+        return {r.strip() for r in m.group(2).split(",") if r.strip()}
+
+    def _collect_file_pragmas(self) -> Set[str]:
+        out: Set[str] = set()
+        for ln in self._comments:
+            out |= self._pragma_rules(ln, "disable-file")
+        return out
+
+    def _is_comment_only_line(self, lineno: int) -> bool:
+        text = self.line(lineno).strip()
+        return text.startswith("#")
+
+    def is_suppressed(self, finding: Finding) -> bool:
+        if finding.rule in self._file_disabled or \
+                "all" in self._file_disabled:
+            return True
+
+        def hit(ln: int) -> bool:
+            rules = self._pragma_rules(ln, "disable")
+            return finding.rule in rules or "all" in rules
+
+        if hit(finding.line):
+            return True
+        # pylint-style standalone pragma on the line(s) just above
+        ln = finding.line - 1
+        while ln >= 1 and self._is_comment_only_line(ln):
+            if hit(ln):
+                return True
+            ln -= 1
+        return False
+
+    # -- traced reachability ---------------------------------------------
+    def traced_functions(self) -> Set[int]:
+        """ids of function nodes whose bodies run under a jax trace."""
+        if self._traced_ids is not None:
+            return self._traced_ids
+        traced: Set[int] = set()
+        for fn in self._funcs:
+            for dec in fn.decorator_list:
+                name = func_simple_name(
+                    dec.func if isinstance(dec, ast.Call) else dec)
+                if name in TRACED_DECORATORS:
+                    traced.add(id(fn))
+                elif name == "partial" and isinstance(dec, ast.Call) \
+                        and dec.args:
+                    inner = func_simple_name(dec.args[0])
+                    if inner in TRACED_DECORATORS:
+                        traced.add(id(fn))
+        # functions handed to jit/scan/... — resolved LEXICALLY: a bare
+        # Name only reaches defs visible from the call site (module
+        # level, or nested in one of the call's enclosing functions);
+        # self.<name> args reach same-named methods. This is what keeps
+        # an unrelated public method named `step` out of the traced set
+        # when some closure `step` is jitted elsewhere in the file.
+        for call in ast.walk(self.tree):
+            if not isinstance(call, ast.Call) or \
+                    func_simple_name(call.func) not in TRACE_WRAPPERS:
+                continue
+            ancestors = set()
+            cur = self.enclosing_function(call)
+            while cur is not None:
+                ancestors.add(id(cur))
+                cur = self.enclosing_function(cur)
+            for arg in list(call.args) + [k.value for k in call.keywords]:
+                if isinstance(arg, ast.Name):
+                    for fn in self._funcs:
+                        if fn.name != arg.id:
+                            continue
+                        owner = self.enclosing_function(fn)
+                        at_module = isinstance(self.parent(fn), ast.Module)
+                        if at_module or (owner is not None
+                                         and id(owner) in ancestors):
+                            traced.add(id(fn))
+                elif isinstance(arg, ast.Attribute) and \
+                        isinstance(arg.value, ast.Name) and \
+                        arg.value.id in ("self", "cls"):
+                    for fn in self._funcs:
+                        if fn.name == arg.attr and \
+                                isinstance(self.parent(fn), ast.ClassDef):
+                            traced.add(id(fn))
+        # closure: nested defs + same-module callees of traced functions
+        fn_by_name: Dict[str, List[ast.AST]] = {}
+        for fn in self._funcs:
+            fn_by_name.setdefault(fn.name, []).append(fn)
+        changed = True
+        while changed:
+            changed = False
+            for fn in self._funcs:
+                if id(fn) not in traced:
+                    continue
+                for sub in ast.walk(fn):
+                    if isinstance(sub, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)) \
+                            and id(sub) not in traced:
+                        traced.add(id(sub))
+                        changed = True
+                    if isinstance(sub, ast.Call):
+                        callee = None
+                        if isinstance(sub.func, ast.Name):
+                            callee = sub.func.id
+                        elif isinstance(sub.func, ast.Attribute) and \
+                                isinstance(sub.func.value, ast.Name) and \
+                                sub.func.value.id in ("self", "cls"):
+                            callee = sub.func.attr
+                        for target in fn_by_name.get(callee, []):
+                            if id(target) not in traced:
+                                traced.add(id(target))
+                                changed = True
+        self._traced_ids = traced
+        return traced
+
+    def is_traced(self, fn: ast.AST) -> bool:
+        return id(fn) in self.traced_functions()
+
+    # -- value taint -----------------------------------------------------
+    def tainted_names(self, fn: ast.AST) -> Set[str]:
+        """Local names of ``fn`` holding traced array values (see module
+        docstring for what counts)."""
+        if id(fn) in self._taint_cache:
+            return self._taint_cache[id(fn)]
+        params = {a.arg for a in
+                  list(fn.args.posonlyargs) + list(fn.args.args)
+                  + list(fn.args.kwonlyargs)} - {"self", "cls"}
+        tainted: Set[str] = set()
+        # parameters with direct tensor evidence: passed bare as the
+        # FIRST positional argument of a jax-family call (the array
+        # slot). Later positions / kwargs are overwhelmingly static
+        # knobs (axis=, shape tuples, pad modes) — not evidence.
+        for call in ast.walk(fn):
+            if is_jax_call(call) and call.args and \
+                    func_simple_name(call.func) not in PROMOTING_JAX_CALLS:
+                arg = call.args[0]
+                if isinstance(arg, ast.Name) and arg.id in params:
+                    tainted.add(arg.id)
+        changed = True
+        passes = 0
+        while changed and passes < 10:
+            changed = False
+            passes += 1
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Assign):
+                    targets, value = node.targets, node.value
+                elif isinstance(node, ast.AugAssign):
+                    targets, value = [node.target], node.value
+                else:
+                    continue
+                if not self._expr_tainted(value, tainted):
+                    continue
+                for tgt in targets:
+                    for name in self._target_names(tgt):
+                        if name not in tainted:
+                            tainted.add(name)
+                            changed = True
+        self._taint_cache[id(fn)] = tainted
+        return tainted
+
+    def _target_names(self, tgt: ast.expr) -> Iterator[str]:
+        if isinstance(tgt, ast.Name):
+            yield tgt.id
+        elif isinstance(tgt, (ast.Tuple, ast.List)):
+            for el in tgt.elts:
+                yield from self._target_names(el)
+
+    def _expr_tainted(self, expr: ast.expr, tainted: Set[str]) -> bool:
+        for node in ast.walk(expr):
+            if is_jax_call(node):
+                return True
+            if isinstance(node, ast.Name) and node.id in tainted \
+                    and not self._under_static_access(node, expr):
+                return True
+        return False
+
+    def _under_static_access(self, name: ast.Name,
+                             within: ast.expr) -> bool:
+        """True when ``name``'s value only feeds a static accessor in
+        this expression (``x.shape``, ``len(x)``, ``x.ndim``...)."""
+        parent = self.parent(name)
+        if isinstance(parent, ast.Attribute) and \
+                parent.attr in STATIC_ATTRS:
+            return True
+        if isinstance(parent, ast.Call) and parent.func is not name and \
+                func_simple_name(parent.func) in (
+                    {"len", "isinstance", "hasattr", "getattr", "type"}
+                    | STATIC_JAX_CALLS):
+            return True
+        return False
+
+    # -- recompile-hazard helpers ---------------------------------------
+    def sanitizer_names(self) -> Set[str]:
+        """Module-local functions that quantize shape-derived ints onto
+        a bucket lattice: any def whose body calls a ``*bucket*``
+        function (e.g. ``_max_len`` calling ``_bucket``)."""
+        if self._sanitizers is not None:
+            return self._sanitizers
+        out: Set[str] = set()
+        for fn in self._funcs:
+            for call in ast.walk(fn):
+                if isinstance(call, ast.Call):
+                    name = func_simple_name(call.func) or ""
+                    if "bucket" in name:
+                        out.add(fn.name)
+                        break
+        self._sanitizers = out
+        return out
+
+
+# ---------------------------------------------------------------------------
+# driving
+# ---------------------------------------------------------------------------
+def iter_py_files(paths: Iterable[Path]) -> Iterator[Path]:
+    for p in paths:
+        if p.is_dir():
+            yield from sorted(p.rglob("*.py"))
+        elif p.suffix == ".py":
+            yield p
+
+
+def lint_source(source: str, relpath: str, rules) -> List[Finding]:
+    """Lint one source string; suppression pragmas applied, no baseline."""
+    try:
+        mod = ModuleInfo(source, relpath)
+    except SyntaxError as e:
+        return [Finding(rule="parse-error", path=relpath,
+                        line=e.lineno or 1, col=e.offset or 0,
+                        symbol="<module>", message=str(e))]
+    out: List[Finding] = []
+    for rule in rules:
+        for f in rule.check(mod):
+            if not mod.is_suppressed(f):
+                out.append(f)
+    out.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return out
+
+
+def relpath_for(path: Path, root: Path) -> str:
+    try:
+        return path.resolve().relative_to(root).as_posix()
+    except ValueError:
+        return path.as_posix()
+
+
+def lint_paths(paths: Iterable[Path], rules,
+               root: Optional[Path] = None) -> List[Finding]:
+    root = (root or Path.cwd()).resolve()
+    findings: List[Finding] = []
+    for path in iter_py_files(paths):
+        rel = relpath_for(path, root)
+        findings.extend(
+            lint_source(path.read_text(encoding="utf-8"), rel, rules))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# baseline
+# ---------------------------------------------------------------------------
+def load_baseline(path: Path) -> List[Dict[str, str]]:
+    data = json.loads(path.read_text(encoding="utf-8"))
+    return list(data.get("findings", []))
+
+
+def baseline_entry(f: Finding) -> Dict[str, str]:
+    return {"rule": f.rule, "path": f.path, "symbol": f.symbol,
+            "line_text": f.line_text.strip()}
+
+
+def write_baseline(path: Path, findings: List[Finding]) -> None:
+    entries = [baseline_entry(f) for f in findings]
+    path.write_text(json.dumps(
+        {"comment": "tpulint grandfathered violations — shrink me, "
+                    "never grow me (see README 'Static analysis')",
+         "findings": entries}, indent=1) + "\n", encoding="utf-8")
+
+
+def split_by_baseline(findings: List[Finding],
+                      baseline: List[Dict[str, str]]):
+    """Partition findings into (new, baselined) against the baseline
+    multiset; returns (new, baselined, stale_entries)."""
+    pool: Dict[Tuple[str, str, str, str], int] = {}
+    for e in baseline:
+        key = (e["rule"], e["path"], e["symbol"], e["line_text"])
+        pool[key] = pool.get(key, 0) + 1
+    new: List[Finding] = []
+    matched: List[Finding] = []
+    for f in findings:
+        key = f.fingerprint()
+        if pool.get(key, 0) > 0:
+            pool[key] -= 1
+            matched.append(f)
+        else:
+            new.append(f)
+    stale = [{"rule": k[0], "path": k[1], "symbol": k[2],
+              "line_text": k[3]}
+             for k, n in pool.items() for _ in range(n)]
+    return new, matched, stale

@@ -1,0 +1,34 @@
+"""tpulint rule registry. A rule is a ``core.Rule`` subclass; adding a
+module here (and instantiating it in ALL_RULES) is the whole plugin
+surface — the CLI, baseline, suppression and JSON layers are generic.
+"""
+from __future__ import annotations
+
+from typing import Dict, List
+
+from ..core import Rule
+from .host_sync import HostSyncInJitRule
+from .nonhashable_static import NonhashableStaticRule
+from .recompile_hazard import RecompileHazardRule
+from .traced_bool import TracedBoolRule
+from .unused_knob import UnusedKnobRule
+
+ALL_RULES: List[Rule] = [
+    UnusedKnobRule(),
+    HostSyncInJitRule(),
+    TracedBoolRule(),
+    NonhashableStaticRule(),
+    RecompileHazardRule(),
+]
+
+RULES_BY_ID: Dict[str, Rule] = {r.id: r for r in ALL_RULES}
+
+
+def select_rules(ids=None) -> List[Rule]:
+    if not ids:
+        return list(ALL_RULES)
+    unknown = [i for i in ids if i not in RULES_BY_ID]
+    if unknown:
+        raise KeyError(f"unknown rule id(s): {', '.join(unknown)} "
+                       f"(known: {', '.join(sorted(RULES_BY_ID))})")
+    return [RULES_BY_ID[i] for i in ids]

@@ -1,0 +1,74 @@
+"""host-sync-in-jit: device→host materialization inside traced code.
+
+``.item()`` / ``.tolist()`` / ``.numpy()`` / ``np.asarray`` /
+``jax.device_get`` on a value reachable from ``jax.jit`` / ``pjit`` /
+``shard_map`` / ``lax.scan`` bodies (or ``def_op`` kernels, which trace
+under vjp) either fails outright under tracing or — worse on the real
+serving path — forces a blocking transfer per step. Fix by staying in
+``jnp`` / ``lax``, or hoist the sync out of the compiled region.
+
+``float()`` / ``int()`` / ``bool()`` are only flagged when applied to a
+*tainted* expression (one holding a traced array per core taint
+analysis) — casting static Python knobs inside kernels is fine.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from ..core import Finding, ModuleInfo, Rule, func_root, func_simple_name
+
+SYNC_METHODS = {"item", "tolist", "numpy"}
+NUMPY_ROOTS = {"np", "numpy", "_np", "onp"}
+SYNC_BUILTINS = {"float", "int", "bool", "complex"}
+
+
+class HostSyncInJitRule(Rule):
+    id = "host-sync-in-jit"
+    description = ("host-sync call (.item()/np.asarray/float()/...) on "
+                   "a traced value inside jit-reachable code")
+
+    def check(self, mod: ModuleInfo) -> Iterator[Finding]:
+        for fn in mod.functions():
+            if not mod.is_traced(fn):
+                continue
+            tainted = mod.tainted_names(fn)
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                hit = self._classify(mod, node, tainted)
+                if hit:
+                    yield self.finding(
+                        mod, node,
+                        f"{hit} inside jit-reachable "
+                        f"'{mod.qualname_of(node)}' forces a device→host "
+                        "sync (or fails under tracing) — keep the value "
+                        "in jnp/lax, or hoist it out of the compiled "
+                        "region")
+
+    def _classify(self, mod, call: ast.Call, tainted) -> str:
+        fnode = call.func
+        # x.item() / x.tolist() / x.numpy()
+        if isinstance(fnode, ast.Attribute) and \
+                fnode.attr in SYNC_METHODS and not call.args:
+            return f".{fnode.attr}()"
+        # np.asarray(x) / np.array(x) on a traced value
+        if isinstance(fnode, ast.Attribute) and \
+                fnode.attr in ("asarray", "array"):
+            root = func_root(fnode)
+            if root in NUMPY_ROOTS and call.args and \
+                    self._arg_traced(mod, call.args[0], tainted):
+                return f"{root}.{fnode.attr}(...)"
+        # jax.device_get(x)
+        if func_simple_name(fnode) == "device_get":
+            return "jax.device_get(...)"
+        # float(x)/int(x)/bool(x) on a tainted expression only
+        if isinstance(fnode, ast.Name) and fnode.id in SYNC_BUILTINS \
+                and call.args and \
+                self._arg_traced(mod, call.args[0], tainted):
+            return f"{fnode.id}(...)"
+        return ""
+
+    @staticmethod
+    def _arg_traced(mod: ModuleInfo, arg: ast.expr, tainted) -> bool:
+        return mod._expr_tainted(arg, tainted)

@@ -1,0 +1,138 @@
+"""nonhashable-static: ``static_argnums``/``static_argnames`` naming a
+parameter whose default (or annotation) is a list/dict/set.
+
+``jax.jit`` hashes static args into the compile-cache key; a list or
+dict default means a guaranteed ``TypeError: unhashable type`` the
+first time the default is actually exercised — typically long after the
+code "worked" with explicit tuples in tests. Fix: make the default a
+tuple / frozenset, or pass the structure as a traced pytree arg.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List, Optional
+
+from ..core import Finding, ModuleInfo, Rule, func_simple_name
+
+JIT_NAMES = {"jit", "pjit"}
+NONHASHABLE_TYPES = {"list", "dict", "set", "List", "Dict", "Set",
+                     "bytearray"}
+
+
+def _nonhashable_default(node: Optional[ast.expr]) -> bool:
+    if node is None:
+        return False
+    if isinstance(node, (ast.List, ast.Dict, ast.Set, ast.ListComp,
+                         ast.DictComp, ast.SetComp)):
+        return True
+    if isinstance(node, ast.Call) and \
+            func_simple_name(node.func) in NONHASHABLE_TYPES:
+        return True
+    return False
+
+
+def _nonhashable_annotation(node: Optional[ast.expr]) -> bool:
+    if node is None:
+        return False
+    base = node.value if isinstance(node, ast.Subscript) else node
+    name = base.id if isinstance(base, ast.Name) else \
+        base.attr if isinstance(base, ast.Attribute) else None
+    return name in NONHASHABLE_TYPES
+
+
+def _params_with_defaults(fn: ast.AST) -> List[tuple]:
+    """[(arg, default_or_None)] over posonly+positional (+kwonly)."""
+    pos = list(fn.args.posonlyargs) + list(fn.args.args)
+    defaults = list(fn.args.defaults)
+    pad = [None] * (len(pos) - len(defaults))
+    out = list(zip(pos, pad + defaults))
+    out += list(zip(fn.args.kwonlyargs, fn.args.kw_defaults))
+    return out
+
+
+class NonhashableStaticRule(Rule):
+    id = "nonhashable-static"
+    description = ("static_argnums/static_argnames names a list/dict-"
+                   "typed parameter (unhashable jit cache key)")
+
+    def check(self, mod: ModuleInfo) -> Iterator[Finding]:
+        fn_by_name = {}
+        for fn in mod.functions():
+            fn_by_name.setdefault(fn.name, fn)
+        for fn in mod.functions():
+            # decorator form: @jax.jit(...)/@partial(jax.jit, ...)
+            for dec in fn.decorator_list:
+                call = dec if isinstance(dec, ast.Call) else None
+                if call is None:
+                    continue
+                target = call
+                if func_simple_name(call.func) == "partial" and \
+                        call.args and \
+                        func_simple_name(call.args[0]) in JIT_NAMES:
+                    pass
+                elif func_simple_name(call.func) in JIT_NAMES:
+                    pass
+                else:
+                    continue
+                yield from self._check_call(mod, target, fn)
+        # call form: jax.jit(f, static_argnums=...)
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            names = None
+            if func_simple_name(node.func) in JIT_NAMES and node.args \
+                    and isinstance(node.args[0], ast.Name):
+                names = node.args[0].id
+            if names is None:
+                continue
+            target_fn = fn_by_name.get(names)
+            if target_fn is not None:
+                yield from self._check_call(mod, node, target_fn)
+
+    def _check_call(self, mod: ModuleInfo, call: ast.Call,
+                    fn: ast.AST) -> Iterator[Finding]:
+        params = _params_with_defaults(fn)
+        by_name = {a.arg: (a, d) for a, d in params}
+        for kw in call.keywords:
+            if kw.arg == "static_argnames":
+                for name in self._const_strs(kw.value):
+                    if name in by_name:
+                        arg, default = by_name[name]
+                        yield from self._flag(mod, call, fn, arg,
+                                              default)
+            elif kw.arg == "static_argnums":
+                for idx in self._const_ints(kw.value):
+                    if 0 <= idx < len(params):
+                        arg, default = params[idx]
+                        yield from self._flag(mod, call, fn, arg,
+                                              default)
+
+    def _flag(self, mod, call, fn, arg, default) -> Iterator[Finding]:
+        if _nonhashable_default(default) or \
+                _nonhashable_annotation(arg.annotation):
+            yield self.finding(
+                mod, call,
+                f"static arg '{arg.arg}' of {fn.name}() has a "
+                "list/dict/set default or annotation — jit hashes "
+                "static args, so this raises 'unhashable type' at the "
+                "first default call; use a tuple or pass it traced")
+
+    @staticmethod
+    def _const_strs(node: ast.expr):
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            yield node.value
+        elif isinstance(node, (ast.Tuple, ast.List)):
+            for el in node.elts:
+                if isinstance(el, ast.Constant) and \
+                        isinstance(el.value, str):
+                    yield el.value
+
+    @staticmethod
+    def _const_ints(node: ast.expr):
+        if isinstance(node, ast.Constant) and isinstance(node.value, int):
+            yield node.value
+        elif isinstance(node, (ast.Tuple, ast.List)):
+            for el in node.elts:
+                if isinstance(el, ast.Constant) and \
+                        isinstance(el.value, int):
+                    yield el.value

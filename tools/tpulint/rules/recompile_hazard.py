@@ -1,0 +1,141 @@
+"""recompile-hazard: raw shape-derived Python ints at a jit boundary.
+
+This repo compiles serving programs through shape-keyed *factories*
+(``_prefill_fn`` / ``_decode_fn`` / ``_step_fn`` — the ``*_fn`` naming
+convention): every int argument to a factory becomes part of a compile
+cache key, so an int derived from a runtime length — ``len(prompt)``,
+``ids.shape[1]``, page counts — silently compiles one XLA program *per
+distinct value*. That is the exact hazard PR 1's ``_bucket()`` lattice
+exists to kill (cf. the recompile-sensitivity lessons in the Ragged
+Paged Attention paper).
+
+Scope and sanitization:
+- boundary = a call whose callee name ends in ``_fn`` (the factory
+  convention). Calls to the *returned* jitted function are not
+  boundaries: there, Python ints become weak-typed traced scalars and
+  do not fork compilations.
+- a value is sanitized once it flows through a ``*bucket*`` call or a
+  module-local function that itself buckets (``_max_len``).
+- shape-taint propagates through arithmetic and through
+  len/int/min/max/abs/sum/round only; any other call is a barrier (its
+  result is an arbitrary object, usually an array, not a key int).
+- array-producing arguments (``jnp.asarray(...)``, ``x.reshape(...)``
+  method calls) and subscript indices (``a[:n]``) are exempt.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Set
+
+from ..core import Finding, ModuleInfo, Rule, func_simple_name
+
+# calls whose int result stays "the same int" for taint purposes
+PROP_FUNCS = {"len", "int", "min", "max", "abs", "sum", "round",
+              "float", "divmod", "bool"}
+ASARRAY_WRAPPERS = {"asarray", "array", "int32", "int64", "full",
+                    "arange", "zeros", "ones", "Tensor", "to_tensor"}
+
+
+def _shape_refs(node: ast.expr, shape_derived: Set[str],
+                sanitizers: Set[str]):
+    """Yield offending references in ``node``: shape metadata reads and
+    shape-derived names — honoring call barriers, bucket sanitizers and
+    subscript-index exemption."""
+    if isinstance(node, ast.Call):
+        name = func_simple_name(node.func) or ""
+        if "bucket" in name or name in sanitizers:
+            return                          # sanitized subtree
+        if name == "len":
+            yield "len(...)"
+            return
+        if name not in PROP_FUNCS:
+            return                          # barrier: opaque result
+        for arg in node.args:
+            yield from _shape_refs(arg, shape_derived, sanitizers)
+        return
+    if isinstance(node, ast.Attribute):
+        if node.attr in ("shape", "ndim", "size"):
+            yield f".{node.attr}"
+            return
+        yield from _shape_refs(node.value, shape_derived, sanitizers)
+        return
+    if isinstance(node, ast.Subscript):
+        # a[:n] / a[i] passes a's elements, not the index int
+        yield from _shape_refs(node.value, shape_derived, sanitizers)
+        return
+    if isinstance(node, ast.Name):
+        if node.id in shape_derived:
+            yield node.id
+        return
+    for child in ast.iter_child_nodes(node):
+        yield from _shape_refs(child, shape_derived, sanitizers)
+
+
+class RecompileHazardRule(Rule):
+    id = "recompile-hazard"
+    description = ("shape/length-derived Python int reaches a *_fn jit "
+                   "factory without _bucket()-style quantization")
+
+    def check(self, mod: ModuleInfo) -> Iterator[Finding]:
+        sanitizers = mod.sanitizer_names()
+        for fn in mod.functions():
+            yield from self._check_function(mod, fn, sanitizers)
+
+    def _check_function(self, mod: ModuleInfo, fn: ast.AST,
+                        sanitizers: Set[str]) -> Iterator[Finding]:
+        shape_derived: Set[str] = set()
+        changed = True
+        passes = 0
+        while changed and passes < 10:
+            changed = False
+            passes += 1
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Assign):
+                    targets, value = node.targets, node.value
+                elif isinstance(node, ast.AugAssign) and \
+                        isinstance(node.target, ast.Name):
+                    targets, value = [node.target], node.value
+                else:
+                    continue
+                if not any(_shape_refs(value, shape_derived, sanitizers)):
+                    continue
+                names = []
+                for t in targets:
+                    if isinstance(t, ast.Name):
+                        names.append(t.id)
+                    elif isinstance(t, (ast.Tuple, ast.List)):
+                        names += [e.id for e in t.elts
+                                  if isinstance(e, ast.Name)]
+                for n in names:
+                    if n not in shape_derived:
+                        shape_derived.add(n)
+                        changed = True
+        for call in ast.walk(fn):
+            if not isinstance(call, ast.Call):
+                continue
+            callee = func_simple_name(call.func) or ""
+            if not callee.endswith("_fn"):
+                continue
+            for arg in list(call.args) + \
+                    [k.value for k in call.keywords]:
+                if self._arg_is_array(arg):
+                    continue
+                bad = next(_shape_refs(arg, shape_derived, sanitizers),
+                           None)
+                if bad:
+                    yield self.finding(
+                        mod, call,
+                        f"shape-derived int '{bad}' reaches jit factory "
+                        f"'{callee}(...)' unquantized — every distinct "
+                        "value compiles a new XLA program; round it "
+                        "onto the _bucket() lattice first")
+
+    @staticmethod
+    def _arg_is_array(arg: ast.expr) -> bool:
+        """jnp.asarray(...) / x.reshape(...)-style args are traced
+        operands whose SHAPE is already fixed by upstream bucketing —
+        their values don't key the factory cache."""
+        if not isinstance(arg, ast.Call):
+            return False
+        return func_simple_name(arg.func) in (
+            ASARRAY_WRAPPERS | {"reshape", "astype", "ravel", "flatten"})

@@ -1,0 +1,66 @@
+"""traced-bool: Python ``if``/``while`` on a traced value in jitted code.
+
+Under ``jax.jit`` a Python branch on a traced array raises a
+TracerBoolConversionError (or, with ``static_argnums`` misuse, silently
+forks compilations). Control flow on traced values belongs in
+``lax.cond`` / ``lax.while_loop`` / ``jnp.where`` — this repo wraps
+those as ``static.nn.cond`` / ``static.nn.while_loop``.
+
+Static conditions stay allowed: branches on Python knobs, ``x is None``
+checks, ``isinstance``, and shape/ndim/dtype metadata are all resolved
+at trace time and are idiomatic in kernels.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Set
+
+from ..core import (Finding, ModuleInfo, Rule, STATIC_JAX_CALLS,
+                    func_simple_name, is_jax_call)
+
+
+class TracedBoolRule(Rule):
+    id = "traced-bool"
+    description = ("Python if/while on a traced value inside a jitted "
+                   "region (use static.nn cond/while_loop or jnp.where)")
+
+    def check(self, mod: ModuleInfo) -> Iterator[Finding]:
+        for fn in mod.functions():
+            if not mod.is_traced(fn):
+                continue
+            tainted = mod.tainted_names(fn)
+            for node in ast.walk(fn):
+                if not isinstance(node, (ast.If, ast.While)):
+                    continue
+                offender = self._offending(mod, node.test, tainted)
+                if offender:
+                    kind = "if" if isinstance(node, ast.If) else "while"
+                    yield self.finding(
+                        mod, node,
+                        f"`{kind}` on traced value {offender} inside "
+                        f"jit-reachable '{mod.qualname_of(node)}' — "
+                        "Python control flow forks at trace time; use "
+                        "static.nn.cond/while_loop or jnp.where")
+
+    def _offending(self, mod: ModuleInfo, test: ast.expr,
+                   tainted: Set[str]) -> str:
+        for node in ast.walk(test):
+            if is_jax_call(node) and \
+                    func_simple_name(node.func) not in STATIC_JAX_CALLS:
+                return f"`{func_simple_name(node.func)}(...)`"
+            if isinstance(node, ast.Name) and node.id in tainted \
+                    and not self._static_use(mod, node):
+                return f"'{node.id}'"
+        return ""
+
+    def _static_use(self, mod: ModuleInfo, name: ast.Name) -> bool:
+        """The reference is static under tracing: shape/ndim/dtype
+        access, len(), isinstance(), or an `is (not) None` operand."""
+        if mod._under_static_access(name, name):
+            return True
+        parent = mod.parent(name)
+        if isinstance(parent, ast.Compare) and \
+                all(isinstance(op, (ast.Is, ast.IsNot))
+                    for op in parent.ops):
+            return True
+        return False

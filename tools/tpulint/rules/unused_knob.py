@@ -1,0 +1,90 @@
+"""unused-knob: a public API accepts a parameter and silently ignores it.
+
+The round-5 findings class: masked_multihead_attention's ``src_mask``,
+pool3d's ``ceil_mode``, matrix_nms's ``normalized`` — knobs a caller
+sets expecting reference semantics while the body never reads them.
+The repo convention (block_multihead_attention) is enforce-or-implement:
+either serve the knob or ``enforce`` it at its default so divergence is
+loud.
+
+A parameter counts as read if its name is loaded anywhere in the body —
+including inside an ``enforce(...)`` guard, which is exactly the
+sanctioned fix.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List
+
+from ..core import Finding, ModuleInfo, Rule
+
+# accepted-everywhere compat knobs that are documented no-ops in the
+# reference API itself (paddle's `name=` labels static-graph nodes)
+IGNORED_PARAMS = {"self", "cls", "name"}
+
+
+def _is_stub(fn: ast.AST) -> bool:
+    body: List[ast.stmt] = list(fn.body)
+    if body and isinstance(body[0], ast.Expr) and \
+            isinstance(body[0].value, ast.Constant) and \
+            isinstance(body[0].value.value, str):
+        body = body[1:]
+    if not body:
+        return True
+    return all(isinstance(s, (ast.Raise, ast.Pass)) or
+               (isinstance(s, ast.Expr) and
+                isinstance(s.value, ast.Constant))
+               for s in body)
+
+
+def _is_public(mod: ModuleInfo, fn: ast.AST) -> bool:
+    name = fn.name
+    if name.startswith("_") and not (name.startswith("__")
+                                     and name.endswith("__")):
+        return False
+    parent = mod.parent(fn)
+    if isinstance(parent, ast.ClassDef):
+        return not parent.name.startswith("_")
+    return isinstance(parent, ast.Module)
+
+
+class UnusedKnobRule(Rule):
+    id = "unused-knob"
+    description = ("public function parameter never read in the body "
+                   "(silent-ignore API divergence)")
+
+    def check(self, mod: ModuleInfo) -> Iterator[Finding]:
+        for fn in mod.functions():
+            if not _is_public(mod, fn) or _is_stub(fn):
+                continue
+            if any(isinstance(d, ast.Name) and d.id == "abstractmethod"
+                   for d in fn.decorator_list):
+                continue
+            args = fn.args
+            params = [a for a in (list(args.posonlyargs) + list(args.args)
+                                  + list(args.kwonlyargs))
+                      if a.arg not in IGNORED_PARAMS
+                      and not a.arg.startswith("_")]
+            if not params:
+                continue
+            loaded = set()
+            for stmt in fn.body:
+                for node in ast.walk(stmt):
+                    if isinstance(node, ast.Name) and \
+                            isinstance(node.ctx, ast.Load):
+                        loaded.add(node.id)
+                    # nested defs capture params via their own args too
+                    if isinstance(node, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef,
+                                         ast.Lambda)):
+                        loaded |= {a.arg for a in node.args.args}
+            for p in params:
+                if p.arg not in loaded:
+                    # anchor at the parameter itself so the pragma /
+                    # baseline pins the exact signature line
+                    yield self.finding(
+                        mod, p,
+                        f"public parameter '{p.arg}' of {fn.name}() is "
+                        "accepted but never read — enforce it at its "
+                        "default or implement it (repo convention: "
+                        "block_multihead_attention)")
