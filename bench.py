@@ -427,7 +427,10 @@ def bench_gpt13b_hybrid(on_tpu, dev):
     strategy = fleet.DistributedStrategy()
     strategy.hybrid_configs = {"dp_degree": dp, "mp_degree": 4,
                                "pp_degree": 2,
-                               "sharding_degree": 1}
+                               "sharding_degree": 1,
+                               # collective-matmul overlap on the TP hot
+                               # path (distributed/collective_matmul.py)
+                               "mp_configs": {"mp_async_allreduce": True}}
     strategy.sharding_configs = {"stage": 2}
     strategy.pipeline_configs = {"accumulate_steps": 2,
                                  "micro_batch_size": B // (2 * dp)}
@@ -444,6 +447,8 @@ def bench_gpt13b_hybrid(on_tpu, dev):
     y = paddle.to_tensor(ids[:, 1:])
     loss = dist_model.train_batch([x, y], opt)
     float(loss)
+    stats = dist_model._engine.stats
+    compiles_warm = stats.compiles
     t0 = time.perf_counter()
     for _ in range(steps):
         loss = dist_model.train_batch([x, y], opt)
@@ -461,6 +466,83 @@ def bench_gpt13b_hybrid(on_tpu, dev):
         "vs_baseline": round(mfu / 0.45, 4) if peak else 0.0,
         "mfu": round(mfu, 4) if peak else 0.0,
         "mesh": f"dp{dp}xpp2xmp4", "devices": n,
+        "mp_async_allreduce": True,
+        # engine compile-cache counters: steady state must be
+        # recompile-free (overlap regressions keyed on traced shapes
+        # would show here)
+        "compiles": stats.compiles,
+        "cache_hits": stats.cache_hits,
+        "recompiles_after_warmup": stats.compiles - compiles_warm,
+        "device": str(getattr(dev, "device_kind", dev.platform)),
+    })
+
+
+# ---------------------------------------------------------------------------
+# 3b. Collective-matmul overlap microbench: the fused ring decompositions
+# (distributed/collective_matmul.py — ag_matmul + matmul_rs, the TP/SP
+# hot-path pair) vs the unfused all_gather -> GEMM -> psum_scatter chain
+# on the same mesh. On TPU the fused rings hide the ICI transfer behind
+# partial GEMMs; on the CPU harness the line still emits (correctness +
+# plumbing smoke, speedup ~1x is expected there).
+# ---------------------------------------------------------------------------
+def bench_tp_overlap(on_tpu, dev):
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from paddle_tpu.distributed import collective_matmul as cm
+    from paddle_tpu.distributed.engine import _shard_map
+
+    n = jax.device_count()
+    if n < 2:
+        _emit({"metric": "tp_overlap_matmul_ms", "value": 0.0,
+               "unit": "needs_chips", "vs_baseline": 0.0,
+               "needs_devices": 2, "have_devices": n})
+        return
+    mesh = Mesh(np.array(jax.devices()).reshape(n), ("mp",))
+    if on_tpu:
+        S, B, K, N = 2048, 4, 4096, 4096
+        dt, iters = jnp.bfloat16, 20
+    else:
+        S, B, K, N = 128, 2, 64, 128
+        dt, iters = jnp.float32, 3
+    r = np.random.RandomState(0)
+    x = jnp.asarray(r.randn(S, B, K), dt)        # seq-major [s, b, h]
+    w1 = jnp.asarray(r.randn(K, N), dt)          # column-sharded
+    w2 = jnp.asarray(r.randn(N, K), dt)          # row-sharded
+
+    def fused(xs, a, b):
+        h = cm.ag_matmul(xs, a, ("mp",), 0)
+        return cm.matmul_rs(h, b, ("mp",), 0)
+
+    def unfused(xs, a, b):
+        h = lax.all_gather(xs, ("mp",), axis=0, tiled=True) @ a
+        return lax.psum_scatter(h @ b, "mp", scatter_dimension=0,
+                                tiled=True)
+
+    in_specs = (P("mp"), P(None, "mp"), P("mp"))
+
+    def timed(fn):
+        step = jax.jit(_shard_map(fn, mesh, in_specs, P("mp")))
+        step(x, w1, w2).block_until_ready()      # compile + warm
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = step(x, w1, w2)
+        out.block_until_ready()
+        return (time.perf_counter() - t0) / iters * 1e3
+
+    fused_ms = timed(fused)
+    unfused_ms = timed(unfused)
+    _emit({
+        "metric": "tp_overlap_matmul_ms",
+        "value": round(fused_ms, 3),
+        "unit": "ms",
+        # the gate on chip: fused must not be slower than unfused
+        "vs_baseline": round(unfused_ms / fused_ms, 4) if fused_ms else 0.0,
+        "unfused_ms": round(unfused_ms, 3),
+        "shape": [S, B, K, N], "dtype": str(jnp.dtype(dt)),
+        "devices": n,
         "device": str(getattr(dev, "device_kind", dev.platform)),
     })
 
@@ -653,12 +735,13 @@ _BENCHES = {}
 # each + headline printed last = one hang, zero lines).
 _TIMEOUTS = {"gpt": 900, "llama_decode": 420, "llama_decode_int8": 420,
              "llama_decode_ragged": 420, "serving": 420, "resnet": 300,
-             "moe": 300, "gpt13b_hybrid": 420, "kernel_parity": 240}
+             "moe": 300, "gpt13b_hybrid": 420, "tp_overlap": 240,
+             "kernel_parity": 240}
 _ORDER = ("gpt", "llama_decode", "llama_decode_int8",
           "llama_decode_ragged", "serving", "resnet", "moe",
-          "gpt13b_hybrid", "kernel_parity")
+          "gpt13b_hybrid", "tp_overlap", "kernel_parity")
 # benches that need a virtual multi-device mesh on the CPU fallback
-_NEEDS_VDEV = {"gpt13b_hybrid": 8}
+_NEEDS_VDEV = {"gpt13b_hybrid": 8, "tp_overlap": 8}
 
 
 def _run_one(name, deadline_s=None):
@@ -780,7 +863,8 @@ def main(argv):
                     llama_decode_int8=bench_llama_decode_int8,
                     llama_decode_ragged=bench_llama_decode_ragged,
                     serving=bench_serving_mixed,
-                    gpt13b_hybrid=bench_gpt13b_hybrid)
+                    gpt13b_hybrid=bench_gpt13b_hybrid,
+                    tp_overlap=bench_tp_overlap)
     if len(argv) > 1 and argv[1] == "--only":
         dl = int(argv[3]) if len(argv) > 3 else None
         _run_one(argv[2], dl)

@@ -1,0 +1,53 @@
+"""Compile-cache telemetry shared by the serving path (Predictor /
+ServingEngine) and the training engine (distributed.engine
+ParallelEngine) — a new signature at a compiled-program launch site is
+an XLA compile, a repeated one is a cache hit, so after warmup a
+healthy path shows ``compiles`` flat and ``cache_hits`` growing."""
+from __future__ import annotations
+
+from typing import Any, Dict
+
+__all__ = ["CompileStats"]
+
+
+class CompileStats:
+    """Compile-cache telemetry for compiled-program launch sites.
+
+    Every launch site notes its FULL shape signature (including
+    lattice dims like the paged-pool size P — the shape jax.jit
+    actually keys on, even when the host-side fn cache key doesn't)."""
+
+    def __init__(self):
+        self.compiles = 0
+        self.cache_hits = 0
+        self.tokens = 0
+        self.bucket_tokens: Dict[Any, int] = {}
+        self._seen = set()
+
+    def note(self, kind: str, sig) -> bool:
+        """Record one compiled-program launch; True if it compiles."""
+        key = (kind, sig)
+        if key in self._seen:
+            self.cache_hits += 1
+            return False
+        self._seen.add(key)
+        self.compiles += 1
+        return True
+
+    def count_tokens(self, bucket, n: int):
+        self.tokens += int(n)
+        self.bucket_tokens[bucket] = self.bucket_tokens.get(bucket, 0) \
+            + int(n)
+
+    def tokens_per_sec(self, elapsed_s: float) -> float:
+        return self.tokens / elapsed_s if elapsed_s > 0 else 0.0
+
+    def as_dict(self) -> Dict[str, Any]:
+        return {"compiles": self.compiles, "cache_hits": self.cache_hits,
+                "tokens": self.tokens,
+                "bucket_tokens": {str(k): v
+                                  for k, v in self.bucket_tokens.items()}}
+
+    def __repr__(self):
+        return (f"CompileStats(compiles={self.compiles}, "
+                f"cache_hits={self.cache_hits}, tokens={self.tokens})")

@@ -32,6 +32,13 @@ _profile_hook = None
 # dispatch (operator-stats collection, amp accuracy tooling).
 _op_observer = None
 
+# Set by static.nn.cond while discovering a branch closure's
+# differentiable inputs: receives every non-stop_gradient Tensor an op
+# consumes. During the capture run the branch executes under no_grad, so
+# branch-internal intermediates are stop_gradient and only the EXTERNAL
+# captured tensors (the closure boundary) reach the hook.
+_input_observer = None
+
 # Flipped to True by paddle_tpu.static on the first Variable creation;
 # gates the static-recording scan off the eager hot path.
 _static_used = [False]
@@ -85,6 +92,10 @@ def apply(opdef: OpDef, args, kwargs):
     )
     if _op_observer is not None:
         _op_observer(opdef.name, conv_args)
+    if _input_observer is not None:
+        for t in in_tensors:
+            if t is not None and not t.stop_gradient:
+                _input_observer(t)
     if _profile_hook is not None:
         with _profile_hook(opdef.name):
             outs = run_op(call)

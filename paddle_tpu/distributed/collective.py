@@ -278,11 +278,20 @@ def in_spmd_region() -> bool:
     return getattr(_spmd, "depth", 0) > 0
 
 
+def axis_size(axis_name) -> int:
+    """Static size of a named mesh axis inside an SPMD region.
+
+    Compat shim: ``lax.axis_size`` only exists in newer jax; a psum over
+    a python int constant-folds to the axis size at trace time on every
+    version."""
+    return lax.psum(1, axis_name)
+
+
 def axis_index(axis_names: Tuple[str, ...]):
     """Linearised rank within the (possibly multi-axis) group."""
     idx = lax.axis_index(axis_names[0])
     for a in axis_names[1:]:
-        idx = idx * lax.axis_size(a) + lax.axis_index(a)
+        idx = idx * axis_size(a) + lax.axis_index(a)
     return idx
 
 

@@ -1,0 +1,418 @@
+"""Collective matmul: chunked ring decompositions that hide ICI transfer
+behind partial GEMMs (T3, arXiv:2401.16677; reference knob:
+``strategy.hybrid_configs["mp_configs"]["mp_async_allreduce"]`` —
+python/paddle/distributed/fleet/meta_parallel/parallel_layers/
+mp_layers.py async allreduce overlap).
+
+XLA already overlaps collectives with *independent* compute (the
+latency-hiding scheduler), but it cannot break a data dependence: an
+``all_gather`` feeding a matmul, or a matmul feeding a
+``reduce_scatter``/``all_reduce``, serializes — the whole-tensor
+collective is exposed on the step's critical path. The decompositions
+here re-express those fused pairs as a ring of per-shard steps, so each
+tick's GEMM has no dependence on that tick's ``lax.ppermute`` and the
+scheduler hides the transfer behind the partial matmul:
+
+- ``ag_matmul(x, w)``     = ``all_gather(x) @ w``: a bidirectional
+  ppermute ring; each tick matmuls the resident shard (writing its slice
+  of the output) while the next shard is in flight from both neighbors.
+- ``matmul_rs(x, w)``     = ``psum_scatter(x @ w)``: a ring of
+  partial-sum shifts; each tick computes the output chunk destined for
+  the accumulator currently passing through and adds it before the shift.
+- ``matmul_allreduce``    = ``psum(x @ w)`` as matmul_rs + all_gather:
+  the reduce half of the allreduce rides behind the GEMM
+  (RowParallelLinear's reduce side).
+- ``matmul_gather``       = ``all_gather(x @ w, axis=-1)`` chunked over
+  rows so each chunk's feature gather overlaps the next chunk's GEMM
+  (ColumnParallelLinear's gather side).
+
+Each op carries a ``jax.custom_vjp`` whose backward is the mirrored ring
+(bwd of ag_matmul is matmul_rs-shaped and vice versa), so the backward
+pass overlaps the same way — and matches the Megatron/SP custom-grad
+pairings of the unfused layers exactly (mp_ops.py /
+sequence_parallel_utils.py), keeping loss parity with the knob off.
+
+Fallback policy (``overlap_available``): the ring needs one concrete
+mesh axis (a single-name mp group) inside an SPMD region, and the
+chunked dim must divide the ring size; anything else runs the unfused
+layer path unchanged.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from . import collective as C
+from ..autograd import engine as _engine
+from ..tensor import Tensor
+
+__all__ = [
+    "ag_matmul", "matmul_rs", "matmul_allreduce", "matmul_gather",
+    "overlap_enabled", "overlap_available",
+    "linear_ag_matmul", "linear_matmul_rs", "linear_matmul_allreduce",
+    "linear_matmul_gather",
+    "pick_scatter_axis", "scatter_divides", "chunk_count",
+]
+
+
+# -- knob -----------------------------------------------------------------
+
+def overlap_enabled() -> bool:
+    """The reference knob, read live from the active fleet strategy
+    (fleet.init / TensorParallel plumb it into the fleet state)."""
+    from . import fleet as _fleet
+
+    strat = _fleet.get_strategy()
+    if strat is None:
+        return False
+    mp_cfg = strat.hybrid_configs.get("mp_configs") or {}
+    return bool(mp_cfg.get("mp_async_allreduce", False))
+
+
+def _ring_axis(axes) -> Optional[str]:
+    """The single concrete mesh axis a ppermute ring can run over, or
+    None (multi-axis mp groups fall back to the unfused path)."""
+    if not axes:
+        return None
+    flat = []
+    for a in axes:
+        flat.extend(a if isinstance(a, (tuple, list)) else (a,))
+    return flat[0] if len(flat) == 1 else None
+
+
+def overlap_available(axes) -> bool:
+    """True when the fused ring path may run: knob on, inside an SPMD
+    region, over exactly one mesh axis."""
+    return (overlap_enabled() and C.in_spmd_region()
+            and _ring_axis(axes) is not None)
+
+
+# -- ring building blocks -------------------------------------------------
+
+def _mm(c, w):
+    """c [..., k] @ w [k, n] — the same contraction F.linear lowers to."""
+    return lax.dot_general(c, w, (((c.ndim - 1,), (0,)), ((), ())))
+
+
+def _tdot(a, b):
+    """Contract ALL leading dims: a [..., k], b [..., n] -> [k, n]
+    (the weight-grad contraction of a linear on >=2-d activations)."""
+    dims = tuple(range(a.ndim - 1))
+    return lax.dot_general(a, b, ((dims, dims), ((), ())))
+
+
+def _ring_info(axes):
+    name = _ring_axis(axes)
+    return name, C.axis_size(name), lax.axis_index(name)
+
+
+def _perms(p):
+    up = [(i, (i + 1) % p) for i in range(p)]    # recv from idx - t
+    dn = [(i, (i - 1) % p) for i in range(p)]    # recv from idx + t
+    return up, dn
+
+
+def _ag_matmul_impl(x, w, axes, axis):
+    """all_gather(x, axis, tiled) @ w as a bidirectional ppermute ring.
+
+    Each tick issues the next shard's permutes FIRST, then matmuls the
+    resident shard into its output slice — the permute has no dependence
+    on the matmul, so XLA's latency-hiding scheduler runs them
+    concurrently on ICI + MXU.
+    """
+    name, p, idx = _ring_info(axes)
+    local = x.shape[axis]
+    chunk0 = _mm(x, w)
+    shape = list(chunk0.shape)
+    shape[axis] = local * p
+    out = jnp.zeros(tuple(shape), chunk0.dtype)
+
+    def place(buf, chunk, pos):
+        return lax.dynamic_update_slice_in_dim(buf, chunk, pos * local,
+                                               axis=axis)
+
+    out = place(out, chunk0, idx)
+    if p == 1:
+        return out
+    up_perm, dn_perm = _perms(p)
+    up = dn = x
+    for t in range(1, (p - 1) // 2 + 1):
+        up = lax.ppermute(up, name, up_perm)
+        dn = lax.ppermute(dn, name, dn_perm)
+        out = place(out, _mm(up, w), (idx - t) % p)
+        out = place(out, _mm(dn, w), (idx + t) % p)
+    if p % 2 == 0:
+        up = lax.ppermute(up, name, up_perm)
+        out = place(out, _mm(up, w), (idx - p // 2) % p)
+    return out
+
+
+def _matmul_rs_impl(x, w, axes, axis):
+    """psum_scatter(x @ w, axis, tiled) as a ring of partial-sum shifts.
+
+    The accumulator destined for rank d is created at rank d+1 and
+    travels i -> i-1; each rank adds its chunk-GEMM for the passing
+    destination. The GEMM of tick t is independent of tick t-1's
+    ppermute, so they overlap.
+    """
+    name, p, idx = _ring_info(axes)
+    local = x.shape[axis] // p
+
+    def chunk(j):
+        return lax.dynamic_slice_in_dim(x, j * local, local, axis=axis)
+
+    acc = _mm(chunk((idx + 1) % p), w)
+    if p == 1:
+        return acc
+    perm = [(i, (i - 1) % p) for i in range(p)]
+    for t in range(1, p):
+        nxt = lax.ppermute(acc, name, perm)
+        acc = nxt + _mm(chunk((idx + 1 + t) % p), w)
+    return acc
+
+
+def _grad_w_ring(shard, full, axes, axis):
+    """sum_j shard_from_rank_j^T . slice_j(full): the weight-grad of a
+    gathered-input linear, computed as the same bidirectional ring so
+    the backward's all-gather hides behind the per-chunk contractions.
+    shard [..., a], full [..., b] (full's ``axis`` dim = p * shard's)
+    -> [a, b]."""
+    name, p, idx = _ring_info(axes)
+    local = shard.shape[axis]
+
+    def sl(j):
+        return lax.dynamic_slice_in_dim(full, j * local, local, axis=axis)
+
+    dw = _tdot(shard, sl(idx))
+    if p == 1:
+        return dw
+    up_perm, dn_perm = _perms(p)
+    up = dn = shard
+    for t in range(1, (p - 1) // 2 + 1):
+        up = lax.ppermute(up, name, up_perm)
+        dn = lax.ppermute(dn, name, dn_perm)
+        dw = dw + _tdot(up, sl((idx - t) % p)) + _tdot(dn, sl((idx + t) % p))
+    if p % 2 == 0:
+        up = lax.ppermute(up, name, up_perm)
+        dw = dw + _tdot(up, sl((idx - p // 2) % p))
+    return dw
+
+
+# -- value-level fused ops with mirrored-ring custom VJPs -----------------
+
+@partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def ag_matmul(x, w, axes, axis=0):
+    """all_gather(x, axis) @ w, overlapped. Pairing (SP column linear):
+    the gather's bwd is a reduce-scatter — so d(x) is matmul_rs-shaped
+    and d(w) is the gather-ring contraction."""
+    return _ag_matmul_impl(x, w, axes, axis)
+
+
+def _ag_matmul_fwd(x, w, axes, axis):
+    return _ag_matmul_impl(x, w, axes, axis), (x, w)
+
+
+def _ag_matmul_bwd(axes, axis, res, g):
+    x, w = res
+    dx = _matmul_rs_impl(g, w.T, axes, axis)
+    dw = _grad_w_ring(x, g, axes, axis)
+    return dx, dw
+
+
+ag_matmul.defvjp(_ag_matmul_fwd, _ag_matmul_bwd)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def matmul_rs(x, w, axes, axis=0):
+    """psum_scatter(x @ w, axis), overlapped. Pairing (SP row linear):
+    the scatter's bwd is an all-gather — so d(x) is ag_matmul-shaped."""
+    return _matmul_rs_impl(x, w, axes, axis)
+
+
+def _matmul_rs_fwd(x, w, axes, axis):
+    return _matmul_rs_impl(x, w, axes, axis), (x, w)
+
+
+def _matmul_rs_bwd(axes, axis, res, g):
+    x, w = res
+    dx = _ag_matmul_impl(g, w.T, axes, axis)
+    dw = _grad_w_ring(g, x, axes, axis).T
+    return dx, dw
+
+
+matmul_rs.defvjp(_matmul_rs_fwd, _matmul_rs_bwd)
+
+
+def _matmul_allreduce_impl(x, w, axes, axis):
+    out = _matmul_rs_impl(x, w, axes, axis)
+    return lax.all_gather(out, axes, axis=axis, tiled=True)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def matmul_allreduce(x, w, axes, axis=0):
+    """psum(x @ w) with the reduce half hidden behind the GEMM
+    (matmul_rs ring + tiled all_gather). Backward keeps the Megatron
+    psum/identity pairing of _mp_allreduce: d(x)/d(w) are LOCAL GEMMs,
+    no collective (mp_ops.py psum_identity_bwd)."""
+    return _matmul_allreduce_impl(x, w, axes, axis)
+
+
+def _matmul_ar_fwd(x, w, axes, axis):
+    return _matmul_allreduce_impl(x, w, axes, axis), (x, w)
+
+
+def _matmul_ar_bwd(axes, axis, res, g):
+    x, w = res
+    return _mm(g, w.T), _tdot(x, g)
+
+
+matmul_allreduce.defvjp(_matmul_ar_fwd, _matmul_ar_bwd)
+
+
+def _matmul_gather_impl(x, w, axes, nchunks):
+    rows = x.shape[0]
+    c = rows // nchunks
+    parts = []
+    for j in range(nchunks):
+        xj = lax.slice_in_dim(x, j * c, (j + 1) * c, axis=0)
+        parts.append(lax.all_gather(_mm(xj, w), axes, axis=xj.ndim - 1,
+                                    tiled=True))
+    return jnp.concatenate(parts, axis=0) if nchunks > 1 else parts[0]
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def matmul_gather(x, w, axes, nchunks=1):
+    """all_gather(x @ w, axis=-1, tiled) with the GEMM chunked over
+    rows: chunk j's feature gather has no dependence on chunk j+1's
+    matmul, so the gather pipelines behind the remaining compute.
+    Backward keeps the _c_concat pairing (local slice, no collective)."""
+    return _matmul_gather_impl(x, w, axes, nchunks)
+
+
+def _matmul_gather_fwd(x, w, axes, nchunks):
+    return _matmul_gather_impl(x, w, axes, nchunks), (x, w)
+
+
+def _matmul_gather_bwd(axes, nchunks, res, g):
+    x, w = res
+    local = w.shape[-1]
+    idx = C.axis_index(axes)
+    g_loc = lax.dynamic_slice_in_dim(g, idx * local, local, axis=g.ndim - 1)
+    return _mm(g_loc, w.T), _tdot(x, g_loc)
+
+
+matmul_gather.defvjp(_matmul_gather_fwd, _matmul_gather_bwd)
+
+
+# -- Tensor-level fused linears (tape + pure-transform dual path) ---------
+#
+# Like the mp_ops primitives, each fused linear works under BOTH autodiff
+# regimes: the eager tape (`loss.backward()` inside the engine's compiled
+# step) via a recorded custom node, and pure function transforms
+# (`jax.vjp` in the pipeline schedule / jit.to_static) via the
+# custom_vjp on the value-level op above.
+
+def _record_fused(name, out_val, bwd_fn, x: Tensor, weight: Tensor):
+    sg = x.stop_gradient and weight.stop_gradient
+    out = Tensor(out_val, stop_gradient=sg)
+    if _engine.is_grad_enabled() and not sg:
+        out.stop_gradient = False
+        _engine.record_custom(name, bwd_fn, [x, weight], [out], out_val)
+    return out
+
+
+def _add_bias(out: Tensor, bias: Optional[Tensor]) -> Tensor:
+    return out if bias is None else out + bias
+
+
+def linear_ag_matmul(x: Tensor, weight: Tensor, bias: Optional[Tensor],
+                     axes, axis: int) -> Tensor:
+    """F.linear(all_gather(x, axis), weight, bias), overlapped."""
+    xv, wv = x._value, weight._value
+
+    def bwd(g):
+        return _ag_matmul_bwd(axes, axis, (xv, wv), g)
+
+    out = _record_fused("ag_matmul", ag_matmul(xv, wv, axes, axis), bwd,
+                        x, weight)
+    return _add_bias(out, bias)
+
+
+def linear_matmul_rs(x: Tensor, weight: Tensor, bias: Optional[Tensor],
+                     axes, axis: int) -> Tensor:
+    """reduce_scatter(F.linear(x, weight), axis) + bias, overlapped."""
+    xv, wv = x._value, weight._value
+
+    def bwd(g):
+        return _matmul_rs_bwd(axes, axis, (xv, wv), g)
+
+    out = _record_fused("matmul_rs", matmul_rs(xv, wv, axes, axis), bwd,
+                        x, weight)
+    return _add_bias(out, bias)
+
+
+def linear_matmul_allreduce(x: Tensor, weight: Tensor,
+                            bias: Optional[Tensor], axes,
+                            axis: int) -> Tensor:
+    """allreduce(F.linear(x, weight)) + bias, reduce half overlapped."""
+    xv, wv = x._value, weight._value
+
+    def bwd(g):
+        return _matmul_ar_bwd(axes, axis, (xv, wv), g)
+
+    out = _record_fused("matmul_allreduce",
+                        matmul_allreduce(xv, wv, axes, axis), bwd, x, weight)
+    return _add_bias(out, bias)
+
+
+def linear_matmul_gather(x: Tensor, weight: Tensor, bias: Optional[Tensor],
+                         axes, nchunks: int) -> Tensor:
+    """all_gather(F.linear(x, weight), axis=-1) chunk-pipelined.
+
+    NOTE bias ordering: the unfused column layer adds its mp-sharded
+    bias BEFORE the gather; here the gathered bias must be added after
+    — callers pass a FULL (gathered) bias or None.
+    """
+    xv, wv = x._value, weight._value
+
+    def bwd(g):
+        return _matmul_gather_bwd(axes, nchunks, (xv, wv), g)
+
+    out = _record_fused("matmul_gather",
+                        matmul_gather(xv, wv, axes, nchunks), bwd, x, weight)
+    return _add_bias(out, bias)
+
+
+def pick_scatter_axis(shape: Sequence[int], axes) -> Optional[int]:
+    """First leading (non-feature) dim the ring size divides, or None —
+    the chunk-doesn't-divide unfused fallback."""
+    name = _ring_axis(axes)
+    if name is None:
+        return None
+    p = C.axis_size(name)
+    for d in range(max(len(shape) - 1, 1)):
+        if shape[d] % p == 0 and shape[d] >= p:
+            return d
+    return None
+
+
+def scatter_divides(n: int, axes) -> bool:
+    """True when the ring size divides ``n`` (matmul_rs needs the
+    scattered dim chunkable; otherwise unfused fallback)."""
+    name = _ring_axis(axes)
+    return name is not None and n % C.axis_size(name) == 0
+
+
+def chunk_count(rows: int, axes) -> int:
+    """Largest chunk count <= ring size that divides ``rows`` (1 =
+    nothing to pipeline -> callers fall back unfused)."""
+    name = _ring_axis(axes)
+    p = C.axis_size(name)
+    for c in range(min(p, rows), 0, -1):
+        if rows % c == 0:
+            return c
+    return 1

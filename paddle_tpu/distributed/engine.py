@@ -34,6 +34,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from . import collective as C
 from ..autograd import engine as _ad
 from ..core import rng as _rng
+from ..core.compile_stats import CompileStats
 from ..tensor import Tensor
 
 try:
@@ -274,6 +275,12 @@ class ParallelEngine:
         self._seed = 0
         self._mesh_epoch = C.mesh_epoch()
         self._compiled: Dict[Any, Callable] = {}
+        # compile-cache telemetry (same counters as the serving path):
+        # a healthy train loop compiles each (shape, spec) signature
+        # once and shows only cache hits in steady state — regressions
+        # that force recompiles (e.g. an overlap path keyed on a traced
+        # shape) surface here and on the bench JSON lines
+        self.stats = CompileStats()
         self._zero = _ZeroPlan(mesh, self.trainable, optimizer)
         # LazyGuard-built params materialize straight into their (zero3-
         # aware) storage sharding: O(shard) bytes per process, no full-
@@ -588,6 +595,7 @@ class ParallelEngine:
             key = (treedef, tuple((v.shape, str(v.dtype))
                                   for v in leaf_vals), b_specs,
                    tuple(sorted(mvals)), amp_key)
+            self.stats.note("train", key)
             if key not in self._compiled:
                 self._compiled[key] = make(treedef, b_specs, mspecs)
             pvals = tuple(p._value for p in params)
@@ -690,6 +698,7 @@ class ParallelEngine:
                 P(data_axes) if data_axes else P())
             key = (treedef, tuple((v.shape, str(v.dtype))
                                   for v in leaf_vals), b_specs, str(ospec))
+            self.stats.note("eval", key)
             if key not in compiled:
                 compiled[key] = make(treedef, b_specs, ospec)
             leaf_vals = _globalize_batch(leaf_vals, b_specs, mesh)

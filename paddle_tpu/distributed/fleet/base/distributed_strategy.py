@@ -15,7 +15,10 @@ _HYBRID_DEFAULTS = {
     "sharding_degree": 1,
     "sep_degree": 1,
     "order": ["dp", "pp", "sharding", "sep", "mp"],
-    "mp_configs": {},
+    # mp_async_allreduce (reference hybrid_configs:1808): overlap the
+    # TP/SP collectives with the matmuls they feed via the chunked ring
+    # decompositions in distributed/collective_matmul.py
+    "mp_configs": {"mp_async_allreduce": False},
     "pp_configs": {},
 }
 
@@ -30,6 +33,9 @@ class _SubConfig(dict):
 class DistributedStrategy:
     def __init__(self):
         self._hybrid_configs: Dict[str, Any] = dict(_HYBRID_DEFAULTS)
+        # nested sub-configs must not alias the class-level defaults
+        for k in ("mp_configs", "pp_configs"):
+            self._hybrid_configs[k] = _SubConfig(_HYBRID_DEFAULTS[k])
         self.pipeline_configs: Dict[str, Any] = {
             "micro_batch_size": 1, "accumulate_steps": 1}
         self.amp = False

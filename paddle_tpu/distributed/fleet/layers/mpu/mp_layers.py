@@ -128,10 +128,25 @@ class ColumnParallelLinear(Layer):
     def forward(self, x):
         if self.is_mp:
             x = _c_identity(x, self._mp_group)
-        out = F.linear(x, self.weight, self.bias)
         if self.gather_output and self.is_mp:
-            out = _c_concat(out, self._mp_group)
-        return out
+            from .... import collective_matmul as _cm
+
+            axes = mp_axes(self._mp_group)
+            if _cm.overlap_available(axes):
+                # gather side overlapped: the matmul is chunked over rows
+                # so each chunk's feature all-gather pipelines behind the
+                # next chunk's GEMM. The mp-sharded bias gathers once
+                # (tiny) and adds after — same value as the unfused
+                # pre-gather add.
+                nchunks = _cm.chunk_count(x.shape[0], axes)
+                out = _cm.linear_matmul_gather(x, self.weight, None, axes,
+                                               nchunks)
+                if self.bias is not None:
+                    out = out + _c_concat(self.bias, self._mp_group)
+                return out
+            return _c_concat(F.linear(x, self.weight, self.bias),
+                             self._mp_group)
+        return F.linear(x, self.weight, self.bias)
 
     def extra_repr(self):
         return (f"in={self.in_features}, out={self.out_features}, "
@@ -169,9 +184,23 @@ class RowParallelLinear(Layer):
     def forward(self, x):
         if self.is_mp and not self.input_is_parallel:
             x = _c_split(x, self._mp_group)
-        out = F.linear(x, self.weight, None)
         if self.is_mp:
-            out = _mp_allreduce(out, self._mp_group)
+            from .... import collective_matmul as _cm
+
+            axes = mp_axes(self._mp_group)
+            if _cm.overlap_available(axes):
+                # reduce side overlapped: the allreduce's reduce-scatter
+                # half rides a partial-sum ring behind the chunked GEMM;
+                # falls through unfused when no leading dim divides the
+                # ring (pick_scatter_axis None)
+                ax = _cm.pick_scatter_axis(x.shape, axes)
+                if ax is not None:
+                    return _cm.linear_matmul_allreduce(
+                        x, self.weight, self.bias, axes, ax)
+            out = _mp_allreduce(F.linear(x, self.weight, None),
+                                self._mp_group)
+        else:
+            out = F.linear(x, self.weight, None)
         if self.bias is not None:
             out = out + self.bias
         return out

@@ -98,7 +98,7 @@ def slice_allgather_bwd(x, axes, axis=-1):
     """Forward this rank's slice of ``axis``; backward all-gather."""
     n = 1
     for a in axes:
-        n *= lax.axis_size(a)
+        n *= C.axis_size(a)
     local = x.shape[axis] // n
     idx = C.axis_index(axes)
     return lax.dynamic_slice_in_dim(x, idx * local, local, axis=axis)

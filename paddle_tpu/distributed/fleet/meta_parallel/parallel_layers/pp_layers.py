@@ -385,7 +385,7 @@ class PipelineLayer(Layer):
         axis = pp_axes[0]
 
         def fn(x_val, *stacked_vals):
-            S = lax.axis_size(axis)
+            S = C.axis_size(axis)
             enforce(S == self._num_stages,
                     f"model was built for {self._num_stages} pipeline "
                     f"stages but the mesh '{axis}' axis has {S} — build "

@@ -37,7 +37,15 @@ class _DelegateWrapper(Layer):
 
 
 class TensorParallel(_DelegateWrapper):
-    pass
+    def __init__(self, layers: Layer, hcg=None, strategy=None):
+        super().__init__(layers, hcg, strategy)
+        # model-parallel setup plumb: a strategy handed straight to the
+        # wrapper (no fleet.init) must still drive the mp_configs knobs
+        # the mpu layers read live (collective_matmul.overlap_enabled)
+        from .. import _fleet_state
+
+        if strategy is not None and _fleet_state.get("strategy") is None:
+            _fleet_state["strategy"] = strategy
 
 
 class SegmentParallel(_DelegateWrapper):

@@ -225,6 +225,15 @@ class ColumnSequenceParallelLinear(Layer):
 
     def forward(self, x):
         if self.is_mp:
+            from ... import collective_matmul as _cm
+
+            axes = mp_axes(self._mp_group)
+            if _cm.overlap_available(axes):
+                # seq all-gather + matmul as one bidirectional ring: each
+                # tick matmuls the resident seq shard while the next is
+                # in flight (backward is the mirrored matmul_rs ring)
+                return _cm.linear_ag_matmul(x, self.weight, self.bias,
+                                            axes, self._seq_axis)
             x = all_gather(x, self._mp_group, axis=self._seq_axis)
         return F.linear(x, self.weight, self.bias)
 
@@ -274,6 +283,20 @@ class RowSequenceParallelLinear(Layer):
                 mark_as_sequence_parallel_parameter(self.bias)
 
     def forward(self, x):
+        if self.is_mp:
+            from ... import collective_matmul as _cm
+
+            axes = mp_axes(self._mp_group)
+            if _cm.overlap_available(axes) and _cm.scatter_divides(
+                    x.shape[self._seq_axis], axes):
+                # matmul + seq reduce-scatter as a ring of partial-sum
+                # shifts: each tick's chunk-GEMM overlaps the in-flight
+                # accumulator (backward is the mirrored ag_matmul ring)
+                out = _cm.linear_matmul_rs(x, self.weight, None, axes,
+                                           self._seq_axis)
+                if self.bias is not None:
+                    out = out + self.bias
+                return out
         out = F.linear(x, self.weight, None)
         if self.is_mp:
             out = reduce_scatter(out, self._mp_group, axis=self._seq_axis)

@@ -61,50 +61,10 @@ def _bucket(n: int, lo: int = 64) -> int:
     return b
 
 
-class CompileStats:
-    """Compile-cache telemetry for the serving path.
-
-    Every compiled-program launch site notes its FULL shape signature
-    (including the paged-pool size P — the shape jax.jit actually keys
-    on, even when the host-side fn cache key doesn't). A new signature
-    is an XLA compile; a repeated one is a cache hit, so after warmup a
-    healthy serving path shows ``compiles`` flat and ``cache_hits``
-    growing under arbitrary traffic mixes."""
-
-    def __init__(self):
-        self.compiles = 0
-        self.cache_hits = 0
-        self.tokens = 0
-        self.bucket_tokens: Dict[Any, int] = {}
-        self._seen = set()
-
-    def note(self, kind: str, sig) -> bool:
-        """Record one compiled-program launch; True if it compiles."""
-        key = (kind, sig)
-        if key in self._seen:
-            self.cache_hits += 1
-            return False
-        self._seen.add(key)
-        self.compiles += 1
-        return True
-
-    def count_tokens(self, bucket, n: int):
-        self.tokens += int(n)
-        self.bucket_tokens[bucket] = self.bucket_tokens.get(bucket, 0) \
-            + int(n)
-
-    def tokens_per_sec(self, elapsed_s: float) -> float:
-        return self.tokens / elapsed_s if elapsed_s > 0 else 0.0
-
-    def as_dict(self) -> Dict[str, Any]:
-        return {"compiles": self.compiles, "cache_hits": self.cache_hits,
-                "tokens": self.tokens,
-                "bucket_tokens": {str(k): v
-                                  for k, v in self.bucket_tokens.items()}}
-
-    def __repr__(self):
-        return (f"CompileStats(compiles={self.compiles}, "
-                f"cache_hits={self.cache_hits}, tokens={self.tokens})")
+# shared with the training engine (ParallelEngine.stats); the class
+# lives in core so distributed/engine.py can import it without pulling
+# the whole inference stack
+from ..core.compile_stats import CompileStats  # noqa: E402,F401
 
 
 def _sample(logits, key, gen: "GenerationConfig"):

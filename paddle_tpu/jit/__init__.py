@@ -218,6 +218,8 @@ def save(layer, path, input_spec=None, **configs):
             _unwrap, out, is_leaf=lambda x: isinstance(x, Tensor))
 
     # symbolic dims for None entries (dynamic batch)
+    import jax.export  # attr-only access fails before the submodule import
+
     sym_names = iter("bcdefghij")
     scopes = jax.export.SymbolicScope()
     in_specs = []
@@ -276,6 +278,8 @@ def load(path, **configs):
     model_file = path + ".pdmodel"
     if not os.path.exists(model_file):
         return state
+    import jax.export  # attr-only access fails before the submodule import
+
     with open(model_file, "rb") as f:
         exported = jax.export.deserialize(bytearray(f.read()))
     return TranslatedLayer(exported, state)

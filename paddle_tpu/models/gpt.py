@@ -141,7 +141,7 @@ def _sep_shard(value, axis: int):
         return value, 0
     n = 1
     for a in axes:
-        n *= _lax.axis_size(a)
+        n *= C.axis_size(a)
     idx = C.axis_index(axes)
     loc = value.shape[axis] // n
     off = idx * loc

@@ -276,14 +276,12 @@ class LlamaAttention(Layer):
             out = Tensor(ov.reshape(B, S, n_local * D), stop_gradient=True)
             return self.o_proj(out), (k_cache, v_cache)
 
-        # training path: tape-tracked rope + flash attention
+        # training path: tape-tracked rope + flash attention. GQA heads
+        # pass through as-is — flash_attention groups q per kv head by
+        # broadcast (no repeated K/V copies on the XLA path)
         q_r = _rope_op(q, B, S, n_local, D, cos, sin)
         k_r = _rope_op(k, B, S, nkv_local, D, cos, sin)
         v_r = ops.reshape(v, (B, S, nkv_local, D))
-        if nkv_local != n_local:
-            rep = n_local // nkv_local
-            k_r = ops.repeat_interleave(k_r, rep, axis=2)
-            v_r = ops.repeat_interleave(v_r, rep, axis=2)
         o = flash_attention(q_r, k_r, v_r, causal=True)
         o = ops.reshape(o, (B, S, n_local * D))
         return self.o_proj(o)

@@ -8,6 +8,7 @@ path is plain XLA, selected at trace time by backend.)
 from __future__ import annotations
 
 import jax
+import numpy as np
 import jax.numpy as jnp
 
 from ..core import flags
@@ -27,15 +28,45 @@ def _use_pallas(q) -> bool:
         return False
 
 
+def _gqa_sdpa(q, k, v, causal):
+    """Grouped-query attention without materializing repeated K/V:
+    q reshapes to [B, KV, rep, S, D] (query head h reads kv head
+    h // rep) and the kv planes broadcast over the rep dim — the XLA
+    fallback analog of the decode kernel's native GQA grouping."""
+    B, S, H, D = q.shape
+    Sk, KV = k.shape[1], k.shape[2]
+    rep = H // KV
+    scale = 1.0 / float(np.sqrt(D))
+    qf = jnp.swapaxes(q, 1, 2).astype(jnp.float32).reshape(B, KV, rep, S, D)
+    kf = jnp.swapaxes(k, 1, 2).astype(jnp.float32)          # [B, KV, Sk, D]
+    vf = jnp.swapaxes(v, 1, 2).astype(jnp.float32)
+    scores = jnp.einsum("bkrqd,bktd->bkrqt", qf, kf) * scale
+    if causal:
+        mask = jnp.tril(jnp.ones((S, Sk), bool), k=Sk - S)
+        scores = jnp.where(mask, scores, -jnp.inf)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bkrqt,bktd->bkrqd", probs, vf)
+    return jnp.swapaxes(out.reshape(B, H, S, D), 1, 2).astype(q.dtype)
+
+
 @def_op("flash_attention")
 def flash_attention(q, k, v, causal=False, dropout=0.0, dropout_key=None):
-    """Layout [batch, seqlen, num_heads, head_dim]."""
+    """Layout [batch, seqlen, num_heads, head_dim]. GQA accepted: k/v
+    may carry fewer (dividing) heads — the XLA path broadcasts the
+    shared kv plane per query group (no per-query-head K/V copies); the
+    Pallas kernel path repeats at the kernel boundary only (the kernel
+    requires equal head counts)."""
+    Hq, Hk = q.shape[2], k.shape[2]
     if _use_pallas(q) and not dropout:
         try:
             from .pallas.flash_attention import flash_attention_fwd
 
+            kk, vv = k, v
+            if Hk != Hq:
+                kk = jnp.repeat(k, Hq // Hk, axis=2)
+                vv = jnp.repeat(v, Hq // Hk, axis=2)
             # positional: custom_vjp nondiff args reject keywords
-            return flash_attention_fwd(q, k, v, causal, None, None)
+            return flash_attention_fwd(q, kk, vv, causal, None, None)
         except (KeyboardInterrupt, SystemExit):
             raise
         except Exception as e:  # unsupported shape, Mosaic compile
@@ -49,6 +80,11 @@ def flash_attention(q, k, v, causal=False, dropout=0.0, dropout_key=None):
                 warnings.warn(
                     f"flash_attention: Pallas kernel unavailable "
                     f"({type(e).__name__}: {e}); using XLA fallback")
+    if Hk != Hq and not dropout:
+        return _gqa_sdpa(q, k, v, causal)
+    if Hk != Hq:
+        k = jnp.repeat(k, Hq // Hk, axis=2)
+        v = jnp.repeat(v, Hq // Hk, axis=2)
     return _sdpa_raw(q, k, v, attn_mask=None, dropout_p=dropout,
                      is_causal=causal, dropout_key=dropout_key)
 

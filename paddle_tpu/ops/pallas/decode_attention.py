@@ -294,20 +294,23 @@ def paged_attention_dense(q, k_pool, v_pool, block_tables, lengths):
 
 
 def _dense_ragged(q, k_cache, v_cache, lengths):
-    """Dense cache attention with per-row offsets (ragged)."""
+    """Dense cache attention with per-row offsets (ragged).
+
+    GQA never copies K/V per query head: q reshapes to [B, KV, rep, S,
+    D] (query head h reads kv head h // rep) and the einsums broadcast
+    the shared kv plane over the rep dim."""
     B, S, H, D = q.shape
     KV, M = k_cache.shape[1], k_cache.shape[2]
-    if KV != H:
-        k_cache = jnp.repeat(k_cache, H // KV, axis=1)
-        v_cache = jnp.repeat(v_cache, H // KV, axis=1)
-    qf = jnp.swapaxes(q, 1, 2).astype(jnp.float32)
-    kf = k_cache.astype(jnp.float32)
+    rep = H // KV
+    qf = jnp.swapaxes(q, 1, 2).astype(jnp.float32)          # [B, H, S, D]
+    qf = qf.reshape(B, KV, rep, S, D)
+    kf = k_cache.astype(jnp.float32)                        # [B, KV, M, D]
     vf = v_cache.astype(jnp.float32)
-    scores = jnp.einsum("bhsd,bhmd->bhsm", qf, kf) / np.sqrt(D)
+    scores = jnp.einsum("bkrsd,bkmd->bkrsm", qf, kf) / np.sqrt(D)
     off = jnp.asarray(lengths, jnp.int32).reshape(B)
     q_pos = off[:, None] + jnp.arange(S)[None, :]          # [B, S]
     keep = jnp.arange(M)[None, None, :] <= q_pos[:, :, None]
-    scores = jnp.where(keep[:, None], scores, _NEG)
+    scores = jnp.where(keep[:, None, None], scores, _NEG)
     probs = jax.nn.softmax(scores, axis=-1)
-    out = jnp.einsum("bhsm,bhmd->bhsd", probs, vf)
-    return jnp.swapaxes(out, 1, 2).astype(q.dtype)
+    out = jnp.einsum("bkrsm,bkmd->bkrsd", probs, vf)
+    return jnp.swapaxes(out.reshape(B, H, S, D), 1, 2).astype(q.dtype)

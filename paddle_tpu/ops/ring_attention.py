@@ -45,11 +45,11 @@ def _ring_attention(q, k, v, axes=(), causal=True, scale=None):
         n = 1
         idx = jnp.int32(0)
     else:
-        n = 1
-        for a in axes:
-            n *= lax.axis_size(a)
         from ..distributed import collective as C
 
+        n = 1
+        for a in axes:
+            n *= C.axis_size(a)
         idx = C.axis_index(axes)
 
     q_pos = idx * Sq + jnp.arange(Sq)

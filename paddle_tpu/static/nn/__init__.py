@@ -12,11 +12,17 @@ predicate is concrete and the branch just runs) and under
 region; this is what makes tensor-valued Python ``if``/``while`` —
 which CANNOT trace — expressible).
 
-Branch/body functions run under ``no_grad``: gradients do not flow
-through these constructs (use masked ``where`` selects for trainable
-branching). XLA requires both branches/iterations to carry identical
-structures, shapes, and dtypes; mismatches raise with the offending
-leaf named.
+Differentiability: ``cond`` IS differentiable (``lax.cond`` has a
+reverse-mode rule, and so does the reference's cond) — when grad is
+enabled, the branch closures' differentiable inputs are discovered via
+a dispatch-level capture pass, both branches become pure functions of
+those captured tensors, and the whole ``lax.cond`` is recorded on the
+tape as one custom node whose backward is ``jax.vjp`` of the same cond.
+``while_loop`` stays NON-differentiable (``lax.while_loop`` has no
+reverse-mode rule) and raises loudly when a loop var requires grad;
+``case``/``switch_case`` branch fns still run under ``no_grad``. XLA
+requires both branches/iterations to carry identical structures,
+shapes, and dtypes; mismatches raise with the offending leaf named.
 """
 from __future__ import annotations
 
@@ -112,12 +118,110 @@ def _check_match(a, b, api, names=("true_fn", "false_fn")):
                         f"{la.shape}/{la.dtype} vs {lb.shape}/{lb.dtype}")
 
 
+def _captured_inputs(fn, api):
+    """Run ``fn`` once under ``no_grad`` with the dispatch-level input
+    observer installed; returns the ordered unique EXTERNAL
+    differentiable Tensors the closure consumes. (Inside the no_grad
+    run every branch-internal intermediate is stop_gradient, so only
+    the closure boundary reaches the observer.)"""
+    from ...core import dispatch as _dispatch
+
+    seen, order = set(), []
+
+    def obs(t):
+        if id(t) not in seen:
+            seen.add(id(t))
+            order.append(t)
+
+    prev = _dispatch._input_observer
+    _dispatch._input_observer = obs
+    try:
+        _run_branch(fn, api)
+    finally:
+        _dispatch._input_observer = prev
+    return order
+
+
+@contextlib.contextmanager
+def _bound_values(tensors, vals):
+    """Temporarily swap each captured Tensor's backing array, making a
+    branch closure a pure function of ``vals`` (the functional-call
+    trick of distributed.engine.bind_params)."""
+    saved = [t._value for t in tensors]
+    try:
+        for t, v in zip(tensors, vals):
+            t._value = v
+        yield
+    finally:
+        for t, v in zip(tensors, saved):
+            t._value = v
+
+
+def _diff_cond(pv, true_fn, false_fn):
+    """Differentiable cond: one lax.cond over the branches as pure
+    functions of their captured tensors, recorded on the tape as a
+    custom node whose backward is jax.vjp of the same cond (correct
+    under BOTH loss.backward() and pure transforms)."""
+    from ...autograd import engine as _engine
+
+    caps = _captured_inputs(true_fn, "cond")
+    cap_ids = {id(t) for t in caps}
+    for t in _captured_inputs(false_fn, "cond"):
+        if id(t) not in cap_ids:
+            cap_ids.add(id(t))
+            caps.append(t)
+    if not caps:
+        return NotImplemented          # nothing differentiable below
+
+    td_box = []
+
+    def branch(fn):
+        def run(vals):
+            with _bound_values(caps, list(vals)):
+                out = _run_branch(fn, "cond")
+            leaves, td = jax.tree_util.tree_flatten(out)
+            td_box.append(td)
+            return tuple(leaves)
+
+        return run
+
+    def cond_fn(*vals):
+        return lax.cond(pv, branch(true_fn), branch(false_fn), vals)
+
+    cap_vals = tuple(t._value for t in caps)
+    out_leaves = cond_fn(*cap_vals)
+    treedef = td_box[0]
+    diff_idx = [i for i, v in enumerate(out_leaves)
+                if jnp.issubdtype(v.dtype, jnp.inexact)]
+    out_tensors = [Tensor(v, stop_gradient=i not in diff_idx)
+                   for i, v in enumerate(out_leaves)]
+    if diff_idx:
+        def bwd(*gs):
+            def diff_fn(*vals):
+                leaves = cond_fn(*vals)
+                return tuple(leaves[i] for i in diff_idx)
+
+            _, vjp = jax.vjp(diff_fn, *cap_vals)
+            grads = vjp(tuple(gs))
+            return tuple(
+                None if getattr(g, "dtype", None) == jax.dtypes.float0
+                else g for g in grads)
+
+        _engine.record_custom(
+            "static_cond", bwd, list(caps),
+            [out_tensors[i] for i in diff_idx],
+            tuple(out_leaves[i] for i in diff_idx))
+    return jax.tree_util.tree_unflatten(treedef, out_tensors)
+
+
 def cond(pred, true_fn: Optional[Callable] = None,
          false_fn: Optional[Callable] = None, name=None,
          return_names=None):
     """Run ``true_fn()`` if ``pred`` else ``false_fn()`` — as a
     ``lax.cond`` HLO, so a TENSOR-VALUED predicate works under
     ``to_static`` tracing (reference: static/nn/control_flow.py:1166).
+    Differentiable: gradients flow to tensors the branch closures
+    capture (lax.cond supports reverse mode; see module doc).
     """
     enforce(true_fn is not None or false_fn is not None,
             "cond needs at least one of true_fn/false_fn")
@@ -142,6 +246,13 @@ def cond(pred, true_fn: Optional[Callable] = None,
     fa = jax.eval_shape(lambda: _run_branch(false_fn, "cond"))
     _check_match(ta, fa, "cond")
 
+    from ...autograd import engine as _engine
+
+    if _engine.is_grad_enabled():
+        out = _diff_cond(pv, true_fn, false_fn)
+        if out is not NotImplemented:
+            return out
+
     out = lax.cond(pv, lambda: _run_branch(true_fn, "cond"),
                    lambda: _run_branch(false_fn, "cond"))
     return _wrap(out)
@@ -151,9 +262,27 @@ def while_loop(cond: Callable, body: Callable, loop_vars: Sequence,
                is_test: bool = False, name=None) -> List:
     """``while cond(*vars): vars = body(*vars)`` as a
     ``lax.while_loop`` HLO (reference: static/nn/control_flow.py:1380).
-    Loop-carried shapes/dtypes must be invariant across iterations."""
+    Loop-carried shapes/dtypes must be invariant across iterations.
+
+    NOT differentiable (``lax.while_loop`` has no reverse-mode rule):
+    a loop var that requires grad raises loudly instead of silently
+    dropping the gradient — detach the inputs or restructure with
+    ``cond``/masked ``where`` selects for trainable control flow."""
     enforce(len(loop_vars) > 0, "while_loop needs at least one loop var")
     _no_program_recording("while_loop", *loop_vars)
+    from ...autograd import engine as _engine
+
+    if _engine.is_grad_enabled():
+        for i, t in enumerate(jax.tree_util.tree_leaves(
+                list(loop_vars), is_leaf=lambda x: isinstance(x, Tensor))):
+            enforce(not (isinstance(t, Tensor) and not t.stop_gradient),
+                    f"static.nn.while_loop is not differentiable, but "
+                    f"loop var {i} requires grad (stop_gradient=False): "
+                    "lax.while_loop has no reverse-mode rule. Detach the "
+                    "input (.detach() / stop_gradient=True), call under "
+                    "paddle.no_grad(), or restructure with static.nn."
+                    "cond / masked where selects (which ARE "
+                    "differentiable).")
     init = tuple(_unwrap(list(loop_vars)))
 
     def c(vs):

@@ -1,0 +1,328 @@
+"""Collective-matmul overlap: exact parity (ring-order fp tolerance) of
+the fused ring decompositions against the unfused collective+GEMM
+chains on the 8-virtual-device CPU mesh, plus end-to-end loss parity of
+the TP/SP linears with ``mp_async_allreduce`` on vs off (the reference
+loss-parity strategy, SURVEY.md §4)."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed import collective_matmul as cm
+from paddle_tpu.distributed import fleet
+from paddle_tpu.distributed.engine import ParallelEngine, _shard_map
+from paddle_tpu.distributed.fleet.utils import \
+    sequence_parallel_utils as spu
+
+AXES = ("mp",)
+TOL = dict(rtol=1e-5, atol=1e-5)
+
+
+def _mesh(n=8):
+    return Mesh(np.array(jax.devices()[:n]).reshape(n), ("mp",))
+
+
+def _sm(f, mesh, in_specs, out_specs):
+    return _shard_map(f, mesh, in_specs, out_specs)
+
+
+# -- raw ring ops vs the unfused reference on 8 devices -------------------
+
+def test_ag_matmul_fwd_bwd_parity():
+    mesh = _mesh()
+    r = np.random.RandomState(0)
+    x = jnp.asarray(r.randn(16, 4, 12), jnp.float32)   # [s, b, k]
+    w = jnp.asarray(r.randn(12, 24), jnp.float32)
+    g = jnp.asarray(r.randn(16, 4, 24), jnp.float32)
+
+    def fused(xs, wv, gv):
+        out, vjp = jax.vjp(lambda a, b: cm.ag_matmul(a, b, AXES, 0),
+                           xs, wv)
+        return (out,) + vjp(gv)
+
+    def ref(xs, wv, gv):
+        out, vjp = jax.vjp(
+            lambda a, b: lax.all_gather(a, AXES, axis=0, tiled=True) @ b,
+            xs, wv)
+        return (out,) + vjp(gv)
+
+    specs = (P("mp"), P(), P(None))
+    outs = (P(None), P("mp"), P())
+    of, dxf, dwf = _sm(fused, mesh, specs, outs)(x, w, g)
+    orr, dxr, dwr = _sm(ref, mesh, specs, outs)(x, w, g)
+    np.testing.assert_allclose(np.asarray(of), np.asarray(orr), **TOL)
+    np.testing.assert_allclose(np.asarray(dxf), np.asarray(dxr), **TOL)
+    np.testing.assert_allclose(np.asarray(dwf), np.asarray(dwr), **TOL)
+
+
+def test_matmul_rs_fwd_bwd_parity():
+    mesh = _mesh()
+    r = np.random.RandomState(1)
+    x = jnp.asarray(r.randn(16, 4, 96), jnp.float32)   # k sharded over mp
+    w = jnp.asarray(r.randn(96, 24), jnp.float32)
+    g = jnp.asarray(r.randn(16, 4, 24), jnp.float32)   # seq-sharded grad
+
+    def fused(xs, wv, gv):
+        out, vjp = jax.vjp(lambda a, b: cm.matmul_rs(a, b, AXES, 0),
+                           xs, wv)
+        return (out,) + vjp(gv)
+
+    def ref(xs, wv, gv):
+        out, vjp = jax.vjp(
+            lambda a, b: lax.psum_scatter(a @ b, "mp",
+                                          scatter_dimension=0, tiled=True),
+            xs, wv)
+        return (out,) + vjp(gv)
+
+    specs = (P(None, None, "mp"), P("mp"), P("mp"))
+    outs = (P("mp"), P(None, None, "mp"), P("mp"))
+    of, dxf, dwf = _sm(fused, mesh, specs, outs)(x, w, g)
+    orr, dxr, dwr = _sm(ref, mesh, specs, outs)(x, w, g)
+    np.testing.assert_allclose(np.asarray(of), np.asarray(orr), **TOL)
+    np.testing.assert_allclose(np.asarray(dxf), np.asarray(dxr), **TOL)
+    np.testing.assert_allclose(np.asarray(dwf), np.asarray(dwr), **TOL)
+
+
+def test_matmul_allreduce_megatron_pairing():
+    """Fused forward == psum(x @ w); backward keeps the identity-bwd
+    pairing (local GEMMs) of _mp_allreduce."""
+    mesh = _mesh()
+    r = np.random.RandomState(2)
+    x = jnp.asarray(r.randn(16, 96), jnp.float32)
+    w = jnp.asarray(r.randn(96, 24), jnp.float32)
+    g = jnp.asarray(r.randn(16, 24), jnp.float32)
+
+    def fused(xs, wv, gv):
+        out, vjp = jax.vjp(
+            lambda a, b: cm.matmul_allreduce(a, b, AXES, 0), xs, wv)
+        return (out,) + vjp(gv)
+
+    specs = (P(None, "mp"), P("mp"), P())
+    outs = (P(None), P(None, "mp"), P("mp"))
+    of, dxf, dwf = _sm(fused, mesh, specs, outs)(x, w, g)
+    np.testing.assert_allclose(np.asarray(of), np.asarray(x @ w), **TOL)
+    np.testing.assert_allclose(np.asarray(dxf), np.asarray(g @ w.T), **TOL)
+    np.testing.assert_allclose(np.asarray(dwf), np.asarray(x.T @ g), **TOL)
+
+
+def test_matmul_gather_parity():
+    mesh = _mesh()
+    r = np.random.RandomState(3)
+    x = jnp.asarray(r.randn(16, 12), jnp.float32)
+    w = jnp.asarray(r.randn(12, 48), jnp.float32)   # cols sharded
+    g = jnp.asarray(r.randn(16, 48), jnp.float32)
+
+    def fused(xs, wv, gv):
+        out, vjp = jax.vjp(
+            lambda a, b: cm.matmul_gather(a, b, AXES, 8), xs, wv)
+        return (out,) + vjp(gv)
+
+    def ref(xs, wv, gv):
+        # the unfused layer path: local matmul + _c_concat's custom
+        # slice-backward pairing (NOT all_gather's true transpose, which
+        # psums — the Megatron convention the layers rely on)
+        from paddle_tpu.distributed.fleet.layers.mpu.mp_ops import \
+            allgather_slice_bwd
+
+        out, vjp = jax.vjp(
+            lambda a, b: allgather_slice_bwd(a @ b, AXES, -1), xs, wv)
+        return (out,) + vjp(gv)
+
+    specs = (P(), P(None, "mp"), P(None, None))
+    outs = (P(None, None), P(), P(None, "mp"))
+    of, dxf, dwf = _sm(fused, mesh, specs, outs)(x, w, g)
+    orr, dxr, dwr = _sm(ref, mesh, specs, outs)(x, w, g)
+    np.testing.assert_allclose(np.asarray(of), np.asarray(orr), **TOL)
+    np.testing.assert_allclose(np.asarray(dxf), np.asarray(dxr), **TOL)
+    np.testing.assert_allclose(np.asarray(dwf), np.asarray(dwr), **TOL)
+
+
+@pytest.mark.parametrize("p", [2, 3, 5, 8])
+def test_ring_sizes(p):
+    """Odd and even ring sizes place every chunk exactly once."""
+    mesh = _mesh(p)
+    r = np.random.RandomState(p)
+    x = jnp.asarray(r.randn(4 * p, 6), jnp.float32)
+    w = jnp.asarray(r.randn(6, 10), jnp.float32)
+
+    def ag(xs, wv):
+        return cm.ag_matmul(xs, wv, AXES, 0)
+
+    def rs(xs, wv):
+        return cm.matmul_rs(xs, wv, AXES, 0)
+
+    out = _sm(ag, mesh, (P("mp"), P()), P(None))(x, w)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(x @ w), **TOL)
+    out = _sm(rs, mesh, (P(), P()), P("mp"))(x, w)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(x @ w) * p,
+                               **TOL)
+
+
+# -- end-to-end loss parity: knob on vs off vs dense golden ---------------
+
+class _TPBlock(paddle.nn.Layer):
+    """Plain TP pair: column (gather side) + row (reduce side)."""
+
+    def __init__(self, d=16, h=32):
+        super().__init__()
+        from paddle_tpu.distributed.fleet.layers import mpu
+
+        self.fc1 = mpu.ColumnParallelLinear(d, h, gather_output=True)
+        self.fc2 = mpu.RowParallelLinear(h, d, input_is_parallel=False)
+
+    def forward(self, x):
+        return self.fc2(paddle.nn.functional.relu(self.fc1(x)))
+
+
+class _SPBlock(paddle.nn.Layer):
+    """SP pair on [b, s, d]: seq all-gather linear + seq reduce-scatter
+    linear."""
+
+    def __init__(self, d=16, h=32):
+        super().__init__()
+        self.fc1 = spu.ColumnSequenceParallelLinear(
+            d, h, gather_output=False, seq_axis=1)
+        self.fc2 = spu.RowSequenceParallelLinear(
+            h, d, input_is_parallel=True, seq_axis=1)
+
+    def forward(self, x):
+        x = spu.scatter(x, axis=1)
+        x = self.fc2(paddle.nn.functional.relu(self.fc1(x)))
+        return spu.gather(x, axis=1)
+
+
+class _Dense(paddle.nn.Layer):
+    def __init__(self, d=16, h=32):
+        super().__init__()
+        self.fc1 = paddle.nn.Linear(d, h)
+        self.fc2 = paddle.nn.Linear(h, d)
+
+    def forward(self, x):
+        return self.fc2(paddle.nn.functional.relu(self.fc1(x)))
+
+
+def _loss_fn(model, batch):
+    out = model(batch["x"])
+    return paddle.mean((out - batch["y"]) ** 2)
+
+
+def _train(block_cls, x, y, overlap, steps=3, seed=7):
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {
+        "dp_degree": 2, "mp_degree": 4, "pp_degree": 1,
+        "mp_configs": {"mp_async_allreduce": overlap}}
+    hcg = fleet.init(is_collective=True, strategy=strategy)
+    paddle.seed(seed)
+    model = block_cls()
+    opt = paddle.optimizer.Adam(learning_rate=0.1,
+                                parameters=model.parameters())
+    eng = ParallelEngine(model, opt, hcg.mesh)
+    step = eng.train_step(_loss_fn)
+    losses = [float(step({"x": paddle.to_tensor(x),
+                          "y": paddle.to_tensor(y)}))
+              for _ in range(steps)]
+    params = {n: np.asarray(p._value)
+              for n, p in model.named_parameters()}
+    return losses, params
+
+
+@pytest.mark.parametrize("block_cls", [_TPBlock, _SPBlock],
+                         ids=["tp", "sp"])
+def test_linear_loss_parity_knob_on_vs_off(block_cls):
+    np.random.seed(0)
+    shape = (4, 16) if block_cls is _TPBlock else (4, 8, 16)
+    x = np.random.randn(*shape).astype("float32")
+    y = np.random.randn(*shape).astype("float32")
+
+    l_off, p_off = _train(block_cls, x, y, overlap=False)
+    l_on, p_on = _train(block_cls, x, y, overlap=True)
+    np.testing.assert_allclose(l_on, l_off, rtol=1e-5, atol=1e-6)
+    for n in p_off:
+        np.testing.assert_allclose(p_on[n], p_off[n], rtol=1e-4,
+                                   atol=1e-5, err_msg=n)
+
+    # and both match the dense single-device golden
+    paddle.seed(7)
+    golden = _Dense()
+    opt = paddle.optimizer.Adam(learning_rate=0.1,
+                                parameters=golden.parameters())
+    g_losses = []
+    for _ in range(3):
+        loss = _loss_fn(golden, {"x": paddle.to_tensor(x),
+                                 "y": paddle.to_tensor(y)})
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        g_losses.append(float(loss))
+    np.testing.assert_allclose(l_on, g_losses, rtol=1e-4, atol=1e-6)
+
+
+def test_knob_defaults_off_and_plumbs():
+    strategy = fleet.DistributedStrategy()
+    assert strategy.hybrid_configs["mp_configs"]["mp_async_allreduce"] \
+        is False
+    strategy.hybrid_configs = {"mp_configs": {"mp_async_allreduce": True}}
+    assert strategy.hybrid_configs["mp_configs"]["mp_async_allreduce"]
+    fleet.init(is_collective=True, strategy=strategy)
+    assert cm.overlap_enabled()
+    # outside an SPMD region the fused path must not engage
+    assert not cm.overlap_available(("mp",)) or False  # in_spmd gate
+
+    # a second strategy object must not inherit the first one's knob
+    assert fleet.DistributedStrategy() \
+        .hybrid_configs["mp_configs"]["mp_async_allreduce"] is False
+
+
+def test_engine_compile_stats_flat_with_overlap():
+    """ParallelEngine's CompileStats: one compile per (shape, spec)
+    signature, cache hits after — and the overlap path must not force
+    steady-state recompiles."""
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {
+        "dp_degree": 2, "mp_degree": 4, "pp_degree": 1,
+        "mp_configs": {"mp_async_allreduce": True}}
+    hcg = fleet.init(is_collective=True, strategy=strategy)
+    paddle.seed(1)
+    model = _TPBlock()
+    opt = paddle.optimizer.Adam(learning_rate=0.1,
+                                parameters=model.parameters())
+    eng = ParallelEngine(model, opt, hcg.mesh)
+    step = eng.train_step(_loss_fn)
+    np.random.seed(2)
+    x = np.random.randn(4, 16).astype("float32")
+    y = np.random.randn(4, 16).astype("float32")
+    batch = {"x": paddle.to_tensor(x), "y": paddle.to_tensor(y)}
+    step(batch)
+    assert eng.stats.compiles == 1 and eng.stats.cache_hits == 0
+    for _ in range(3):
+        step(batch)
+    assert eng.stats.compiles == 1          # steady state: no recompiles
+    assert eng.stats.cache_hits == 3
+    d = eng.stats.as_dict()
+    assert d["compiles"] == 1 and d["cache_hits"] == 3
+
+    # eval steps key separately but are also compile-stable
+    ev = eng.eval_step(lambda m, b: m(b["x"]))
+    ev({"x": paddle.to_tensor(x)})
+    ev({"x": paddle.to_tensor(x)})
+    assert eng.stats.compiles == 2 and eng.stats.cache_hits == 4
+
+
+def test_overlap_eager_fallback():
+    """Knob on, but eager (no SPMD region): layers run the unfused path
+    and still produce the single-device result."""
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {
+        "dp_degree": 1, "mp_degree": 8, "pp_degree": 1,
+        "mp_configs": {"mp_async_allreduce": True}}
+    fleet.init(is_collective=True, strategy=strategy)
+    paddle.seed(11)
+    block = _TPBlock()
+    x = paddle.to_tensor(np.random.RandomState(4)
+                         .randn(4, 16).astype("float32"))
+    out = block(x)                      # eager: identity collectives
+    assert tuple(out.shape) == (4, 16)

@@ -40,6 +40,49 @@ def test_llama_gqa_heads():
     assert out.shape == [2, 8, cfg.vocab_size]
 
 
+def test_gqa_broadcast_matches_repeated_kv():
+    """The no-copy GQA paths (broadcast q over [KV, rep]) match the
+    materialized repeat_interleave reference exactly — fwd + grad for
+    the training attention, fwd for the ragged decode cache path."""
+    import jax.numpy as jnp
+
+    import jax
+    from paddle_tpu.ops.attention import flash_attention
+    from paddle_tpu.ops.pallas.decode_attention import _dense_ragged
+
+    r = np.random.RandomState(3)
+    B, S, H, KV, D = 2, 8, 4, 2, 16
+    q = jnp.asarray(r.randn(B, S, H, D), jnp.float32)
+    k = jnp.asarray(r.randn(B, S, KV, D), jnp.float32)
+    v = jnp.asarray(r.randn(B, S, KV, D), jnp.float32)
+
+    def rep(t):
+        return jnp.repeat(t, H // KV, axis=2)
+
+    fwd = flash_attention.raw(q, k, v, causal=True)
+    ref = flash_attention.raw(q, rep(k), rep(v), causal=True)
+    np.testing.assert_allclose(np.asarray(fwd), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+    gk = jax.grad(lambda kk: flash_attention.raw(
+        q, kk, v, causal=True).sum())(k)
+    gk_ref = jax.grad(lambda kk: flash_attention.raw(
+        q, rep(kk), rep(v), causal=True).sum())(k)
+    np.testing.assert_allclose(np.asarray(gk), np.asarray(gk_ref),
+                               rtol=1e-5, atol=1e-5)
+
+    # decode cache path: head-major [B, KV, M, D] caches, ragged offsets
+    M = 32
+    qd = jnp.asarray(r.randn(B, 1, H, D), jnp.float32)
+    kc = jnp.asarray(r.randn(B, KV, M, D), jnp.float32)
+    vc = jnp.asarray(r.randn(B, KV, M, D), jnp.float32)
+    lens = jnp.asarray([20, 7], jnp.int32)
+    out = _dense_ragged(qd, kc, vc, lens)
+    ref = _dense_ragged(qd, jnp.repeat(kc, H // KV, axis=1),
+                        jnp.repeat(vc, H // KV, axis=1), lens)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
 def test_llama_tp_engine_parity():
     """mp=2 tensor-parallel Llama (GQA kv=2 shards 1 kv head/rank)
     matches single-device training."""

@@ -30,7 +30,10 @@ class TestConvergence:
         assert _fit(optimizer.SGD, learning_rate=0.1) < 1e-2
 
     def test_momentum(self):
-        assert _fit(optimizer.Momentum, learning_rate=0.05) < 1e-2
+        # 60 steps lands at 0.0112 — a hair ABOVE the 1e-2 bar, so the
+        # test's outcome used to hinge on unrelated cross-module state;
+        # 80 steps converges to ~1e-3, deterministic in any test order
+        assert _fit(optimizer.Momentum, steps=80, learning_rate=0.05) < 1e-2
 
     def test_adam(self):
         assert _fit(optimizer.Adam, steps=150, learning_rate=0.1) < 1e-2

@@ -177,3 +177,91 @@ class TestProgramRecordingGate:
             with pytest.raises(Exception, match="to_static"):
                 snn.while_loop(lambda i: i < 2, lambda i: (i + 1,),
                                [[x]])
+
+
+class TestCondGrad:
+    """cond IS differentiable (lax.cond supports reverse mode; the
+    reference's cond does too): gradients flow to tensors the branch
+    closures capture, under the eager tape and under to_static."""
+
+    def test_taken_branch_grad(self):
+        w = paddle.to_tensor(np.array([2.0, 3.0], "float32"))
+        w.stop_gradient = False
+        x = paddle.to_tensor(np.array([1.0, 4.0], "float32"))
+        out = snn.cond(paddle.to_tensor(True),
+                       lambda: (w * x).sum(), lambda: (w - x).sum())
+        assert not out.stop_gradient
+        out.backward()
+        assert (_np(w.grad) == [1.0, 4.0]).all()
+
+    def test_untaken_branch_grad(self):
+        w = paddle.to_tensor(np.array([2.0, 3.0], "float32"))
+        w.stop_gradient = False
+        x = paddle.to_tensor(np.array([1.0, 4.0], "float32"))
+        out = snn.cond(paddle.to_tensor(False),
+                       lambda: (w * x).sum(), lambda: (w * w).sum())
+        out.backward()
+        assert (_np(w.grad) == [4.0, 6.0]).all()
+
+    def test_traced_predicate_grad(self):
+        z = paddle.to_tensor(np.array([1.0, 2.0], "float32"))
+        z.stop_gradient = False
+        loss = snn.cond(z.sum() > 0.0,
+                        lambda: (z * z).sum(), lambda: z.sum())
+        loss.backward()
+        assert (_np(z.grad) == [2.0, 4.0]).all()
+
+    def test_branches_capturing_different_tensors(self):
+        a = paddle.to_tensor(np.array(3.0, "float32"))
+        b = paddle.to_tensor(np.array(5.0, "float32"))
+        a.stop_gradient = False
+        b.stop_gradient = False
+        out = snn.cond(paddle.to_tensor(True),
+                       lambda: a * 2.0, lambda: b * 7.0)
+        out.backward()
+        # taken branch grad flows; untaken branch's capture gets zero
+        assert float(_np(a.grad)) == 2.0
+        assert b.grad is None or float(_np(b.grad)) == 0.0
+
+    def test_no_grad_still_works(self):
+        with paddle.no_grad():
+            x = paddle.to_tensor(np.array([1.0], "float32"))
+            out = snn.cond(paddle.to_tensor(True),
+                           lambda: x * 2.0, lambda: x * 3.0)
+        assert out.stop_gradient
+        assert (_np(out) == [2.0]).all()
+
+    def test_chained_into_tape(self):
+        """cond output feeds further tape ops; grads route through."""
+        w = paddle.to_tensor(np.array([1.0, -2.0], "float32"))
+        w.stop_gradient = False
+        h = w * 3.0
+        out = snn.cond(paddle.to_tensor(True),
+                       lambda: h * h, lambda: h)
+        loss = out.sum()
+        loss.backward()
+        # d/dw (3w)^2 = 18w
+        assert (_np(w.grad) == [18.0, -36.0]).all()
+
+
+class TestWhileLoopNonDiff:
+    def test_grad_loop_var_raises_loudly(self):
+        v = paddle.to_tensor(np.array(0.0, "float32"))
+        v.stop_gradient = False
+        with pytest.raises(Exception, match="not differentiable"):
+            snn.while_loop(lambda a: a < 3.0, lambda a: (a + 1.0,), [v])
+
+    def test_detached_vars_still_run(self):
+        v = paddle.to_tensor(np.array(0.0, "float32"))
+        v.stop_gradient = False
+        (out,) = snn.while_loop(lambda a: a < 3.0, lambda a: (a + 1.0,),
+                                [v.detach()])
+        assert float(_np(out)) == 3.0
+
+    def test_no_grad_context_still_runs(self):
+        v = paddle.to_tensor(np.array(0.0, "float32"))
+        v.stop_gradient = False
+        with paddle.no_grad():
+            (out,) = snn.while_loop(lambda a: a < 2.0,
+                                    lambda a: (a + 1.0,), [v])
+        assert float(_np(out)) == 2.0

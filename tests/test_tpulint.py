@@ -470,3 +470,66 @@ class TestCLI:
         assert r.returncode == 0
         r = _cli("--list-rules")
         assert r.returncode == 0 and "recompile-hazard" in r.stdout
+
+    def test_prune_baseline_drops_unmatched(self, tmp_path):
+        """--prune-baseline drops entries whose fingerprints no longer
+        match any linted file (fixed violations, deleted files) and
+        keeps live + out-of-scope-but-existing ones."""
+        tree = tmp_path / "pkg"
+        tree.mkdir()
+        bad = tree / "bad.py"
+        bad.write_text("def api(x, knob=False):\n    return x\n")
+        other = tmp_path / "outside.py"
+        other.write_text("def api2(y, flag=False):\n    return y\n")
+        baseline = tmp_path / "baseline.json"
+        entries = [
+            # live: matches bad.py's unused-knob finding
+            {"rule": "unused-knob", "path": "pkg/bad.py", "symbol": "api",
+             "line_text": "def api(x, knob=False):"},
+            # fixed: fingerprint matches nothing anymore
+            {"rule": "unused-knob", "path": "pkg/bad.py", "symbol": "gone",
+             "line_text": "def gone(x, dead_knob=False):"},
+            # deleted file: can never match again
+            {"rule": "traced-bool", "path": "pkg/removed.py",
+             "symbol": "f", "line_text": "if x:"},
+            # out of linted scope but still on disk: kept
+            {"rule": "unused-knob", "path": "outside.py", "symbol": "api2",
+             "line_text": "def api2(y, flag=False):"},
+        ]
+        baseline.write_text(json.dumps({"findings": entries}))
+
+        r = _cli("pkg", "--baseline", str(baseline), "--root",
+                 str(tmp_path), cwd=tmp_path)
+        assert r.returncode == 0, r.stdout + r.stderr  # all baselined
+
+        r = _cli("pkg", "--baseline", str(baseline), "--prune-baseline",
+                 "--root", str(tmp_path), cwd=tmp_path)
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert "pruned 2" in r.stdout
+        kept = json.loads(baseline.read_text())["findings"]
+        assert {(e["path"], e["symbol"]) for e in kept} == {
+            ("pkg/bad.py", "api"), ("outside.py", "api2")}
+
+        # pruned baseline still matches: clean run, zero stale
+        r = _cli("pkg", "--baseline", str(baseline), "--root",
+                 str(tmp_path), "--json", cwd=tmp_path)
+        assert r.returncode == 0
+        report = json.loads(r.stdout)
+        assert report["new"] == 0 and report["baseline_stale"] == []
+
+    def test_prune_baseline_noop_on_live_tree(self, tmp_path):
+        """Pruning the checked-in baseline against the real tree drops
+        nothing (every entry is live) and leaves the gate green."""
+        import shutil
+
+        from tools.tpulint.cli import DEFAULT_BASELINE
+
+        copy = tmp_path / "baseline.json"
+        shutil.copy(DEFAULT_BASELINE, copy)
+        r = _cli("paddle_tpu/", "--baseline", str(copy),
+                 "--prune-baseline")
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert "pruned 0" in r.stdout
+        before = json.loads(DEFAULT_BASELINE.read_text())["findings"]
+        after = json.loads(copy.read_text())["findings"]
+        assert len(before) == len(after)

@@ -13,8 +13,9 @@ import sys
 from pathlib import Path
 from typing import List, Optional
 
-from .core import (Finding, iter_py_files, lint_paths, load_baseline,
-                   relpath_for, split_by_baseline, write_baseline)
+from .core import (Finding, baseline_entry, iter_py_files, lint_paths,
+                   load_baseline, relpath_for, split_by_baseline,
+                   write_baseline, write_baseline_entries)
 from .rules import ALL_RULES, select_rules
 
 DEFAULT_BASELINE = Path(__file__).resolve().parent / "baseline.json"
@@ -40,6 +41,10 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--write-baseline", action="store_true",
                     help="rewrite the baseline from current findings "
                          "and exit 0")
+    ap.add_argument("--prune-baseline", action="store_true",
+                    help="drop baseline entries whose fingerprints no "
+                         "longer match any linted file (fixed/moved/"
+                         "deleted), write the shrunk baseline, exit 0")
     ap.add_argument("--list-rules", action="store_true",
                     help="print the rule catalog and exit")
     ap.add_argument("--root", type=Path, default=None,
@@ -80,6 +85,27 @@ def main(argv: Optional[List[str]] = None) -> int:
         write_baseline(baseline_path, findings)
         print(f"tpulint: wrote {len(findings)} baseline entries to "
               f"{baseline_path}")
+        return 0
+
+    if args.prune_baseline:
+        baseline = load_baseline(baseline_path) \
+            if baseline_path.exists() else []
+        root = (args.root or Path.cwd()).resolve()
+        linted = {relpath_for(p, root) for p in iter_py_files(paths)}
+        in_scope = [e for e in baseline if e["path"] in linted]
+        out_scope = [e for e in baseline if e["path"] not in linted]
+        # in-scope entries survive only if a current finding still
+        # matches their fingerprint; out-of-scope entries survive only
+        # while their file exists (an entry for a deleted file can
+        # never match again)
+        _, matched, stale = split_by_baseline(findings, in_scope)
+        kept_out = [e for e in out_scope if (root / e["path"]).is_file()]
+        kept = [baseline_entry(f) for f in matched] + kept_out
+        dropped = len(baseline) - len(kept)
+        write_baseline_entries(baseline_path, kept)
+        print(f"tpulint: pruned {dropped} stale baseline entr"
+              f"{'y' if dropped == 1 else 'ies'} "
+              f"({len(baseline)} -> {len(kept)}) in {baseline_path}")
         return 0
 
     baseline = []
