@@ -50,6 +50,36 @@ def _emit(payload):
     print(json.dumps(payload), flush=True)
 
 
+def _telemetry_section():
+    """Compact snapshot of the unified observability registry
+    (paddle_tpu/observability) — bench lines carry the SAME metrics a
+    live scrape would see: histograms as count/p50/p99, counters and
+    gauges as values. Each bench runs in its own process, so the
+    registry holds exactly that bench's run."""
+    try:
+        from paddle_tpu.observability import get_registry
+
+        snap = get_registry().snapshot()
+    except Exception:
+        return {}
+    out = {}
+    for name, entry in sorted(snap["metrics"].items()):
+        short = name.replace("paddle_tpu_", "", 1)
+        for row in entry["series"]:
+            lbl = ",".join(f"{k}={v}"
+                           for k, v in sorted(row["labels"].items()))
+            key = short + (f"{{{lbl}}}" if lbl else "")
+            if entry["type"] == "histogram":
+                if row["count"]:
+                    out[key] = {"count": row["count"],
+                                "p50": round(row["p50"], 6),
+                                "p99": round(row["p99"], 6)}
+            else:
+                v = row["value"]
+                out[key] = round(v, 6) if isinstance(v, float) else v
+    return out
+
+
 # ---------------------------------------------------------------------------
 # 1. ResNet-50 (BASELINE row 1)
 # ---------------------------------------------------------------------------
@@ -368,6 +398,14 @@ def bench_serving_mixed(on_tpu, dev):
         seq_dt = max(time.perf_counter() - t0, 1e-4)
         seq_tok_s = len(stream) * n_new / seq_dt
 
+        # the latency percentiles come from the SAME registry a live
+        # scrape would read (ServingEngine's TTFT/TPOT histograms)
+        snap = eng.metrics_snapshot()["metrics"]
+
+        def _hist(name, q):
+            rows = snap[name]["series"]
+            return round(rows[0][q], 6) if rows else 0.0
+
         _emit({
             "metric": "serving_mixed_traffic_tokens_per_sec" if on_tpu
             else "serving_smoke_mixed_traffic_tokens_per_sec",
@@ -376,11 +414,16 @@ def bench_serving_mixed(on_tpu, dev):
             # the gate: continuous batching must beat sequential serving
             "vs_baseline": round(tok_s / seq_tok_s, 4),
             "sequential_tokens_per_sec": round(seq_tok_s, 2),
+            "ttft_p50_s": _hist("paddle_tpu_serving_ttft_seconds", "p50"),
+            "ttft_p99_s": _hist("paddle_tpu_serving_ttft_seconds", "p99"),
+            "tpot_p50_s": _hist("paddle_tpu_serving_tpot_seconds", "p50"),
+            "tpot_p99_s": _hist("paddle_tpu_serving_tpot_seconds", "p99"),
             "compiles": eng.stats.compiles,
             "cache_hits": eng.stats.cache_hits,
             "recompiles_after_warmup": eng.stats.compiles - compiles_warm,
             "batch": B, "page_size": page, "decode_chunk": chunk,
             "requests": len(stream), "tokens": n_tok,
+            "telemetry": _telemetry_section(),
             "device": str(getattr(dev, "device_kind", dev.platform)),
         })
     finally:
@@ -473,6 +516,7 @@ def bench_gpt13b_hybrid(on_tpu, dev):
         "compiles": stats.compiles,
         "cache_hits": stats.cache_hits,
         "recompiles_after_warmup": stats.compiles - compiles_warm,
+        "telemetry": _telemetry_section(),
         "device": str(getattr(dev, "device_kind", dev.platform)),
     })
 
@@ -717,6 +761,7 @@ def bench_gpt(on_tpu, dev):
             "batch": B,
             "device": str(getattr(dev, "device_kind", dev.platform)),
             "params": n_params,
+            "telemetry": _telemetry_section(),
         })
     else:
         _emit({
@@ -724,6 +769,7 @@ def bench_gpt(on_tpu, dev):
             "value": round(tok_s, 2),
             "unit": "tokens/s",
             "vs_baseline": 0.0,
+            "telemetry": _telemetry_section(),
         })
 
 

@@ -42,6 +42,7 @@ from . import metric  # noqa: F401
 from . import distributed  # noqa: F401
 from . import hapi  # noqa: F401
 from . import profiler  # noqa: F401
+from . import observability  # noqa: F401
 from . import static  # noqa: F401
 from . import distribution  # noqa: F401
 from . import quantization  # noqa: F401

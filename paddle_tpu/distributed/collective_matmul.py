@@ -116,7 +116,40 @@ def _perms(p):
     return up, dn
 
 
+# Semantic trace scopes (observability.annotate): each ring is named in
+# the XLA metadata, so a Perfetto/TensorBoard device trace shows e.g.
+# `ag_matmul_ring` spanning the ppermute+GEMM ticks instead of a soup
+# of anonymous dynamic-update-slices — the first thing to look at when
+# asking "which collective ate the step".
 def _ag_matmul_impl(x, w, axes, axis):
+    from ..observability import annotate as _annotate
+
+    with _annotate("ag_matmul_ring"):
+        return _ag_matmul_body(x, w, axes, axis)
+
+
+def _matmul_rs_impl(x, w, axes, axis):
+    from ..observability import annotate as _annotate
+
+    with _annotate("matmul_rs_ring"):
+        return _matmul_rs_body(x, w, axes, axis)
+
+
+def _matmul_allreduce_impl(x, w, axes, axis):
+    from ..observability import annotate as _annotate
+
+    with _annotate("matmul_allreduce_ring"):
+        return _matmul_allreduce_body(x, w, axes, axis)
+
+
+def _matmul_gather_impl(x, w, axes, nchunks):
+    from ..observability import annotate as _annotate
+
+    with _annotate("matmul_gather_ring"):
+        return _matmul_gather_body(x, w, axes, nchunks)
+
+
+def _ag_matmul_body(x, w, axes, axis):
     """all_gather(x, axis, tiled) @ w as a bidirectional ppermute ring.
 
     Each tick issues the next shard's permutes FIRST, then matmuls the
@@ -151,7 +184,7 @@ def _ag_matmul_impl(x, w, axes, axis):
     return out
 
 
-def _matmul_rs_impl(x, w, axes, axis):
+def _matmul_rs_body(x, w, axes, axis):
     """psum_scatter(x @ w, axis, tiled) as a ring of partial-sum shifts.
 
     The accumulator destined for rank d is created at rank d+1 and
@@ -247,8 +280,8 @@ def _matmul_rs_bwd(axes, axis, res, g):
 matmul_rs.defvjp(_matmul_rs_fwd, _matmul_rs_bwd)
 
 
-def _matmul_allreduce_impl(x, w, axes, axis):
-    out = _matmul_rs_impl(x, w, axes, axis)
+def _matmul_allreduce_body(x, w, axes, axis):
+    out = _matmul_rs_body(x, w, axes, axis)
     return lax.all_gather(out, axes, axis=axis, tiled=True)
 
 
@@ -273,7 +306,7 @@ def _matmul_ar_bwd(axes, axis, res, g):
 matmul_allreduce.defvjp(_matmul_ar_fwd, _matmul_ar_bwd)
 
 
-def _matmul_gather_impl(x, w, axes, nchunks):
+def _matmul_gather_body(x, w, axes, nchunks):
     rows = x.shape[0]
     c = rows // nchunks
     parts = []

@@ -23,6 +23,7 @@ jit.to_static + PIR interpreter (SURVEY.md §3.4).
 from __future__ import annotations
 
 import contextlib
+import time
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
 import jax
@@ -35,6 +36,8 @@ from . import collective as C
 from ..autograd import engine as _ad
 from ..core import rng as _rng
 from ..core.compile_stats import CompileStats
+from ..observability import flops as _flops
+from ..observability.catalog import train_metrics as _train_metrics
 from ..tensor import Tensor
 
 try:
@@ -160,6 +163,21 @@ def _mesh_data_axes(mesh: Mesh):
                  if a in mesh.axis_names and mesh.shape[a] > 1)
 
 
+def _batch_tokens(leaf_vals) -> int:
+    """Tokens one (host-local) batch carries: the largest integer leaf
+    (token ids [B, S] beat labels [B]); falls back to the leading dim of
+    the first leaf (samples) for non-token workloads like vision."""
+    tok = 0
+    for v in leaf_vals:
+        if getattr(v, "ndim", 0) >= 1 and \
+                jnp.issubdtype(v.dtype, jnp.integer):
+            tok = max(tok, int(np.prod(v.shape)))
+    if tok == 0 and leaf_vals:
+        v0 = leaf_vals[0]
+        tok = int(v0.shape[0]) if getattr(v0, "ndim", 0) >= 1 else 1
+    return tok
+
+
 def _multiprocess(mesh: Mesh) -> bool:
     return jax.process_count() > 1
 
@@ -281,6 +299,15 @@ class ParallelEngine:
         # that force recompiles (e.g. an overlap path keyed on a traced
         # shape) surface here and on the bench JSON lines
         self.stats = CompileStats()
+        # unified telemetry (observability/): per-step wall time, loss,
+        # grad-norm, tokens/s, MFU, device memory, compile counters —
+        # all host-side on fetched scalars, never inside the trace
+        self._metrics = _train_metrics()
+        self._n_params_cfg = _flops.params_from_config(
+            getattr(model, "config", None))
+        self._stats_reported = (0, 0)    # (compiles, cache_hits) synced
+        self._pending_scalars = None     # (loss_dev, gnorm_dev) lazy
+        self._prev_step_entry = None
         self._zero = _ZeroPlan(mesh, self.trainable, optimizer)
         # LazyGuard-built params materialize straight into their (zero3-
         # aware) storage sharding: O(shard) bytes per process, no full-
@@ -497,6 +524,24 @@ class ParallelEngine:
                     # bias-correction step count advances only on applied
                     # steps (the reference skips optimizer.step entirely)
                     stepc = tstep_v + (1 - found.astype(jnp.int32))
+                # global grad-norm (telemetry): local sum-of-squares,
+                # psum'd over exactly the axes each grad is sharded on
+                # (spec axes, + the ZeRO axis for scattered shards) so
+                # replicated grads contribute once
+                gsq = jnp.float32(0.0)
+                for p, g in zip(trainable, grads):
+                    loc = jnp.sum(jnp.square(g.astype(jnp.float32)))
+                    axes_set = set(_spec_axes(p))
+                    e = zero.entry(p)
+                    if e is not None:
+                        axes_set.add(zero.axis)
+                    ax = tuple(a for a in axes_set
+                               if a in mesh.axis_names
+                               and mesh.shape[a] > 1)
+                    if ax:
+                        loc = lax.psum(loc, ax)
+                    gsq = gsq + loc
+                gnorm = jnp.sqrt(gsq)
                 new_p, new_s = opt._fused_update(
                     tuple(upd_in), tuple(grads), tuple(svals), lr, stepc)
                 if use_scaler:
@@ -551,7 +596,7 @@ class ParallelEngine:
                                  if mesh.shape[a] > 1)
                 if all_axes:
                     lv = lax.pmean(lv, all_axes)
-            return lv, tuple(out_p), tuple(new_s), out_m, amp_out
+            return lv, gnorm, tuple(out_p), tuple(new_s), out_m, amp_out
 
         def make(batch_treedef, b_specs, mspecs):
             def flat_step(pvals, svals, mvals, batch_leaves, lr, stepc,
@@ -565,12 +610,18 @@ class ParallelEngine:
             amp_ospec = (P(),) * 5 if use_scaler else ()
             in_specs = (pspecs, sspecs, mspecs, tuple(b_specs), P(), P(),
                         P(), amp_ispec)
-            out_specs = (P(), pspecs, sspecs, mspecs, amp_ospec)
+            out_specs = (P(), P(), pspecs, sspecs, mspecs, amp_ospec)
             sharded = _shard_map(flat_step, mesh, in_specs, out_specs)
             return jax.jit(sharded,
                            donate_argnums=(0, 1, 2) if donate else ())
 
         def step(batch):
+            t_entry = time.perf_counter()
+            # previous step's loss/grad-norm scalars are fetched HERE
+            # (one-step lag): the device has certainly finished the
+            # prior step by the next dispatch, so telemetry never adds
+            # a sync on the critical path
+            self._flush_pending_scalars()
             self._check_mesh_epoch()
             leaves, treedef = jax.tree_util.tree_flatten(
                 batch, is_leaf=lambda x: isinstance(x, Tensor))
@@ -582,6 +633,7 @@ class ParallelEngine:
                 b_specs = tuple(
                     P(data_axes) if data_axes and v.ndim > 0 else P()
                     for v in leaf_vals)
+            n_tok = _batch_tokens(leaf_vals)   # host-local batch tokens
             mvals = {i: opt._master_weights[id(p)]
                      for i, p in zip(t_index, trainable)
                      if id(p) in opt._master_weights}
@@ -622,7 +674,7 @@ class ParallelEngine:
                                    for v in amp_in)
                     scaler._dev = amp_in
                     scaler._dev_global = True
-            lv, new_p, new_s, new_m, amp_out = self._compiled[key](
+            lv, gnorm, new_p, new_s, new_m, amp_out = self._compiled[key](
                 pvals, svals, mvals, leaf_vals, lr, stepc, seed, amp_in)
             for p, nv in zip(params, new_p):
                 p._value = nv
@@ -636,9 +688,98 @@ class ParallelEngine:
 
             if isinstance(opt._lr, LRScheduler):
                 opt._lr.step()  # advance the schedule once per train step
+            self._note_step(t_entry, n_tok, lv, gnorm)
             return Tensor(lv, stop_gradient=True)
 
         return step
+
+    # -- telemetry (observability/) -------------------------------------
+    def _flush_pending_scalars(self):
+        """Fetch the PREVIOUS step's loss/grad-norm device scalars into
+        the loss/grad_norm gauges. Called at the next step's entry (and
+        from metrics_snapshot), so the fetch blocks only on work that
+        is already done — telemetry adds no sync to the hot path."""
+        pend = self._pending_scalars
+        if pend is None:
+            return
+        self._pending_scalars = None
+        lv, gnorm = pend
+        try:
+            m = self._metrics
+            m["loss"].set(float(np.asarray(lv)))
+            m["grad_norm"].set(float(np.asarray(gnorm)))
+        except Exception:
+            pass        # a dead device must not take telemetry down
+
+    def _note_step(self, t_entry: float, n_tok: int, lv, gnorm):
+        """Host-side per-step instrumentation on fetched/host values
+        only (never called under tracing)."""
+        now = time.perf_counter()
+        m = self._metrics
+        m["step_seconds"].observe(now - t_entry)
+        m["steps"].inc()
+        m["tokens"].inc(n_tok)
+        # steady-state throughput between step ENTRIES: on an async
+        # backend the dispatch returns early, so the inter-step gap is
+        # the honest per-step wall time once the pipeline fills
+        if self._prev_step_entry is not None:
+            dt = max(t_entry - self._prev_step_entry, 1e-9)
+            tps = n_tok / dt
+            m["tokens_per_sec"].set(tps)
+            n_params = self._n_params_cfg or sum(
+                int(np.prod(p._value.shape)) for p in self.params)
+            dev = next(iter(self.mesh.devices.flat))
+            peak, _ = _flops.peak_flops_per_chip(dev)
+            m["mfu"].set(_flops.mfu(
+                n_params, tps * jax.process_count(), self.mesh.size,
+                peak, config=getattr(self.model, "config", None)))
+        self._prev_step_entry = t_entry
+        self._pending_scalars = (lv, gnorm)
+        # compile-cache counters: report the delta since last step so
+        # the Prometheus counters stay monotonic
+        rc, rh = self._stats_reported
+        if self.stats.compiles > rc:
+            m["compiles"].inc(self.stats.compiles - rc,
+                              site="train_engine")
+        if self.stats.cache_hits > rh:
+            m["cache_hits"].inc(self.stats.cache_hits - rh,
+                                site="train_engine")
+        self._stats_reported = (self.stats.compiles,
+                                self.stats.cache_hits)
+        try:
+            for d in jax.local_devices():
+                ms = d.memory_stats()
+                for k in ("bytes_in_use", "peak_bytes_in_use",
+                          "bytes_limit"):
+                    if ms and k in ms:
+                        m["device_memory"].set(
+                            ms[k], device=str(d.id), stat=k)
+        except Exception:
+            pass        # CPU backends may not expose memory_stats
+        from ..observability import get_registry
+
+        get_registry().snapshot()    # feeds the stall flight-record ring
+
+    def metrics_snapshot(self):
+        """Fetch pending scalars, then return the registry snapshot —
+        the in-process API bench.py emits from."""
+        self._flush_pending_scalars()
+        from ..observability import get_registry
+
+        return get_registry().snapshot()
+
+    def pod_throughput(self) -> Dict[str, float]:
+        """Pod-level tokens/s: every host contributes its local gauge
+        through a cross-host all_gather, so rank 0 can report aggregate
+        throughput. Call BETWEEN steps (it synchronizes all hosts)."""
+        from ..observability import cross_host_sum
+
+        local = self._metrics["tokens_per_sec"].value()
+        total = cross_host_sum(local)
+        self._metrics["pod_tokens_per_sec"].set(total)
+        return {"local_tokens_per_sec": local,
+                "pod_tokens_per_sec": total,
+                "processes": float(jax.process_count())}
 
     def _check_mesh_epoch(self):
         if C.mesh_epoch() != self._mesh_epoch:

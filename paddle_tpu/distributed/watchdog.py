@@ -49,11 +49,13 @@ class CommTaskManager:
         self.error_handling = error_handling
         self.on_timeout = on_timeout
         self.poll = poll_interval
+        self.last_flight_record: Optional[str] = None
         self._tasks = []
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._timed_out: Optional[str] = None
-        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="watchdog-monitor")
         self._thread.start()
 
     def _loop(self):
@@ -66,20 +68,37 @@ class CommTaskManager:
             for t in hung:
                 t.done = True
                 self._timed_out = t.name
+                self._dump_flight_record(t.name)
                 if self.on_timeout:
                     self.on_timeout(t.name)
                 if self.error_handling == "teardown":
                     os.abort()
+
+    def _dump_flight_record(self, name: str):
+        """Before raising/tearing down, persist the stall flight-record
+        (last-N metric snapshots + in-flight named regions + every
+        thread's stack) — the post-mortem the reference dumps from its
+        async-trace task queue (FLAGS_enable_async_trace)."""
+        try:
+            from ..observability import flight as _flight
+
+            self.last_flight_record = _flight.dump(
+                reason=f"watchdog: '{name}' exceeded {self.timeout}s "
+                       "without the device coming back")
+        except Exception:       # the dump must never mask the timeout
+            self.last_flight_record = None
 
     def check(self):
         """Raise if any tracked region has timed out (call between
         steps — the main thread may be past the hung region by then)."""
         if self._timed_out is not None and self.error_handling == "raise":
             name, self._timed_out = self._timed_out, None
+            where = (f"; flight record: {self.last_flight_record}"
+                     if self.last_flight_record else "")
             raise TimeoutError_(
                 f"collective step '{name}' exceeded "
                 f"{self.timeout}s — a peer likely left the mesh "
-                "(reference: NCCLCommTask::IsTimeout)")
+                f"(reference: NCCLCommTask::IsTimeout){where}")
 
     def track(self, name: str = "step", timeout: Optional[float] = None):
         return _Tracker(self, name, timeout or self.timeout)
@@ -99,12 +118,19 @@ class _Tracker:
     def __enter__(self):
         self._task = _Task(self._name,
                            time.monotonic() + self._timeout)
+        # the tracked region shows up in stall flight-records as an
+        # in-flight named region on this thread
+        from ..observability import trace as _trace
+
+        self._region = _trace.annotate(f"watchdog:{self._name}")
+        self._region.__enter__()
         with self._mgr._lock:
             self._mgr._tasks.append(self._task)
         return self
 
     def __exit__(self, *exc):
         self._task.done = True
+        self._region.__exit__(None, None, None)
         self._mgr.check()
         return False
 

@@ -37,6 +37,7 @@ outside the compiled scan.
 """
 from __future__ import annotations
 
+import time
 from collections import deque
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
@@ -47,6 +48,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..core.enforce import enforce
+from ..observability.catalog import serving_metrics as _serving_metrics
 from ..tensor import Tensor
 
 __all__ = ["ServingEngine", "ServingRequest"]
@@ -61,6 +63,11 @@ class ServingRequest:
     max_new_tokens: int
     eos_token_id: Optional[int] = None
     new_tokens: List[int] = field(default_factory=list)
+    # telemetry timestamps (perf_counter domain): TTFT = t_first_token
+    # - t_submit; TPOT = (t_finish - t_first_token) / (n_tokens - 1)
+    t_submit: float = 0.0
+    t_first_token: float = 0.0
+    t_finish: float = 0.0
 
     @property
     def output_ids(self) -> np.ndarray:
@@ -129,6 +136,13 @@ class ServingEngine:
         self.queue: deque = deque()
         self.finished: Dict[int, ServingRequest] = {}
         self.stats = predictor.stats      # shared compile telemetry
+        # unified telemetry: TTFT/TPOT histograms, occupancy gauges,
+        # admission/eviction/backfill counters (observability/catalog).
+        # All host-side — the compiled prefill/decode programs are
+        # untouched, so the compile lattice stays exactly as flat
+        self._metrics = _serving_metrics()
+        self._stats_reported = (self.stats.compiles,
+                                self.stats.cache_hits)
         self.gen = cfg.generation
         self._rng = jax.random.PRNGKey(self.gen.seed)
         self._step_fns: Dict[Any, Any] = {}
@@ -155,7 +169,10 @@ class ServingEngine:
                 f"the pool only has {self.P - 1}; raise pool_pages")
         rid = self._next_rid
         self._next_rid += 1
-        self.queue.append(ServingRequest(rid, ids, n_new, eos))
+        self.queue.append(ServingRequest(rid, ids, n_new, eos,
+                                         t_submit=time.perf_counter()))
+        self._metrics["requests"].inc(event="submitted")
+        self._metrics["queue_depth"].set(len(self.queue))
         return rid
 
     def _pages_needed(self, L: int, n_new: int) -> int:
@@ -177,10 +194,18 @@ class ServingEngine:
                 return                    # head-of-line waits for evictions
             self.queue.popleft()
             b = free[0]
+            # a backfill is an admission that joins rows mid-decode
+            # (the continuous-batching event; a cold admit is not one)
+            backfill = self.num_active > 0
             pages = [self._free_pages.pop() for _ in range(need)]
             self.tables[b, :] = self.trash
             self.tables[b, :need] = pages
             self.slots[b] = _Slot(req, pages)
+            m = self._metrics
+            m["requests"].inc(event="admitted")
+            if backfill:
+                m["requests"].inc(event="backfilled")
+            m["queue_depth"].set(len(self.queue))
             self._prefill(b)
 
     def _prefill(self, b: int):
@@ -188,6 +213,7 @@ class ServingEngine:
 
         slot = self.slots[b]
         req = slot.req
+        t0 = time.perf_counter()
         L = len(req.prompt)
         Sb = min(_bucket(L), self.M)
         ids = np.zeros((1, Sb), np.int32)
@@ -204,6 +230,12 @@ class ServingEngine:
         tok0 = int(np.asarray(_sample(last, sub, self.gen))[0])
         req.new_tokens.append(tok0)
         self.stats.count_tokens(("prefill", Sb, self.P), 1)
+        now = time.perf_counter()
+        req.t_first_token = now
+        m = self._metrics
+        m["prefill_seconds"].observe(now - t0)
+        m["ttft"].observe(now - req.t_submit)
+        m["tokens"].inc(1, phase="prefill")
         if len(req.new_tokens) >= req.max_new_tokens or \
                 (req.eos_token_id is not None and tok0 == req.eos_token_id):
             self._finish(b)
@@ -249,6 +281,7 @@ class ServingEngine:
         active = [b for b in range(self.B) if self.slots[b] is not None]
         if not active:
             return
+        t0 = time.perf_counter()
         tok = np.zeros((self.B,), np.int32)
         pos = np.zeros((self.B,), np.int32)
         for b in active:
@@ -283,6 +316,9 @@ class ServingEngine:
                     break               # rest of the chunk is discarded
         self.stats.count_tokens(("decode", self.B, self.chunk, self.P),
                                 emitted)
+        m = self._metrics
+        m["decode_round_seconds"].observe(time.perf_counter() - t0)
+        m["tokens"].inc(emitted, phase="decode")
 
     def _finish(self, b: int):
         """Evict a finished row: pages back on the free list, table row
@@ -292,6 +328,13 @@ class ServingEngine:
         self.tables[b, :] = self.trash
         self.slots[b] = None
         self.finished[slot.req.rid] = slot.req
+        req = slot.req
+        req.t_finish = time.perf_counter()
+        m = self._metrics
+        m["requests"].inc(event="evicted")
+        if len(req.new_tokens) > 1 and req.t_first_token:
+            m["tpot"].observe((req.t_finish - req.t_first_token)
+                              / (len(req.new_tokens) - 1))
 
     # -- driving ---------------------------------------------------------
     @property
@@ -303,6 +346,37 @@ class ServingEngine:
         pool), then one shared decode round for the in-flight batch."""
         self._admit()
         self._decode_round()
+        self._note_tick()
+
+    def _note_tick(self):
+        """Per-tick occupancy gauges + compile-counter deltas, then one
+        registry snapshot into the stall flight-record ring."""
+        m = self._metrics
+        m["queue_depth"].set(len(self.queue))
+        m["active_slots"].set(self.num_active)
+        m["free_pages"].set(len(self._free_pages))
+        usable = self.P - 1              # trash page is never allocable
+        m["page_occupancy"].set(
+            (usable - len(self._free_pages)) / usable if usable else 0.0)
+        rc, rh = self._stats_reported
+        if self.stats.compiles > rc:
+            m["compiles"].inc(self.stats.compiles - rc, site="serving")
+        if self.stats.cache_hits > rh:
+            m["cache_hits"].inc(self.stats.cache_hits - rh,
+                                site="serving")
+        self._stats_reported = (self.stats.compiles,
+                                self.stats.cache_hits)
+        from ..observability import get_registry
+
+        get_registry().snapshot()
+
+    def metrics_snapshot(self):
+        """Current registry snapshot (TTFT/TPOT histograms, occupancy,
+        counters) — the in-process API bench.py emits from."""
+        self._note_tick()
+        from ..observability import get_registry
+
+        return get_registry().snapshot()
 
     def run(self, max_steps: Optional[int] = None
             ) -> Dict[int, ServingRequest]:

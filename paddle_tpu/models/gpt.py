@@ -36,6 +36,7 @@ from ..distributed.fleet.layers.mpu import (ColumnParallelLinear,
                                             RowParallelLinear,
                                             VocabParallelEmbedding,
                                             parallel_cross_entropy)
+from ..observability import annotate as _annotate
 from ..distributed.fleet.layers.mpu.mp_ops import (_c_identity, mp_active,
                                                    mp_axes)
 from ..tensor import Tensor
@@ -262,12 +263,16 @@ class GPTDecoderLayer(Layer):
 
     def forward(self, x, cache=None):
         if cache is not None:
-            a, new_cache = self.attn(self.ln1(x), cache=cache)
+            with _annotate("attention"):
+                a, new_cache = self.attn(self.ln1(x), cache=cache)
             x = x + a
-            x = x + self.mlp(self.ln2(x))
+            with _annotate("mlp"):
+                x = x + self.mlp(self.ln2(x))
             return x, new_cache
-        x = x + self.attn(self.ln1(x))
-        x = x + self.mlp(self.ln2(x))
+        with _annotate("attention"):
+            x = x + self.attn(self.ln1(x))
+        with _annotate("mlp"):
+            x = x + self.mlp(self.ln2(x))
         return x
 
 
@@ -310,16 +315,23 @@ class GPTModel(Layer):
             ids_local, off = _sep_shard(input_ids._value, axis=1)
             input_ids = Tensor(ids_local, stop_gradient=True)
             position_offset = off
-        x = self.embeddings(input_ids, position_offset)
-        if caches is not None:
-            new_caches = []
-            for layer, cache in zip(self.layers, caches):
-                x, nc = layer(x, cache=cache)
-                new_caches.append(nc)
-            return self.final_ln(x), new_caches
-        for layer in self.layers:
-            x = layer(x)
-        return self.final_ln(x)
+        # named scopes per layer: device traces read `gpt/layer3/mlp`
+        # instead of bare fusions
+        with _annotate("gpt"):
+            with _annotate("embed"):
+                x = self.embeddings(input_ids, position_offset)
+            if caches is not None:
+                new_caches = []
+                for i, (layer, cache) in enumerate(zip(self.layers,
+                                                       caches)):
+                    with _annotate(f"layer{i}"):
+                        x, nc = layer(x, cache=cache)
+                    new_caches.append(nc)
+                return self.final_ln(x), new_caches
+            for i, layer in enumerate(self.layers):
+                with _annotate(f"layer{i}"):
+                    x = layer(x)
+            return self.final_ln(x)
 
 
 class GPTForCausalLM(Layer):

@@ -43,6 +43,7 @@ from ..distributed.fleet.layers.mpu import (ColumnParallelLinear,
                                             RowParallelLinear,
                                             VocabParallelEmbedding,
                                             parallel_cross_entropy)
+from ..observability import annotate as _annotate
 from ..tensor import Tensor
 
 __all__ = ["LlamaConfig", "LlamaModel", "LlamaForCausalLM",
@@ -126,10 +127,14 @@ def _dispatch_kernel(name, supported, kernel, fallback):
     backend allow, warn ONCE PER KERNEL on failure, fall back to XLA."""
     from ..core import flags as _flags
 
+    # the semantic scope names BOTH outcomes (kernel or XLA fallback)
+    # after the kernel, so device traces show e.g. `decode_attention`
+    # over whichever lowering actually ran
     if (_flags._get("use_pallas_kernels", True) and supported()
             and (jax.default_backend() != "cpu")):
         try:
-            return kernel()
+            with _annotate(name):
+                return kernel()
         except (KeyboardInterrupt, SystemExit):
             raise
         except Exception as e:
@@ -140,7 +145,8 @@ def _dispatch_kernel(name, supported, kernel, fallback):
                 warnings.warn(f"{name}: Pallas kernel unavailable "
                               f"({type(e).__name__}: {e}); using dense "
                               "XLA fallback")
-    return fallback()
+    with _annotate(name):
+        return fallback()
 
 
 def _cache_attention(q, k_cache, v_cache, offset, S):
@@ -329,13 +335,17 @@ class LlamaDecoderLayer(Layer):
 
     def forward(self, x, cache=None, offset=0):
         if cache is not None:
-            a, new_cache = self.self_attn(self.input_layernorm(x),
-                                          cache=cache, offset=offset)
+            with _annotate("attention"):
+                a, new_cache = self.self_attn(self.input_layernorm(x),
+                                              cache=cache, offset=offset)
             x = x + a
-            x = x + self.mlp(self.post_attention_layernorm(x))
+            with _annotate("mlp"):
+                x = x + self.mlp(self.post_attention_layernorm(x))
             return x, new_cache
-        x = x + self.self_attn(self.input_layernorm(x))
-        x = x + self.mlp(self.post_attention_layernorm(x))
+        with _annotate("attention"):
+            x = x + self.self_attn(self.input_layernorm(x))
+        with _annotate("mlp"):
+            x = x + self.mlp(self.post_attention_layernorm(x))
         return x
 
 
@@ -351,16 +361,24 @@ class LlamaModel(Layer):
         self.norm = RMSNorm(config.hidden_size, epsilon=config.rms_norm_eps)
 
     def forward(self, input_ids, caches=None, offset=0):
-        x = self.embed_tokens(input_ids)
-        if caches is not None:
-            new_caches = []
-            for layer, cache in zip(self.layers, caches):
-                x, nc = layer(x, cache=cache, offset=offset)
-                new_caches.append(nc)
-            return self.norm(x), new_caches
-        for layer in self.layers:
-            x = layer(x)
-        return self.norm(x)
+        # named scopes per layer: XLA metadata (and thus the Perfetto /
+        # TensorBoard device trace) reads `llama/layer3/attention`
+        # instead of bare fusions
+        with _annotate("llama"):
+            with _annotate("embed"):
+                x = self.embed_tokens(input_ids)
+            if caches is not None:
+                new_caches = []
+                for i, (layer, cache) in enumerate(zip(self.layers,
+                                                       caches)):
+                    with _annotate(f"layer{i}"):
+                        x, nc = layer(x, cache=cache, offset=offset)
+                    new_caches.append(nc)
+                return self.norm(x), new_caches
+            for i, layer in enumerate(self.layers):
+                with _annotate(f"layer{i}"):
+                    x = layer(x)
+            return self.norm(x)
 
 
 class LlamaForCausalLM(Layer):

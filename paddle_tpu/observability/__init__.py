@@ -1,0 +1,56 @@
+"""Unified telemetry for the paddle_tpu stack.
+
+One process-wide ``MetricsRegistry`` (metrics.py) that the three hot
+subsystems instrument into:
+
+- **training** — ``distributed.engine.ParallelEngine`` emits per-step
+  wall time, tokens/s, loss, grad-norm, an MFU estimate (flops.py),
+  device memory stats, and the CompileStats counters; cross-host
+  aggregation via ``cross_host_sum`` lets rank 0 report pod throughput,
+- **serving**  — ``inference.serving.ServingEngine`` emits TTFT / TPOT
+  histograms, queue depth, slot/page-pool occupancy, and
+  admission/eviction/backfill counters,
+- **traces**   — ``trace.annotate`` stamps ``jax.named_scope`` names
+  onto transformer layers, the collective-matmul rings, and the paged-
+  attention kernels so XLA/Perfetto device traces carry framework
+  names, and mirrors them into host region stacks that
+  ``flight.dump()`` (the watchdog's stall flight-record) reports.
+
+Exports: Prometheus text exposition + JSONL sink + in-process
+snapshots (metrics.py). All instrumentation is host-side python on
+fetched scalars — nothing here runs inside traced code, so compile
+caches stay exactly as flat as they were without telemetry.
+"""
+from __future__ import annotations
+
+from .metrics import (Counter, Gauge, Histogram, JsonlSink,  # noqa: F401
+                      MetricsRegistry, DEFAULT_LATENCY_BUCKETS,
+                      get_registry, parse_prometheus_text,
+                      reset_registry)
+from .trace import annotate, current_regions  # noqa: F401
+from .flight import FlightRecorder, dump as dump_flight_record, \
+    get_recorder  # noqa: F401
+from . import flops  # noqa: F401
+
+__all__ = [
+    "Counter", "Gauge", "Histogram", "MetricsRegistry", "JsonlSink",
+    "DEFAULT_LATENCY_BUCKETS", "get_registry", "reset_registry",
+    "parse_prometheus_text", "annotate", "current_regions",
+    "FlightRecorder", "dump_flight_record", "get_recorder", "flops",
+    "cross_host_sum",
+]
+
+
+def cross_host_sum(value: float) -> float:
+    """Sum a host-local scalar across every process (rank 0 reports
+    pod-level throughput). Single-process: identity. Multi-process:
+    ``multihost_utils.process_allgather`` (an all_gather over hosts) —
+    call BETWEEN steps only; it synchronizes all processes."""
+    import jax
+
+    if jax.process_count() == 1:
+        return float(value)
+    import numpy as np
+    from jax.experimental import multihost_utils as mh
+
+    return float(np.sum(mh.process_allgather(np.asarray(float(value)))))

@@ -1,0 +1,129 @@
+"""The metric catalog: every instrument the engines emit, in one place.
+
+Names, label sets, units, and bucket lattices are API — dashboards and
+the scrape config key on them — so they are defined HERE once, mirrored
+into ``schema.json``, and pinned by a tier-1 test
+(tests/test_observability.py): adding/renaming a metric without
+updating the schema fails CI instead of silently breaking dashboards.
+
+All metrics live in the global registry (one process = one exposition);
+concurrent engines share series, which is the Prometheus model.
+"""
+from __future__ import annotations
+
+from typing import Dict
+
+from .metrics import (DEFAULT_LATENCY_BUCKETS, MetricsRegistry,
+                      get_registry)
+
+__all__ = ["train_metrics", "serving_metrics", "SCHEMA_PATH"]
+
+SCHEMA_PATH = __file__.rsplit("/", 1)[0] + "/schema.json"
+
+# Sub-second lattice for decode-side latencies (TPOT sits at ~1-50ms on
+# chip): denser low end than the generic latency lattice.
+_FAST_BUCKETS = (0.0005, 0.001, 0.002, 0.005, 0.01, 0.02, 0.05, 0.1,
+                 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0)
+
+
+def train_metrics(reg: MetricsRegistry = None) -> Dict[str, object]:
+    """Register (get-or-create) the training instrument set."""
+    r = reg or get_registry()
+    return {
+        "step_seconds": r.histogram(
+            "paddle_tpu_train_step_seconds",
+            "wall time of one compiled train step (dispatch to return; "
+            "on async backends steady-state throughput is the "
+            "tokens_per_sec gauge, measured between step entries)",
+            unit="s", buckets=DEFAULT_LATENCY_BUCKETS),
+        "steps": r.counter(
+            "paddle_tpu_train_steps_total", "compiled train steps run"),
+        "tokens": r.counter(
+            "paddle_tpu_train_tokens_total",
+            "training tokens consumed (samples when the batch carries "
+            "no token ids)"),
+        "tokens_per_sec": r.gauge(
+            "paddle_tpu_train_tokens_per_sec",
+            "tokens/s over the last inter-step interval (this process)",
+            unit="tokens/s"),
+        "pod_tokens_per_sec": r.gauge(
+            "paddle_tpu_train_pod_tokens_per_sec",
+            "tokens/s summed across all hosts (set by pod_throughput(), "
+            "an explicit cross-host all_gather)", unit="tokens/s"),
+        "loss": r.gauge(
+            "paddle_tpu_train_loss",
+            "last fetched train loss (one-step lag: fetched at the next "
+            "step so telemetry never blocks the dispatch)"),
+        "grad_norm": r.gauge(
+            "paddle_tpu_train_grad_norm",
+            "last fetched global gradient norm (pre-clip, all shards)"),
+        "mfu": r.gauge(
+            "paddle_tpu_train_mfu",
+            "model-FLOPs utilization estimate (6N convention; 0 on "
+            "CPU where peak FLOPs are unknown)"),
+        "compiles": r.counter(
+            "paddle_tpu_compiles_total",
+            "XLA compiles at instrumented launch sites",
+            labelnames=("site",)),
+        "cache_hits": r.counter(
+            "paddle_tpu_compile_cache_hits_total",
+            "compiled-program cache hits at instrumented launch sites",
+            labelnames=("site",)),
+        "device_memory": r.gauge(
+            "paddle_tpu_device_memory_bytes",
+            "per-device memory stats from the jax runtime",
+            labelnames=("device", "stat"), unit="bytes"),
+    }
+
+
+def serving_metrics(reg: MetricsRegistry = None) -> Dict[str, object]:
+    """Register (get-or-create) the serving instrument set."""
+    r = reg or get_registry()
+    return {
+        "ttft": r.histogram(
+            "paddle_tpu_serving_ttft_seconds",
+            "time to first token: submit() to the prefill sample",
+            unit="s", buckets=DEFAULT_LATENCY_BUCKETS),
+        "tpot": r.histogram(
+            "paddle_tpu_serving_tpot_seconds",
+            "time per output token after the first, per finished "
+            "request", unit="s", buckets=_FAST_BUCKETS),
+        "prefill_seconds": r.histogram(
+            "paddle_tpu_serving_prefill_seconds",
+            "one bucketed prefill (admission-time)", unit="s",
+            buckets=DEFAULT_LATENCY_BUCKETS),
+        "decode_round_seconds": r.histogram(
+            "paddle_tpu_serving_decode_round_seconds",
+            "one shared chunked decode round for the in-flight batch",
+            unit="s", buckets=DEFAULT_LATENCY_BUCKETS),
+        "queue_depth": r.gauge(
+            "paddle_tpu_serving_queue_depth",
+            "requests waiting for admission"),
+        "active_slots": r.gauge(
+            "paddle_tpu_serving_active_slots",
+            "in-flight batch rows currently serving a request"),
+        "free_pages": r.gauge(
+            "paddle_tpu_serving_free_pages",
+            "physical KV pages on the free list"),
+        "page_occupancy": r.gauge(
+            "paddle_tpu_serving_page_occupancy",
+            "fraction of the physical page pool in use (trash page "
+            "excluded)"),
+        "requests": r.counter(
+            "paddle_tpu_serving_requests_total",
+            "request lifecycle events: submitted / admitted / "
+            "backfilled (admitted while other rows were mid-decode) / "
+            "evicted (finished, pages freed)",
+            labelnames=("event",)),
+        "tokens": r.counter(
+            "paddle_tpu_serving_tokens_total",
+            "tokens produced, by phase", labelnames=("phase",)),
+        "compiles": r.counter(
+            "paddle_tpu_compiles_total",
+            "XLA compiles at instrumented launch sites",
+            labelnames=("site",)),
+        "cache_hits": r.counter(
+            "paddle_tpu_compile_cache_hits_total",
+            "compiled-program cache hits at instrumented launch sites",
+            labelnames=("site",)),
+    }

@@ -1,0 +1,104 @@
+"""Stall flight-records: the last-N metric snapshots + in-flight named
+regions + all-thread stacks, dumped to disk when something hangs.
+
+(reference: CommTaskManager's FLAGS_enable_async_trace dump — when an
+NCCL collective times out the manager serializes the in-flight task
+queue so the post-mortem shows WHAT was queued, not just that the pod
+died. TPU-native equivalent: collectives are compiled into the step, so
+the record instead captures the registry's recent snapshots (what the
+workload was doing), the semantic region stacks (where in the framework
+each thread is), and raw python stacks (ground truth).)
+
+The ring is fed automatically: every ``MetricsRegistry.snapshot()``
+pushes into it, and the instrumented engines snapshot once per
+step/round. ``dump()`` is called by the watchdog's timeout handler
+before it raises or tears down — and can be called manually from a
+debugger or signal handler.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+import traceback
+from collections import deque
+from typing import Any, Dict, Optional
+
+__all__ = ["FlightRecorder", "get_recorder", "dump"]
+
+DEFAULT_DIR_ENV = "PADDLE_TPU_FLIGHT_DIR"
+_DEFAULT_DIR = "./flight_records"
+
+
+class FlightRecorder:
+    """Bounded ring of registry snapshots + a post-mortem dumper."""
+
+    def __init__(self, maxlen: int = 32):
+        self._ring: deque = deque(maxlen=maxlen)
+        self._lock = threading.Lock()
+        self.last_dump_path: Optional[str] = None
+
+    def push(self, snapshot: Dict[str, Any]) -> None:
+        with self._lock:
+            self._ring.append(snapshot)
+
+    def snapshots(self):
+        with self._lock:
+            return list(self._ring)
+
+    def thread_stacks(self) -> Dict[str, Any]:
+        """Python stacks of every live thread (the os-level ground truth
+        under the semantic region stacks)."""
+        names = {t.ident: t.name for t in threading.enumerate()}
+        out = {}
+        for tid, frame in sys._current_frames().items():
+            out[f"{names.get(tid, 'unknown')} ({tid})"] = \
+                traceback.format_stack(frame)
+        return out
+
+    def record(self, reason: str = "") -> Dict[str, Any]:
+        """Assemble the flight record (without writing it)."""
+        from . import trace
+
+        return {
+            "reason": reason,
+            "ts": time.time(),
+            "pid": os.getpid(),
+            "inflight_regions": trace.current_regions(),
+            "thread_stacks": self.thread_stacks(),
+            "snapshots": self.snapshots(),
+        }
+
+    def dump(self, path: Optional[str] = None, reason: str = "") -> str:
+        """Write the flight record; returns the path. Directory from
+        ``PADDLE_TPU_FLIGHT_DIR`` (default ./flight_records)."""
+        if path is None:
+            d = os.environ.get(DEFAULT_DIR_ENV, _DEFAULT_DIR)
+            os.makedirs(d, exist_ok=True)
+            path = os.path.join(
+                d, f"flight_{os.getpid()}_{int(time.time() * 1e3)}.json")
+        rec = self.record(reason)
+        with open(path, "w") as f:
+            json.dump(rec, f, indent=1, default=str)
+        self.last_dump_path = path
+        return path
+
+
+_recorder = FlightRecorder()
+
+
+def get_recorder() -> FlightRecorder:
+    return _recorder
+
+
+def attach(registry) -> None:
+    """Wire a registry's snapshot() into the ring (metrics.get_registry
+    does this for the global registry)."""
+    registry._flight = _recorder
+
+
+def dump(path: Optional[str] = None, reason: str = "") -> str:
+    """Module-level shortcut the watchdog timeout handler calls."""
+    return _recorder.dump(path, reason)

@@ -1,0 +1,76 @@
+"""Flop accountant: model FLOPs/token + MFU from the model config.
+
+MFU follows the PaLM/Megatron convention (PAPERS.md: Megatron-LM): a
+decoder-only transformer spends ~6*N FLOPs per token (fwd 2N + bwd 4N),
+optionally plus the attention term 12*L*h*S that 6N omits; recompute
+FLOPs are deliberately EXCLUDED so remat lowers measured MFU honestly
+(the bench.py convention). The accountant reads whatever config the
+model carries (GPTConfig / LlamaConfig expose ``num_params()``); when
+there is no config it falls back to summing parameter sizes, which the
+engine can always do.
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+__all__ = ["params_from_config", "train_flops_per_token",
+           "peak_flops_per_chip", "mfu"]
+
+# Peak dense bf16 FLOPs and HBM bandwidth per chip by TPU generation
+# (public specs — the same table bench.py uses for its roofline lines).
+PEAK_BY_CHIP = {
+    "v4": (275e12, 1.2e12),
+    "v5e": (197e12, 0.819e12), "v5 lite": (197e12, 0.819e12),
+    "v5litepod": (197e12, 0.819e12),
+    "v5p": (459e12, 2.765e12),
+    "v6e": (918e12, 1.64e12), "v6 lite": (918e12, 1.64e12),
+}
+
+
+def params_from_config(config) -> Optional[int]:
+    """Parameter count from a model config, or None (configs across the
+    model zoo expose ``num_params()``; anything else is ignored)."""
+    fn = getattr(config, "num_params", None)
+    if callable(fn):
+        try:
+            return int(fn())
+        except Exception:
+            return None
+    return None
+
+
+def train_flops_per_token(n_params: int, *, config=None,
+                          with_attention: bool = True) -> float:
+    """~FLOPs one training token costs: 6*N plus (when the config
+    exposes layer geometry) the 12*L*h*S attention-matmul term."""
+    f = 6.0 * n_params
+    if with_attention and config is not None:
+        L = getattr(config, "num_layers", None)
+        h = getattr(config, "hidden_size", None)
+        S = getattr(config, "max_position_embeddings", None)
+        if L and h and S:
+            f += 12.0 * L * h * S
+    return f
+
+
+def peak_flops_per_chip(device) -> Tuple[float, float]:
+    """(peak dense bf16 FLOPs/s, HBM bytes/s) for a jax device; (0, 0)
+    on CPU, where MFU is not meaningful."""
+    kind = str(getattr(device, "device_kind", "")).lower()
+    for k, v in PEAK_BY_CHIP.items():
+        if k in kind:
+            return v
+    if "tpu" in str(getattr(device, "platform", "")).lower():
+        return PEAK_BY_CHIP["v5p"]   # unknown generation: assume v5p
+    return (0.0, 0.0)
+
+
+def mfu(n_params: int, tokens_per_sec: float, n_devices: int,
+        peak_per_chip: float, *, config=None) -> float:
+    """Model-FLOPs utilization of the whole slice; 0.0 when peak is
+    unknown (CPU) so gauges stay well-defined everywhere."""
+    denom = peak_per_chip * max(n_devices, 1)
+    if denom <= 0:
+        return 0.0
+    return train_flops_per_token(n_params, config=config) \
+        * tokens_per_sec / denom

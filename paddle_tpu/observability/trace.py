@@ -1,0 +1,60 @@
+"""Semantic device-trace annotations + host-side in-flight regions.
+
+``annotate(name)`` does two jobs at once:
+
+- inside a ``jax.jit``/``shard_map`` trace it opens a
+  ``jax.named_scope``, so the XLA metadata (and therefore the
+  TensorBoard/Perfetto device trace the TPU profiler captures) carries
+  framework names — ``llama/layer3/attention``, ``ag_matmul_ring``,
+  ``paged_decode_attention`` — instead of bare HLO ops (the reference
+  gets this from its C++ RecordEvent annotations feeding CUPTI),
+- on the host it pushes the name on a per-thread region stack, so a
+  stall flight-record (flight.py) can report what every thread was
+  doing when the watchdog fired — including mid-trace hangs, where the
+  region stack shows how deep into the model the tracer got.
+
+The host bookkeeping is plain list push/pop under no lock (each thread
+touches only its own stack; the flight dump reads other threads'
+stacks racily, which is fine for a post-mortem).
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Dict, List
+
+__all__ = ["annotate", "current_regions"]
+
+# tid -> region-name stack. Threads insert their own entry on first
+# annotate; the dict itself is only ever appended to (no rebalancing),
+# so racy reads from the flight dump see a consistent-enough view.
+_regions: Dict[int, List[str]] = {}
+
+
+@contextlib.contextmanager
+def annotate(name: str):
+    """Named region: jax.named_scope for the device trace + an in-flight
+    marker for stall flight-records. Cheap enough for per-layer use."""
+    import jax
+
+    tid = threading.get_ident()
+    stack = _regions.get(tid)
+    if stack is None:
+        stack = _regions[tid] = []
+    stack.append(name)
+    try:
+        with jax.named_scope(name):
+            yield
+    finally:
+        stack.pop()
+
+
+def current_regions() -> Dict[str, List[str]]:
+    """{thread-name (tid): open-region stack}, innermost last — what
+    each thread is inside right now (flight records embed this)."""
+    names = {t.ident: t.name for t in threading.enumerate()}
+    out = {}
+    for tid, stack in list(_regions.items()):
+        if stack:
+            out[f"{names.get(tid, 'dead')} ({tid})"] = list(stack)
+    return out

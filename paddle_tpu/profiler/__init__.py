@@ -40,9 +40,18 @@ class ProfilerState(Enum):
     RECORD_AND_RETURN = 3
 
 
-_events: List[Tuple[str, float, float, str]] = []
+# event rows: (name, t0, t1, category, thread-id, thread-name). The
+# thread id feeds the chrome exporter's `tid` so ServingEngine worker
+# threads and the watchdog monitor thread separate into lanes.
+_events: List[Tuple[str, float, float, str, int, str]] = []
 _events_lock = threading.Lock()
 _active = 0
+
+
+def _append_event(name: str, t0: float, t1: float, cat: str):
+    th = threading.current_thread()
+    with _events_lock:
+        _events.append((name, t0, t1, cat, th.ident or 0, th.name))
 
 
 class RecordEvent:
@@ -59,9 +68,8 @@ class RecordEvent:
     def end(self):
         if self._t0 is None or not _active:
             return
-        t1 = time.perf_counter()
-        with _events_lock:
-            _events.append((self.name, self._t0, t1, self.event_type))
+        _append_event(self.name, self._t0, time.perf_counter(),
+                      self.event_type)
         self._t0 = None
 
     def __enter__(self):
@@ -78,9 +86,10 @@ def _op_record(name: str):
     try:
         yield
     finally:
-        t1 = time.perf_counter()
-        with _events_lock:
-            _events.append((name, t0, t1, "Operator"))
+        # same `_active` gate as RecordEvent.end: an unstarted (or
+        # already-stopped) profiler must not grow the global event list
+        if _active:
+            _append_event(name, t0, time.perf_counter(), "Operator")
 
 
 def make_scheduler(*, closed: int, ready: int, record: int, repeat: int = 0,
@@ -143,29 +152,36 @@ class Profiler:
         self._state = ProfilerState.CLOSED
         self._device_tracing = False
         self._step_times: List[float] = []
+        # (interval seconds, samples) pairs from step(num_samples=...)
+        self._samples: List[Tuple[float, float]] = []
         self._last_step_t = None
 
     # -- lifecycle ------------------------------------------------------
     def start(self):
         global _active
         _active += 1
-        with _events_lock:
-            _events.clear()
+        if _active == 1:
+            # only the OUTERMOST profiler resets the global recorder: a
+            # nested start must neither clear the outer run's events nor
+            # (on its stop) tear the dispatch hook out from under it
+            with _events_lock:
+                _events.clear()
+            from ..core import dispatch as _dispatch
+
+            _dispatch._profile_hook = _op_record
         self._state = (self.scheduler(self.step_num)
                        if self.scheduler else ProfilerState.RECORD)
         self._maybe_device(True)
         self._last_step_t = time.perf_counter()
-        from ..core import dispatch as _dispatch
-
-        _dispatch._profile_hook = _op_record
 
     def stop(self):
         global _active
-        from ..core import dispatch as _dispatch
-
-        _dispatch._profile_hook = None
         self._maybe_device(False)
         _active = max(0, _active - 1)
+        if _active == 0:
+            from ..core import dispatch as _dispatch
+
+            _dispatch._profile_hook = None
         if self.on_trace_ready:
             self.on_trace_ready(self)
 
@@ -189,7 +205,12 @@ class Profiler:
     def step(self, num_samples: Optional[int] = None):
         now = time.perf_counter()
         if self._last_step_t is not None:
-            self._step_times.append(now - self._last_step_t)
+            dur = now - self._last_step_t
+            self._step_times.append(dur)
+            if num_samples:
+                # throughput accounting (reference profiler.py ips):
+                # num_samples processed over the interval just ended
+                self._samples.append((dur, float(num_samples)))
         self._last_step_t = now
         self.step_num += 1
         if self.scheduler:
@@ -216,7 +237,7 @@ class Profiler:
         unit = {"s": 1.0, "ms": 1e3, "us": 1e6}[time_unit]
         agg = {}
         with _events_lock:
-            for name, t0, t1, _ in _events:
+            for name, t0, t1, *_ in _events:
                 tot, cnt = agg.get(name, (0.0, 0))
                 agg[name] = (tot + (t1 - t0), cnt + 1)
         lines = [f"{'Name':<40} {'Calls':>8} {'Total(' + time_unit + ')':>14}"
@@ -233,6 +254,12 @@ class Profiler:
                          f"{st.mean() * unit:.3f}{time_unit}  p50 "
                          f"{np.percentile(st, 50) * unit:.3f}  p99 "
                          f"{np.percentile(st, 99) * unit:.3f}")
+        if self._samples:
+            tot_t = sum(d for d, _ in self._samples)
+            tot_n = sum(n for _, n in self._samples)
+            ips = tot_n / tot_t if tot_t > 0 else 0.0
+            lines.append(f"throughput: {ips:.2f} ips "
+                         f"({int(tot_n)} samples / {tot_t:.3f}s)")
         out = "\n".join(lines)
         print(out)
         return out
@@ -240,14 +267,24 @@ class Profiler:
     def _export_chrome(self, path: str):
         with _events_lock:
             evs = list(_events)
-        base = min((t0 for _, t0, _, _ in evs), default=0.0)
-        trace = {"traceEvents": [
-            {"name": name, "ph": "X", "pid": os.getpid(), "tid": 0,
-             "ts": (t0 - base) * 1e6, "dur": (t1 - t0) * 1e6,
-             "cat": cat}
-            for name, t0, t1, cat in evs]}
+        base = min((e[1] for e in evs), default=0.0)
+        pid = os.getpid()
+        events = []
+        lanes = {}                  # tid -> thread name (first seen)
+        for name, t0, t1, cat, tid, tname in evs:
+            lanes.setdefault(tid, tname)
+            events.append(
+                {"name": name, "ph": "X", "pid": pid, "tid": tid,
+                 "ts": (t0 - base) * 1e6, "dur": (t1 - t0) * 1e6,
+                 "cat": cat})
+        # chrome://tracing / Perfetto label each lane from thread_name
+        # metadata — serving workers and the watchdog monitor get their
+        # python thread names
+        for tid, tname in lanes.items():
+            events.append({"name": "thread_name", "ph": "M", "pid": pid,
+                           "tid": tid, "args": {"name": tname}})
         with open(path, "w") as f:
-            json.dump(trace, f)
+            json.dump({"traceEvents": events}, f)
 
     export = _export_chrome
 

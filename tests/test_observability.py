@@ -1,0 +1,459 @@
+"""Unified telemetry (paddle_tpu/observability/).
+
+Under test:
+- metrics primitives: Counter/Gauge/Histogram with labels, thread
+  safety, fixed-bucket percentiles, conflicting re-registration
+- exports: Prometheus text exposition round-trip, JSONL sink
+  round-trip, in-process snapshots
+- training instrumentation: a ParallelEngine loop fills the step
+  histogram / loss / grad-norm / token counters with correct counts,
+  and the engine compile counter stays FLAT with telemetry enabled
+- serving instrumentation: ServingEngine emits TTFT/TPOT histograms,
+  occupancy gauges, admission/eviction/backfill counters — zero
+  recompiles after warmup
+- traces: annotate() named regions survive jit tracing and surface in
+  current_regions(); the watchdog dumps a flight record on timeout
+- the metric schema gate: names/labels/types in a live snapshot must
+  match the checked-in schema.json (dashboards don't silently break)
+- tpulint: the observability package lints clean with ZERO baseline
+  entries
+"""
+import json
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import observability as obs
+from paddle_tpu.observability import catalog
+from paddle_tpu.observability.metrics import MetricsRegistry
+
+
+@pytest.fixture()
+def reg():
+    """A fresh registry per test, detached from the global one."""
+    return MetricsRegistry()
+
+
+# ---------------------------------------------------------------------------
+# primitives
+# ---------------------------------------------------------------------------
+class TestPrimitives:
+    def test_counter_labels(self, reg):
+        c = reg.counter("reqs_total", "requests", labelnames=("event",))
+        c.inc(event="submitted")
+        c.inc(2, event="submitted")
+        c.inc(event="evicted")
+        assert c.value(event="submitted") == 3
+        assert c.value(event="evicted") == 1
+        with pytest.raises(ValueError):
+            c.inc(-1, event="submitted")
+        with pytest.raises(ValueError):
+            c.inc(event="submitted", extra="nope")
+
+    def test_gauge(self, reg):
+        g = reg.gauge("depth")
+        g.set(4)
+        g.inc()
+        g.dec(2)
+        assert g.value() == 3
+
+    def test_histogram_percentiles(self, reg):
+        h = reg.histogram("lat", buckets=(0.001, 0.01, 0.1, 1.0))
+        for v in (0.005,) * 98 + (0.5,) * 2:
+            h.observe(v)
+        assert h.count() == 100
+        # p50 lands in the (0.001, 0.01] bucket, p99 in (0.1, 1.0]
+        assert 0.001 <= h.percentile(50) <= 0.01
+        assert 0.1 <= h.percentile(99) <= 0.5
+        assert h.percentile(100) == 0.5
+
+    def test_histogram_empty_and_overflow(self, reg):
+        h = reg.histogram("lat", buckets=(1.0,))
+        assert h.percentile(99) == 0.0
+        h.observe(5.0)              # +Inf bucket
+        assert h.percentile(99) == 5.0
+
+    def test_reregistration_same_spec_returns_same_object(self, reg):
+        a = reg.counter("c", "x", labelnames=("k",))
+        b = reg.counter("c", "x", labelnames=("k",))
+        assert a is b
+        with pytest.raises(ValueError):
+            reg.gauge("c")          # type conflict
+        with pytest.raises(ValueError):
+            reg.counter("c", labelnames=("other",))   # label conflict
+
+    def test_thread_safety(self, reg):
+        c = reg.counter("n")
+        h = reg.histogram("h", buckets=(0.5, 1.0))
+
+        def work():
+            for _ in range(500):
+                c.inc()
+                h.observe(0.25)
+
+        ts = [threading.Thread(target=work) for _ in range(8)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert c.value() == 4000
+        assert h.count() == 4000
+
+
+# ---------------------------------------------------------------------------
+# exports
+# ---------------------------------------------------------------------------
+class TestExports:
+    def _populate(self, reg):
+        c = reg.counter("tokens_total", "tokens", labelnames=("phase",))
+        c.inc(7, phase="decode")
+        c.inc(2, phase="prefill")
+        g = reg.gauge("depth", "queue depth")
+        g.set(3)
+        h = reg.histogram("ttft_seconds", "ttft",
+                          buckets=(0.01, 0.1, 1.0))
+        for v in (0.005, 0.05, 0.5):
+            h.observe(v)
+        return reg
+
+    def test_prometheus_round_trip(self, reg):
+        self._populate(reg)
+        text = reg.prometheus_text()
+        parsed = obs.parse_prometheus_text(text)
+        assert parsed["tokens_total"][(("phase", "decode"),)] == 7
+        assert parsed["tokens_total"][(("phase", "prefill"),)] == 2
+        assert parsed["depth"][()] == 3
+        assert parsed["ttft_seconds_count"][()] == 3
+        assert parsed["ttft_seconds_sum"][()] == pytest.approx(0.555)
+        # cumulative bucket counts
+        assert parsed["ttft_seconds_bucket"][(("le", "0.01"),)] == 1
+        assert parsed["ttft_seconds_bucket"][(("le", "+Inf"),)] == 3
+
+    def test_jsonl_round_trip(self, reg, tmp_path):
+        self._populate(reg)
+        snap = reg.snapshot()
+        sink = obs.JsonlSink(tmp_path / "m.jsonl")
+        sink.write(snap)
+        sink.write(reg.snapshot())
+        back = obs.JsonlSink.read(tmp_path / "m.jsonl")
+        assert len(back) == 2
+        assert back[0]["metrics"]["ttft_seconds"]["series"][0]["count"] \
+            == 3
+        assert back[0]["metrics"]["tokens_total"]["series"][0]["labels"]
+
+    def test_snapshot_percentiles(self, reg):
+        self._populate(reg)
+        row = reg.snapshot()["metrics"]["ttft_seconds"]["series"][0]
+        assert row["count"] == 3 and "p50" in row and "p99" in row
+
+
+# ---------------------------------------------------------------------------
+# training instrumentation
+# ---------------------------------------------------------------------------
+@pytest.fixture(scope="module")
+def trained_engine():
+    """One tiny GPT train loop; its registry snapshot is shared by the
+    train-side assertions (module-scoped: compile once)."""
+    from paddle_tpu.distributed import fleet
+    from paddle_tpu.distributed.engine import ParallelEngine
+    from paddle_tpu.models import (GPTConfig, GPTForCausalLM,
+                                   GPTPretrainingCriterion)
+
+    obs.reset_registry()
+    paddle.seed(0)
+    cfg = GPTConfig(vocab_size=128, hidden_size=32, num_layers=2,
+                    num_heads=2, max_position_embeddings=32)
+    model = GPTForCausalLM(cfg)
+    crit = GPTPretrainingCriterion(cfg)
+    opt = paddle.optimizer.AdamW(learning_rate=1e-4,
+                                 parameters=model.parameters())
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 2, "mp_degree": 2}
+    hcg = fleet.init(is_collective=True, strategy=strategy)
+    eng = ParallelEngine(model, opt, hcg.mesh)
+    step = eng.train_step(lambda m, b: crit(m(b["x"]), b["y"]))
+    r = np.random.RandomState(0)
+    ids = r.randint(0, 128, (4, 17))
+    batch = {"x": paddle.to_tensor(ids[:, :-1]),
+             "y": paddle.to_tensor(ids[:, 1:])}
+    losses = [float(step(batch)) for _ in range(4)]
+    return eng, losses, eng.metrics_snapshot()["metrics"]
+
+
+class TestTrainingInstrumentation:
+    def test_step_histogram_counts(self, trained_engine):
+        _, losses, m = trained_engine
+        row = m["paddle_tpu_train_step_seconds"]["series"][0]
+        assert row["count"] == 4
+        assert row["sum"] > 0
+        assert m["paddle_tpu_train_steps_total"]["series"][0]["value"] \
+            == 4
+        # 4 steps x B4 x S16 token ids
+        assert m["paddle_tpu_train_tokens_total"]["series"][0]["value"] \
+            == 4 * 4 * 16
+
+    def test_loss_and_grad_norm_gauges(self, trained_engine):
+        _, losses, m = trained_engine
+        # one-step lag: the snapshot (taken after the loop) flushed the
+        # LAST step's scalars
+        assert m["paddle_tpu_train_loss"]["series"][0]["value"] \
+            == pytest.approx(losses[-1], rel=1e-5)
+        assert m["paddle_tpu_train_grad_norm"]["series"][0]["value"] > 0
+
+    def test_throughput_and_mfu_gauges(self, trained_engine):
+        _, _, m = trained_engine
+        assert m["paddle_tpu_train_tokens_per_sec"]["series"][0][
+            "value"] > 0
+        # CPU: peak FLOPs unknown -> MFU pinned to 0, not garbage
+        assert m["paddle_tpu_train_mfu"]["series"][0]["value"] == 0.0
+
+    def test_compile_counters_flat_in_steady_state(self, trained_engine):
+        eng, _, m = trained_engine
+        rows = {tuple(sorted(s["labels"].items())): s["value"]
+                for s in m["paddle_tpu_compiles_total"]["series"]}
+        assert rows[(("site", "train_engine"),)] == 1   # one signature
+        assert eng.stats.compiles == 1
+        assert eng.stats.cache_hits == 3
+
+    def test_pod_throughput_single_process(self, trained_engine):
+        eng, _, _ = trained_engine
+        rep = eng.pod_throughput()
+        assert rep["processes"] == 1.0
+        assert rep["pod_tokens_per_sec"] == pytest.approx(
+            rep["local_tokens_per_sec"])
+
+
+# ---------------------------------------------------------------------------
+# serving instrumentation
+# ---------------------------------------------------------------------------
+@pytest.fixture(scope="module")
+def served_engine():
+    from paddle_tpu.inference import (Config, ServingEngine,
+                                      create_predictor)
+    from paddle_tpu.models.llama import LlamaForCausalLM, llama_tiny
+
+    obs.reset_registry()
+    paddle.seed(11)
+    model = LlamaForCausalLM(llama_tiny())
+    pred = create_predictor(
+        Config().set_model(model).enable_paged_kv(page_size=8))
+    eng = ServingEngine(pred, max_batch=2, decode_chunk=2)
+    r = np.random.RandomState(0)
+    V = model.config.vocab_size
+    # warmup mix, then a longer mixed stream (arrivals backfill)
+    for L in (7, 12):
+        eng.submit(r.randint(1, V, (L,)), max_new_tokens=6)
+    eng.run()
+    warm_compiles = eng.stats.compiles
+    lens = [24, 17, 11, 9, 5]
+    rids = [eng.submit(r.randint(1, V, (L,)), max_new_tokens=6)
+            for L in lens]
+    done = eng.run()
+    n_requests = 2 + len(lens)
+    return (eng, warm_compiles, n_requests,
+            {rid: done[rid] for rid in rids},
+            eng.metrics_snapshot()["metrics"])
+
+
+class TestServingInstrumentation:
+    def test_ttft_histogram_counts(self, served_engine):
+        _, _, n_requests, _, m = served_engine
+        assert m["paddle_tpu_serving_ttft_seconds"]["series"][0][
+            "count"] == n_requests
+
+    def test_tpot_histogram_counts(self, served_engine):
+        _, _, n_requests, done, m = served_engine
+        # every request decodes > 1 token, so each contributes one TPOT
+        assert all(len(r.new_tokens) > 1 for r in done.values())
+        row = m["paddle_tpu_serving_tpot_seconds"]["series"][0]
+        assert row["count"] == n_requests
+        assert row["p99"] >= row["p50"] > 0
+
+    def test_lifecycle_counters(self, served_engine):
+        _, _, n_requests, _, m = served_engine
+        ev = {s["labels"]["event"]: s["value"]
+              for s in m["paddle_tpu_serving_requests_total"]["series"]}
+        assert ev["submitted"] == n_requests
+        assert ev["admitted"] == n_requests
+        assert ev["evicted"] == n_requests
+        assert 0 < ev["backfilled"] <= n_requests
+
+    def test_token_counters(self, served_engine):
+        _, _, n_requests, done, m = served_engine
+        tok = {s["labels"]["phase"]: s["value"]
+               for s in m["paddle_tpu_serving_tokens_total"]["series"]}
+        assert tok["prefill"] == n_requests   # one sampled token each
+        assert tok["decode"] > 0
+
+    def test_occupancy_gauges_drain_to_zero(self, served_engine):
+        eng, _, _, _, m = served_engine
+        assert m["paddle_tpu_serving_queue_depth"]["series"][0][
+            "value"] == 0
+        assert m["paddle_tpu_serving_active_slots"]["series"][0][
+            "value"] == 0
+        assert m["paddle_tpu_serving_free_pages"]["series"][0][
+            "value"] == eng.P - 1
+        assert m["paddle_tpu_serving_page_occupancy"]["series"][0][
+            "value"] == 0.0
+
+    def test_no_recompiles_after_warmup_with_telemetry(self,
+                                                       served_engine):
+        eng, warm_compiles, _, _, _ = served_engine
+        # the acceptance gate: instrumentation must not perturb the
+        # compiled (B, Sb, P) program lattice
+        assert eng.stats.compiles == warm_compiles
+
+
+# ---------------------------------------------------------------------------
+# schema gate
+# ---------------------------------------------------------------------------
+class TestSchemaGate:
+    def test_checked_in_schema_matches_catalog(self):
+        """schema.json IS the catalog: regenerating it must be a no-op
+        (renaming a metric or changing a label set fails here first)."""
+        r = MetricsRegistry()
+        catalog.train_metrics(r)
+        catalog.serving_metrics(r)
+        with open(catalog.SCHEMA_PATH) as f:
+            checked_in = json.load(f)
+        assert r.schema() == checked_in
+
+    def test_live_snapshots_stay_inside_schema(self, trained_engine,
+                                               served_engine):
+        """Every metric either engine emitted must exist in schema.json
+        with the exact declared label set."""
+        with open(catalog.SCHEMA_PATH) as f:
+            schema = json.load(f)
+        for m in (trained_engine[2], served_engine[4]):
+            for name, entry in m.items():
+                assert name in schema, f"undeclared metric {name}"
+                assert sorted(entry["labels"]) == schema[name]["labels"]
+                assert entry["type"] == schema[name]["type"]
+                for row in entry["series"]:
+                    assert sorted(row["labels"]) == schema[name]["labels"]
+
+    def test_core_metrics_present(self, trained_engine, served_engine):
+        assert "paddle_tpu_train_step_seconds" in trained_engine[2]
+        assert "paddle_tpu_serving_ttft_seconds" in served_engine[4]
+        assert "paddle_tpu_serving_tpot_seconds" in served_engine[4]
+
+
+# ---------------------------------------------------------------------------
+# traces + flight records
+# ---------------------------------------------------------------------------
+class TestTracesAndFlight:
+    def test_annotate_inside_jit_and_region_stack(self):
+        import jax
+        import jax.numpy as jnp
+
+        seen = {}
+
+        def f(x):
+            with obs.annotate("outer"):
+                with obs.annotate("inner"):
+                    seen.update(obs.current_regions())
+                    return x * 2
+
+        out = jax.jit(f)(jnp.ones((2,)))
+        assert float(out[0]) == 2.0
+        (stack,) = [v for k, v in seen.items() if "MainThread" in k]
+        assert stack == ["outer", "inner"]
+        assert not any("MainThread" in k
+                       for k in obs.current_regions())   # popped
+
+    def test_flight_dump_contents(self, tmp_path):
+        reg = obs.reset_registry()
+        reg.counter("paddle_tpu_train_steps_total").inc(3)
+        reg.snapshot()                      # feeds the ring
+        reg.snapshot()
+        path = obs.dump_flight_record(
+            str(tmp_path / "f.json"), reason="unit test")
+        rec = json.load(open(path))
+        assert rec["reason"] == "unit test"
+        assert len(rec["snapshots"]) >= 2
+        assert rec["snapshots"][-1]["metrics"][
+            "paddle_tpu_train_steps_total"]["series"][0]["value"] == 3
+        assert any("MainThread" in k for k in rec["thread_stacks"])
+
+    def test_watchdog_timeout_dumps_flight_record(self, tmp_path,
+                                                  monkeypatch):
+        from paddle_tpu.distributed.watchdog import (CommTaskManager,
+                                                     TimeoutError_)
+
+        monkeypatch.setenv("PADDLE_TPU_FLIGHT_DIR", str(tmp_path))
+        mgr = CommTaskManager(timeout=0.15, poll_interval=0.03)
+        try:
+            with pytest.raises(TimeoutError_) as ei:
+                with mgr.track("hung_collective"):
+                    time.sleep(0.5)
+            assert "flight record" in str(ei.value)
+            assert mgr.last_flight_record
+            rec = json.load(open(mgr.last_flight_record))
+            assert "hung_collective" in rec["reason"]
+            # the tracked region was in flight on the main thread
+            regions = [r for k, rs in rec["inflight_regions"].items()
+                       for r in rs if "MainThread" in k]
+            assert "watchdog:hung_collective" in regions
+            # the monitor thread itself shows up in the stacks
+            assert any("watchdog-monitor" in k
+                       for k in rec["thread_stacks"])
+        finally:
+            mgr.shutdown()
+
+    def test_flight_ring_is_bounded(self):
+        rec = obs.FlightRecorder(maxlen=4)
+        for i in range(10):
+            rec.push({"i": i})
+        snaps = rec.snapshots()
+        assert len(snaps) == 4 and snaps[-1]["i"] == 9
+
+
+# ---------------------------------------------------------------------------
+# flop accountant
+# ---------------------------------------------------------------------------
+class TestFlops:
+    def test_params_from_config(self):
+        from paddle_tpu.models.llama import llama_tiny
+
+        cfg = llama_tiny()
+        assert obs.flops.params_from_config(cfg) == cfg.num_params()
+        assert obs.flops.params_from_config(object()) is None
+
+    def test_mfu_math(self):
+        # 1e9 params at 1000 tok/s vs 6e12 peak: 6e12/6e12 = 1.0
+        assert obs.flops.mfu(int(1e9), 1000.0, 1, 6e12) \
+            == pytest.approx(1.0)
+        assert obs.flops.mfu(int(1e9), 1000.0, 1, 0.0) == 0.0
+
+    def test_attention_term_additive(self):
+        from paddle_tpu.models.gpt import GPTConfig
+
+        cfg = GPTConfig(vocab_size=128, hidden_size=32, num_layers=2,
+                        num_heads=2, max_position_embeddings=32)
+        n = cfg.num_params()
+        base = obs.flops.train_flops_per_token(n, config=None)
+        with_attn = obs.flops.train_flops_per_token(n, config=cfg)
+        assert with_attn == base + 12.0 * 2 * 32 * 32
+
+
+# ---------------------------------------------------------------------------
+# tpulint gate: the new package must be clean with ZERO baseline entries
+# ---------------------------------------------------------------------------
+def test_tpulint_observability_package_zero_baseline():
+    from pathlib import Path
+
+    repo = Path(__file__).resolve().parents[1]
+    sys.path.insert(0, str(repo))
+    try:
+        from tools.tpulint import ALL_RULES, lint_paths
+
+        findings = lint_paths([repo / "paddle_tpu" / "observability"],
+                              ALL_RULES, root=repo)
+    finally:
+        sys.path.remove(str(repo))
+    assert findings == [], [str(f) for f in findings]

@@ -1,0 +1,223 @@
+"""Profiler correctness satellites (tier-1; the launcher/subprocess
+profiler tests stay in the slow suite).
+
+Under test (paddle_tpu/profiler):
+- an UNSTARTED profiler must not leak dispatch events into the global
+  list (_op_record honors the same `_active` gate as RecordEvent.end)
+- Profiler.step(num_samples=...) drives an ips (samples/sec) line in
+  summary() like the reference paddle.profiler
+- the chrome exporter records the EMITTING thread id (worker threads /
+  watchdog monitor separate into lanes) + thread_name metadata
+- make_scheduler edges: skip_first, repeat exhaustion, and
+  RECORD_AND_RETURN exactly on the last record step of each span
+- start/stop re-entrancy: nested profilers keep `_active` balanced,
+  the inner stop neither clears the outer's events nor removes the
+  dispatch hook
+"""
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.profiler as profiler
+from paddle_tpu.core import dispatch as _dispatch
+
+
+@pytest.fixture(autouse=True)
+def _clean_profiler_state():
+    """Each test starts with no active profiler and an empty event
+    list (the module state is global by design)."""
+    with profiler._events_lock:
+        profiler._events.clear()
+    profiler._active = 0
+    _dispatch._profile_hook = None
+    yield
+    profiler._active = 0
+    _dispatch._profile_hook = None
+
+
+def _mm():
+    x = paddle.to_tensor(np.ones((4, 4), "float32"))
+    return paddle.matmul(x, x)
+
+
+# ---------------------------------------------------------------------------
+# satellite: _op_record must not record when no profiler is active
+# ---------------------------------------------------------------------------
+class TestInactiveRecording:
+    def test_op_record_inactive_no_leak(self):
+        # a stale hook (e.g. left by an unbalanced stop) must not grow
+        # the global event list while _active == 0
+        _dispatch._profile_hook = profiler._op_record
+        _mm()
+        assert profiler._events == []
+
+    def test_record_event_inactive_no_leak(self):
+        with profiler.RecordEvent("orphan"):
+            pass
+        assert profiler._events == []
+
+    def test_stop_mid_op_drops_event(self):
+        # _active re-checked at append time, mirroring RecordEvent.end
+        with profiler._op_record("op"):
+            pass                       # _active == 0 the whole time
+        assert profiler._events == []
+
+
+# ---------------------------------------------------------------------------
+# satellite: step(num_samples) -> ips in summary
+# ---------------------------------------------------------------------------
+class TestThroughput:
+    def test_summary_reports_ips(self):
+        with profiler.Profiler(timer_only=True) as p:
+            for _ in range(3):
+                _mm()
+                time.sleep(0.002)
+                p.step(num_samples=16)
+        out = p.summary()
+        assert "ips" in out and "48 samples" in out
+        tot_t = sum(d for d, _ in p._samples)
+        ips = 48 / tot_t
+        assert f"{ips:.2f}" in out
+
+    def test_no_samples_no_ips_line(self):
+        with profiler.Profiler(timer_only=True) as p:
+            _mm()
+            p.step()
+        assert "ips" not in p.summary()
+
+    def test_interval_accounting(self):
+        p = profiler.Profiler(timer_only=True)
+        p.start()
+        time.sleep(0.005)
+        p.step(num_samples=10)
+        p.stop()
+        (dur, n), = p._samples
+        assert n == 10 and dur >= 0.004
+
+
+# ---------------------------------------------------------------------------
+# satellite: chrome exporter thread lanes
+# ---------------------------------------------------------------------------
+class TestChromeThreadLanes:
+    def test_events_carry_real_tids(self, tmp_path):
+        with profiler.Profiler(timer_only=True) as p:
+            def worker():
+                with profiler.RecordEvent("worker_block"):
+                    _mm()
+
+            t = threading.Thread(target=worker, name="svc-worker-0")
+            t.start()
+            t.join()
+            with profiler.RecordEvent("main_block"):
+                _mm()
+        path = str(tmp_path / "trace.json")
+        p._export_chrome(path)
+        data = json.load(open(path))
+        evs = [e for e in data["traceEvents"] if e["ph"] == "X"]
+        by_name = {e["name"]: e for e in evs}
+        assert by_name["worker_block"]["tid"] \
+            != by_name["main_block"]["tid"]
+        assert all(e["tid"] != 0 for e in evs)
+        lanes = {e["args"]["name"] for e in data["traceEvents"]
+                 if e["ph"] == "M" and e["name"] == "thread_name"}
+        assert {"svc-worker-0", "MainThread"} <= lanes
+
+    def test_ops_attributed_to_dispatch_thread(self, tmp_path):
+        with profiler.Profiler(timer_only=True) as p:
+            tids = []
+
+            def worker():
+                tids.append(threading.get_ident())
+                _mm()
+
+            t = threading.Thread(target=worker)
+            t.start()
+            t.join()
+        path = str(tmp_path / "trace.json")
+        p._export_chrome(path)
+        data = json.load(open(path))
+        op = [e for e in data["traceEvents"]
+              if e["ph"] == "X" and e["name"] == "matmul"]
+        assert op and op[0]["tid"] == tids[0]
+
+
+# ---------------------------------------------------------------------------
+# satellite: make_scheduler state-transition edges
+# ---------------------------------------------------------------------------
+class TestSchedulerEdges:
+    def test_skip_first_window_closed(self):
+        sched = profiler.make_scheduler(closed=0, ready=0, record=2,
+                                        skip_first=3)
+        S = profiler.ProfilerState
+        assert [sched(i) for i in range(3)] == [S.CLOSED] * 3
+        assert sched(3) == S.RECORD
+        assert sched(4) == S.RECORD_AND_RETURN
+
+    def test_record_and_return_on_last_record_step(self):
+        sched = profiler.make_scheduler(closed=1, ready=1, record=3)
+        S = profiler.ProfilerState
+        # period = 5: steps 2,3 RECORD; 4 (last of the span) RETURNs
+        assert [sched(i) for i in range(5)] == [
+            S.CLOSED, S.READY, S.RECORD, S.RECORD, S.RECORD_AND_RETURN]
+        # repeat=0 cycles forever
+        assert sched(9) == S.RECORD_AND_RETURN
+
+    def test_repeat_exhaustion_closes(self):
+        sched = profiler.make_scheduler(closed=1, ready=0, record=1,
+                                        repeat=2)
+        S = profiler.ProfilerState
+        assert [sched(i) for i in range(6)] == [
+            S.CLOSED, S.RECORD_AND_RETURN,
+            S.CLOSED, S.RECORD_AND_RETURN,
+            S.CLOSED, S.CLOSED]        # past repeat*period: closed
+
+    def test_record_one_is_immediately_return(self):
+        sched = profiler.make_scheduler(closed=0, ready=0, record=1)
+        assert sched(0) == profiler.ProfilerState.RECORD_AND_RETURN
+
+
+# ---------------------------------------------------------------------------
+# satellite: start/stop re-entrancy
+# ---------------------------------------------------------------------------
+class TestReentrancy:
+    def test_nested_profilers_balance_active(self):
+        outer = profiler.Profiler(timer_only=True)
+        inner = profiler.Profiler(timer_only=True)
+        outer.start()
+        assert profiler._active == 1
+        inner.start()
+        assert profiler._active == 2
+        inner.stop()
+        assert profiler._active == 1
+        # the hook survives the inner stop: ops still recorded
+        _mm()
+        assert any(e[0] == "matmul" for e in profiler._events)
+        outer.stop()
+        assert profiler._active == 0
+        assert _dispatch._profile_hook is None
+
+    def test_inner_start_keeps_outer_events(self):
+        outer = profiler.Profiler(timer_only=True)
+        outer.start()
+        with profiler.RecordEvent("before_inner"):
+            pass
+        with profiler.Profiler(timer_only=True):
+            pass
+        assert any(e[0] == "before_inner" for e in profiler._events)
+        outer.stop()
+
+    def test_unbalanced_stop_clamps_at_zero(self):
+        p = profiler.Profiler(timer_only=True)
+        p.start()
+        p.stop()
+        p.stop()                        # extra stop must not go negative
+        assert profiler._active == 0
+        q = profiler.Profiler(timer_only=True)
+        q.start()                       # and a fresh start still works
+        _mm()
+        assert any(e[0] == "matmul" for e in profiler._events)
+        q.stop()
