@@ -466,59 +466,68 @@ def bench_gpt13b_hybrid(on_tpu, dev):
         dp = max(n // 8, 1)
         B, S, steps, state_dtype = 2 * dp * 2, 16, 2, None
 
-    paddle.seed(0)
-    strategy = fleet.DistributedStrategy()
-    strategy.hybrid_configs = {"dp_degree": dp, "mp_degree": 4,
-                               "pp_degree": 2,
-                               "sharding_degree": 1,
-                               # collective-matmul overlap on the TP hot
-                               # path (distributed/collective_matmul.py)
-                               "mp_configs": {"mp_async_allreduce": True}}
-    strategy.sharding_configs = {"stage": 2}
-    strategy.pipeline_configs = {"accumulate_steps": 2,
-                                 "micro_batch_size": B // (2 * dp)}
-    hcg = fleet.init(is_collective=True, strategy=strategy)
-    model = GPTForCausalLMPipe(cfg)
-    dist_model = fleet.distributed_model(model)
-    opt = fleet.distributed_optimizer(
-        paddle.optimizer.AdamW(learning_rate=1e-4,
-                               parameters=model.parameters(),
-                               state_dtype=state_dtype))
-    r = np.random.RandomState(0)
-    ids = r.randint(0, cfg.vocab_size, (B, S + 1))
-    x = paddle.to_tensor(ids[:, :-1])
-    y = paddle.to_tensor(ids[:, 1:])
-    loss = dist_model.train_batch([x, y], opt)
-    float(loss)
-    stats = dist_model._engine.stats
-    compiles_warm = stats.compiles
-    t0 = time.perf_counter()
-    for _ in range(steps):
+    # vpp=1 (GPipe-family rotation) and vpp=2 (circular interleaved
+    # schedule, pp_layers._pipe_fn): same model/mesh/microbatches, so
+    # the two lines isolate the schedule's bubble effect
+    for vpp in (1, 2):
+        paddle.seed(0)
+        strategy = fleet.DistributedStrategy()
+        strategy.hybrid_configs = {
+            "dp_degree": dp, "mp_degree": 4,
+            "pp_degree": 2,
+            "sharding_degree": 1,
+            # collective-matmul overlap on the TP hot
+            # path (distributed/collective_matmul.py)
+            "mp_configs": {"mp_async_allreduce": True},
+            "pp_configs": {"num_virtual_pipeline_stages": vpp}}
+        strategy.sharding_configs = {"stage": 2}
+        strategy.pipeline_configs = {"accumulate_steps": 2,
+                                     "micro_batch_size": B // (2 * dp)}
+        hcg = fleet.init(is_collective=True, strategy=strategy)
+        model = GPTForCausalLMPipe(cfg)
+        dist_model = fleet.distributed_model(model)
+        opt = fleet.distributed_optimizer(
+            paddle.optimizer.AdamW(learning_rate=1e-4,
+                                   parameters=model.parameters(),
+                                   state_dtype=state_dtype))
+        r = np.random.RandomState(0)
+        ids = r.randint(0, cfg.vocab_size, (B, S + 1))
+        x = paddle.to_tensor(ids[:, :-1])
+        y = paddle.to_tensor(ids[:, 1:])
         loss = dist_model.train_batch([x, y], opt)
-    float(loss)
-    dt = time.perf_counter() - t0
-    tok_s = B * S * steps / dt
-    peak, _ = _chip(dev)
-    n_params = cfg.num_params()
-    mfu = (6.0 * n_params * tok_s / (peak * n)) if peak else 0.0
-    _emit({
-        "metric": "gpt13b_hybrid_train_tokens_per_sec" if on_tpu
-        else "gpt13b_hybrid_smoke_tokens_per_sec",
-        "value": round(tok_s, 2),
-        "unit": "tokens/s",
-        "vs_baseline": round(mfu / 0.45, 4) if peak else 0.0,
-        "mfu": round(mfu, 4) if peak else 0.0,
-        "mesh": f"dp{dp}xpp2xmp4", "devices": n,
-        "mp_async_allreduce": True,
-        # engine compile-cache counters: steady state must be
-        # recompile-free (overlap regressions keyed on traced shapes
-        # would show here)
-        "compiles": stats.compiles,
-        "cache_hits": stats.cache_hits,
-        "recompiles_after_warmup": stats.compiles - compiles_warm,
-        "telemetry": _telemetry_section(),
-        "device": str(getattr(dev, "device_kind", dev.platform)),
-    })
+        float(loss)
+        stats = dist_model._engine.stats
+        compiles_warm = stats.compiles
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            loss = dist_model.train_batch([x, y], opt)
+        float(loss)
+        dt = time.perf_counter() - t0
+        tok_s = B * S * steps / dt
+        peak, _ = _chip(dev)
+        n_params = cfg.num_params()
+        mfu = (6.0 * n_params * tok_s / (peak * n)) if peak else 0.0
+        base = ("gpt13b_hybrid_train_tokens_per_sec" if on_tpu
+                else "gpt13b_hybrid_smoke_tokens_per_sec")
+        _emit({
+            "metric": base if vpp == 1 else
+            base.replace("gpt13b_hybrid", "gpt13b_hybrid_vpp2"),
+            "value": round(tok_s, 2),
+            "unit": "tokens/s",
+            "vs_baseline": round(mfu / 0.45, 4) if peak else 0.0,
+            "mfu": round(mfu, 4) if peak else 0.0,
+            "mesh": f"dp{dp}xpp2xmp4", "devices": n,
+            "mp_async_allreduce": True,
+            "pp_vpp": vpp,
+            # engine compile-cache counters: steady state must be
+            # recompile-free (overlap regressions keyed on traced shapes
+            # would show here)
+            "compiles": stats.compiles,
+            "cache_hits": stats.cache_hits,
+            "recompiles_after_warmup": stats.compiles - compiles_warm,
+            "telemetry": _telemetry_section(),
+            "device": str(getattr(dev, "device_kind", dev.platform)),
+        })
 
 
 # ---------------------------------------------------------------------------
@@ -781,7 +790,7 @@ _BENCHES = {}
 # each + headline printed last = one hang, zero lines).
 _TIMEOUTS = {"gpt": 900, "llama_decode": 420, "llama_decode_int8": 420,
              "llama_decode_ragged": 420, "serving": 420, "resnet": 300,
-             "moe": 300, "gpt13b_hybrid": 420, "tp_overlap": 240,
+             "moe": 300, "gpt13b_hybrid": 700, "tp_overlap": 240,
              "kernel_parity": 240}
 _ORDER = ("gpt", "llama_decode", "llama_decode_int8",
           "llama_decode_ragged", "serving", "resnet", "moe",

@@ -735,6 +735,18 @@ class ParallelEngine:
                 peak, config=getattr(self.model, "config", None)))
         self._prev_step_entry = t_entry
         self._pending_scalars = (lv, gnorm)
+        # pipelined models: publish the analytic bubble fraction of the
+        # attached schedule — (S-1)/(vpp*M+S-1) with the circular
+        # interleave's vpp as a label, so dashboards can see the
+        # schedule regime a run trains under (pp_layers._pipe_fn)
+        if getattr(self.model, "_pp_ownership", False) and \
+                "pp" in self.mesh.axis_names and self.mesh.shape["pp"] > 1:
+            S = getattr(self.model, "_num_stages", 1)
+            vpp = getattr(self.model, "_vpp", 1)
+            n_mb = getattr(self.model, "_num_microbatches", 1)
+            if S > 1:
+                m["pp_bubble"].set(
+                    (S - 1) / (vpp * n_mb + S - 1), pp_vpp=str(vpp))
         # compile-cache counters: report the delta since last step so
         # the Prometheus counters stay monotonic
         rc, rh = self._stats_reported

@@ -35,12 +35,16 @@ def init(role_maker=None, is_collective: bool = True,
     _init_hybrid_parallel_env)."""
     strategy = strategy or DistributedStrategy()
     hc = strategy.hybrid_configs
+    pp_conf = hc.get("pp_configs", {}) or {}
     hcg = HybridCommunicateGroup(
         dp_degree=hc.get("dp_degree", 1), mp_degree=hc.get("mp_degree", 1),
         pp_degree=hc.get("pp_degree", 1),
         sharding_degree=hc.get("sharding_degree", 1),
         sep_degree=hc.get("sep_degree", 1),
-        order=list(hc.get("order", ["dp", "pp", "sharding", "sep", "mp"])))
+        order=list(hc.get("order", ["dp", "pp", "sharding", "sep", "mp"])),
+        # circular-interleave schedule knob, plumbed to PipelineLayer
+        # (pp_layers.py) via the HCG
+        vpp_degree=pp_conf.get("num_virtual_pipeline_stages", 1))
     _fleet_state["initialized"] = True
     _fleet_state["hcg"] = hcg
     _fleet_state["strategy"] = strategy

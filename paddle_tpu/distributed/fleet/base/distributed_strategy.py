@@ -19,7 +19,13 @@ _HYBRID_DEFAULTS = {
     # TP/SP collectives with the matmuls they feed via the chunked ring
     # decompositions in distributed/collective_matmul.py
     "mp_configs": {"mp_async_allreduce": False},
-    "pp_configs": {},
+    # num_virtual_pipeline_stages (vpp): circular interleaved pipeline
+    # schedule — each pp stage holds vpp non-contiguous layer chunks and
+    # activations make vpp circuits of the ICI ring, shrinking the
+    # bubble to (S-1)/(vpp*M+S-1) (meta_parallel/parallel_layers/
+    # pp_layers.py). Requires num_layers % (pp*vpp) == 0 and
+    # accumulate_steps % pp == 0.
+    "pp_configs": {"num_virtual_pipeline_stages": 1},
 }
 
 

@@ -49,7 +49,8 @@ class HybridCommunicateGroup:
                  dp_degree: int = 1, mp_degree: int = 1, pp_degree: int = 1,
                  sharding_degree: int = 1, sep_degree: int = 1,
                  order: Optional[List[str]] = None,
-                 devices: Optional[list] = None):
+                 devices: Optional[list] = None,
+                 vpp_degree: int = 1):
         if topology is not None:
             degrees = {n: topology.get_dim(n)
                        for n in topology.get_hybrid_group_names()}
@@ -63,6 +64,10 @@ class HybridCommunicateGroup:
         self._pp_degree = pp_degree
         self._sharding_degree = sharding_degree
         self._sep_degree = sep_degree
+        # virtual pipeline (circular interleave) chunks per pp stage —
+        # a schedule knob, not a mesh axis: it multiplies layer chunks,
+        # not devices (pp_layers.PipelineLayer reads it at build time)
+        self._vpp_degree = int(vpp_degree or 1)
         self._order = order or _DEFAULT_ORDER
         self._topo = topology or CommunicateTopology(
             self._order, [self._degree_of(n) for n in self._order])
@@ -103,6 +108,11 @@ class HybridCommunicateGroup:
 
     def get_pipe_parallel_world_size(self):
         return self._pp_degree
+
+    def get_virtual_pipeline_parallel_world_size(self):
+        """num_virtual_pipeline_stages from pp_configs (1 = no
+        interleave); consumed by PipelineLayer at build time."""
+        return self._vpp_degree
 
     def get_sharding_parallel_world_size(self):
         return self._sharding_degree

@@ -25,13 +25,32 @@ property is achieved differently: each pipeline TICK is wrapped in
 disable), so the only activations that survive the forward scan are the
 O(microbatch) stage-boundary carries — per-block residuals exist for
 just ONE tick at a time during backward. Cost: one extra stage-forward
-per tick (the standard remat trade). Because memory no longer scales
-with per-block residuals x M, the bubble (S-1)/(M+S-1) can be driven
-down by raising M freely — which is also why interleaved virtual
-stages are NOT implemented: their bubble advantage presupposes 1F1B's
-hand-scheduled fwd/bwd interleaving; here ``num_virtual_pipeline_stages
-> 1`` raises instead of silently degrading (reference interleave:
-pipeline_parallel.py:938).
+per tick (the standard remat trade).
+
+Interleaved virtual stages (the CIRCULAR schedule): contrary to the
+folk claim that interleave presupposes 1F1B's hand-scheduled fwd/bwd
+ticks, a GSPMD-style *circular* schedule expresses it inside the same
+single ``lax.scan`` + ``lax.ppermute`` program. With
+``num_virtual_pipeline_stages = vpp > 1`` each stage holds ``vpp``
+NON-contiguous layer chunks of ``L/(pp*vpp)`` layers: the stacked
+parameters are shaped ``[vpp, L/vpp, ...]`` with axis 1 sharded over
+'pp', so rank ``s`` physically owns, for every circuit ``v``, the
+global layers ``[v*L/vpp + s*K, v*L/vpp + (s+1)*K)`` (``K =
+L/(pp*vpp)``) — the round-robin chunk→stage map of Megatron/GSPMD
+interleave. Each microbatch makes ``vpp`` circuits of the ICI ring
+(stage S-1's output ppermutes back into stage 0, which applies its
+NEXT chunk to it), so the scan runs ``T = vpp*M + S - 1`` ticks of
+``1/vpp``-sized stage work: bubble (S-1)/(vpp*M+S-1) instead of
+(S-1)/(M+S-1) — the up-to-~2x small-M win measured in
+PP_SCHEDULE.json (tools/pp_schedule_measure.py). Microbatches are
+admitted in groups of S (circuit v+1 of a microbatch re-enters stage 0
+exactly S ticks after circuit v left it — a pure shift register, no
+carry buffering), which is why ``accumulate_steps % pp == 0`` is
+required when vpp > 1. ``jax.vjp`` of the circular program IS the
+exact reverse schedule, and ``tick_checkpoint`` remat keeps the
+O(microbatch) memory property per chunk (each tick now recomputes only
+K layers). RNG streams are distinct per (tick, stage, chunk) — see
+``_tick_seed``.
 
 Stage ownership: the prologue (embedding) runs under ``lax.cond`` only
 on stage 0 and the epilogue (final norm + 50K-vocab head + loss) only
@@ -45,6 +64,7 @@ reference's SharedLayerDesc allreduce does by hand).
 """
 from __future__ import annotations
 
+from contextlib import nullcontext as _nullcontext
 from functools import partial
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
@@ -100,21 +120,46 @@ class SharedLayerDesc(LayerDesc):
 
 
 class SegmentLayers:
-    """Partition N layers into num_parts stages (reference pp_layers.py:92).
+    """Partition N layers into num_parts parts (reference pp_layers.py:92).
 
-    method: "uniform" or "layer:<ClassName>" (cut so each stage starts at
-    an instance of the named class)."""
+    method: "uniform" or "layer:<ClassName>" (cut so each part starts at
+    an instance of the named class).
+
+    With ``num_virtual_pipeline_stage = vpp > 1`` the layer list is cut
+    into ``num_stages * vpp`` parts whose stage ASSIGNMENT is
+    interleaved round-robin (part j → stage ``j % num_stages``, circuit
+    ``j // num_stages``) — the circular-schedule chunk→stage map — NOT
+    the reference's contiguous ``num_parts *= vpp`` blocks-per-stage
+    pre-multiplication."""
 
     def __init__(self, layers_desc, num_parts, method="uniform",
                  num_virtual_pipeline_stage=None):
         self._layers_desc = layers_desc
         self.method = method
-        self.num_parts = num_parts
+        self.num_stages = num_parts
+        self.num_virtual = num_virtual_pipeline_stage or 1
+        self.num_parts = num_parts * self.num_virtual
         self.num_items = len(layers_desc)
-        if num_virtual_pipeline_stage:
-            self.num_parts = num_parts * num_virtual_pipeline_stage
         enforce(self.num_items >= self.num_parts,
-                "layer number should be greater than number of segments")
+                f"layer number ({self.num_items}) should be no less than "
+                f"the number of segments = pp degree ({self.num_stages}) "
+                f"x num_virtual_pipeline_stages ({self.num_virtual}) = "
+                f"{self.num_parts}")
+
+    def part_stage(self, part_idx: int) -> int:
+        """Physical pp stage owning segment ``part_idx``: interleaved
+        round-robin under virtual stages (part j → stage j % pp during
+        circuit j // pp), contiguous identity otherwise."""
+        enforce(0 <= part_idx < self.num_parts,
+                f"part {part_idx} out of range [0, {self.num_parts})")
+        return part_idx % self.num_stages
+
+    def part_chunk(self, part_idx: int) -> int:
+        """Circuit (virtual-stage chunk) index of segment ``part_idx``
+        on its owning stage."""
+        enforce(0 <= part_idx < self.num_parts,
+                f"part {part_idx} out of range [0, {self.num_parts})")
+        return part_idx // self.num_stages
 
     def do_segment(self) -> List[int]:
         if self.method == "uniform":
@@ -131,7 +176,9 @@ class SegmentLayers:
             total = len(idxs)
             enforce(total % self.num_parts == 0,
                     f"the number of {cls_name} ({total}) must be divisible "
-                    f"by num stages ({self.num_parts})")
+                    f"by pp degree ({self.num_stages}) x "
+                    f"num_virtual_pipeline_stages ({self.num_virtual}) "
+                    f"= {self.num_parts}")
             per = total // self.num_parts
             return ([0] + [idxs[k * per] for k in range(1, self.num_parts)]
                     + [self.num_items])
@@ -180,6 +227,18 @@ def _bind(params: Sequence[Parameter], values):
     return bind_params(params, values)
 
 
+def _tick_seed(base_seed, t, stage, chunk):
+    """Distinct rng stream per (tick, stage, chunk): dropout masks must
+    differ across microbatches, stages, AND the vpp chunks a stage
+    applies on different circuits of the same tick phase. Affine mix of
+    odd/coprime constants over uint32; uniqueness over realistic
+    (t, stage, chunk) grids is pinned by tests/test_pp_vpp.py."""
+    return (base_seed * jnp.uint32(1000003)
+            + t.astype(jnp.uint32) * jnp.uint32(2654435761)
+            + stage.astype(jnp.uint32)
+            + chunk.astype(jnp.uint32) * jnp.uint32(40503))
+
+
 class PipelineLayer(Layer):
     """Pipeline-partitioned model (reference pp_layers.py:261).
 
@@ -202,30 +261,30 @@ class PipelineLayer(Layer):
             num_stages = (hcg.get_pipe_parallel_world_size()
                           if hcg is not None else 1)
         self._num_stages = int(num_stages)
-        if num_virtual_pipeline_stages and num_virtual_pipeline_stages > 1:
-            raise ValueError(
-                "num_virtual_pipeline_stages > 1 (interleaved schedule) is "
-                "not supported by the compiled SPMD pipeline: interleave's "
-                "bubble win presupposes 1F1B's hand-scheduled fwd/bwd "
-                "ticks, which a single jax.vjp'd program cannot express. "
-                "Use more microbatches instead — with the default "
-                "tick_checkpoint=True, activation memory no longer scales "
-                "with per-block residuals x microbatches, so raising "
-                "accumulate_steps shrinks the bubble at O(microbatch) "
-                "memory cost. MEASURED (tools/pp_schedule_measure.py -> "
-                "PP_SCHEDULE.json, 8-dev mesh): realized bubble 0.049 at "
-                "pp=2/M=16 and 0.080 at pp=4/M=32, vs the interleave-vpp2 "
-                "analytic bound of 0.111 / 0.158 at its feasible M=2S — "
-                "raising M wins outright, at flat activation memory "
-                "(tests/test_pipeline_parallel.py).")
-        self._vpp = 1
+        if num_virtual_pipeline_stages is None:
+            # plumbed from strategy.hybrid_configs["pp_configs"] via
+            # fleet.init -> HybridCommunicateGroup
+            hcg = self._hcg()
+            num_virtual_pipeline_stages = (
+                hcg.get_virtual_pipeline_parallel_world_size()
+                if hcg is not None else 1)
+        vpp = int(num_virtual_pipeline_stages or 1)
+        enforce(vpp >= 1,
+                f"num_virtual_pipeline_stages must be >= 1; got {vpp}")
+        if vpp > 1:
+            enforce(self._num_stages > 1,
+                    f"num_virtual_pipeline_stages={vpp} (the circular "
+                    f"interleaved schedule) needs a pipelined mesh, but "
+                    f"pp_degree is {self._num_stages} — set "
+                    "hybrid_configs['pp_degree'] > 1 or drop "
+                    "hybrid_configs['pp_configs']"
+                    "['num_virtual_pipeline_stages']")
+        self._vpp = vpp
         self._tick_checkpoint = bool(tick_checkpoint)
         self._loss_fn = loss_fn
         # the stacked blocks share ONE scanned body, so recompute is
         # all-or-nothing here: every block (interval=1) or none (0) —
         # a per-k-th-layer policy is not expressible inside lax.scan
-        from .....core.enforce import enforce
-
         enforce(recompute_interval in (0, 1),
                 "recompute_interval must be 0 (off) or 1 (recompute every "
                 f"block); got {recompute_interval}")
@@ -247,8 +306,12 @@ class PipelineLayer(Layer):
         n_mid = len(mid)
         total = self._num_stages * self._vpp
         enforce(n_mid % total == 0 if total > 1 else True,
-                f"pipelined middle has {n_mid} layers, not divisible by "
-                f"pp degree x virtual stages = {total}")
+                f"pipelined middle has {n_mid} layers (L), not divisible "
+                f"by pp_degree ({self._num_stages}) x "
+                f"num_virtual_pipeline_stages ({self._vpp}) = {total}; "
+                "each stage must own num_virtual_pipeline_stages chunks "
+                f"of L/{total} layers — adjust num_layers or the "
+                "pp_degree / num_virtual_pipeline_stages knobs")
         self.prologue = LayerList(built[:lo])
         self.epilogue = LayerList(built[hi:])
         self._n_blocks = n_mid
@@ -264,26 +327,47 @@ class PipelineLayer(Layer):
             for n in names:
                 tp = per_block[0][n]
                 stacked = jnp.stack([pb[n]._value for pb in per_block])
-                sp = Parameter(stacked, trainable=tp.trainable)
                 base = getattr(tp, "dist_attr", None)
                 base = tuple(base) if isinstance(base, P) else \
                     (None,) * tp.ndim
-                if total > 1:
+                if self._vpp > 1:
+                    # circular interleave: leading chunk axis laid out
+                    # round-robin over stages — sharding axis 1 (L/vpp
+                    # layer rows) over 'pp' hands rank s, for every
+                    # circuit v, the non-contiguous global layers
+                    # [v*L/vpp + s*K, v*L/vpp + (s+1)*K)
+                    stacked = stacked.reshape(
+                        (self._vpp, n_mid // self._vpp) + stacked.shape[1:])
+                    sp = Parameter(stacked, trainable=tp.trainable)
+                    sp.dist_attr = P(None, "pp", *base)
+                    sp.is_distributed = True
+                elif total > 1:
+                    sp = Parameter(stacked, trainable=tp.trainable)
                     sp.dist_attr = P("pp", *base)
                     sp.is_distributed = True
-                elif any(a is not None for a in base):
-                    sp.dist_attr = P(None, *base)
-                    sp.is_distributed = True
+                else:
+                    sp = Parameter(stacked, trainable=tp.trainable)
+                    if any(a is not None for a in base):
+                        sp.dist_attr = P(None, *base)
+                        sp.is_distributed = True
                 self.add_parameter("blocks__" + n.replace(".", "__"), sp)
                 self._t_params.append(tp)
                 self._s_params.append(sp)
-        # segment bookkeeping (reference parity: stage boundaries)
+        # segment bookkeeping (reference parity: part boundaries, plus
+        # the interleaved part→(stage, chunk) map under virtual stages)
         if mid:
-            self.segment_parts = SegmentLayers(
+            seg = SegmentLayers(
                 self._descs[lo:hi], self._num_stages, seg_method,
-                self._vpp if self._vpp > 1 else None).do_segment()
+                self._vpp if self._vpp > 1 else None)
+            self.segment_parts = seg.do_segment()
+            self.segment_part_stages = [seg.part_stage(j)
+                                        for j in range(seg.num_parts)]
+            self.segment_part_chunks = [seg.part_chunk(j)
+                                        for j in range(seg.num_parts)]
         else:
             self.segment_parts = [0]
+            self.segment_part_stages = []
+            self.segment_part_chunks = []
 
     # -- construction helpers -------------------------------------------
     def _hcg(self):
@@ -375,14 +459,34 @@ class PipelineLayer(Layer):
         """The pipeline schedule: microbatch rotation over the pp ring.
 
         Returns pure fn(x, *stacked) -> last-stage outputs (valid rows
-        only on the last pp stage; zeros-masked elsewhere). GPipe-family
-        schedule: T = M + S - 1 ticks; at tick t, stage s computes
-        microbatch t - s; lax.ppermute rotates activations one stage
-        forward per tick on ICI. jax.vjp of this function yields the
-        reverse schedule (backward pipeline) automatically.
+        only on the last pp stage; zeros-masked elsewhere).
+
+        vpp=1 (GPipe-family): T = M + S - 1 ticks; at tick t, stage s
+        computes microbatch t - s; lax.ppermute rotates activations one
+        stage forward per tick on ICI.
+
+        vpp>1 (circular interleave): each stage holds vpp chunks of
+        K = L/(S*vpp) layers (round-robin layout, see __init__); every
+        activation makes vpp circuits of the ring before emitting, so
+        the scan runs T = vpp*M + S - 1 ticks of 1/vpp-sized stage work
+        — bubble (S-1)/(vpp*M+S-1). Work items (microbatch m, circuit
+        v) enter stage 0 in groups of S microbatches, all circuits of a
+        group before the next group (entry order e = g*S*vpp + v*S +
+        (m - g*S)): circuit v+1 of an item re-enters stage 0 exactly S
+        ticks after circuit v entered, which is precisely when its
+        carry returns from stage S-1 — a pure shift register, no
+        buffering, hence the accumulate_steps % pp == 0 requirement.
+        The item at stage s on tick t is e = t - s; its chunk is
+        v = (e mod S*vpp) // S.
+
+        jax.vjp of this function yields the exact reverse schedule
+        (backward pipeline) automatically — for vpp>1 included, because
+        the circular rotation is ordinary data flow through scan +
+        ppermute.
         """
         enforce(len(pp_axes) == 1, "pp must map to a single mesh axis")
         axis = pp_axes[0]
+        V = self._vpp
 
         def fn(x_val, *stacked_vals):
             S = C.axis_size(axis)
@@ -391,19 +495,41 @@ class PipelineLayer(Layer):
                     f"stages but the mesh '{axis}' axis has {S} — build "
                     "the PipelineLayer after fleet.init (or pass "
                     "num_stages)")
+            if V > 1:
+                enforce(M % S == 0,
+                        f"accumulate_steps (microbatches M={M}) must be "
+                        f"a multiple of pp_degree (S={S}) when "
+                        f"num_virtual_pipeline_stages={V}: the circular "
+                        "schedule admits microbatches in groups of "
+                        "pp_degree so returning circuits slot into the "
+                        "ring without buffering")
             stage = lax.axis_index(axis)
             B = x_val.shape[0]
             enforce(B % M == 0, f"local batch {B} not divisible by "
                     f"microbatches {M}")
             mb = B // M
             xm = x_val.reshape((M, mb) + x_val.shape[1:])
-            n_rows = stacked_vals[0].shape[0] if stacked_vals else 0
+            if stacked_vals:
+                n_rows = stacked_vals[0].shape[1 if V > 1 else 0]
+            else:
+                n_rows = 0
             carry = jnp.zeros((mb,) + x_val.shape[1:], x_val.dtype)
             out_buf = jnp.zeros_like(xm)
             perm = [(i, (i + 1) % self._num_stages)
                     for i in range(self._num_stages)]
+            SV = S * V
+            E = V * M          # total work items (microbatch, circuit)
 
-            def tick(x_in, seed_t, *sv):
+            def tick(x_in, seed_t, v, *sv):
+                if V > 1:
+                    # chunk selection INSIDE the remat boundary: the
+                    # backward recomputes the [K, ...] gather instead
+                    # of saving a per-tick copy of the chunk params
+                    # (T x param bytes — the memory-flatness test
+                    # catches the difference)
+                    sv = tuple(
+                        lax.dynamic_index_in_dim(s_, v, 0, keepdims=False)
+                        for s_ in sv)
                 with _rng.fork_traced(seed_t):
                     return self._apply_rows(x_in, sv, n_rows)
 
@@ -412,22 +538,35 @@ class PipelineLayer(Layer):
                 # boundary carries survive the forward scan; the blocks'
                 # residuals exist for one tick at a time during backward
                 # (recomputed), so activation memory does NOT scale with
-                # microbatch count (see module docstring)
+                # microbatch count (see module docstring). Under vpp>1
+                # each tick rematerializes only its K-layer chunk.
                 tick = jax.checkpoint(tick)
 
             def body(state, t):
                 carry, out_buf = state
-                x_mb = lax.dynamic_index_in_dim(
-                    xm, jnp.clip(t, 0, M - 1), 0, keepdims=False)
-                x_in = jnp.where(stage == 0, x_mb, carry)
-                # distinct rng stream per (tick, stage) so dropout masks
-                # differ across microbatches and stages
-                seed_t = (base_seed * jnp.uint32(1000003)
-                          + t.astype(jnp.uint32) * jnp.uint32(2654435761)
-                          + stage.astype(jnp.uint32))
-                y = tick(x_in, seed_t, *stacked_vals)
-                idx = jnp.clip(t - (S - 1), 0, M - 1)
-                write = (stage == S - 1) & (t >= S - 1)
+                # work item at this stage this tick: entry index e,
+                # chunk v = (e mod S*vpp) // S, microbatch
+                # m = (e // S*vpp)*S + (e mod S*vpp) mod S
+                e = jnp.clip(t - stage, 0, E - 1)
+                r = e % SV
+                v = r // S
+                m_in = jnp.clip((e // SV) * S + r, 0, M - 1)
+                x_mb = lax.dynamic_index_in_dim(xm, m_in, 0,
+                                                keepdims=False)
+                # stage 0 injects a fresh microbatch on circuit 0; on
+                # later circuits it consumes the carry returning from
+                # stage S-1 (the circular rotation)
+                x_in = jnp.where((stage == 0) & (v == 0), x_mb, carry)
+                seed_t = _tick_seed(base_seed, t, stage, v)
+                y = tick(x_in, seed_t, v, *stacked_vals)
+                # the last stage emits items on their FINAL circuit only
+                ew = t - (S - 1)
+                ewc = jnp.clip(ew, 0, E - 1)
+                rw = ewc % SV
+                idx = jnp.clip((ewc // SV) * S + (rw - S * (V - 1)),
+                               0, M - 1)
+                write = ((stage == S - 1) & (ew >= 0) & (ew < E)
+                         & (rw >= S * (V - 1)))
                 cur = lax.dynamic_index_in_dim(out_buf, idx, 0,
                                                keepdims=False)
                 out_buf = lax.dynamic_update_index_in_dim(
@@ -436,7 +575,7 @@ class PipelineLayer(Layer):
                 return (carry, out_buf), None
 
             (carry, out_buf), _ = lax.scan(
-                body, (carry, out_buf), jnp.arange(M + S - 1))
+                body, (carry, out_buf), jnp.arange(E + S - 1))
             return out_buf.reshape(x_val.shape)
 
         return fn
@@ -460,9 +599,17 @@ class PipelineLayer(Layer):
         if seed is None:
             seed = jnp.uint32(np.random.randint(0, 2**31))
         if pp_axes is None:
+            n_blocks = self._n_blocks
+            vpp = self._vpp
+
             def fn(xv, *sv):
+                if vpp > 1:
+                    # chunked layout [vpp, L/vpp, ...] flattens back to
+                    # global layer order for sequential application
+                    sv = [s.reshape((n_blocks,) + s.shape[2:])
+                          for s in sv]
                 with _rng.fork_traced(seed):
-                    return self._apply_rows(xv, sv, self._n_blocks)
+                    return self._apply_rows(xv, sv, n_blocks)
         else:
             fn = self._pipe_fn(self._num_microbatches, seed, pp_axes)
 
@@ -510,9 +657,18 @@ class PipelineLayer(Layer):
         in_vals = [t._value for t in inputs]
         pvals = [p._value for p in own]
         axes = tuple(pp_axes)
+        amb_seed = _rng.traced_seed()
 
         def pure(iv, pv):
-            with no_grad(), _bind(own, pv):
+            # fork an owner-distinct rng stream for the duration of the
+            # call: without it, dropout inside the prologue/epilogue
+            # splits the ambient traced key under jax.eval_shape's /
+            # lax.cond's inner trace and leaks that tracer into the
+            # global rng state (UnexpectedTracerError on the next use)
+            ctx = (_rng.fork_traced(
+                amb_seed * jnp.uint32(48271) + jnp.uint32(owner + 1))
+                if amb_seed is not None else _nullcontext())
+            with ctx, no_grad(), _bind(own, pv):
                 out = fn_eager(*[Tensor(v, stop_gradient=True)
                                  for v in iv])
             return out._value
@@ -605,6 +761,11 @@ class PipelineLayer(Layer):
     # reference API parity helpers
     def get_num_stages(self) -> int:
         return self._num_stages
+
+    def get_num_virtual_stages(self) -> int:
+        """Chunks per stage in the circular interleaved schedule (1 =
+        plain GPipe-family rotation)."""
+        return self._vpp
 
     @property
     def parameters_in_stacked_blocks(self):

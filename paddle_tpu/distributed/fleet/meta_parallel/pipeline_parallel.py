@@ -11,7 +11,11 @@ plus the optimizer update) is ONE compiled XLA program built by
 ``PipelineLayer._pipe_fn`` (lax.scan + ppermute), and its jax.vjp is the
 reverse schedule. Host Python dispatches one executable per step instead
 of 4·M p2p calls, which removes the per-microbatch launch overhead the
-reference pays (SURVEY.md §7 hard parts: "1F1B under XLA").
+reference pays (SURVEY.md §7 hard parts: "1F1B under XLA"). The same
+program expresses interleaved virtual stages (``pp_configs
+["num_virtual_pipeline_stages"] > 1``) as a circular rotation — see the
+pp_layers module docstring; this wrapper validates the microbatch-count
+constraint that schedule adds (accumulate_steps % pp == 0).
 """
 from __future__ import annotations
 
@@ -53,7 +57,22 @@ class PipelineParallel(_DelegateWrapper):
         return self._engine
 
     def _check_batch(self, inputs):
-        if self.micro_batch_size <= 0 or self._hcg is None:
+        if self._hcg is None:
+            return
+        # circular-interleave feasibility, named by knob: microbatches
+        # enter the ring in groups of pp_degree (pp_layers._pipe_fn)
+        vpp = getattr(self._layers, "_vpp", 1)
+        pp = self._hcg.get_pipe_parallel_world_size()
+        if vpp > 1:
+            enforce(self.accumulate_steps % pp == 0,
+                    "pipeline_configs['accumulate_steps'] "
+                    f"({self.accumulate_steps}) must be a multiple of "
+                    f"pp_degree ({pp}) when pp_configs"
+                    f"['num_virtual_pipeline_stages'] is {vpp}: the "
+                    "circular schedule admits microbatches in groups of "
+                    "pp_degree so each returning circuit slots into the "
+                    "ring tick its carry arrives on")
+        if self.micro_batch_size <= 0:
             return
         first = inputs[0] if isinstance(inputs, (tuple, list)) else inputs
         data_deg = (self._hcg.get_data_parallel_world_size()

@@ -61,6 +61,13 @@ def train_metrics(reg: MetricsRegistry = None) -> Dict[str, object]:
             "paddle_tpu_train_mfu",
             "model-FLOPs utilization estimate (6N convention; 0 on "
             "CPU where peak FLOPs are unknown)"),
+        "pp_bubble": r.gauge(
+            "paddle_tpu_train_pp_bubble_fraction",
+            "analytic pipeline bubble fraction of the attached "
+            "schedule, (S-1)/(vpp*M+S-1) — published per step when a "
+            "pipelined model is attached, labeled by the virtual-stage "
+            "count (realized bubble: tools/pp_schedule_measure.py)",
+            labelnames=("pp_vpp",)),
         "compiles": r.counter(
             "paddle_tpu_compiles_total",
             "XLA compiles at instrumented launch sites",

@@ -1,0 +1,212 @@
+"""Circular interleaved virtual-pipeline (vpp>1) — fast unit layer.
+
+Structure, knob plumbing, interleaved segmentation, rng-stream
+distinctness, and the named-knob error messages. The compiled-schedule
+parity / compile-stability / memory tests live in
+tests/test_pipeline_parallel.py (slow marker — they compile pp
+programs on the 8-vdev mesh).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed import fleet
+from paddle_tpu.distributed.fleet.meta_parallel import (LayerDesc,
+                                                        PipelineLayer,
+                                                        SegmentLayers)
+from paddle_tpu.models import GPTForCausalLMPipe
+from paddle_tpu.models.gpt import GPTConfig
+
+
+def _init_fleet(pp, vpp, dp=1, M=4, micro=1):
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {
+        "dp_degree": dp, "mp_degree": 1, "pp_degree": pp,
+        "pp_configs": {"num_virtual_pipeline_stages": vpp}}
+    strategy.pipeline_configs = {"accumulate_steps": M,
+                                 "micro_batch_size": micro}
+    fleet._fleet_state.update(initialized=False, hcg=None, strategy=None)
+    return fleet.init(is_collective=True, strategy=strategy)
+
+
+def gpt_tiny(num_layers=4, **kw):
+    return GPTConfig(vocab_size=256, hidden_size=64,
+                     num_layers=num_layers, num_heads=4,
+                     max_position_embeddings=128, **kw)
+
+
+# ---------------------------------------------------------------------------
+# SegmentLayers: interleaved part -> (stage, chunk) assignment
+# ---------------------------------------------------------------------------
+class TestSegmentInterleave:
+    def test_round_robin_part_stage_map(self):
+        descs = [LayerDesc(paddle.nn.Linear, 4, 4) for _ in range(8)]
+        seg = SegmentLayers(descs, num_parts=2, method="uniform",
+                            num_virtual_pipeline_stage=2)
+        assert seg.num_parts == 4
+        # part j -> stage j % pp during circuit j // pp — interleaved,
+        # NOT the reference's contiguous blocks-per-stage
+        assert [seg.part_stage(j) for j in range(4)] == [0, 1, 0, 1]
+        assert [seg.part_chunk(j) for j in range(4)] == [0, 0, 1, 1]
+        assert seg.do_segment() == [0, 2, 4, 6, 8]
+
+    def test_vpp1_is_contiguous_identity(self):
+        descs = [LayerDesc(paddle.nn.Linear, 4, 4) for _ in range(8)]
+        seg = SegmentLayers(descs, num_parts=4, method="uniform")
+        assert [seg.part_stage(j) for j in range(4)] == [0, 1, 2, 3]
+        assert [seg.part_chunk(j) for j in range(4)] == [0, 0, 0, 0]
+
+    def test_layer_method_composes_with_vpp(self):
+        class Blk(paddle.nn.Layer):
+            def __init__(self):
+                super().__init__()
+
+        descs = []
+        for _ in range(4):
+            descs.append(LayerDesc(Blk))
+            descs.append(LayerDesc(paddle.nn.Linear, 4, 4))
+        seg = SegmentLayers(descs, num_parts=2, method="layer:Blk",
+                            num_virtual_pipeline_stage=2)
+        # each of the 4 parts starts at a Blk occurrence
+        assert seg.do_segment() == [0, 2, 4, 6, 8]
+        assert [seg.part_stage(j) for j in range(4)] == [0, 1, 0, 1]
+
+    def test_layer_method_divisibility_error_names_vpp(self):
+        class Blk(paddle.nn.Layer):
+            def __init__(self):
+                super().__init__()
+
+        descs = [LayerDesc(Blk) for _ in range(6)]
+        seg = SegmentLayers(descs, num_parts=2, method="layer:Blk",
+                            num_virtual_pipeline_stage=2)
+        with pytest.raises(Exception, match="num_virtual_pipeline_stages"):
+            seg.do_segment()
+
+
+# ---------------------------------------------------------------------------
+# rng streams: distinct per (tick, stage, chunk)
+# ---------------------------------------------------------------------------
+def test_tick_seed_unique_per_tick_stage_chunk():
+    import jax.numpy as jnp
+
+    from paddle_tpu.distributed.fleet.meta_parallel.parallel_layers import \
+        pp_layers
+
+    base = jnp.uint32(12345)
+    seen = set()
+    # a realistic large grid: T = vpp*M + S - 1 ticks for S<=8, vpp<=4,
+    # M up to 32 -> t < 140
+    for t in range(140):
+        for s in range(8):
+            for v in range(4):
+                seed = int(pp_layers._tick_seed(
+                    base, jnp.int32(t), jnp.int32(s), jnp.int32(v)))
+                assert seed not in seen, (t, s, v)
+                seen.add(seed)
+
+
+# ---------------------------------------------------------------------------
+# PipelineLayer structure + knob plumbing
+# ---------------------------------------------------------------------------
+class TestVppStructure:
+    def test_stacked_params_gain_chunk_axis(self):
+        _init_fleet(pp=2, vpp=2)
+        model = GPTForCausalLMPipe(gpt_tiny(num_layers=8))
+        assert model.get_num_virtual_stages() == 2
+        sp = model.parameters_in_stacked_blocks
+        # [vpp, L/vpp, ...] with the LAYER axis (1) sharded over 'pp'
+        assert sp and all(p.shape[0] == 2 and p.shape[1] == 4 for p in sp)
+        assert all(tuple(p.dist_attr)[:2] == (None, "pp") for p in sp)
+
+    def test_knob_plumbs_from_strategy_through_hcg(self):
+        hcg = _init_fleet(pp=2, vpp=2)
+        assert hcg.get_virtual_pipeline_parallel_world_size() == 2
+        model = GPTForCausalLMPipe(gpt_tiny())
+        assert model._vpp == 2
+
+    def test_explicit_kwarg_overrides_strategy(self):
+        _init_fleet(pp=2, vpp=2)
+        model = GPTForCausalLMPipe(gpt_tiny(),
+                                   num_virtual_pipeline_stages=1)
+        assert model._vpp == 1
+        sp = model.parameters_in_stacked_blocks
+        assert all(tuple(p.dist_attr)[0] == "pp" for p in sp)
+
+    def test_segment_part_stages_interleaved(self):
+        _init_fleet(pp=2, vpp=2)
+        model = GPTForCausalLMPipe(gpt_tiny(num_layers=8))
+        # seg_method="layer:GPTDecoderLayer" composed with vpp
+        assert model.segment_parts == [0, 2, 4, 6, 8]
+        assert model.segment_part_stages == [0, 1, 0, 1]
+        assert model.segment_part_chunks == [0, 0, 1, 1]
+
+    def test_chunk_rows_cover_global_layers_round_robin(self):
+        """The [vpp, L/vpp] reshape + axis-1 'pp' sharding IS the
+        round-robin chunk->stage map: rank s's chunk v holds global
+        layers [v*L/vpp + s*K, v*L/vpp + (s+1)*K)."""
+        _init_fleet(pp=2, vpp=2)
+        paddle.seed(5)
+        L = 4
+        cfg = gpt_tiny(num_layers=L)
+        flat = GPTForCausalLMPipe(cfg, num_virtual_pipeline_stages=1)
+        paddle.seed(5)
+        chunked = GPTForCausalLMPipe(cfg)
+        for pf, pc in zip(flat.parameters_in_stacked_blocks,
+                          chunked.parameters_in_stacked_blocks):
+            np.testing.assert_array_equal(
+                np.asarray(pf._value),
+                np.asarray(pc._value).reshape(pf.shape))
+
+
+# ---------------------------------------------------------------------------
+# named-knob error messages
+# ---------------------------------------------------------------------------
+class TestVppErrors:
+    def test_layers_not_divisible_names_both_knobs(self):
+        _init_fleet(pp=2, vpp=4)
+        with pytest.raises(Exception) as ei:
+            GPTForCausalLMPipe(gpt_tiny(num_layers=6))
+        msg = str(ei.value)
+        assert "pp_degree (2)" in msg
+        assert "num_virtual_pipeline_stages (4)" in msg
+        assert "6 layers" in msg
+
+    def test_vpp_without_pipelined_mesh_rejected(self):
+        _init_fleet(pp=1, vpp=2)
+        with pytest.raises(Exception, match="pp_degree is 1"):
+            GPTForCausalLMPipe(gpt_tiny())
+
+    def test_microbatches_not_multiple_of_pp_names_knobs(self):
+        _init_fleet(pp=2, vpp=2, M=3)
+        model = GPTForCausalLMPipe(gpt_tiny())
+        dm = fleet.distributed_model(model)
+        opt = fleet.distributed_optimizer(paddle.optimizer.SGD(
+            learning_rate=0.0, parameters=model.parameters()))
+        ids = paddle.to_tensor(np.zeros((3, 16), dtype="int32"))
+        with pytest.raises(Exception) as ei:
+            dm.train_batch([ids, ids], opt)
+        msg = str(ei.value)
+        assert "accumulate_steps" in msg and "(3)" in msg
+        assert "pp_degree (2)" in msg
+        assert "num_virtual_pipeline_stages" in msg
+
+    def test_vpp_zero_or_negative_rejected(self):
+        _init_fleet(pp=2, vpp=1)
+        with pytest.raises(Exception, match="must be >= 1"):
+            GPTForCausalLMPipe(gpt_tiny(),
+                               num_virtual_pipeline_stages=-2)
+
+
+# ---------------------------------------------------------------------------
+# observability: the bubble gauge is cataloged with the pp_vpp label
+# ---------------------------------------------------------------------------
+def test_pp_bubble_gauge_in_catalog_schema():
+    import json
+
+    from paddle_tpu.observability import catalog
+
+    with open(catalog.SCHEMA_PATH) as f:
+        schema = json.load(f)
+    entry = schema["paddle_tpu_train_pp_bubble_fraction"]
+    assert entry["type"] == "gauge"
+    assert entry["labels"] == ["pp_vpp"]

@@ -1,24 +1,49 @@
-"""Measure the compiled-GPipe pipeline schedule instead of asserting it.
+"""Measure the compiled pipeline schedules instead of asserting them.
 
-Round-4 verdict: the vpp>1 raise in pp_layers.py argued (but never
-measured) that raising microbatch count M beats implementing 1F1B /
-interleaved-vpp on TPU. This script measures, on the 8-virtual-device
-CPU mesh (and on real hardware when present), step time vs M for
-pp=2,4, derives the REALIZED bubble fraction, and compares it to the
-analytic schedule bounds:
+Round-4 verdict: the (former) vpp>1 raise in pp_layers.py argued (but
+never measured) that raising microbatch count M beats interleaved-vpp
+on TPU. PR 5 implemented the circular interleaved schedule, so this
+script now measures BOTH schedules on the 8-virtual-device CPU mesh
+(and on real hardware when present) at vpp=1 and vpp=2 for pp=2,4:
+
+- ``step_ms``: full ``train_batch`` wall time (throughput view — same
+  instrument as the PR-4 file, includes loss/optimizer/dispatch);
+- ``pipe_ms`` and the REALIZED bubble: the pipelined middle's
+  fwd+backward program ALONE (``PipelineLayer._pipe_fn`` + jax.vjp,
+  jitted under shard_map). The bubble is a property of the schedule's
+  scan, so it is measured on exactly that program — timing the whole
+  train step would fold the M-independent optimizer update, grad
+  psums, and host dispatch into the "bubble" and bias it upward at
+  small M (that bias is how the PR-4 numbers overstated the vpp=1
+  bubble at M=2).
+
+Analytic bounds the realized columns sit next to:
 
     GPipe / 1F1B bubble    = (S-1) / (M + S-1)   (same bubble; 1F1B's
                              win is activation MEMORY, which the
                              compiled pipeline already gets from
                              per-tick remat — memory flat in M,
                              tests/test_pipeline_parallel.py)
-    interleaved vpp bubble = (S-1) / (vpp*M + S-1)
+    circular vpp bubble    = (S-1) / (vpp*M + S-1)
 
-Realized bubble at M uses the marginal per-microbatch time tau
-(slope between the two largest M): bubble = 1 - M*tau / t(M).
-If compiled-GPipe at feasible M realizes a bubble <= what interleave
-would give at small M, "raise M" wins and the numbers are recorded
-where the vpp error message cites them (PP_SCHEDULE.json).
+Realized bubble at M: least-squares marginal per-microbatch time tau
+over the (min-of-repeats) pipe-program curve, bubble =
+1 - M*tau/(t(M) - c). The M-independent harness floor c (jit dispatch
++ buffer setup, host work that is not schedule) is estimated JOINTLY
+from the two curves — both LS intercepts satisfy b_v = (S-1)*tick_v +
+c with tick_1 = tau_1, tick_2 = tau_2/2 — and removed; the raw
+uncorrected bubbles are kept in the bubble_raw_* columns. What stays
+measured is the schedule content: whether vpp=2's ticks are really
+about half of vpp=1's and whether the leftover beyond M*tau matches
+the (S-1) bubble ticks the analytic formula predicts.
+
+The checked-in decision flags (PP_SCHEDULE.json), both sides REALIZED:
+  - ``vpp2_beats_vpp1_at_equal_M``: the circular schedule must realize
+    a strictly smaller bubble at every equal M;
+  - ``raise_M_beats_vpp2_at_2S``: does vpp=1 at its feasible M=8S
+    still beat circular vpp=2 at small M=2S? (Pre-implementation this
+    was decided against the vpp2 ANALYTIC bound; the realized
+    comparison is the honest one.)
 
 Run: XLA_FLAGS=--xla_force_host_platform_device_count=8 \
      python tools/pp_schedule_measure.py
@@ -43,47 +68,183 @@ if os.environ.get("PP_MEASURE_TPU") != "1":
 
 import numpy as np
 
+SEQ = 32
+MICRO = 2          # rows per microbatch (B = MICRO * M)
 
-def measure(pp: int, M_list, steps=6):
+
+def _build(pp: int, vpp: int, M: int):
     import paddle_tpu as paddle
     from paddle_tpu.distributed import fleet
     from paddle_tpu.models import GPTForCausalLMPipe
     from paddle_tpu.models.gpt import GPTConfig
 
+    paddle.seed(0)
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {
+        "dp_degree": 1, "mp_degree": 1, "pp_degree": pp,
+        "pp_configs": {"num_virtual_pipeline_stages": vpp}}
+    strategy.pipeline_configs = {"accumulate_steps": M,
+                                 "micro_batch_size": MICRO}
+    fleet._fleet_state.update(initialized=False, hcg=None, strategy=None)
+    hcg = fleet.init(is_collective=True, strategy=strategy)
+    # pp*2 layers: divisible by pp*vpp for vpp in {1, 2}, and the SAME
+    # model for both schedules so equal-M rows compare fairly (PR-4's
+    # model family, so step_ms stays comparable across rounds)
+    cfg = GPTConfig(vocab_size=512, hidden_size=128,
+                    num_layers=pp * 2, num_heads=4,
+                    max_position_embeddings=64)
+    model = GPTForCausalLMPipe(cfg)
+    return hcg, cfg, model
+
+
+def _time_min(run, steps: int, repeats: int) -> float:
+    """min over ``repeats`` of mean-of-``steps``: robust to host
+    contention spikes (a single slow block would fake a bubble)."""
+    best = float("inf")
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            out = run()
+        jax.block_until_ready(out)
+        best = min(best, (time.perf_counter() - t0) / steps)
+    return best
+
+
+def measure_step(pp: int, M_list, vpp: int = 1, steps: int = 6,
+                 repeats: int = 3):
+    """Full train_batch wall time (throughput view, PR-4 instrument)."""
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed import fleet
+
     results = {}
     for M in M_list:
-        paddle.seed(0)
-        strategy = fleet.DistributedStrategy()
-        strategy.hybrid_configs = {"dp_degree": 1, "mp_degree": 1,
-                                   "pp_degree": pp}
-        strategy.pipeline_configs = {"accumulate_steps": M,
-                                     "micro_batch_size": 2}
-        fleet._fleet_state.update(initialized=False, hcg=None,
-                                  strategy=None)
-        hcg = fleet.init(is_collective=True, strategy=strategy)
-        cfg = GPTConfig(vocab_size=512, hidden_size=128,
-                        num_layers=pp * 2, num_heads=4,
-                        max_position_embeddings=64)
-        model = GPTForCausalLMPipe(cfg)
+        hcg, cfg, model = _build(pp, vpp, M)
         dist_model = fleet.distributed_model(model)
         opt = fleet.distributed_optimizer(
             paddle.optimizer.AdamW(learning_rate=1e-4,
                                    parameters=model.parameters()))
         r = np.random.RandomState(0)
-        B, S = 2 * M, 32
-        ids = r.randint(0, cfg.vocab_size, (B, S + 1))
+        B = MICRO * M
+        ids = r.randint(0, cfg.vocab_size, (B, SEQ + 1))
         x = paddle.to_tensor(ids[:, :-1])
         y = paddle.to_tensor(ids[:, 1:])
-        loss = dist_model.train_batch([x, y], opt)     # compile+warm
-        float(loss)
-        t0 = time.perf_counter()
-        for _ in range(steps):
-            loss = dist_model.train_batch([x, y], opt)
-        float(loss)
-        dt = (time.perf_counter() - t0) / steps
-        results[M] = dt
-        print(f"  pp={pp} M={M:3d}  step={dt*1e3:8.1f} ms", flush=True)
+        float(dist_model.train_batch([x, y], opt))     # compile+warm
+
+        def run():
+            return dist_model.train_batch([x, y], opt)._value
+
+        results[M] = _time_min(run, steps, repeats)
+        print(f"  [step] pp={pp} vpp={vpp} M={M:3d}  "
+              f"{results[M]*1e3:8.1f} ms", flush=True)
     return results
+
+
+def measure_pipe_all(pp: int, M_list, steps: int = 8, rounds: int = 5):
+    """The pipelined middle's fwd+bwd program alone — the schedule's
+    scan + ppermute + per-tick remat, nothing else.
+
+    All (vpp, M) programs are built/compiled/warmed UP FRONT, then
+    timed in interleaved rounds taking the per-config min: process
+    state (allocator, threadpool, frequency) drifts over a run, and
+    measuring configs back-to-back per round makes every config see
+    the same ambient conditions instead of the first-measured ones
+    eating the cold phase."""
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from paddle_tpu.distributed import collective as C
+    from paddle_tpu.distributed.engine import _shard_map, global_put
+
+    runners = {}
+    for vpp in (1, 2):
+        for M in M_list:
+            hcg, cfg, model = _build(pp, vpp, M)
+            mesh = hcg.mesh
+            model._num_microbatches = M
+            sparams = model.parameters_in_stacked_blocks
+            svals = tuple(p._value for p in sparams)
+            sspecs = tuple(p.dist_attr for p in sparams)
+            fn = model._pipe_fn(M, jnp.uint32(7), ("pp",))
+
+            def fwdbwd(x, *sv, _fn=fn):
+                from jax import lax
+
+                with C.spmd_region():
+                    y, vjp = jax.vjp(_fn, x, *sv)
+                    grads = vjp(jnp.ones_like(y))
+                    # scalar probe so the fwd result is live; grads
+                    # carry the reverse schedule's cost
+                    return lax.psum(jnp.sum(y), "pp"), grads[1:]
+
+            sm = _shard_map(fwdbwd, mesh, (P(),) + sspecs, (P(), sspecs))
+            jfn = jax.jit(sm)
+            r = np.random.RandomState(0)
+            B = MICRO * M
+            x = global_put(
+                r.standard_normal(
+                    (B, SEQ, cfg.hidden_size)).astype("float32"),
+                mesh, P())
+            jax.block_until_ready(jfn(x, *svals))      # compile+warm
+
+            def run(_jfn=jfn, _x=x, _sv=svals):
+                return _jfn(_x, *_sv)[0]
+
+            runners[(vpp, M)] = run
+
+    best = {k: float("inf") for k in runners}
+    for _ in range(rounds):
+        for k, run in runners.items():
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                out = run()
+            jax.block_until_ready(out)
+            best[k] = min(best[k], (time.perf_counter() - t0) / steps)
+    for (vpp, M), t in sorted(best.items()):
+        print(f"  [pipe] pp={pp} vpp={vpp} M={M:3d}  {t*1e3:8.1f} ms",
+              flush=True)
+    return ({M: best[(1, M)] for M in M_list},
+            {M: best[(2, M)] for M in M_list})
+
+
+def _fit(res):
+    """Least-squares (tau, intercept) of the min-timed t(M) curve."""
+    Ms = sorted(res)
+    xs = np.array(Ms, dtype=float)
+    ys = np.array([res[M] for M in Ms])
+    tau, b = np.polyfit(xs, ys, 1)
+    return float(tau), float(b)
+
+
+def _realized_pair(pipe1, pipe2, S):
+    """Realized bubbles of both schedules, floor-corrected.
+
+    Model: t_v(M) = tick_v * T_v(M) + c, with T_v = v*M + S - 1 ticks
+    of tick_v = tau_v / v each, and c an M-independent harness floor
+    (jit dispatch + buffer setup — host work, not schedule). Both
+    curves share c, so the two LS intercepts b_v = (S-1)*tick_v + c
+    give two independent floor estimates; their mean is removed before
+    computing bubble = 1 - M*tau_v/(t_v(M) - c).
+
+    The raw (uncorrected) bubbles are reported alongside — the
+    correction only removes the harness floor, the schedule content
+    (is tick_2 really ~tick_1/2? does the leftover match (S-1) ticks?)
+    stays measured."""
+    tau1, b1 = _fit(pipe1)
+    tau2, b2 = _fit(pipe2)
+    c1 = b1 - (S - 1) * tau1            # tick_1 = tau_1
+    c2 = b2 - (S - 1) * tau2 / 2.0      # tick_2 = tau_2 / 2
+    c = max(0.0, (c1 + c2) / 2.0)
+
+    def bub(res, tau):
+        return {M: max(0.0, 1.0 - M * tau / max(res[M] - c, 1e-9))
+                for M in res}
+
+    def raw(res, tau):
+        return {M: max(0.0, 1.0 - M * tau / res[M]) for M in res}
+
+    return {"tau1": tau1, "tau2": tau2, "floor": c,
+            "real1": bub(pipe1, tau1), "real2": bub(pipe2, tau2),
+            "raw1": raw(pipe1, tau1), "raw2": raw(pipe2, tau2)}
 
 
 def main():
@@ -91,30 +252,45 @@ def main():
            "n_devices": jax.device_count(), "pp": {}}
     for pp in (2, 4):
         M_list = [pp, 2 * pp, 4 * pp, 8 * pp]
-        res = measure(pp, M_list)
-        Ms = sorted(res)
-        # marginal per-microbatch time from the two largest M
-        tau = (res[Ms[-1]] - res[Ms[-2]]) / (Ms[-1] - Ms[-2])
+        step1 = measure_step(pp, M_list, vpp=1)
+        step2 = measure_step(pp, M_list, vpp=2)
+        pipe1, pipe2 = measure_pipe_all(pp, M_list)
+        r = _realized_pair(pipe1, pipe2, pp)
+        real1, real2 = r["real1"], r["real2"]
         rows = []
-        for M in Ms:
-            realized = max(0.0, 1.0 - M * tau / res[M])
+        for M in M_list:
             gpipe = (pp - 1) / (M + pp - 1)
             vpp2 = (pp - 1) / (2 * M + pp - 1)
             rows.append({
-                "M": M, "step_ms": round(res[M] * 1e3, 2),
-                "bubble_realized": round(realized, 4),
+                "M": M,
+                "step_ms": round(step1[M] * 1e3, 2),
+                "step_ms_vpp2": round(step2[M] * 1e3, 2),
+                "pipe_ms": round(pipe1[M] * 1e3, 2),
+                "pipe_ms_vpp2": round(pipe2[M] * 1e3, 2),
+                "bubble_realized": round(real1[M], 4),
+                "bubble_realized_vpp2": round(real2[M], 4),
+                "bubble_raw": round(r["raw1"][M], 4),
+                "bubble_raw_vpp2": round(r["raw2"][M], 4),
                 "bubble_analytic_gpipe_1f1b": round(gpipe, 4),
                 "bubble_analytic_vpp2": round(vpp2, 4),
             })
-        out["pp"][str(pp)] = {"tau_ms": round(tau * 1e3, 3), "rows": rows}
-        # the decision number: does M=8S beat interleave-vpp2 at M=2S?
-        big_M = rows[-1]["bubble_realized"]
-        vpp2_small = (pp - 1) / (2 * (2 * pp) + pp - 1)
-        out["pp"][str(pp)]["raise_M_beats_vpp2_at_2S"] = \
-            bool(big_M <= vpp2_small)
-        print(f"pp={pp}: tau={tau*1e3:.2f}ms  bubble(M={Ms[-1]})="
-              f"{big_M:.3f} vs analytic vpp2@M={2*pp}:"
-              f" {vpp2_small:.3f}", flush=True)
+        entry = {"tau_ms": round(r["tau1"] * 1e3, 3),
+                 "tau_ms_vpp2": round(r["tau2"] * 1e3, 3),
+                 "dispatch_floor_ms": round(r["floor"] * 1e3, 3),
+                 "rows": rows}
+        # decision numbers, both sides REALIZED now that the circular
+        # schedule exists (see module docstring)
+        big_M = real1[M_list[-1]]
+        vpp2_small = real2[2 * pp]
+        entry["raise_M_beats_vpp2_at_2S"] = bool(big_M <= vpp2_small)
+        entry["vpp2_beats_vpp1_at_equal_M"] = bool(
+            all(real2[M] < real1[M] for M in M_list))
+        out["pp"][str(pp)] = entry
+        print(f"pp={pp}: tau={r['tau1']*1e3:.2f}ms "
+              f"tau_vpp2={r['tau2']*1e3:.2f}ms "
+              f"floor={r['floor']*1e3:.2f}ms  "
+              f"bubble(vpp1,M={M_list[-1]})={big_M:.3f} vs realized "
+              f"vpp2@M={2*pp}: {vpp2_small:.3f}", flush=True)
     path = os.path.join(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))), "PP_SCHEDULE.json")
     with open(path, "w") as f:
