@@ -406,6 +406,15 @@ def bench_serving_mixed(on_tpu, dev):
             rows = snap[name]["series"]
             return round(rows[0][q], 6) if rows else 0.0
 
+        # per-request lifecycle span percentiles (queued / prefill /
+        # decode / e2e) from the bounded trace ring's stage histogram
+        spans = {
+            row["labels"]["stage"]: {"count": row["count"],
+                                     "p50": round(row["p50"], 6),
+                                     "p99": round(row["p99"], 6)}
+            for row in snap["paddle_tpu_serving_request_stage_seconds"]
+            ["series"]}
+
         _emit({
             "metric": "serving_mixed_traffic_tokens_per_sec" if on_tpu
             else "serving_smoke_mixed_traffic_tokens_per_sec",
@@ -423,6 +432,8 @@ def bench_serving_mixed(on_tpu, dev):
             "recompiles_after_warmup": eng.stats.compiles - compiles_warm,
             "batch": B, "page_size": page, "decode_chunk": chunk,
             "requests": len(stream), "tokens": n_tok,
+            "request_spans": spans,
+            "request_traces": len(eng.traces),
             "telemetry": _telemetry_section(),
             "device": str(getattr(dev, "device_kind", dev.platform)),
         })
@@ -504,6 +515,27 @@ def bench_gpt13b_hybrid(on_tpu, dev):
         float(loss)
         dt = time.perf_counter() - t0
         tok_s = B * S * steps / dt
+        # exposed-comm attribution (observability/commledger): per-axis
+        # overlapped-vs-exposed split + grad_sync_exposed_seconds. The
+        # gauges land in the telemetry section below; the compact
+        # report rides on the line itself. Offline pass — state is
+        # restored and the compile counters above are not perturbed.
+        prof = dist_model.profile_exposed_comm([x, y], repeats=2)
+        exposed_comm = {
+            "step_seconds": round(prof.step_seconds, 6),
+            "exposed_seconds": {a: round(v, 6) for a, v in
+                                prof.exposed_seconds.items()},
+            "replay_seconds": {a: round(v, 6) for a, v in
+                               prof.replay_seconds.items()},
+            "exposed_fraction": {a: round(v, 4) for a, v in
+                                 prof.exposed_fraction.items()},
+            "grad_sync_exposed_seconds": round(
+                prof.grad_sync_exposed_seconds, 6),
+        }
+        led = dist_model._engine.comm_ledger()
+        comm_bytes_per_step = {
+            f"{a}/{o}": round(t["bytes"], 1)
+            for (a, o), t in sorted(led.totals().items())} if led else {}
         peak, _ = _chip(dev)
         n_params = cfg.num_params()
         mfu = (6.0 * n_params * tok_s / (peak * n)) if peak else 0.0
@@ -525,6 +557,12 @@ def bench_gpt13b_hybrid(on_tpu, dev):
             "compiles": stats.compiles,
             "cache_hits": stats.cache_hits,
             "recompiles_after_warmup": stats.compiles - compiles_warm,
+            # static comm ledger of the compiled step (bytes-on-wire
+            # per participant per step, by axis/op) + the exposed-comm
+            # attribution — the instrument panel quant_comm / T3
+            # overlap / MoE a2a report through
+            "comm_bytes_per_step": comm_bytes_per_step,
+            "exposed_comm": exposed_comm,
             "telemetry": _telemetry_section(),
             "device": str(getattr(dev, "device_kind", dev.platform)),
         })

@@ -296,6 +296,120 @@ def axis_index(axis_names: Tuple[str, ...]):
 
 
 # ---------------------------------------------------------------------------
+# Traced-collective shim (the comm ledger's interposition point).
+#
+# EVERY in-graph collective in the tree funnels through these t_*
+# wrappers instead of calling lax.* directly, so that
+# observability/commledger.py sees each one at TRACE time (op kind,
+# axes, local shape/dtype, group size) and the exposed-comm profiler
+# can ablate an axis's collectives into shape-preserving local ops.
+# With no capture and no ablation active they ARE the lax call — the
+# fast path adds one predicate per traced call site and nothing to the
+# compiled program.
+# ---------------------------------------------------------------------------
+
+
+def _flat_axes(axes) -> Tuple[str, ...]:
+    if isinstance(axes, str):
+        return (axes,)
+    flat: List[str] = []
+    for a in axes:
+        flat.extend(a if isinstance(a, (tuple, list)) else (a,))
+    return tuple(flat)
+
+
+def _group_size(axes: Tuple[str, ...]) -> int:
+    p = 1
+    for a in axes:
+        p *= int(axis_size(a))
+    return p
+
+
+def _note_shim(op: str, axes, x, args: Tuple = ()):
+    """If the ledger is active: note the collective and answer
+    (group size, is-this-axis-group-ablated). Trace-time host
+    bookkeeping only — adds nothing to the compiled program."""
+    from ..observability import commledger as cl
+
+    if not cl.active():
+        return None, False
+    flat = _flat_axes(axes)
+    p = _group_size(flat)
+    cl.note(op, flat, tuple(getattr(x, "shape", ())),
+            getattr(x, "dtype", "float32"), p, args)
+    return p, cl.ablating("+".join(flat))
+
+
+def t_psum(x, axes):
+    p, abl = _note_shim("psum", axes, x)
+    return x if abl else lax.psum(x, axes)
+
+
+def t_pmean(x, axes):
+    # wire-identical to psum (ledger kind "psum"); ablated = identity
+    p, abl = _note_shim("psum", axes, x)
+    return x if abl else lax.pmean(x, axes)
+
+
+def t_pmax(x, axes):
+    p, abl = _note_shim("pmax", axes, x)
+    return x if abl else lax.pmax(x, axes)
+
+
+def t_pmin(x, axes):
+    p, abl = _note_shim("pmin", axes, x)
+    return x if abl else lax.pmin(x, axes)
+
+
+def _abl_gather(x, p, axis):
+    """Ablated all_gather: p local copies (shape-preserving stand-in)."""
+    return jnp.concatenate([x] * p, axis=axis)
+
+
+def _abl_scatter(x, p, dim):
+    """Ablated reduce_scatter: keep the leading 1/p local chunk."""
+    return lax.slice_in_dim(x, 0, x.shape[dim] // p, axis=dim)
+
+
+def _abl_a2a(x, p, split_axis, concat_axis):
+    """Ablated all_to_all: local reshuffle with the same output shape."""
+    if split_axis == concat_axis:
+        return x
+    y = lax.slice_in_dim(x, 0, x.shape[split_axis] // p, axis=split_axis)
+    return jnp.concatenate([y] * p, axis=concat_axis)
+
+
+def t_all_gather(x, axes, axis=0, tiled=True):
+    p, abl = _note_shim("all_gather", axes, x, (int(axis),))
+    return _abl_gather(x, p, axis) if (abl and tiled) else \
+        lax.all_gather(x, axes, axis=axis, tiled=tiled)
+
+
+def t_psum_scatter(x, axes, scatter_dimension=0, tiled=True):
+    p, abl = _note_shim("reduce_scatter", axes, x,
+                        (int(scatter_dimension),))
+    return _abl_scatter(x, p, scatter_dimension) if (abl and tiled) else \
+        lax.psum_scatter(x, axes, scatter_dimension=scatter_dimension,
+                         tiled=tiled)
+
+
+def t_all_to_all(x, axes, split_axis=0, concat_axis=0, tiled=True):
+    p, abl = _note_shim("all_to_all", axes, x,
+                        (int(split_axis), int(concat_axis)))
+    return _abl_a2a(x, p, split_axis, concat_axis) if (abl and tiled) \
+        else lax.all_to_all(x, axes, split_axis=split_axis,
+                            concat_axis=concat_axis, tiled=tiled)
+
+
+def t_ppermute(x, axes, perm):
+    perm = tuple(tuple(pr) for pr in perm)
+    flat = _flat_axes(axes)
+    _, abl = _note_shim("ppermute", flat, x, (perm,))
+    return x if abl else lax.ppermute(
+        x, flat[0] if len(flat) == 1 else flat, perm=list(perm))
+
+
+# ---------------------------------------------------------------------------
 # Collective kernels (registered ops so autograd records them; analog of
 # phi collective kernels phi/kernels/gpu/all_reduce_kernel.cu etc.)
 # ---------------------------------------------------------------------------
@@ -303,21 +417,21 @@ def axis_index(axis_names: Tuple[str, ...]):
 
 def _psum_like(x, op: int, axes):
     if op == ReduceOp.SUM:
-        return lax.psum(x, axes)
+        return t_psum(x, axes)
     if op == ReduceOp.MAX:
-        return lax.pmax(x, axes)
+        return t_pmax(x, axes)
     if op == ReduceOp.MIN:
-        return lax.pmin(x, axes)
+        return t_pmin(x, axes)
     if op == ReduceOp.AVG:
-        return lax.pmean(x, axes)
+        return t_pmean(x, axes)
     if op == ReduceOp.PROD:
         # sign/zero-correct product: magnitude via exp∘psum∘log of |x|,
         # sign via negative-count parity, zero if any member holds a zero
-        zero = lax.pmax((x == 0).astype(x.dtype), axes)
-        negs = lax.psum((x < 0).astype(jnp.int32), axes)
+        zero = t_pmax((x == 0).astype(x.dtype), axes)
+        negs = t_psum((x < 0).astype(jnp.int32), axes)
         sign = jnp.where(negs % 2 == 0, jnp.ones_like(x), -jnp.ones_like(x))
         safe = jnp.where(x == 0, jnp.ones_like(x), jnp.abs(x))
-        mag = jnp.exp(lax.psum(jnp.log(safe), axes))
+        mag = jnp.exp(t_psum(jnp.log(safe), axes))
         return jnp.where(zero > 0, jnp.zeros_like(x), sign * mag)
     raise ValueError(f"bad reduce op {op}")
 
@@ -329,18 +443,18 @@ def _c_allreduce(x, op=0, axes=()):
 
 @def_op("c_allgather")
 def _c_allgather(x, axes=(), axis=0):
-    return lax.all_gather(x, axes, axis=axis, tiled=True)
+    return t_all_gather(x, axes, axis=axis, tiled=True)
 
 
 @def_op("c_reducescatter")
 def _c_reducescatter(x, axes=(), axis=0):
-    return lax.psum_scatter(x, axes, scatter_dimension=axis, tiled=True)
+    return t_psum_scatter(x, axes, scatter_dimension=axis, tiled=True)
 
 
 @def_op("c_alltoall")
 def _c_alltoall(x, axes=(), split_axis=0, concat_axis=0):
-    return lax.all_to_all(x, axes, split_axis=split_axis,
-                          concat_axis=concat_axis, tiled=True)
+    return t_all_to_all(x, axes, split_axis=split_axis,
+                        concat_axis=concat_axis, tiled=True)
 
 
 @def_op("c_broadcast")
@@ -348,13 +462,12 @@ def _c_broadcast(x, axes=(), src=0):
     # broadcast = select src's value on every member
     idx = axis_index(axes)
     masked = jnp.where(idx == src, x, jnp.zeros_like(x))
-    return lax.psum(masked, axes)
+    return t_psum(masked, axes)
 
 
 @def_op("c_ppermute")
 def _c_ppermute(x, axes=(), perm=()):
-    return lax.ppermute(x, axes[0] if len(axes) == 1 else axes,
-                        perm=[tuple(p) for p in perm])
+    return t_ppermute(x, axes, perm)
 
 
 # ---------------------------------------------------------------------------

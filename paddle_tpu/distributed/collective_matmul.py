@@ -174,12 +174,12 @@ def _ag_matmul_body(x, w, axes, axis):
     up_perm, dn_perm = _perms(p)
     up = dn = x
     for t in range(1, (p - 1) // 2 + 1):
-        up = lax.ppermute(up, name, up_perm)
-        dn = lax.ppermute(dn, name, dn_perm)
+        up = C.t_ppermute(up, name, up_perm)
+        dn = C.t_ppermute(dn, name, dn_perm)
         out = place(out, _mm(up, w), (idx - t) % p)
         out = place(out, _mm(dn, w), (idx + t) % p)
     if p % 2 == 0:
-        up = lax.ppermute(up, name, up_perm)
+        up = C.t_ppermute(up, name, up_perm)
         out = place(out, _mm(up, w), (idx - p // 2) % p)
     return out
 
@@ -203,7 +203,7 @@ def _matmul_rs_body(x, w, axes, axis):
         return acc
     perm = [(i, (i - 1) % p) for i in range(p)]
     for t in range(1, p):
-        nxt = lax.ppermute(acc, name, perm)
+        nxt = C.t_ppermute(acc, name, perm)
         acc = nxt + _mm(chunk((idx + 1 + t) % p), w)
     return acc
 
@@ -226,11 +226,11 @@ def _grad_w_ring(shard, full, axes, axis):
     up_perm, dn_perm = _perms(p)
     up = dn = shard
     for t in range(1, (p - 1) // 2 + 1):
-        up = lax.ppermute(up, name, up_perm)
-        dn = lax.ppermute(dn, name, dn_perm)
+        up = C.t_ppermute(up, name, up_perm)
+        dn = C.t_ppermute(dn, name, dn_perm)
         dw = dw + _tdot(up, sl((idx - t) % p)) + _tdot(dn, sl((idx + t) % p))
     if p % 2 == 0:
-        up = lax.ppermute(up, name, up_perm)
+        up = C.t_ppermute(up, name, up_perm)
         dw = dw + _tdot(up, sl((idx - p // 2) % p))
     return dw
 
@@ -282,7 +282,7 @@ matmul_rs.defvjp(_matmul_rs_fwd, _matmul_rs_bwd)
 
 def _matmul_allreduce_body(x, w, axes, axis):
     out = _matmul_rs_body(x, w, axes, axis)
-    return lax.all_gather(out, axes, axis=axis, tiled=True)
+    return C.t_all_gather(out, axes, axis=axis, tiled=True)
 
 
 @partial(jax.custom_vjp, nondiff_argnums=(2, 3))
@@ -312,8 +312,8 @@ def _matmul_gather_body(x, w, axes, nchunks):
     parts = []
     for j in range(nchunks):
         xj = lax.slice_in_dim(x, j * c, (j + 1) * c, axis=0)
-        parts.append(lax.all_gather(_mm(xj, w), axes, axis=xj.ndim - 1,
-                                    tiled=True))
+        parts.append(C.t_all_gather(_mm(xj, w), axes,
+                                     axis=xj.ndim - 1, tiled=True))
     return jnp.concatenate(parts, axis=0) if nchunks > 1 else parts[0]
 
 

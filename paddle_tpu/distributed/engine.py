@@ -36,6 +36,7 @@ from . import collective as C
 from ..autograd import engine as _ad
 from ..core import rng as _rng
 from ..core.compile_stats import CompileStats
+from ..observability import commledger as _cl
 from ..observability import flops as _flops
 from ..observability.catalog import train_metrics as _train_metrics
 from ..tensor import Tensor
@@ -308,6 +309,13 @@ class ParallelEngine:
         self._stats_reported = (0, 0)    # (compiles, cache_hits) synced
         self._pending_scalars = None     # (loss_dev, gnorm_dev) lazy
         self._prev_step_entry = None
+        # per-program static comm ledgers (observability/commledger):
+        # filled when a program first traces, re-published every step
+        self._ledgers: Dict[Any, Any] = {}
+        self._last_key = None
+        # profile_exposed_comm() replays: suppress telemetry/counters
+        # so offline attribution never pollutes the live metrics
+        self._profiling = False
         self._zero = _ZeroPlan(mesh, self.trainable, optimizer)
         # LazyGuard-built params materialize straight into their (zero3-
         # aware) storage sharding: O(shard) bytes per process, no full-
@@ -428,7 +436,7 @@ class ParallelEngine:
             for i, p in enumerate(params):
                 e = zero.entry(p)
                 if e is not None and e[1]:
-                    pvals[i] = lax.all_gather(pvals[i], zero.axis,
+                    pvals[i] = C.t_all_gather(pvals[i], zero.axis,
                                               axis=e[0], tiled=True)
             pvals = tuple(pvals)
             with bind_params(params, pvals):
@@ -466,12 +474,12 @@ class ParallelEngine:
                         dp_only = tuple(a for a in gmean_axes
                                         if a != zero.axis)
                         if dp_only:
-                            g = lax.pmean(g, dp_only)
+                            g = C.t_pmean(g, dp_only)
                         psum_axes = _grad_axes(p)
                         if psum_axes:
-                            g = lax.psum(g, psum_axes)
+                            g = C.t_psum(g, psum_axes)
                         if zero.axis in data_axes:
-                            g = lax.psum_scatter(
+                            g = C.t_psum_scatter(
                                 g, zero.axis, scatter_dimension=dim,
                                 tiled=True) / zero.n
                         else:
@@ -488,7 +496,7 @@ class ParallelEngine:
                         pm = tuple(a for a in gmean_axes
                                    if a not in spec_axes)
                         if pm:
-                            g = lax.pmean(g, pm)
+                            g = C.t_pmean(g, pm)
                         dup = 1
                         for a in gmean_axes:
                             if a in spec_axes:
@@ -497,7 +505,7 @@ class ParallelEngine:
                             g = g / dup
                         psum_axes = _grad_axes(p)
                         if psum_axes:
-                            g = lax.psum(g, psum_axes)
+                            g = C.t_psum(g, psum_axes)
                         upd_in.append(mvals[i] if mvals and i in mvals
                                       else pvals[i])
                     grads.append(g)
@@ -514,7 +522,7 @@ class ParallelEngine:
                     sync_axes = tuple(a for a in mesh.axis_names
                                       if mesh.shape[a] > 1)
                     if sync_axes:
-                        found = lax.pmax(found, sync_axes)
+                        found = C.t_pmax(found, sync_axes)
                     found_b = found > 0
                     # unscale in f32; zero overflowed grads so the (thrown
                     # away) update math stays NaN-free
@@ -539,7 +547,7 @@ class ParallelEngine:
                                if a in mesh.axis_names
                                and mesh.shape[a] > 1)
                     if ax:
-                        loc = lax.psum(loc, ax)
+                        loc = C.t_psum(loc, ax)
                     gsq = gsq + loc
                 gnorm = jnp.sqrt(gsq)
                 new_p, new_s = opt._fused_update(
@@ -582,7 +590,7 @@ class ParallelEngine:
                         # stage 1/2: params stay replicated — gather the
                         # updated shards (the reference's param broadcast,
                         # dygraph_sharding_optimizer.py:317)
-                        nv_p = lax.all_gather(nv, zero.axis, axis=e[0],
+                        nv_p = C.t_all_gather(nv, zero.axis, axis=e[0],
                                               tiled=True)
                     else:
                         nv_p = nv
@@ -595,7 +603,7 @@ class ParallelEngine:
                 all_axes = tuple(a for a in mesh.axis_names
                                  if mesh.shape[a] > 1)
                 if all_axes:
-                    lv = lax.pmean(lv, all_axes)
+                    lv = C.t_pmean(lv, all_axes)
             return lv, gnorm, tuple(out_p), tuple(new_s), out_m, amp_out
 
         def make(batch_treedef, b_specs, mspecs):
@@ -644,10 +652,15 @@ class ParallelEngine:
             amp_key = ((scaler._dynamic, scaler._incr_every,
                         scaler._decr_every, scaler._incr_ratio,
                         scaler._decr_ratio) if use_scaler else None)
+            # commledger.ablation_token() keys the exposed-comm
+            # profiler's comm-ablated replays OUT of the real program
+            # cache (None in normal operation, so live keys are
+            # unchanged and steady state stays recompile-free)
             key = (treedef, tuple((v.shape, str(v.dtype))
                                   for v in leaf_vals), b_specs,
-                   tuple(sorted(mvals)), amp_key)
-            self.stats.note("train", key)
+                   tuple(sorted(mvals)), amp_key, _cl.ablation_token())
+            if not self._profiling:
+                self.stats.note("train", key)
             if key not in self._compiled:
                 self._compiled[key] = make(treedef, b_specs, mspecs)
             pvals = tuple(p._value for p in params)
@@ -674,8 +687,17 @@ class ParallelEngine:
                                    for v in amp_in)
                     scaler._dev = amp_in
                     scaler._dev_global = True
-            lv, gnorm, new_p, new_s, new_m, amp_out = self._compiled[key](
-                pvals, svals, mvals, leaf_vals, lr, stepc, seed, amp_in)
+            # the capture collects comm notes only if THIS call traces
+            # (first execution of the program); cached executions note
+            # nothing and reuse the stored ledger
+            with _cl.capture() as cap:
+                lv, gnorm, new_p, new_s, new_m, amp_out = \
+                    self._compiled[key](pvals, svals, mvals, leaf_vals,
+                                        lr, stepc, seed, amp_in)
+            if len(cap):
+                self._ledgers[key] = cap
+            if not self._profiling:
+                self._last_key = key
             for p, nv in zip(params, new_p):
                 p._value = nv
             for p, ns in zip(trainable, new_s):
@@ -688,7 +710,12 @@ class ParallelEngine:
 
             if isinstance(opt._lr, LRScheduler):
                 opt._lr.step()  # advance the schedule once per train step
-            self._note_step(t_entry, n_tok, lv, gnorm)
+            if not self._profiling:
+                led = self._ledgers.get(key)
+                if led is not None:
+                    led.publish(self._metrics["comm_bytes"],
+                                self._metrics["comm_ops"])
+                self._note_step(t_entry, n_tok, lv, gnorm)
             return Tensor(lv, stop_gradient=True)
 
         return step
@@ -793,6 +820,123 @@ class ParallelEngine:
                 "pod_tokens_per_sec": total,
                 "processes": float(jax.process_count())}
 
+    # -- communication accounting (observability/commledger) ------------
+    def comm_ledger(self):
+        """The static comm ledger of the last-run compiled step (None
+        before any step has traced)."""
+        return self._ledgers.get(self._last_key)
+
+    def _state_snapshot(self):
+        """Device-copy of everything a step mutates (jnp.copy keeps
+        each array's sharding), so offline replays can be undone."""
+        opt = self.optimizer
+        snap = {
+            "params": [jnp.copy(p._value) for p in self.params],
+            "states": {id(p): {k: (jnp.copy(v) if hasattr(v, "shape")
+                                   else v)
+                               for k, v in opt._states[id(p)].items()}
+                       for p in self.trainable if id(p) in opt._states},
+            "masters": {k: jnp.copy(v)
+                        for k, v in opt._master_weights.items()},
+            "step_count": opt._step_count,
+            "seed": self._seed,
+            "pending": self._pending_scalars,
+        }
+        from ..optimizer.lr import LRScheduler
+
+        if isinstance(opt._lr, LRScheduler):
+            snap["lr_state"] = dict(opt._lr.__dict__)
+        return snap
+
+    def _state_restore(self, snap):
+        opt = self.optimizer
+        for p, v in zip(self.params, snap["params"]):
+            p._value = v
+        for pid, st in snap["states"].items():
+            opt._states[pid] = st
+        opt._master_weights = dict(snap["masters"])
+        opt._step_count = snap["step_count"]
+        self._seed = snap["seed"]
+        self._pending_scalars = snap["pending"]
+        if "lr_state" in snap:
+            opt._lr.__dict__.update(snap["lr_state"])
+
+    @staticmethod
+    def _time_calls(fn, repeats: int) -> float:
+        """Median wall time of ``fn()`` over ``repeats`` blocked calls
+        (one unmeasured warmup call first — it may compile)."""
+        jax.block_until_ready(fn())
+        times = []
+        for _ in range(max(repeats, 1)):
+            t0 = time.perf_counter()
+            jax.block_until_ready(fn())
+            times.append(time.perf_counter() - t0)
+        times.sort()
+        return times[len(times) // 2]
+
+    def profile_exposed_comm(self, step, batch, repeats: int = 3,
+                             publish: bool = True):
+        """Exposed-comm attribution: split each mesh axis's comm time
+        into exposed vs overlapped (observability/commledger.py).
+
+        For every axis label in the step's comm ledger this compiles a
+        REPLAY of the same step with that axis's collectives ablated to
+        shape-preserving local ops, and a standalone back-to-back
+        replay of the axis's recorded collectives; then
+
+        - exposed(axis) = t(full) - t(ablated): what the axis's comm
+          adds to the critical path,
+        - replay(axis): the axis's total comm time, nothing hiding it,
+        - comm_exposed_fraction{axis} = exposed / max(replay, exposed),
+        - grad_sync_exposed_seconds = exposed summed over dp/sharding.
+
+        Offline only: params / optimizer state / rng / lr schedule are
+        snapshotted and restored (the ablated replays compute garbage
+        on purpose), telemetry counters and CompileStats are suppressed
+        while it runs, and the replay executables are dropped from the
+        program cache afterwards — the next real step hits the original
+        compiled program. Run between steps, never under an AMP
+        GradScaler whose state you care about.
+
+        Returns an ``ExposedCommReport``; ``publish=True`` also sets
+        the comm_exposed_* / grad_sync_exposed_seconds gauges.
+        """
+        self._flush_pending_scalars()
+        led = self.comm_ledger()
+        if led is None or not len(led):
+            rep = _cl.build_report(0.0, {}, {})
+            if publish:
+                rep.publish(self._metrics)
+            return rep
+        snap = self._state_snapshot()
+        self._profiling = True
+        try:
+            t_full = self._time_calls(lambda: step(batch)._value, repeats)
+            exposed: Dict[str, float] = {}
+            replay: Dict[str, float] = {}
+            for label in led.axis_labels():
+                with _cl.ablate({label}):
+                    t_abl = self._time_calls(
+                        lambda: step(batch)._value, repeats)
+                exposed[label] = t_full - t_abl
+                recs = [r for r in led.records if r.axis == label]
+                rfn = _cl.replay_callable(recs, self.mesh, _shard_map,
+                                          jax.jit)
+                replay[label] = self._time_calls(rfn, repeats)
+        finally:
+            self._profiling = False
+            self._state_restore(snap)
+            # drop the ablated executables (ablation_token is the last
+            # key component; None marks the real programs)
+            self._compiled = {k: v for k, v in self._compiled.items()
+                              if k[-1] is None}
+            self._ledgers = {k: v for k, v in self._ledgers.items()
+                             if k[-1] is None}
+        rep = _cl.build_report(t_full, exposed, replay)
+        if publish:
+            rep.publish(self._metrics)
+        return rep
+
     def _check_mesh_epoch(self):
         if C.mesh_epoch() != self._mesh_epoch:
             from ..core.enforce import PreconditionNotMetError
@@ -819,7 +963,7 @@ class ParallelEngine:
                 for i, p in enumerate(params):
                     e = zero.entry(p)
                     if e is not None and e[1]:
-                        pvals[i] = lax.all_gather(pvals[i], zero.axis,
+                        pvals[i] = C.t_all_gather(pvals[i], zero.axis,
                                                   axis=e[0], tiled=True)
                 pvals = tuple(pvals)
                 with C.spmd_region(), bind_params(params, pvals), \

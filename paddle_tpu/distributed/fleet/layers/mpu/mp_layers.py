@@ -261,15 +261,15 @@ def _pce_fwd_impl(lv, lab, axes, ignore_index):
     off = idx * vloc
     # pmax input is stop_gradient'ed: the LSE max-shift is gradient-free
     # mathematically and pmax has no differentiation rule
-    maxl = lax.pmax(
+    maxl = C.t_pmax(
         lax.stop_gradient(jnp.max(lv, axis=-1, keepdims=True)), axes)
     shifted = lv - maxl
     expx = jnp.exp(shifted)
-    sumexp = lax.psum(jnp.sum(expx, axis=-1, keepdims=True), axes)
+    sumexp = C.t_psum(jnp.sum(expx, axis=-1, keepdims=True), axes)
     local_lab = jnp.clip(lab - off, 0, vloc - 1)
     in_shard = (lab >= off) & (lab < off + vloc)
     tgt = jnp.take_along_axis(shifted, local_lab[..., None], axis=-1)[..., 0]
-    tgt = lax.psum(jnp.where(in_shard, tgt, jnp.zeros((), lv.dtype)), axes)
+    tgt = C.t_psum(jnp.where(in_shard, tgt, jnp.zeros((), lv.dtype)), axes)
     valid = lab != ignore_index
     loss = jnp.where(valid, jnp.log(sumexp[..., 0]) - tgt,
                      jnp.zeros((), lv.dtype))[..., None]

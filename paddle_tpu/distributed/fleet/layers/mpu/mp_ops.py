@@ -62,23 +62,23 @@ def identity_psum_bwd(x, axes):
 
 
 identity_psum_bwd.defvjp(lambda x, axes: (x, None),
-                         lambda axes, _, g: (lax.psum(g, axes),))
+                         lambda axes, _, g: (C.t_psum(g, axes),))
 
 
 @partial(jax.custom_vjp, nondiff_argnums=(1,))
 def psum_identity_bwd(x, axes):
     """Forward psum over ``axes``; backward identity (g in Megatron)."""
-    return lax.psum(x, axes)
+    return C.t_psum(x, axes)
 
 
-psum_identity_bwd.defvjp(lambda x, axes: (lax.psum(x, axes), None),
+psum_identity_bwd.defvjp(lambda x, axes: (C.t_psum(x, axes), None),
                          lambda axes, _, g: (g,))
 
 
 @partial(jax.custom_vjp, nondiff_argnums=(1, 2))
 def allgather_slice_bwd(x, axes, axis=-1):
     """Forward all-gather (tiled) along ``axis``; backward local slice."""
-    return lax.all_gather(x, axes, axis=axis % x.ndim, tiled=True)
+    return C.t_all_gather(x, axes, axis=axis % x.ndim, tiled=True)
 
 
 def _ag_fwd(x, axes, axis):
@@ -106,7 +106,7 @@ def slice_allgather_bwd(x, axes, axis=-1):
 
 slice_allgather_bwd.defvjp(
     lambda x, axes, axis: (slice_allgather_bwd(x, axes, axis), None),
-    lambda axes, axis, _, g: (lax.all_gather(g, axes, axis=axis % g.ndim,
+    lambda axes, axis, _, g: (C.t_all_gather(g, axes, axis=axis % g.ndim,
                                              tiled=True),))
 
 
@@ -114,13 +114,13 @@ slice_allgather_bwd.defvjp(
 def allgather_reducescatter_bwd(x, axes, axis=0):
     """Forward all-gather along ``axis``; backward reduce-scatter (sum).
     The SP pairing (sequence_parallel_utils AllGatherOp)."""
-    return lax.all_gather(x, axes, axis=axis, tiled=True)
+    return C.t_all_gather(x, axes, axis=axis, tiled=True)
 
 
 def _agrs_bwd(axes, axis, _, g):
     out = g
     for a in axes:
-        out = lax.psum_scatter(out, a, scatter_dimension=axis, tiled=True)
+        out = C.t_psum_scatter(out, a, scatter_dimension=axis, tiled=True)
     return (out,)
 
 
@@ -135,13 +135,13 @@ def reducescatter_allgather_bwd(x, axes, axis=0):
     The SP pairing (sequence_parallel_utils ReduceScatterOp)."""
     out = x
     for a in axes:
-        out = lax.psum_scatter(out, a, scatter_dimension=axis, tiled=True)
+        out = C.t_psum_scatter(out, a, scatter_dimension=axis, tiled=True)
     return out
 
 
 reducescatter_allgather_bwd.defvjp(
     lambda x, axes, axis: (reducescatter_allgather_bwd(x, axes, axis), None),
-    lambda axes, axis, _, g: (lax.all_gather(g, axes, axis=axis,
+    lambda axes, axis, _, g: (C.t_all_gather(g, axes, axis=axis,
                                              tiled=True),))
 
 
@@ -163,7 +163,7 @@ def _c_identity(x: Tensor, group: Optional[C.Group] = None) -> Tensor:
     axes = mp_axes(group)
 
     def bwd(g):
-        return (lax.psum(g, axes),)
+        return (C.t_psum(g, axes),)
 
     return _custom("c_identity", identity_psum_bwd(x._value, axes), bwd, x)
 
@@ -208,6 +208,6 @@ def _c_split(x: Tensor, group: Optional[C.Group] = None) -> Tensor:
     axes = mp_axes(group)
 
     def bwd(g):
-        return (lax.all_gather(g, axes, axis=g.ndim - 1, tiled=True),)
+        return (C.t_all_gather(g, axes, axis=g.ndim - 1, tiled=True),)
 
     return _custom("c_split", slice_allgather_bwd(x._value, axes, -1), bwd, x)

@@ -75,6 +75,7 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from ..... import ops
+from .... import collective as C
 from .....autograd import engine as _engine
 from .....autograd.engine import no_grad
 from .....core import rng as _rng
@@ -571,7 +572,7 @@ class PipelineLayer(Layer):
                                                keepdims=False)
                 out_buf = lax.dynamic_update_index_in_dim(
                     out_buf, jnp.where(write, y, cur), idx, 0)
-                carry = lax.ppermute(y, axis, perm)
+                carry = C.t_ppermute(y, axis, perm)
                 return (carry, out_buf), None
 
             (carry, out_buf), _ = lax.scan(
@@ -777,7 +778,8 @@ class PipelineLayer(Layer):
 @partial(jax.custom_vjp, nondiff_argnums=(1, 2))
 def _pp_collect_raw(x, axes, src):
     stage = C.axis_index(axes)
-    return lax.psum(jnp.where(stage == src, x, jnp.zeros((), x.dtype)), axes)
+    return C.t_psum(jnp.where(stage == src, x,
+                             jnp.zeros((), x.dtype)), axes)
 
 
 _pp_collect_raw.defvjp(

@@ -100,14 +100,29 @@ class PipelineParallel(_DelegateWrapper):
             self._train_step = eng.train_step(fn)
         return self._train_step({"inputs": inputs, "labels": labels})
 
+    def profile_exposed_comm(self, data, repeats: int = 3,
+                             publish: bool = True):
+        """Exposed-comm attribution of the compiled pipeline step
+        (ParallelEngine.profile_exposed_comm): per-axis overlapped-vs-
+        exposed comm split + the grad_sync_exposed_seconds gauge.
+        Offline — run between steps; engine state is restored."""
+        inputs, labels = data
+        enforce(self._train_step is not None,
+                "run train_batch once before profile_exposed_comm "
+                "(the compiled step and its comm ledger must exist)")
+        return self._engine.profile_exposed_comm(
+            self._train_step, {"inputs": inputs, "labels": labels},
+            repeats=repeats, publish=publish)
+
     def eval_batch(self, data, compute_loss: bool = True):
         inputs, labels = data
         eng = self._engine
         enforce(eng is not None, "call train_batch once before eval_batch "
                 "(or use forward directly)")
         if compute_loss not in self._eval_steps:
-            from jax import lax
             from jax.sharding import PartitionSpec as P
+
+            from ... import collective as C
 
             axes = tuple(a for a in eng.mesh.axis_names
                          if eng.mesh.shape[a] > 1)
@@ -116,7 +131,7 @@ class PipelineParallel(_DelegateWrapper):
                 if _loss:
                     loss = model.compute_loss(batch["inputs"],
                                               batch["labels"])
-                    v = lax.pmean(loss._value, axes) if axes else loss._value
+                    v = C.t_pmean(loss._value, axes) if axes else loss._value
                     return Tensor(v, stop_gradient=True)
                 return model(batch["inputs"])
 

@@ -73,7 +73,7 @@ def scatter(x: Tensor, group=None, axis: int = 0) -> Tensor:
             f"sequence dim {x.shape[axis]} must divide mp degree")
 
     def bwd(g):
-        return (lax.all_gather(g, axes, axis=axis, tiled=True),)
+        return (C.t_all_gather(g, axes, axis=axis, tiled=True),)
 
     return _custom("sp_scatter", _slice_allgather_bwd(x._value, axes, axis),
                    bwd, x)
@@ -89,7 +89,7 @@ def all_gather(x: Tensor, group=None, axis: int = 0) -> Tensor:
     def bwd(g):
         out = g
         for a in axes:
-            out = lax.psum_scatter(out, a, scatter_dimension=axis,
+            out = C.t_psum_scatter(out, a, scatter_dimension=axis,
                                    tiled=True)
         return (out,)
 
@@ -121,7 +121,7 @@ def reduce_scatter(x: Tensor, group=None, axis: int = 0) -> Tensor:
     axes = mp_axes(group)
 
     def bwd(g):
-        return (lax.all_gather(g, axes, axis=axis, tiled=True),)
+        return (C.t_all_gather(g, axes, axis=axis, tiled=True),)
 
     return _custom("sp_reduce_scatter",
                    _rs_allgather_bwd(x._value, axes, axis), bwd, x)

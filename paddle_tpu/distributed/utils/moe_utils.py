@@ -28,15 +28,15 @@ __all__ = ["global_scatter", "global_gather"]
 
 def _a2a(x: Tensor, axes, split_axis: int, concat_axis: int,
          name: str) -> Tensor:
-    val = lax.all_to_all(x._value, axes, split_axis, concat_axis,
-                         tiled=True)
+    val = C.t_all_to_all(x._value, axes, split_axis, concat_axis,
+                          tiled=True)
     out = Tensor(val, stop_gradient=x.stop_gradient)
     if _engine.is_grad_enabled() and not x.stop_gradient:
         out.stop_gradient = False
 
         def bwd(g):
-            return (lax.all_to_all(g, axes, concat_axis, split_axis,
-                                   tiled=True),)
+            return (C.t_all_to_all(g, axes, concat_axis, split_axis,
+                                    tiled=True),)
 
         _engine.record_custom(name, bwd, [x], [out], val)
     return out

@@ -88,12 +88,16 @@ def _moe_forward(x2d, gate_w, w1, b1, w2, b2, axes, k, cap, act_fn):
     if axes:
         # [E, C, d] -> [E/n, n*C, d]: each rank keeps its experts, slots
         # from every source rank ride ICI
-        expert_in = lax.all_to_all(expert_in, axes, 0, 1, tiled=True)
+        from .....distributed import collective as C
+
+        expert_in = C.t_all_to_all(expert_in, axes, 0, 1, tiled=True)
     h = act_fn(jnp.einsum("ecd,edf->ecf", expert_in, w1)
                + b1[:, None, :].astype(dt))
     out = jnp.einsum("ecf,efd->ecd", h, w2) + b2[:, None, :].astype(dt)
     if axes:
-        out = lax.all_to_all(out, axes, 1, 0, tiled=True)
+        from .....distributed import collective as C
+
+        out = C.t_all_to_all(out, axes, 1, 0, tiled=True)
     y = jnp.einsum("ecd,tec->td", out, combine.astype(dt))
     return y, aux
 

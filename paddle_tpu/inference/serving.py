@@ -48,7 +48,9 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..core.enforce import enforce
+from ..observability import commledger as _cl
 from ..observability.catalog import serving_metrics as _serving_metrics
+from ..observability.spans import RequestTrace, SpanRing
 from ..tensor import Tensor
 
 __all__ = ["ServingEngine", "ServingRequest"]
@@ -102,7 +104,8 @@ class ServingEngine:
     """
 
     def __init__(self, predictor, max_batch: Optional[int] = None,
-                 pool_pages: Optional[int] = None, decode_chunk: int = 1):
+                 pool_pages: Optional[int] = None, decode_chunk: int = 1,
+                 trace_ring: int = 256):
         from . import _bucket
 
         cfg = predictor.config
@@ -143,6 +146,16 @@ class ServingEngine:
         self._metrics = _serving_metrics()
         self._stats_reported = (self.stats.compiles,
                                 self.stats.cache_hits)
+        # per-request lifecycle traces (observability/spans): live
+        # traces keyed by rid; finished ones land in a bounded ring
+        # with Chrome-trace export. Host-side perf_counter floats only.
+        self.traces = SpanRing(maxlen=trace_ring)
+        self._live_traces: Dict[int, RequestTrace] = {}
+        self._round = 0
+        # static comm ledgers of the prefill/decode programs (empty on
+        # a single-device mesh; populated the first time a program
+        # traces with collectives, republished per execution)
+        self._ledgers: Dict[Any, Any] = {}
         self.gen = cfg.generation
         self._rng = jax.random.PRNGKey(self.gen.seed)
         self._step_fns: Dict[Any, Any] = {}
@@ -169,8 +182,13 @@ class ServingEngine:
                 f"the pool only has {self.P - 1}; raise pool_pages")
         rid = self._next_rid
         self._next_rid += 1
+        now = time.perf_counter()
         self.queue.append(ServingRequest(rid, ids, n_new, eos,
-                                         t_submit=time.perf_counter()))
+                                         t_submit=now))
+        tr = RequestTrace(rid, meta={"prompt_len": L,
+                                     "max_new_tokens": n_new})
+        tr.begin("queued", now)
+        self._live_traces[rid] = tr
         self._metrics["requests"].inc(event="submitted")
         self._metrics["queue_depth"].set(len(self.queue))
         return rid
@@ -206,6 +224,13 @@ class ServingEngine:
             if backfill:
                 m["requests"].inc(event="backfilled")
             m["queue_depth"].set(len(self.queue))
+            tr = self._live_traces.get(req.rid)
+            if tr is not None:
+                sp = tr.end("queued", time.perf_counter())
+                tr.meta["backfill"] = bool(backfill)
+                if sp is not None:
+                    m["stage_seconds"].observe(sp.seconds,
+                                               stage="queued")
             self._prefill(b)
 
     def _prefill(self, b: int):
@@ -223,8 +248,9 @@ class ServingEngine:
         fn = self.pred._prefill_fn(1, Sb, self.M)
         self.stats.note("prefill", (1, Sb, self.M, self.page, self.P,
                                     str(ids.dtype), str(self._dtype)))
-        last, caches = fn(self._pvals(), jnp.asarray(ids), caches,
-                          jnp.asarray([L], jnp.int32))
+        last, caches = self._run_captured(
+            ("prefill", Sb), fn, self._pvals(), jnp.asarray(ids), caches,
+            jnp.asarray([L], jnp.int32))
         self.pools = [(c[0], c[1]) for c in caches]
         self._rng, sub = jax.random.split(self._rng)
         tok0 = int(np.asarray(_sample(last, sub, self.gen))[0])
@@ -236,6 +262,11 @@ class ServingEngine:
         m["prefill_seconds"].observe(now - t0)
         m["ttft"].observe(now - req.t_submit)
         m["tokens"].inc(1, phase="prefill")
+        tr = self._live_traces.get(req.rid)
+        if tr is not None:
+            tr.add("prefill", t0, now, {"seq_bucket": Sb})
+            m["stage_seconds"].observe(now - t0, stage="prefill")
+            tr.begin("decode", now)    # closed at eviction
         if len(req.new_tokens) >= req.max_new_tokens or \
                 (req.eos_token_id is not None and tok0 == req.eos_token_id):
             self._finish(b)
@@ -282,6 +313,8 @@ class ServingEngine:
         if not active:
             return
         t0 = time.perf_counter()
+        round_traces = [self._live_traces.get(self.slots[b].req.rid)
+                        for b in active]
         tok = np.zeros((self.B,), np.int32)
         pos = np.zeros((self.B,), np.int32)
         for b in active:
@@ -298,8 +331,9 @@ class ServingEngine:
                          self.gen.temperature, self.gen.top_k,
                          self.gen.top_p, str(self._dtype)))
         self._rng, sub = jax.random.split(self._rng)
-        toks, caches = fn(self._pvals(), jnp.asarray(tok), caches,
-                          jnp.asarray(pos), sub)
+        toks, caches = self._run_captured(
+            ("decode",), fn, self._pvals(), jnp.asarray(tok), caches,
+            jnp.asarray(pos), sub)
         self.pools = [(c[0], c[1]) for c in caches]
         toks = np.asarray(toks)
         emitted = 0
@@ -317,8 +351,18 @@ class ServingEngine:
         self.stats.count_tokens(("decode", self.B, self.chunk, self.P),
                                 emitted)
         m = self._metrics
-        m["decode_round_seconds"].observe(time.perf_counter() - t0)
+        now = time.perf_counter()
+        m["decode_round_seconds"].observe(now - t0)
         m["tokens"].inc(emitted, phase="decode")
+        # per-request decode-round spans: each request in flight this
+        # round gets one "decode_round" span on its trace lane (the
+        # Chrome export shows the shared rounds lining up across rids);
+        # round_traces was captured before evictions could retire them
+        for tr in round_traces:
+            if tr is not None:
+                tr.add("decode_round", t0, now,
+                       {"round": self._round, "chunk": self.chunk})
+        self._round += 1
 
     def _finish(self, b: int):
         """Evict a finished row: pages back on the free list, table row
@@ -335,6 +379,16 @@ class ServingEngine:
         if len(req.new_tokens) > 1 and req.t_first_token:
             m["tpot"].observe((req.t_finish - req.t_first_token)
                               / (len(req.new_tokens) - 1))
+        tr = self._live_traces.pop(req.rid, None)
+        if tr is not None:
+            sp = tr.end("decode", req.t_finish)
+            if sp is not None:
+                m["stage_seconds"].observe(sp.seconds, stage="decode")
+            tr.meta["new_tokens"] = len(req.new_tokens)
+            tr.add("e2e", req.t_submit, req.t_finish)
+            m["stage_seconds"].observe(req.t_finish - req.t_submit,
+                                       stage="e2e")
+            self.traces.add(tr)
 
     # -- driving ---------------------------------------------------------
     @property
@@ -369,6 +423,41 @@ class ServingEngine:
         from ..observability import get_registry
 
         get_registry().snapshot()
+
+    def _run_captured(self, site, fn, *args):
+        """Run a compiled program under a comm-ledger capture: when the
+        call traces (first execution) its static ledger is stored under
+        ``site``; every execution republishes the stored ledger to the
+        comm_bytes/comm_ops counters. Single-device programs record
+        nothing and publish nothing."""
+        with _cl.capture() as cap:
+            out = fn(*args)
+        if len(cap):
+            self._ledgers[site] = cap
+        led = self._ledgers.get(site)
+        if led is not None:
+            led.publish(self._metrics["comm_bytes"],
+                        self._metrics["comm_ops"])
+        return out
+
+    def comm_ledger(self, site) -> Optional[Any]:
+        """Static comm ledger of a compiled serving program: site is
+        ("decode",) or ("prefill", seq_bucket)."""
+        return self._ledgers.get(site)
+
+    # -- per-request traces ----------------------------------------------
+    def request_traces(self) -> List[Dict[str, Any]]:
+        """Finished request traces (bounded ring), oldest first — each
+        with its queued/prefill/decode_round/decode/e2e spans."""
+        return self.traces.to_dicts()
+
+    def export_request_traces(self, path: Optional[str] = None
+                              ) -> Dict[str, Any]:
+        """Chrome-trace JSON (chrome://tracing / Perfetto) of the
+        finished request traces plus any still in flight; writes to
+        ``path`` when given and returns the trace dict."""
+        return self.traces.to_chrome_trace(
+            path, extra=list(self._live_traces.values()))
 
     def metrics_snapshot(self):
         """Current registry snapshot (TTFT/TPOT histograms, occupancy,

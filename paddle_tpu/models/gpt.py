@@ -125,7 +125,9 @@ def _masked_mean_over_splits(num, den):
             R = 1
             for a in axes:
                 R *= mesh.shape[a]
-            den = _T(_lax.psum(den._value, axes), stop_gradient=True)
+            from ..distributed import collective as _C
+
+            den = _T(_C.t_psum(den._value, axes), stop_gradient=True)
             num = num * float(R)
     return num / ops.clip(den, min=1.0)
 

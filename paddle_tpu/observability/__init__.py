@@ -14,12 +14,21 @@ subsystems instrument into:
   onto transformer layers, the collective-matmul rings, and the paged-
   attention kernels so XLA/Perfetto device traces carry framework
   names, and mirrors them into host region stacks that
-  ``flight.dump()`` (the watchdog's stall flight-record) reports.
+  ``flight.dump()`` (the watchdog's stall flight-record) reports,
+- **comm**     — ``commledger`` accounts every collective the traced
+  step issues (axis / op / dtype / bytes, via the shim in
+  ``distributed/collective.py``) and backs the exposed-comm
+  attribution pass (``ParallelEngine.profile_exposed_comm``),
+- **spans**    — per-request serving lifecycle traces
+  (queued → prefill → decode rounds) in a bounded ring with
+  Chrome-trace export (``ServingEngine.export_request_traces``).
 
 Exports: Prometheus text exposition + JSONL sink + in-process
-snapshots (metrics.py). All instrumentation is host-side python on
-fetched scalars — nothing here runs inside traced code, so compile
-caches stay exactly as flat as they were without telemetry.
+snapshots (metrics.py), plus an optional stdlib HTTP ``/metrics``
+endpoint (``exporter.serve_metrics``). All instrumentation is
+host-side python on fetched scalars or trace-time bookkeeping —
+nothing here adds ops to compiled programs, so compile caches stay
+exactly as flat as they were without telemetry.
 """
 from __future__ import annotations
 
@@ -31,13 +40,19 @@ from .trace import annotate, current_regions  # noqa: F401
 from .flight import FlightRecorder, dump as dump_flight_record, \
     get_recorder  # noqa: F401
 from . import flops  # noqa: F401
+from . import commledger  # noqa: F401
+from . import spans  # noqa: F401
+from .commledger import CommLedger  # noqa: F401
+from .spans import RequestTrace, SpanRing  # noqa: F401
+from .exporter import MetricsServer, serve_metrics  # noqa: F401
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry", "JsonlSink",
     "DEFAULT_LATENCY_BUCKETS", "get_registry", "reset_registry",
     "parse_prometheus_text", "annotate", "current_regions",
     "FlightRecorder", "dump_flight_record", "get_recorder", "flops",
-    "cross_host_sum",
+    "cross_host_sum", "commledger", "CommLedger", "spans",
+    "RequestTrace", "SpanRing", "MetricsServer", "serve_metrics",
 ]
 
 

@@ -16,7 +16,8 @@ from typing import Dict
 from .metrics import (DEFAULT_LATENCY_BUCKETS, MetricsRegistry,
                       get_registry)
 
-__all__ = ["train_metrics", "serving_metrics", "SCHEMA_PATH"]
+__all__ = ["train_metrics", "serving_metrics", "comm_metrics",
+           "SCHEMA_PATH"]
 
 SCHEMA_PATH = __file__.rsplit("/", 1)[0] + "/schema.json"
 
@@ -26,10 +27,54 @@ _FAST_BUCKETS = (0.0005, 0.001, 0.002, 0.005, 0.01, 0.02, 0.05, 0.1,
                  0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0)
 
 
+def comm_metrics(reg: MetricsRegistry = None) -> Dict[str, object]:
+    """Register (get-or-create) the communication-ledger instrument
+    set — shared by the train and serving engines (both publish their
+    compiled programs' static comm ledgers through it)."""
+    r = reg or get_registry()
+    return {
+        "comm_bytes": r.counter(
+            "paddle_tpu_comm_bytes_total",
+            "bytes-on-wire per participant, from the static comm "
+            "ledger of every executed compiled program (closed-form "
+            "ring accounting; see observability/commledger.py)",
+            labelnames=("axis", "op"), unit="bytes"),
+        "comm_ops": r.counter(
+            "paddle_tpu_comm_ops_total",
+            "collectives issued per executed compiled program, from "
+            "the static comm ledger (per traced call site; scan "
+            "bodies count once)", labelnames=("axis", "op")),
+        "comm_exposed_seconds": r.gauge(
+            "paddle_tpu_comm_exposed_seconds",
+            "per-axis comm wall time EXPOSED on the step's critical "
+            "path: t(full step) - t(step with this axis's collectives "
+            "ablated), from profile_exposed_comm()",
+            labelnames=("axis",), unit="s"),
+        "comm_replay_seconds": r.gauge(
+            "paddle_tpu_comm_replay_seconds",
+            "per-axis total comm time: wall time of a standalone "
+            "back-to-back replay of the axis's ledger collectives "
+            "(nothing to hide behind)", labelnames=("axis",), unit="s"),
+        "comm_exposed_fraction": r.gauge(
+            "paddle_tpu_comm_exposed_fraction",
+            "exposed / max(replay, exposed) per axis: 1.0 = the "
+            "axis's comm is fully serialized on the critical path, "
+            "0.0 = fully hidden behind compute",
+            labelnames=("axis",)),
+        "grad_sync_exposed": r.gauge(
+            "paddle_tpu_grad_sync_exposed_seconds",
+            "exposed comm seconds summed over the data-parallel axes "
+            "(dp/sharding) — the T3-overlap headline: how much of "
+            "gradient synchronization the step fails to hide",
+            unit="s"),
+    }
+
+
 def train_metrics(reg: MetricsRegistry = None) -> Dict[str, object]:
     """Register (get-or-create) the training instrument set."""
     r = reg or get_registry()
-    return {
+    out = comm_metrics(r)
+    out.update({
         "step_seconds": r.histogram(
             "paddle_tpu_train_step_seconds",
             "wall time of one compiled train step (dispatch to return; "
@@ -80,13 +125,15 @@ def train_metrics(reg: MetricsRegistry = None) -> Dict[str, object]:
             "paddle_tpu_device_memory_bytes",
             "per-device memory stats from the jax runtime",
             labelnames=("device", "stat"), unit="bytes"),
-    }
+    })
+    return out
 
 
 def serving_metrics(reg: MetricsRegistry = None) -> Dict[str, object]:
     """Register (get-or-create) the serving instrument set."""
     r = reg or get_registry()
-    return {
+    out = comm_metrics(r)
+    out.update({
         "ttft": r.histogram(
             "paddle_tpu_serving_ttft_seconds",
             "time to first token: submit() to the prefill sample",
@@ -133,4 +180,14 @@ def serving_metrics(reg: MetricsRegistry = None) -> Dict[str, object]:
             "paddle_tpu_compile_cache_hits_total",
             "compiled-program cache hits at instrumented launch sites",
             labelnames=("site",)),
-    }
+        "stage_seconds": r.histogram(
+            "paddle_tpu_serving_request_stage_seconds",
+            "per-request lifecycle stage latency (spans): queued = "
+            "submit→admit, prefill = admit→first token, decode = "
+            "first token→finish, e2e = submit→finish "
+            "(observability/spans.py; Chrome-trace export via "
+            "ServingEngine.export_request_traces)",
+            unit="s", labelnames=("stage",),
+            buckets=DEFAULT_LATENCY_BUCKETS),
+    })
+    return out

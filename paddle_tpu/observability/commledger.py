@@ -1,0 +1,365 @@
+"""Communication ledger: trace-time accounting of every collective.
+
+Everything the hybrid step moves over ICI goes through the traced-
+collective shim in ``distributed/collective.py`` (``t_psum`` /
+``t_all_gather`` / ``t_psum_scatter`` / ``t_all_to_all`` /
+``t_ppermute`` and friends). Each shim call *notes* itself here at
+TRACE time — op kind, mesh axes, local shape, dtype, ring size — so
+capturing one compilation of a step yields an exact static ledger of
+that program's communication, with zero ops added to the compiled
+program (the ledger cannot perturb the compile lattice: recording is
+host-side bookkeeping that only runs while jax is tracing).
+
+Byte accounting (the closed forms tests pin, per participant, ring
+algorithms — the standard lower bound XLA's ICI collectives meet):
+
+====================  =========================================
+op                    wire bytes sent per participant
+====================  =========================================
+psum (all-reduce)     2 * (p-1)/p * payload     (reduce-scatter
+                      + all-gather phases; pmean/pmax/pmin same)
+all_gather            (p-1) * payload           (payload = the
+                      local shard, forwarded p-1 times)
+reduce_scatter        (p-1)/p * payload         (payload = the
+                      full local input)
+all_to_all            (p-1)/p * payload
+ppermute              payload                   (one neighbor
+                      shift of the whole buffer)
+====================  =========================================
+
+``payload`` is the noting call's local input buffer in bytes. The
+ledger stores both ``payload_bytes`` and the derived ``wire_bytes``.
+
+Caveats (documented, asserted nowhere): a collective inside a
+``lax.scan`` body is traced ONCE and therefore counted once, not
+``length`` times — the pipeline ring's per-tick ppermute is a lower
+bound. Unrolled Python rings (collective_matmul) and the flat
+grad-sync collectives are exact.
+
+The second half of this module is the **exposed-comm attribution**
+support: ``ablate(labels)`` switches the shim into a mode where the
+named axes' collectives lower to shape-preserving LOCAL ops instead,
+so an engine can compile a comm-ablated replay of the same step and
+measure how much wall time each axis's communication adds to the
+critical path (exposed) versus hides behind compute (overlapped).
+``ablation_token()`` participates in the engines' compile keys so
+ablated replays never collide with the real program cache.
+"""
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+import numpy as np
+
+__all__ = [
+    "CommRecord", "CommLedger", "capture", "note", "wire_bytes",
+    "active", "ablate", "ablating", "ablation_token", "OPS",
+]
+
+# canonical op kinds the ledger aggregates under (the {op} label of
+# paddle_tpu_comm_bytes_total / paddle_tpu_comm_ops_total)
+OPS = ("psum", "pmax", "pmin", "all_gather", "reduce_scatter",
+       "all_to_all", "ppermute")
+
+
+def wire_bytes(op: str, payload_bytes: float, p: int) -> float:
+    """Closed-form bytes-on-wire per participant for ``op`` over a
+    group of ``p`` members moving a ``payload_bytes`` local buffer."""
+    if p <= 1:
+        return 0.0
+    if op in ("psum", "pmax", "pmin"):
+        return 2.0 * (p - 1) / p * payload_bytes
+    if op == "all_gather":
+        return float((p - 1) * payload_bytes)
+    if op in ("reduce_scatter", "all_to_all"):
+        return (p - 1) / p * payload_bytes
+    if op == "ppermute":
+        return float(payload_bytes)
+    raise ValueError(f"unknown collective op kind {op!r}")
+
+
+def _itemsize(dtype) -> int:
+    try:
+        return int(np.dtype(dtype).itemsize)
+    except TypeError:
+        return int(getattr(dtype, "itemsize", 4))
+
+
+@dataclass(frozen=True)
+class CommRecord:
+    """One traced collective: everything needed to re-issue it."""
+
+    op: str                      # canonical kind (OPS)
+    axes: Tuple[str, ...]        # mesh axis names of the group
+    axis: str                    # display label: "+".join(axes)
+    shape: Tuple[int, ...]       # local input shape at the call
+    dtype: str
+    p: int                       # group size (product of axis sizes)
+    payload_bytes: int
+    wire_bytes: float
+    args: Tuple = ()             # op-specific statics (gather axis,
+    #                              scatter dim, (split, concat), perm)
+
+
+class CommLedger:
+    """The static communication record of ONE compiled program."""
+
+    def __init__(self):
+        self.records: List[CommRecord] = []
+
+    def __len__(self):
+        return len(self.records)
+
+    def add(self, rec: CommRecord):
+        self.records.append(rec)
+
+    def axis_labels(self) -> List[str]:
+        return sorted({r.axis for r in self.records})
+
+    def totals(self) -> Dict[Tuple[str, str], Dict[str, float]]:
+        """{(axis, op): {"bytes": wire bytes, "payload_bytes": ...,
+        "ops": count}} aggregated per execution of the program."""
+        out: Dict[Tuple[str, str], Dict[str, float]] = {}
+        for r in self.records:
+            t = out.setdefault((r.axis, r.op),
+                               {"bytes": 0.0, "payload_bytes": 0,
+                                "ops": 0})
+            t["bytes"] += r.wire_bytes
+            t["payload_bytes"] += r.payload_bytes
+            t["ops"] += 1
+        return out
+
+    def bytes_for(self, axis: Optional[str] = None,
+                  op: Optional[str] = None) -> float:
+        return sum(r.wire_bytes for r in self.records
+                   if (axis is None or r.axis == axis)
+                   and (op is None or r.op == op))
+
+    def ops_for(self, axis: Optional[str] = None,
+                op: Optional[str] = None) -> int:
+        return sum(1 for r in self.records
+                   if (axis is None or r.axis == axis)
+                   and (op is None or r.op == op))
+
+    def publish(self, bytes_counter, ops_counter) -> None:
+        """Add one execution's worth of this program's traffic to the
+        registry counters (called once per step by the engines)."""
+        for (axis, op), t in self.totals().items():
+            bytes_counter.inc(t["bytes"], axis=axis, op=op)
+            ops_counter.inc(t["ops"], axis=axis, op=op)
+
+    def summary(self) -> Dict[str, Any]:
+        return {
+            "records": len(self.records),
+            "axes": self.axis_labels(),
+            "totals": {f"{a}/{o}": t
+                       for (a, o), t in sorted(self.totals().items())},
+        }
+
+
+class _State(threading.local):
+    def __init__(self):
+        self.captures: List[CommLedger] = []
+        self.ablated: frozenset = frozenset()
+
+
+_state = _State()
+
+
+def active() -> bool:
+    """True when any capture or ablation is in effect on this thread
+    (the shim's fast path skips all bookkeeping otherwise)."""
+    return bool(_state.captures) or bool(_state.ablated)
+
+
+class _Capture:
+    def __enter__(self) -> CommLedger:
+        self.ledger = CommLedger()
+        _state.captures.append(self.ledger)
+        return self.ledger
+
+    def __exit__(self, *exc):
+        _state.captures.remove(self.ledger)
+        return False
+
+
+def capture() -> _Capture:
+    """Context manager collecting every collective noted while jax
+    traces inside it. A cached (already-compiled) execution notes
+    nothing — an empty capture means "program reused, keep the stored
+    ledger"."""
+    return _Capture()
+
+
+def note(op: str, axes: Iterable[str], shape, dtype, p: int,
+         args: Tuple = ()) -> None:
+    """Record one collective into every active capture (trace time)."""
+    if not _state.captures:
+        return
+    axes = tuple(axes)
+    payload = int(np.prod(shape)) * _itemsize(dtype) if shape else \
+        _itemsize(dtype)
+    rec = CommRecord(op=op, axes=axes, axis="+".join(axes),
+                     shape=tuple(int(s) for s in shape),
+                     dtype=str(dtype), p=int(p),
+                     payload_bytes=payload,
+                     wire_bytes=wire_bytes(op, payload, int(p)),
+                     args=tuple(args))
+    for led in _state.captures:
+        led.add(rec)
+
+
+# -- ablation (the exposed-comm replay mode) ------------------------------
+
+
+class _Ablate:
+    def __init__(self, labels):
+        self.labels = frozenset(labels)
+
+    def __enter__(self):
+        self.prev = _state.ablated
+        _state.ablated = self.prev | self.labels
+        return self
+
+    def __exit__(self, *exc):
+        _state.ablated = self.prev
+        return False
+
+
+def ablate(labels: Iterable[str]) -> _Ablate:
+    """While active, the collective shim lowers any collective whose
+    axis label ("+".join(axes)) is in ``labels`` to a shape-preserving
+    LOCAL op — the comm-ablated replay the exposed-comm profiler times
+    against the real step. Compose with the engines' compile keys via
+    ``ablation_token()``; never use for numerical work (the replay's
+    outputs are wrong on purpose)."""
+    return _Ablate(labels)
+
+
+def ablating(axis_label: str) -> bool:
+    return axis_label in _state.ablated
+
+
+def ablation_token() -> Optional[frozenset]:
+    """Hashable compile-key component: None in normal operation, the
+    ablated label set inside an ``ablate()`` region — so an engine's
+    program cache never serves an ablated executable to a real step
+    (or vice versa)."""
+    return _state.ablated or None
+
+
+# -- exposed-comm attribution ---------------------------------------------
+
+
+@dataclass
+class ExposedCommReport:
+    """The split of per-axis comm time into exposed vs overlapped.
+
+    ``exposed_seconds[axis]``  = t_full - t_ablated(axis): wall time the
+    axis's collectives add to the step's critical path.
+    ``replay_seconds[axis]``   = wall time of a standalone replay of the
+    SAME collectives (shapes/dtypes/perms from the ledger) issued
+    back-to-back: the axis's total comm time with nothing to hide it.
+    ``exposed_fraction[axis]`` = exposed / max(replay, exposed): 1.0
+    means fully serialized on the critical path, 0.0 fully hidden.
+    ``grad_sync_exposed_seconds`` sums the exposed time of the data-
+    parallel axes (dp / sharding) — the T3-overlap headline metric.
+    """
+
+    step_seconds: float = 0.0
+    exposed_seconds: Dict[str, float] = field(default_factory=dict)
+    replay_seconds: Dict[str, float] = field(default_factory=dict)
+    exposed_fraction: Dict[str, float] = field(default_factory=dict)
+    grad_sync_exposed_seconds: float = 0.0
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "step_seconds": self.step_seconds,
+            "exposed_seconds": dict(self.exposed_seconds),
+            "replay_seconds": dict(self.replay_seconds),
+            "exposed_fraction": dict(self.exposed_fraction),
+            "grad_sync_exposed_seconds": self.grad_sync_exposed_seconds,
+        }
+
+    def publish(self, metrics: Dict[str, Any]) -> None:
+        """Set the catalog gauges (train_metrics keys)."""
+        for ax, v in self.exposed_seconds.items():
+            metrics["comm_exposed_seconds"].set(v, axis=ax)
+        for ax, v in self.replay_seconds.items():
+            metrics["comm_replay_seconds"].set(v, axis=ax)
+        for ax, v in self.exposed_fraction.items():
+            metrics["comm_exposed_fraction"].set(v, axis=ax)
+        metrics["grad_sync_exposed"].set(self.grad_sync_exposed_seconds)
+
+
+GRAD_SYNC_AXES = ("dp", "sharding")
+
+
+def build_report(step_seconds: float,
+                 exposed: Dict[str, float],
+                 replay: Dict[str, float]) -> ExposedCommReport:
+    """Assemble the report from raw timings (clamping + fractions)."""
+    rep = ExposedCommReport(step_seconds=step_seconds)
+    for ax in sorted(set(exposed) | set(replay)):
+        e = max(0.0, float(exposed.get(ax, 0.0)))
+        r = max(0.0, float(replay.get(ax, 0.0)))
+        rep.exposed_seconds[ax] = e
+        rep.replay_seconds[ax] = r
+        denom = max(r, e)
+        rep.exposed_fraction[ax] = (e / denom) if denom > 0 else 0.0
+    rep.grad_sync_exposed_seconds = sum(
+        v for ax, v in rep.exposed_seconds.items()
+        if set(ax.split("+")) & set(GRAD_SYNC_AXES))
+    return rep
+
+
+def replay_callable(records: List[CommRecord], mesh, shard_map_fn,
+                    jit_fn):
+    """Build a compiled program that issues exactly ``records``'s
+    collectives back-to-back over ``mesh`` (zeros payloads, results
+    folded into one replicated scalar so nothing is DCE'd) — the
+    "total comm time" half of the exposed/overlapped split.
+
+    ``shard_map_fn``/``jit_fn`` are injected (jax.shard_map wrapper and
+    jax.jit) so this module stays import-light; the engine passes its
+    own.
+    """
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+
+    sync_axes = tuple(a for a in mesh.axis_names if mesh.shape[a] > 1)
+
+    def body():
+        acc = jnp.float32(0.0)
+        for r in records:
+            x = jnp.zeros(r.shape, r.dtype)
+            if r.op in ("psum", "pmax", "pmin"):
+                fn = {"psum": lax.psum, "pmax": lax.pmax,
+                      "pmin": lax.pmin}[r.op]
+                out = fn(x, r.axes)
+            elif r.op == "all_gather":
+                out = lax.all_gather(x, r.axes, axis=r.args[0],
+                                     tiled=True)
+            elif r.op == "reduce_scatter":
+                out = lax.psum_scatter(x, r.axes,
+                                       scatter_dimension=r.args[0],
+                                       tiled=True)
+            elif r.op == "all_to_all":
+                out = lax.all_to_all(x, r.axes, split_axis=r.args[0],
+                                     concat_axis=r.args[1], tiled=True)
+            elif r.op == "ppermute":
+                out = lax.ppermute(
+                    x, r.axes[0] if len(r.axes) == 1 else r.axes,
+                    perm=[tuple(pr) for pr in r.args[0]])
+            else:  # pragma: no cover - OPS is closed
+                continue
+            acc = acc + out.ravel()[0].astype(jnp.float32)
+        # replicate the scalar so out_specs=P() is valid on any mesh
+        if sync_axes:
+            acc = lax.pmax(acc, sync_axes)
+        return acc
+
+    return jit_fn(shard_map_fn(body, mesh, (), P()))

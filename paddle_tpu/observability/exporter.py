@@ -1,0 +1,80 @@
+"""Optional stdlib HTTP ``/metrics`` endpoint for a real scrape loop.
+
+``serve_metrics(port)`` starts a daemon-threaded ``http.server``
+serving the registry's Prometheus text exposition at ``/metrics``
+(and ``/``), so a standard Prometheus scrape config works against a
+training or serving process without the JSONL sink. Stdlib only — no
+new dependencies — and entirely off the hot path: a scrape calls
+``registry.prometheus_text()`` exactly like ``metrics_snapshot()``
+does.
+
+    >>> srv = serve_metrics(9100)        # port 0 picks a free port
+    >>> srv.port
+    9100
+    >>> # ... prometheus scrapes http://host:9100/metrics ...
+    >>> srv.close()
+"""
+from __future__ import annotations
+
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from .metrics import MetricsRegistry, get_registry
+
+__all__ = ["MetricsServer", "serve_metrics"]
+
+CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
+class MetricsServer:
+    """Handle on a running exporter: ``port`` is the bound port (useful
+    with ``port=0``), ``close()`` shuts the listener down."""
+
+    def __init__(self, httpd: ThreadingHTTPServer,
+                 thread: threading.Thread):
+        self._httpd = httpd
+        self._thread = thread
+        self.port = int(httpd.server_address[1])
+
+    def close(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=5)
+
+    # context-manager sugar so tests/tools can `with serve_metrics(0):`
+    def __enter__(self) -> "MetricsServer":
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+
+def serve_metrics(port: int = 0, registry: Optional[MetricsRegistry] = None,
+                  host: str = "127.0.0.1") -> MetricsServer:
+    """Start the ``/metrics`` endpoint on ``host:port`` (0 = ephemeral)
+    serving ``registry`` (default: the process-wide one)."""
+    reg = registry or get_registry()
+
+    class _Handler(BaseHTTPRequestHandler):
+        def do_GET(self):
+            if self.path.split("?", 1)[0] not in ("/", "/metrics"):
+                self.send_error(404, "only /metrics is served")
+                return
+            body = reg.prometheus_text().encode("utf-8")
+            self.send_response(200)
+            self.send_header("Content-Type", CONTENT_TYPE)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, fmt, *args):
+            pass                # scrapes must not spam the train log
+
+    httpd = ThreadingHTTPServer((host, port), _Handler)
+    httpd.daemon_threads = True
+    thread = threading.Thread(target=httpd.serve_forever,
+                              name="metrics-exporter", daemon=True)
+    thread.start()
+    return MetricsServer(httpd, thread)

@@ -1,0 +1,156 @@
+"""Per-request lifecycle spans for the serving engine.
+
+Every ``ServingRequest`` gets a ``RequestTrace``: a list of named spans
+(queued → prefill → decode, plus one span per shared decode round the
+request was in flight for) on the ``time.perf_counter`` clock. Finished
+traces land in a bounded ``SpanRing`` so a long-running engine keeps
+the last-N request histories without growing memory.
+
+Exports:
+
+- ``SpanRing.to_chrome_trace()`` — Chrome ``chrome://tracing`` /
+  Perfetto JSON ("X" complete events, one ``tid`` lane per request,
+  timestamps rebased to the earliest span), the same format the
+  profiler's chrome exporter emits so both open in the same UI,
+- per-stage latency percentiles via the
+  ``paddle_tpu_serving_request_stage_seconds{stage}`` histogram
+  (observed by the engine as each span closes) — the bench telemetry
+  section carries them per line.
+
+Host-side python on perf_counter floats only; nothing here touches
+traced code.
+"""
+from __future__ import annotations
+
+import json
+import threading
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+__all__ = ["Span", "RequestTrace", "SpanRing"]
+
+# the per-request lifecycle stages, in order (the stage histogram's
+# label values; "decode_round" additionally marks shared-round spans)
+STAGES = ("queued", "prefill", "decode", "e2e")
+
+
+class Span:
+    """One named interval; ``end`` stays None while open."""
+
+    __slots__ = ("name", "t0", "t1", "meta")
+
+    def __init__(self, name: str, t0: float,
+                 t1: Optional[float] = None,
+                 meta: Optional[Dict[str, Any]] = None):
+        self.name = name
+        self.t0 = float(t0)
+        self.t1 = None if t1 is None else float(t1)
+        self.meta = meta or {}
+
+    @property
+    def seconds(self) -> float:
+        return 0.0 if self.t1 is None else self.t1 - self.t0
+
+    def to_dict(self) -> Dict[str, Any]:
+        d = {"name": self.name, "t0": self.t0, "t1": self.t1,
+             "seconds": self.seconds}
+        if self.meta:
+            d["meta"] = dict(self.meta)
+        return d
+
+
+class RequestTrace:
+    """The span set of one serving request (rid keys the trace)."""
+
+    __slots__ = ("rid", "spans", "meta")
+
+    def __init__(self, rid: int, meta: Optional[Dict[str, Any]] = None):
+        self.rid = rid
+        self.spans: List[Span] = []
+        self.meta = meta or {}
+
+    def begin(self, name: str, t0: float,
+              meta: Optional[Dict[str, Any]] = None) -> Span:
+        sp = Span(name, t0, meta=meta)
+        self.spans.append(sp)
+        return sp
+
+    def end(self, name: str, t1: float) -> Optional[Span]:
+        """Close the most recent open span named ``name``; returns it
+        (None when no such span is open — callers treat that as a
+        stage the request never entered)."""
+        for sp in reversed(self.spans):
+            if sp.name == name and sp.t1 is None:
+                sp.t1 = float(t1)
+                return sp
+        return None
+
+    def add(self, name: str, t0: float, t1: float,
+            meta: Optional[Dict[str, Any]] = None) -> Span:
+        sp = Span(name, t0, t1, meta)
+        self.spans.append(sp)
+        return sp
+
+    def span(self, name: str) -> Optional[Span]:
+        for sp in self.spans:
+            if sp.name == name:
+                return sp
+        return None
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"rid": self.rid, "meta": dict(self.meta),
+                "spans": [s.to_dict() for s in self.spans]}
+
+
+class SpanRing:
+    """Bounded ring of finished request traces (thread-safe)."""
+
+    def __init__(self, maxlen: int = 256):
+        self._ring: deque = deque(maxlen=maxlen)
+        self._lock = threading.Lock()
+
+    def __len__(self):
+        with self._lock:
+            return len(self._ring)
+
+    def add(self, trace: RequestTrace) -> None:
+        with self._lock:
+            self._ring.append(trace)
+
+    def traces(self) -> List[RequestTrace]:
+        with self._lock:
+            return list(self._ring)
+
+    def to_dicts(self) -> List[Dict[str, Any]]:
+        return [t.to_dict() for t in self.traces()]
+
+    def to_chrome_trace(self, path: Optional[str] = None,
+                        extra: Optional[List[RequestTrace]] = None
+                        ) -> Dict[str, Any]:
+        """Chrome-trace JSON of every finished trace (plus ``extra``
+        in-flight ones): one ``tid`` lane per request, "X" complete
+        events in microseconds rebased to the earliest span. Writes to
+        ``path`` when given; always returns the dict."""
+        traces = self.traces() + list(extra or [])
+        events: List[Dict[str, Any]] = []
+        t_base = min((s.t0 for t in traces for s in t.spans),
+                     default=0.0)
+        for tr in traces:
+            events.append({"ph": "M", "name": "thread_name", "pid": 0,
+                           "tid": tr.rid,
+                           "args": {"name": f"req{tr.rid}"}})
+            for sp in tr.spans:
+                if sp.t1 is None:
+                    continue
+                events.append({
+                    "ph": "X", "cat": "serving", "name": sp.name,
+                    "pid": 0, "tid": tr.rid,
+                    "ts": (sp.t0 - t_base) * 1e6,
+                    "dur": sp.seconds * 1e6,
+                    "args": {**tr.meta, **sp.meta},
+                })
+        doc = {"traceEvents": events, "displayTimeUnit": "ms"}
+        if path is not None:
+            with open(path, "w") as f:
+                json.dump(doc, f)
+        return doc

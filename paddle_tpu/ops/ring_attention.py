@@ -75,8 +75,10 @@ def _ring_attention(q, k, v, axes=(), causal=True, scale=None):
             jnp.einsum("bhqk,bkhd->bhqd", p, vj.astype(f32))
         m = m_new
         if t < n - 1:
-            kj = lax.ppermute(kj, axes[0], perm)
-            vj = lax.ppermute(vj, axes[0], perm)
+            from ..distributed import collective as C
+
+            kj = C.t_ppermute(kj, axes[0], perm)
+            vj = C.t_ppermute(vj, axes[0], perm)
     out = acc / jnp.maximum(l, 1e-30)[..., None]
     return jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)
 

@@ -227,6 +227,85 @@ class TestTrainingInstrumentation:
         assert rep["pod_tokens_per_sec"] == pytest.approx(
             rep["local_tokens_per_sec"])
 
+    def test_pod_throughput_aggregates_across_hosts(self, trained_engine,
+                                                    monkeypatch):
+        """pod_tokens_per_sec must be the cross-host SUM of the local
+        gauges (simulated 3-host pod: every host reports the same local
+        rate, the pod gauge carries 3x it)."""
+        eng, _, _ = trained_engine
+        import paddle_tpu.observability as obs_mod
+
+        monkeypatch.setattr(obs_mod, "cross_host_sum",
+                            lambda v: 3.0 * float(v))
+        local = eng._metrics["tokens_per_sec"].value()
+        assert local > 0
+        rep = eng.pod_throughput()
+        assert rep["pod_tokens_per_sec"] == pytest.approx(3.0 * local)
+        assert eng._metrics["pod_tokens_per_sec"].value() == \
+            pytest.approx(3.0 * local)
+        # the local gauge itself is untouched by aggregation
+        assert rep["local_tokens_per_sec"] == pytest.approx(local)
+
+
+class TestFirstStepLag:
+    """The one-step-lag scalar fetch on the very FIRST step: before any
+    step the gauges hold their zero-init; after one step but before the
+    next flush they still do (the lag contract: the fetch happens at
+    the NEXT step's entry / at metrics_snapshot, never on the hot
+    path); the first flush then lands exactly that step's values."""
+
+    @pytest.fixture()
+    def fresh_engine(self):
+        from paddle_tpu.distributed import fleet
+        from paddle_tpu.distributed.engine import ParallelEngine
+        from paddle_tpu.models import (GPTConfig, GPTForCausalLM,
+                                       GPTPretrainingCriterion)
+
+        obs.reset_registry()
+        paddle.seed(3)
+        cfg = GPTConfig(vocab_size=64, hidden_size=16, num_layers=1,
+                        num_heads=2, max_position_embeddings=16)
+        model = GPTForCausalLM(cfg)
+        crit = GPTPretrainingCriterion(cfg)
+        opt = paddle.optimizer.AdamW(learning_rate=1e-4,
+                                     parameters=model.parameters())
+        strategy = fleet.DistributedStrategy()
+        strategy.hybrid_configs = {"dp_degree": 1, "mp_degree": 1}
+        hcg = fleet.init(is_collective=True, strategy=strategy)
+        eng = ParallelEngine(model, opt, hcg.mesh)
+        step = eng.train_step(
+            lambda m, b: crit(m(b["x"]), b["y"]))
+        r = np.random.RandomState(0)
+        ids = r.randint(0, 64, (2, 9))
+        batch = {"x": paddle.to_tensor(ids[:, :-1]),
+                 "y": paddle.to_tensor(ids[:, 1:])}
+        return eng, step, batch
+
+    def test_gauges_zero_before_any_step(self, fresh_engine):
+        eng, _, _ = fresh_engine
+        assert eng._metrics["grad_norm"].value() == 0.0
+        assert eng._metrics["loss"].value() == 0.0
+        eng._flush_pending_scalars()          # no pending: a no-op
+        assert eng._metrics["grad_norm"].value() == 0.0
+
+    def test_first_step_lags_then_flushes(self, fresh_engine):
+        eng, step, batch = fresh_engine
+        loss = float(step(batch))
+        # one-step lag: the first step's scalars are PENDING, the
+        # gauges still hold zero until something flushes
+        assert eng._pending_scalars is not None
+        assert eng._metrics["grad_norm"].value() == 0.0
+        assert eng._metrics["loss"].value() == 0.0
+        m = eng.metrics_snapshot()["metrics"]   # flushes the lag
+        assert m["paddle_tpu_train_loss"]["series"][0]["value"] == \
+            pytest.approx(loss, rel=1e-5)
+        assert m["paddle_tpu_train_grad_norm"]["series"][0]["value"] > 0
+        assert eng._pending_scalars is None
+        # flushing twice is idempotent (nothing new pending)
+        before = eng._metrics["grad_norm"].value()
+        eng._flush_pending_scalars()
+        assert eng._metrics["grad_norm"].value() == before
+
 
 # ---------------------------------------------------------------------------
 # serving instrumentation

@@ -1,0 +1,219 @@
+"""bench_compare: diff the newest BENCH_r0*.json against the previous
+run and print the full metric trajectory.
+
+The driver snapshots every bench round as ``BENCH_r<NN>.json`` with the
+shape ``{"n": round, "cmd": ..., "rc": ..., "tail": <stdout tail>}``
+where ``tail`` holds the bench's JSON lines (one object per metric;
+the headline line is re-emitted after every bench, so the LAST
+occurrence of a metric wins). Nothing consumed those snapshots until
+now — this tool turns them into:
+
+- a **regression gate**: each metric in the newest round is compared
+  against the previous round under a per-metric threshold (relative,
+  direction-aware: tokens/s up is good, ms/token down is good), with
+  exact gates for pass/fail parity metrics,
+- a **trajectory table**: every metric's value across all rounds, so a
+  slow drift is visible even when each single diff passes.
+
+Usage::
+
+    python -m tools.bench_compare [--dir REPO] [--threshold 0.25]
+                                  [--strict] [--json]
+
+``--strict`` exits 1 when any metric regresses (for CI); the default
+always exits 0 so a noisy CPU round can't block a merge by itself.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+__all__ = ["load_rounds", "parse_metrics", "compare", "trajectory",
+           "main"]
+
+# units where a SMALLER value is the improvement
+_LOWER_BETTER_UNITS = {"ms"}
+# metrics that must stay exactly at their expected value
+_EXACT = {"pallas_kernel_parity_interpret": 1.0,
+          "pallas_kernel_parity_onchip": 1.0}
+# per-metric relative thresholds overriding the CLI default (CPU smoke
+# lines are noisy; recompile counts are exact)
+_THRESHOLDS = {
+    "recompiles_after_warmup": 0.0,
+}
+# line kinds that are status reports, not comparable measurements
+_SKIP_UNITS = {"error", "needs_chips", "skipped", "ok"}
+
+
+def load_rounds(directory: str) -> List[Tuple[int, str]]:
+    """[(round_number, tail_text)] for every BENCH_r*.json, ascending."""
+    out = []
+    for name in sorted(os.listdir(directory)):
+        m = re.match(r"BENCH_r(\d+)\.json$", name)
+        if not m:
+            continue
+        try:
+            with open(os.path.join(directory, name)) as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            continue
+        out.append((int(m.group(1)), str(doc.get("tail", ""))))
+    out.sort()
+    return out
+
+
+def parse_metrics(tail: str) -> Dict[str, Dict[str, Any]]:
+    """{metric: line-dict} from a round's stdout tail. Later lines win
+    (the headline is re-emitted after every bench); status lines
+    (error/needs_chips/...) are kept but marked unmeasurable."""
+    out: Dict[str, Dict[str, Any]] = {}
+    for line in tail.splitlines():
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            doc = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(doc, dict) and "metric" in doc:
+            out[str(doc["metric"])] = doc
+    return out
+
+
+def _measurable(line: Dict[str, Any]) -> bool:
+    return line.get("unit") not in _SKIP_UNITS
+
+
+def compare(prev: Dict[str, Dict[str, Any]],
+            new: Dict[str, Dict[str, Any]],
+            threshold: float) -> List[Dict[str, Any]]:
+    """Per-metric diff of two rounds: value delta, relative change in
+    the metric's GOOD direction, and a verdict in
+    {improved, ok, regressed, new, gone, unmeasured}."""
+    rows: List[Dict[str, Any]] = []
+    for name in sorted(set(prev) | set(new)):
+        a, b = prev.get(name), new.get(name)
+        row: Dict[str, Any] = {"metric": name}
+        if a is None or b is None:
+            row.update(verdict="new" if a is None else "gone",
+                       prev=a and a.get("value"),
+                       value=b and b.get("value"))
+            rows.append(row)
+            continue
+        if not (_measurable(a) and _measurable(b)):
+            row.update(verdict="unmeasured", prev=a.get("value"),
+                       value=b.get("value"),
+                       note=b.get("error") or a.get("error") or "")
+            rows.append(row)
+            continue
+        va, vb = float(a.get("value", 0.0)), float(b.get("value", 0.0))
+        row.update(prev=va, value=vb, unit=b.get("unit", ""))
+        if name in _EXACT:
+            ok = vb == _EXACT[name]
+            row["verdict"] = "ok" if ok else "regressed"
+            row["why"] = "" if ok else f"expected {_EXACT[name]}"
+            rows.append(row)
+            continue
+        lower_better = b.get("unit") in _LOWER_BETTER_UNITS
+        # relative change in the good direction: positive = improved
+        base = abs(va) if va else 1.0
+        rel = (va - vb) / base if lower_better else (vb - va) / base
+        row["rel_change"] = round(rel, 4)
+        thr = _THRESHOLDS.get(name, threshold)
+        if rel < -thr:
+            row["verdict"] = "regressed"
+            row["why"] = (f"{rel * 100:+.1f}% vs previous "
+                          f"(threshold -{thr * 100:.0f}%)")
+        elif rel > thr:
+            row["verdict"] = "improved"
+        else:
+            row["verdict"] = "ok"
+        rows.append(row)
+    return rows
+
+
+def trajectory(rounds: List[Tuple[int, str]]
+               ) -> Dict[str, List[Optional[float]]]:
+    """{metric: [value per round, None where absent/unmeasurable]}."""
+    parsed = [(n, parse_metrics(tail)) for n, tail in rounds]
+    names = sorted({m for _, p in parsed for m in p})
+    out: Dict[str, List[Optional[float]]] = {}
+    for name in names:
+        vals: List[Optional[float]] = []
+        for _, p in parsed:
+            line = p.get(name)
+            vals.append(float(line["value"])
+                        if line is not None and _measurable(line)
+                        else None)
+        out[name] = vals
+    return out
+
+
+def _fmt(v: Optional[float]) -> str:
+    if v is None:
+        return "-"
+    return f"{v:.4g}"
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="bench_compare",
+                                 description=__doc__.splitlines()[0])
+    ap.add_argument("--dir", default=".",
+                    help="directory holding BENCH_r*.json (default .)")
+    ap.add_argument("--threshold", type=float, default=0.25,
+                    help="default relative regression threshold "
+                         "(default 0.25 — CPU smoke lines are noisy)")
+    ap.add_argument("--strict", action="store_true",
+                    help="exit 1 when any metric regresses")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit the diff + trajectory as one JSON doc")
+    args = ap.parse_args(argv)
+
+    rounds = load_rounds(args.dir)
+    if len(rounds) < 2:
+        print(f"bench_compare: need >= 2 BENCH_r*.json under "
+              f"{args.dir!r}, found {len(rounds)}", file=sys.stderr)
+        return 2
+    (n_prev, t_prev), (n_new, t_new) = rounds[-2], rounds[-1]
+    rows = compare(parse_metrics(t_prev), parse_metrics(t_new),
+                   args.threshold)
+    traj = trajectory(rounds)
+    regressed = [r for r in rows if r["verdict"] == "regressed"]
+
+    if args.as_json:
+        print(json.dumps({"prev_round": n_prev, "new_round": n_new,
+                          "diff": rows, "trajectory": traj,
+                          "rounds": [n for n, _ in rounds],
+                          "regressed": [r["metric"] for r in regressed]},
+                         indent=1))
+    else:
+        print(f"bench_compare: r{n_prev:02d} -> r{n_new:02d}")
+        width = max((len(r["metric"]) for r in rows), default=10)
+        for r in rows:
+            mark = {"regressed": "!!", "improved": "++", "ok": "  ",
+                    "new": " +", "gone": " -",
+                    "unmeasured": " ?"}[r["verdict"]]
+            rel = r.get("rel_change")
+            rel_s = f"{rel * 100:+7.1f}%" if rel is not None else \
+                "        "
+            print(f"{mark} {r['metric']:<{width}} "
+                  f"{_fmt(r.get('prev')):>12} -> "
+                  f"{_fmt(r.get('value')):>12} {rel_s} "
+                  f"{r.get('why', r.get('note', ''))}")
+        print(f"\ntrajectory ({', '.join(f'r{n:02d}' for n, _ in rounds)})")
+        width = max((len(m) for m in traj), default=10)
+        for name, vals in traj.items():
+            print(f"   {name:<{width}} " +
+                  " ".join(f"{_fmt(v):>12}" for v in vals))
+        if regressed:
+            print(f"\n{len(regressed)} regression(s): "
+                  + ", ".join(r["metric"] for r in regressed))
+    return 1 if (args.strict and regressed) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
