@@ -569,6 +569,137 @@ def bench_gpt13b_hybrid(on_tpu, dev):
 
 
 # ---------------------------------------------------------------------------
+# 4b. GPT-MoE hybrid: expert parallelism as a first-class mesh axis.
+# TP x EP x DP on 8 vdevs — stacked expert weights sharded over 'ep',
+# token dispatch/combine all_to_alls inside the compiled step (fused
+# into a ppermute ring behind the expert GEMMs: ep_async_dispatch).
+# Gates carried on the line: loss parity <= 1e-5 vs the single-device
+# dense-dispatch golden (computed per batch shard so capacity/drop
+# decisions match exactly), 0 recompiles after warmup, and the
+# expert-load / drop-rate gauges + comm_bytes_total{axis="ep"} in the
+# telemetry snapshot.
+# ---------------------------------------------------------------------------
+def bench_gpt_moe_hybrid(on_tpu, dev):
+    import jax
+
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed import fleet
+    from paddle_tpu.distributed.engine import ParallelEngine
+    from paddle_tpu.models import (GPTConfig, GPTForCausalLM,
+                                   GPTPretrainingCriterion)
+
+    n = jax.device_count()
+    if n < 8:
+        _emit({"metric": "gpt_moe_hybrid_train_tokens_per_sec",
+               "value": 0.0, "unit": "needs_chips", "vs_baseline": 0.0,
+               "needs_devices": 8, "have_devices": n})
+        return
+    if on_tpu:
+        cfg = GPTConfig(vocab_size=50304, hidden_size=1024, num_layers=12,
+                        num_heads=16, max_position_embeddings=1024,
+                        dtype="bfloat16", num_experts=16, moe_every=2)
+        dp = max(n // 4, 1)
+        B, S, steps, state_dtype = 4 * dp, 1024, 5, "bfloat16"
+    else:
+        cfg = GPTConfig(vocab_size=512, hidden_size=64, num_layers=4,
+                        num_heads=4, max_position_embeddings=64,
+                        num_experts=8, moe_every=2)
+        dp = max(n // 4, 1)
+        B, S, steps, state_dtype = 4 * dp, 16, 2, None
+
+    # single-device dense-dispatch golden, built BEFORE fleet.init (no
+    # hybrid mesh -> plain layers, MoE group None) from the same seed —
+    # the mp/ep model below draws the same full-shape init sequence
+    paddle.seed(0)
+    golden = GPTForCausalLM(cfg)
+    crit = GPTPretrainingCriterion(cfg)
+
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {
+        "dp_degree": dp, "mp_degree": 2, "ep_degree": 2,
+        # dispatch/combine a2a fused into the chunked expert-GEMM ring
+        # (distributed/collective_matmul.py moe_a2a_ffn)
+        "moe_configs": {"ep_async_dispatch": True}}
+    hcg = fleet.init(is_collective=True, strategy=strategy)
+    paddle.seed(0)
+    model = GPTForCausalLM(cfg)
+    opt = paddle.optimizer.AdamW(learning_rate=1e-4,
+                                 parameters=model.parameters(),
+                                 state_dtype=state_dtype)
+    eng = ParallelEngine(model, opt, hcg.mesh)
+
+    def loss_fn(m, b):
+        return crit(m(b["x"]), b["y"]) + m.aux_loss
+
+    step = eng.train_step(loss_fn)
+    r = np.random.RandomState(0)
+    ids = r.randint(0, cfg.vocab_size, (B, S + 1))
+    x, y = ids[:, :-1], ids[:, 1:]
+    batch = {"x": paddle.to_tensor(x), "y": paddle.to_tensor(y)}
+
+    # loss parity on the FIRST step (identical weights): the engine's
+    # reported loss is the pmean of per-rank local losses, and each
+    # (dp, ep) rank holds one contiguous batch shard — so the golden is
+    # the mean of the dense model's loss over the same shards (same
+    # per-shard token count -> same capacity buckets -> same drops)
+    shards = dp * 2
+    Bl = B // shards
+    g_losses = []
+    for i in range(shards):
+        xb = paddle.to_tensor(x[i * Bl:(i + 1) * Bl])
+        yb = paddle.to_tensor(y[i * Bl:(i + 1) * Bl])
+        g_losses.append(float(loss_fn(golden, {"x": xb, "y": yb})))
+    g_loss = float(np.mean(g_losses))
+    loss0 = float(step(batch))
+    parity_err = abs(loss0 - g_loss)
+    parity_tol = 0.02 if on_tpu else 1e-5   # bf16 vs the f32 smoke gate
+    compiles_warm = eng.stats.compiles
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = step(batch)
+    float(loss)
+    dt = time.perf_counter() - t0
+    tok_s = B * S * steps / dt
+
+    led = eng.comm_ledger()
+    comm_bytes_per_step = {
+        f"{a}/{o}": round(t["bytes"], 1)
+        for (a, o), t in sorted(led.totals().items())} if led else {}
+    tel = _telemetry_section()
+    load = {k.split("expert=")[1].split(",")[0].rstrip("}"): v
+            for k, v in tel.items()
+            if k.startswith("moe_expert_load") and "layer=layer0" in k}
+    peak, _ = _chip(dev)
+    n_params = cfg.num_params()
+    mfu = (6.0 * n_params * tok_s / (peak * n)) if peak else 0.0
+    _emit({
+        "metric": "gpt_moe_hybrid_train_tokens_per_sec" if on_tpu
+        else "gpt_moe_hybrid_smoke_tokens_per_sec",
+        "value": round(tok_s, 2),
+        "unit": "tokens/s",
+        "vs_baseline": round(mfu / 0.45, 4) if peak else 0.0,
+        "mesh": f"dp{dp}xep2xmp2", "devices": n,
+        "num_experts": cfg.num_experts,
+        "ep_async_dispatch": True,
+        "loss_parity_err": round(parity_err, 8),
+        "compiles": eng.stats.compiles,
+        "cache_hits": eng.stats.cache_hits,
+        "recompiles_after_warmup": eng.stats.compiles - compiles_warm,
+        "comm_bytes_per_step": comm_bytes_per_step,
+        "expert_load_layer0": load,
+        "telemetry": tel,
+        "device": str(getattr(dev, "device_kind", dev.platform)),
+    })
+    # the exact gates ride their own lines so bench_compare can pin them
+    _emit({"metric": "gpt_moe_hybrid_loss_parity",
+           "value": 1.0 if parity_err <= parity_tol else 0.0,
+           "unit": "pass",
+           "vs_baseline": 1.0 if parity_err <= parity_tol else 0.0,
+           "err": round(parity_err, 8), "tol": parity_tol})
+
+
+# ---------------------------------------------------------------------------
 # 3b. Collective-matmul overlap microbench: the fused ring decompositions
 # (distributed/collective_matmul.py — ag_matmul + matmul_rs, the TP/SP
 # hot-path pair) vs the unfused all_gather -> GEMM -> psum_scatter chain
@@ -828,13 +959,14 @@ _BENCHES = {}
 # each + headline printed last = one hang, zero lines).
 _TIMEOUTS = {"gpt": 900, "llama_decode": 420, "llama_decode_int8": 420,
              "llama_decode_ragged": 420, "serving": 420, "resnet": 300,
-             "moe": 300, "gpt13b_hybrid": 700, "tp_overlap": 240,
-             "kernel_parity": 240}
+             "moe": 300, "gpt_moe_hybrid": 420, "gpt13b_hybrid": 700,
+             "tp_overlap": 240, "kernel_parity": 240}
 _ORDER = ("gpt", "llama_decode", "llama_decode_int8",
           "llama_decode_ragged", "serving", "resnet", "moe",
-          "gpt13b_hybrid", "tp_overlap", "kernel_parity")
+          "gpt_moe_hybrid", "gpt13b_hybrid", "tp_overlap",
+          "kernel_parity")
 # benches that need a virtual multi-device mesh on the CPU fallback
-_NEEDS_VDEV = {"gpt13b_hybrid": 8, "tp_overlap": 8}
+_NEEDS_VDEV = {"gpt13b_hybrid": 8, "tp_overlap": 8, "gpt_moe_hybrid": 8}
 
 
 def _run_one(name, deadline_s=None):
@@ -956,6 +1088,7 @@ def main(argv):
                     llama_decode_int8=bench_llama_decode_int8,
                     llama_decode_ragged=bench_llama_decode_ragged,
                     serving=bench_serving_mixed,
+                    gpt_moe_hybrid=bench_gpt_moe_hybrid,
                     gpt13b_hybrid=bench_gpt13b_hybrid,
                     tp_overlap=bench_tp_overlap)
     if len(argv) > 1 and argv[1] == "--only":

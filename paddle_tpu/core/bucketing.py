@@ -1,0 +1,22 @@
+"""The shared compile-lattice bucketing helper.
+
+Dynamic sizes that reach a compiled program's shapes (serving sequence
+lengths, KV page-pool sizes, MoE expert capacity) are quantized onto a
+geometric lattice so jitter in the raw value never mints a new XLA
+program: each distinct bucket is one compilation, and the bucket count
+stays logarithmic in the dynamic range. One definition lives here —
+``inference`` (sequence/page lattice) and the MoE capacity path
+(incubate/.../moe/moe_layer.py) must stay on the SAME discipline so
+their compile-stability tests mean the same thing.
+"""
+from __future__ import annotations
+
+__all__ = ["bucket"]
+
+
+def bucket(n: int, lo: int = 64) -> int:
+    """Smallest power-of-two multiple of ``lo`` that is >= ``n``."""
+    b = lo
+    while b < n:
+        b *= 2
+    return b

@@ -53,6 +53,7 @@ from ..tensor import Tensor
 __all__ = [
     "ag_matmul", "matmul_rs", "matmul_allreduce", "matmul_gather",
     "overlap_enabled", "overlap_available",
+    "moe_a2a_ffn", "moe_overlap_enabled", "moe_overlap_available",
     "linear_ag_matmul", "linear_matmul_rs", "linear_matmul_allreduce",
     "linear_matmul_gather",
     "pick_scatter_axis", "scatter_divides", "chunk_count",
@@ -339,6 +340,143 @@ def _matmul_gather_bwd(axes, nchunks, res, g):
 
 
 matmul_gather.defvjp(_matmul_gather_fwd, _matmul_gather_bwd)
+
+
+# -- MoE: dispatch-a2a + batched expert FFN + combine-a2a as one ring -----
+#
+# The unfused expert-parallel MoE middle is
+#   all_to_all(expert_in) -> batched expert FFN -> all_to_all(out)
+# and both all_to_alls are exposed: the FFN depends on the whole
+# dispatched tensor and the combine depends on the whole FFN output.
+# The ring below exchanges one destination-rank block per tick — at
+# shift t each rank sends block (idx+t)%p of its dispatch tensor
+# directly to its owner and runs the expert GEMMs on the block that
+# just landed, so tick t+1's ppermute (a fresh slice of the input,
+# no dependence on tick t's GEMM) and tick t's return ppermute both
+# hide behind the MXU work. Reference knob:
+# ``strategy.hybrid_configs["moe_configs"]["ep_async_dispatch"]``.
+
+def moe_overlap_enabled() -> bool:
+    """The ep_async_dispatch knob, read live from the fleet strategy."""
+    from . import fleet as _fleet
+
+    strat = _fleet.get_strategy()
+    if strat is None:
+        return False
+    moe_cfg = strat.hybrid_configs.get("moe_configs") or {}
+    return bool(moe_cfg.get("ep_async_dispatch", False))
+
+
+def moe_overlap_available(axes) -> bool:
+    """True when the fused MoE ring may run: knob on, inside an SPMD
+    region, over exactly one mesh axis (the expert-dim chunking is
+    guaranteed by MoELayer's num_experts % ep check)."""
+    return (moe_overlap_enabled() and C.in_spmd_region()
+            and _ring_axis(axes) is not None)
+
+
+def _chunk_ffn(blk, w1, b1, w2, b2, act):
+    """Batched per-expert FFN on one ring block [eloc, C, d]."""
+    dt = blk.dtype
+    h = act(jnp.einsum("ecd,edf->ecf", blk, w1)
+            + b1[:, None, :].astype(dt))
+    return jnp.einsum("ecf,efd->ecd", h, w2) + b2[:, None, :].astype(dt)
+
+
+def _moe_ring_body(x, w1, b1, w2, b2, axes, act, save_blocks):
+    """The shared fwd ring. x: [E_total, C, d] (block j = the C slots
+    destined for rank j's experts); w1/b1/w2/b2 are this rank's expert
+    shards [eloc, ...]. Returns the combined [E_total, C, d] (block j =
+    rank j's expert outputs for OUR tokens) and, when ``save_blocks``,
+    the received dispatch blocks in tick order (the bwd residuals)."""
+    name, p, idx = _ring_info(axes)
+    eloc = x.shape[0] // p
+    out = jnp.zeros_like(x)
+    blocks = []
+    for t in range(p):
+        j = (idx + t) % p
+        blk = lax.dynamic_slice_in_dim(x, j * eloc, eloc, axis=0)
+        if t:
+            # send block (i+t) to rank i+t <=> receive rank (i-t)'s
+            # tokens for our experts
+            blk = C.t_ppermute(blk, name,
+                               [(s, (s + t) % p) for s in range(p)])
+        if save_blocks:
+            blocks.append(blk)
+        o = _chunk_ffn(blk, w1, b1, w2, b2, act)
+        if t:
+            # return the processed block to its token-owner rank
+            o = C.t_ppermute(o, name,
+                             [(s, (s - t) % p) for s in range(p)])
+        out = lax.dynamic_update_slice_in_dim(out, o, j * eloc, axis=0)
+    return out, blocks
+
+
+def _moe_a2a_ffn_impl(x, w1, b1, w2, b2, axes, act, save_blocks=False):
+    from ..observability import annotate as _annotate
+
+    with _annotate("moe_a2a_ffn_ring"):
+        return _moe_ring_body(x, w1, b1, w2, b2, axes, act, save_blocks)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(5, 6))
+def moe_a2a_ffn(x, w1, b1, w2, b2, axes, act):
+    """combine_a2a(expert_ffn(dispatch_a2a(x))) as one overlapped ring.
+
+    Exactly the unfused ``t_all_to_all(0,1) -> FFN -> t_all_to_all(1,0)``
+    math (concat order inside an expert's slot dim is irrelevant: the
+    FFN acts per (expert, slot) row), with the ICI exchange chunked so
+    it hides behind the expert GEMMs.
+    """
+    return _moe_a2a_ffn_impl(x, w1, b1, w2, b2, axes, act)[0]
+
+
+def _moe_a2a_ffn_fwd(x, w1, b1, w2, b2, axes, act):
+    out, blocks = _moe_a2a_ffn_impl(x, w1, b1, w2, b2, axes, act,
+                                    save_blocks=True)
+    return out, (jnp.stack(blocks), w1, b1, w2, b2)
+
+
+def _moe_a2a_ffn_bwd(axes, act, res, g):
+    """Mirrored ring: the cotangent of the combine a2a is dispatch-
+    shaped and vice versa, so dL/dout blocks travel token-owner ->
+    expert-owner (forward's dispatch direction), the per-block dFFN
+    runs against the saved dispatch blocks, and dL/dx blocks return on
+    the combine direction. Expert weight grads accumulate locally —
+    each rank owns its expert shard and saw every token routed to it,
+    so no cross-ring reduction is needed."""
+    blocks, w1, b1, w2, b2 = res
+    name, p, idx = _ring_info(axes)
+    eloc = g.shape[0] // p
+    dx = jnp.zeros_like(g)
+    dw1 = jnp.zeros_like(w1)
+    db1 = jnp.zeros_like(b1)
+    dw2 = jnp.zeros_like(w2)
+    db2 = jnp.zeros_like(b2)
+
+    def ffn(blk, a1, c1, a2, c2):
+        return _chunk_ffn(blk, a1, c1, a2, c2, act)
+
+    for t in range(p):
+        j = (idx + t) % p
+        gblk = lax.dynamic_slice_in_dim(g, j * eloc, eloc, axis=0)
+        if t:
+            gblk = C.t_ppermute(gblk, name,
+                                [(s, (s + t) % p) for s in range(p)])
+        _, pull = jax.vjp(ffn, blocks[t], w1, b1, w2, b2)
+        dblk, dw1_t, db1_t, dw2_t, db2_t = pull(gblk)
+        dw1 = dw1 + dw1_t
+        db1 = db1 + db1_t
+        dw2 = dw2 + dw2_t
+        db2 = db2 + db2_t
+        if t:
+            dblk = C.t_ppermute(dblk, name,
+                                [(s, (s - t) % p) for s in range(p)])
+        dx = lax.dynamic_update_slice_in_dim(dx, dblk, j * eloc, axis=0)
+    return dx, dw1, db1, dw2, db2
+
+
+moe_a2a_ffn.defvjp(_moe_a2a_ffn_fwd, _moe_a2a_ffn_bwd)
 
 
 # -- Tensor-level fused linears (tape + pure-transform dual path) ---------

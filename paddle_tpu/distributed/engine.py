@@ -38,6 +38,7 @@ from ..core import rng as _rng
 from ..core.compile_stats import CompileStats
 from ..observability import commledger as _cl
 from ..observability import flops as _flops
+from ..observability import moestats as _moestats
 from ..observability.catalog import train_metrics as _train_metrics
 from ..tensor import Tensor
 
@@ -56,7 +57,12 @@ except Exception:  # pragma: no cover
 
 __all__ = ["ParallelEngine", "bind_params", "param_spec", "shard_module_params"]
 
-_DATA_AXES = ("dp", "sharding")
+# axes the token batch is sharded over. 'ep' rides here too: expert
+# parallelism subdivides the data-parallel replicas (GShard/DeepSpeed-MoE
+# deployment) — each ep rank sees its own token shard, MoE expert params
+# shard over 'ep' (distinct per-rank grads, so ZeRO leaves them out and
+# the grad mean skips the axis exactly like experts-over-dp).
+_DATA_AXES = ("dp", "sharding", "ep")
 
 
 def param_spec(p) -> P:
@@ -308,6 +314,7 @@ class ParallelEngine:
             getattr(model, "config", None))
         self._stats_reported = (0, 0)    # (compiles, cache_hits) synced
         self._pending_scalars = None     # (loss_dev, gnorm_dev) lazy
+        self._pending_moe = None         # MoE stats devices, same lag
         self._prev_step_entry = None
         # per-program static comm ledgers (observability/commledger):
         # filled when a program first traces, re-published every step
@@ -439,10 +446,36 @@ class ParallelEngine:
                     pvals[i] = C.t_all_gather(pvals[i], zero.axis,
                                               axis=e[0], tiled=True)
             pvals = tuple(pvals)
+            # MoE routing telemetry: collect the traced expert-load /
+            # drop stats each MoELayer records during the forward, to be
+            # returned as extra (replicated) step outputs. The pipelined
+            # path is excluded — its stage-masked scan records values the
+            # gauges would misreport (observability/moestats.py).
+            collect_moe = not getattr(self.model, "_pp_ownership", False)
             with bind_params(params, pvals):
                 t_batch = jax.tree_util.tree_map(
                     lambda v: Tensor(v, stop_gradient=True), batch)
-                loss = fn(self.model, t_batch)
+                if collect_moe:
+                    _moestats.begin()
+                try:
+                    loss = fn(self.model, t_batch)
+                finally:
+                    moe_recs = _moestats.drain() if collect_moe else []
+                moe_tel = {}
+                for li, st in enumerate(moe_recs):
+                    load, routed = st["load"], st["routed"]
+                    dropped, aux = st["dropped"], st["aux"]
+                    if gmean_axes:
+                        # token counts ADD over the batch-sharding axes
+                        # (each rank routed its own token shard); the
+                        # aux loss averages like the reported loss
+                        load = C.t_psum(load, gmean_axes)
+                        routed = C.t_psum(routed, gmean_axes)
+                        dropped = C.t_psum(dropped, gmean_axes)
+                        aux = C.t_pmean(aux, gmean_axes)
+                    moe_tel[f"layer{li}"] = {
+                        "load": load, "routed": routed,
+                        "dropped": dropped, "aux": aux}
                 if use_scaler:
                     scale_v, good_v, bad_v, tstep_v = amp_in
                     # cap the scale below the loss dtype's max so the
@@ -604,7 +637,8 @@ class ParallelEngine:
                                  if mesh.shape[a] > 1)
                 if all_axes:
                     lv = C.t_pmean(lv, all_axes)
-            return lv, gnorm, tuple(out_p), tuple(new_s), out_m, amp_out
+            return (lv, gnorm, tuple(out_p), tuple(new_s), out_m, amp_out,
+                    moe_tel)
 
         def make(batch_treedef, b_specs, mspecs):
             def flat_step(pvals, svals, mvals, batch_leaves, lr, stepc,
@@ -618,7 +652,10 @@ class ParallelEngine:
             amp_ospec = (P(),) * 5 if use_scaler else ()
             in_specs = (pspecs, sspecs, mspecs, tuple(b_specs), P(), P(),
                         P(), amp_ispec)
-            out_specs = (P(), P(), pspecs, sspecs, mspecs, amp_ospec)
+            # the trailing P() is a pytree-prefix spec for the MoE
+            # telemetry dict: every entry is replicated (psum'd over the
+            # batch axes inside the step)
+            out_specs = (P(), P(), pspecs, sspecs, mspecs, amp_ospec, P())
             sharded = _shard_map(flat_step, mesh, in_specs, out_specs)
             return jax.jit(sharded,
                            donate_argnums=(0, 1, 2) if donate else ())
@@ -691,7 +728,7 @@ class ParallelEngine:
             # (first execution of the program); cached executions note
             # nothing and reuse the stored ledger
             with _cl.capture() as cap:
-                lv, gnorm, new_p, new_s, new_m, amp_out = \
+                lv, gnorm, new_p, new_s, new_m, amp_out, moe_tel = \
                     self._compiled[key](pvals, svals, mvals, leaf_vals,
                                         lr, stepc, seed, amp_in)
             if len(cap):
@@ -716,6 +753,7 @@ class ParallelEngine:
                     led.publish(self._metrics["comm_bytes"],
                                 self._metrics["comm_ops"])
                 self._note_step(t_entry, n_tok, lv, gnorm)
+                self._pending_moe = moe_tel
             return Tensor(lv, stop_gradient=True)
 
         return step
@@ -727,6 +765,13 @@ class ParallelEngine:
         from metrics_snapshot), so the fetch blocks only on work that
         is already done — telemetry adds no sync to the hot path."""
         pend = self._pending_scalars
+        moe_pend = self._pending_moe
+        self._pending_moe = None
+        if moe_pend:
+            try:
+                _moestats.publish(moe_pend, self._metrics)
+            except Exception:
+                pass    # a dead device must not take telemetry down
         if pend is None:
             return
         self._pending_scalars = None
@@ -841,6 +886,7 @@ class ParallelEngine:
             "step_count": opt._step_count,
             "seed": self._seed,
             "pending": self._pending_scalars,
+            "pending_moe": self._pending_moe,
         }
         from ..optimizer.lr import LRScheduler
 
@@ -858,6 +904,7 @@ class ParallelEngine:
         opt._step_count = snap["step_count"]
         self._seed = snap["seed"]
         self._pending_scalars = snap["pending"]
+        self._pending_moe = snap["pending_moe"]
         if "lr_state" in snap:
             opt._lr.__dict__.update(snap["lr_state"])
 

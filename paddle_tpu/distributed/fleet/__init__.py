@@ -41,7 +41,11 @@ def init(role_maker=None, is_collective: bool = True,
         pp_degree=hc.get("pp_degree", 1),
         sharding_degree=hc.get("sharding_degree", 1),
         sep_degree=hc.get("sep_degree", 1),
-        order=list(hc.get("order", ["dp", "pp", "sharding", "sep", "mp"])),
+        # expert parallelism: the 'ep' mesh axis MoELayer shards its
+        # stacked expert weights over and runs token dispatch/combine on
+        ep_degree=hc.get("ep_degree", 1),
+        order=list(hc.get("order",
+                          ["dp", "pp", "sharding", "sep", "ep", "mp"])),
         # circular-interleave schedule knob, plumbed to PipelineLayer
         # (pp_layers.py) via the HCG
         vpp_degree=pp_conf.get("num_virtual_pipeline_stages", 1))

@@ -14,7 +14,12 @@ _HYBRID_DEFAULTS = {
     "pp_degree": 1,
     "sharding_degree": 1,
     "sep_degree": 1,
-    "order": ["dp", "pp", "sharding", "sep", "mp"],
+    # expert parallelism: stacked [E, d, h] MoE expert weights shard
+    # over the 'ep' mesh axis and token dispatch/combine is an
+    # all_to_all on it (incubate/.../moe/moe_layer.py). Like dp, 'ep'
+    # splits the token batch — the engine treats it as a data axis.
+    "ep_degree": 1,
+    "order": ["dp", "pp", "sharding", "sep", "ep", "mp"],
     # mp_async_allreduce (reference hybrid_configs:1808): overlap the
     # TP/SP collectives with the matmuls they feed via the chunked ring
     # decompositions in distributed/collective_matmul.py
@@ -26,6 +31,12 @@ _HYBRID_DEFAULTS = {
     # pp_layers.py). Requires num_layers % (pp*vpp) == 0 and
     # accumulate_steps % pp == 0.
     "pp_configs": {"num_virtual_pipeline_stages": 1},
+    # ep_async_dispatch: fuse the MoE dispatch/combine all_to_alls with
+    # the expert GEMMs as a chunked ppermute ring
+    # (distributed/collective_matmul.py moe_a2a_ffn) so the ICI
+    # exchange hides behind the per-chunk expert FFN; unfused fallback
+    # outside SPMD or when E doesn't chunk over the ring.
+    "moe_configs": {"ep_async_dispatch": False},
 }
 
 
@@ -40,7 +51,7 @@ class DistributedStrategy:
     def __init__(self):
         self._hybrid_configs: Dict[str, Any] = dict(_HYBRID_DEFAULTS)
         # nested sub-configs must not alias the class-level defaults
-        for k in ("mp_configs", "pp_configs"):
+        for k in ("mp_configs", "pp_configs", "moe_configs"):
             self._hybrid_configs[k] = _SubConfig(_HYBRID_DEFAULTS[k])
         self.pipeline_configs: Dict[str, Any] = {
             "micro_batch_size": 1, "accumulate_steps": 1}
@@ -67,7 +78,8 @@ class DistributedStrategy:
     @hybrid_configs.setter
     def hybrid_configs(self, configs: Dict[str, Any]):
         for k, v in configs.items():
-            if k in ("mp_configs", "pp_configs") and isinstance(v, dict):
+            if k in ("mp_configs", "pp_configs", "moe_configs") \
+                    and isinstance(v, dict):
                 merged = _SubConfig(self._hybrid_configs.get(k, {}))
                 merged.update(v)
                 self._hybrid_configs[k] = merged

@@ -22,7 +22,7 @@ from ... import collective as C
 
 __all__ = ["CommunicateTopology", "HybridCommunicateGroup"]
 
-_DEFAULT_ORDER = ["dp", "pp", "sharding", "sep", "mp"]
+_DEFAULT_ORDER = ["dp", "pp", "sharding", "sep", "ep", "mp"]
 
 
 class CommunicateTopology:
@@ -50,7 +50,7 @@ class HybridCommunicateGroup:
                  sharding_degree: int = 1, sep_degree: int = 1,
                  order: Optional[List[str]] = None,
                  devices: Optional[list] = None,
-                 vpp_degree: int = 1):
+                 vpp_degree: int = 1, ep_degree: int = 1):
         if topology is not None:
             degrees = {n: topology.get_dim(n)
                        for n in topology.get_hybrid_group_names()}
@@ -59,21 +59,28 @@ class HybridCommunicateGroup:
             pp_degree = degrees.get("pp", 1)
             sharding_degree = degrees.get("sharding", 1)
             sep_degree = degrees.get("sep", 1)
+            ep_degree = degrees.get("ep", 1)
         self._dp_degree = dp_degree
         self._mp_degree = mp_degree
         self._pp_degree = pp_degree
         self._sharding_degree = sharding_degree
         self._sep_degree = sep_degree
+        self._ep_degree = ep_degree
         # virtual pipeline (circular interleave) chunks per pp stage —
         # a schedule knob, not a mesh axis: it multiplies layer chunks,
         # not devices (pp_layers.PipelineLayer reads it at build time)
         self._vpp_degree = int(vpp_degree or 1)
-        self._order = order or _DEFAULT_ORDER
+        self._order = list(order) if order else list(_DEFAULT_ORDER)
+        if ep_degree > 1 and "ep" not in self._order:
+            raise ValueError(
+                f"ep_degree={ep_degree} needs an 'ep' axis in the hybrid "
+                f"order, got {self._order}; add 'ep' (default order is "
+                f"{_DEFAULT_ORDER}) or drop the custom order")
         self._topo = topology or CommunicateTopology(
             self._order, [self._degree_of(n) for n in self._order])
 
         total = (dp_degree * mp_degree * pp_degree * sharding_degree *
-                 sep_degree)
+                 sep_degree * ep_degree)
         devs = devices if devices is not None else jax.devices()
         if total > len(devs):
             raise ValueError(
@@ -97,7 +104,7 @@ class HybridCommunicateGroup:
     def _degree_of(self, name: str) -> int:
         return {"dp": self._dp_degree, "mp": self._mp_degree,
                 "pp": self._pp_degree, "sharding": self._sharding_degree,
-                "sep": self._sep_degree}[name]
+                "sep": self._sep_degree, "ep": self._ep_degree}[name]
 
     # -- degrees (reference API parity) ---------------------------------
     def get_data_parallel_world_size(self):
@@ -120,6 +127,9 @@ class HybridCommunicateGroup:
     def get_sep_parallel_world_size(self):
         return self._sep_degree
 
+    def get_expert_parallel_world_size(self):
+        return self._ep_degree
+
     # -- ranks: traced inside SPMD region -------------------------------
     def get_data_parallel_rank(self):
         return self._axis_rank("dp")
@@ -135,6 +145,9 @@ class HybridCommunicateGroup:
 
     def get_sep_parallel_rank(self):
         return self._axis_rank("sep")
+
+    def get_expert_parallel_rank(self):
+        return self._axis_rank("ep")
 
     def _axis_rank(self, name):
         if C.in_spmd_region():
@@ -158,6 +171,9 @@ class HybridCommunicateGroup:
 
     def get_sep_parallel_group(self):
         return self._groups["sep"]
+
+    def get_expert_parallel_group(self):
+        return self._groups.get("ep")
 
     def get_check_parallel_group(self, *a):
         return self._groups["world"]
@@ -186,4 +202,5 @@ class HybridCommunicateGroup:
     def __repr__(self):
         return (f"HCG(dp={self._dp_degree}, pp={self._pp_degree}, "
                 f"sharding={self._sharding_degree}, sep={self._sep_degree}, "
-                f"mp={self._mp_degree}, order={self._order})")
+                f"ep={self._ep_degree}, mp={self._mp_degree}, "
+                f"order={self._order})")

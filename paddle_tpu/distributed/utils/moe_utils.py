@@ -43,13 +43,27 @@ def _a2a(x: Tensor, axes, split_axis: int, concat_axis: int,
 
 
 def _check_uniform(counts, world, name):
+    """Reject the variable-length count protocol with an actionable
+    error: XLA collectives compile to static shapes, so the NCCL-style
+    per-(rank, expert) counts the reference op accepts must all be
+    EQUAL here (one fixed capacity per slot)."""
     if counts is None:
         return
-    vals = counts.numpy() if isinstance(counts, Tensor) else counts
-    enforce(len(set(int(v) for v in vals)) <= 1,
-            f"{name}: XLA all_to_all needs uniform per-rank counts; lay "
-            "tokens out at fixed capacity (MoELayer does this) — got "
-            f"{list(vals)[:8]}")
+    vals = [int(v) for v in
+            (counts.numpy() if isinstance(counts, Tensor) else counts)]
+    distinct = sorted(set(vals))
+    enforce(
+        len(distinct) <= 1,
+        f"{name}: non-uniform per-rank token counts {vals} "
+        f"({len(distinct)} distinct values {distinct}, group size "
+        f"{world}). XLA's all_to_all is compiled with a static shape, "
+        f"so the reference's variable-length send/recv protocol "
+        f"becomes a uniform-slot exchange: every rank must move the "
+        f"SAME count per peer. Pad each (rank, expert) slot to a fixed "
+        f"capacity C = max(counts) and lay tokens out as "
+        f"[n_expert_total, C, d] (MoELayer's dense GShard dispatch "
+        f"does exactly this), or route through MoELayer instead of "
+        f"calling {name} directly.")
 
 
 def global_scatter(x: Tensor, local_count=None, global_count=None,
@@ -66,9 +80,12 @@ def global_scatter(x: Tensor, local_count=None, global_count=None,
     if squeeze:
         from ...ops import manipulation as M
 
+        # [n*k, d] -> [n, k, d], then the shape-preserving block
+        # exchange (split == concat axis): block j -> rank j. This is
+        # an involution, so the gather round trip is the identity.
         n = g.nranks
         x = M.reshape(x, [n, x.shape[0] // n, x.shape[1]])
-        out = _a2a(x, axes, 0, 1, "global_scatter")
+        out = _a2a(x, axes, 0, 0, "global_scatter")
         return M.reshape(out, [-1, out.shape[-1]])
     return _a2a(x, axes, 0, 1, "global_scatter")
 
@@ -86,8 +103,12 @@ def global_gather(x: Tensor, local_count=None, global_count=None,
     if squeeze:
         from ...ops import manipulation as M
 
+        # inverse of global_scatter's 2D form: the SAME shape-preserving
+        # block exchange (it is an involution). The previous
+        # (split=1, concat=0) form on the [n, k, d] reshape was neither
+        # the inverse nor generally legal (needed n | k).
         n = g.nranks
         x = M.reshape(x, [n, x.shape[0] // n, x.shape[1]])
-        out = _a2a(x, axes, 1, 0, "global_gather")
+        out = _a2a(x, axes, 0, 0, "global_gather")
         return M.reshape(out, [-1, out.shape[-1]])
     return _a2a(x, axes, 1, 0, "global_gather")

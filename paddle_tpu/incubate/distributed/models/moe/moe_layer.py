@@ -23,6 +23,7 @@ expert modules (the reference loops over ``self.experts`` per rank).
 from __future__ import annotations
 
 import math
+from functools import partial
 from typing import List, Optional
 
 import jax
@@ -35,6 +36,7 @@ from .....autograd import engine as _engine
 from .....core.enforce import enforce
 from .....distributed import collective as C
 from .....nn.layer import Layer
+from .....observability import moestats as _moestats
 from .....tensor import Tensor
 from .gate import BaseGate, GShardGate, NaiveGate, SwitchGate
 
@@ -77,29 +79,69 @@ def _topk_dispatch(probs, k: int, cap: int):
     return combine, dispatch, aux
 
 
-def _moe_forward(x2d, gate_w, w1, b1, w2, b2, axes, k, cap, act_fn):
-    """Pure function: tokens [T, d] → (mixed output [T, d], aux loss)."""
+@partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3))
+def _ledger_a2a(x, axes, split_axis, concat_axis):
+    """t_all_to_all whose BACKWARD also routes through the traced-
+    collective shim: jax's built-in all_to_all transpose calls lax
+    directly, which would leave the bwd dispatch/combine exchanges out
+    of the comm ledger. The transpose of a (split s, concat c) a2a is
+    the (split c, concat s) a2a."""
+    return C.t_all_to_all(x, axes, split_axis, concat_axis, tiled=True)
+
+
+def _ledger_a2a_fwd(x, axes, split_axis, concat_axis):
+    return _ledger_a2a(x, axes, split_axis, concat_axis), None
+
+
+def _ledger_a2a_bwd(axes, split_axis, concat_axis, _, g):
+    return (C.t_all_to_all(g, axes, concat_axis, split_axis, tiled=True),)
+
+
+_ledger_a2a.defvjp(_ledger_a2a_fwd, _ledger_a2a_bwd)
+
+
+def _moe_forward(x2d, gate_w, w1, b1, w2, b2, axes, k, cap, act_fn,
+                 ring=False):
+    """Pure function: tokens [T, d] → ((output [T, d], aux loss),
+    routing stats). The stats dict (per-expert load, routed/dropped
+    slot counts) is non-differentiated telemetry — callers take it
+    through ``jax.vjp(..., has_aux=True)``."""
     dt = x2d.dtype
+    T = x2d.shape[0]
     logits = x2d.astype(jnp.float32) @ gate_w.astype(jnp.float32)
     probs = jax.nn.softmax(logits, axis=-1)
     combine, dispatch, aux = _topk_dispatch(probs, k, cap)
+    routed = jnp.float32(T * k)
+    kept = jnp.sum(dispatch.astype(jnp.float32))
+    stats = {
+        "load": lax.stop_gradient(
+            jnp.sum(dispatch, axis=(0, 2)).astype(jnp.float32)),
+        "routed": routed,
+        "dropped": lax.stop_gradient(jnp.maximum(routed - kept, 0.0)),
+        "aux": lax.stop_gradient(aux.astype(jnp.float32)),
+    }
     # dispatch: [T,E,C] x [T,d] -> [E,C,d]
     expert_in = jnp.einsum("tec,td->ecd", dispatch.astype(dt), x2d)
+    if axes and ring:
+        # fused path (ep_async_dispatch): dispatch-a2a + expert FFN +
+        # combine-a2a as one chunked ppermute ring, the ICI exchange
+        # hidden behind the per-block expert GEMMs
+        from .....distributed import collective_matmul as cm
+
+        out = cm.moe_a2a_ffn(expert_in, w1, b1, w2, b2, axes, act_fn)
+        y = jnp.einsum("ecd,tec->td", out, combine.astype(dt))
+        return (y, aux), stats
     if axes:
         # [E, C, d] -> [E/n, n*C, d]: each rank keeps its experts, slots
         # from every source rank ride ICI
-        from .....distributed import collective as C
-
-        expert_in = C.t_all_to_all(expert_in, axes, 0, 1, tiled=True)
+        expert_in = _ledger_a2a(expert_in, axes, 0, 1)
     h = act_fn(jnp.einsum("ecd,edf->ecf", expert_in, w1)
                + b1[:, None, :].astype(dt))
     out = jnp.einsum("ecf,efd->ecd", h, w2) + b2[:, None, :].astype(dt)
     if axes:
-        from .....distributed import collective as C
-
-        out = C.t_all_to_all(out, axes, 1, 0, tiled=True)
+        out = _ledger_a2a(out, axes, 1, 0)
     y = jnp.einsum("ecd,tec->td", out, combine.astype(dt))
-    return y, aux
+    return (y, aux), stats
 
 
 def _extract_expert_weights(experts: List[Layer]):
@@ -136,8 +178,10 @@ class MoELayer(Layer):
         MoELayer(d_model, d_hidden=2048, num_experts=8, gate="gshard")
 
     ``group`` is the expert-parallel group (reference ``moe_group``);
-    defaults to the fleet dp group — the standard "experts over dp"
-    deployment. Stacked expert params are sharded over it on dim 0.
+    defaults to the fleet 'ep' group when ``ep_degree > 1`` (expert
+    parallelism as a first-class hybrid axis), else to the dp group —
+    the legacy "experts over dp" deployment. Stacked expert params are
+    sharded over it on dim 0.
     """
 
     def __init__(self, d_model: int, experts=None, gate=None,
@@ -156,7 +200,11 @@ class MoELayer(Layer):
             from .....distributed import fleet as _fleet
 
             hcg = _fleet.get_hybrid_communicate_group()
-            if hcg is not None and hcg.get_data_parallel_world_size() > 1:
+            if hcg is not None and \
+                    hcg.get_expert_parallel_world_size() > 1:
+                group = hcg.get_expert_parallel_group()
+            elif hcg is not None and \
+                    hcg.get_data_parallel_world_size() > 1:
                 group = hcg.get_data_parallel_group()
         self._group = group
         self.world_size = group.nranks if group is not None else 1
@@ -212,8 +260,17 @@ class MoELayer(Layer):
         cf = self.gate.capacity_factor
         if cf is None:
             return T  # naive gate: no token dropped
-        return max(1, int(math.ceil(self.gate.top_k * cf * T
-                                    / self.num_experts)))
+        raw = max(1, int(math.ceil(self.gate.top_k * cf * T
+                                   / self.num_experts)))
+        # bucket C onto the serving compile lattice (core/bucketing):
+        # token-count / capacity-factor jitter lands on a handful of
+        # power-of-two capacities instead of minting a new XLA program
+        # per value. Rounding UP only ever keeps more tokens (effective
+        # capacity factor >= requested); a cap above T is dead slots
+        # (each expert queues at most T tokens), so clamp there.
+        from .....core.bucketing import bucket
+
+        return min(bucket(raw, lo=1), T)
 
     def forward(self, x: Tensor) -> Tensor:
         shape = list(x.shape)
@@ -225,22 +282,26 @@ class MoELayer(Layer):
                 if self.world_size > 1 and C.in_spmd_region()
                 and self._group is not None else ())
 
+        from .....distributed import collective_matmul as _cm
+
+        ring = bool(axes) and _cm.moe_overlap_available(axes)
         x2d = x._value.reshape(T, self.d_model)
         ins = (x2d, self.gate.weight._value, self.w1._value, self.b1._value,
                self.w2._value, self.b2._value)
 
         def pure(*vals):
             return _moe_forward(*vals, axes=axes, k=self.gate.top_k,
-                                cap=cap, act_fn=self._act)
+                                cap=cap, act_fn=self._act, ring=ring)
 
         in_tensors = [x, self.gate.weight, self.w1, self.b1, self.w2,
                       self.b2]
         need_grad = _engine.is_grad_enabled() and any(
             not t.stop_gradient for t in in_tensors)
         if need_grad:
-            (y2d, aux), vjp_fn = jax.vjp(pure, *ins)
+            (y2d, aux), vjp_fn, stats = jax.vjp(pure, *ins, has_aux=True)
         else:  # inference: skip the linearization + residuals entirely
-            y2d, aux = pure(*ins)
+            (y2d, aux), stats = pure(*ins)
+        _moestats.record(stats)
         y = Tensor(y2d.reshape(shape), stop_gradient=True)
         aux_t = Tensor(aux, stop_gradient=True)
         if need_grad:

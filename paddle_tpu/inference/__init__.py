@@ -54,11 +54,9 @@ __all__ = ["Config", "Predictor", "create_predictor", "GenerationConfig",
            "CompileStats", "ServingEngine", "ServingRequest"]
 
 
-def _bucket(n: int, lo: int = 64) -> int:
-    b = lo
-    while b < n:
-        b *= 2
-    return b
+# one lattice definition for the whole tree (serving S/P buckets, MoE
+# expert capacity): core/bucketing.py
+from ..core.bucketing import bucket as _bucket  # noqa: E402
 
 
 # shared with the training engine (ParallelEngine.stats); the class

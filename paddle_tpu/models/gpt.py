@@ -119,7 +119,7 @@ def _masked_mean_over_splits(num, den):
 
     mesh = C.get_world_mesh() if C.in_spmd_region() else None
     if mesh is not None:
-        axes = tuple(a for a in ("dp", "sharding", "sep")
+        axes = tuple(a for a in ("dp", "sharding", "sep", "ep")
                      if a in mesh.axis_names and mesh.shape[a] > 1)
         if axes:
             R = 1

@@ -41,6 +41,7 @@ from .flight import FlightRecorder, dump as dump_flight_record, \
     get_recorder  # noqa: F401
 from . import flops  # noqa: F401
 from . import commledger  # noqa: F401
+from . import moestats  # noqa: F401
 from . import spans  # noqa: F401
 from .commledger import CommLedger  # noqa: F401
 from .spans import RequestTrace, SpanRing  # noqa: F401
@@ -51,7 +52,7 @@ __all__ = [
     "DEFAULT_LATENCY_BUCKETS", "get_registry", "reset_registry",
     "parse_prometheus_text", "annotate", "current_regions",
     "FlightRecorder", "dump_flight_record", "get_recorder", "flops",
-    "cross_host_sum", "commledger", "CommLedger", "spans",
+    "cross_host_sum", "commledger", "CommLedger", "moestats", "spans",
     "RequestTrace", "SpanRing", "MetricsServer", "serve_metrics",
 ]
 

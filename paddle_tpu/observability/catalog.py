@@ -125,6 +125,23 @@ def train_metrics(reg: MetricsRegistry = None) -> Dict[str, object]:
             "paddle_tpu_device_memory_bytes",
             "per-device memory stats from the jax runtime",
             labelnames=("device", "stat"), unit="bytes"),
+        "moe_expert_load": r.gauge(
+            "paddle_tpu_moe_expert_load",
+            "fraction of routed-and-kept tokens landing on each "
+            "expert last step, summed over the batch-sharding axes "
+            "(1/E everywhere = perfectly balanced routing; fetched "
+            "with the loss's one-step lag — observability/moestats.py)",
+            labelnames=("layer", "expert")),
+        "moe_drop_rate": r.gauge(
+            "paddle_tpu_moe_token_drop_rate",
+            "fraction of routing slots (tokens x top_k) dropped at "
+            "capacity last step, per MoE layer",
+            labelnames=("layer",)),
+        "moe_aux_loss": r.gauge(
+            "paddle_tpu_moe_aux_loss",
+            "load-balance auxiliary loss of the last step "
+            "(unscaled, averaged over ep ranks), per MoE layer",
+            labelnames=("layer",)),
     })
     return out
 

@@ -244,6 +244,62 @@ class TestDpGradSyncLedger:
 
 
 # ---------------------------------------------------------------------------
+# EP all-to-all through the compiled MoE step (the expert-parallel axis)
+# ---------------------------------------------------------------------------
+class TestEpA2aLedger:
+    def _engine(self, async_dispatch):
+        from paddle_tpu.distributed import fleet
+        from paddle_tpu.incubate.distributed.models.moe import MoELayer
+
+        strategy = fleet.DistributedStrategy()
+        strategy.hybrid_configs = {
+            "dp_degree": 1, "ep_degree": 8, "mp_degree": 1,
+            "moe_configs": {"ep_async_dispatch": async_dispatch}}
+        hcg = fleet.init(is_collective=True, strategy=strategy)
+        paddle.seed(0)
+        model = MoELayer(8, d_hidden=16, num_experts=8, gate="gshard")
+        opt = paddle.optimizer.Adam(learning_rate=0.01,
+                                    parameters=model.parameters())
+        eng = ParallelEngine(model, opt, hcg.mesh)
+        step = eng.train_step(
+            lambda m, b: paddle.mean(m(b["x"]) ** 2) + 0.01 * m.aux_loss)
+        r = np.random.RandomState(0)
+        batch = {"x": paddle.to_tensor(
+            r.randn(16, 8, 8).astype("float32"))}
+        float(step(batch))
+        # per-rank shapes of the dispatch tensor [E, C, d]
+        T_local = 16 * 8 // 8
+        C_cap = model._capacity(T_local)
+        return eng, 8 * C_cap * 8 * F32
+
+    def test_unfused_a2a_closed_form(self):
+        """dispatch + combine, fwd + bwd = 4 all_to_alls of the full
+        [E, C, d] dispatch tensor, each (p-1)/p x payload on the wire
+        (the _ledger_a2a custom VJP keeps the backward pair visible)."""
+        eng, payload = self._engine(False)
+        led = eng.comm_ledger()
+        p = 8
+        assert led.ops_for(axis="ep", op="all_to_all") == 4
+        assert led.bytes_for(axis="ep", op="all_to_all") == \
+            4 * (p - 1) / p * payload
+        assert led.ops_for(axis="ep", op="ppermute") == 0
+
+    def test_fused_ring_same_wire_bytes(self):
+        """ep_async_dispatch rides ppermutes: 2(p-1) per direction per
+        pass = 4(p-1) block-sized shifts, totalling EXACTLY the a2a
+        closed form (the ring re-chunks the exchange, it does not move
+        more bytes)."""
+        eng, payload = self._engine(True)
+        led = eng.comm_ledger()
+        p = 8
+        block = payload // p                  # [E/p, C, d] per tick
+        assert led.ops_for(axis="ep", op="all_to_all") == 0
+        assert led.ops_for(axis="ep", op="ppermute") == 4 * (p - 1)
+        assert led.bytes_for(axis="ep", op="ppermute") == \
+            4 * (p - 1) * block == 4 * (p - 1) / p * payload
+
+
+# ---------------------------------------------------------------------------
 # ablation stand-ins
 # ---------------------------------------------------------------------------
 class TestAblation:

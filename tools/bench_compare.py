@@ -39,11 +39,18 @@ __all__ = ["load_rounds", "parse_metrics", "compare", "trajectory",
 _LOWER_BETTER_UNITS = {"ms"}
 # metrics that must stay exactly at their expected value
 _EXACT = {"pallas_kernel_parity_interpret": 1.0,
-          "pallas_kernel_parity_onchip": 1.0}
+          "pallas_kernel_parity_onchip": 1.0,
+          # MoE-on-mesh loss parity vs the single-device dense-dispatch
+          # golden (<= 1e-5 on the CPU smoke) — pass/fail, never drifts
+          "gpt_moe_hybrid_loss_parity": 1.0}
 # per-metric relative thresholds overriding the CLI default (CPU smoke
 # lines are noisy; recompile counts are exact)
 _THRESHOLDS = {
     "recompiles_after_warmup": 0.0,
+    # the MoE hybrid smoke line runs a 3-way (dp x ep x mp) 8-vdev CPU
+    # mesh — wall-clock noise is higher than single-axis smokes, so
+    # only flag large tokens/s moves; on chip the default applies
+    "gpt_moe_hybrid_smoke_tokens_per_sec": 0.5,
 }
 # line kinds that are status reports, not comparable measurements
 _SKIP_UNITS = {"error", "needs_chips", "skipped", "ok"}
