@@ -455,12 +455,14 @@ def bench_gpt13b_hybrid(on_tpu, dev):
     from paddle_tpu.models import GPTForCausalLMPipe
     from paddle_tpu.models.gpt import GPTConfig
 
+    from paddle_tpu.observability import flops as _flops
+
     n = jax.device_count()
     if on_tpu and n < 8:
         _emit({"metric": "gpt13b_hybrid_train_tokens_per_sec",
                "value": 0.0, "unit": "needs_chips", "vs_baseline": 0.0,
                "needs_devices": 8, "have_devices": n,
-               "note": "13B = TP4 x PP2 x DP(n/8) + sharding stage2; "
+               "note": "13B = TP4 x PP2 x sharding(n/8) stage2; "
                        "config compiled/validated on the 8-virtual-"
                        "device CPU mesh (dryrun + this bench on CPU)"})
         return
@@ -469,31 +471,46 @@ def bench_gpt13b_hybrid(on_tpu, dev):
         cfg = GPTConfig(vocab_size=50304, hidden_size=5120,
                         num_layers=40, num_heads=40,
                         max_position_embeddings=1024, dtype="bfloat16")
-        dp = max(n // 8, 1)
-        B, S, steps, state_dtype = 4 * dp, 1024, 5, "bfloat16"
+        mp_deg, shard_deg = 4, max(n // 8, 1)
+        B, S, steps, state_dtype = 4 * shard_deg, 1024, 5, "bfloat16"
+        buf_mb = 64.0
     else:
         cfg = GPTConfig(vocab_size=512, hidden_size=64, num_layers=4,
                         num_heads=4, max_position_embeddings=64)
-        dp = max(n // 8, 1)
-        B, S, steps, state_dtype = 2 * dp * 2, 16, 2, None
+        # the smoke mesh carries a REAL sharding axis (mp2 x pp2 x
+        # sharding2 = 8 vdevs) so the stage-2 grad reduce-scatter —
+        # the tail comm_overlap exists to hide — is actually on the
+        # wire and in the exposed-comm report
+        mp_deg, shard_deg = 2, 2
+        B, S, steps, state_dtype = 2 * shard_deg * 2, 16, 2, None
+        buf_mb = 0.001        # tiny target -> several buckets at toy size
 
-    # vpp=1 (GPipe-family rotation) and vpp=2 (circular interleaved
-    # schedule, pp_layers._pipe_fn): same model/mesh/microbatches, so
-    # the two lines isolate the schedule's bubble effect
-    for vpp in (1, 2):
+    # three lines, one knob apart each: vpp=1 (GPipe-family rotation),
+    # vpp=2 (circular interleave), and vpp=1 + comm_overlap (T3-style
+    # bucketed backward: per-bucket grad reduce-scatter inside the
+    # backward seam, distributed/grad_buckets.py). base vs overlap is
+    # the same program shape, so the loss-parity and
+    # profile_exposed_comm("sharding") comparison is one flag apart.
+    results = {}
+    for tag, vpp, overlap in (("base", 1, False), ("vpp2", 2, False),
+                              ("overlap", 1, True)):
         paddle.seed(0)
         strategy = fleet.DistributedStrategy()
         strategy.hybrid_configs = {
-            "dp_degree": dp, "mp_degree": 4,
+            "dp_degree": 1, "mp_degree": mp_deg,
             "pp_degree": 2,
-            "sharding_degree": 1,
+            "sharding_degree": shard_deg,
             # collective-matmul overlap on the TP hot
             # path (distributed/collective_matmul.py)
             "mp_configs": {"mp_async_allreduce": True},
-            "pp_configs": {"num_virtual_pipeline_stages": vpp}}
+            "pp_configs": {"num_virtual_pipeline_stages": vpp},
+            # T3-style bucketed grad sync (grad_buckets.py)
+            "sharding_configs": {"comm_overlap": overlap,
+                                 "comm_buffer_size_MB": buf_mb}}
         strategy.sharding_configs = {"stage": 2}
-        strategy.pipeline_configs = {"accumulate_steps": 2,
-                                     "micro_batch_size": B // (2 * dp)}
+        strategy.pipeline_configs = {
+            "accumulate_steps": 2,
+            "micro_batch_size": B // (2 * shard_deg)}
         hcg = fleet.init(is_collective=True, strategy=strategy)
         model = GPTForCausalLMPipe(cfg)
         dist_model = fleet.distributed_model(model)
@@ -505,14 +522,12 @@ def bench_gpt13b_hybrid(on_tpu, dev):
         ids = r.randint(0, cfg.vocab_size, (B, S + 1))
         x = paddle.to_tensor(ids[:, :-1])
         y = paddle.to_tensor(ids[:, 1:])
-        loss = dist_model.train_batch([x, y], opt)
-        float(loss)
+        losses = [float(dist_model.train_batch([x, y], opt))]
         stats = dist_model._engine.stats
         compiles_warm = stats.compiles
         t0 = time.perf_counter()
         for _ in range(steps):
-            loss = dist_model.train_batch([x, y], opt)
-        float(loss)
+            losses.append(float(dist_model.train_batch([x, y], opt)))
         dt = time.perf_counter() - t0
         tok_s = B * S * steps / dt
         # exposed-comm attribution (observability/commledger): per-axis
@@ -532,25 +547,30 @@ def bench_gpt13b_hybrid(on_tpu, dev):
             "grad_sync_exposed_seconds": round(
                 prof.grad_sync_exposed_seconds, 6),
         }
-        led = dist_model._engine.comm_ledger()
+        eng = dist_model._engine
+        led = eng.comm_ledger()
         comm_bytes_per_step = {
             f"{a}/{o}": round(t["bytes"], 1)
             for (a, o), t in sorted(led.totals().items())} if led else {}
+        plan = eng._bucket_plan
+        results[tag] = {"losses": losses, "prof": prof, "led": led,
+                        "plan": plan}
         peak, _ = _chip(dev)
         n_params = cfg.num_params()
         mfu = (6.0 * n_params * tok_s / (peak * n)) if peak else 0.0
         base = ("gpt13b_hybrid_train_tokens_per_sec" if on_tpu
                 else "gpt13b_hybrid_smoke_tokens_per_sec")
-        _emit({
-            "metric": base if vpp == 1 else
-            base.replace("gpt13b_hybrid", "gpt13b_hybrid_vpp2"),
+        line = {
+            "metric": base if tag == "base" else
+            base.replace("gpt13b_hybrid", f"gpt13b_hybrid_{tag}"),
             "value": round(tok_s, 2),
             "unit": "tokens/s",
             "vs_baseline": round(mfu / 0.45, 4) if peak else 0.0,
             "mfu": round(mfu, 4) if peak else 0.0,
-            "mesh": f"dp{dp}xpp2xmp4", "devices": n,
+            "mesh": f"sharding{shard_deg}xpp2xmp{mp_deg}", "devices": n,
             "mp_async_allreduce": True,
             "pp_vpp": vpp,
+            "comm_overlap": overlap,
             # engine compile-cache counters: steady state must be
             # recompile-free (overlap regressions keyed on traced shapes
             # would show here)
@@ -565,7 +585,36 @@ def bench_gpt13b_hybrid(on_tpu, dev):
             "exposed_comm": exposed_comm,
             "telemetry": _telemetry_section(),
             "device": str(getattr(dev, "device_kind", dev.platform)),
-        })
+        }
+        if overlap and plan is not None:
+            summ = plan.summary()
+            line["grad_buckets"] = summ["buckets"]
+            line["bucket_payload_bytes"] = summ["bucket_payload_bytes"]
+            line["grad_sync_floor_seconds"] = round(
+                _flops.comm_seconds_lower_bound(
+                    led.bytes_for(axis="sharding"), dev), 6) if led \
+                else 0.0
+        _emit(line)
+
+    # the T3 acceptance pair: knob-on vs knob-off on the same program —
+    # loss parity (exact-gated in tools/bench_compare.py) and the
+    # sharding axis's exposed seconds (direction-aware: lower is better)
+    base_r, ov_r = results["base"], results["overlap"]
+    parity = max(abs(a - b) for a, b in zip(base_r["losses"],
+                                            ov_r["losses"]))
+    _emit({"metric": "gpt13b_hybrid_overlap_loss_parity",
+           "value": 1.0 if parity <= 1e-5 else 0.0, "unit": "pass",
+           "vs_baseline": 1.0, "max_abs_loss_diff": parity,
+           "grad_buckets": (ov_r["plan"].num_buckets
+                            if ov_r["plan"] else 0)})
+    exp_off = base_r["prof"].exposed_seconds.get("sharding", 0.0)
+    exp_on = ov_r["prof"].exposed_seconds.get("sharding", 0.0)
+    _emit({"metric": "gpt13b_hybrid_grad_sync_exposed_seconds",
+           "value": round(exp_on, 6), "unit": "s", "vs_baseline": 0.0,
+           "knob_off_exposed_seconds": round(exp_off, 6),
+           "exposed_lower_than_knob_off": bool(exp_on < exp_off),
+           "note": "CPU smoke proves parity + compile stability; the "
+                   "realized overlap win is an on-TPU ROADMAP item"})
 
 
 # ---------------------------------------------------------------------------
@@ -959,7 +1008,7 @@ _BENCHES = {}
 # each + headline printed last = one hang, zero lines).
 _TIMEOUTS = {"gpt": 900, "llama_decode": 420, "llama_decode_int8": 420,
              "llama_decode_ragged": 420, "serving": 420, "resnet": 300,
-             "moe": 300, "gpt_moe_hybrid": 420, "gpt13b_hybrid": 700,
+             "moe": 300, "gpt_moe_hybrid": 420, "gpt13b_hybrid": 900,
              "tp_overlap": 240, "kernel_parity": 240}
 _ORDER = ("gpt", "llama_decode", "llama_decode_int8",
           "llama_decode_ragged", "serving", "resnet", "moe",

@@ -93,9 +93,16 @@ class _ZeroPlan:
     sharded; params stay replicated across 'sharding'.
     Stage 3 ("p_g_os"):   params are *stored* sharded and all-gathered
     at step entry (donated buffers keep peak memory at shard size).
+
+    ``row_dims`` (the per-bucket ZeRO plan): {id(param): k} marking k
+    leading stacked-layer dims the shard-dim search must skip — set
+    when comm_overlap buckets the grad sync along the pp stacked-params
+    seam (distributed/grad_buckets.py), so the reduce-scatter dim never
+    collides with the layer-row axis the bucket scan chunks over. Only
+    WHERE states shard moves; the update math is unchanged.
     """
 
-    def __init__(self, mesh: Mesh, trainable, optimizer):
+    def __init__(self, mesh: Mesh, trainable, optimizer, row_dims=None):
         axis = getattr(optimizer, "state_partition_axis", None) \
             if optimizer is not None else None
         stage3 = any(getattr(p, "_zero3", False) for p in trainable)
@@ -120,7 +127,8 @@ class _ZeroPlan:
             if flat_spec & set(_DATA_AXES):
                 continue
             shape = tuple(p._value.shape)
-            for d in range(len(shape)):
+            start = (row_dims or {}).get(id(p), 0)
+            for d in range(start, len(shape)):
                 used = spec[d] if d < len(spec) else None
                 if used is None and shape[d] % self.n == 0 \
                         and shape[d] >= self.n:
@@ -283,7 +291,11 @@ class ParallelEngine:
         loss = step({"x": xb, "y": yb})      # one XLA execution
     """
 
-    def __init__(self, model, optimizer=None, mesh: Optional[Mesh] = None):
+    def __init__(self, model, optimizer=None, mesh: Optional[Mesh] = None,
+                 comm_overlap: Optional[bool] = None,
+                 comm_buffer_size_mb: Optional[float] = None):
+        from . import grad_buckets as _gb
+
         self.model = model
         self.optimizer = optimizer
         if mesh is None:
@@ -323,7 +335,25 @@ class ParallelEngine:
         # profile_exposed_comm() replays: suppress telemetry/counters
         # so offline attribution never pollutes the live metrics
         self._profiling = False
-        self._zero = _ZeroPlan(mesh, self.trainable, optimizer)
+        # T3-style bucketed grad sync (distributed/grad_buckets.py):
+        # knob from strategy.hybrid_configs["sharding_configs"], or the
+        # explicit constructor override (tests / engines built without
+        # fleet.init). Default off — the unbucketed tail sync.
+        cfg_on, cfg_mb = _gb.strategy_config()
+        self._overlap_on = bool(cfg_on if comm_overlap is None
+                                else comm_overlap)
+        self._overlap_mb = float(cfg_mb if comm_buffer_size_mb is None
+                                 else comm_buffer_size_mb)
+        # the pp stacked-params chunk seam: the natural bucketing grain
+        # for pipelined models (PipelineLayer.grad_bucket_seam)
+        self._seam_row_dims = None
+        seam_fn = getattr(model, "grad_bucket_seam", None)
+        if self._overlap_on and callable(seam_fn):
+            self._seam_row_dims = {id(p): int(k) for p, k in seam_fn()}
+        self._bucket_plan = None
+        self._zero = _ZeroPlan(mesh, self.trainable, optimizer,
+                               row_dims=self._seam_row_dims
+                               if self._overlap_on else None)
         # LazyGuard-built params materialize straight into their (zero3-
         # aware) storage sharding: O(shard) bytes per process, no full-
         # size init anywhere
@@ -435,6 +465,22 @@ class ParallelEngine:
             loc = v.shape[dim] // zero.n
             return lax.dynamic_slice_in_dim(v, idx * loc, loc, axis=dim)
 
+        # T3-style bucketed grad sync (grad_buckets.py): a static plan
+        # over (signature groups x size-targeted buckets, the stacked-
+        # params seam as a lax.scan) built HERE from shapes/specs only —
+        # nothing shape-derived reaches a compile key, and knob-off
+        # leaves the unbucketed path byte-for-byte untouched
+        bucket_plan = None
+        if self._overlap_on:
+            from . import grad_buckets as _gb
+
+            bucket_plan = _gb.build_plan(
+                trainable, mesh, zero, gmean_axes, data_axes,
+                _spec_axes, _grad_axes, param_spec,
+                seam_row_dims=self._seam_row_dims,
+                buffer_mb=self._overlap_mb)
+        self._bucket_plan = bucket_plan
+
         def _step_inner(pvals, svals, mvals, batch, lr, stepc, amp_in):
             # ZeRO-3 params arrive as shards: all-gather for the forward,
             # but keep the stored shard for the optimizer update
@@ -495,12 +541,33 @@ class ParallelEngine:
                         stop_gradient=True))
                 else:
                     loss.backward()
+                raw_grads = {
+                    id(p): (p.grad._value if p.grad is not None
+                            else jnp.zeros_like(p._value))
+                    for p in trainable}
+                # comm_overlap: issue the per-bucket collectives (the
+                # seam scan + the eager flat buckets) — bit-exact vs
+                # the per-parameter path below, with the grad-norm
+                # sum-of-squares folded into the bucket scan
+                if bucket_plan is not None:
+                    bsync, bgsq = bucket_plan.sync(raw_grads)
+                else:
+                    bsync, bgsq = {}, None
                 upd_in, grads = [], []
                 for i, p in zip(t_index, trainable):
-                    g = (p.grad._value if p.grad is not None
-                         else jnp.zeros_like(p._value))
+                    g = raw_grads[id(p)]
                     e = zero.entry(p)
-                    if e is not None:
+                    if id(p) in bsync:
+                        g = bsync[id(p)]
+                        if e is not None:
+                            upd_in.append(
+                                mvals[i] if mvals and i in mvals
+                                else (pshards[i] if e[1]
+                                      else _shard_of(p, pvals[i], e[0])))
+                        else:
+                            upd_in.append(mvals[i] if mvals and i in mvals
+                                          else pvals[i])
+                    elif e is not None:
                         # grad mean over plain dp, then reduce-scatter the
                         # sharding axis onto the owner shard (ZeRO)
                         dim = e[0]
@@ -568,9 +635,19 @@ class ParallelEngine:
                 # global grad-norm (telemetry): local sum-of-squares,
                 # psum'd over exactly the axes each grad is sharded on
                 # (spec axes, + the ZeRO axis for scattered shards) so
-                # replicated grads contribute once
+                # replicated grads contribute once. Bucketed params
+                # arrive pre-folded (one psum per signature group, the
+                # seam contribution accumulated in the scan carry);
+                # they were summed pre-unscale, so the scaler's inverse
+                # applies squared (inv=0 on overflow matches the zeroed
+                # per-param grads).
                 gsq = jnp.float32(0.0)
+                if bgsq is not None:
+                    gsq = bgsq * (inv * inv if use_scaler
+                                  else jnp.float32(1.0))
                 for p, g in zip(trainable, grads):
+                    if id(p) in bsync:
+                        continue
                     loc = jnp.sum(jnp.square(g.astype(jnp.float32)))
                     axes_set = set(_spec_axes(p))
                     e = zero.entry(p)
@@ -807,6 +884,12 @@ class ParallelEngine:
                 peak, config=getattr(self.model, "config", None)))
         self._prev_step_entry = t_entry
         self._pending_scalars = (lv, gnorm)
+        # gradient-sync bucketing: how many per-bucket collectives the
+        # compiled step issues (0 = the unbucketed tail sync, i.e.
+        # sharding_configs["comm_overlap"] off or nothing bucketable)
+        m["grad_buckets"].set(
+            float(self._bucket_plan.num_buckets)
+            if self._bucket_plan is not None else 0.0)
         # pipelined models: publish the analytic bubble fraction of the
         # attached schedule — (S-1)/(vpp*M+S-1) with the circular
         # interleave's vpp as a label, so dashboards can see the

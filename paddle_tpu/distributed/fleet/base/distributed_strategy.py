@@ -37,6 +37,16 @@ _HYBRID_DEFAULTS = {
     # exchange hides behind the per-chunk expert FFN; unfused fallback
     # outside SPMD or when E doesn't chunk over the ring.
     "moe_configs": {"ep_async_dispatch": False},
+    # comm_overlap (reference sharding_configs surface): T3-style
+    # bucketed backward grad sync — the stage-2 reduce-scatter / DP
+    # grad all-reduce issues per layer-grained bucket (the pp stacked-
+    # params seam for pipelined models, size-targeted param_spec groups
+    # for flat ones) instead of one exposed end-of-backward tail;
+    # comm_buffer_size_MB targets the per-bucket payload
+    # (distributed/grad_buckets.py). Bit-exact loss/param parity vs
+    # the unbucketed path.
+    "sharding_configs": {"comm_overlap": False,
+                         "comm_buffer_size_MB": 25.0},
 }
 
 
@@ -51,7 +61,8 @@ class DistributedStrategy:
     def __init__(self):
         self._hybrid_configs: Dict[str, Any] = dict(_HYBRID_DEFAULTS)
         # nested sub-configs must not alias the class-level defaults
-        for k in ("mp_configs", "pp_configs", "moe_configs"):
+        for k in ("mp_configs", "pp_configs", "moe_configs",
+                  "sharding_configs"):
             self._hybrid_configs[k] = _SubConfig(_HYBRID_DEFAULTS[k])
         self.pipeline_configs: Dict[str, Any] = {
             "micro_batch_size": 1, "accumulate_steps": 1}
@@ -78,7 +89,8 @@ class DistributedStrategy:
     @hybrid_configs.setter
     def hybrid_configs(self, configs: Dict[str, Any]):
         for k, v in configs.items():
-            if k in ("mp_configs", "pp_configs", "moe_configs") \
+            if k in ("mp_configs", "pp_configs", "moe_configs",
+                     "sharding_configs") \
                     and isinstance(v, dict):
                 merged = _SubConfig(self._hybrid_configs.get(k, {}))
                 merged.update(v)

@@ -759,6 +759,19 @@ class PipelineLayer(Layer):
                                        extra=(self._loss_fn,)))
         return _pp_collect(loss, pp_axes, S - 1)
 
+    def grad_bucket_seam(self):
+        """The stacked-params chunk seam for layer-grained gradient
+        bucketing (distributed/grad_buckets.py): ``[(param, k)]`` where
+        the first ``k`` dims of each stacked parameter enumerate layer
+        rows — 1 for the plain ``[L/pp, ...]`` stack, 2 for the circular
+        interleave's ``[vpp, L/(pp*vpp), ...]`` chunk layout. The engine
+        cuts these rows into size-targeted buckets and runs the grad
+        reduce-scatter / pmean as a scan over them, so the per-bucket
+        collective can overlap the neighboring buckets' work instead of
+        waiting for the whole stacked grad."""
+        k = 2 if self._vpp > 1 else 1
+        return [(p, k) for p in self._s_params if p.trainable]
+
     # reference API parity helpers
     def get_num_stages(self) -> int:
         return self._num_stages

@@ -1,0 +1,462 @@
+"""Bucketed gradient sync: T3-style eager per-bucket DP/sharding comm.
+
+The engine's unbucketed step computes the ENTIRE backward and only then
+issues one collective per parameter — so every step ends with a fully
+exposed grad-sync tail (the ``grad_sync_exposed_seconds`` the exposed-
+comm attribution in observability/commledger.py measures). T3
+(Transparent Tracking & Triggering, PAPERS.md) hides that tail by
+fusing each producing compute step with its collective. This module is
+the static *plan* for that restructuring:
+
+- **Flat models** (no stacked pipeline middle): trainable parameters are
+  grouped by *sync signature* (the exact collective set their grads
+  need: pmean axes, extra psum axes, duplication rescale, the ZeRO
+  reduce-scatter entry, dtype, and the grad-norm psum axes) and each
+  group is cut into size-targeted buckets in REVERSE registration order
+  — the tape forms grads last-layer-first, so issuing bucket i's
+  coalesced collective as its own dataflow node (depending only on that
+  bucket's grads) lets XLA's latency-hiding scheduler start it while
+  bucket i+1's backward compute is still running.
+- **Pipelined models**: the PR-5 stacked-params chunk layout IS the
+  bucketing seam (``PipelineLayer.grad_bucket_seam``). The stacked
+  grads leave the pipeline vjp as ``[rows, ...]`` arrays; the sync runs
+  as a ``lax.scan`` over row chunks with the per-bucket reduce-scatter
+  / pmean issued inside each tick, so one monolithic end-of-step
+  collective becomes ``nb`` pipelined chunk collectives (XLA's async
+  collectives overlap tick i's wire time with tick i+1's pack/unpack).
+  Ledger records inside the scan carry ``trips=nb``
+  (commledger.scan_trips) so byte/op accounting stays EXACT.
+
+Coalescing is bit-exact: a bucket's grads are packed into one flat
+buffer — *rank-major* for the reduce-scatter path, so
+``psum_scatter(flat)`` hands every rank exactly the concatenation of
+the per-parameter shards the unbucketed path would have produced —
+and psum/pmean/reduce-scatter are elementwise across ranks, so the
+synced values are identical to the per-parameter collectives
+regardless of grouping (tests pin loss/param parity and exact wire
+bytes: sum over buckets == the unbucketed closed form).
+
+Knob (reference surface: sharding comm_overlap / comm_buffer_size_MB,
+dygraph_sharding_optimizer buffer fusion):
+``strategy.hybrid_configs["sharding_configs"]["comm_overlap"]`` with
+``comm_buffer_size_MB`` sizing the per-bucket payload; default off.
+"""
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+import jax.numpy as jnp
+from jax import lax
+
+from ..observability import commledger as _cl
+
+__all__ = ["BucketPlan", "build_plan", "strategy_config",
+           "DEFAULT_BUFFER_MB"]
+
+# same default as the eager DataParallel reducer (parallel.py): the
+# reference's fuse-buffer size
+DEFAULT_BUFFER_MB = 25.0
+
+
+def strategy_config(strategy=None) -> Tuple[bool, float]:
+    """(comm_overlap, comm_buffer_size_MB) from the active fleet
+    strategy's ``hybrid_configs["sharding_configs"]`` (the reference
+    knob surface), or the defaults when no strategy is active."""
+    if strategy is None:
+        from . import fleet as _fleet
+
+        strategy = _fleet.get_strategy()
+    if strategy is None:
+        return False, DEFAULT_BUFFER_MB
+    sc = strategy.hybrid_configs.get("sharding_configs") or {}
+    return (bool(sc.get("comm_overlap", False)),
+            float(sc.get("comm_buffer_size_MB", DEFAULT_BUFFER_MB)))
+
+
+# ---------------------------------------------------------------------------
+# the static plan
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class BucketEntry:
+    """One parameter's slot in a bucket (static metadata only)."""
+
+    pid: int                     # id(param) — runtime key, not hashed
+    index: int                   # position in `trainable` (stable key)
+    shape: Tuple[int, ...]       # LOCAL grad shape inside the step
+    dtype: str
+    shard_dim: Optional[int]     # ZeRO scatter dim (local coords)
+    row_dims: int                # leading stacked-layer dims (seam)
+
+    def describe(self) -> Tuple:
+        return (self.index, self.shape, self.dtype, self.shard_dim,
+                self.row_dims)
+
+
+@dataclass
+class BucketGroup:
+    """Parameters sharing one sync signature; cut into buckets."""
+
+    kind: str                    # "rs" (ZeRO reduce-scatter) | "pmean"
+    seam: bool                   # stacked-layer scan group?
+    pm: Tuple[str, ...]          # grad-mean axes (dp_only for "rs")
+    extra: Tuple[str, ...]       # extra psum axes (pp ownership, sp)
+    dup: int                     # data-axis duplication rescale
+    n: int                       # ZeRO group size ("rs")
+    axis: Optional[str]          # ZeRO axis ("rs")
+    dtype: str
+    gnorm_axes: Tuple[str, ...]  # folded grad-norm psum axes
+    entries: List[BucketEntry] = field(default_factory=list)
+    buckets: List[List[BucketEntry]] = field(default_factory=list)
+    # seam scan geometry: rows_local cut into nb ticks of R rows
+    nb: int = 1
+    rows: int = 0
+    R: int = 0
+
+    @property
+    def num_buckets(self) -> int:
+        return self.nb if self.seam else len(self.buckets)
+
+    def describe(self) -> Tuple:
+        if self.seam:
+            cut: Tuple = ("scan", self.nb, self.R,
+                          tuple(e.describe() for e in self.entries))
+        else:
+            cut = ("flat", tuple(tuple(e.describe() for e in b)
+                                 for b in self.buckets))
+        return (self.kind, self.pm, self.extra, self.dup, self.n,
+                self.axis, self.dtype, self.gnorm_axes, cut)
+
+
+class BucketPlan:
+    """The full static bucketing of one engine's trainable set."""
+
+    def __init__(self, groups: List[BucketGroup], buffer_mb: float):
+        self.groups = groups
+        self.buffer_mb = buffer_mb
+        self._covered = {e.pid for g in groups for e in g.entries}
+
+    def covers(self, pid: int) -> bool:
+        return pid in self._covered
+
+    def __len__(self):
+        return len(self._covered)
+
+    @property
+    def num_buckets(self) -> int:
+        return sum(g.num_buckets for g in self.groups)
+
+    def describe(self) -> Tuple:
+        """Canonical, picklable description — identical across ranks
+        and processes for the same model/strategy (pinned by tests)."""
+        return (round(self.buffer_mb, 6),
+                tuple(g.describe() for g in self.groups))
+
+    def digest(self) -> str:
+        return hashlib.sha256(repr(self.describe()).encode()).hexdigest()
+
+    def summary(self) -> Dict[str, Any]:
+        """Per-bucket payload bytes (what each tick/bucket puts on the
+        wire before the ring factor) — the bench line's attribution."""
+        per_bucket: List[int] = []
+        for g in self.groups:
+            if g.seam:
+                tick = sum(int(np.prod(e.shape)) for e in g.entries) \
+                    // max(g.nb, 1) * _itemsize(g.dtype)
+                per_bucket.extend([tick] * g.nb)
+            else:
+                for b in g.buckets:
+                    per_bucket.append(sum(
+                        int(np.prod(e.shape)) * _itemsize(e.dtype)
+                        for e in b))
+        return {"buckets": self.num_buckets,
+                "bucket_payload_bytes": per_bucket,
+                "groups": len(self.groups)}
+
+    # -- trace-time execution (inside the compiled step) ----------------
+    def sync(self, grads: Dict[int, Any]):
+        """Issue every group's bucketed collectives on the raw grads.
+
+        Returns ``(synced, gsq)``: the per-parameter synced grads (the
+        ZeRO shard for "rs" entries — exactly what the unbucketed path
+        produces) and the folded global grad-norm sum-of-squares
+        (f32 scalar, group psums already applied).
+        """
+        synced: Dict[int, Any] = {}
+        gsq = jnp.float32(0.0)
+        for g in self.groups:
+            if g.seam:
+                out, sq = _sync_seam_group(g, grads)
+                synced.update(out)
+            else:
+                sq = jnp.float32(0.0)
+                for bucket in g.buckets:
+                    if g.kind == "rs":
+                        outs, bsq = _sync_rs_bucket(
+                            [(grads[e.pid], e.shard_dim) for e in bucket],
+                            g.n, g.axis, g.pm, g.extra)
+                    else:
+                        outs, bsq = _sync_pmean_bucket(
+                            [grads[e.pid] for e in bucket],
+                            [e.shape for e in bucket],
+                            g.pm, g.dup, g.extra)
+                    for e, o in zip(bucket, outs):
+                        synced[e.pid] = o
+                    sq = sq + bsq
+            if g.gnorm_axes:
+                from . import collective as C
+
+                sq = C.t_psum(sq, g.gnorm_axes)
+            gsq = gsq + sq
+        return synced, gsq
+
+
+# ---------------------------------------------------------------------------
+# plan construction (host-side, static shapes only)
+# ---------------------------------------------------------------------------
+
+
+def _itemsize(dtype) -> int:
+    try:
+        return int(np.dtype(dtype).itemsize)
+    except TypeError:
+        return 4
+
+
+def _local_shape(shape: Sequence[int], spec, mesh) -> Tuple[int, ...]:
+    """The shard shape a parameter's grad has inside shard_map."""
+    out = list(int(s) for s in shape)
+    for d, ax in enumerate(tuple(spec)[:len(out)]):
+        if ax is None:
+            continue
+        for a in (ax if isinstance(ax, (tuple, list)) else (ax,)):
+            if a in mesh.axis_names:
+                out[d] //= int(mesh.shape[a])
+    return tuple(out)
+
+
+def _divisor_rows_per_tick(rows: int, row_bytes: int,
+                           target: float) -> int:
+    """Rows per scan tick: the divisor of ``rows`` whose chunk payload
+    lands nearest the byte target (buckets must tile the row axis
+    EXACTLY so ledger bytes stay closed-form — no padding)."""
+    best, best_err = rows, float("inf")
+    for R in range(1, rows + 1):
+        if rows % R:
+            continue
+        err = abs(R * row_bytes - target)
+        if err < best_err or (err == best_err and R < best):
+            best, best_err = R, err
+    return best
+
+
+def build_plan(trainable: Sequence, mesh, zero, gmean_axes, data_axes,
+               spec_axes_fn: Callable, grad_axes_fn: Callable,
+               param_spec_fn: Callable,
+               seam_row_dims: Optional[Dict[int, int]] = None,
+               buffer_mb: float = DEFAULT_BUFFER_MB
+               ) -> Optional[BucketPlan]:
+    """Build the static bucket plan for an engine's trainable set.
+
+    Deterministic in (parameter order, shapes/dtypes/specs, mesh axis
+    sizes, the ZeRO plan, ``buffer_mb``) — identical across ranks and
+    processes by construction; nothing here reads device state.
+    Parameters whose grads need no collective at all (and the legacy
+    local-slice ZeRO fallback) are left to the engine's unbucketed
+    path. Returns None when nothing buckets.
+    """
+    seam_row_dims = seam_row_dims or {}
+    target = max(float(buffer_mb), 1e-6) * (1 << 20)
+    sigs: Dict[Tuple, BucketGroup] = {}
+    order: List[Tuple] = []
+    gmean_axes = tuple(gmean_axes)
+
+    def _mesh_axes(axes) -> Tuple[str, ...]:
+        return tuple(a for a in sorted(axes)
+                     if a in mesh.axis_names and int(mesh.shape[a]) > 1)
+
+    # reverse registration order ~ the tape's grad formation order
+    # (backward emits last-registered layers' grads first), so bucket 0
+    # is ready earliest — the T3 eager-issue ordering
+    for index in range(len(trainable) - 1, -1, -1):
+        p = trainable[index]
+        e = zero.entry(p)
+        spec_axes = frozenset(spec_axes_fn(p))
+        extra = tuple(grad_axes_fn(p))
+        row_dims = int(seam_row_dims.get(id(p), 0))
+        lshape = _local_shape(p._value.shape, param_spec_fn(p), mesh)
+        dtype = str(p._value.dtype)
+        if e is not None and zero.axis in data_axes:
+            kind = "rs"
+            pm = tuple(a for a in gmean_axes if a != zero.axis)
+            dup = 1
+            shard_dim: Optional[int] = int(e[0])
+            gnorm = _mesh_axes(spec_axes | {zero.axis})
+        elif e is not None:
+            continue     # legacy local-slice fallback stays unbucketed
+        else:
+            kind = "pmean"
+            pm = tuple(a for a in gmean_axes if a not in spec_axes)
+            dup = 1
+            for a in gmean_axes:
+                if a in spec_axes:
+                    dup *= int(mesh.shape[a])
+            shard_dim = None
+            if not pm and not extra and dup == 1:
+                continue  # nothing to sync — leave alone
+            gnorm = _mesh_axes(spec_axes)
+        seam = row_dims > 0
+        key = (kind, seam, pm, extra, dup, dtype, gnorm,
+               row_dims if seam else 0,
+               lshape[:row_dims] if seam else ())
+        if key not in sigs:
+            sigs[key] = BucketGroup(
+                kind=kind, seam=seam, pm=pm, extra=extra, dup=dup,
+                n=int(getattr(zero, "n", 1)), axis=zero.axis,
+                dtype=dtype, gnorm_axes=gnorm)
+            order.append(key)
+        sigs[key].entries.append(BucketEntry(
+            pid=id(p), index=index, shape=lshape, dtype=dtype,
+            shard_dim=shard_dim, row_dims=row_dims))
+
+    groups: List[BucketGroup] = []
+    for key in order:
+        g = sigs[key]
+        if g.seam:
+            rows = 1
+            for d in g.entries[0].shape[:g.entries[0].row_dims]:
+                rows *= int(d)
+            if rows <= 0:
+                continue
+            row_bytes = sum(
+                int(np.prod(e.shape)) * _itemsize(e.dtype)
+                for e in g.entries) // rows
+            g.rows = rows
+            g.R = _divisor_rows_per_tick(rows, max(row_bytes, 1), target)
+            g.nb = rows // g.R
+        else:
+            bucket: List[BucketEntry] = []
+            size = 0
+            for e in g.entries:
+                bucket.append(e)
+                size += int(np.prod(e.shape)) * _itemsize(e.dtype)
+                if size >= target:
+                    g.buckets.append(bucket)
+                    bucket, size = [], 0
+            if bucket:
+                g.buckets.append(bucket)
+        groups.append(g)
+    if not groups:
+        return None
+    return BucketPlan(groups, float(buffer_mb))
+
+
+# ---------------------------------------------------------------------------
+# trace-time bucket sync kernels
+# ---------------------------------------------------------------------------
+
+
+def _shard_shape(shape: Tuple[int, ...], d: int,
+                 n: int) -> Tuple[int, ...]:
+    return shape[:d] + (shape[d] // n,) + shape[d + 1:]
+
+
+def _rank_major(g, d: int, n: int):
+    """[n, -1] view of ``g`` with rank r's scatter-dim chunk as row r,
+    so a flat psum_scatter over the concatenation hands every rank
+    exactly its per-parameter shards (bit-exact vs per-param rs)."""
+    s = g.shape
+    loc = s[d] // n
+    gr = g.reshape(s[:d] + (n, loc) + s[d + 1:])
+    gr = jnp.moveaxis(gr, d, 0)
+    return gr.reshape(n, -1)
+
+
+def _sync_rs_bucket(vals_dims, n: int, axis: str, pm, extra):
+    """One bucket of the ZeRO path: coalesced dp-mean + extra psum +
+    rank-major flat reduce-scatter. Returns (per-param shards, local
+    sum-of-squares of the shard in f32)."""
+    from . import collective as C
+
+    flat = jnp.concatenate(
+        [_rank_major(g, d, n) for g, d in vals_dims], axis=1).reshape(-1)
+    if pm:
+        flat = C.t_pmean(flat, pm)
+    if extra:
+        flat = C.t_psum(flat, extra)
+    shard = C.t_psum_scatter(flat, axis, scatter_dimension=0,
+                             tiled=True) / n
+    outs, off = [], 0
+    for g, d in vals_dims:
+        ss = _shard_shape(tuple(g.shape), d, n)
+        m = int(np.prod(ss))
+        outs.append(shard[off:off + m].reshape(ss))
+        off += m
+    return outs, jnp.sum(jnp.square(shard.astype(jnp.float32)))
+
+
+def _sync_pmean_bucket(vals, shapes, pm, dup: int, extra):
+    """One bucket of the replicated-grad path: coalesced pmean (+
+    duplication rescale + extra psum). Returns (per-param grads, local
+    sum-of-squares in f32)."""
+    from . import collective as C
+
+    flat = jnp.concatenate([g.reshape(-1) for g in vals])
+    if pm:
+        flat = C.t_pmean(flat, pm)
+    if dup > 1:
+        flat = flat / dup
+    if extra:
+        flat = C.t_psum(flat, extra)
+    outs, off = [], 0
+    for s in shapes:
+        m = int(np.prod(s))
+        outs.append(flat[off:off + m].reshape(tuple(s)))
+        off += m
+    return outs, jnp.sum(jnp.square(flat.astype(jnp.float32)))
+
+
+def _sync_seam_group(g: BucketGroup, grads: Dict[int, Any]):
+    """The layer-grained bucket scan over the stacked-params seam: nb
+    ticks of R rows, the bucket collective issued INSIDE the tick, the
+    grad-norm sum-of-squares folded into the carry. Ledger records are
+    noted once with trips=nb (commledger.scan_trips) so accounting
+    stays exact."""
+    nb, R = g.nb, g.R
+    xs = []
+    tails: List[Tuple[int, ...]] = []
+    for e in g.entries:
+        arr = grads[e.pid]
+        tail = tuple(arr.shape[e.row_dims:])
+        tails.append(tail)
+        xs.append(arr.reshape((nb, R) + tail))
+    if g.kind == "rs":
+        # scatter dim in tick coords: row dims collapse to one leading
+        # R axis (the ZeRO plan keeps seam entries off the row dims)
+        dims = [e.shard_dim - e.row_dims + 1 for e in g.entries]
+
+        def tick(carry, xs_t):
+            outs, sq = _sync_rs_bucket(list(zip(xs_t, dims)), g.n,
+                                       g.axis, g.pm, g.extra)
+            return carry + sq, tuple(outs)
+    else:
+        tick_shapes = [(R,) + t for t in tails]
+
+        def tick(carry, xs_t):
+            outs, sq = _sync_pmean_bucket(list(xs_t), tick_shapes,
+                                          g.pm, g.dup, g.extra)
+            return carry + sq, tuple(outs)
+
+    with _cl.scan_trips(nb):
+        gsq, ys = lax.scan(tick, jnp.float32(0.0), tuple(xs))
+    synced: Dict[int, Any] = {}
+    for e, y in zip(g.entries, ys):
+        rows_shape = e.shape[:e.row_dims]
+        out = y.reshape((nb * R,) + tuple(y.shape[2:]))
+        synced[e.pid] = out.reshape(tuple(rows_shape)
+                                    + tuple(y.shape[2:]))
+    return synced, gsq

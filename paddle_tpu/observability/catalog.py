@@ -102,6 +102,12 @@ def train_metrics(reg: MetricsRegistry = None) -> Dict[str, object]:
         "grad_norm": r.gauge(
             "paddle_tpu_train_grad_norm",
             "last fetched global gradient norm (pre-clip, all shards)"),
+        "grad_buckets": r.gauge(
+            "paddle_tpu_train_grad_buckets",
+            "gradient-sync buckets the compiled step issues per-bucket "
+            "DP/sharding collectives over (T3-style overlap, "
+            "sharding_configs['comm_overlap']; 0 = the unbucketed "
+            "end-of-backward tail sync — distributed/grad_buckets.py)"),
         "mfu": r.gauge(
             "paddle_tpu_train_mfu",
             "model-FLOPs utilization estimate (6N convention; 0 on "

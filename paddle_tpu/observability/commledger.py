@@ -34,7 +34,14 @@ Caveats (documented, asserted nowhere): a collective inside a
 ``lax.scan`` body is traced ONCE and therefore counted once, not
 ``length`` times — the pipeline ring's per-tick ppermute is a lower
 bound. Unrolled Python rings (collective_matmul) and the flat
-grad-sync collectives are exact.
+grad-sync collectives are exact. Scan bodies whose trip count is
+statically known can opt into exact accounting by wrapping the
+``lax.scan`` call in ``scan_trips(length)``: records noted inside
+carry ``trips=length`` and every byte/op total (and the exposed-comm
+replay) scales by it — the bucketed grad-sync scan
+(distributed/grad_buckets.py) does this, so
+``comm_exposed_fraction{axis=sharding}`` is never overstated by a
+once-counted ledger.
 
 The second half of this module is the **exposed-comm attribution**
 support: ``ablate(labels)`` switches the shim into a mode where the
@@ -55,7 +62,8 @@ import numpy as np
 
 __all__ = [
     "CommRecord", "CommLedger", "capture", "note", "wire_bytes",
-    "active", "ablate", "ablating", "ablation_token", "OPS",
+    "active", "ablate", "ablating", "ablation_token", "scan_trips",
+    "OPS",
 ]
 
 # canonical op kinds the ledger aggregates under (the {op} label of
@@ -101,6 +109,10 @@ class CommRecord:
     wire_bytes: float
     args: Tuple = ()             # op-specific statics (gather axis,
     #                              scatter dim, (split, concat), perm)
+    trips: int = 1               # executions per program run: 1 for a
+    #                              flat/unrolled call site; the scan
+    #                              length for sites noted under
+    #                              scan_trips() (bucketed grad sync)
 
 
 class CommLedger:
@@ -126,20 +138,20 @@ class CommLedger:
             t = out.setdefault((r.axis, r.op),
                                {"bytes": 0.0, "payload_bytes": 0,
                                 "ops": 0})
-            t["bytes"] += r.wire_bytes
-            t["payload_bytes"] += r.payload_bytes
-            t["ops"] += 1
+            t["bytes"] += r.wire_bytes * r.trips
+            t["payload_bytes"] += r.payload_bytes * r.trips
+            t["ops"] += r.trips
         return out
 
     def bytes_for(self, axis: Optional[str] = None,
                   op: Optional[str] = None) -> float:
-        return sum(r.wire_bytes for r in self.records
+        return sum(r.wire_bytes * r.trips for r in self.records
                    if (axis is None or r.axis == axis)
                    and (op is None or r.op == op))
 
     def ops_for(self, axis: Optional[str] = None,
                 op: Optional[str] = None) -> int:
-        return sum(1 for r in self.records
+        return sum(r.trips for r in self.records
                    if (axis is None or r.axis == axis)
                    and (op is None or r.op == op))
 
@@ -163,6 +175,7 @@ class _State(threading.local):
     def __init__(self):
         self.captures: List[CommLedger] = []
         self.ablated: frozenset = frozenset()
+        self.trips: int = 1
 
 
 _state = _State()
@@ -206,9 +219,32 @@ def note(op: str, axes: Iterable[str], shape, dtype, p: int,
                      dtype=str(dtype), p=int(p),
                      payload_bytes=payload,
                      wire_bytes=wire_bytes(op, payload, int(p)),
-                     args=tuple(args))
+                     args=tuple(args), trips=int(_state.trips))
     for led in _state.captures:
         led.add(rec)
+
+
+class _ScanTrips:
+    def __init__(self, length: int):
+        self.length = max(int(length), 1)
+
+    def __enter__(self):
+        self.prev = _state.trips
+        _state.trips = self.prev * self.length
+        return self
+
+    def __exit__(self, *exc):
+        _state.trips = self.prev
+        return False
+
+
+def scan_trips(length: int) -> _ScanTrips:
+    """While active, every noted collective carries ``trips=length``
+    (multiplicative under nesting): wrap a ``lax.scan`` call whose body
+    issues collectives and whose trip count is static, and the ledger's
+    byte/op totals and the exposed-comm replay account the scan exactly
+    instead of the once-traced lower bound."""
+    return _ScanTrips(length)
 
 
 # -- ablation (the exposed-comm replay mode) ------------------------------
@@ -335,28 +371,35 @@ def replay_callable(records: List[CommRecord], mesh, shard_map_fn,
     def body():
         acc = jnp.float32(0.0)
         for r in records:
-            x = jnp.zeros(r.shape, r.dtype)
-            if r.op in ("psum", "pmax", "pmin"):
-                fn = {"psum": lax.psum, "pmax": lax.pmax,
-                      "pmin": lax.pmin}[r.op]
-                out = fn(x, r.axes)
-            elif r.op == "all_gather":
-                out = lax.all_gather(x, r.axes, axis=r.args[0],
-                                     tiled=True)
-            elif r.op == "reduce_scatter":
-                out = lax.psum_scatter(x, r.axes,
-                                       scatter_dimension=r.args[0],
-                                       tiled=True)
-            elif r.op == "all_to_all":
-                out = lax.all_to_all(x, r.axes, split_axis=r.args[0],
-                                     concat_axis=r.args[1], tiled=True)
-            elif r.op == "ppermute":
-                out = lax.ppermute(
-                    x, r.axes[0] if len(r.axes) == 1 else r.axes,
-                    perm=[tuple(pr) for pr in r.args[0]])
-            else:  # pragma: no cover - OPS is closed
-                continue
-            acc = acc + out.ravel()[0].astype(jnp.float32)
+            # scan-traced records (trips > 1, the bucketed grad-sync
+            # scan) replay trip-count times; chaining acc into each
+            # payload stops XLA CSE'ing the identical collectives and
+            # keeps them back-to-back, matching the real scan
+            for _ in range(max(int(getattr(r, "trips", 1)), 1)):
+                x = jnp.zeros(r.shape, r.dtype) + \
+                    (acc * 0).astype(r.dtype)
+                if r.op in ("psum", "pmax", "pmin"):
+                    fn = {"psum": lax.psum, "pmax": lax.pmax,
+                          "pmin": lax.pmin}[r.op]
+                    out = fn(x, r.axes)
+                elif r.op == "all_gather":
+                    out = lax.all_gather(x, r.axes, axis=r.args[0],
+                                         tiled=True)
+                elif r.op == "reduce_scatter":
+                    out = lax.psum_scatter(x, r.axes,
+                                           scatter_dimension=r.args[0],
+                                           tiled=True)
+                elif r.op == "all_to_all":
+                    out = lax.all_to_all(x, r.axes, split_axis=r.args[0],
+                                         concat_axis=r.args[1],
+                                         tiled=True)
+                elif r.op == "ppermute":
+                    out = lax.ppermute(
+                        x, r.axes[0] if len(r.axes) == 1 else r.axes,
+                        perm=[tuple(pr) for pr in r.args[0]])
+                else:  # pragma: no cover - OPS is closed
+                    continue
+                acc = acc + out.ravel()[0].astype(jnp.float32)
         # replicate the scalar so out_specs=P() is valid on any mesh
         if sync_axes:
             acc = lax.pmax(acc, sync_axes)

@@ -14,7 +14,8 @@ from __future__ import annotations
 from typing import Optional, Tuple
 
 __all__ = ["params_from_config", "train_flops_per_token",
-           "peak_flops_per_chip", "mfu"]
+           "peak_flops_per_chip", "mfu", "ici_bytes_per_sec",
+           "comm_seconds_lower_bound"]
 
 # Peak dense bf16 FLOPs and HBM bandwidth per chip by TPU generation
 # (public specs — the same table bench.py uses for its roofline lines).
@@ -25,6 +26,41 @@ PEAK_BY_CHIP = {
     "v5p": (459e12, 2.765e12),
     "v6e": (918e12, 1.64e12), "v6 lite": (918e12, 1.64e12),
 }
+
+# Aggregate ICI bandwidth per chip (bytes/s, public specs: v4 2400
+# Gbps, v5e 1600, v5p 4800, v6e 3584 — all links, both directions).
+# The comm floor below uses it to turn ledger wire bytes into a
+# lower-bound transfer time, contextualizing exposed-comm seconds.
+ICI_BY_CHIP = {
+    "v4": 300e9,
+    "v5e": 200e9, "v5 lite": 200e9, "v5litepod": 200e9,
+    "v5p": 600e9,
+    "v6e": 448e9, "v6 lite": 448e9,
+}
+
+
+def ici_bytes_per_sec(device) -> float:
+    """Aggregate ICI bytes/s of a jax device's chip generation; 0.0 on
+    CPU (no ICI — comm floors are then reported as 0, well-defined)."""
+    kind = str(getattr(device, "device_kind", "")).lower()
+    for k, v in ICI_BY_CHIP.items():
+        if k in kind:
+            return v
+    if "tpu" in str(getattr(device, "platform", "")).lower():
+        return ICI_BY_CHIP["v5p"]    # unknown generation: assume v5p
+    return 0.0
+
+
+def comm_seconds_lower_bound(wire_bytes: float, device) -> float:
+    """Analytic floor for moving ``wire_bytes`` (per participant, the
+    comm ledger's closed-form accounting) over ICI: bytes / aggregate
+    per-chip bandwidth. The per-bucket grad-sync attribution divides a
+    step's ledger bytes by this to sanity-check exposed-comm numbers:
+    exposed seconds below the floor mean the collective overlapped."""
+    bw = ici_bytes_per_sec(device)
+    if bw <= 0:
+        return 0.0
+    return float(wire_bytes) / bw
 
 
 def params_from_config(config) -> Optional[int]:

@@ -300,6 +300,147 @@ class TestEpA2aLedger:
 
 
 # ---------------------------------------------------------------------------
+# bucketed grad sync (comm_overlap): exact wire bytes + scan trip counts
+# ---------------------------------------------------------------------------
+def _zero2_engine(overlap):
+    """dp2 x sharding4 ZeRO stage-2 MLP engine (grad_buckets target)."""
+    import paddle_tpu.distributed as dist
+    from paddle_tpu.distributed import fleet
+
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {
+        "dp_degree": 2, "sharding_degree": 4,
+        "sharding_configs": {"comm_overlap": overlap,
+                             "comm_buffer_size_MB": 1e-6}}
+    fleet._fleet_state.update(initialized=False, hcg=None, strategy=None)
+    hcg = fleet.init(is_collective=True, strategy=strategy)
+    paddle.seed(3)
+
+    class MLP(paddle.nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc1 = paddle.nn.Linear(16, 32)
+            self.fc2 = paddle.nn.Linear(32, 16)
+
+        def forward(self, x):
+            return self.fc2(paddle.nn.functional.relu(self.fc1(x)))
+
+    model = MLP()
+    opt = paddle.optimizer.Adam(learning_rate=0.1,
+                                parameters=model.parameters())
+    model, opt, _ = dist.group_sharded_parallel(model, opt, "os_g")
+    eng = ParallelEngine(model, opt, hcg.mesh)
+    step = eng.train_step(
+        lambda m, b: paddle.mean((m(b["x"]) - b["y"]) ** 2))
+    x = np.zeros((8, 16), "float32")
+    float(step({"x": paddle.to_tensor(x), "y": paddle.to_tensor(x)}))
+    return eng
+
+
+class TestBucketedGradSyncLedger:
+    """The satellite pins: per-bucket collectives move EXACTLY the
+    unbucketed closed-form bytes (coalescing re-chunks, it never moves
+    more), and the ledger op count equals the bucket count."""
+
+    def test_bucketed_bytes_match_unbucketed_closed_form(self):
+        eng_on = _zero2_engine(True)
+        eng_off = _zero2_engine(False)
+        led_on, led_off = eng_on.comm_ledger(), eng_off.comm_ledger()
+        plan = eng_on._bucket_plan
+        p_sh, p_dp = 4, 2
+        payload = sum(
+            int(np.prod(q._value.shape)) * q._value.dtype.itemsize
+            for q in eng_on.trainable)
+        # stage-2 reduce-scatter over 'sharding': sum over buckets ==
+        # (p-1)/p x total grad payload == the per-param closed form
+        assert led_on.bytes_for(axis="sharding", op="reduce_scatter") \
+            == (p_sh - 1) / p_sh * payload \
+            == led_off.bytes_for(axis="sharding", op="reduce_scatter")
+        # grad pmean over plain dp: 2(p-1)/p x total payload, same both
+        # ways (the ledger books pmean under the "psum" kind); knob-off
+        # adds nothing else on dp, knob-on adds nothing else on dp
+        dp_grad = 2 * (p_dp - 1) / p_dp * payload
+        assert led_on.bytes_for(axis="dp", op="psum") == dp_grad
+        assert led_off.bytes_for(axis="dp", op="psum") == dp_grad
+        # op count == bucket count (the tiny buffer forces one param
+        # per bucket here), vs one op per parameter unbucketed
+        nb = plan.num_buckets
+        assert nb == len(eng_on.trainable)
+        assert led_on.ops_for(axis="sharding", op="reduce_scatter") == nb
+        assert led_on.ops_for(axis="dp", op="psum") == nb
+        assert led_off.ops_for(axis="sharding", op="reduce_scatter") \
+            == len(eng_off.trainable)
+        # the folded grad-norm: ONE psum per signature group over
+        # spec+zero axes, instead of one per parameter
+        assert led_on.ops_for(axis="sharding", op="psum") \
+            == len(plan.groups)
+
+    def test_scan_trips_scales_ledger_and_survives_ablation(self):
+        """A collective noted under scan_trips(nb) counts nb times —
+        the bucket scan's exact accounting (plain scan bodies stay the
+        documented once-counted lower bound)."""
+        mesh = _mesh()
+        nb = 4
+
+        def prog(x):
+            def tick(c, xt):
+                return c + C.t_psum_scatter(
+                    xt, ("mp",), scatter_dimension=0, tiled=True).sum(), \
+                    None
+
+            with cl.scan_trips(nb):
+                out, _ = jax.lax.scan(tick, jnp.float32(0.0),
+                                      x.reshape(nb, 16, 4))
+            # an unmarked scan body still counts once (lower bound)
+            def tick2(c, xt):
+                return c + C.t_psum(xt, ("mp",)).sum(), None
+
+            out2, _ = jax.lax.scan(tick2, jnp.float32(0.0),
+                                   x.reshape(nb, 16, 4))
+            return out + out2
+
+        step = jax.jit(_shard_map(prog, mesh, (P(None, "mp"),), P()))
+        x = jnp.ones((64, 32), jnp.float32)   # local shard [64, 4]
+        with cl.capture() as led:
+            step(x)
+        tick_payload = 16 * 4 * F32
+        assert led.ops_for(op="reduce_scatter") == nb
+        assert led.bytes_for(op="reduce_scatter") == \
+            nb * 7 / 8 * tick_payload
+        assert led.ops_for(op="psum") == 1          # unmarked scan
+        assert [r.trips for r in led.records] == [nb, 1]
+        # the trip-scaled records replay trip-count times and the
+        # ablated compile keeps shapes (the exposed-comm machinery
+        # works unchanged over the bucket scan)
+        rfn = cl.replay_callable(
+            [r for r in led.records if r.op == "reduce_scatter"],
+            mesh, _shard_map, jax.jit)
+        assert float(rfn()) == 0.0
+        with cl.ablate({"mp"}):
+            abl = jax.jit(_shard_map(prog, mesh, (P(None, "mp"),),
+                                     P()))(x)
+        assert abl.shape == ()
+
+    def test_trips_default_and_nesting(self):
+        led = cl.CommLedger()
+        cl._state.captures.append(led)
+        try:
+            cl.note("psum", ("dp",), (4,), np.dtype("float32"), 2)
+            with cl.scan_trips(3):
+                cl.note("psum", ("dp",), (4,), np.dtype("float32"), 2)
+                with cl.scan_trips(2):
+                    cl.note("psum", ("dp",), (4,), np.dtype("float32"),
+                            2)
+        finally:
+            cl._state.captures.remove(led)
+        assert [r.trips for r in led.records] == [1, 3, 6]
+        assert led.ops_for(op="psum") == 10
+        one = 2 * (2 - 1) / 2 * 16
+        assert led.bytes_for(op="psum") == 10 * one
+        assert led.totals()[("dp", "psum")]["ops"] == 10
+
+
+# ---------------------------------------------------------------------------
 # ablation stand-ins
 # ---------------------------------------------------------------------------
 class TestAblation:
