@@ -372,7 +372,11 @@ def bench_serving_mixed(on_tpu, dev):
         def prompts(lens):
             return [r.randint(1, cfg.vocab_size, (L,)) for L in lens]
 
-        eng = ServingEngine(pred, max_batch=B, decode_chunk=chunk)
+        # mem_ledger=True: per-executable HBM attribution (prefill per
+        # bucket + the shared decode) rides the line below; the
+        # recompiles_after_warmup field still gates at 0 with it on
+        eng = ServingEngine(pred, max_batch=B, decode_chunk=chunk,
+                            mem_ledger=True)
         for p in prompts(warm_mix):                      # warmup mix
             eng.submit(p, max_new_tokens=n_new)
         eng.run()
@@ -415,6 +419,12 @@ def bench_serving_mixed(on_tpu, dev):
             for row in snap["paddle_tpu_serving_request_stage_seconds"]
             ["series"]}
 
+        # HBM memory ledger + roofline verdict for the serving engine:
+        # per-executable byte classes, resident state (params + KV
+        # page pool), and the decode round's compute/HBM/ICI bound
+        mem = eng.memory_summary()
+        roof = eng.roofline_report()
+
         _emit({
             "metric": "serving_mixed_traffic_tokens_per_sec" if on_tpu
             else "serving_smoke_mixed_traffic_tokens_per_sec",
@@ -434,9 +444,22 @@ def bench_serving_mixed(on_tpu, dev):
             "requests": len(stream), "tokens": n_tok,
             "request_spans": spans,
             "request_traces": len(eng.traces),
+            "memory": mem,
+            "roofline": roof.to_dict(),
             "telemetry": _telemetry_section(),
             "device": str(getattr(dev, "device_kind", dev.platform)),
         })
+        # memory-ledger exact gate: the measured KV pool bytes (shard
+        # accounting over the live pool arrays) must equal the closed
+        # form page_bytes x pool_pages (bench_compare _EXACT)
+        st = mem["state"]
+        ok = st["kv_pool_bytes"] == st["page_bytes"] * st["pool_pages"]
+        _emit({"metric": "serving_mem_pool_parity",
+               "value": 1.0 if ok else 0.0, "unit": "pass",
+               "vs_baseline": 1.0 if ok else 0.0,
+               "kv_pool_bytes": st["kv_pool_bytes"],
+               "page_bytes": st["page_bytes"],
+               "pool_pages": st["pool_pages"]})
     finally:
         paddle.set_default_dtype(old_dtype)
 
@@ -448,6 +471,8 @@ def bench_serving_mixed(on_tpu, dev):
 # (correctness: the same strategy dryrun_multichip validates).
 # ---------------------------------------------------------------------------
 def bench_gpt13b_hybrid(on_tpu, dev):
+    import os
+
     import jax
 
     import paddle_tpu as paddle
@@ -456,6 +481,14 @@ def bench_gpt13b_hybrid(on_tpu, dev):
     from paddle_tpu.models.gpt import GPTConfig
 
     from paddle_tpu.observability import flops as _flops
+    from paddle_tpu.observability import memledger as _ml
+
+    # HBM memory ledger on for every engine this bench builds (the
+    # engines live behind fleet.distributed_model, so the env knob is
+    # the plumbing): one extra AOT analysis per program, zero
+    # recompiles of the live step (the recompiles_after_warmup field
+    # below still gates at 0 with the ledger on)
+    os.environ["PADDLE_TPU_MEM_LEDGER"] = "1"
 
     n = jax.device_count()
     if on_tpu and n < 8:
@@ -553,8 +586,17 @@ def bench_gpt13b_hybrid(on_tpu, dev):
             f"{a}/{o}": round(t["bytes"], 1)
             for (a, o), t in sorted(led.totals().items())} if led else {}
         plan = eng._bucket_plan
+        # memory ledger + state accounting + roofline verdict: the
+        # per-executable byte classes (XLA memory_analysis), the
+        # measured model-state breakdown with the auto_tuner drift,
+        # and the compute/HBM/ICI bound verdict joining flops + comm +
+        # memory (observability/memledger.py)
+        mem_led = eng.memory_ledger()
+        acct = eng.state_accounting()
+        roof = eng.roofline_report(exposed=prof)
         results[tag] = {"losses": losses, "prof": prof, "led": led,
-                        "plan": plan}
+                        "plan": plan, "eng": eng, "acct": acct,
+                        "roof": roof}
         peak, _ = _chip(dev)
         n_params = cfg.num_params()
         mfu = (6.0 * n_params * tok_s / (peak * n)) if peak else 0.0
@@ -583,6 +625,11 @@ def bench_gpt13b_hybrid(on_tpu, dev):
             # overlap / MoE a2a report through
             "comm_bytes_per_step": comm_bytes_per_step,
             "exposed_comm": exposed_comm,
+            "memory": {
+                "executable": mem_led.to_dict() if mem_led else {},
+                "state": acct.to_dict(),
+            },
+            "roofline": roof.to_dict(),
             "telemetry": _telemetry_section(),
             "device": str(getattr(dev, "device_kind", dev.platform)),
         }
@@ -615,6 +662,28 @@ def bench_gpt13b_hybrid(on_tpu, dev):
            "exposed_lower_than_knob_off": bool(exp_on < exp_off),
            "note": "CPU smoke proves parity + compile stability; the "
                    "realized overlap win is an on-TPU ROADMAP item"})
+    # memory-ledger exact gate: the measured state accounting (shard_
+    # shape path) must equal the closed form (global shape / sharding
+    # degree path) byte-for-byte — incl. ZeRO stage-2 scattered state
+    # and the pp x vpp stacked-chunk ownership (bench_compare _EXACT)
+    acct = base_r["acct"]
+    closed = _ml.closed_form_state_bytes(base_r["eng"])
+    ok = all(acct.components.get(k) == v for k, v in closed.items())
+    _emit({"metric": "gpt13b_hybrid_mem_state_parity",
+           "value": 1.0 if ok else 0.0, "unit": "pass",
+           "vs_baseline": 1.0 if ok else 0.0,
+           "measured": {k: acct.components.get(k) for k in closed},
+           "closed_form": closed,
+           "analytic_drift": round(acct.drift, 4)})
+    # HBM headroom of the roofline verdict (direction-aware in
+    # bench_compare: higher = more slack before the memory wall; 0 on
+    # CPU where peak tables are unknown and the verdict is "unknown")
+    roof = base_r["roof"]
+    _emit({"metric": "gpt13b_hybrid_hbm_headroom_pct",
+           "value": round(roof.headroom_pct.get("hbm", 0.0), 2),
+           "unit": "pct", "vs_baseline": 0.0, "bound": roof.bound,
+           "roofline_seconds": {k: round(v, 6)
+                                for k, v in roof.seconds.items()}})
 
 
 # ---------------------------------------------------------------------------

@@ -6,6 +6,9 @@ prune the search space before launching trials.)
 
 Transformer-shaped models only (the tuner's target); constants are
 calibratable but the *ordering* of configs is what pruning needs.
+``estimate_memory_gb`` is cross-checked at runtime against the
+measured model-state accounting (observability/memledger.py —
+``paddle_tpu_mem_analytic_drift``), so its bias is observable.
 """
 from __future__ import annotations
 

@@ -3,6 +3,17 @@
 (reference: python/paddle/distributed/auto_tuner/tuner.py + search.py +
 prune.py — grid/GBS search over dp/mp/pp/sharding/micro-batch configs by
 launching trial jobs, with analytic pruning.)
+
+The ``hbm_gb`` pruning input is no longer validated by faith alone:
+the observability memory ledger (``observability/memledger.py``)
+measures the real per-device model-state footprint of a running
+``ParallelEngine`` (``engine.state_accounting()``, addressable-shard
+bytes incl. ZeRO scatter and pp x vpp chunk ownership) and publishes
+the analytic-vs-measured gap as the ``paddle_tpu_mem_analytic_drift``
+gauge. ``AutoTuner.crosscheck(cfg, measured_gb)`` computes the same
+drift for a trial's measured footprint, so a persistent bias in
+``estimate_memory_gb`` can be recalibrated instead of silently
+mis-pruning configs.
 """
 from __future__ import annotations
 
@@ -108,6 +119,18 @@ class AutoTuner:
         fits.sort(key=lambda x: x[0])
         return [dict(cfg, _pred_time=t, _pred_mem_gb=mem)
                 for t, mem, cfg in fits]
+
+    def crosscheck(self, cfg: Dict, measured_gb: float) -> float:
+        """Relative drift of the analytic memory model against a
+        measured per-chip footprint: (analytic - measured) / measured
+        (positive = the model over-estimates, i.e. prunes configs that
+        would actually fit). The live counterpart is the
+        ``paddle_tpu_mem_analytic_drift`` gauge
+        (observability/memledger.account_engine)."""
+        pred = estimate_memory_gb(self.model, cfg, self.global_batch,
+                                  self.seq_len,
+                                  recompute=self.recompute)
+        return (pred - measured_gb) / max(measured_gb, 1e-9)
 
     def best_by_model(self) -> Dict:
         ranked = self.pruned()

@@ -38,6 +38,7 @@ from ..core import rng as _rng
 from ..core.compile_stats import CompileStats
 from ..observability import commledger as _cl
 from ..observability import flops as _flops
+from ..observability import memledger as _ml
 from ..observability import moestats as _moestats
 from ..observability.catalog import train_metrics as _train_metrics
 from ..tensor import Tensor
@@ -293,7 +294,10 @@ class ParallelEngine:
 
     def __init__(self, model, optimizer=None, mesh: Optional[Mesh] = None,
                  comm_overlap: Optional[bool] = None,
-                 comm_buffer_size_mb: Optional[float] = None):
+                 comm_buffer_size_mb: Optional[float] = None,
+                 mem_ledger: Optional[bool] = None):
+        import os
+
         from . import grad_buckets as _gb
 
         self.model = model
@@ -332,6 +336,21 @@ class ParallelEngine:
         # filled when a program first traces, re-published every step
         self._ledgers: Dict[Any, Any] = {}
         self._last_key = None
+        # per-program HBM memory ledgers (observability/memledger):
+        # XLA memory_analysis of the SAME program, stored next to the
+        # comm ledger. Analysis costs one extra trace + AOT compile
+        # per program, so it is eager only behind the knob (ctor arg
+        # or PADDLE_TPU_MEM_LEDGER=1); memory_ledger() computes on
+        # demand either way from the per-key example args kept below.
+        self._mem_on = (bool(int(os.environ.get(
+            "PADDLE_TPU_MEM_LEDGER", "0") or 0))
+            if mem_ledger is None else bool(mem_ledger))
+        self._mem_ledgers: Dict[Any, Any] = {}
+        self._mem_args: Dict[Any, Any] = {}
+        self._state_acct = None          # cached StateAccounting
+        self._live_peak = 0              # live-bytes high-water mark
+        self._last_tokens = 0
+        self._last_step_seconds = 0.0
         # profile_exposed_comm() replays: suppress telemetry/counters
         # so offline attribution never pollutes the live metrics
         self._profiling = False
@@ -812,6 +831,13 @@ class ParallelEngine:
                 self._ledgers[key] = cap
             if not self._profiling:
                 self._last_key = key
+                # example args for on-demand AOT memory analysis of
+                # this program (references only; the batch leaves are
+                # never donated). Params/states are rebuilt from the
+                # engine's CURRENT values at analysis time, so the
+                # stored tuple only pins shapes/dtypes/tree structure.
+                self._mem_args[key] = (leaf_vals, lr, stepc, seed,
+                                       amp_in)
             for p, nv in zip(params, new_p):
                 p._value = nv
             for p, ns in zip(trainable, new_s):
@@ -873,6 +899,7 @@ class ParallelEngine:
         # the honest per-step wall time once the pipeline fills
         if self._prev_step_entry is not None:
             dt = max(t_entry - self._prev_step_entry, 1e-9)
+            self._last_step_seconds = dt
             tps = n_tok / dt
             m["tokens_per_sec"].set(tps)
             n_params = self._n_params_cfg or sum(
@@ -923,6 +950,31 @@ class ParallelEngine:
                             ms[k], device=str(d.id), stat=k)
         except Exception:
             pass        # CPU backends may not expose memory_stats
+        self._last_tokens = n_tok
+        # HBM memory ledger (observability/memledger): knob-gated eager
+        # analysis once per program, gauges republished per step, state
+        # accounting cached, live-bytes watermark at the step boundary
+        if self._mem_on:
+            led = self._mem_ledgers.get(self._last_key)
+            if led is None:
+                led = self.memory_ledger()
+            if led is not None:
+                led.publish(m, program="train")
+            if self._state_acct is None:
+                try:
+                    self._state_acct = _ml.account_engine(
+                        self, batch_tokens=n_tok,
+                        accumulate_steps=int(getattr(
+                            self.model, "_num_microbatches", 1) or 1))
+                except Exception:
+                    pass    # accounting must never take the step down
+            if self._state_acct is not None:
+                self._state_acct.publish(m)
+            lb = _ml.live_bytes()
+            if lb:
+                self._live_peak = max(self._live_peak, lb)
+                m["mem_live"].set(lb)
+                m["mem_live_peak"].set(self._live_peak)
         from ..observability import get_registry
 
         get_registry().snapshot()    # feeds the stall flight-record ring
@@ -953,6 +1005,88 @@ class ParallelEngine:
         """The static comm ledger of the last-run compiled step (None
         before any step has traced)."""
         return self._ledgers.get(self._last_key)
+
+    # -- memory accounting (observability/memledger) ---------------------
+    def memory_ledger(self, key=None):
+        """Static HBM memory ledger of the last-run (or given-key)
+        compiled train step: lowers the SAME jitted program AOT against
+        the engine's current param/state values and reads XLA's
+        ``memory_analysis()`` (temp / argument / output / alias / code
+        bytes per device). Cached per program key — one extra trace +
+        XLA compile the first time, zero thereafter, and the live
+        step's jit cache / CompileStats are never touched. Returns
+        None before any step has run."""
+        key = key if key is not None else self._last_key
+        if key is None or key not in self._compiled:
+            return None
+        led = self._mem_ledgers.get(key)
+        if led is not None:
+            return led
+        stored = self._mem_args.get(key)
+        if stored is None or self.optimizer is None:
+            return None
+        leaf_vals, lr, stepc, seed, amp_in = stored
+        opt = self.optimizer
+        pvals = tuple(p._value for p in self.params)
+        svals = tuple(opt._states[id(p)] for p in self.trainable)
+        # key[3] pins which params carried master weights at trace time
+        mvals = {i: opt._master_weights[id(self.params[i])]
+                 for i in key[3]}
+        led = _ml.analyze(
+            self._compiled[key],
+            (pvals, svals, mvals, leaf_vals, lr, stepc, seed, amp_in),
+            program="train")
+        self._mem_ledgers[key] = led
+        return led
+
+    def state_accounting(self, batch_tokens: Optional[int] = None):
+        """Measured per-device model-state accounting
+        (memledger.account_engine): params / grads / optimizer state /
+        master weights at addressable-shard size plus the analytic
+        activation-checkpoint term, with the auto_tuner cost-model
+        drift. Cached after the first step; ``batch_tokens`` overrides
+        the last step's token count for the checkpoint term."""
+        if self._state_acct is not None and batch_tokens is None:
+            return self._state_acct
+        acct = _ml.account_engine(
+            self, batch_tokens=int(batch_tokens if batch_tokens
+                                   is not None else self._last_tokens),
+            accumulate_steps=int(getattr(self.model,
+                                         "_num_microbatches", 1) or 1))
+        if batch_tokens is None:
+            self._state_acct = acct
+        return acct
+
+    def roofline_report(self, exposed=None):
+        """Roofline bottleneck verdict of the last-run compiled step
+        (memledger.roofline): joins the flop accountant (peak
+        FLOPs/HBM/ICI tables), the memory ledger's HBM-traffic
+        estimate, and the comm ledger — ``exposed`` (an
+        ExposedCommReport from profile_exposed_comm) supplies measured
+        exposed-ICI seconds and the measured step time; without it the
+        analytic wire floor and the last inter-step interval stand in.
+        All quantities are one chip's share."""
+        n_params = self._n_params_cfg or sum(
+            int(np.prod(p._value.shape)) for p in self.params)
+        tokens = self._last_tokens * jax.process_count()
+        fl = _flops.train_flops_per_token(
+            n_params, config=getattr(self.model, "config", None)) \
+            * tokens / max(self.mesh.size, 1)
+        led = self.memory_ledger()
+        traffic = led.traffic_bytes if led is not None and \
+            led.available else 0.0
+        comm = self.comm_ledger()
+        wire = comm.bytes_for() if comm is not None else 0.0
+        exp_ici = None
+        step_s = self._last_step_seconds
+        if exposed is not None:
+            exp_ici = sum(exposed.exposed_seconds.values())
+            step_s = exposed.step_seconds or step_s
+        dev = next(iter(self.mesh.devices.flat))
+        return _ml.roofline(
+            step_seconds=step_s, flops_per_step=fl,
+            hbm_traffic_bytes=traffic, wire_bytes=wire, device=dev,
+            exposed_ici_seconds=exp_ici, program="train")
 
     def _state_snapshot(self):
         """Device-copy of everything a step mutates (jnp.copy keeps
@@ -1062,6 +1196,11 @@ class ParallelEngine:
                               if k[-1] is None}
             self._ledgers = {k: v for k, v in self._ledgers.items()
                              if k[-1] is None}
+            self._mem_ledgers = {k: v for k, v
+                                 in self._mem_ledgers.items()
+                                 if k[-1] is None}
+            self._mem_args = {k: v for k, v in self._mem_args.items()
+                              if k[-1] is None}
         rep = _cl.build_report(t_full, exposed, replay)
         if publish:
             rep.publish(self._metrics)

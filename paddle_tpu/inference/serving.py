@@ -49,6 +49,7 @@ from jax import lax
 
 from ..core.enforce import enforce
 from ..observability import commledger as _cl
+from ..observability import memledger as _ml
 from ..observability.catalog import serving_metrics as _serving_metrics
 from ..observability.spans import RequestTrace, SpanRing
 from ..tensor import Tensor
@@ -104,8 +105,10 @@ class ServingEngine:
     """
 
     def __init__(self, predictor, max_batch: Optional[int] = None,
-                 pool_pages: Optional[int] = None, decode_chunk: int = 1,
-                 trace_ring: int = 256):
+                 pool_pages=None, decode_chunk: int = 1,
+                 trace_ring: int = 256, mem_ledger: bool = False):
+        import os
+
         from . import _bucket
 
         cfg = predictor.config
@@ -122,14 +125,30 @@ class ServingEngine:
         enforce(self.B >= 1 and decode_chunk >= 1,
                 "max_batch and decode_chunk must be >= 1")
         self.chunk = int(decode_chunk)
+        self._dtype = predictor._params[0]._value.dtype
         # one pool for the engine's whole lifetime, on the same bucket
         # lattice as Predictor._paged_caches: the compiled programs are
-        # keyed on this shape and NEVER change it
-        want = pool_pages or (self.B * self.npages + 1)
+        # keyed on this shape and NEVER change it. pool_pages="auto"
+        # sizes it from measured HBM headroom (memledger.
+        # suggest_pool_pages: bytes_limit minus the resident params,
+        # 10% margin) capped at the geometric maximum the batch can
+        # ever reference; backends without memory stats (the CPU
+        # harness) fall back to the geometric default.
+        geom = self.B * self.npages + 1
+        if pool_pages == "auto":
+            page_bytes = (2 * mcfg.num_layers * mcfg.num_kv_heads
+                          * self.page * mcfg.head_dim
+                          * np.dtype(self._dtype).itemsize)
+            resident = sum(_ml.shard_bytes(p._value)
+                           for p in predictor._params)
+            fit = _ml.suggest_pool_pages(jax.devices()[0], page_bytes,
+                                         resident)
+            want = min(fit, geom) if fit else geom
+        else:
+            want = pool_pages or geom
         self.P = _bucket(int(want), lo=8)
         self.trash = self.P - 1
         self._free_pages = list(range(self.P - 1))
-        self._dtype = predictor._params[0]._value.dtype
         shape = (self.P, mcfg.num_kv_heads, self.page, mcfg.head_dim)
         self.pools = [(jnp.zeros(shape, self._dtype),
                        jnp.zeros(shape, self._dtype))
@@ -156,6 +175,15 @@ class ServingEngine:
         # a single-device mesh; populated the first time a program
         # traces with collectives, republished per execution)
         self._ledgers: Dict[Any, Any] = {}
+        # per-program HBM memory ledgers (observability/memledger):
+        # analyzed at a site's FIRST execution (before the call — the
+        # cache buffers are donated) when the knob is on. One extra
+        # trace + AOT compile per site; the jit cache and CompileStats
+        # are untouched, so the (B, Sb, P) lattice stays exactly flat.
+        self._mem_on = bool(mem_ledger) or bool(int(os.environ.get(
+            "PADDLE_TPU_MEM_LEDGER", "0") or 0))
+        self._mem_ledgers: Dict[Any, Any] = {}
+        self._live_peak = 0
         self.gen = cfg.generation
         self._rng = jax.random.PRNGKey(self.gen.seed)
         self._step_fns: Dict[Any, Any] = {}
@@ -420,6 +448,12 @@ class ServingEngine:
                                 site="serving")
         self._stats_reported = (self.stats.compiles,
                                 self.stats.cache_hits)
+        if self._mem_on:
+            lb = _ml.live_bytes()
+            if lb:
+                self._live_peak = max(self._live_peak, lb)
+                m["mem_live"].set(lb)
+                m["mem_live_peak"].set(self._live_peak)
         from ..observability import get_registry
 
         get_registry().snapshot()
@@ -429,7 +463,13 @@ class ServingEngine:
         call traces (first execution) its static ledger is stored under
         ``site``; every execution republishes the stored ledger to the
         comm_bytes/comm_ops counters. Single-device programs record
-        nothing and publish nothing."""
+        nothing and publish nothing. With the memory ledger on, the
+        site's FIRST execution also stores an XLA memory_analysis of
+        the same program (lowered BEFORE the call: the cache buffers
+        are donated), republished as mem gauges per execution."""
+        if self._mem_on and site not in self._mem_ledgers:
+            self._mem_ledgers[site] = _ml.analyze(
+                fn, args, program="_".join(str(s) for s in site))
         with _cl.capture() as cap:
             out = fn(*args)
         if len(cap):
@@ -438,12 +478,79 @@ class ServingEngine:
         if led is not None:
             led.publish(self._metrics["comm_bytes"],
                         self._metrics["comm_ops"])
+        mled = self._mem_ledgers.get(site)
+        if mled is not None:
+            mled.publish(self._metrics)
         return out
 
     def comm_ledger(self, site) -> Optional[Any]:
         """Static comm ledger of a compiled serving program: site is
         ("decode",) or ("prefill", seq_bucket)."""
         return self._ledgers.get(site)
+
+    # -- memory accounting (observability/memledger) ---------------------
+    def memory_ledger(self, site=("decode",)) -> Optional[Any]:
+        """Static HBM memory ledger of a compiled serving program
+        (site as in ``comm_ledger``); populated at the site's first
+        execution when the engine was built with ``mem_ledger=True``
+        (or PADDLE_TPU_MEM_LEDGER=1)."""
+        return self._mem_ledgers.get(site)
+
+    def memory_summary(self) -> Dict[str, Any]:
+        """The serving memory section bench lines carry: every
+        analyzed executable's byte classes plus the measured resident
+        state (params + the KV page pool, with the per-page byte cost
+        and pool geometry the "auto" sizing uses)."""
+        mcfg = self.pred._model.config
+        page_bytes = (2 * mcfg.num_layers * mcfg.num_kv_heads
+                      * self.page * mcfg.head_dim
+                      * np.dtype(self._dtype).itemsize)
+        pool_bytes = sum(_ml.shard_bytes(kp) + _ml.shard_bytes(vp)
+                         for kp, vp in self.pools)
+        return {
+            "executables": {led.program: led.to_dict()
+                            for led in self._mem_ledgers.values()},
+            "state": {
+                "params_bytes": sum(_ml.shard_bytes(p._value)
+                                    for p in self.pred._params),
+                "kv_pool_bytes": pool_bytes,
+                "page_bytes": page_bytes,
+                "pool_pages": self.P,
+                "live_peak_bytes": self._live_peak,
+            },
+        }
+
+    def roofline_report(self):
+        """Roofline verdict of the shared decode round
+        (memledger.roofline): FLOPs from the 2N-per-token forward over
+        the full B x chunk round, HBM traffic from the decode
+        executable's memory ledger, ICI from its comm ledger's wire
+        bytes, against the median measured round time. Serving decode
+        is expected HBM-bound on chip (the weight-bandwidth roofline
+        bench.py's decode lines report against)."""
+        cfg = getattr(self.pred._model, "config", None)
+        n_params = None
+        fn = getattr(cfg, "num_params", None)
+        if callable(fn):
+            try:
+                n_params = int(fn())
+            except Exception:
+                n_params = None
+        if n_params is None:
+            n_params = sum(
+                int(np.prod(p._value.shape)) for p in self.pred._params)
+        n_dev = max(jax.device_count(), 1)
+        fl = 2.0 * n_params * self.B * self.chunk / n_dev
+        led = self._mem_ledgers.get(("decode",))
+        traffic = led.traffic_bytes if led is not None and \
+            led.available else 0.0
+        comm = self._ledgers.get(("decode",))
+        wire = comm.bytes_for() if comm is not None else 0.0
+        step_s = self._metrics["decode_round_seconds"].percentile(50)
+        return _ml.roofline(
+            step_seconds=step_s, flops_per_step=fl,
+            hbm_traffic_bytes=traffic, wire_bytes=wire,
+            device=jax.devices()[0], program="decode")
 
     # -- per-request traces ----------------------------------------------
     def request_traces(self) -> List[Dict[str, Any]]:

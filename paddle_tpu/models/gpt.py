@@ -493,6 +493,11 @@ class GPTForCausalLMPipe:
             recompute_interval=recompute_interval, **pp_kwargs)
         if config.dtype not in ("float32", None):
             model.astype(config.dtype)
+        # expose the model config on the PipelineLayer like the eager
+        # GPTForCausalLM does: the engine's flop accountant (MFU) and
+        # the memory ledger's state accounting / auto_tuner cross-check
+        # (observability/memledger.py) read layer geometry from it
+        model.config = config
         return model
 
 

@@ -19,6 +19,13 @@ subsystems instrument into:
   step issues (axis / op / dtype / bytes, via the shim in
   ``distributed/collective.py``) and backs the exposed-comm
   attribution pass (``ParallelEngine.profile_exposed_comm``),
+- **memory**   — ``memledger`` attributes per-executable HBM bytes
+  (XLA ``memory_analysis``: temp / argument / output / alias / code),
+  measures the model-state footprint per device (ZeRO- and
+  pp x vpp-aware shard accounting, cross-checked against the
+  auto_tuner's analytic model), and joins flops + comm + memory into
+  per-step roofline verdicts (compute- / hbm- / ici-bound with
+  headroom percentages),
 - **spans**    — per-request serving lifecycle traces
   (queued → prefill → decode rounds) in a bounded ring with
   Chrome-trace export (``ServingEngine.export_request_traces``).
@@ -41,9 +48,11 @@ from .flight import FlightRecorder, dump as dump_flight_record, \
     get_recorder  # noqa: F401
 from . import flops  # noqa: F401
 from . import commledger  # noqa: F401
+from . import memledger  # noqa: F401
 from . import moestats  # noqa: F401
 from . import spans  # noqa: F401
 from .commledger import CommLedger  # noqa: F401
+from .memledger import MemLedger, RooflineReport, StateAccounting  # noqa: F401,E501
 from .spans import RequestTrace, SpanRing  # noqa: F401
 from .exporter import MetricsServer, serve_metrics  # noqa: F401
 
@@ -52,8 +61,10 @@ __all__ = [
     "DEFAULT_LATENCY_BUCKETS", "get_registry", "reset_registry",
     "parse_prometheus_text", "annotate", "current_regions",
     "FlightRecorder", "dump_flight_record", "get_recorder", "flops",
-    "cross_host_sum", "commledger", "CommLedger", "moestats", "spans",
-    "RequestTrace", "SpanRing", "MetricsServer", "serve_metrics",
+    "cross_host_sum", "commledger", "CommLedger", "memledger",
+    "MemLedger", "RooflineReport", "StateAccounting", "moestats",
+    "spans", "RequestTrace", "SpanRing", "MetricsServer",
+    "serve_metrics",
 ]
 
 

@@ -17,7 +17,7 @@ from .metrics import (DEFAULT_LATENCY_BUCKETS, MetricsRegistry,
                       get_registry)
 
 __all__ = ["train_metrics", "serving_metrics", "comm_metrics",
-           "SCHEMA_PATH"]
+           "mem_metrics", "SCHEMA_PATH"]
 
 SCHEMA_PATH = __file__.rsplit("/", 1)[0] + "/schema.json"
 
@@ -70,10 +70,69 @@ def comm_metrics(reg: MetricsRegistry = None) -> Dict[str, object]:
     }
 
 
+def mem_metrics(reg: MetricsRegistry = None) -> Dict[str, object]:
+    """Register (get-or-create) the HBM memory-ledger instrument set —
+    shared by the train and serving engines (both store per-executable
+    memory ledgers and a model-state accounting;
+    observability/memledger.py)."""
+    r = reg or get_registry()
+    return {
+        "mem_temp": r.gauge(
+            "paddle_tpu_mem_temp_bytes",
+            "scratch bytes one execution of the compiled program peaks "
+            "through mid-step (activations, remat windows, collective "
+            "staging), per device — XLA buffer assignment via "
+            "memory_analysis()", labelnames=("program",), unit="bytes"),
+        "mem_argument": r.gauge(
+            "paddle_tpu_mem_argument_bytes",
+            "input buffer bytes the compiled program reads (params, "
+            "optimizer state, batch), per device",
+            labelnames=("program",), unit="bytes"),
+        "mem_output": r.gauge(
+            "paddle_tpu_mem_output_bytes",
+            "result buffer bytes the compiled program writes, per "
+            "device", labelnames=("program",), unit="bytes"),
+        "mem_alias": r.gauge(
+            "paddle_tpu_mem_alias_bytes",
+            "bytes shared between arguments and outputs by donation "
+            "(buffer aliasing; counted in both classes, subtracted "
+            "once from the peak)", labelnames=("program",),
+            unit="bytes"),
+        "mem_code": r.gauge(
+            "paddle_tpu_mem_generated_code_bytes",
+            "the executable's own code + embedded constants, per "
+            "device", labelnames=("program",), unit="bytes"),
+        "mem_state": r.gauge(
+            "paddle_tpu_mem_state_bytes",
+            "measured per-device model-state footprint by component "
+            "(params / grads / optimizer_state / master_weights / "
+            "activation_ckpt), addressable-shard bytes — ZeRO scatter "
+            "and pp x vpp chunk ownership included "
+            "(memledger.account_engine)", labelnames=("component",),
+            unit="bytes"),
+        "mem_drift": r.gauge(
+            "paddle_tpu_mem_analytic_drift",
+            "(analytic - measured) / measured of the auto_tuner memory "
+            "model vs the measured state accounting — the gauge that "
+            "validates hbm_gb pruning against reality"),
+        "mem_live": r.gauge(
+            "paddle_tpu_mem_live_bytes",
+            "device bytes held by live jax arrays at the last step "
+            "boundary (memledger.live_bytes; the watermark source on "
+            "backends without memory_stats)", unit="bytes"),
+        "mem_live_peak": r.gauge(
+            "paddle_tpu_mem_live_peak_bytes",
+            "high-water mark of paddle_tpu_mem_live_bytes over the "
+            "engine's lifetime, sampled at step boundaries",
+            unit="bytes"),
+    }
+
+
 def train_metrics(reg: MetricsRegistry = None) -> Dict[str, object]:
     """Register (get-or-create) the training instrument set."""
     r = reg or get_registry()
     out = comm_metrics(r)
+    out.update(mem_metrics(r))
     out.update({
         "step_seconds": r.histogram(
             "paddle_tpu_train_step_seconds",
@@ -156,6 +215,7 @@ def serving_metrics(reg: MetricsRegistry = None) -> Dict[str, object]:
     """Register (get-or-create) the serving instrument set."""
     r = reg or get_registry()
     out = comm_metrics(r)
+    out.update(mem_metrics(r))
     out.update({
         "ttft": r.histogram(
             "paddle_tpu_serving_ttft_seconds",

@@ -1,4 +1,5 @@
-"""Optional stdlib HTTP ``/metrics`` endpoint for a real scrape loop.
+"""Optional stdlib HTTP ``/metrics`` + ``/healthz`` endpoint for a
+real scrape loop.
 
 ``serve_metrics(port)`` starts a daemon-threaded ``http.server``
 serving the registry's Prometheus text exposition at ``/metrics``
@@ -8,14 +9,29 @@ new dependencies — and entirely off the hot path: a scrape calls
 ``registry.prometheus_text()`` exactly like ``metrics_snapshot()``
 does.
 
+``/healthz`` answers 200 with a tiny JSON liveness payload::
+
+    {"status": "ok", "snapshot_age_seconds": 1.7, "pid": 1234}
+
+``snapshot_age_seconds`` is the time since the registry's last
+in-process snapshot — the engines snapshot once per step / serving
+tick, so an external scraper can tell a HUNG process (age growing
+without bound while the port still answers) from an idle-but-healthy
+one. Scrapes of ``/metrics`` deliberately do not refresh the age
+(metrics.py ``snapshot(touch=False)``); before any engine tick the
+age is ``null``.
+
     >>> srv = serve_metrics(9100)        # port 0 picks a free port
     >>> srv.port
     9100
-    >>> # ... prometheus scrapes http://host:9100/metrics ...
+    >>> # ... prometheus scrapes http://host:9100/metrics,
+    >>> # ... the orchestrator probes /healthz ...
     >>> srv.close()
 """
 from __future__ import annotations
 
+import json
+import os
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
@@ -59,12 +75,25 @@ def serve_metrics(port: int = 0, registry: Optional[MetricsRegistry] = None,
 
     class _Handler(BaseHTTPRequestHandler):
         def do_GET(self):
-            if self.path.split("?", 1)[0] not in ("/", "/metrics"):
-                self.send_error(404, "only /metrics is served")
+            path = self.path.split("?", 1)[0]
+            if path == "/healthz":
+                age = reg.snapshot_age_seconds()
+                body = json.dumps({
+                    "status": "ok",
+                    "snapshot_age_seconds":
+                        round(age, 3) if age is not None else None,
+                    "pid": os.getpid(),
+                }).encode("utf-8")
+                ctype = "application/json"
+            elif path in ("/", "/metrics"):
+                body = reg.prometheus_text().encode("utf-8")
+                ctype = CONTENT_TYPE
+            else:
+                self.send_error(404, "only /metrics and /healthz are "
+                                     "served")
                 return
-            body = reg.prometheus_text().encode("utf-8")
             self.send_response(200)
-            self.send_header("Content-Type", CONTENT_TYPE)
+            self.send_header("Content-Type", ctype)
             self.send_header("Content-Length", str(len(body)))
             self.end_headers()
             self.wfile.write(body)

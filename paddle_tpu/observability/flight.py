@@ -7,7 +7,9 @@ queue so the post-mortem shows WHAT was queued, not just that the pod
 died. TPU-native equivalent: collectives are compiled into the step, so
 the record instead captures the registry's recent snapshots (what the
 workload was doing), the semantic region stacks (where in the framework
-each thread is), and raw python stacks (ground truth).)
+each thread is), raw python stacks (ground truth), and a memory context
+(the paddle_tpu_mem_* / device_memory gauges + fresh per-device
+memory_stats — OOM-adjacent stalls answer "how full was HBM").)
 
 The ring is fed automatically: every ``MetricsRegistry.snapshot()``
 pushes into it, and the instrumented engines snapshot once per
@@ -30,6 +32,48 @@ __all__ = ["FlightRecorder", "get_recorder", "dump"]
 
 DEFAULT_DIR_ENV = "PADDLE_TPU_FLIGHT_DIR"
 _DEFAULT_DIR = "./flight_records"
+
+
+def _memory_context() -> Dict[str, Any]:
+    """Current memory picture for the flight record: the live values of
+    every ``paddle_tpu_mem_*`` / ``paddle_tpu_device_memory_bytes``
+    gauge plus fresh per-device ``memory_stats()`` — so an OOM-adjacent
+    stall dump answers "how full was HBM" without replaying the
+    snapshot ring. Best-effort and lock-timeout-guarded: the dumping
+    thread may be the one that wedged while HOLDING the registry lock,
+    and a post-mortem must never deadlock on it."""
+    out: Dict[str, Any] = {"gauges": {}, "device_memory_stats": {}}
+    try:
+        from .metrics import get_registry
+
+        reg = get_registry()
+        gauges: Dict[str, Any] = {}
+        locked = reg._lock.acquire(timeout=0.5)
+        try:
+            for name, m in list(reg._metrics.items()):
+                if not (name.startswith("paddle_tpu_mem_")
+                        or name == "paddle_tpu_device_memory_bytes"):
+                    continue
+                for key, s in list(m._series.items()):
+                    lbl = ",".join(f"{k}={v}" for k, v
+                                   in zip(m.labelnames, key))
+                    gauges[name + (f"{{{lbl}}}" if lbl else "")] = \
+                        s[0] if isinstance(s, list) else None
+        finally:
+            if locked:
+                reg._lock.release()
+        out["gauges"] = gauges
+    except Exception:
+        pass
+    try:
+        import jax
+
+        out["device_memory_stats"] = {
+            str(d.id): (d.memory_stats() or {})
+            for d in jax.local_devices()}
+    except Exception:
+        pass
+    return out
 
 
 class FlightRecorder:
@@ -68,6 +112,9 @@ class FlightRecorder:
             "pid": os.getpid(),
             "inflight_regions": trace.current_regions(),
             "thread_stacks": self.thread_stacks(),
+            # memory context (observability/memledger gauges + device
+            # stats): OOM-adjacent stalls carry how full HBM was
+            "memory": _memory_context(),
             "snapshots": self.snapshots(),
         }
 

@@ -1,0 +1,547 @@
+"""HBM memory ledger: per-executable memory attribution, model-state
+accounting, and roofline bottleneck verdicts.
+
+The comm ledger (commledger.py) made bytes-on-wire a first-class,
+per-program fact; this module does the same for HBM, in three layers:
+
+1. **Executable ledger** (``analyze`` -> ``MemLedger``): XLA's own
+   buffer assignment, read through
+   ``jax.stages.Compiled.memory_analysis()`` — temp / argument /
+   output / alias / generated-code bytes of ONE compiled program,
+   per device (SPMD executables share one module, so the numbers are
+   what each chip's HBM actually holds). The engines store a ledger
+   per compiled program next to its comm ledger and publish it as the
+   ``paddle_tpu_mem_*_bytes{program}`` gauges. Analysis re-lowers the
+   SAME jitted program AOT (an extra trace + XLA compile, once per
+   program), so it is knob-gated: ``ParallelEngine(...,
+   mem_ledger=True)`` / ``ServingEngine(..., mem_ledger=True)`` or
+   ``PADDLE_TPU_MEM_LEDGER=1`` for eager per-trace analysis; the
+   ``memory_ledger()`` accessors compute on demand either way. The
+   compiled-program cache is untouched — zero recompiles of the real
+   step (asserted in tests/test_memledger.py).
+
+2. **Model-state accounting** (``account_engine`` ->
+   ``StateAccounting``): measured per-device bytes of params / grads /
+   optimizer state / master weights, dtype-aware and sharding-aware —
+   each array's contribution is its ADDRESSABLE SHARD size
+   (``sharding.shard_shape``), so ZeRO-scattered optimizer state,
+   tp/pp-sharded params, and the pp x vpp stacked-chunk ownership all
+   count at what one chip really stores. An analytic
+   activation-checkpoint term (tokens_per_microbatch x hidden x
+   local_layers x dtype) rides along, and the whole total is
+   cross-checked against the auto_tuner's analytic model
+   (distributed/auto_tuner/cost_model.estimate_memory_gb) with the
+   relative drift reported as ``paddle_tpu_mem_analytic_drift`` — the
+   gauge that finally validates the tuner's ``hbm_gb`` pruning against
+   reality. ``closed_form_state_bytes`` recomputes params/state from
+   GLOBAL shapes divided by sharding degrees (an independent
+   derivation) for the exact parity gates.
+
+3. **Roofline verdict** (``roofline`` -> ``RooflineReport``): joins
+   the flop accountant (flops.py peak tables), the comm ledger (wire
+   bytes / exposed seconds), and the memory ledger into a per-step
+   bottleneck verdict: t_compute = FLOPs/peak, t_hbm = traffic/BW
+   (traffic estimated as argument + output + 2 x temp bytes: args read
+   once, outputs written once, temps written and read), t_ici =
+   measured exposed-comm seconds (falling back to wire_bytes/ICI-BW).
+   The largest term names the bound — compute-bound / hbm-bound /
+   ici-bound — and every resource gets a headroom percentage
+   ``100 * (1 - t_r / t_bound)``. On CPU all peaks are unknown, every
+   term is 0 and the verdict is "unknown" (well-defined everywhere,
+   the flops.py convention).
+
+Live-bytes watermarks (``live_bytes``) sum every live ``jax.Array``'s
+addressable shards — the step-boundary peak gauge on backends without
+``memory_stats`` (the CPU harness). ``suggest_pool_pages`` turns the
+measured headroom into serving page-pool sizing
+(ServingEngine ``pool_pages="auto"``).
+
+Everything here is host-side bookkeeping on shapes, dtypes and
+shardings; nothing adds ops to any compiled program.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+__all__ = [
+    "MemLedger", "analyze", "shard_bytes", "StateAccounting",
+    "account_engine", "closed_form_state_bytes", "RooflineReport",
+    "roofline", "live_bytes", "suggest_pool_pages", "RESOURCES",
+]
+
+# the three roofline resources, in verdict tie-break order (a tie goes
+# to the earlier entry: compute beats hbm beats ici)
+RESOURCES = ("compute", "hbm", "ici")
+
+
+# ---------------------------------------------------------------------------
+# 1. per-executable memory ledger (XLA buffer assignment)
+# ---------------------------------------------------------------------------
+@dataclass(frozen=True)
+class MemLedger:
+    """Static memory attribution of ONE compiled executable, per device.
+
+    Byte classes (XLA buffer assignment, ``memory_analysis()``):
+
+    - ``argument_bytes``: input buffers the executable reads (params,
+      optimizer state, the batch) — resident before the step runs,
+    - ``output_bytes``: result buffers it writes (updated params/state,
+      the loss) — resident after,
+    - ``alias_bytes``: bytes shared between the two by donation
+      (``donate_argnums`` buffer aliasing — the ZeRO-style in-place
+      update; counted in BOTH argument and output, so peak subtracts
+      it once),
+    - ``temp_bytes``: scratch the program peaks through mid-step
+      (activations, remat windows, collective staging),
+    - ``generated_code_bytes``: the executable's own code + constants.
+    """
+
+    program: str = ""
+    temp_bytes: int = 0
+    argument_bytes: int = 0
+    output_bytes: int = 0
+    alias_bytes: int = 0
+    generated_code_bytes: int = 0
+    available: bool = True
+    note: str = ""
+
+    @property
+    def peak_bytes(self) -> int:
+        """Estimated HBM high-water mark of one execution: arguments +
+        outputs + temps + code, minus the donation-aliased bytes that
+        argument and output both count."""
+        return (self.argument_bytes + self.output_bytes
+                + self.temp_bytes + self.generated_code_bytes
+                - self.alias_bytes)
+
+    @property
+    def traffic_bytes(self) -> int:
+        """Roofline HBM-traffic estimate for one execution: arguments
+        read once + outputs written once + temps written AND read
+        (2x). A deliberate lower-bound-flavored heuristic — fusion
+        avoids re-reads, loops re-touch — but byte-proportional to the
+        working set, which is what the verdict needs."""
+        return (self.argument_bytes + self.output_bytes
+                + 2 * self.temp_bytes)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "program": self.program,
+            "temp_bytes": self.temp_bytes,
+            "argument_bytes": self.argument_bytes,
+            "output_bytes": self.output_bytes,
+            "alias_bytes": self.alias_bytes,
+            "generated_code_bytes": self.generated_code_bytes,
+            "peak_bytes": self.peak_bytes,
+            "available": self.available,
+            **({"note": self.note} if self.note else {}),
+        }
+
+    def publish(self, metrics: Dict[str, Any],
+                program: Optional[str] = None) -> None:
+        """Set the ``paddle_tpu_mem_*_bytes{program}`` catalog gauges
+        (train_metrics / serving_metrics keys)."""
+        if not self.available:
+            return
+        prog = program if program is not None else self.program
+        metrics["mem_temp"].set(self.temp_bytes, program=prog)
+        metrics["mem_argument"].set(self.argument_bytes, program=prog)
+        metrics["mem_output"].set(self.output_bytes, program=prog)
+        metrics["mem_alias"].set(self.alias_bytes, program=prog)
+        metrics["mem_code"].set(self.generated_code_bytes, program=prog)
+
+    def same_totals(self, other: "MemLedger") -> bool:
+        """Byte-class equality (the recompile-stability check)."""
+        return (self.temp_bytes == other.temp_bytes
+                and self.argument_bytes == other.argument_bytes
+                and self.output_bytes == other.output_bytes
+                and self.alias_bytes == other.alias_bytes)
+
+
+def analyze(fn, args=(), program: str = "") -> MemLedger:
+    """Memory ledger of ``fn`` (a ``jax.jit``-wrapped callable) at the
+    given example ``args``: lowers the program AOT and reads XLA's
+    ``memory_analysis()``. The identical trace means the identical
+    buffer assignment as the executed program; the extra XLA compile
+    happens once per program (the callers cache per program key) and
+    never touches the jit cache, so the live step's compile counters
+    stay flat. Backends without the analysis (or a failed lowering)
+    return an ``available=False`` ledger instead of raising — a dead
+    analysis must not take the step down."""
+    try:
+        stats = fn.lower(*args).compile().memory_analysis()
+    except Exception as e:  # noqa: BLE001 - observability must not raise
+        return MemLedger(program=program, available=False,
+                         note=f"{type(e).__name__}: {e}"[:200])
+    if stats is None:
+        return MemLedger(program=program, available=False,
+                         note="memory_analysis unavailable")
+    return MemLedger(
+        program=program,
+        temp_bytes=int(getattr(stats, "temp_size_in_bytes", 0)),
+        argument_bytes=int(getattr(stats, "argument_size_in_bytes", 0)),
+        output_bytes=int(getattr(stats, "output_size_in_bytes", 0)),
+        alias_bytes=int(getattr(stats, "alias_size_in_bytes", 0)),
+        generated_code_bytes=int(
+            getattr(stats, "generated_code_size_in_bytes", 0)))
+
+
+# ---------------------------------------------------------------------------
+# 2. model-state accounting (measured, per device)
+# ---------------------------------------------------------------------------
+def shard_bytes(arr) -> int:
+    """Bytes ONE device's addressable shard of ``arr`` occupies: the
+    global shape run through ``sharding.shard_shape`` (replicated dims
+    contribute fully, sharded dims their slice). Plain host / single-
+    device arrays fall back to their full size."""
+    shape = getattr(arr, "shape", None)
+    if shape is None:
+        return 0
+    try:
+        itemsize = int(np.dtype(arr.dtype).itemsize)
+    except Exception:
+        itemsize = int(getattr(getattr(arr, "dtype", None), "itemsize", 4))
+    sh = getattr(arr, "sharding", None)
+    if sh is not None:
+        try:
+            shape = sh.shard_shape(tuple(int(s) for s in shape))
+        except Exception:
+            pass
+    return int(np.prod(shape)) * itemsize if len(shape) else itemsize
+
+
+def _spec_degree(p, mesh, extra_axes=()) -> int:
+    """Number of distinct shards a param's PartitionSpec (plus
+    ``extra_axes``) splits it into — the closed-form divisor."""
+    axes = set(extra_axes)
+    da = getattr(p, "dist_attr", None)
+    for ax in (tuple(da) if da is not None else ()):
+        if isinstance(ax, (tuple, list)):
+            axes.update(ax)
+        elif ax is not None:
+            axes.add(ax)
+    deg = 1
+    for a in axes:
+        if a in mesh.axis_names:
+            deg *= int(mesh.shape[a])
+    return max(deg, 1)
+
+
+def _group_name(name: str) -> str:
+    """Layer-group key for the per-group breakdown: the first two
+    dotted path components ("gpt.decoder", "lm_head", ...) — coarse on
+    purpose; the stacked pp blocks live under one group whose bytes
+    show the chunk ownership."""
+    parts = name.split(".")
+    return ".".join(parts[:2]) if len(parts) > 1 else name
+
+
+@dataclass
+class StateAccounting:
+    """Measured per-device model-state footprint + the analytic drift.
+
+    ``components``: params / grads / optimizer_state / master_weights /
+    activation_ckpt bytes one device holds. Grads are transient (alive
+    between backward and update) and counted at the param's
+    PartitionSpec shard size; activation_ckpt is the analytic
+    checkpoint-boundary term (see ``account_engine``). ``groups`` is
+    the per-layer-group breakdown of the persistent classes.
+    ``analytic_bytes`` is the auto_tuner cost model's estimate for the
+    same config; ``drift`` = (analytic - measured) / measured.
+    """
+
+    components: Dict[str, int] = field(default_factory=dict)
+    groups: Dict[str, Dict[str, int]] = field(default_factory=dict)
+    analytic_bytes: float = 0.0
+    drift: float = 0.0
+
+    @property
+    def measured_bytes(self) -> int:
+        return int(sum(self.components.values()))
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "components": dict(self.components),
+            "groups": {g: dict(v) for g, v in sorted(self.groups.items())},
+            "measured_bytes": self.measured_bytes,
+            "analytic_bytes": round(self.analytic_bytes, 1),
+            "analytic_drift": round(self.drift, 4),
+        }
+
+    def publish(self, metrics: Dict[str, Any]) -> None:
+        for comp, v in self.components.items():
+            metrics["mem_state"].set(v, component=comp)
+        metrics["mem_drift"].set(self.drift)
+
+
+def _mesh_degree(mesh, axis: str) -> int:
+    return int(mesh.shape[axis]) if axis in mesh.axis_names else 1
+
+
+def account_engine(engine, batch_tokens: int = 0,
+                   accumulate_steps: int = 1) -> StateAccounting:
+    """Measured model-state accounting of a ``ParallelEngine``:
+    addressable-shard bytes of every param / optimizer-state / master-
+    weight array (ZeRO scatter, tp/pp sharding and the pp x vpp stacked
+    chunks all already live in the arrays' shardings), plus the
+    analytic activation-checkpoint term and the auto_tuner cross-check.
+
+    ``batch_tokens`` is the host-local tokens one step consumes (the
+    engine's ``_batch_tokens``); ``accumulate_steps`` the microbatch
+    count — together they size the checkpoint term:
+    ``local_layers x tokens_per_microbatch_per_rank x hidden x
+    dtype_bytes`` (one saved residual per transformer block, the
+    remat-boundary convention; reported 0 when the model carries no
+    layer-geometry config)."""
+    mesh = engine.mesh
+    opt = engine.optimizer
+    comp = {"params": 0, "grads": 0, "optimizer_state": 0,
+            "master_weights": 0, "activation_ckpt": 0}
+    groups: Dict[str, Dict[str, int]] = {}
+    named = {}
+    try:
+        named = {id(p): n for n, p in engine.model.named_parameters()}
+    except Exception:
+        pass
+    states = getattr(opt, "_states", {}) if opt is not None else {}
+    masters = getattr(opt, "_master_weights", {}) if opt is not None \
+        else {}
+    for p in engine.params:
+        pb = shard_bytes(p._value)
+        comp["params"] += pb
+        g = groups.setdefault(_group_name(named.get(id(p), "param")),
+                              {"params": 0, "optimizer_state": 0,
+                               "master_weights": 0})
+        g["params"] += pb
+        if getattr(p, "trainable", True):
+            # transient backward grads live at the param's spec shard
+            # (before any ZeRO scatter); dtype follows the param
+            comp["grads"] += pb
+        st = states.get(id(p))
+        if st:
+            sb = sum(shard_bytes(v) for v in st.values()
+                     if hasattr(v, "shape"))
+            comp["optimizer_state"] += sb
+            g["optimizer_state"] += sb
+        mw = masters.get(id(p))
+        if mw is not None:
+            mb = shard_bytes(mw)
+            comp["master_weights"] += mb
+            g["master_weights"] += mb
+
+    cfg = getattr(engine.model, "config", None)
+    hidden = getattr(cfg, "hidden_size", None)
+    layers = getattr(cfg, "num_layers", None)
+    analytic = 0.0
+    if hidden and layers:
+        dtype_bytes = int(np.dtype(engine.params[0]._value.dtype).itemsize
+                          if engine.params else 4)
+        pp = _mesh_degree(mesh, "pp")
+        mp = _mesh_degree(mesh, "mp")
+        data_deg = 1
+        for a in ("dp", "sharding", "ep"):
+            data_deg *= _mesh_degree(mesh, a)
+        micro_tokens = batch_tokens / max(data_deg * accumulate_steps, 1)
+        comp["activation_ckpt"] = int(
+            (layers / max(pp, 1)) * micro_tokens * hidden * dtype_bytes)
+        # the auto_tuner's analytic model for the same config (its
+        # pruning input, now validated against the measured total).
+        # seq_len carries the whole tokens-per-microbatch-per-rank
+        # product with micro_batch_size pinned to 1 — the model only
+        # ever uses micro x seq_len x hidden.
+        from ..distributed.auto_tuner.cost_model import \
+            estimate_memory_gb
+
+        zero = getattr(engine, "_zero", None)
+        sh_deg = getattr(zero, "n", 1) if getattr(zero, "axis", None) \
+            else 1
+        stage3 = any(e[1] for e in zero.entries.values()) \
+            if zero is not None and zero.entries else False
+        model_d = {"hidden_size": hidden, "num_layers": layers,
+                   "vocab_size": getattr(cfg, "vocab_size", 50304)}
+        cfg_d = {"dp_degree": _mesh_degree(mesh, "dp"),
+                 "mp_degree": mp, "pp_degree": pp,
+                 "sharding_degree": sh_deg,
+                 "sharding_stage": 3 if stage3 else 2,
+                 "micro_batch_size": 1}
+        try:
+            analytic = estimate_memory_gb(
+                model_d, cfg_d,
+                global_batch=max(data_deg * accumulate_steps, 1),
+                seq_len=max(int(micro_tokens), 1),
+                dtype_bytes=dtype_bytes) * 1e9
+        except Exception:
+            analytic = 0.0
+    measured = sum(comp.values())
+    drift = ((analytic - measured) / measured) if measured and analytic \
+        else 0.0
+    return StateAccounting(components=comp, groups=groups,
+                           analytic_bytes=analytic, drift=drift)
+
+
+def closed_form_state_bytes(engine) -> Dict[str, int]:
+    """Closed-form per-device param / optimizer / master-weight bytes:
+    GLOBAL shapes divided by the sharding degrees the specs + ZeRO plan
+    declare — an independent derivation from ``account_engine`` (which
+    reads ``sharding.shard_shape``); the two must agree exactly, which
+    the bench parity lines and tests/test_memledger.py gate on."""
+    mesh = engine.mesh
+    opt = engine.optimizer
+    zero = getattr(engine, "_zero", None)
+    out = {"params": 0, "optimizer_state": 0, "master_weights": 0}
+    for p in engine.params:
+        nbytes = int(np.prod(p._value.shape) if p._value.ndim else 1) \
+            * int(np.dtype(p._value.dtype).itemsize)
+        e = zero.entry(p) if zero is not None else None
+        # stage-3 params are STORED scattered; stage 1/2 replicated
+        store_extra = (zero.axis,) if e is not None and e[1] else ()
+        out["params"] += nbytes // _spec_degree(p, mesh, store_extra)
+        if not getattr(p, "trainable", True) or opt is None:
+            continue
+        state_extra = (zero.axis,) if e is not None else ()
+        st = getattr(opt, "_states", {}).get(id(p), {})
+        for v in st.values():
+            if not hasattr(v, "shape"):
+                continue
+            vb = int(np.prod(v.shape) if v.ndim else 1) \
+                * int(np.dtype(v.dtype).itemsize)
+            if tuple(v.shape) == tuple(p._value.shape):
+                vb //= _spec_degree(p, mesh, state_extra)
+            out["optimizer_state"] += vb
+        mw = getattr(opt, "_master_weights", {}).get(id(p))
+        if mw is not None:
+            mb = int(np.prod(mw.shape) if mw.ndim else 1) \
+                * int(np.dtype(mw.dtype).itemsize)
+            out["master_weights"] += mb // _spec_degree(p, mesh,
+                                                        state_extra)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# 3. roofline verdict
+# ---------------------------------------------------------------------------
+@dataclass
+class RooflineReport:
+    """The per-step bottleneck verdict.
+
+    ``seconds[r]`` is the analytic floor each resource needs for one
+    step (compute: FLOPs/peak; hbm: traffic/BW; ici: measured exposed
+    comm, else wire-bytes/BW). ``bound`` names the largest —
+    compute-bound / hbm-bound / ici-bound — or "unknown" when every
+    peak is unknown (CPU). ``headroom_pct[r]`` = 100 x (1 - t_r /
+    t_bound): 0 for the binding resource, how far the others sit below
+    it. ``util_pct[r]`` = 100 x t_r / step_seconds when a measured
+    step time is known (how much of the real step each floor explains;
+    the gap to 100 across ALL resources is dispatch/bubble overhead).
+    """
+
+    program: str = ""
+    step_seconds: float = 0.0
+    seconds: Dict[str, float] = field(default_factory=dict)
+    bound: str = "unknown"
+    headroom_pct: Dict[str, float] = field(default_factory=dict)
+    util_pct: Dict[str, float] = field(default_factory=dict)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "program": self.program,
+            "bound": self.bound,
+            "step_seconds": round(self.step_seconds, 6),
+            "seconds": {k: round(v, 6) for k, v in self.seconds.items()},
+            "headroom_pct": {k: round(v, 2)
+                             for k, v in self.headroom_pct.items()},
+            "util_pct": {k: round(v, 2)
+                         for k, v in self.util_pct.items()},
+        }
+
+
+def roofline(*, step_seconds: float, flops_per_step: float,
+             hbm_traffic_bytes: float, wire_bytes: float = 0.0,
+             device=None, exposed_ici_seconds: Optional[float] = None,
+             program: str = "") -> RooflineReport:
+    """Assemble the roofline verdict from per-chip quantities:
+    ``flops_per_step`` / ``hbm_traffic_bytes`` / ``wire_bytes`` are
+    one chip's share (the comm ledger's per-participant convention);
+    ``exposed_ici_seconds`` is the measured exposed-comm total when a
+    profile_exposed_comm report exists (preferred over the analytic
+    wire floor, which assumes zero overlap credit)."""
+    from . import flops as _flops
+
+    peak, hbm_bw = _flops.peak_flops_per_chip(device) if device \
+        is not None else (0.0, 0.0)
+    ici_bw = _flops.ici_bytes_per_sec(device) if device is not None \
+        else 0.0
+    t = {
+        "compute": (flops_per_step / peak) if peak > 0 else 0.0,
+        "hbm": (hbm_traffic_bytes / hbm_bw) if hbm_bw > 0 else 0.0,
+        "ici": (float(exposed_ici_seconds)
+                if exposed_ici_seconds is not None
+                else ((wire_bytes / ici_bw) if ici_bw > 0 else 0.0)),
+    }
+    t = {k: max(v, 0.0) for k, v in t.items()}
+    rep = RooflineReport(program=program,
+                         step_seconds=max(float(step_seconds), 0.0),
+                         seconds=t)
+    # a verdict needs the chip's peak tables: on CPU (all peaks
+    # unknown) one measured ici term must not be crowned "the bound"
+    # over floors that are simply unknowable — stay "unknown"
+    peaks_known = peak > 0 or hbm_bw > 0 or ici_bw > 0
+    t_bound = max(t.values())
+    if peaks_known and t_bound > 0:
+        rep.bound = next(r for r in RESOURCES if t[r] == t_bound) \
+            + "-bound"
+        rep.headroom_pct = {r: 100.0 * (1.0 - t[r] / t_bound)
+                            for r in RESOURCES}
+    else:
+        rep.headroom_pct = {r: 0.0 for r in RESOURCES}
+    if rep.step_seconds > 0:
+        rep.util_pct = {r: 100.0 * t[r] / rep.step_seconds
+                        for r in RESOURCES}
+    return rep
+
+
+# ---------------------------------------------------------------------------
+# live-bytes watermark + page-pool sizing
+# ---------------------------------------------------------------------------
+def live_bytes() -> int:
+    """Total device bytes held by live ``jax.Array``s in this process
+    (every array's shard size times its addressable-device count) —
+    the step-boundary watermark source on backends without
+    ``memory_stats`` (the CPU harness). Best-effort: 0 on failure."""
+    try:
+        import jax
+
+        total = 0
+        for a in jax.live_arrays():
+            sh = getattr(a, "sharding", None)
+            n_dev = len(sh.addressable_devices) if sh is not None else 1
+            total += shard_bytes(a) * n_dev
+        return int(total)
+    except Exception:
+        return 0
+
+
+def suggest_pool_pages(device, page_bytes: int, reserved_bytes: int,
+                       margin: float = 0.1) -> Optional[int]:
+    """Size a serving KV page pool from measured HBM headroom:
+    ``(bytes_limit x (1 - margin) - reserved_bytes) / page_bytes``
+    pages, where ``reserved_bytes`` is what the model already holds
+    (params; ``account_engine``-style shard bytes). Returns ``None``
+    when the backend exposes no ``bytes_limit`` (CPU) or nothing fits
+    — the caller falls back to its geometric default."""
+    if page_bytes <= 0:
+        return None
+    try:
+        stats = device.memory_stats() or {}
+    except Exception:
+        return None
+    limit = int(stats.get("bytes_limit", 0))
+    if limit <= 0:
+        return None
+    usable = int(limit * (1.0 - margin)) - int(reserved_bytes)
+    if usable < page_bytes:
+        return None
+    return int(usable // page_bytes)

@@ -217,6 +217,10 @@ class MetricsRegistry:
         self._lock = threading.Lock()
         self._metrics: Dict[str, _Metric] = {}
         self._flight = None          # set by flight.attach()
+        # wall-clock of the last snapshot(): the engines snapshot once
+        # per step/tick, so its age distinguishes a hung process from
+        # an idle one (the /healthz payload, exporter.py)
+        self._last_snapshot_ts: Optional[float] = None
 
     # -- registration ---------------------------------------------------
     def _register(self, cls, name, help, labelnames, unit, **kw):
@@ -255,11 +259,13 @@ class MetricsRegistry:
                               buckets=buckets)
 
     # -- export ---------------------------------------------------------
-    def snapshot(self) -> Dict[str, Any]:
+    def snapshot(self, touch: bool = True) -> Dict[str, Any]:
         """Plain-dict view of every series (the in-process API).
 
         Also appended to the attached flight recorder's ring, so any
         code path that snapshots keeps the stall flight-record fresh.
+        ``touch=False`` (the scrape path) skips the liveness timestamp
+        so an external scraper's own reads never mask a hung engine.
         """
         out: Dict[str, Any] = {"ts": time.time(), "metrics": {}}
         with self._lock:
@@ -293,7 +299,16 @@ class MetricsRegistry:
                     row[f"p{q}"] = m.percentile(q, **row["labels"])
         if self._flight is not None:
             self._flight.push(out)
+        if touch:
+            self._last_snapshot_ts = out["ts"]
         return out
+
+    def snapshot_age_seconds(self) -> Optional[float]:
+        """Seconds since the last snapshot() on this registry, or None
+        before the first one — the /healthz liveness signal (an engine
+        ticking keeps this fresh; a hung step lets it grow)."""
+        ts = self._last_snapshot_ts
+        return None if ts is None else max(time.time() - ts, 0.0)
 
     def schema(self) -> Dict[str, Any]:
         """{name: spec} for every registered metric — compared against
@@ -304,7 +319,7 @@ class MetricsRegistry:
 
     def prometheus_text(self) -> str:
         """Prometheus text exposition of the current state."""
-        snap = self.snapshot()
+        snap = self.snapshot(touch=False)
         lines: List[str] = []
         for name, entry in sorted(snap["metrics"].items()):
             if entry["help"]:

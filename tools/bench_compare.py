@@ -49,7 +49,15 @@ _EXACT = {"pallas_kernel_parity_interpret": 1.0,
           "gpt_moe_hybrid_loss_parity": 1.0,
           # comm_overlap (bucketed grad sync) vs unbucketed on the same
           # program: bit-exact coalescing, <= 1e-5 gated — never drifts
-          "gpt13b_hybrid_overlap_loss_parity": 1.0}
+          "gpt13b_hybrid_overlap_loss_parity": 1.0,
+          # memory ledger: measured state accounting (shard_shape path)
+          # == closed form (global shape / sharding degree), byte-for-
+          # byte incl. ZeRO-2 scattered state + pp x vpp chunks
+          # (observability/memledger.py) — exact on the CPU smoke
+          "gpt13b_hybrid_mem_state_parity": 1.0,
+          # serving KV pool: measured pool array bytes == page_bytes x
+          # pool_pages closed form — exact everywhere
+          "serving_mem_pool_parity": 1.0}
 # per-metric relative thresholds overriding the CLI default (CPU smoke
 # lines are noisy; recompile counts are exact)
 _THRESHOLDS = {
@@ -62,6 +70,12 @@ _THRESHOLDS = {
     # load; only a sustained blow-up should flag (on chip the exposed
     # tail is the headline, tracked by the trajectory table)
     "gpt13b_hybrid_grad_sync_exposed_seconds": 2.0,
+    # roofline HBM headroom (direction-aware: HIGHER is better — the
+    # default direction — falling headroom means the config is walking
+    # into the memory wall). 0 on CPU where peaks are unknown; on chip
+    # batch/pool retunes legitimately move it, so gate loosely and let
+    # tools/step_report.py's trajectory carry the narrative
+    "gpt13b_hybrid_hbm_headroom_pct": 0.5,
 }
 # line kinds that are status reports, not comparable measurements
 _SKIP_UNITS = {"error", "needs_chips", "skipped", "ok"}

@@ -1,0 +1,192 @@
+"""step_report: render the roofline verdicts + memory attribution of
+every bench round as one trajectory table.
+
+bench.py lines now carry a ``memory`` section (per-executable byte
+classes from XLA's memory_analysis + the measured model-state
+accounting with its analytic drift, observability/memledger.py) and a
+``roofline`` verdict (compute-bound / hbm-bound / ici-bound with
+per-resource headroom percentages). This tool joins them across the
+driver's ``BENCH_r<NN>.json`` snapshots — the longitudinal view
+``tools/bench_compare.py`` gives throughput numbers, for bottlenecks:
+
+- **verdict table** (newest round): per bench line, the bound, the
+  per-resource floor seconds, headroom percentages, and the measured
+  step time they explain,
+- **memory table** (newest round): per bench line, the executable's
+  temp/argument/output bytes, the state-accounting components, and
+  the analytic-vs-measured drift,
+- **verdict trajectory**: one letter per round (C/H/I/?, for
+  compute/hbm/ici/unknown) per metric, so a config drifting toward
+  the memory wall is visible across rounds even while tokens/s holds.
+
+Usage::
+
+    python -m tools.step_report [--dir REPO] [--json]
+
+Exit codes mirror bench_compare: 0 on success, 2 when no BENCH_r*.json
+rounds exist. The tool only reads; it never gates (bench_compare owns
+regression verdicts — the memory/roofline metric lines are registered
+there).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+from tools.bench_compare import load_rounds, parse_metrics
+
+__all__ = ["roofline_rows", "memory_rows", "verdict_trajectory", "main"]
+
+_BOUND_LETTER = {"compute-bound": "C", "hbm-bound": "H",
+                 "ici-bound": "I", "unknown": "?"}
+
+
+def _mb(v: Optional[float]) -> str:
+    if v is None:
+        return "-"
+    return f"{v / 1e6:.2f}M"
+
+
+def roofline_rows(metrics: Dict[str, Dict[str, Any]]
+                  ) -> List[Dict[str, Any]]:
+    """Per bench line carrying a ``roofline`` section: the verdict and
+    its per-resource floors/headrooms, flattened for the table."""
+    rows = []
+    for name, line in sorted(metrics.items()):
+        roof = line.get("roofline")
+        if not isinstance(roof, dict):
+            continue
+        rows.append({
+            "metric": name,
+            "bound": roof.get("bound", "unknown"),
+            "step_seconds": roof.get("step_seconds", 0.0),
+            "seconds": dict(roof.get("seconds", {})),
+            "headroom_pct": dict(roof.get("headroom_pct", {})),
+            "util_pct": dict(roof.get("util_pct", {})),
+        })
+    return rows
+
+
+def memory_rows(metrics: Dict[str, Dict[str, Any]]
+                ) -> List[Dict[str, Any]]:
+    """Per bench line carrying a ``memory`` section: executable byte
+    classes (the single-program form AND the serving multi-executable
+    form) + the state accounting."""
+    rows = []
+    for name, line in sorted(metrics.items()):
+        mem = line.get("memory")
+        if not isinstance(mem, dict):
+            continue
+        execs: Dict[str, Dict[str, Any]] = {}
+        if isinstance(mem.get("executable"), dict) and mem["executable"]:
+            execs[mem["executable"].get("program", "program")] = \
+                mem["executable"]
+        for prog, led in (mem.get("executables") or {}).items():
+            execs[prog] = led
+        state = mem.get("state", {})
+        comps = state.get("components", state)
+        rows.append({
+            "metric": name,
+            "executables": {
+                prog: {k: led.get(k) for k in
+                       ("temp_bytes", "argument_bytes", "output_bytes",
+                        "alias_bytes", "peak_bytes")}
+                for prog, led in sorted(execs.items())},
+            "state": {k: v for k, v in comps.items()
+                      if isinstance(v, (int, float))},
+            "analytic_drift": state.get("analytic_drift",
+                                        mem.get("analytic_drift")),
+        })
+    return rows
+
+
+def verdict_trajectory(rounds: List[Tuple[int, str]]
+                       ) -> Dict[str, List[str]]:
+    """{metric: [bound letter per round]} over every line that ever
+    carried a roofline section ('-' where the round lacks it)."""
+    parsed = [(n, parse_metrics(tail)) for n, tail in rounds]
+    names = sorted({m for _, p in parsed for m, line in p.items()
+                    if isinstance(line.get("roofline"), dict)})
+    out: Dict[str, List[str]] = {}
+    for name in names:
+        letters = []
+        for _, p in parsed:
+            roof = (p.get(name) or {}).get("roofline")
+            letters.append(_BOUND_LETTER.get(
+                (roof or {}).get("bound", "unknown"), "?")
+                if isinstance(roof, dict) else "-")
+        out[name] = letters
+    return out
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="step_report",
+                                 description=__doc__.splitlines()[0])
+    ap.add_argument("--dir", default=".",
+                    help="directory holding BENCH_r*.json (default .)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit the report as one JSON doc")
+    args = ap.parse_args(argv)
+
+    rounds = load_rounds(args.dir)
+    if not rounds:
+        print(f"step_report: no BENCH_r*.json under {args.dir!r}",
+              file=sys.stderr)
+        return 2
+    n_new, tail = rounds[-1]
+    metrics = parse_metrics(tail)
+    roof = roofline_rows(metrics)
+    mem = memory_rows(metrics)
+    traj = verdict_trajectory(rounds)
+
+    if args.as_json:
+        print(json.dumps({"round": n_new, "roofline": roof,
+                          "memory": mem,
+                          "verdict_trajectory": traj,
+                          "rounds": [n for n, _ in rounds]}, indent=1))
+        return 0
+
+    print(f"step_report: round r{n_new:02d}")
+    if not roof and not mem:
+        print("  (no memory/roofline sections in this round — rerun "
+              "bench.py with the memory ledger on)")
+    if roof:
+        width = max(len(r["metric"]) for r in roof)
+        print("\nroofline verdicts "
+              "(floor seconds | headroom% compute/hbm/ici)")
+        for r in roof:
+            s, h = r["seconds"], r["headroom_pct"]
+            print(f"  {r['metric']:<{width}} {r['bound']:>13}  "
+                  f"step {r['step_seconds']:.4g}s  "
+                  f"c {s.get('compute', 0):.3g}s/{h.get('compute', 0):.0f}% "
+                  f"h {s.get('hbm', 0):.3g}s/{h.get('hbm', 0):.0f}% "
+                  f"i {s.get('ici', 0):.3g}s/{h.get('ici', 0):.0f}%")
+    if mem:
+        print("\nmemory (per-executable + state accounting)")
+        for r in mem:
+            print(f"  {r['metric']}")
+            for prog, led in r["executables"].items():
+                print(f"    [{prog}] temp {_mb(led.get('temp_bytes'))} "
+                      f"arg {_mb(led.get('argument_bytes'))} "
+                      f"out {_mb(led.get('output_bytes'))} "
+                      f"peak {_mb(led.get('peak_bytes'))}")
+            if r["state"]:
+                comps = " ".join(f"{k} {_mb(v)}"
+                                 for k, v in sorted(r["state"].items()))
+                print(f"    state: {comps}")
+            if r.get("analytic_drift") is not None:
+                print(f"    analytic drift: {r['analytic_drift']:+.2%}")
+    if traj:
+        print("\nverdict trajectory "
+              f"({', '.join(f'r{n:02d}' for n, _ in rounds)}; "
+              "C=compute H=hbm I=ici ?=unknown -=absent)")
+        width = max(len(m) for m in traj)
+        for name, letters in traj.items():
+            print(f"  {name:<{width}} {' '.join(letters)}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
