@@ -53,7 +53,15 @@ def set_rng_state(key) -> None:
 
 @contextlib.contextmanager
 def fork_traced(seed_tensor):
-    """Temporarily derive all randomness from a traced seed (for jitted steps)."""
+    """Temporarily derive all randomness from a traced seed (for jitted steps).
+
+    The tracker's dropout-site counter restarts at 0 for the traced
+    region: site numbering must be a pure function of the PROGRAM (site
+    0..K in trace order), not of how many traces ran before in this
+    process — otherwise retracing the same step (or compiling a fresh
+    engine after restore_checkpoint) would bake different fold
+    constants and silently change every dropout mask. Exact resume
+    (tests/test_fault_tolerance.py) pins this."""
     from ..tensor import Tensor
 
     if isinstance(seed_tensor, Tensor):
@@ -63,11 +71,15 @@ def fork_traced(seed_tensor):
     prev_traced = getattr(_state, "traced_seed", None)
     _state.key = jax.random.key(seed_val)
     _state.traced_seed = seed_val
+    tracker = get_rng_tracker()
+    prev_counter = tracker._entry_counter
+    tracker._entry_counter = 0
     try:
         yield
     finally:
         _state.key = prev
         _state.traced_seed = prev_traced
+        tracker._entry_counter = prev_counter
 
 
 def traced_seed():

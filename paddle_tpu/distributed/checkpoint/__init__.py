@@ -4,10 +4,23 @@
 per-rank shard files + global metadata after cross-rank dedup;
 load_state_dict.py reshards on load; metadata.py LocalTensorMetadata /
 LocalTensorIndex.)
+
+Crash consistency added on top of the reference surface: atomic commit
+protocol (tmp + fsync + per-shard crc32 + COMMIT marker + rename),
+loader that refuses uncommitted/corrupt directories, an async save
+path, and a rolling :class:`CheckpointManager` with newest-committed
+fallback (`latest_committed`) — see save_state_dict.py / manager.py.
 """
 from .metadata import LocalTensorIndex, LocalTensorMetadata, Metadata  # noqa: F401
-from .save_state_dict import save_state_dict  # noqa: F401
-from .load_state_dict import load_state_dict  # noqa: F401
+from .save_state_dict import (save_state_dict, wait_async_saves,  # noqa: F401
+                              COMMIT_MARKER)
+from .load_state_dict import (load_state_dict, is_committed,  # noqa: F401
+                              resolve_committed, CheckpointCorruptError)
+from .manager import (CheckpointManager, latest_committed,  # noqa: F401
+                      read_extra_meta)
 
 __all__ = ["save_state_dict", "load_state_dict", "Metadata",
-           "LocalTensorMetadata", "LocalTensorIndex"]
+           "LocalTensorMetadata", "LocalTensorIndex", "CheckpointManager",
+           "latest_committed", "read_extra_meta", "is_committed",
+           "resolve_committed", "CheckpointCorruptError",
+           "wait_async_saves", "COMMIT_MARKER"]

@@ -1,4 +1,4 @@
-"""Sharded checkpoint load with reshard-on-load.
+"""Sharded checkpoint load with reshard-on-load and commit verification.
 
 (reference: distributed/checkpoint/load_state_dict.py — computes the
 overlap between stored shards and the target distribution, point-to-point
@@ -11,14 +11,23 @@ opened lazily only when one of their shards is actually needed. Host
 bytes per process are therefore O(addressable shards + touched files),
 not O(model) — the reshard across any source/target dp/mp/pp/sharding
 layout falls out of the window/shard overlap arithmetic.
+
+Crash consistency: the loader REFUSES a directory without the ``COMMIT``
+marker the writer cuts last (a crash mid-save can never be read back),
+probing ``<path>``, then a committed ``<path>.tmp`` / ``<path>.old``
+(mid-rename crash windows). Every storage file is checksum-verified
+against the metadata's per-shard crc32 on first open; a mismatch (or an
+unparseable npz — torn write) raises :class:`CheckpointCorruptError`
+instead of silently loading garbage. Newest-committed *fallback across
+checkpoints* lives in ``manager.latest_committed``.
 """
 from __future__ import annotations
 
 import glob
 import json
 import os
-import pickle
-from typing import Dict
+import zlib
+from typing import Dict, Optional
 
 import jax
 import numpy as np
@@ -27,8 +36,33 @@ import jax.numpy as jnp
 from ...core.enforce import enforce
 from ...tensor import Tensor
 from .metadata import Metadata
+from .save_state_dict import COMMIT_MARKER, OLD_SUFFIX, TMP_SUFFIX
 
-__all__ = ["load_state_dict"]
+__all__ = ["load_state_dict", "is_committed", "resolve_committed",
+           "CheckpointCorruptError"]
+
+
+class CheckpointCorruptError(RuntimeError):
+    """A committed checkpoint failed verification (checksum mismatch or
+    unreadable shard archive) — fall back to an older committed one."""
+
+
+def is_committed(path: str) -> bool:
+    """Whether ``path`` is a fully-committed checkpoint directory (the
+    writer's COMMIT marker plus a metadata file exist)."""
+    return (os.path.isdir(path)
+            and os.path.isfile(os.path.join(path, COMMIT_MARKER))
+            and bool(glob.glob(os.path.join(path, "*.metadata"))))
+
+
+def resolve_committed(path: str) -> Optional[str]:
+    """The committed directory to read for ``path``: the path itself,
+    else a committed ``.tmp``/``.old`` sibling left by a crash between
+    the COMMIT marker and the final rename."""
+    for cand in (path, path + TMP_SUFFIX, path + OLD_SUFFIX):
+        if is_committed(cand):
+            return cand
+    return None
 
 
 def _flatten(state: Dict, prefix=""):
@@ -42,18 +76,50 @@ def _flatten(state: Dict, prefix=""):
     return out
 
 
+def _as_dtype(arr: np.ndarray, dtype: str) -> np.ndarray:
+    """Reinterpret an npz member with the metadata's dtype (np.savez
+    round-trips ml_dtypes like bfloat16 as void records)."""
+    want = np.dtype(dtype)
+    if arr.dtype == want:
+        return arr
+    return arr.view(want) if arr.dtype.itemsize == want.itemsize \
+        else arr.astype(want)
+
+
 class _LazyStorages:
     """Opens .distcp files on first use (a process only pays for the
-    files whose shards overlap its windows)."""
+    files whose shards overlap its windows) and verifies every member's
+    crc32 against the metadata before any shard is handed out."""
 
-    def __init__(self, path: str):
+    def __init__(self, path: str, md: Metadata):
         self._path = path
+        self._md = md
         self._cache: Dict[str, Dict] = {}
 
     def get(self, fname: str):
         if fname not in self._cache:
-            with open(os.path.join(self._path, fname), "rb") as f:
-                self._cache[fname] = pickle.load(f)
+            full = os.path.join(self._path, fname)
+            try:
+                with np.load(full, allow_pickle=False) as z:
+                    data = {k: z[k] for k in z.files}
+            except Exception as e:
+                raise CheckpointCorruptError(
+                    f"checkpoint shard file {full!r} is unreadable "
+                    f"({e}) — torn write or corruption; fall back to "
+                    "an older committed checkpoint") from None
+            sums = self._md.checksums
+            for sk, arr in data.items():
+                want = sums.get(sk)
+                if want is None:
+                    continue        # pre-checksum writer
+                got = zlib.crc32(np.ascontiguousarray(arr).tobytes())
+                if got != want:
+                    raise CheckpointCorruptError(
+                        f"checksum mismatch for shard {sk!r} in "
+                        f"{full!r} (crc32 {got:#010x} != recorded "
+                        f"{want:#010x}) — refusing the corrupt "
+                        "checkpoint")
+            self._cache[fname] = data
         return self._cache[fname]
 
 
@@ -62,7 +128,7 @@ def _window(md, storages, key, metas, gshape, dtype, sl):
     shards overlapping it."""
     shape = tuple(s.indices(d)[1] - s.indices(d)[0]
                   for s, d in zip(sl, gshape))
-    out = np.zeros(shape, dtype=dtype)
+    out = np.zeros(shape, dtype=np.dtype(dtype))
     starts = tuple(s.indices(d)[0] for s, d in zip(sl, gshape))
     stops = tuple(s.indices(d)[1] for s, d in zip(sl, gshape))
     for m in metas:
@@ -73,6 +139,7 @@ def _window(md, storages, key, metas, gshape, dtype, sl):
             continue  # no overlap with this stored shard
         sk = f"{key}@" + "_".join(str(o) for o in m.global_offset)
         data = storages.get(md.storage_metadata[sk])[sk]
+        data = _as_dtype(data, m.dtype).reshape(m.local_shape)
         src = tuple(slice(l - o, h - o) for l, h, o in
                     zip(lo, hi, m.global_offset))
         dst = tuple(slice(l - a, h - a) for l, h, a in zip(lo, hi, starts))
@@ -84,12 +151,21 @@ def load_state_dict(state_dict: Dict, path: str, process_group=None,
                     coordinator_rank: int = 0, unique_id=None,
                     offload: bool = False) -> None:
     """Fill ``state_dict``'s tensors in place from the checkpoint at
-    ``path``, resharding stored shards to each tensor's current layout."""
+    ``path``, resharding stored shards to each tensor's current layout.
+    Refuses uncommitted directories; verifies shard checksums."""
+    resolved = resolve_committed(path)
+    enforce(resolved is not None,
+            f"no committed checkpoint at {path!r}: the COMMIT marker "
+            "the atomic writer cuts last is missing (crash mid-save, "
+            "pre-commit-protocol directory, or wrong path). Use "
+            "checkpoint.manager.latest_committed(base) to fall back to "
+            "the newest committed checkpoint")
+    path = resolved
     meta_files = glob.glob(os.path.join(path, "*.metadata"))
     enforce(meta_files, f"no .metadata file under {path!r}")
     with open(meta_files[0]) as f:
         md = Metadata.from_json(json.load(f))
-    storages = _LazyStorages(path)
+    storages = _LazyStorages(path, md)
 
     flat = _flatten(state_dict)
     for key, (owner, k, cur) in flat.items():

@@ -43,11 +43,14 @@ class LocalTensorIndex:
 class Metadata:
     """state_dict_metadata: key → list of shard metadata;
     storage_metadata: storage_key → data file name;
-    global_shape: key → full shape."""
+    global_shape: key → full shape;
+    checksums: storage_key → crc32 of the shard's raw bytes (computed at
+    snapshot time, verified by the loader before any shard is used)."""
     state_dict_metadata: Dict[str, List[LocalTensorMetadata]] = \
         field(default_factory=dict)
     storage_metadata: Dict[str, str] = field(default_factory=dict)
     global_shape: Dict[str, List[int]] = field(default_factory=dict)
+    checksums: Dict[str, int] = field(default_factory=dict)
 
     def to_json(self):
         return {
@@ -56,6 +59,7 @@ class Metadata:
                 for k, v in self.state_dict_metadata.items()},
             "storage_metadata": self.storage_metadata,
             "global_shape": self.global_shape,
+            "checksums": self.checksums,
         }
 
     @staticmethod
@@ -66,4 +70,6 @@ class Metadata:
             for k, v in d["state_dict_metadata"].items()}
         md.storage_metadata = d["storage_metadata"]
         md.global_shape = d.get("global_shape", {})
+        md.checksums = {k: int(v)
+                        for k, v in d.get("checksums", {}).items()}
         return md

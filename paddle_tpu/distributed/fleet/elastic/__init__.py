@@ -1,5 +1,8 @@
 from .manager import ElasticManager, ElasticStatus  # noqa: F401
-from .resume import load_train_state, save_train_state  # noqa: F401
+from .resume import (load_train_state, save_train_state,  # noqa: F401
+                     resume_latest, train_with_recovery,
+                     RESTART_EXIT_CODE)
 
 __all__ = ["ElasticManager", "ElasticStatus", "save_train_state",
-           "load_train_state"]
+           "load_train_state", "resume_latest", "train_with_recovery",
+           "RESTART_EXIT_CODE"]

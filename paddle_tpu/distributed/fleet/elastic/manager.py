@@ -10,12 +10,14 @@ thread ages them.)
 from __future__ import annotations
 
 import enum
-import json
+import logging
 import threading
 import time
-from typing import Callable, Dict, List, Optional
+from typing import Callable, List, Optional
 
 __all__ = ["ElasticManager", "ElasticStatus"]
+
+logger = logging.getLogger("paddle_tpu.elastic")
 
 
 class ElasticStatus(enum.Enum):
@@ -70,7 +72,18 @@ class ElasticManager:
             try:
                 self.store.set(self._node_key(self.rank),
                                str(time.time()))
-            except Exception:
+            except Exception as e:
+                # a dead store means THIS node now looks dead to every
+                # peer while still running — surface it loudly (status
+                # ERROR flips restart_needed) instead of silently
+                # letting the pod split-brain
+                if not self._stop.is_set():
+                    self.status = ElasticStatus.ERROR
+                    logger.error(
+                        "elastic heartbeat for rank %d failed (%s: %s); "
+                        "peers will see this node as dead — flagging "
+                        "ERROR for the recovery loop", self.rank,
+                        type(e).__name__, e)
                 return
 
     # -- watching -------------------------------------------------------
@@ -96,6 +109,8 @@ class ElasticManager:
                 self._last_world = world
                 continue
             if world != self._last_world:
+                logger.warning("elastic world changed: %s -> %s",
+                               self._last_world, world)
                 self._last_world = world
                 self.status = ElasticStatus.RESTART
                 if self.on_world_change:
@@ -103,7 +118,19 @@ class ElasticManager:
 
     @property
     def restart_needed(self) -> bool:
-        return self.status == ElasticStatus.RESTART
+        """True when recovery must run: a peer changed the world
+        (RESTART) or this node's own heartbeat died (ERROR — peers
+        already consider us gone)."""
+        return self.status in (ElasticStatus.RESTART, ElasticStatus.ERROR)
+
+    def ack_world_change(self):
+        """Acknowledge a handled RESTART so the manager is reusable
+        (e.g. the driver decided the new world is acceptable and
+        continues instead of relaunching); the watcher keeps comparing
+        against the latest world. ERROR is sticky — a node whose own
+        heartbeat died cannot talk itself back to health."""
+        if self.status == ElasticStatus.RESTART:
+            self.status = ElasticStatus.HOLD
 
     def wait_restart(self, timeout: float = 60.0) -> bool:
         """Block until the watcher flags a world change (survivor-side

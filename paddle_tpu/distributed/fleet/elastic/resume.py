@@ -7,87 +7,226 @@ job with the new world; training resumes from the last checkpoint.)
 TPU-native flow: a live jax runtime cannot resize, so recovery is
 restart-shaped by design —
 
-1. every rank periodically calls :func:`save_train_state` (the sharded
-   distributed checkpoint: each process writes only its addressable
-   shards, see checkpoint/save_state_dict.py);
+1. every rank periodically checkpoints (the atomic sharded distributed
+   checkpoint: each process writes only its addressable shards, the
+   commit protocol guarantees a crash mid-save can never be read back —
+   see checkpoint/save_state_dict.py);
 2. the :class:`ElasticManager` heartbeat watcher detects the world
-   change; survivors stop stepping (``wait_restart``) and exit with a
-   restart code for the launcher;
+   change (or the watchdog detects a hung collective); survivors stop
+   stepping, dump a flight record, and exit with
+   :data:`RESTART_EXIT_CODE` for the launcher — the
+   :func:`train_with_recovery` loop wires all three signals;
 3. the relaunched job — ANY new world size/mesh — calls
-   :func:`load_train_state`: reshard-on-load reassembles each tensor's
-   addressable windows from the old layout's shards, the optimizer
-   moments included, and training continues from the recorded step.
+   :func:`resume_latest`: the NEWEST COMMITTED checkpoint is found by a
+   pure directory scan (uncommitted/corrupt dirs are skipped by
+   construction), reshard-on-load reassembles each tensor's addressable
+   windows from the old layout's shards, the optimizer moments
+   included, and training continues from the recorded step.
 """
 from __future__ import annotations
 
-import json
-import os
-from typing import Any, Dict, Optional
+from typing import Any, Callable, Dict, Optional, Tuple
 
 import jax
 
-from ...checkpoint import load_state_dict, save_state_dict
+from ...checkpoint import (latest_committed, load_state_dict,
+                           read_extra_meta, resolve_committed,
+                           save_state_dict)
 
-__all__ = ["save_train_state", "load_train_state"]
+__all__ = ["save_train_state", "load_train_state", "resume_latest",
+           "train_with_recovery", "opt_state_tensors",
+           "RESTART_EXIT_CODE"]
 
-_META = "train_meta.json"
+# the exit code a survivor returns so the launcher relaunches instead of
+# declaring the job failed (reference: elastic manager's restart signal)
+RESTART_EXIT_CODE = 3
+
+
+def opt_state_tensors(model, optimizer):
+    """Optimizer state (array slots + master weights) as checkpoint
+    tensors keyed by the MODEL's structured parameter names.
+
+    Auto-generated parameter names (``linear_7.w_0``) come from a
+    process-global counter, so a rebuilt model in the same process gets
+    DIFFERENT names and a p.name-keyed checkpoint silently fails to
+    fill its moments. Structured names (``layers.3.fc1.weight``) are a
+    function of the module tree alone — stable across rebuilds,
+    processes, and topologies.
+
+    Returns ``(slots, tensors)``: ``slots[key] = (param, slot_name)``
+    for writing loaded values back, ``tensors[key] = Tensor(value)``
+    as the save source / load target.
+    """
+    from ....tensor import Tensor
+
+    name_of = {id(p): n for n, p in model.named_parameters()}
+    slots: Dict[str, Any] = {}
+    tensors: Dict[str, Any] = {}
+    for i, p in enumerate(optimizer._parameter_list or []):
+        name = name_of.get(id(p)) or p.name or f"param_{i}"
+        st = optimizer._states.get(id(p)) or {}
+        for k, v in st.items():
+            if not hasattr(v, "shape"):
+                continue
+            slots[f"{name}.{k}"] = (p, k)
+            tensors[f"{name}.{k}"] = Tensor(v)
+        mw = optimizer._master_weights.get(id(p))
+        if mw is not None:
+            slots[f"{name}.master_weight"] = (p, "master_weight")
+            tensors[f"{name}.master_weight"] = Tensor(mw)
+    return slots, tensors
+
+
+def _apply_opt_state(optimizer, slots, tensors) -> None:
+    """Write loaded checkpoint tensors back into the optimizer."""
+    import jax.numpy as jnp
+
+    for key, (p, k) in slots.items():
+        v = tensors[key]._value
+        if k == "master_weight":
+            optimizer._master_weights[id(p)] = v.astype(jnp.float32)
+        else:
+            optimizer._states[id(p)][k] = v
 
 
 def save_train_state(path: str, model, optimizer=None, step: int = 0,
-                     extra: Optional[Dict[str, Any]] = None) -> None:
-    """Sharded save of model (+ optimizer moments) + scalar metadata."""
+                     extra: Optional[Dict[str, Any]] = None,
+                     async_save: bool = False) -> None:
+    """Sharded save of model (+ optimizer moments) + scalar metadata.
+
+    The metadata commits atomically WITH the shards (inside the tmp →
+    COMMIT → rename unit), so a crash can never leave tensors from one
+    save next to counters from another."""
+    from ....optimizer.lr import LRScheduler
+
     state = {"model": model.state_dict()}
     meta: Dict[str, Any] = {"step": int(step)}
     if optimizer is not None:
-        osd = optimizer.state_dict()
-        meta["opt_step_count"] = int(osd.pop("step_count", 0))
-        lrs = osd.pop("LR_Scheduler", None)
-        if lrs is not None:
-            meta["lr_scheduler"] = lrs
-        state["optim"] = osd
+        meta["opt_step_count"] = int(optimizer._step_count)
+        if isinstance(optimizer._lr, LRScheduler):
+            meta["lr_scheduler"] = optimizer._lr.state_dict()
+        _, tensors = opt_state_tensors(model, optimizer)
+        if tensors:
+            state["optim"] = tensors
     if extra:
         meta.update(extra)
-    save_state_dict(state, path)
-    if jax.process_index() == 0:
-        with open(os.path.join(path, _META), "w") as f:
-            json.dump(meta, f)
+    save_state_dict(state, path, async_save=async_save, extra_meta=meta)
 
 
 def load_train_state(path: str, model, optimizer=None) -> Dict[str, Any]:
     """Fill model/optimizer from the checkpoint, resharding to the NEW
     world's layout; returns the metadata (incl. ``step``)."""
-    with open(os.path.join(path, _META)) as f:
-        meta = json.load(f)
+    from ....core.enforce import enforce
+
+    resolved = resolve_committed(path)
+    enforce(resolved is not None,
+            f"no committed checkpoint at {path!r} (resume_latest(base) "
+            "falls back to the newest committed one)")
+    meta = read_extra_meta(resolved)
     # phase 1: model params FIRST — any optimizer state materialized
     # below (fresh multi-precision masters) must copy the LOADED
     # weights, never the pre-load random init
     model_t = {"model": model.state_dict()}
-    load_state_dict(model_t, path)
+    load_state_dict(model_t, resolved)
     model.set_state_dict(model_t["model"])
     if optimizer is None:
         return meta
 
-    osd = optimizer.state_dict()
-    osd.pop("step_count", None)
-    osd.pop("LR_Scheduler", None)
-    if not osd:
-        # moments not materialized yet (fresh optimizer): allocate them
-        # so the load has shaped targets to fill
-        shapes = optimizer._state_shapes()
-        if shapes:
-            for p in optimizer._parameter_list:
-                optimizer._param_state(p, shapes)
-            osd = optimizer.state_dict()
-            osd.pop("step_count", None)
-            osd.pop("LR_Scheduler", None)
-    if osd:
-        targets = {"optim": osd}
-        load_state_dict(targets, path)
-        filled = dict(targets["optim"])
-    else:
-        filled = {}
-    filled["step_count"] = meta.get("opt_step_count", meta["step"])
-    if "lr_scheduler" in meta:
-        filled["LR_Scheduler"] = meta["lr_scheduler"]
-    optimizer.set_state_dict(filled)
+    from ....optimizer.lr import LRScheduler
+
+    # moments not materialized yet (fresh optimizer): allocate them so
+    # the load has shaped targets to fill (AFTER the param load above —
+    # fresh multi-precision masters must copy the LOADED weights)
+    shapes = optimizer._state_shapes()
+    if shapes:
+        for p in optimizer._parameter_list:
+            optimizer._param_state(p, shapes)
+    slots, tensors = opt_state_tensors(model, optimizer)
+    if tensors:
+        load_state_dict({"optim": tensors}, resolved)
+        _apply_opt_state(optimizer, slots, tensors)
+    optimizer._step_count = int(meta.get("opt_step_count",
+                                         meta["step"]))
+    if "lr_scheduler" in meta and isinstance(optimizer._lr,
+                                             LRScheduler):
+        optimizer._lr.set_state_dict(meta["lr_scheduler"])
     return meta
+
+
+def resume_latest(base: str, model, optimizer=None
+                  ) -> Optional[Dict[str, Any]]:
+    """Restore from the NEWEST COMMITTED checkpoint under ``base`` (a
+    CheckpointManager base dir); None when no committed checkpoint
+    exists (cold start). Corrupt/uncommitted dirs are skipped by the
+    commit-marker scan; a checkpoint that turns out corrupt mid-load
+    raises CheckpointCorruptError — delete it and call again to fall
+    back one more save."""
+    path = latest_committed(base)
+    if path is None:
+        return None
+    meta = load_train_state(path, model, optimizer)
+    meta.setdefault("checkpoint_path", path)
+    return meta
+
+
+def train_with_recovery(step_fn: Callable[[int], Any], total_steps: int,
+                        *, start_step: int = 0,
+                        save_fn: Optional[Callable[[int], None]] = None,
+                        save_every: int = 0, elastic=None, watchdog=None,
+                        on_step: Optional[Callable[[int, Any],
+                                                   None]] = None
+                        ) -> Tuple[str, int]:
+    """Survivor-driven recovery loop around a compiled step function.
+
+    Runs ``step_fn(step)`` for ``start_step <= step < total_steps``,
+    checkpointing via ``save_fn(step+1)`` every ``save_every`` steps,
+    and stops the moment either recovery signal fires:
+
+    - ``elastic`` (an :class:`ElasticManager`): ``restart_needed``
+      between steps (a peer's heartbeat aged out, or the manager hit
+      ERROR on a dead store) — the world changed under us;
+    - ``watchdog`` (a :class:`~paddle_tpu.distributed.watchdog.
+      CommTaskManager`): the step is tracked against its timeout, so a
+      hung collective (dead peer mid-step) raises instead of wedging.
+
+    On a signal: a stall flight record is dumped (post-mortem), pending
+    async checkpoint writes are NOT waited on (the store may be the
+    thing that died — the commit protocol makes the half-written save
+    harmless), and ``("restart", step)`` is returned so the caller can
+    ``sys.exit(RESTART_EXIT_CODE)`` for the launcher to relaunch; the
+    relaunched job resumes via :func:`resume_latest`. Completing every
+    step returns ``("completed", total_steps)``.
+    """
+    from ...watchdog import TimeoutError_
+
+    for step in range(start_step, total_steps):
+        if elastic is not None and elastic.restart_needed:
+            _dump_flight(f"elastic: world changed before step {step} "
+                         f"(status {elastic.status.name})")
+            return ("restart", step)
+        try:
+            if watchdog is not None:
+                with watchdog.track(f"step{step}"):
+                    out = step_fn(step)
+                    jax.block_until_ready(jax.tree_util.tree_map(
+                        lambda t: getattr(t, "_value", t), out))
+            else:
+                out = step_fn(step)
+        except TimeoutError_:
+            # the watchdog already dumped the flight record on its way up
+            return ("restart", step)
+        if on_step is not None:
+            on_step(step, out)
+        if save_fn is not None and save_every > 0 \
+                and (step + 1) % save_every == 0:
+            save_fn(step + 1)
+    return ("completed", total_steps)
+
+
+def _dump_flight(reason: str) -> None:
+    try:
+        from ....observability import flight as _flight
+
+        _flight.dump(reason=reason)
+    except Exception:
+        pass            # the post-mortem must never mask the recovery

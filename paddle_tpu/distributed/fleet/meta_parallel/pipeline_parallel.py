@@ -92,13 +92,42 @@ class PipelineParallel(_DelegateWrapper):
         """
         inputs, labels = data
         self._check_batch(inputs)
+        if lr_scheduler is not None:
+            # the engine advances the optimizer's attached schedule once
+            # per step — attach the caller's so it is the one advanced
+            _unwrap_optimizer(optimizer).set_lr_scheduler(lr_scheduler)
         eng = self._ensure_engine(optimizer)
         if self._train_step is None:
             def fn(model, batch):
                 return model.compute_loss(batch["inputs"], batch["labels"])
 
-            self._train_step = eng.train_step(fn)
+            # the scaler of the FIRST call is baked into the compiled
+            # step (the traced dynamic loss-scaling protocol)
+            self._train_step = eng.train_step(fn, scaler=scaler)
         return self._train_step({"inputs": inputs, "labels": labels})
+
+    # -- crash-consistent checkpointing ---------------------------------
+    def save_checkpoint(self, path=None, **kw):
+        """Checkpoint the compiled pipeline's full training state
+        (ParallelEngine.save_checkpoint): params incl. the pp x vpp
+        stacked chunks shard-exact, ZeRO-scattered moments, AMP
+        state, counters, RNG."""
+        enforce(self._engine is not None,
+                "run train_batch once before save_checkpoint (the "
+                "engine owns the optimizer state being saved)")
+        return self._engine.save_checkpoint(path, **kw)
+
+    def restore_checkpoint(self, path, optimizer=None, scaler=None):
+        """Restore from a committed checkpoint, resharding to the
+        current topology. Callable before the first train_batch when
+        ``optimizer`` is given (the engine is built here so moments
+        have shaped, sharded targets to land in)."""
+        if self._engine is None:
+            enforce(optimizer is not None,
+                    "restore_checkpoint before the first train_batch "
+                    "needs the optimizer (it owns the moment targets)")
+            self._ensure_engine(optimizer)
+        return self._engine.restore_checkpoint(path, scaler=scaler)
 
     def profile_exposed_comm(self, data, repeats: int = 3,
                              publish: bool = True):

@@ -66,8 +66,13 @@ class TCPStore:
     MAX_BLOB = 64 * 1024 * 1024
 
     def set(self, key: str, value) -> None:
+        from . import failpoints as _fp
+
         data = value if isinstance(value, (bytes, bytearray)) else \
             str(value).encode()
+        # fault-injection site: a hung/raising store is how a control-
+        # plane outage presents to heartbeats and barriers
+        data = _fp.hit("store.set", bytes(data))
         if len(data) > self.MAX_BLOB:
             raise ValueError(
                 f"TCPStore.set({key!r}): payload of {len(data)} bytes "
@@ -81,6 +86,9 @@ class TCPStore:
         enforce(rc == 0, f"TCPStore.set({key!r}) failed")
 
     def get(self, key: str, timeout: Optional[float] = None) -> bytes:
+        from . import failpoints as _fp
+
+        _fp.hit("store.get")
         out = ctypes.POINTER(ctypes.c_uint8)()
         ms = int(timeout * 1000) if timeout is not None else self.timeout_ms
         with self._mu:

@@ -10,15 +10,34 @@ tasks, so hang detection wraps the *step execution*: a monitor thread
 arms a deadline around each tracked region (dispatch → block_until_ready)
 and fires the timeout handler if the device never comes back — the
 typical cause being a peer host dropping out of a multi-host collective.
+
+``error_handling`` modes on timeout (a flight record is dumped first in
+every mode):
+
+- ``"raise"``  — record the timeout; ``check()`` (called when a tracked
+  region exits, and between steps) raises :class:`TimeoutError_`.
+- ``"log"``    — log an ERROR naming the hung region and the flight-
+  record path, and keep going (observe-only deployments).
+- ``"teardown"`` — ``os.abort()`` so the launcher's watcher restarts
+  the pod (the reference's ErrorHandlingMode::TearDown).
+
+Lifecycle: the monitor thread starts lazily on the first tracked
+region and is joined by ``shutdown()``; the manager (and the ``watch``
+wrapper) are context managers so tests and loops can scope them —
+``with CommTaskManager(...) as mgr: ...`` / ``with watch(step) as w:``
+never leak a monitor thread.
 """
 from __future__ import annotations
 
+import logging
 import os
 import threading
 import time
 from typing import Callable, Optional
 
 __all__ = ["CommTaskManager", "TimeoutError_", "watch"]
+
+logger = logging.getLogger("paddle_tpu.watchdog")
 
 
 class TimeoutError_(RuntimeError):
@@ -33,18 +52,16 @@ class _Task:
 
 
 class CommTaskManager:
-    """Tracks in-flight step executions against a timeout.
-
-    ``error_handling``: "raise" (raise TimeoutError_ in the monitor and
-    record it for the main thread), "log", or "teardown" (SIGABRT the
-    process — the reference's ErrorHandlingMode::TearDown, letting the
-    launcher's watcher restart the pod).
-    """
+    """Tracks in-flight step executions against a timeout."""
 
     def __init__(self, timeout: float = 1800.0,
                  error_handling: str = "raise",
                  on_timeout: Optional[Callable] = None,
                  poll_interval: float = 0.5):
+        if error_handling not in ("raise", "log", "teardown"):
+            raise ValueError(
+                f"error_handling {error_handling!r}: choose "
+                "raise | log | teardown")
         self.timeout = timeout
         self.error_handling = error_handling
         self.on_timeout = on_timeout
@@ -54,9 +71,17 @@ class CommTaskManager:
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._timed_out: Optional[str] = None
-        self._thread = threading.Thread(target=self._loop, daemon=True,
-                                        name="watchdog-monitor")
-        self._thread.start()
+        # lazy: no monitor thread until something is actually tracked
+        self._thread: Optional[threading.Thread] = None
+
+    def _ensure_thread(self):
+        with self._lock:
+            if self._thread is None or not self._thread.is_alive():
+                self._stop.clear()
+                self._thread = threading.Thread(
+                    target=self._loop, daemon=True,
+                    name="watchdog-monitor")
+                self._thread.start()
 
     def _loop(self):
         while not self._stop.wait(self.poll):
@@ -71,14 +96,20 @@ class CommTaskManager:
                 self._dump_flight_record(t.name)
                 if self.on_timeout:
                     self.on_timeout(t.name)
-                if self.error_handling == "teardown":
+                if self.error_handling == "log":
+                    logger.error(
+                        "watchdog: tracked region '%s' exceeded %.1fs "
+                        "without the device coming back (peer likely "
+                        "left the mesh); flight record: %s", t.name,
+                        self.timeout, self.last_flight_record or "<none>")
+                elif self.error_handling == "teardown":
                     os.abort()
 
     def _dump_flight_record(self, name: str):
-        """Before raising/tearing down, persist the stall flight-record
-        (last-N metric snapshots + in-flight named regions + every
-        thread's stack) — the post-mortem the reference dumps from its
-        async-trace task queue (FLAGS_enable_async_trace)."""
+        """Before raising/logging/tearing down, persist the stall
+        flight-record (last-N metric snapshots + in-flight named regions
+        + every thread's stack) — the post-mortem the reference dumps
+        from its async-trace task queue (FLAGS_enable_async_trace)."""
         try:
             from ..observability import flight as _flight
 
@@ -101,11 +132,21 @@ class CommTaskManager:
                 f"(reference: NCCLCommTask::IsTimeout){where}")
 
     def track(self, name: str = "step", timeout: Optional[float] = None):
+        self._ensure_thread()
         return _Tracker(self, name, timeout or self.timeout)
 
     def shutdown(self):
         self._stop.set()
-        self._thread.join(timeout=2)
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+            self._thread = None
+
+    def __enter__(self) -> "CommTaskManager":
+        return self
+
+    def __exit__(self, *exc):
+        self.shutdown()
+        return False
 
 
 class _Tracker:
@@ -135,21 +176,43 @@ class _Tracker:
         return False
 
 
-def watch(fn: Callable, timeout: float = 1800.0, name: str = "step",
-          **mgr_kw):
-    """Wrap a compiled step so each call is tracked: blocks until the
-    result is device-ready inside the watched region."""
-    mgr = CommTaskManager(timeout=timeout, **mgr_kw)
+class _Watched:
+    """Callable wrapper around a step fn + its watchdog; context-manager
+    and ``shutdown()`` wiring so the monitor thread never leaks."""
 
-    def wrapped(*args, **kwargs):
+    def __init__(self, fn: Callable, mgr: CommTaskManager, name: str):
+        self._fn = fn
+        self._name = name
+        self._watchdog = mgr
+
+    def __call__(self, *args, **kwargs):
         import jax
 
-        with mgr.track(name):
-            out = fn(*args, **kwargs)
+        with self._watchdog.track(self._name):
+            out = self._fn(*args, **kwargs)
             jax.block_until_ready(
                 jax.tree_util.tree_map(
                     lambda t: getattr(t, "_value", t), out))
         return out
 
-    wrapped._watchdog = mgr
-    return wrapped
+    def shutdown(self):
+        self._watchdog.shutdown()
+
+    def __enter__(self) -> "_Watched":
+        return self
+
+    def __exit__(self, *exc):
+        self.shutdown()
+        return False
+
+
+def watch(fn: Callable, timeout: float = 1800.0, name: str = "step",
+          **mgr_kw) -> _Watched:
+    """Wrap a compiled step so each call is tracked: blocks until the
+    result is device-ready inside the watched region.
+
+    The wrapper owns its CommTaskManager — scope it (``with watch(step)
+    as w: ...``) or call ``w.shutdown()`` when done; the monitor thread
+    only starts on the first call.
+    """
+    return _Watched(fn, CommTaskManager(timeout=timeout, **mgr_kw), name)

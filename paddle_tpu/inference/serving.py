@@ -71,6 +71,14 @@ class ServingRequest:
     t_submit: float = 0.0
     t_first_token: float = 0.0
     t_finish: float = 0.0
+    # graceful degradation: why this request was load-shed (None =
+    # served normally); admission deadline in the t_submit clock domain
+    shed_reason: Optional[str] = None
+    deadline: Optional[float] = None
+
+    @property
+    def shed(self) -> bool:
+        return self.shed_reason is not None
 
     @property
     def output_ids(self) -> np.ndarray:
@@ -106,7 +114,10 @@ class ServingEngine:
 
     def __init__(self, predictor, max_batch: Optional[int] = None,
                  pool_pages=None, decode_chunk: int = 1,
-                 trace_ring: int = 256, mem_ledger: bool = False):
+                 trace_ring: int = 256, mem_ledger: bool = False,
+                 max_queue: Optional[int] = None,
+                 admission_deadline_s: Optional[float] = None,
+                 degraded_window_s: float = 30.0):
         import os
 
         from . import _bucket
@@ -188,12 +199,44 @@ class ServingEngine:
         self._rng = jax.random.PRNGKey(self.gen.seed)
         self._step_fns: Dict[Any, Any] = {}
         self._next_rid = 0
+        # graceful degradation: a bounded admission queue sheds at
+        # submit (reason "queue_full"); a per-request admission deadline
+        # sheds queued requests whose wait already blew their budget
+        # (reason "deadline") BEFORE paying a prefill for them. Shed
+        # requests never reach prefill, so TTFT stays honest — the shed
+        # path is counted on paddle_tpu_serving_shed_total instead.
+        self.max_queue = int(max_queue) if max_queue else None
+        self.admission_deadline_s = admission_deadline_s
+        self._degraded_window = float(degraded_window_s)
+        self._last_shed_time: Optional[float] = None
+        # /healthz integration: report "degraded" while shedding
+        import weakref
+
+        from ..observability import exporter as _exporter
+
+        ref = weakref.ref(self)
+
+        def _health_provider():
+            eng = ref()
+            if eng is None:
+                return None              # engine gone: exporter prunes
+            return {"component": "serving", "status": eng.health()}
+
+        self._health_provider = _health_provider
+        _exporter.add_health_provider(_health_provider)
 
     # -- admission -------------------------------------------------------
     def submit(self, prompt, max_new_tokens: Optional[int] = None,
-               eos_token_id: Optional[int] = None) -> int:
+               eos_token_id: Optional[int] = None,
+               deadline_s: Optional[float] = None) -> int:
         """Queue one request; returns its rid (admission happens inside
-        step()/run(), when a slot and enough free pages exist)."""
+        step()/run(), when a slot and enough free pages exist).
+
+        Graceful degradation: with ``max_queue`` set, a full queue sheds
+        the request immediately (it lands in ``finished`` with
+        ``shed_reason="queue_full"`` and zero tokens). ``deadline_s``
+        (default: the engine's ``admission_deadline_s``) bounds how long
+        the request may wait for admission before being shed."""
         ids = np.asarray(prompt._value if isinstance(prompt, Tensor)
                          else prompt).reshape(-1).astype(np.int64)
         n_new = int(max_new_tokens if max_new_tokens is not None
@@ -211,15 +254,51 @@ class ServingEngine:
         rid = self._next_rid
         self._next_rid += 1
         now = time.perf_counter()
-        self.queue.append(ServingRequest(rid, ids, n_new, eos,
-                                         t_submit=now))
+        dls = deadline_s if deadline_s is not None \
+            else self.admission_deadline_s
+        req = ServingRequest(rid, ids, n_new, eos, t_submit=now,
+                             deadline=(now + dls) if dls is not None
+                             else None)
         tr = RequestTrace(rid, meta={"prompt_len": L,
                                      "max_new_tokens": n_new})
         tr.begin("queued", now)
         self._live_traces[rid] = tr
         self._metrics["requests"].inc(event="submitted")
+        if self.max_queue is not None and len(self.queue) >= self.max_queue:
+            self._shed(req, "queue_full")
+            return rid
+        self.queue.append(req)
         self._metrics["queue_depth"].set(len(self.queue))
         return rid
+
+    def _shed(self, req: ServingRequest, reason: str):
+        """Load-shed a queued request: it finishes with no tokens, no
+        TTFT observation (shed latency must not pollute the latency
+        SLO histograms), and a shed counter tick."""
+        req.shed_reason = reason
+        req.t_finish = time.perf_counter()
+        self.finished[req.rid] = req
+        self._last_shed_time = req.t_finish
+        m = self._metrics
+        m["shed"].inc(reason=reason)
+        tr = self._live_traces.pop(req.rid, None)
+        if tr is not None:
+            tr.end("queued", req.t_finish)
+            tr.meta["shed_reason"] = reason
+            self.traces.add(tr)
+
+    def health(self) -> str:
+        """"ok", or "degraded" while the engine is shedding load (a
+        shed within ``degraded_window_s``, or the admission queue at
+        its bound) — surfaced on /healthz by the metrics exporter."""
+        if self.max_queue is not None and \
+                len(self.queue) >= self.max_queue:
+            return "degraded"
+        if self._last_shed_time is not None and \
+                time.perf_counter() - self._last_shed_time \
+                <= self._degraded_window:
+            return "degraded"
+        return "ok"
 
     def _pages_needed(self, L: int, n_new: int) -> int:
         return -(-(L + n_new) // self.page)
@@ -229,12 +308,20 @@ class ServingEngine:
 
     def _admit(self):
         """FIFO-admit queued requests into free slots while pages last;
-        each admission runs one bucketed prefill into the shared pool."""
+        each admission runs one bucketed prefill into the shared pool.
+        Requests whose admission deadline already passed are shed here,
+        BEFORE any prefill is spent on them."""
         while self.queue:
+            now = time.perf_counter()
+            req = self.queue[0]
+            if req.deadline is not None and now > req.deadline:
+                self.queue.popleft()
+                self._shed(req, "deadline")
+                self._metrics["queue_depth"].set(len(self.queue))
+                continue
             free = [b for b in range(self.B) if self.slots[b] is None]
             if not free:
                 return
-            req = self.queue[0]
             need = self._pages_needed(len(req.prompt), req.max_new_tokens)
             if need > len(self._free_pages):
                 return                    # head-of-line waits for evictions

@@ -17,7 +17,7 @@ from .metrics import (DEFAULT_LATENCY_BUCKETS, MetricsRegistry,
                       get_registry)
 
 __all__ = ["train_metrics", "serving_metrics", "comm_metrics",
-           "mem_metrics", "SCHEMA_PATH"]
+           "mem_metrics", "ckpt_metrics", "SCHEMA_PATH"]
 
 SCHEMA_PATH = __file__.rsplit("/", 1)[0] + "/schema.json"
 
@@ -128,11 +128,49 @@ def mem_metrics(reg: MetricsRegistry = None) -> Dict[str, object]:
     }
 
 
+def ckpt_metrics(reg: MetricsRegistry = None) -> Dict[str, object]:
+    """Register (get-or-create) the checkpoint instrument set —
+    published by :class:`distributed.checkpoint.CheckpointManager`
+    after every commit (and on ``publish()`` so the age gauge keeps
+    counting between saves)."""
+    r = reg or get_registry()
+    return {
+        "age": r.gauge(
+            "paddle_tpu_ckpt_last_save_age_seconds",
+            "seconds since the last COMMITTED checkpoint (refreshed on "
+            "every commit and CheckpointManager.publish(); growing "
+            "without bound = saves are failing or stopped)", unit="s"),
+        "save_seconds": r.gauge(
+            "paddle_tpu_ckpt_save_seconds",
+            "wall time of the last completed checkpoint save by phase: "
+            "snapshot = device->host shard copy (the only stall the "
+            "step loop sees in async mode), write = the commit "
+            "protocol's file I/O, total = snapshot + write",
+            labelnames=("phase",), unit="s"),
+        "save_bytes": r.gauge(
+            "paddle_tpu_ckpt_save_bytes",
+            "bytes this process's shards contributed to the last "
+            "completed checkpoint save", unit="bytes"),
+        "last_step": r.gauge(
+            "paddle_tpu_ckpt_last_committed_step",
+            "training step of the newest committed checkpoint"),
+        "pending": r.gauge(
+            "paddle_tpu_ckpt_async_pending",
+            "async checkpoint saves snapshotted but not yet committed "
+            "(writer-thread queue depth; stuck >0 = storage stalled)"),
+        "saves": r.counter(
+            "paddle_tpu_ckpt_saves_total",
+            "checkpoint saves by outcome (committed = the COMMIT "
+            "marker hit disk)", labelnames=("result",)),
+    }
+
+
 def train_metrics(reg: MetricsRegistry = None) -> Dict[str, object]:
     """Register (get-or-create) the training instrument set."""
     r = reg or get_registry()
     out = comm_metrics(r)
     out.update(mem_metrics(r))
+    out.update({f"ckpt_{k}": v for k, v in ckpt_metrics(r).items()})
     out.update({
         "step_seconds": r.histogram(
             "paddle_tpu_train_step_seconds",
@@ -252,6 +290,14 @@ def serving_metrics(reg: MetricsRegistry = None) -> Dict[str, object]:
             "backfilled (admitted while other rows were mid-decode) / "
             "evicted (finished, pages freed)",
             labelnames=("event",)),
+        "shed": r.counter(
+            "paddle_tpu_serving_shed_total",
+            "requests shed by graceful degradation, by reason: "
+            "queue_full (bounded admission queue at max_queue on "
+            "submit) / deadline (admission deadline expired while "
+            "queued). Shed requests never reach prefill, so their "
+            "latency is excluded from the TTFT histogram",
+            labelnames=("reason",)),
         "tokens": r.counter(
             "paddle_tpu_serving_tokens_total",
             "tokens produced, by phase", labelnames=("phase",)),

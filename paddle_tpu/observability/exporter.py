@@ -21,6 +21,15 @@ one. Scrapes of ``/metrics`` deliberately do not refresh the age
 (metrics.py ``snapshot(touch=False)``); before any engine tick the
 age is ``null``.
 
+Components can degrade the health verdict without owning the endpoint:
+``add_health_provider(fn)`` registers a callable returning
+``{"component": ..., "status": "ok" | "degraded"}`` (or None to be
+pruned — dead engines fall away via weakrefs). ``/healthz`` reports
+``"status": "degraded"`` plus the per-component list whenever any
+provider does — the ServingEngine registers one that flips to
+degraded while it is load-shedding, so an external LB can drain the
+replica before users see errors.
+
     >>> srv = serve_metrics(9100)        # port 0 picks a free port
     >>> srv.port
     9100
@@ -38,9 +47,50 @@ from typing import Optional
 
 from .metrics import MetricsRegistry, get_registry
 
-__all__ = ["MetricsServer", "serve_metrics"]
+__all__ = ["MetricsServer", "serve_metrics", "add_health_provider",
+           "remove_health_provider", "health_status"]
 
 CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+_health_lock = threading.Lock()
+_health_providers: list = []
+
+
+def add_health_provider(fn) -> None:
+    """Register a component health callable for /healthz: returns
+    ``{"component": str, "status": "ok" | "degraded"}``, or None to be
+    pruned (a provider closing over a dead weakref)."""
+    with _health_lock:
+        if fn not in _health_providers:
+            _health_providers.append(fn)
+
+
+def remove_health_provider(fn) -> None:
+    with _health_lock:
+        if fn in _health_providers:
+            _health_providers.remove(fn)
+
+
+def health_status() -> dict:
+    """Aggregate component health: worst status wins; providers that
+    return None (component gone) are pruned."""
+    with _health_lock:
+        providers = list(_health_providers)
+    components, dead = [], []
+    for fn in providers:
+        try:
+            c = fn()
+        except Exception:
+            continue        # a broken provider must not break liveness
+        if c is None:
+            dead.append(fn)
+            continue
+        components.append(c)
+    for fn in dead:
+        remove_health_provider(fn)
+    status = "degraded" if any(
+        c.get("status") != "ok" for c in components) else "ok"
+    return {"status": status, "components": components}
 
 
 class MetricsServer:
@@ -78,12 +128,16 @@ def serve_metrics(port: int = 0, registry: Optional[MetricsRegistry] = None,
             path = self.path.split("?", 1)[0]
             if path == "/healthz":
                 age = reg.snapshot_age_seconds()
-                body = json.dumps({
-                    "status": "ok",
+                health = health_status()
+                doc = {
+                    "status": health["status"],
                     "snapshot_age_seconds":
                         round(age, 3) if age is not None else None,
                     "pid": os.getpid(),
-                }).encode("utf-8")
+                }
+                if health["components"]:
+                    doc["components"] = health["components"]
+                body = json.dumps(doc).encode("utf-8")
                 ctype = "application/json"
             elif path in ("/", "/metrics"):
                 body = reg.prometheus_text().encode("utf-8")

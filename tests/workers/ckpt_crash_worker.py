@@ -1,0 +1,88 @@
+"""Crash-consistency worker: train with rolling atomic checkpoints and
+(optionally) die at an armed failpoint mid-save; on relaunch,
+auto-resume from the newest COMMITTED checkpoint.
+
+The failpoint table arms itself from PADDLE_TPU_FAILPOINTS in the
+environment (e.g. "ckpt.commit=kill@2" SIGKILLs this process during the
+second save), so the driving test only sets env vars:
+CKPT_BASE, TOTAL_STEPS, SAVE_EVERY, TEST_OUT, SAVE_ASYNC, KEEP_LAST_K.
+
+Losses stream to <TEST_OUT>.log one per line (flushed per step) so
+progress is readable after a SIGKILL; on clean completion
+<TEST_OUT>.json records where the run started (0 = cold,
+>0 = resumed from that committed step).
+"""
+import json
+import os
+import sys
+
+os.environ.setdefault("XLA_FLAGS",
+                      "--xla_force_host_platform_device_count=1")
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np  # noqa: E402
+
+import paddle_tpu as paddle  # noqa: E402
+from paddle_tpu.distributed.checkpoint import (CheckpointManager,  # noqa: E402
+                                               latest_committed)
+from paddle_tpu.distributed.engine import ParallelEngine  # noqa: E402
+from paddle_tpu.models import (GPTForCausalLM,  # noqa: E402
+                               GPTPretrainingCriterion, gpt_tiny)
+
+
+def batch(step, B, S, V):
+    r = np.random.RandomState(1000 + step)
+    ids = r.randint(0, V, (B, S + 1))
+    return (paddle.to_tensor(ids[:, :-1]),
+            paddle.to_tensor(ids[:, 1:]))
+
+
+def main():
+    out = os.environ["TEST_OUT"]
+    base = os.environ["CKPT_BASE"]
+    total = int(os.environ.get("TOTAL_STEPS", "8"))
+    save_every = int(os.environ.get("SAVE_EVERY", "2"))
+    async_save = os.environ.get("SAVE_ASYNC", "") == "1"
+    keep = int(os.environ.get("KEEP_LAST_K", "2"))
+
+    paddle.seed(42)
+    cfg = gpt_tiny()
+    model = GPTForCausalLM(cfg)
+    crit = GPTPretrainingCriterion(cfg)
+    opt = paddle.optimizer.AdamW(learning_rate=5e-3,
+                                 parameters=model.parameters())
+    eng = ParallelEngine(model, opt)
+    step_fn = eng.train_step(lambda m, b: crit(m(b["x"]), b["y"]))
+
+    start = 0
+    latest = latest_committed(base)
+    if latest is not None:
+        meta = eng.restore_checkpoint(latest)
+        start = int(meta["step"])
+
+    mgr = CheckpointManager(base, keep_last_k=keep,
+                            async_save=async_save)
+    log = open(f"{out}.log", "a")
+    B, S, V = 8, 16, cfg.vocab_size
+    for step in range(start, total):
+        x, y = batch(step, B, S, V)
+        loss = step_fn({"x": x, "y": y})
+        log.write(f"{float(loss)!r}\n")
+        log.flush()
+        if (step + 1) % save_every == 0 and step + 1 < total:
+            eng.save_checkpoint(manager=mgr, step=step + 1)
+    mgr.wait()
+    mgr.close()
+    log.close()
+    with open(f"{out}.json", "w") as f:
+        json.dump({"start": start}, f)
+
+
+if __name__ == "__main__":
+    main()
