@@ -472,6 +472,8 @@ def bench_serving_mixed(on_tpu, dev):
 # ---------------------------------------------------------------------------
 def bench_gpt13b_hybrid(on_tpu, dev):
     import os
+    import shutil
+    import tempfile
 
     import jax
 
@@ -481,6 +483,7 @@ def bench_gpt13b_hybrid(on_tpu, dev):
     from paddle_tpu.models.gpt import GPTConfig
 
     from paddle_tpu.observability import flops as _flops
+    from paddle_tpu.observability import goodput as _gp
     from paddle_tpu.observability import memledger as _ml
 
     # HBM memory ledger on for every engine this bench builds (the
@@ -524,9 +527,13 @@ def bench_gpt13b_hybrid(on_tpu, dev):
     # backward seam, distributed/grad_buckets.py). base vs overlap is
     # the same program shape, so the loss-parity and
     # profile_exposed_comm("sharding") comparison is one flag apart.
+    gp_base = tempfile.mkdtemp(prefix="goodput_gpt13b_")
     results = {}
     for tag, vpp, overlap in (("base", 1, False), ("vpp2", 2, False),
                               ("overlap", 1, True)):
+        # one goodput journal per tag (run-level wall attribution:
+        # compile vs step_compute vs idle; observability/goodput.py)
+        gp_led = _gp.attach_dir(os.path.join(gp_base, tag))
         paddle.seed(0)
         strategy = fleet.DistributedStrategy()
         strategy.hybrid_configs = {
@@ -563,6 +570,10 @@ def bench_gpt13b_hybrid(on_tpu, dev):
             losses.append(float(dist_model.train_batch([x, y], opt)))
         dt = time.perf_counter() - t0
         tok_s = B * S * steps / dt
+        # goodput summary BEFORE the offline exposed-comm replays (the
+        # profiler suppresses goodput segments, so its wall time would
+        # book as idle and dilute the percentage)
+        gp_summary = gp_led.summary()
         # exposed-comm attribution (observability/commledger): per-axis
         # overlapped-vs-exposed split + grad_sync_exposed_seconds. The
         # gauges land in the telemetry section below; the compact
@@ -596,7 +607,7 @@ def bench_gpt13b_hybrid(on_tpu, dev):
         roof = eng.roofline_report(exposed=prof)
         results[tag] = {"losses": losses, "prof": prof, "led": led,
                         "plan": plan, "eng": eng, "acct": acct,
-                        "roof": roof}
+                        "roof": roof, "goodput": gp_summary}
         peak, _ = _chip(dev)
         n_params = cfg.num_params()
         mfu = (6.0 * n_params * tok_s / (peak * n)) if peak else 0.0
@@ -630,6 +641,10 @@ def bench_gpt13b_hybrid(on_tpu, dev):
                 "state": acct.to_dict(),
             },
             "roofline": roof.to_dict(),
+            # run-level wall-clock attribution of THIS tag's run
+            # (tools/run_report.py draws the waterfall;
+            # tools/step_report.py columns + --strict gate ride on it)
+            "goodput": gp_summary,
             "telemetry": _telemetry_section(),
             "device": str(getattr(dev, "device_kind", dev.platform)),
         }
@@ -684,6 +699,29 @@ def bench_gpt13b_hybrid(on_tpu, dev):
            "unit": "pct", "vs_baseline": 0.0, "bound": roof.bound,
            "roofline_seconds": {k: round(v, 6)
                                 for k, v in roof.seconds.items()}})
+    # run-level goodput headline (higher-better in bench_compare; the
+    # CPU smoke number is dominated by compile at this toy scale — the
+    # trajectory, not the absolute, is the signal) + the health
+    # monitor's event count, which must be EXACTLY 0 on this
+    # deterministic line (bench_compare _EXACT)
+    gp = base_r["goodput"]
+    _emit({"metric": "gpt13b_hybrid_goodput_pct",
+           "value": gp["goodput_pct"], "unit": "pct",
+           "vs_baseline": 0.0,
+           "segment_pct": gp["segment_pct"],
+           "wall_seconds": gp["wall_seconds"]})
+    # each tag's engine carries its OWN health monitor (per-run
+    # windows); a deterministic fixed-seed bench must raise no event
+    # on any of them
+    n_events = sum(r["eng"]._health.event_count()
+                   for r in results.values())
+    _emit({"metric": "gpt13b_hybrid_health_spike_events",
+           "value": float(n_events),
+           "unit": "events", "vs_baseline": 0.0,
+           "events": [e for r in results.values()
+                      for e in r["eng"]._health.events()][-4:]})
+    _gp.detach()
+    shutil.rmtree(gp_base, ignore_errors=True)
 
 
 # ---------------------------------------------------------------------------
@@ -707,6 +745,7 @@ def bench_ckpt_overlap(on_tpu, dev):
     from paddle_tpu.distributed.checkpoint import CheckpointManager
     from paddle_tpu.models import GPTForCausalLMPipe
     from paddle_tpu.models.gpt import GPTConfig
+    from paddle_tpu.observability import goodput as _gp
     from paddle_tpu.observability.catalog import ckpt_metrics
 
     n = jax.device_count()
@@ -765,6 +804,7 @@ def bench_ckpt_overlap(on_tpu, dev):
             best = dt if best is None else min(best, dt)
         return best
 
+    _gp.detach()                 # baseline steps stay unattributed
     dt_base = timed(lambda: run_steps(N))
 
     base_dir = tempfile.mkdtemp(prefix="ckpt_overlap_")
@@ -798,6 +838,14 @@ def bench_ckpt_overlap(on_tpu, dev):
 
         stall_async = timed(async_round) - dt_base
         mgr_a.close()
+        # goodput attribution of the two phases: each manager attached
+        # its own journal when constructed, so the sync phase's steps +
+        # commit stalls landed in <base>/sync and the async phase's —
+        # including the writer thread's OVERLAPPED ckpt_async
+        # intervals — in <base>/async
+        gp_sync = mgr_s._goodput.summary() if mgr_s._goodput else {}
+        gp_async = mgr_a._goodput.summary() if mgr_a._goodput else {}
+        _gp.detach()
     finally:
         shutil.rmtree(base_dir, ignore_errors=True)
 
@@ -819,9 +867,24 @@ def bench_ckpt_overlap(on_tpu, dev):
         "write_seconds": round(write_s, 6),
         "mesh": "sharding2xpp2xmp2", "devices": n,
         "train_steps_behind": N,
+        # run-level attribution of the ASYNC phase (the shipping
+        # config): ckpt_stall = the snapshot the loop pays, ckpt_async
+        # = the overlapped background commit; the sync phase rides
+        # along for the contrast (its ckpt_stall carries the whole
+        # commit protocol)
+        "goodput": gp_async,
+        "goodput_sync_phase": gp_sync,
         "telemetry": _telemetry_section(),
         "device": str(getattr(dev, "device_kind", dev.platform)),
     })
+    _emit({"metric": "ckpt_overlap_goodput_pct",
+           "value": gp_async.get("goodput_pct", 0.0), "unit": "pct",
+           "vs_baseline": 0.0,
+           "sync_phase_goodput_pct": gp_sync.get("goodput_pct", 0.0),
+           "segment_pct": gp_async.get("segment_pct", {})})
+    _emit({"metric": "ckpt_overlap_health_spike_events",
+           "value": float(dist_model._engine._health.event_count()),
+           "unit": "events", "vs_baseline": 0.0})
 
 
 # ---------------------------------------------------------------------------

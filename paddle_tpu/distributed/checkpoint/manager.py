@@ -92,6 +92,7 @@ class CheckpointManager:
 
     def __init__(self, base: str, keep_last_k: int = 3,
                  async_save: bool = False, coordinator_rank: int = 0):
+        from ...observability import goodput as _gp
         from ...observability.catalog import ckpt_metrics
 
         self.base = base
@@ -100,6 +101,14 @@ class CheckpointManager:
         self.coordinator_rank = coordinator_rank
         os.makedirs(base, exist_ok=True)
         self._metrics = ckpt_metrics()
+        # run-level goodput ledger lives next to the checkpoints (the
+        # crash-durable journal resume_latest continues after a kill);
+        # within a process the same base reuses the same live ledger
+        try:
+            self._goodput = _gp.attach_dir(base)
+        except OSError:
+            self._goodput = None     # unwritable base: saves will fail
+
         self._queue: "queue.Queue" = queue.Queue()
         self._writer: Optional[threading.Thread] = None
         self._cv = threading.Condition()
@@ -130,14 +139,21 @@ class CheckpointManager:
         (the file protocol runs on the writer thread — ``wait()`` to
         join). A failed background save surfaces on the next call or
         ``wait()``."""
+        from ...observability import goodput as _gp
+
         self._raise_pending()
         t0 = time.perf_counter()
-        md, shards, fname = collect_shards(state_dict)
+        # the device->host snapshot is the only stall the step loop
+        # pays in async mode — book it as ckpt_stall either way
+        with _gp.segment("ckpt_stall"):
+            md, shards, fname = collect_shards(state_dict)
         snap_s = time.perf_counter() - t0
         nbytes = sum(int(a.nbytes) for a in shards.values())
         job = (md, shards, fname, int(step), extra_meta, snap_s, nbytes)
         if not self.async_save:
-            self._write(*job)
+            # sync mode: the whole commit protocol stalls the loop
+            with _gp.segment("ckpt_stall"):
+                self._write(*job)
             return
         if self._writer is None or not self._writer.is_alive():
             self._writer = threading.Thread(
@@ -149,15 +165,27 @@ class CheckpointManager:
         self._metrics["pending"].set(float(self._pending))
 
     def _writer_loop(self) -> None:
+        import time as _time
+
         while True:
             job = self._queue.get()
             if job is None:
                 return
+            t0 = _time.time()
             try:
                 self._write(*job)
             except BaseException as e:   # surfaced on wait()/next save
                 self._errors.append(e)
             finally:
+                # background commit: journaled as an OVERLAPPED
+                # ckpt_async interval (runs under the step loop, so it
+                # is excluded from the foreground wall-sum identity)
+                try:
+                    if self._goodput is not None:
+                        self._goodput.record_overlapped(
+                            "ckpt_async", t0, _time.time())
+                except Exception:
+                    pass
                 with self._cv:
                     self._pending -= 1
                     self._cv.notify_all()

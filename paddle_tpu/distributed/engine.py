@@ -39,6 +39,8 @@ from ..core import rng as _rng
 from ..core.compile_stats import CompileStats
 from ..observability import commledger as _cl
 from ..observability import flops as _flops
+from ..observability import goodput as _gp
+from ..observability import healthmon as _hm
 from ..observability import memledger as _ml
 from ..observability import moestats as _moestats
 from ..observability.catalog import train_metrics as _train_metrics
@@ -327,10 +329,18 @@ class ParallelEngine:
         # grad-norm, tokens/s, MFU, device memory, compile counters —
         # all host-side on fetched scalars, never inside the trace
         self._metrics = _train_metrics()
+        # run-health watcher (observability/healthmon): rolling robust
+        # spike/stall detection over the scalars the lagged fetch below
+        # already pays for. PER-ENGINE windows — a fresh model's first
+        # loss must never be judged against another run's converged
+        # baseline — surfaced on /healthz via a weakref provider
+        self._health = _hm.HealthMonitor()
+        self._health.register_healthz("train_health")
         self._n_params_cfg = _flops.params_from_config(
             getattr(model, "config", None))
         self._stats_reported = (0, 0)    # (compiles, cache_hits) synced
         self._pending_scalars = None     # (loss_dev, gnorm_dev) lazy
+        self._pending_found = None       # scaler found_inf of that step
         self._pending_moe = None         # MoE stats devices, same lag
         self._prev_step_entry = None
         # per-program static comm ledgers (observability/commledger):
@@ -352,6 +362,14 @@ class ParallelEngine:
         self._live_peak = 0              # live-bytes high-water mark
         self._last_tokens = 0
         self._last_step_seconds = 0.0
+        self._last_dispatch_fresh = False
+        # set by restore_checkpoint, cleared after the next dispatch:
+        # the first execution after a cross-process restore can pay a
+        # silent XLA-level relayout/recompile (loaded arrays' layouts
+        # differ from compiled-step outputs) that the host-side key
+        # cache never sees — goodput books that dispatch as compile
+        # (warmup), and the health monitor's step-time baseline skips it
+        self._post_restore_warmup = False
         # profile_exposed_comm() replays: suppress telemetry/counters
         # so offline attribution never pollutes the live metrics
         self._profiling = False
@@ -798,6 +816,35 @@ class ParallelEngine:
                    tuple(sorted(mvals)), amp_key, _cl.ablation_token())
             if not self._profiling:
                 self.stats.note("train", key)
+            # goodput attribution (observability/goodput): a known key
+            # is productive step_compute; a fresh one pays trace + XLA
+            # compile in this very call, so the whole dispatch window
+            # books as compile. Host-side journal writes only — the
+            # compiled program and its cache key are untouched.
+            fresh_key = key not in self._compiled
+            self._last_dispatch_fresh = (fresh_key
+                                         or self._post_restore_warmup)
+            _gp_led = None if self._profiling else _gp.current()
+            if _gp_led is not None:
+                _gp_led.begin("compile" if self._last_dispatch_fresh
+                              else "step_compute",
+                              step=int(opt._step_count) + 1)
+            try:
+                return _dispatch(key, treedef, b_specs, mspecs,
+                                 leaf_vals, t_entry, n_tok, mvals)
+            finally:
+                # restore warmup ends at the first dispatch whose key
+                # was already compiled: in a relaunched process that is
+                # dispatch #2 (dispatch #1 traces; its outputs then
+                # shift the avals off the restored arrays' layouts), in
+                # an in-process restore it is dispatch #1
+                if not fresh_key:
+                    self._post_restore_warmup = False
+                if _gp_led is not None:
+                    _gp_led.end()
+
+        def _dispatch(key, treedef, b_specs, mspecs, leaf_vals,
+                      t_entry, n_tok, mvals):
             if key not in self._compiled:
                 self._compiled[key] = make(treedef, b_specs, mspecs)
             pvals = tuple(p._value for p in params)
@@ -859,7 +906,8 @@ class ParallelEngine:
                 if led is not None:
                     led.publish(self._metrics["comm_bytes"],
                                 self._metrics["comm_ops"])
-                self._note_step(t_entry, n_tok, lv, gnorm)
+                self._note_step(t_entry, n_tok, lv, gnorm,
+                                found=amp_out[4] if amp_out else None)
                 self._pending_moe = moe_tel
             return Tensor(lv, stop_gradient=True)
 
@@ -882,22 +930,51 @@ class ParallelEngine:
         if pend is None:
             return
         self._pending_scalars = None
+        found = self._pending_found
+        self._pending_found = None
         lv, gnorm = pend
         try:
             m = self._metrics
-            m["loss"].set(float(np.asarray(lv)))
-            m["grad_norm"].set(float(np.asarray(gnorm)))
+            lvf = float(np.asarray(lv))
+            gnf = float(np.asarray(gnorm))
+            m["loss"].set(lvf)
+            m["grad_norm"].set(gnf)
+            # health monitor: robust spike/nonfinite detection on the
+            # SAME fetched scalars (one-step lag — still off the hot
+            # path; events ring + health_* gauges + goodput journal).
+            # A step the AMP GradScaler SKIPPED (found_inf: grads
+            # zeroed, update dropped) is protocol, not an anomaly —
+            # its scalars never enter the detector's windows.
+            if found is None or float(np.asarray(found)) == 0.0:
+                self._health.observe(
+                    loss=lvf, grad_norm=gnf,
+                    step=int(self.optimizer._step_count)
+                    if self.optimizer is not None else None)
         except Exception:
             pass        # a dead device must not take telemetry down
 
-    def _note_step(self, t_entry: float, n_tok: int, lv, gnorm):
+    def _note_step(self, t_entry: float, n_tok: int, lv, gnorm,
+                   found=None):
         """Host-side per-step instrumentation on fetched/host values
-        only (never called under tracing)."""
+        only (never called under tracing). ``found``: the traced AMP
+        found_inf flag of THIS step (device scalar; fetched with the
+        same one-step lag as the loss)."""
         now = time.perf_counter()
         m = self._metrics
         m["step_seconds"].observe(now - t_entry)
         m["steps"].inc()
         m["tokens"].inc(n_tok)
+        # step-time stall watch on the DISPATCH window (entry to
+        # return): unlike the inter-step interval it contains no
+        # checkpoint stalls / input waits, and compile dispatches are
+        # excluded — so the rolling baseline only ever sees the
+        # compiled step itself (coarse thresholds regardless: host
+        # noise is real; healthmon docstring)
+        if not self._last_dispatch_fresh:
+            try:
+                self._health.observe(step_seconds=now - t_entry)
+            except Exception:
+                pass
         # steady-state throughput between step ENTRIES: on an async
         # backend the dispatch returns early, so the inter-step gap is
         # the honest per-step wall time once the pipeline fills
@@ -915,6 +992,7 @@ class ParallelEngine:
                 peak, config=getattr(self.model, "config", None)))
         self._prev_step_entry = t_entry
         self._pending_scalars = (lv, gnorm)
+        self._pending_found = found
         # gradient-sync bucketing: how many per-bucket collectives the
         # compiled step issues (0 = the unbucketed tail sync, i.e.
         # sharding_configs["comm_overlap"] off or nothing bucketable)
@@ -979,6 +1057,14 @@ class ParallelEngine:
                 self._live_peak = max(self._live_peak, lb)
                 m["mem_live"].set(lb)
                 m["mem_live_peak"].set(self._live_peak)
+        # goodput gauges: the live view of the attached run ledger
+        # (the crash-durable journal remains the source of truth)
+        led_gp = _gp.current()
+        if led_gp is not None:
+            try:
+                led_gp.publish(m)
+            except Exception:
+                pass    # a dead journal must not take the step down
         from ..observability import get_registry
 
         get_registry().snapshot()    # feeds the stall flight-record ring
@@ -1003,6 +1089,14 @@ class ParallelEngine:
         return {"local_tokens_per_sec": local,
                 "pod_tokens_per_sec": total,
                 "processes": float(jax.process_count())}
+
+    def pod_step_skew(self) -> Dict[str, Any]:
+        """Cross-host straggler check: all-gather every host's last
+        inter-step interval (the pod_throughput pattern — synchronizes
+        all hosts, call BETWEEN steps) and publish the
+        paddle_tpu_health_step_time_skew / slowest_host gauges. A
+        persistently hot skew names the straggler host."""
+        return self._health.observe_pod_skew(self._last_step_seconds)
 
     # -- communication accounting (observability/commledger) ------------
     def comm_ledger(self):
@@ -1107,6 +1201,7 @@ class ParallelEngine:
             "step_count": opt._step_count,
             "seed": self._seed,
             "pending": self._pending_scalars,
+            "pending_found": self._pending_found,
             "pending_moe": self._pending_moe,
         }
         from ..optimizer.lr import LRScheduler
@@ -1125,6 +1220,7 @@ class ParallelEngine:
         opt._step_count = snap["step_count"]
         self._seed = snap["seed"]
         self._pending_scalars = snap["pending"]
+        self._pending_found = snap["pending_found"]
         self._pending_moe = snap["pending_moe"]
         if "lr_state" in snap:
             opt._lr.__dict__.update(snap["lr_state"])
@@ -1216,7 +1312,19 @@ class ParallelEngine:
 
         Restoring never changes a shape, dtype, sharding spec, or the
         master-weight key set, so already-compiled steps keep hitting
-        their cache — 0 recompiles after restore (pinned by tests)."""
+        their cache — 0 recompiles after restore (pinned by tests).
+        Restore also never touches CompileStats: the warmup compile of
+        a restored engine books as a compile exactly once, and a
+        restore into an already-compiled engine books nothing (pinned
+        by tests against the registry counters too). Wall time spent
+        here is journaled as the goodput ``restore`` segment."""
+        with _gp.segment("restore"):
+            meta = self._restore_checkpoint_inner(path, scaler)
+        self._post_restore_warmup = True
+        return meta
+
+    def _restore_checkpoint_inner(self, path: str, scaler=None
+                                  ) -> Dict[str, Any]:
         from ..core import rng as _rng_mod
         from ..optimizer.lr import LRScheduler
         from .checkpoint import load_state_dict, read_extra_meta, \

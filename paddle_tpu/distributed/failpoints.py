@@ -63,6 +63,11 @@ KNOWN_SITES = (
     "store.set",            # TCPStore.set
     "store.get",            # TCPStore.get
     "engine.step_dispatch",  # ParallelEngine step entry
+    # telemetry-only loss perturbation: arm with action "corrupt"
+    # (e.g. "health.loss_spike=corrupt@12") to make the health
+    # monitor's N-th OBSERVED loss a spike — training state is
+    # untouched (observability/healthmon.py)
+    "health.loss_spike",
 )
 
 _ACTIONS = ("raise", "hang", "corrupt", "kill")

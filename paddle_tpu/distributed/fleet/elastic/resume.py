@@ -29,6 +29,7 @@ from typing import Any, Callable, Dict, Optional, Tuple
 
 import jax
 
+from ....observability import goodput as _gp
 from ...checkpoint import (latest_committed, load_state_dict,
                            read_extra_meta, resolve_committed,
                            save_state_dict)
@@ -123,33 +124,35 @@ def load_train_state(path: str, model, optimizer=None) -> Dict[str, Any]:
             f"no committed checkpoint at {path!r} (resume_latest(base) "
             "falls back to the newest committed one)")
     meta = read_extra_meta(resolved)
-    # phase 1: model params FIRST — any optimizer state materialized
-    # below (fresh multi-precision masters) must copy the LOADED
-    # weights, never the pre-load random init
-    model_t = {"model": model.state_dict()}
-    load_state_dict(model_t, resolved)
-    model.set_state_dict(model_t["model"])
-    if optimizer is None:
-        return meta
+    with _gp.segment("restore"):
+        # phase 1: model params FIRST — any optimizer state
+        # materialized below (fresh multi-precision masters) must copy
+        # the LOADED weights, never the pre-load random init
+        model_t = {"model": model.state_dict()}
+        load_state_dict(model_t, resolved)
+        model.set_state_dict(model_t["model"])
+        if optimizer is None:
+            return meta
 
-    from ....optimizer.lr import LRScheduler
+        from ....optimizer.lr import LRScheduler
 
-    # moments not materialized yet (fresh optimizer): allocate them so
-    # the load has shaped targets to fill (AFTER the param load above —
-    # fresh multi-precision masters must copy the LOADED weights)
-    shapes = optimizer._state_shapes()
-    if shapes:
-        for p in optimizer._parameter_list:
-            optimizer._param_state(p, shapes)
-    slots, tensors = opt_state_tensors(model, optimizer)
-    if tensors:
-        load_state_dict({"optim": tensors}, resolved)
-        _apply_opt_state(optimizer, slots, tensors)
-    optimizer._step_count = int(meta.get("opt_step_count",
-                                         meta["step"]))
-    if "lr_scheduler" in meta and isinstance(optimizer._lr,
-                                             LRScheduler):
-        optimizer._lr.set_state_dict(meta["lr_scheduler"])
+        # moments not materialized yet (fresh optimizer): allocate
+        # them so the load has shaped targets to fill (AFTER the param
+        # load above — fresh multi-precision masters must copy the
+        # LOADED weights)
+        shapes = optimizer._state_shapes()
+        if shapes:
+            for p in optimizer._parameter_list:
+                optimizer._param_state(p, shapes)
+        slots, tensors = opt_state_tensors(model, optimizer)
+        if tensors:
+            load_state_dict({"optim": tensors}, resolved)
+            _apply_opt_state(optimizer, slots, tensors)
+        optimizer._step_count = int(meta.get("opt_step_count",
+                                             meta["step"]))
+        if "lr_scheduler" in meta and isinstance(optimizer._lr,
+                                                 LRScheduler):
+            optimizer._lr.set_state_dict(meta["lr_scheduler"])
     return meta
 
 
@@ -161,6 +164,14 @@ def resume_latest(base: str, model, optimizer=None
     commit-marker scan; a checkpoint that turns out corrupt mid-load
     raises CheckpointCorruptError — delete it and call again to fall
     back one more save."""
+    # continue the run's goodput journal FIRST: a journal left behind
+    # by a killed process gets its dangling tail closed as the
+    # recovery_restart segment the moment the relaunch scans for a
+    # checkpoint — before any restore work books its own segment
+    try:
+        _gp.attach_dir(base)
+    except OSError:
+        pass            # unwritable base surfaces on the load below
     path = latest_committed(base)
     if path is None:
         return None
@@ -203,6 +214,8 @@ def train_with_recovery(step_fn: Callable[[int], Any], total_steps: int,
         if elastic is not None and elastic.restart_needed:
             _dump_flight(f"elastic: world changed before step {step} "
                          f"(status {elastic.status.name})")
+            _gp.note_event("restart_signal", step=step,
+                           reason="elastic_world_change")
             return ("restart", step)
         try:
             if watchdog is not None:
@@ -214,6 +227,8 @@ def train_with_recovery(step_fn: Callable[[int], Any], total_steps: int,
                 out = step_fn(step)
         except TimeoutError_:
             # the watchdog already dumped the flight record on its way up
+            _gp.note_event("restart_signal", step=step,
+                           reason="watchdog_timeout")
             return ("restart", step)
         if on_step is not None:
             on_step(step, out)

@@ -274,7 +274,11 @@ class ServingEngine:
     def _shed(self, req: ServingRequest, reason: str):
         """Load-shed a queued request: it finishes with no tokens, no
         TTFT observation (shed latency must not pollute the latency
-        SLO histograms), and a shed counter tick."""
+        SLO histograms), and a shed counter tick. The decision itself
+        lands in the request's span trace as a zero-length "shed"
+        event (an "i" instant in the Chrome export), so
+        export_request_traces shows shed requests — when and why they
+        were turned away — not just the ones that completed."""
         req.shed_reason = reason
         req.t_finish = time.perf_counter()
         self.finished[req.rid] = req
@@ -283,8 +287,15 @@ class ServingEngine:
         m["shed"].inc(reason=reason)
         tr = self._live_traces.pop(req.rid, None)
         if tr is not None:
-            tr.end("queued", req.t_finish)
+            # the queued span closes but is NOT observed on the stage
+            # histogram — shed latency stays out of the SLO percentiles
+            # exactly like the TTFT exclusion above
+            sp = tr.end("queued", req.t_finish)
             tr.meta["shed_reason"] = reason
+            tr.add("shed", req.t_finish, req.t_finish,
+                   {"reason": reason,
+                    "queued_seconds": (sp.seconds if sp is not None
+                                       else 0.0)})
             self.traces.add(tr)
 
     def health(self) -> str:

@@ -28,7 +28,17 @@ subsystems instrument into:
   headroom percentages),
 - **spans**    — per-request serving lifecycle traces
   (queued → prefill → decode rounds) in a bounded ring with
-  Chrome-trace export (``ServingEngine.export_request_traces``).
+  Chrome-trace export (``ServingEngine.export_request_traces``),
+- **goodput**  — run-level wall-clock attribution (``goodput``): every
+  second of a — possibly crash-interrupted — run booked to a closed
+  segment set (compile / step_compute / ckpt_stall / ckpt_async /
+  restore / recovery_restart / input_wait / idle) in a crash-durable
+  JSONL journal under the checkpoint base dir; ``goodput_pct`` spans
+  restart boundaries (``tools/run_report.py`` renders the waterfall),
+- **health**   — rolling robust (median + MAD) anomaly events over
+  loss / grad-norm / step time (``healthmon``): spike events + flight
+  records + a degraded ``/healthz`` component + cross-host straggler
+  gauges.
 
 Exports: Prometheus text exposition + JSONL sink + in-process
 snapshots (metrics.py), plus an optional stdlib HTTP ``/metrics``
@@ -48,10 +58,14 @@ from .flight import FlightRecorder, dump as dump_flight_record, \
     get_recorder  # noqa: F401
 from . import flops  # noqa: F401
 from . import commledger  # noqa: F401
+from . import goodput  # noqa: F401
+from . import healthmon  # noqa: F401
 from . import memledger  # noqa: F401
 from . import moestats  # noqa: F401
 from . import spans  # noqa: F401
 from .commledger import CommLedger  # noqa: F401
+from .goodput import GoodputLedger  # noqa: F401
+from .healthmon import HealthMonitor  # noqa: F401
 from .memledger import MemLedger, RooflineReport, StateAccounting  # noqa: F401,E501
 from .spans import RequestTrace, SpanRing  # noqa: F401
 from .exporter import MetricsServer, serve_metrics  # noqa: F401
@@ -61,7 +75,8 @@ __all__ = [
     "DEFAULT_LATENCY_BUCKETS", "get_registry", "reset_registry",
     "parse_prometheus_text", "annotate", "current_regions",
     "FlightRecorder", "dump_flight_record", "get_recorder", "flops",
-    "cross_host_sum", "commledger", "CommLedger", "memledger",
+    "cross_host_sum", "commledger", "CommLedger", "goodput",
+    "GoodputLedger", "healthmon", "HealthMonitor", "memledger",
     "MemLedger", "RooflineReport", "StateAccounting", "moestats",
     "spans", "RequestTrace", "SpanRing", "MetricsServer",
     "serve_metrics",

@@ -17,7 +17,8 @@ from .metrics import (DEFAULT_LATENCY_BUCKETS, MetricsRegistry,
                       get_registry)
 
 __all__ = ["train_metrics", "serving_metrics", "comm_metrics",
-           "mem_metrics", "ckpt_metrics", "SCHEMA_PATH"]
+           "mem_metrics", "ckpt_metrics", "goodput_metrics",
+           "health_metrics", "SCHEMA_PATH"]
 
 SCHEMA_PATH = __file__.rsplit("/", 1)[0] + "/schema.json"
 
@@ -165,12 +166,88 @@ def ckpt_metrics(reg: MetricsRegistry = None) -> Dict[str, object]:
     }
 
 
+def goodput_metrics(reg: MetricsRegistry = None) -> Dict[str, object]:
+    """Register (get-or-create) the run-level goodput instrument set —
+    published by the attached :class:`observability.goodput.
+    GoodputLedger` (wall-clock attribution across restarts; the
+    crash-durable journal under the checkpoint base dir is the source
+    of truth, these gauges are its live view)."""
+    r = reg or get_registry()
+    return {
+        "goodput_segments": r.gauge(
+            "paddle_tpu_goodput_segment_seconds",
+            "cumulative run wall time attributed to each goodput "
+            "segment (compile / step_compute / ckpt_stall / ckpt_async "
+            "/ restore / recovery_restart / input_wait / idle), "
+            "restart-spanning (observability/goodput.py journal)",
+            labelnames=("segment",), unit="s"),
+        "goodput_pct": r.gauge(
+            "paddle_tpu_goodput_pct",
+            "productive step seconds / run wall seconds x 100, across "
+            "restart boundaries — the run-level goodput headline",
+            unit="pct"),
+        "goodput_wall": r.gauge(
+            "paddle_tpu_goodput_wall_seconds",
+            "wall seconds since the run's first journal record, "
+            "crashes and restarts included", unit="s"),
+        "goodput_restarts": r.gauge(
+            "paddle_tpu_goodput_restarts",
+            "process restarts the run's goodput journal has absorbed "
+            "(each closed a recovery_restart segment)"),
+    }
+
+
+def health_metrics(reg: MetricsRegistry = None) -> Dict[str, object]:
+    """Register (get-or-create) the training health-monitor instrument
+    set (observability/healthmon.py: rolling median+MAD anomaly events
+    over loss / grad-norm / step time, cross-host straggler skew)."""
+    r = reg or get_registry()
+    return {
+        "events": r.counter(
+            "paddle_tpu_health_events_total",
+            "health anomaly events by kind: loss_spike / "
+            "grad_norm_spike / loss_nonfinite / step_time_stall "
+            "(robust rolling median+MAD detection; each event also "
+            "lands in the goodput journal and may dump a flight "
+            "record)", labelnames=("kind",)),
+        "loss_z": r.gauge(
+            "paddle_tpu_health_loss_zscore",
+            "robust z-score of the last observed loss against its "
+            "rolling window (0 while the window is warming up)"),
+        "grad_norm_z": r.gauge(
+            "paddle_tpu_health_grad_norm_zscore",
+            "robust z-score of the last observed global grad-norm "
+            "against its rolling window"),
+        "step_time_z": r.gauge(
+            "paddle_tpu_health_step_time_zscore",
+            "robust z-score of the last observed step time against "
+            "its rolling window"),
+        "degraded": r.gauge(
+            "paddle_tpu_health_degraded",
+            "1 while the health monitor is within degraded_window_s "
+            "of its last anomaly event (mirrors the /healthz "
+            "component verdict), else 0"),
+        "step_time_skew": r.gauge(
+            "paddle_tpu_health_step_time_skew",
+            "(slowest host's step time - median) / median across the "
+            "pod, from observe_pod_skew's cross-host all_gather — "
+            "0 on a single process; a persistently hot value names a "
+            "straggler host"),
+        "slowest_host": r.gauge(
+            "paddle_tpu_health_slowest_host",
+            "process index of the slowest host in the last "
+            "observe_pod_skew exchange"),
+    }
+
+
 def train_metrics(reg: MetricsRegistry = None) -> Dict[str, object]:
     """Register (get-or-create) the training instrument set."""
     r = reg or get_registry()
     out = comm_metrics(r)
     out.update(mem_metrics(r))
     out.update({f"ckpt_{k}": v for k, v in ckpt_metrics(r).items()})
+    out.update(goodput_metrics(r))
+    out.update({f"health_{k}": v for k, v in health_metrics(r).items()})
     out.update({
         "step_seconds": r.histogram(
             "paddle_tpu_train_step_seconds",

@@ -142,6 +142,18 @@ class SpanRing:
             for sp in tr.spans:
                 if sp.t1 is None:
                     continue
+                if sp.t1 == sp.t0:
+                    # zero-length span = a point event (a shed
+                    # decision, an eviction): Chrome "i" instant
+                    # events render as markers instead of vanishing
+                    # as 0-width "X" slices
+                    events.append({
+                        "ph": "i", "cat": "serving", "name": sp.name,
+                        "pid": 0, "tid": tr.rid, "s": "t",
+                        "ts": (sp.t0 - t_base) * 1e6,
+                        "args": {**tr.meta, **sp.meta},
+                    })
+                    continue
                 events.append({
                     "ph": "X", "cat": "serving", "name": sp.name,
                     "pid": 0, "tid": tr.rid,

@@ -235,8 +235,13 @@ class TestCheckpointManager:
         mgr = CheckpointManager(str(tmp_path), keep_last_k=2)
         for s in (1, 2, 3, 4):
             mgr.save(m.state_dict(), step=s)
-        names = sorted(os.listdir(str(tmp_path)))
+        # the base also carries the run's goodput journal (PR 11) —
+        # retention is about the step_* checkpoint dirs
+        names = sorted(n for n in os.listdir(str(tmp_path))
+                       if n.startswith("step_"))
         assert names == ["step_00000003", "step_00000004"]
+        assert sorted(os.listdir(str(tmp_path))) == \
+            ["goodput.jsonl"] + names
         assert mgr.latest_step() == 4
 
     def test_newest_committed_fallback_after_crash(self, tmp_path):

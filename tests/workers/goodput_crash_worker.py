@@ -1,0 +1,141 @@
+"""Goodput-journal crash worker: train with rolling checkpoints and a
+crash-durable goodput ledger, optionally dying at an armed failpoint
+(PADDLE_TPU_FAILPOINTS, e.g. "ckpt.write_shard=kill@2"); on relaunch,
+auto-resume from the newest COMMITTED checkpoint and CONTINUE the same
+goodput journal (the dangling segment the kill left behind is closed
+as recovery_restart).
+
+Env: CKPT_BASE, TOTAL_STEPS, SAVE_EVERY, TEST_OUT, HYBRID (1 = the
+gpt13b smoke topology mp2 x pp2 x sharding2 on 8 virtual devices —
+export XLA_FLAGS accordingly), SAVE_ASYNC, KEEP_LAST_K.
+
+On clean completion <TEST_OUT>.json records {"start": resumed-from
+step, "goodput": <ledger summary>, "compiles": engine XLA compiles}.
+Losses stream to <TEST_OUT>.log one per line (flushed per step).
+"""
+import json
+import os
+import sys
+
+os.environ.setdefault("XLA_FLAGS",
+                      "--xla_force_host_platform_device_count=1")
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np  # noqa: E402
+
+import paddle_tpu as paddle  # noqa: E402
+from paddle_tpu.distributed.checkpoint import (CheckpointManager,  # noqa: E402
+                                               latest_committed)
+from paddle_tpu.observability import goodput  # noqa: E402
+
+
+def _build_simple():
+    from paddle_tpu.distributed.engine import ParallelEngine
+    from paddle_tpu.models import (GPTForCausalLM,
+                                   GPTPretrainingCriterion, gpt_tiny)
+
+    paddle.seed(42)
+    cfg = gpt_tiny()
+    model = GPTForCausalLM(cfg)
+    crit = GPTPretrainingCriterion(cfg)
+    opt = paddle.optimizer.AdamW(learning_rate=5e-3,
+                                 parameters=model.parameters())
+    eng = ParallelEngine(model, opt)
+    step_fn = eng.train_step(lambda m, b: crit(m(b["x"]), b["y"]))
+    return cfg, eng, None, step_fn, 8
+
+
+def _build_hybrid():
+    """The gpt13b smoke topology (mp2 x pp2 x sharding2, vpp2)."""
+    from paddle_tpu.distributed import fleet
+    from paddle_tpu.models import GPTForCausalLMPipe
+    from paddle_tpu.models.gpt import GPTConfig
+
+    paddle.seed(42)
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {
+        "dp_degree": 1, "mp_degree": 2, "pp_degree": 2,
+        "sharding_degree": 2,
+        "pp_configs": {"num_virtual_pipeline_stages": 2}}
+    strategy.sharding_configs = {"stage": 2}
+    strategy.pipeline_configs = {"accumulate_steps": 2,
+                                 "micro_batch_size": 2}
+    fleet.init(is_collective=True, strategy=strategy)
+    cfg = GPTConfig(vocab_size=128, hidden_size=32, num_layers=4,
+                    num_heads=4, max_position_embeddings=32)
+    model = GPTForCausalLMPipe(cfg)
+    dm = fleet.distributed_model(model)
+    opt = fleet.distributed_optimizer(paddle.optimizer.AdamW(
+        learning_rate=1e-3, parameters=model.parameters()))
+
+    def step_fn(batch):
+        return dm.train_batch([batch["x"], batch["y"]], opt)
+
+    return cfg, dm, opt, step_fn, 8
+
+
+def batch(step, B, S, V):
+    r = np.random.RandomState(1000 + step)
+    ids = r.randint(0, V, (B, S + 1))
+    return {"x": paddle.to_tensor(ids[:, :-1]),
+            "y": paddle.to_tensor(ids[:, 1:])}
+
+
+def main():
+    out = os.environ["TEST_OUT"]
+    base = os.environ["CKPT_BASE"]
+    total = int(os.environ.get("TOTAL_STEPS", "10"))
+    save_every = int(os.environ.get("SAVE_EVERY", "2"))
+    async_save = os.environ.get("SAVE_ASYNC", "") == "1"
+    keep = int(os.environ.get("KEEP_LAST_K", "2"))
+    hybrid = os.environ.get("HYBRID", "") == "1"
+
+    # the journal FIRST: a relaunch closes the killed run's dangling
+    # segment as recovery_restart before anything else books time
+    led = goodput.attach_dir(base)
+
+    if hybrid:
+        cfg, eng, opt, step_fn, B = _build_hybrid()
+    else:
+        cfg, eng, opt, step_fn, B = _build_simple()
+
+    start = 0
+    latest = latest_committed(base)
+    if latest is not None:
+        # the hybrid wrapper builds its engine lazily: restoring
+        # before the first train_batch needs the optimizer
+        meta = (eng.restore_checkpoint(latest, optimizer=opt)
+                if hybrid else eng.restore_checkpoint(latest))
+        start = int(meta["step"])
+
+    mgr = CheckpointManager(base, keep_last_k=keep,
+                            async_save=async_save)
+    log = open(f"{out}.log", "a")
+    S, V = 16, cfg.vocab_size
+    for step in range(start, total):
+        with goodput.segment("input_wait"):
+            b = batch(step, B, S, V)
+        loss = step_fn(b)
+        log.write(f"{float(loss)!r}\n")
+        log.flush()
+        if (step + 1) % save_every == 0 and step + 1 < total:
+            eng.save_checkpoint(manager=mgr, step=step + 1)
+    mgr.wait()
+    mgr.close()
+    log.close()
+    stats = (eng._engine.stats if hybrid and eng._engine is not None
+             else getattr(eng, "stats", None))
+    compiles = stats.compiles if stats is not None else None
+    with open(f"{out}.json", "w") as f:
+        json.dump({"start": start, "goodput": led.summary(),
+                   "compiles": compiles}, f)
+
+
+if __name__ == "__main__":
+    main()

@@ -19,14 +19,22 @@ driver's ``BENCH_r<NN>.json`` snapshots — the longitudinal view
   compute/hbm/ici/unknown) per metric, so a config drifting toward
   the memory wall is visible across rounds even while tokens/s holds.
 
+Bench lines that carry a ``goodput`` section (run-level wall-clock
+attribution, observability/goodput.py) additionally get a **goodput
+column** next to the verdicts: ``goodput_pct`` plus the per-segment
+percentage breakdown, so "compute-bound at 60% goodput" reads as one
+line. ``--strict`` exits 1 when the newest round's ``goodput_pct``
+regresses against the previous round by more than
+``--goodput-drop-pp`` percentage points on any line (the roofline /
+memory tables stay report-only; bench_compare owns the throughput
+gates).
+
 Usage::
 
-    python -m tools.step_report [--dir REPO] [--json]
+    python -m tools.step_report [--dir REPO] [--json] [--strict]
 
-Exit codes mirror bench_compare: 0 on success, 2 when no BENCH_r*.json
-rounds exist. The tool only reads; it never gates (bench_compare owns
-regression verdicts — the memory/roofline metric lines are registered
-there).
+Exit codes: 0 on success, 1 on a --strict goodput regression, 2 when
+no BENCH_r*.json rounds exist.
 """
 from __future__ import annotations
 
@@ -37,7 +45,8 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from tools.bench_compare import load_rounds, parse_metrics
 
-__all__ = ["roofline_rows", "memory_rows", "verdict_trajectory", "main"]
+__all__ = ["roofline_rows", "memory_rows", "verdict_trajectory",
+           "goodput_rows", "goodput_regressions", "main"]
 
 _BOUND_LETTER = {"compute-bound": "C", "hbm-bound": "H",
                  "ici-bound": "I", "unknown": "?"}
@@ -102,6 +111,46 @@ def memory_rows(metrics: Dict[str, Dict[str, Any]]
     return rows
 
 
+def goodput_rows(metrics: Dict[str, Dict[str, Any]]
+                 ) -> List[Dict[str, Any]]:
+    """Per bench line carrying a ``goodput`` section: the headline
+    percentage and the per-segment breakdown, flattened for the
+    table."""
+    rows = []
+    for name, line in sorted(metrics.items()):
+        gp = line.get("goodput")
+        if not isinstance(gp, dict):
+            continue
+        rows.append({
+            "metric": name,
+            "goodput_pct": float(gp.get("goodput_pct", 0.0)),
+            "wall_seconds": float(gp.get("wall_seconds", 0.0)),
+            "restarts": int(gp.get("restarts", 0)),
+            "segment_pct": dict(gp.get("segment_pct", {})),
+        })
+    return rows
+
+
+def goodput_regressions(prev: Dict[str, Dict[str, Any]],
+                        new: Dict[str, Dict[str, Any]],
+                        drop_pp: float) -> List[Dict[str, Any]]:
+    """Lines whose ``goodput_pct`` fell by more than ``drop_pp``
+    percentage points between two rounds (the --strict gate)."""
+    prev_rows = {r["metric"]: r for r in goodput_rows(prev)}
+    out = []
+    for r in goodput_rows(new):
+        p = prev_rows.get(r["metric"])
+        if p is None:
+            continue
+        drop = p["goodput_pct"] - r["goodput_pct"]
+        if drop > drop_pp:
+            out.append({"metric": r["metric"],
+                        "prev": p["goodput_pct"],
+                        "value": r["goodput_pct"],
+                        "drop_pp": round(drop, 2)})
+    return out
+
+
 def verdict_trajectory(rounds: List[Tuple[int, str]]
                        ) -> Dict[str, List[str]]:
     """{metric: [bound letter per round]} over every line that ever
@@ -128,6 +177,13 @@ def main(argv=None) -> int:
                     help="directory holding BENCH_r*.json (default .)")
     ap.add_argument("--json", action="store_true", dest="as_json",
                     help="emit the report as one JSON doc")
+    ap.add_argument("--strict", action="store_true",
+                    help="exit 1 when goodput_pct regresses vs the "
+                         "previous round")
+    ap.add_argument("--goodput-drop-pp", type=float, default=5.0,
+                    help="--strict tolerance: max goodput_pct drop in "
+                         "percentage points (default 5.0 — CPU smoke "
+                         "wall clocks are noisy)")
     args = ap.parse_args(argv)
 
     rounds = load_rounds(args.dir)
@@ -139,30 +195,57 @@ def main(argv=None) -> int:
     metrics = parse_metrics(tail)
     roof = roofline_rows(metrics)
     mem = memory_rows(metrics)
+    goodput = goodput_rows(metrics)
     traj = verdict_trajectory(rounds)
+    regressions: List[Dict[str, Any]] = []
+    if len(rounds) >= 2:
+        regressions = goodput_regressions(
+            parse_metrics(rounds[-2][1]), metrics,
+            args.goodput_drop_pp)
 
     if args.as_json:
         print(json.dumps({"round": n_new, "roofline": roof,
-                          "memory": mem,
+                          "memory": mem, "goodput": goodput,
+                          "goodput_regressions": regressions,
                           "verdict_trajectory": traj,
                           "rounds": [n for n, _ in rounds]}, indent=1))
-        return 0
+        return 1 if (args.strict and regressions) else 0
 
     print(f"step_report: round r{n_new:02d}")
     if not roof and not mem:
         print("  (no memory/roofline sections in this round — rerun "
               "bench.py with the memory ledger on)")
+    gp_by_name = {r["metric"]: r for r in goodput}
+    if goodput:
+        width = max(len(r["metric"]) for r in goodput)
+        print("\ngoodput (run-level wall-clock attribution; "
+              "tools/run_report.py has the full waterfall)")
+        for r in goodput:
+            segs = " ".join(
+                f"{seg} {pct:.0f}%" for seg, pct in sorted(
+                    r["segment_pct"].items(), key=lambda kv: -kv[1])
+                if pct >= 0.5)
+            print(f"  {r['metric']:<{width}} "
+                  f"{r['goodput_pct']:>6.2f}%  wall "
+                  f"{r['wall_seconds']:.3g}s  restarts "
+                  f"{r['restarts']}  [{segs}]")
     if roof:
         width = max(len(r["metric"]) for r in roof)
         print("\nroofline verdicts "
               "(floor seconds | headroom% compute/hbm/ici)")
         for r in roof:
             s, h = r["seconds"], r["headroom_pct"]
+            # the goodput column: a bench line carrying both sections
+            # reads "hbm-bound at 61% goodput" in one row
+            gp = gp_by_name.get(r["metric"])
+            gp_s = (f"  goodput {gp['goodput_pct']:.1f}%"
+                    if gp is not None else "")
             print(f"  {r['metric']:<{width}} {r['bound']:>13}  "
                   f"step {r['step_seconds']:.4g}s  "
                   f"c {s.get('compute', 0):.3g}s/{h.get('compute', 0):.0f}% "
                   f"h {s.get('hbm', 0):.3g}s/{h.get('hbm', 0):.0f}% "
-                  f"i {s.get('ici', 0):.3g}s/{h.get('ici', 0):.0f}%")
+                  f"i {s.get('ici', 0):.3g}s/{h.get('ici', 0):.0f}%"
+                  f"{gp_s}")
     if mem:
         print("\nmemory (per-executable + state accounting)")
         for r in mem:
@@ -185,7 +268,12 @@ def main(argv=None) -> int:
         width = max(len(m) for m in traj)
         for name, letters in traj.items():
             print(f"  {name:<{width}} {' '.join(letters)}")
-    return 0
+    if regressions:
+        print(f"\n{len(regressions)} goodput regression(s): "
+              + ", ".join(f"{r['metric']} {r['prev']:.1f}% -> "
+                          f"{r['value']:.1f}% (-{r['drop_pp']:.1f}pp)"
+                          for r in regressions))
+    return 1 if (args.strict and regressions) else 0
 
 
 if __name__ == "__main__":
