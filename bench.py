@@ -465,6 +465,176 @@ def bench_serving_mixed(on_tpu, dev):
 
 
 # ---------------------------------------------------------------------------
+# 5d. Chunked prefill vs head-of-line prefill under a Poisson
+# mixed-length stream (the serving_mixed_traffic line's latency axis):
+# long prompts are injected mid-decode into a stream of short requests,
+# and the SAME arrival schedule is served twice — chunked prefill ON
+# (prompts folded into the unified ragged [B, Sc] step, decode rows
+# advancing every round) vs OFF (each arrival's prefill runs as its own
+# program, stalling every in-flight decode row). The JSON lines carry
+# TPOT p99 for both, the ragged-kernel parity gate, and the memledger
+# comparison of the unified program's HBM traffic against the old
+# prefill+decode two-program sum.
+# ---------------------------------------------------------------------------
+def bench_serving_chunked(on_tpu, dev):
+    import paddle_tpu as paddle
+    from paddle_tpu.inference import Config, ServingEngine, \
+        create_predictor
+    from paddle_tpu.models.llama import (LlamaConfig, LlamaForCausalLM,
+                                         llama_7b)
+
+    old_dtype = paddle.get_default_dtype()
+    if on_tpu:
+        paddle.set_default_dtype("bfloat16")
+        cfg = llama_7b(max_position_embeddings=2304, dtype="bfloat16")
+        page, B, Sc = 128, 8, 256
+        short_lens, long_len = (64, 96, 128), 1536
+        n_short, n_long, new_s, new_l = 24, 3, 32, 16
+        rate = 1.2                      # arrivals per decode round
+    else:
+        # the tiny smoke config with a longer position space so the
+        # injected long prompts tower over the short stream (the HOL
+        # contrast the line measures)
+        cfg = LlamaConfig(vocab_size=256, hidden_size=64, num_layers=2,
+                          num_heads=4, num_kv_heads=2,
+                          intermediate_size=128,
+                          max_position_embeddings=512)
+        page, B, Sc = 8, 4, 32
+        short_lens, long_len = (6, 9, 12, 15), 192
+        n_short, n_long, new_s, new_l = 18, 3, 12, 8
+        rate = 0.8
+    try:
+        paddle.seed(0)
+        model = LlamaForCausalLM(cfg)
+        conf = Config().set_model(model).enable_paged_kv(page_size=page)
+        if on_tpu:
+            conf.enable_weight_only("weight_only_int8")
+        pred = create_predictor(conf)
+        r = np.random.RandomState(7)
+
+        # Poisson arrival schedule in decode-round time: short requests
+        # stream steadily, long prompts land mid-decode (the HOL test)
+        gaps = r.exponential(1.0 / rate, n_short)
+        arrivals = [(float(t), int(r.choice(short_lens)), new_s)
+                    for t in np.cumsum(gaps)]
+        span = arrivals[-1][0]
+        for k in range(n_long):
+            arrivals.append((span * (k + 1.0) / (n_long + 1.0),
+                             long_len, new_l))
+        arrivals.sort()
+        prompts = [(t, r.randint(1, cfg.vocab_size, (L,)), n)
+                   for t, L, n in arrivals]
+
+        def serve(chunked):
+            eng = ServingEngine(
+                pred, max_batch=B, mem_ledger=True,
+                prefill_chunk=Sc if chunked else None)
+            # warmup: one short + one long through every program shape
+            for L in (short_lens[0], long_len):
+                eng.submit(r.randint(1, cfg.vocab_size, (L,)),
+                           max_new_tokens=2)
+            eng.run()
+            warm = eng.stats.compiles
+            t0 = time.perf_counter()
+            rnd, i = 0, 0
+            while i < len(prompts) or eng.queue or eng.num_active:
+                while i < len(prompts) and prompts[i][0] <= rnd:
+                    _, ids, n = prompts[i]
+                    eng.submit(ids, max_new_tokens=n)
+                    i += 1
+                eng.step()
+                rnd += 1
+            dt = max(time.perf_counter() - t0, 1e-4)
+            tpots = [(q.t_finish - q.t_first_token)
+                     / (len(q.new_tokens) - 1)
+                     for q in eng.finished.values()
+                     if len(q.new_tokens) > 1 and q.t_first_token]
+            n_tok = sum(len(q.new_tokens) for q in eng.finished.values())
+            return eng, {
+                "tpot_p50_ms": round(float(np.percentile(tpots, 50))
+                                     * 1e3, 3),
+                "tpot_p99_ms": round(float(np.percentile(tpots, 99))
+                                     * 1e3, 3),
+                "tokens_per_sec": round(n_tok / dt, 2),
+                "recompiles_after_warmup": eng.stats.compiles - warm,
+                "rounds": rnd,
+            }
+
+        eng_on, on = serve(chunked=True)
+        eng_off, off = serve(chunked=False)
+        # the acceptance gate: the fixed lattice must absorb the whole
+        # stream with ZERO post-warmup compiles in BOTH modes
+        assert on["recompiles_after_warmup"] == 0, on
+        assert off["recompiles_after_warmup"] == 0, off
+
+        # memledger: the unified program's HBM traffic vs the old
+        # prefill+decode two-program sum (measurable on chip; the CPU
+        # backend has no memory_analysis and reports unknown)
+        from paddle_tpu.core.bucketing import bucket as _bucket
+
+        led_u = eng_on.memory_ledger(("unified", eng_on.Sc))
+        led_p = eng_off.memory_ledger(
+            ("prefill", min(_bucket(long_len), eng_off.M)))
+        led_d = eng_off.memory_ledger(("decode",))
+        if led_u is not None and led_u.available and \
+                led_p is not None and led_p.available and \
+                led_d is not None and led_d.available:
+            two = led_p.traffic_bytes + led_d.traffic_bytes
+            hbm = {"unified_traffic_bytes": int(led_u.traffic_bytes),
+                   "two_program_traffic_bytes": int(two),
+                   "unified_le_two_program":
+                       bool(led_u.traffic_bytes <= two)}
+        else:
+            hbm = {"unified_le_two_program": "unknown (needs chips)"}
+
+        _emit({
+            "metric": "serving_mixed_traffic_tpot_p99_ms",
+            "value": on["tpot_p99_ms"],
+            "unit": "ms",
+            # the gate: chunked prefill must hold the TPOT tail below
+            # the head-of-line-blocking baseline on the same stream
+            "vs_baseline": round(off["tpot_p99_ms"]
+                                 / max(on["tpot_p99_ms"], 1e-9), 4),
+            "chunked_on": on, "chunked_off": off,
+            "prefill_chunk": Sc, "batch": B, "page_size": page,
+            "long_prompt_len": long_len, "requests": len(prompts),
+            "hbm": hbm,
+            "telemetry": _telemetry_section(),
+            "device": str(getattr(dev, "device_kind", dev.platform)),
+        })
+
+        # ragged-kernel parity gate (exact, bench_compare _EXACT): the
+        # unified kernel vs its dense XLA fallback on a mixed batch
+        # whose chunk straddles page boundaries — interpret mode off
+        # chip, Mosaic on chip
+        import jax.numpy as jnp
+
+        from paddle_tpu.ops.pallas.ragged_paged_attention import (
+            ragged_paged_attention, ragged_paged_attention_dense)
+
+        B2, Sq, H, KV, D, pg, npg = 4, 16, 8, 2, 128, 8, 16
+        P2 = B2 * npg + 5
+        q = jnp.asarray(r.randn(B2, Sq, H, D), jnp.float32)
+        kp = jnp.asarray(r.randn(P2, KV, pg, D), jnp.float32)
+        vp = jnp.asarray(r.randn(P2, KV, pg, D), jnp.float32)
+        tb = jnp.asarray(r.permutation(P2)[:B2 * npg].reshape(B2, npg),
+                         jnp.int32)
+        st = jnp.asarray([5, 77, 0, 0], jnp.int32)    # straddles pages
+        nv = jnp.asarray([16, 1, 16, 0], jnp.int32)   # chunk/decode/dead
+        diff = float(jnp.abs(
+            ragged_paged_attention(q, kp, vp, tb, st, nv)
+            - ragged_paged_attention_dense(q, kp, vp, tb, st, nv)).max())
+        ok = diff < 1e-4
+        _emit({"metric": "serving_ragged_kernel_parity",
+               "value": 1.0 if ok else 0.0, "unit": "pass",
+               "vs_baseline": 1.0 if ok else 0.0,
+               "max_abs_diff": diff,
+               "mode": "mosaic" if on_tpu else "interpret"})
+    finally:
+        paddle.set_default_dtype(old_dtype)
+
+
+# ---------------------------------------------------------------------------
 # 3. GPT-13B hybrid TP x PP x DP + GroupSharded stage2 (BASELINE row 3).
 # Needs >= 8 chips; on one chip it reports the requirement cleanly, and
 # on the CPU harness it runs the FULL hybrid code path on tiny shapes
@@ -1277,13 +1447,14 @@ _BENCHES = {}
 # driver's budget (the round-4 blackout: kernel_parity first + 1200s
 # each + headline printed last = one hang, zero lines).
 _TIMEOUTS = {"gpt": 900, "llama_decode": 420, "llama_decode_int8": 420,
-             "llama_decode_ragged": 420, "serving": 420, "resnet": 300,
+             "llama_decode_ragged": 420, "serving": 420,
+             "serving_chunked": 600, "resnet": 300,
              "moe": 300, "gpt_moe_hybrid": 420, "gpt13b_hybrid": 900,
              "tp_overlap": 240, "kernel_parity": 240,
              "ckpt_overlap": 420}
 _ORDER = ("gpt", "llama_decode", "llama_decode_int8",
-          "llama_decode_ragged", "serving", "resnet", "moe",
-          "gpt_moe_hybrid", "gpt13b_hybrid", "ckpt_overlap",
+          "llama_decode_ragged", "serving", "serving_chunked", "resnet",
+          "moe", "gpt_moe_hybrid", "gpt13b_hybrid", "ckpt_overlap",
           "tp_overlap", "kernel_parity")
 # benches that need a virtual multi-device mesh on the CPU fallback
 _NEEDS_VDEV = {"gpt13b_hybrid": 8, "tp_overlap": 8, "gpt_moe_hybrid": 8,
@@ -1409,6 +1580,7 @@ def main(argv):
                     llama_decode_int8=bench_llama_decode_int8,
                     llama_decode_ragged=bench_llama_decode_ragged,
                     serving=bench_serving_mixed,
+                    serving_chunked=bench_serving_chunked,
                     gpt_moe_hybrid=bench_gpt_moe_hybrid,
                     gpt13b_hybrid=bench_gpt13b_hybrid,
                     ckpt_overlap=bench_ckpt_overlap,

@@ -5,7 +5,10 @@ lengths, KV page-pool sizes, MoE expert capacity) are quantized onto a
 geometric lattice so jitter in the raw value never mints a new XLA
 program: each distinct bucket is one compilation, and the bucket count
 stays logarithmic in the dynamic range. One definition lives here —
-``inference`` (sequence/page lattice) and the MoE capacity path
+``inference`` (sequence/page lattice), the chunked-prefill chunk size
+(``ServingEngine(prefill_chunk=...)`` buckets with ``lo=page_size``,
+making the chunk a power-of-two multiple of the page so chunk
+frontiers land on page boundaries), and the MoE capacity path
 (incubate/.../moe/moe_layer.py) must stay on the SAME discipline so
 their compile-stability tests mean the same thing.
 """

@@ -25,6 +25,30 @@ program for arbitrary length mixes):
   shape. ``decode_chunk`` fuses that many decode steps into one
   ``lax.scan`` launch; admission/eviction happens at chunk boundaries.
 
+CHUNKED PREFILL (``prefill_chunk``, the Ragged Paged Attention design):
+the per-arrival prefill program above head-of-line-blocks every decode
+row for the length of the longest arriving prompt. With a chunk size
+set, prompts are instead split into <= Sc-token chunks (Sc power-of-two
+bucketed, a multiple of the page size) and folded into ONE unified
+compiled step of fixed shape [B, Sc]: every row carries host-side
+``(kind, start, seq_len)`` metadata — a prefill row feeds its next
+chunk (seq_len <= Sc), a decode row its last sampled token
+(seq_len = 1), an idle row nothing (seq_len = 0) — and the attention
+inside is the ragged paged kernel (ops/pallas/ragged_paged_attention),
+whose per-row DMA frontier makes the one program's HBM traffic come in
+at or below the old two-program sum. A token-budget policy
+(``prefill_token_budget``) caps prefill tokens per step so decode rows
+always advance: the worst-case inter-token stall under a long-prompt
+arrival drops from one full prefill to one chunk round. Rounds with no
+chunk to feed fall back to the cheap fused [B, 1] decode scan — both
+programs live on the same fixed lattice, so the zero-recompile
+guarantee is unchanged. Pages are reserved INCREMENTALLY per chunk
+(admission needs only the first chunk's pages; the decode tail is
+reserved before the last chunk feeds), so a long prompt no longer
+hoards pages it cannot use yet; a page-starved engine preempts the
+youngest mid-prefill row (no tokens sampled yet — restart is exact)
+back to the queue head rather than deadlock.
+
 Compile stability: every program is keyed on the small fixed lattice
 (batch B, seq bucket Sb, pool bucket P). After one warmup mix, a stream
 with arbitrary length mixes triggers ZERO additional XLA compiles —
@@ -90,13 +114,21 @@ class ServingRequest:
 class _Slot:
     """Host-side state of one in-flight batch row."""
 
-    __slots__ = ("req", "pages", "pos")
+    __slots__ = ("req", "pages", "pos", "state", "fed", "chunks", "seq")
 
-    def __init__(self, req: ServingRequest, pages: List[int]):
+    def __init__(self, req: ServingRequest, pages: List[int],
+                 state: str = "decode", seq: int = 0):
         self.req = req
         self.pages = pages
         # cache position the NEXT decode input token is written at
         self.pos = len(req.prompt)
+        # chunked-prefill scheduler state: "prefill" while prompt
+        # tokens remain unfed, then "decode"; legacy (unchunked) slots
+        # are born "decode" because admission prefills synchronously
+        self.state = state
+        self.fed = 0            # prompt tokens already written
+        self.chunks = 0         # chunks fed (span/telemetry index)
+        self.seq = seq          # admission order (scheduler fairness)
 
 
 class ServingEngine:
@@ -117,7 +149,9 @@ class ServingEngine:
                  trace_ring: int = 256, mem_ledger: bool = False,
                  max_queue: Optional[int] = None,
                  admission_deadline_s: Optional[float] = None,
-                 degraded_window_s: float = 30.0):
+                 degraded_window_s: float = 30.0,
+                 prefill_chunk: Optional[int] = None,
+                 prefill_token_budget: Optional[int] = None):
         import os
 
         from . import _bucket
@@ -136,6 +170,36 @@ class ServingEngine:
         enforce(self.B >= 1 and decode_chunk >= 1,
                 "max_batch and decode_chunk must be >= 1")
         self.chunk = int(decode_chunk)
+        # chunked prefill: prompts feed the unified [B, Sc] step in
+        # <= Sc-token chunks; Sc lives on the shared power-of-two
+        # lattice AND is a multiple of the page size (bucket with
+        # lo=page gives both), so chunk frontiers land on page
+        # boundaries and the compiled shape never varies
+        self.chunked = prefill_chunk is not None
+        if self.chunked:
+            enforce(int(prefill_chunk) >= 1, "prefill_chunk must be >= 1")
+            self.Sc = min(_bucket(int(prefill_chunk), lo=self.page),
+                          _bucket(self.M, lo=self.page))
+            import inspect
+
+            enforce("valid" in inspect.signature(
+                predictor._model.forward).parameters,
+                "prefill_chunk needs a model whose forward accepts the "
+                "unified ragged metadata kwarg `valid` (see "
+                "models/llama.py)")
+            self.prefill_budget = int(prefill_token_budget or self.Sc)
+            enforce(self.prefill_budget >= 1,
+                    "prefill_token_budget must be >= 1")
+        else:
+            self.Sc = 0
+            self.prefill_budget = 0
+        self._admit_seq = 0
+        # chunked-mode admission backpressure: while an active row is
+        # page-stalled, new admissions pause so the freed/free pages
+        # reach the OLDEST stalled row first (otherwise a preempted
+        # request could be readmitted straight into the pages its
+        # elder is waiting for — livelock)
+        self._page_stalled = False
         self._dtype = predictor._params[0]._value.dtype
         # one pool for the engine's whole lifetime, on the same bucket
         # lattice as Predictor._paged_caches: the compiled programs are
@@ -314,6 +378,19 @@ class ServingEngine:
     def _pages_needed(self, L: int, n_new: int) -> int:
         return -(-(L + n_new) // self.page)
 
+    def _pages_for(self, tokens: int) -> int:
+        return -(-tokens // self.page)
+
+    def _admit_need(self, req: ServingRequest) -> int:
+        """Pages admission must secure. Legacy: the request's whole
+        len+new footprint (held admission→eviction). Chunked: only the
+        FIRST chunk's pages — the rest are reserved incrementally as
+        chunks feed (_plan_chunks), so a long prompt no longer blocks
+        admission of short requests the free list could serve today."""
+        if self.chunked:
+            return self._pages_for(min(len(req.prompt), self.Sc))
+        return self._pages_needed(len(req.prompt), req.max_new_tokens)
+
     def _pvals(self):
         return tuple(p._value for p in self.pred._params)
 
@@ -330,10 +407,12 @@ class ServingEngine:
                 self._shed(req, "deadline")
                 self._metrics["queue_depth"].set(len(self.queue))
                 continue
+            if self.chunked and self._page_stalled and self.num_active:
+                return    # backpressure: stalled elders drain first
             free = [b for b in range(self.B) if self.slots[b] is None]
             if not free:
                 return
-            need = self._pages_needed(len(req.prompt), req.max_new_tokens)
+            need = self._admit_need(req)
             if need > len(self._free_pages):
                 return                    # head-of-line waits for evictions
             self.queue.popleft()
@@ -344,7 +423,10 @@ class ServingEngine:
             pages = [self._free_pages.pop() for _ in range(need)]
             self.tables[b, :] = self.trash
             self.tables[b, :need] = pages
-            self.slots[b] = _Slot(req, pages)
+            self.slots[b] = _Slot(
+                req, pages, state="prefill" if self.chunked else "decode",
+                seq=self._admit_seq)
+            self._admit_seq += 1
             m = self._metrics
             m["requests"].inc(event="admitted")
             if backfill:
@@ -357,7 +439,13 @@ class ServingEngine:
                 if sp is not None:
                     m["stage_seconds"].observe(sp.seconds,
                                                stage="queued")
-            self._prefill(b)
+            if self.chunked:
+                # chunks feed inside the unified rounds; the prefill
+                # stage span (admit -> first token) opens here
+                if tr is not None:
+                    tr.begin("prefill", time.perf_counter())
+            else:
+                self._prefill(b)
 
     def _prefill(self, b: int):
         from . import _bucket, _sample
@@ -434,8 +522,228 @@ class ServingEngine:
         self._step_fns[key] = jax.jit(step, donate_argnums=(2,))
         return self._step_fns[key]
 
+    # -- unified chunked-prefill + decode step ---------------------------
+    def _unified_step_fn(self):
+        """THE unified compiled step (chunked mode): fixed [B, Sc] ids
+        at per-row ``(start, seq_len)`` metadata against the shared
+        pool — prefill-chunk rows, decode rows, and dead rows in one
+        dispatch (the ragged paged-attention kernel underneath). Keyed
+        ONLY on lattice constants; the metadata is DATA, not shape."""
+        gen = self.gen
+        key = ("unified", self.B, self.Sc, self.M, gen.temperature,
+               gen.top_k, gen.top_p)
+        if key in self._step_fns:
+            return self._step_fns[key]
+        model, params = self.pred._model, self.pred._params
+        from . import _sample
+        from ..autograd import no_grad
+        from ..distributed.engine import bind_params
+
+        def step(pvals, ids, caches, starts, nvalid, rng):
+            with no_grad(), bind_params(params, pvals):
+                logits, caches = model.forward(
+                    Tensor(ids, stop_gradient=True), caches=caches,
+                    offset=starts, valid=nvalid)
+            lv = (logits._value if isinstance(logits, Tensor)
+                  else logits)
+            # each row samples at its LAST valid slot: a decode row's
+            # next token, a final prefill chunk's first token; mid-
+            # prefill / dead rows sample garbage the host ignores
+            idx = jnp.maximum(nvalid - 1, 0)
+            last = jnp.take_along_axis(
+                lv, idx[:, None, None], axis=1)[:, 0]
+            rng, sub = jax.random.split(rng)
+            return _sample(last, sub, gen), caches
+
+        self._step_fns[key] = jax.jit(step, donate_argnums=(2,))
+        return self._step_fns[key]
+
+    def _plan_chunks(self):
+        """Pick this round's prefill feeders (admission order) under
+        the token budget, reserving pages incrementally: a chunk needs
+        pages up to its own frontier only, except the LAST chunk, which
+        also secures the decode tail (so decode rows never stall on
+        pages). Returns (feeders, stalled): feeders as (row, n_tokens,
+        is_last); stalled True when some row's reservation could not be
+        met this round (it waits for evictions — or preemption when
+        nothing else can move)."""
+        feeders: List[tuple] = []
+        stalled = False
+        budget = self.prefill_budget
+        rows = sorted((b for b in range(self.B)
+                       if self.slots[b] is not None
+                       and self.slots[b].state == "prefill"),
+                      key=lambda b: self.slots[b].seq)
+        for b in rows:
+            if budget <= 0:
+                break
+            s = self.slots[b]
+            L = len(s.req.prompt)
+            n = min(L - s.fed, self.Sc, budget)
+            if n <= 0:
+                continue
+            last = s.fed + n == L
+            want_tokens = (L + s.req.max_new_tokens) if last \
+                else (s.fed + n)
+            extra = self._pages_for(want_tokens) - len(s.pages)
+            if extra > len(self._free_pages):
+                stalled = True
+                self._metrics["prefill_stall"].inc()
+                continue
+            if extra > 0:
+                newp = [self._free_pages.pop() for _ in range(extra)]
+                self.tables[b, len(s.pages):len(s.pages) + extra] = newp
+                s.pages.extend(newp)
+            feeders.append((b, n, last))
+            budget -= n
+        self._page_stalled = stalled
+        return feeders, stalled
+
+    def _unified_round(self, feeders):
+        """One unified dispatch: every feeder writes its next prompt
+        chunk, every decode row advances one token, dead rows ride
+        along at seq_len 0 — ONE compiled program, fixed shape."""
+        t0 = time.perf_counter()
+        B = self.B
+        ids = np.zeros((B, self.Sc), np.int32)
+        starts = np.zeros((B,), np.int32)
+        nvalid = np.zeros((B,), np.int32)
+        feed = {b: (n, last) for b, n, last in feeders}
+        decode_rows = []
+        for b in range(B):
+            s = self.slots[b]
+            if s is None:
+                continue
+            if s.state == "decode":
+                ids[b, 0] = s.req.new_tokens[-1]
+                starts[b] = s.pos + len(s.req.new_tokens) - 1
+                nvalid[b] = 1
+                decode_rows.append(b)
+            elif b in feed:
+                n, _last = feed[b]
+                ids[b, :n] = s.req.prompt[s.fed:s.fed + n]
+                starts[b] = s.fed
+                nvalid[b] = n
+            # stalled/out-of-budget prefill rows and free slots stay
+            # at seq_len 0: no writes (redirected to the trash
+            # column), no attention, output ignored
+        # the model's `valid` contract: one extra trailing table
+        # column that ALWAYS maps to the trash page (dead-slot writes
+        # land there; attention slices it back off)
+        tbl = np.concatenate(
+            [self.tables, np.full((B, 1), self.trash, np.int32)], axis=1)
+        caches = [(kp, vp, jnp.asarray(tbl)) for kp, vp in self.pools]
+        fn = self._unified_step_fn()
+        self.stats.note("unified",
+                        (B, self.Sc, self.M, self.page, self.P,
+                         self.gen.temperature, self.gen.top_k,
+                         self.gen.top_p, str(self._dtype)))
+        self._rng, sub = jax.random.split(self._rng)
+        toks, caches = self._run_captured(
+            ("unified", self.Sc), fn, self._pvals(), jnp.asarray(ids),
+            caches, jnp.asarray(starts), jnp.asarray(nvalid), sub)
+        self.pools = [(c[0], c[1]) for c in caches]
+        toks = np.asarray(toks)
+        now = time.perf_counter()
+        m = self._metrics
+        fed_tokens = 0
+        for b, n, last in feeders:
+            s = self.slots[b]
+            req = s.req
+            tr = self._live_traces.get(req.rid)
+            if tr is not None:
+                # per-chunk span: Chrome request traces show chunk
+                # scheduling interleaved with the decode rounds
+                tr.add("prefill_chunk", t0, now,
+                       {"chunk": s.chunks, "tokens": n, "start": s.fed})
+            s.fed += n
+            s.chunks += 1
+            fed_tokens += n
+            m["prefill_chunks"].inc()
+            if last:
+                tok0 = int(toks[b])
+                req.new_tokens.append(tok0)
+                req.t_first_token = now
+                m["ttft"].observe(now - req.t_submit)
+                m["tokens"].inc(1, phase="prefill")
+                s.state = "decode"
+                if tr is not None:
+                    sp = tr.end("prefill", now)
+                    if sp is not None:
+                        m["prefill_seconds"].observe(sp.seconds)
+                        m["stage_seconds"].observe(sp.seconds,
+                                                   stage="prefill")
+                    tr.begin("decode", now)    # closed at eviction
+                if len(req.new_tokens) >= req.max_new_tokens or \
+                        (req.eos_token_id is not None
+                         and tok0 == req.eos_token_id):
+                    self._finish(b)
+        emitted = 0
+        for b in decode_rows:
+            req = self.slots[b].req
+            t = int(toks[b])
+            tr = self._live_traces.get(req.rid)
+            if tr is not None:
+                tr.add("decode_round", t0, now,
+                       {"round": self._round, "unified": True})
+            req.new_tokens.append(t)
+            emitted += 1
+            if len(req.new_tokens) >= req.max_new_tokens or \
+                    (req.eos_token_id is not None
+                     and t == req.eos_token_id):
+                self._finish(b)
+        self.stats.count_tokens(("unified", self.Sc, self.P),
+                                fed_tokens + emitted)
+        m["unified_round_seconds"].observe(now - t0)
+        if emitted:
+            m["tokens"].inc(emitted, phase="decode")
+        self._round += 1
+
+    def _preempt_youngest(self):
+        """Deadlock breaker: when every mid-prefill row is stalled on
+        pages and no decode row can free any, bounce the YOUNGEST
+        mid-prefill row back to the queue head — it has sampled no
+        token yet, so restarting its prefill from scratch is exact.
+        The oldest row is never preempted, so it monotonically acquires
+        pages and the engine always makes progress."""
+        rows = [b for b in range(self.B)
+                if self.slots[b] is not None
+                and self.slots[b].state == "prefill"]
+        if len(rows) <= 1:
+            return                  # never preempt the only/oldest row
+        b = max(rows, key=lambda b: self.slots[b].seq)
+        s = self.slots[b]
+        now = time.perf_counter()
+        self._free_pages.extend(s.pages)
+        self.tables[b, :] = self.trash
+        self.slots[b] = None
+        self.queue.appendleft(s.req)
+        m = self._metrics
+        m["requests"].inc(event="preempted")
+        m["queue_depth"].set(len(self.queue))
+        tr = self._live_traces.get(s.req.rid)
+        if tr is not None:
+            tr.end("prefill", now)     # partial prefill span, kept
+            tr.add("preempt", now, now,
+                   {"reason": "pages", "fed": s.fed})
+            tr.begin("queued", now)
+
+    def _chunked_round(self):
+        """One chunked-mode tick: feed chunks through the unified step
+        when any are ready (decode rows ride along); otherwise run the
+        cheap fused decode scan; preempt only when nothing can move."""
+        feeders, stalled = self._plan_chunks()
+        if feeders:
+            self._unified_round(feeders)
+        elif any(s is not None and s.state == "decode"
+                 for s in self.slots):
+            self._decode_round()
+        elif stalled:
+            self._preempt_youngest()
+
     def _decode_round(self):
-        active = [b for b in range(self.B) if self.slots[b] is not None]
+        active = [b for b in range(self.B) if self.slots[b] is not None
+                  and self.slots[b].state == "decode"]
         if not active:
             return
         t0 = time.perf_counter()
@@ -448,8 +756,19 @@ class ServingEngine:
             tok[b] = s.req.new_tokens[-1]
             pos[b] = s.pos + len(s.req.new_tokens) - 1
         # free slots ride along at pos 0 with an all-trash table row:
-        # their writes hit the trash page, their outputs are ignored
-        caches = [(kp, vp, jnp.asarray(self.tables))
+        # their writes hit the trash page, their outputs are ignored.
+        # In chunked mode, stalled mid-prefill rows ride the same way —
+        # their REAL table rows are masked to all-trash for this round
+        # so the riding write cannot clobber their fed pages
+        tbl = self.tables
+        if self.chunked:
+            mid_prefill = [b for b in range(self.B)
+                           if self.slots[b] is not None
+                           and self.slots[b].state == "prefill"]
+            if mid_prefill:
+                tbl = self.tables.copy()
+                tbl[mid_prefill, :] = self.trash
+        caches = [(kp, vp, jnp.asarray(tbl))
                   for kp, vp in self.pools]
         fn = self._decode_step_fn()
         self.stats.note("serve_decode",
@@ -522,10 +841,15 @@ class ServingEngine:
         return sum(s is not None for s in self.slots)
 
     def step(self):
-        """One serving tick: admit arrivals (each prefilled into the
-        pool), then one shared decode round for the in-flight batch."""
+        """One serving tick: admit arrivals, then one shared round —
+        legacy mode prefills each arrival at admission and decodes the
+        batch; chunked mode folds pending prompt chunks and decode rows
+        into the unified dispatch (_chunked_round)."""
         self._admit()
-        self._decode_round()
+        if self.chunked:
+            self._chunked_round()
+        else:
+            self._decode_round()
         self._note_tick()
 
     def _note_tick(self):
@@ -583,7 +907,8 @@ class ServingEngine:
 
     def comm_ledger(self, site) -> Optional[Any]:
         """Static comm ledger of a compiled serving program: site is
-        ("decode",) or ("prefill", seq_bucket)."""
+        ("decode",), ("prefill", seq_bucket), or ("unified",
+        chunk_bucket) in chunked mode."""
         return self._ledgers.get(site)
 
     # -- memory accounting (observability/memledger) ---------------------

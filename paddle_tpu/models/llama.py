@@ -180,6 +180,21 @@ def _paged_attention(q, k_pool, v_pool, tables, lengths, S):
                                           lengths))
 
 
+def _unified_paged_attention(q, k_pool, v_pool, tables, starts, valid):
+    """Unified mixed prefill-chunk/decode attention dispatch over the
+    page pool (the Ragged Paged Attention design): Pallas ragged kernel
+    on TPU, gathered doubly-ragged dense mask elsewhere."""
+    from ..ops.pallas import ragged_paged_attention as _ra
+
+    return _dispatch_kernel(
+        "ragged_paged_attention",
+        lambda: _ra.ragged_supported(q.shape, k_pool.shape),
+        lambda: _ra.ragged_paged_attention(q, k_pool, v_pool, tables,
+                                           starts, valid),
+        lambda: _ra.ragged_paged_attention_dense(q, k_pool, v_pool,
+                                                 tables, starts, valid))
+
+
 def _cache_attention_dense(q, k_cache, v_cache, offset, S):
     """Caches are head-major [B, KV, M, D]; offset scalar or [B]. The
     math lives in ops/pallas/decode_attention._dense_ragged (shared
@@ -227,7 +242,7 @@ class LlamaAttention(Layer):
     def _tables(self, dtype):
         return self._rope
 
-    def forward(self, x, cache=None, offset=0):
+    def forward(self, x, cache=None, offset=0, valid=None):
         cfg = self.config
         B, S = x.shape[0], x.shape[1]
         D = cfg.head_dim
@@ -250,6 +265,19 @@ class LlamaAttention(Layer):
                 off = jnp.broadcast_to(
                     jnp.asarray(offset, jnp.int32).reshape(-1), (B,))
                 pos = off[:, None] + jnp.arange(S, dtype=jnp.int32)[None]
+                if valid is not None:
+                    # unified mixed prefill-chunk/decode step: only the
+                    # first valid[b] slots of row b are real tokens.
+                    # CONTRACT: the caller's table carries ONE EXTRA
+                    # trailing column that always maps to the trash
+                    # page (inference/serving.py builds it) — dead
+                    # slots' kv writes are redirected there instead of
+                    # clobbering the row's own future cache slots
+                    nv = jnp.asarray(valid, jnp.int32).reshape(B)
+                    alive = jnp.arange(S, dtype=jnp.int32)[None] \
+                        < nv[:, None]
+                    pos = jnp.where(alive, pos,
+                                    (tables.shape[1] - 1) * page)
                 pid = jnp.take_along_axis(tables, pos // page, axis=1)
                 slot = pos % page        # [B,S]
                 # advanced-index scatter: [B,S] page ids + slots land
@@ -260,10 +288,23 @@ class LlamaAttention(Layer):
                     kv_.astype(k_pool.dtype))
                 v_pool = v_pool.at[pid, :, slot, :].set(
                     vv.astype(v_pool.dtype))
-                ov = _paged_attention(qv, k_pool, v_pool, tables, off, S)
+                if valid is not None:
+                    # the trailing trash column is a write-side device
+                    # only: attention sees the canonical [B, npages]
+                    # table, so the key space (and the compiled
+                    # attention shape) matches the two-program path
+                    ov = _unified_paged_attention(
+                        qv, k_pool, v_pool, tables[:, :-1], off, nv)
+                else:
+                    ov = _paged_attention(qv, k_pool, v_pool, tables,
+                                          off, S)
                 out = Tensor(ov.reshape(B, S, n_local * D),
                              stop_gradient=True)
                 return self.o_proj(out), (k_pool, v_pool, tables)
+            from ..core.enforce import enforce
+
+            enforce(valid is None, "valid (unified ragged metadata) is "
+                    "only served over the paged KV cache")
             k_cache, v_cache = cache    # head-major [B, KV, M, D]
             off = jnp.asarray(offset, jnp.int32)
             k_new = jnp.swapaxes(kv_, 1, 2).astype(k_cache.dtype)
@@ -333,11 +374,12 @@ class LlamaDecoderLayer(Layer):
                                                 epsilon=config.rms_norm_eps)
         self.mlp = LlamaMLP(config)
 
-    def forward(self, x, cache=None, offset=0):
+    def forward(self, x, cache=None, offset=0, valid=None):
         if cache is not None:
             with _annotate("attention"):
                 a, new_cache = self.self_attn(self.input_layernorm(x),
-                                              cache=cache, offset=offset)
+                                              cache=cache, offset=offset,
+                                              valid=valid)
             x = x + a
             with _annotate("mlp"):
                 x = x + self.mlp(self.post_attention_layernorm(x))
@@ -360,7 +402,7 @@ class LlamaModel(Layer):
                                  for _ in range(config.num_layers)])
         self.norm = RMSNorm(config.hidden_size, epsilon=config.rms_norm_eps)
 
-    def forward(self, input_ids, caches=None, offset=0):
+    def forward(self, input_ids, caches=None, offset=0, valid=None):
         # named scopes per layer: XLA metadata (and thus the Perfetto /
         # TensorBoard device trace) reads `llama/layer3/attention`
         # instead of bare fusions
@@ -372,7 +414,8 @@ class LlamaModel(Layer):
                 for i, (layer, cache) in enumerate(zip(self.layers,
                                                        caches)):
                     with _annotate(f"layer{i}"):
-                        x, nc = layer(x, cache=cache, offset=offset)
+                        x, nc = layer(x, cache=cache, offset=offset,
+                                      valid=valid)
                     new_caches.append(nc)
                 return self.norm(x), new_caches
             for i, layer in enumerate(self.layers):
@@ -409,10 +452,10 @@ class LlamaForCausalLM(Layer):
             return ops.matmul(x, w, transpose_y=True)
         return self.lm_head(x)
 
-    def forward(self, input_ids, caches=None, offset=0):
+    def forward(self, input_ids, caches=None, offset=0, valid=None):
         if caches is not None:
             x, new_caches = self.llama(input_ids, caches=caches,
-                                       offset=offset)
+                                       offset=offset, valid=valid)
             return self._logits(x), new_caches
         return self._logits(self.llama(input_ids))
 

@@ -342,12 +342,30 @@ def serving_metrics(reg: MetricsRegistry = None) -> Dict[str, object]:
             "request", unit="s", buckets=_FAST_BUCKETS),
         "prefill_seconds": r.histogram(
             "paddle_tpu_serving_prefill_seconds",
-            "one bucketed prefill (admission-time)", unit="s",
+            "prefill latency per request: one bucketed admission-time "
+            "prefill (legacy), or admit to first token across the "
+            "scheduled chunks (chunked mode)", unit="s",
             buckets=DEFAULT_LATENCY_BUCKETS),
         "decode_round_seconds": r.histogram(
             "paddle_tpu_serving_decode_round_seconds",
             "one shared chunked decode round for the in-flight batch",
             unit="s", buckets=DEFAULT_LATENCY_BUCKETS),
+        "unified_round_seconds": r.histogram(
+            "paddle_tpu_serving_unified_round_seconds",
+            "one unified mixed prefill-chunk + decode dispatch "
+            "(chunked-prefill mode: the fixed [B, Sc] ragged program)",
+            unit="s", buckets=DEFAULT_LATENCY_BUCKETS),
+        "prefill_chunks": r.counter(
+            "paddle_tpu_serving_prefill_chunks_total",
+            "prompt chunks fed through the unified step (chunked-"
+            "prefill mode; per-chunk token counts ride the request "
+            "traces' prefill_chunk spans)"),
+        "prefill_stall": r.counter(
+            "paddle_tpu_serving_prefill_page_stall_total",
+            "rounds a mid-prefill row could not reserve its next "
+            "chunk's pages and waited (incremental page reservation; "
+            "sustained growth means the pool is undersized for the "
+            "admitted mix)"),
         "queue_depth": r.gauge(
             "paddle_tpu_serving_queue_depth",
             "requests waiting for admission"),

@@ -2,9 +2,15 @@
 
 Every ``ServingRequest`` gets a ``RequestTrace``: a list of named spans
 (queued → prefill → decode, plus one span per shared decode round the
-request was in flight for) on the ``time.perf_counter`` clock. Finished
-traces land in a bounded ``SpanRing`` so a long-running engine keeps
-the last-N request histories without growing memory.
+request was in flight for — and, under chunked prefill, one
+``prefill_chunk`` span per scheduled chunk carrying the chunk index +
+token count, plus ``preempt`` instants when a page-starved row bounces
+back to the queue) on the ``time.perf_counter`` clock. Finished traces
+land in a bounded ``SpanRing`` so a long-running engine keeps the
+last-N request histories without growing memory. The Chrome export thus
+shows chunk scheduling interleaved with the decode rounds; TTFT stays
+defined as first-token time (the ``prefill`` stage span closes when the
+last chunk samples, not per chunk).
 
 Exports:
 

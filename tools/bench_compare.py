@@ -60,6 +60,10 @@ _EXACT = {"pallas_kernel_parity_interpret": 1.0,
           # serving KV pool: measured pool array bytes == page_bytes x
           # pool_pages closed form — exact everywhere
           "serving_mem_pool_parity": 1.0,
+          # unified ragged paged-attention kernel vs its dense XLA
+          # fallback on a mixed prefill-chunk/decode batch (chunk
+          # straddling page boundaries) — pass/fail, never drifts
+          "serving_ragged_kernel_parity": 1.0,
           # health monitor event counts on the DETERMINISTIC bench
           # lines: robust spike detection must stay silent on a clean
           # fixed-seed run — any event is a regression (either a real
@@ -82,6 +86,12 @@ _THRESHOLDS = {
     # host-load noise dominates; the async_stall_lt_step bool on the
     # line carries the acceptance bound
     "ckpt_save_overlap_stall_seconds": 2.0,
+    # TPOT p99 under the Poisson mixed-length stream ("ms" unit:
+    # lower-better): on CPU the smoke value is host-scheduling noise
+    # around ms-scale rounds, so only a sustained blow-up should flag;
+    # on chip the chunked-on vs chunked-off ratio on the line itself
+    # (vs_baseline > 1) carries the acceptance
+    "serving_mixed_traffic_tpot_p99_ms": 1.0,
     # roofline HBM headroom (direction-aware: HIGHER is better — the
     # default direction — falling headroom means the config is walking
     # into the memory wall). 0 on CPU where peaks are unknown; on chip
