@@ -161,8 +161,9 @@ class CheckpointManager:
             self._writer.start()
         with self._cv:
             self._pending += 1
+            pending = self._pending
         self._queue.put(job)
-        self._metrics["pending"].set(float(self._pending))
+        self._metrics["pending"].set(float(pending))
 
     def _writer_loop(self) -> None:
         import time as _time
@@ -175,7 +176,8 @@ class CheckpointManager:
             try:
                 self._write(*job)
             except BaseException as e:   # surfaced on wait()/next save
-                self._errors.append(e)
+                with self._cv:
+                    self._errors.append(e)
             finally:
                 # background commit: journaled as an OVERLAPPED
                 # ckpt_async interval (runs under the step loop, so it
@@ -197,8 +199,11 @@ class CheckpointManager:
                         coordinator_rank=self.coordinator_rank,
                         extra_meta=extra_meta)
         write_s = time.perf_counter() - t0
-        self._last_commit_time = time.time()
-        self._last_step = step
+        # commit bookkeeping is read by publish()/last_save_step on the
+        # train-loop thread while the writer thread commits
+        with self._cv:
+            self._last_commit_time = time.time()
+            self._last_step = step
         self._prune()
         m = self._metrics
         m["saves"].inc(result="committed")
@@ -235,8 +240,10 @@ class CheckpointManager:
                 shutil.rmtree(p, ignore_errors=True)
 
     def _raise_pending(self) -> None:
-        if self._errors:
-            raise self._errors.pop(0)
+        with self._cv:
+            err = self._errors.pop(0) if self._errors else None
+        if err is not None:
+            raise err
 
     # -- synchronization / teardown -------------------------------------
     def wait(self, timeout: Optional[float] = None) -> None:
@@ -265,10 +272,14 @@ class CheckpointManager:
     def publish(self) -> None:
         """Refresh the ckpt_last_save_age_seconds gauge (call from the
         step loop or a scrape hook; save() calls it on every commit)."""
-        if self._last_commit_time is not None:
-            self._metrics["age"].set(time.time() - self._last_commit_time)
-        self._metrics["pending"].set(float(self._pending))
+        with self._cv:
+            last_commit = self._last_commit_time
+            pending = self._pending
+        if last_commit is not None:
+            self._metrics["age"].set(time.time() - last_commit)
+        self._metrics["pending"].set(float(pending))
 
     @property
     def last_save_step(self) -> Optional[int]:
-        return self._last_step
+        with self._cv:
+            return self._last_step

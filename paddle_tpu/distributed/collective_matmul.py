@@ -304,6 +304,11 @@ def _matmul_ar_bwd(axes, axis, res, g):
     return _mm(g, w.T), _tdot(x, g)
 
 
+# fwd's rs-ring + tiled all_gather COMPOSE a full allreduce, so this is
+# the Megatron psum/identity pairing (mp_ops.psum_identity_bwd): the
+# cotangent is replicated over mp and the correct bwd is local GEMMs
+# with zero collectives — an empty bwd ledger is the contract here
+# tpulint: disable=vjp-ledger-symmetry
 matmul_allreduce.defvjp(_matmul_ar_fwd, _matmul_ar_bwd)
 
 

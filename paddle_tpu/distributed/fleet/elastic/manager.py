@@ -51,7 +51,14 @@ class ElasticManager:
         self._stop = threading.Event()
         self._threads: List[threading.Thread] = []
         self._last_world: Optional[tuple] = None
+        # guards status: written by the heartbeat/watcher threads and
+        # the driver (register/ack/exit) concurrently
+        self._state_lock = threading.Lock()
         self.status = ElasticStatus.HOLD
+
+    def _set_status(self, status: "ElasticStatus") -> None:
+        with self._state_lock:
+            self.status = status
 
     # -- registration / heartbeat --------------------------------------
     def _node_key(self, rank: int) -> str:
@@ -65,7 +72,7 @@ class ElasticManager:
         w = threading.Thread(target=self._watch_loop, daemon=True)
         w.start()
         self._threads.append(w)
-        self.status = ElasticStatus.HOLD
+        self._set_status(ElasticStatus.HOLD)
 
     def _heartbeat_loop(self):
         while not self._stop.wait(self.heartbeat_interval):
@@ -78,7 +85,7 @@ class ElasticManager:
                 # ERROR flips restart_needed) instead of silently
                 # letting the pod split-brain
                 if not self._stop.is_set():
-                    self.status = ElasticStatus.ERROR
+                    self._set_status(ElasticStatus.ERROR)
                     logger.error(
                         "elastic heartbeat for rank %d failed (%s: %s); "
                         "peers will see this node as dead — flagging "
@@ -112,7 +119,7 @@ class ElasticManager:
                 logger.warning("elastic world changed: %s -> %s",
                                self._last_world, world)
                 self._last_world = world
-                self.status = ElasticStatus.RESTART
+                self._set_status(ElasticStatus.RESTART)
                 if self.on_world_change:
                     self.on_world_change(list(world))
 
@@ -121,7 +128,9 @@ class ElasticManager:
         """True when recovery must run: a peer changed the world
         (RESTART) or this node's own heartbeat died (ERROR — peers
         already consider us gone)."""
-        return self.status in (ElasticStatus.RESTART, ElasticStatus.ERROR)
+        with self._state_lock:
+            return self.status in (ElasticStatus.RESTART,
+                                   ElasticStatus.ERROR)
 
     def ack_world_change(self):
         """Acknowledge a handled RESTART so the manager is reusable
@@ -129,8 +138,11 @@ class ElasticManager:
         continues instead of relaunching); the watcher keeps comparing
         against the latest world. ERROR is sticky — a node whose own
         heartbeat died cannot talk itself back to health."""
-        if self.status == ElasticStatus.RESTART:
-            self.status = ElasticStatus.HOLD
+        with self._state_lock:
+            # atomic check-and-set: a concurrent watcher ERROR between
+            # the read and the write must not be overwritten to HOLD
+            if self.status == ElasticStatus.RESTART:
+                self.status = ElasticStatus.HOLD
 
     def wait_restart(self, timeout: float = 60.0) -> bool:
         """Block until the watcher flags a world change (survivor-side
@@ -154,8 +166,8 @@ class ElasticManager:
         return False
 
     def exit(self, completed: bool = True):
-        self.status = (ElasticStatus.COMPLETED if completed
-                       else ElasticStatus.ERROR)
+        self._set_status(ElasticStatus.COMPLETED if completed
+                         else ElasticStatus.ERROR)
         self._stop.set()
         for t in self._threads:
             t.join(timeout=2)

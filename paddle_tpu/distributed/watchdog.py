@@ -92,8 +92,11 @@ class CommTaskManager:
                 self._tasks = [t for t in self._tasks if not t.done]
             for t in hung:
                 t.done = True
-                self._timed_out = t.name
                 self._dump_flight_record(t.name)
+                # published AFTER the dump so a concurrent check()
+                # never raises with the flight record still unwritten
+                with self._lock:
+                    self._timed_out = t.name
                 if self.on_timeout:
                     self.on_timeout(t.name)
                 if self.error_handling == "log":
@@ -113,19 +116,24 @@ class CommTaskManager:
         try:
             from ..observability import flight as _flight
 
-            self.last_flight_record = _flight.dump(
+            path = _flight.dump(
                 reason=f"watchdog: '{name}' exceeded {self.timeout}s "
                        "without the device coming back")
         except Exception:       # the dump must never mask the timeout
-            self.last_flight_record = None
+            path = None
+        with self._lock:
+            self.last_flight_record = path
 
     def check(self):
         """Raise if any tracked region has timed out (call between
         steps — the main thread may be past the hung region by then)."""
-        if self._timed_out is not None and self.error_handling == "raise":
+        if self.error_handling != "raise":
+            return
+        with self._lock:
             name, self._timed_out = self._timed_out, None
-            where = (f"; flight record: {self.last_flight_record}"
-                     if self.last_flight_record else "")
+            record = self.last_flight_record
+        if name is not None:
+            where = f"; flight record: {record}" if record else ""
             raise TimeoutError_(
                 f"collective step '{name}' exceeded "
                 f"{self.timeout}s — a peer likely left the mesh "

@@ -129,7 +129,10 @@ class FlightRecorder:
         rec = self.record(reason)
         with open(path, "w") as f:
             json.dump(rec, f, indent=1, default=str)
-        self.last_dump_path = path
+        # dump() runs on watchdog/monitor threads while owners read
+        # the path from the main thread
+        with self._lock:
+            self.last_dump_path = path
         return path
 
 

@@ -217,20 +217,28 @@ class HealthMonitor:
         # the spike post-mortem: a flight record freezes the metric
         # ring + thread/region state around the event (rate-limited so
         # a spiking run does not bury the disk in dumps)
-        if flight and self.flight_on_spike and \
-                (self._last_flight_ts is None or
-                 now - self._last_flight_ts >=
-                 self.flight_min_interval_s):
+        dump_now = False
+        if flight and self.flight_on_spike:
+            # atomic check-and-reserve of the rate-limit slot: two
+            # concurrent observers must not both dump
+            with self._lock:
+                dump_now = (self._last_flight_ts is None or
+                            now - self._last_flight_ts >=
+                            self.flight_min_interval_s)
+                if dump_now:
+                    self._last_flight_ts = now
+        if dump_now:
             try:
                 from . import flight as _flight
 
-                self.last_flight_record = _flight.dump(
+                record = _flight.dump(
                     reason=f"healthmon: {kind} value={value:.6g} "
                            f"median={median:.6g} z={z:.1f}"
                            + (f" step={step}" if step is not None
                               else ""))
-                ev["flight_record"] = self.last_flight_record
-                self._last_flight_ts = now
+                ev["flight_record"] = record
+                with self._lock:
+                    self.last_flight_record = record
             except Exception:
                 pass    # the post-mortem must never take the run down
         # durable: the goodput journal carries the event timeline

@@ -421,6 +421,35 @@ class TestSchemaGate:
         assert "paddle_tpu_serving_ttft_seconds" in served_engine[4]
         assert "paddle_tpu_serving_tpot_seconds" in served_engine[4]
 
+    def test_tpulint_and_schema_agree_on_the_metric_set(self):
+        """Single source of truth: the STATIC metric set tpulint's
+        unregistered-metric rule collects from the whole tree must
+        equal schema.json's key set exactly. The live-registry check
+        above only sees metrics the test run registers; this one pins
+        every registration site in the code — the two checkers can
+        never drift apart, and a registration on an untested code path
+        still fails CI."""
+        import sys
+        from pathlib import Path
+
+        repo = Path(__file__).resolve().parent.parent
+        if str(repo) not in sys.path:
+            sys.path.insert(0, str(repo))
+        from tools.tpulint import Project
+        from tools.tpulint.rules.unregistered_metric import \
+            registered_names
+
+        project, errors = Project.from_paths(
+            [repo / "paddle_tpu"], repo)
+        assert errors == []
+        with open(catalog.SCHEMA_PATH) as f:
+            schema = json.load(f)
+        static = registered_names(project)
+        assert static == set(schema), (
+            f"schema.json and the tree's metric registrations drifted: "
+            f"only-in-code={sorted(static - set(schema))} "
+            f"only-in-schema={sorted(set(schema) - static)}")
+
 
 # ---------------------------------------------------------------------------
 # traces + flight records

@@ -1,26 +1,37 @@
-"""tpulint — trace-safety & API-fidelity static analyzer for paddle_tpu.
+"""tpulint — trace-safety, API-fidelity & concurrency-contract static
+analyzer for paddle_tpu.
 
 Run it:
 
     python -m tools.tpulint paddle_tpu/            # human output
     python -m tools.tpulint paddle_tpu/ --json     # machine-readable
+    python -m tools.tpulint --changed origin/main  # incremental
     python -m tools.tpulint --list-rules
 
-Five rules ship (see README "Static analysis" for the catalog with
-examples): unused-knob, host-sync-in-jit, traced-bool,
-nonhashable-static, recompile-hazard. Suppress a single site with
-``# tpulint: disable=<rule>`` on (or on a comment line directly above)
-the reported line; grandfathered violations live in ``baseline.json``
-next to this file — the tier-1 gate (tests/test_tpulint.py) fails on
-any NEW finding, so the baseline can only shrink.
+Ten rules ship (see README "Static analysis" for the catalog with
+examples). Five are per-module trace-safety rules: unused-knob,
+host-sync-in-jit, traced-bool, nonhashable-static, recompile-hazard.
+Five are package-wide interprocedural contract rules riding the
+``Project`` pass (cross-module import/call graph, Thread-target
+reachability, collective/donation taint): raw-collective,
+unregistered-metric, vjp-ledger-symmetry, donation-reuse,
+unguarded-shared-mutation.
+
+Suppress a single site with ``# tpulint: disable=<rule>`` on (or on a
+comment line directly above) the reported line; grandfathered
+violations live in ``baseline.json`` next to this file, each with a
+mandatory ``justification`` — the tier-1 gate (tests/test_tpulint.py)
+fails on any NEW finding, so the baseline can only shrink.
 """
 from .core import (Finding, ModuleInfo, Rule, baseline_entry, lint_paths,
-                   lint_source, load_baseline, split_by_baseline,
-                   write_baseline)
+                   lint_source, load_baseline, match_baseline_entries,
+                   split_by_baseline, write_baseline)
+from .project import Project, ProjectRule, lint_project
 from .rules import ALL_RULES, RULES_BY_ID, select_rules
 
 __all__ = [
-    "Finding", "ModuleInfo", "Rule", "ALL_RULES", "RULES_BY_ID",
-    "select_rules", "lint_source", "lint_paths", "load_baseline",
-    "baseline_entry", "split_by_baseline", "write_baseline",
+    "Finding", "ModuleInfo", "Rule", "Project", "ProjectRule",
+    "ALL_RULES", "RULES_BY_ID", "select_rules", "lint_source",
+    "lint_paths", "lint_project", "load_baseline", "baseline_entry",
+    "match_baseline_entries", "split_by_baseline", "write_baseline",
 ]

@@ -4,18 +4,31 @@ Exit codes: 0 = clean (every finding baselined or none), 1 = new
 violations, 2 = usage error. ``--json`` emits one machine-readable
 report on stdout (bench/verdict rounds track ``baseline_size`` /
 ``new`` from it).
+
+Incremental mode: ``--changed <git-ref>`` lints only the files changed
+vs the ref (plus untracked files), but the interprocedural facts —
+call graph, thread reachability, donation/collective taint — are still
+built from the WHOLE tree, so a changed caller is judged against
+unchanged callees. ``--stats`` appends a per-rule
+hit/suppression summary for CI logs.
+
+Baselining: every baseline entry must carry a ``justification`` string
+— ``--write-baseline`` refuses entries lacking one (existing
+justifications are carried over by fingerprint; supply
+``--justification`` for the new entries).
 """
 from __future__ import annotations
 
 import argparse
 import json
+import subprocess
 import sys
 from pathlib import Path
-from typing import List, Optional
+from typing import Dict, List, Optional
 
 from .core import (Finding, baseline_entry, iter_py_files, lint_paths,
-                   load_baseline, relpath_for, split_by_baseline,
-                   write_baseline, write_baseline_entries)
+                   load_baseline, match_baseline_entries, relpath_for,
+                   split_by_baseline, write_baseline_entries)
 from .rules import ALL_RULES, select_rules
 
 DEFAULT_BASELINE = Path(__file__).resolve().parent / "baseline.json"
@@ -24,8 +37,8 @@ DEFAULT_BASELINE = Path(__file__).resolve().parent / "baseline.json"
 def build_parser() -> argparse.ArgumentParser:
     ap = argparse.ArgumentParser(
         prog="python -m tools.tpulint",
-        description="trace-safety & API-fidelity static analyzer for "
-                    "paddle_tpu")
+        description="trace-safety, API-fidelity & concurrency-contract "
+                    "static analyzer for paddle_tpu")
     ap.add_argument("paths", nargs="*", default=["paddle_tpu"],
                     help="files or directories to lint "
                          "(default: paddle_tpu)")
@@ -38,9 +51,20 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--select", default="",
                     help="comma-separated rule ids to run "
                          "(default: all)")
+    ap.add_argument("--changed", metavar="GIT_REF", default=None,
+                    help="lint only files changed vs GIT_REF (plus "
+                         "untracked); interprocedural facts still "
+                         "built from the whole tree")
+    ap.add_argument("--stats", action="store_true",
+                    help="append a per-rule hit/suppression summary")
     ap.add_argument("--write-baseline", action="store_true",
                     help="rewrite the baseline from current findings "
-                         "and exit 0")
+                         "and exit 0; refuses entries lacking a "
+                         "justification (see --justification)")
+    ap.add_argument("--justification", default=None, metavar="TEXT",
+                    help="justification applied to NEW baseline "
+                         "entries on --write-baseline (existing "
+                         "entries keep theirs)")
     ap.add_argument("--prune-baseline", action="store_true",
                     help="drop baseline entries whose fingerprints no "
                          "longer match any linted file (fixed/moved/"
@@ -52,6 +76,33 @@ def build_parser() -> argparse.ArgumentParser:
     return ap
 
 
+def _changed_paths(ref: str, root: Path) -> Optional[List[Path]]:
+    """.py files changed vs ``ref`` plus untracked ones, as paths
+    relative to cwd; None on git failure."""
+    try:
+        diff = subprocess.run(
+            ["git", "diff", "--name-only", ref, "--"],
+            cwd=root, capture_output=True, text=True, timeout=60)
+        if diff.returncode != 0:
+            return None
+        untracked = subprocess.run(
+            ["git", "ls-files", "--others", "--exclude-standard"],
+            cwd=root, capture_output=True, text=True, timeout=60)
+        names = diff.stdout.splitlines() + (
+            untracked.stdout.splitlines()
+            if untracked.returncode == 0 else [])
+    except (OSError, subprocess.SubprocessError):
+        return None
+    out = []
+    for name in names:
+        if not name.endswith(".py"):
+            continue
+        p = root / name
+        if p.is_file():
+            out.append(p)
+    return out
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     ap = build_parser()
     try:
@@ -61,7 +112,7 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     if args.list_rules:
         for r in ALL_RULES:
-            print(f"{r.id:<22} {r.description}")
+            print(f"{r.id:<26} {r.description}")
         return 0
 
     try:
@@ -77,30 +128,49 @@ def main(argv: Optional[List[str]] = None) -> int:
         print(f"tpulint: no such path: "
               f"{', '.join(str(p) for p in missing)}", file=sys.stderr)
         return 2
+    root = (args.root or Path.cwd()).resolve()
 
-    findings = lint_paths(paths, rules, root=args.root)
+    project_paths = None
+    lint_targets = paths
+    if args.changed is not None:
+        changed = _changed_paths(args.changed, root)
+        if changed is None:
+            print(f"tpulint: --changed {args.changed}: git diff failed "
+                  f"(not a repo, or unknown ref)", file=sys.stderr)
+            return 2
+        # restrict to the requested subtrees, facts from the full paths
+        scoped = {relpath_for(p, root) for p in iter_py_files(paths)}
+        lint_targets = [p for p in changed
+                        if relpath_for(p, root) in scoped]
+        project_paths = paths
+
+    stats: Dict[str, Dict[str, int]] = {
+        r.id: {"total": 0, "new": 0, "baselined": 0, "suppressed": 0}
+        for r in rules}
+    findings = lint_paths(lint_targets, rules, root=args.root,
+                          project_paths=project_paths, stats=stats)
+    for f in findings:
+        if f.rule in stats:
+            stats[f.rule]["total"] += 1
 
     baseline_path = args.baseline or DEFAULT_BASELINE
     if args.write_baseline:
-        write_baseline(baseline_path, findings)
-        print(f"tpulint: wrote {len(findings)} baseline entries to "
-              f"{baseline_path}")
-        return 0
+        return _write_baseline(args, baseline_path, findings)
 
     if args.prune_baseline:
         baseline = load_baseline(baseline_path) \
             if baseline_path.exists() else []
-        root = (args.root or Path.cwd()).resolve()
-        linted = {relpath_for(p, root) for p in iter_py_files(paths)}
+        linted = {relpath_for(p, root)
+                  for p in iter_py_files(lint_targets)}
         in_scope = [e for e in baseline if e["path"] in linted]
         out_scope = [e for e in baseline if e["path"] not in linted]
         # in-scope entries survive only if a current finding still
         # matches their fingerprint; out-of-scope entries survive only
         # while their file exists (an entry for a deleted file can
         # never match again)
-        _, matched, stale = split_by_baseline(findings, in_scope)
+        kept_in = match_baseline_entries(findings, in_scope)
         kept_out = [e for e in out_scope if (root / e["path"]).is_file()]
-        kept = [baseline_entry(f) for f in matched] + kept_out
+        kept = kept_in + kept_out
         dropped = len(baseline) - len(kept)
         write_baseline_entries(baseline_path, kept)
         print(f"tpulint: pruned {dropped} stale baseline entr"
@@ -113,10 +183,16 @@ def main(argv: Optional[List[str]] = None) -> int:
         baseline = load_baseline(baseline_path)
         # when linting a subtree, baseline entries for files outside it
         # are out of scope — neither matchable nor stale
-        root = (args.root or Path.cwd()).resolve()
-        linted = {relpath_for(p, root) for p in iter_py_files(paths)}
+        linted = {relpath_for(p, root)
+                  for p in iter_py_files(lint_targets)}
         baseline = [e for e in baseline if e["path"] in linted]
     new, matched, stale = split_by_baseline(findings, baseline)
+    for f in new:
+        if f.rule in stats:
+            stats[f.rule]["new"] += 1
+    for f in matched:
+        if f.rule in stats:
+            stats[f.rule]["baselined"] += 1
 
     if args.as_json:
         counts = {}
@@ -134,6 +210,11 @@ def main(argv: Optional[List[str]] = None) -> int:
             "findings": [f.as_dict(baselined=False) for f in new]
             + [f.as_dict(baselined=True) for f in matched],
         }
+        if args.stats:
+            report["stats"] = stats
+        if args.changed is not None:
+            report["changed_files"] = sorted(
+                relpath_for(p, root) for p in iter_py_files(lint_targets))
         print(json.dumps(report, indent=1))
         return 1 if new else 0
 
@@ -143,14 +224,66 @@ def main(argv: Optional[List[str]] = None) -> int:
     if stale:
         print(f"\ntpulint: {len(stale)} stale baseline entr"
               f"{'y' if len(stale) == 1 else 'ies'} (fixed or moved — "
-              "shrink the baseline with --write-baseline):")
+              "shrink the baseline with --prune-baseline):")
         for e in stale:
             print(f"  {e['rule']}: {e['path']} [{e['symbol']}] "
                   f"{e['line_text'][:60]}")
+    if args.stats:
+        print("\ntpulint per-rule stats "
+              "(total/new/baselined/suppressed):")
+        for rid in sorted(stats):
+            s = stats[rid]
+            print(f"  {rid:<26} {s['total']:>4} {s['new']:>4} "
+                  f"{s['baselined']:>4} {s['suppressed']:>4}")
+    if args.changed is not None:
+        n = len(list(iter_py_files(lint_targets)))
+        print(f"\ntpulint: incremental vs {args.changed}: {n} changed "
+              f"file(s) linted (facts from the whole tree)")
     print(f"\ntpulint: {len(findings)} finding(s): {len(new)} new, "
           f"{len(matched)} baselined"
           + (f", {len(stale)} stale baseline" if stale else ""))
     return 1 if new else 0
+
+
+def _write_baseline(args, baseline_path: Path,
+                    findings: List[Finding]) -> int:
+    """--write-baseline with mandatory per-entry justification: carry
+    existing justifications over by fingerprint, apply
+    --justification to new entries, refuse anything still missing."""
+    old = load_baseline(baseline_path) if baseline_path.exists() else []
+    by_fp: Dict[tuple, List[str]] = {}
+    for e in old:
+        j = e.get("justification")
+        if j:
+            key = (e["rule"], e["path"], e["symbol"], e["line_text"])
+            by_fp.setdefault(key, []).append(j)
+    entries, unjustified = [], []
+    for f in findings:
+        e = baseline_entry(f)
+        carried = by_fp.get(f.fingerprint())
+        if carried:
+            e["justification"] = carried.pop(0)
+        elif args.justification:
+            e["justification"] = args.justification
+        else:
+            unjustified.append(e)
+            continue
+        entries.append(e)
+    if unjustified:
+        print("tpulint: refusing to write baseline — entries lack a "
+              "justification (pass --justification TEXT, or fix the "
+              "finding instead):", file=sys.stderr)
+        for e in unjustified[:20]:
+            print(f"  {e['rule']}: {e['path']} [{e['symbol']}] "
+                  f"{e['line_text'][:60]}", file=sys.stderr)
+        if len(unjustified) > 20:
+            print(f"  ... and {len(unjustified) - 20} more",
+                  file=sys.stderr)
+        return 2
+    write_baseline_entries(baseline_path, entries)
+    print(f"tpulint: wrote {len(entries)} baseline entries to "
+          f"{baseline_path}")
+    return 0
 
 
 if __name__ == "__main__":  # pragma: no cover

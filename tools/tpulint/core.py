@@ -429,21 +429,19 @@ def iter_py_files(paths: Iterable[Path]) -> Iterator[Path]:
             yield p
 
 
-def lint_source(source: str, relpath: str, rules) -> List[Finding]:
-    """Lint one source string; suppression pragmas applied, no baseline."""
+def lint_source(source: str, relpath: str, rules,
+                resources=None) -> List[Finding]:
+    """Lint one source string as a single-module project; suppression
+    pragmas applied, no baseline."""
+    from .project import Project, lint_project
+
     try:
         mod = ModuleInfo(source, relpath)
     except SyntaxError as e:
         return [Finding(rule="parse-error", path=relpath,
                         line=e.lineno or 1, col=e.offset or 0,
                         symbol="<module>", message=str(e))]
-    out: List[Finding] = []
-    for rule in rules:
-        for f in rule.check(mod):
-            if not mod.is_suppressed(f):
-                out.append(f)
-    out.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
-    return out
+    return lint_project(Project([mod], resources=resources), rules)
 
 
 def relpath_for(path: Path, root: Path) -> str:
@@ -454,13 +452,25 @@ def relpath_for(path: Path, root: Path) -> str:
 
 
 def lint_paths(paths: Iterable[Path], rules,
-               root: Optional[Path] = None) -> List[Finding]:
+               root: Optional[Path] = None,
+               project_paths: Optional[Iterable[Path]] = None,
+               stats: Optional[Dict[str, Dict[str, int]]] = None
+               ) -> List[Finding]:
+    """Lint ``paths``. Interprocedural facts are built from
+    ``project_paths`` when given (the ``--changed`` incremental mode:
+    facts whole-tree, findings only for the changed files)."""
+    from .project import Project, lint_project
+
     root = (root or Path.cwd()).resolve()
-    findings: List[Finding] = []
-    for path in iter_py_files(paths):
-        rel = relpath_for(path, root)
-        findings.extend(
-            lint_source(path.read_text(encoding="utf-8"), rel, rules))
+    fact_paths = list(project_paths) if project_paths is not None \
+        else list(paths)
+    project, findings = Project.from_paths(fact_paths, root)
+    findings = list(findings)
+    findings.extend(lint_project(project, rules, stats=stats))
+    if project_paths is not None:
+        linted = {relpath_for(p, root) for p in iter_py_files(paths)}
+        findings = [f for f in findings if f.path in linted]
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
     return findings
 
 
@@ -487,6 +497,24 @@ def write_baseline_entries(path: Path,
         {"comment": "tpulint grandfathered violations — shrink me, "
                     "never grow me (see README 'Static analysis')",
          "findings": entries}, indent=1) + "\n", encoding="utf-8")
+
+
+def match_baseline_entries(findings: List[Finding],
+                           baseline: List[Dict[str, str]]
+                           ) -> List[Dict[str, str]]:
+    """The subset of baseline entries a current finding still matches
+    (multiset semantics; the ORIGINAL dicts are returned so extra keys
+    like ``justification`` survive a prune)."""
+    pool: Dict[Tuple[str, str, str, str], List[Dict[str, str]]] = {}
+    for e in baseline:
+        key = (e["rule"], e["path"], e["symbol"], e["line_text"])
+        pool.setdefault(key, []).append(e)
+    kept: List[Dict[str, str]] = []
+    for f in findings:
+        entries = pool.get(f.fingerprint())
+        if entries:
+            kept.append(entries.pop(0))
+    return kept
 
 
 def split_by_baseline(findings: List[Finding],

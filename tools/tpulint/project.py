@@ -1,0 +1,645 @@
+"""tpulint project model: the package-wide interprocedural pass.
+
+``core.ModuleInfo`` answers questions about ONE file; a ``Project``
+holds every parsed module of the lint tree and computes the cross-file
+facts the contract rules need:
+
+- *import resolution*: relative and absolute imports mapped onto the
+  project's own modules, so ``from . import collective as C`` followed
+  by ``C.t_psum(...)`` resolves to the actual shim def;
+- *call resolution*: a best-effort map from call expressions to project
+  function defs (local defs, imported symbols, module-alias attribute
+  chains, ``self.method``), with a name-based method fallback used only
+  where noted;
+- *thread reachability*: the transitive closure of functions reachable
+  from ``threading.Thread(target=...)`` entrypoints, across modules —
+  the ckpt writer thread reaching ``GoodputLedger.record_overlapped``
+  two modules away is the motivating case;
+- *collective taint*: which canonical ledger op kinds (psum /
+  all_gather / reduce_scatter / all_to_all / ppermute) a function
+  transitively issues through the ``t_*`` shim — the fact the
+  VJP-symmetry rule compares between a ``custom_vjp``'s fwd and bwd;
+- *class concurrency facts*: per class, the lock attributes, the
+  thread-safe attributes (queue.Queue / threading.Event / ...), every
+  ``self.X`` mutation/read site with the set of locks lexically held,
+  and a fixpoint "locks always held on entry" for private methods only
+  ever called under a lock (``_close_interval`` in goodput.py);
+- *donation facts*: attributes/stores/factory methods bound to
+  ``jax.jit(..., donate_argnums=...)`` results, and forwarder wrappers
+  (``def _run(self, site, fn, *args): ... fn(*args)``) so a donated
+  buffer read after the dispatch is visible through one indirection.
+
+Everything is a heuristic tuned to this repo's idiom, like the core
+taint pass: pragmas and the justified baseline absorb the residue.
+"""
+from __future__ import annotations
+
+import ast
+import json
+from pathlib import Path
+from typing import (Any, Dict, FrozenSet, Iterable, Iterator, List,
+                    Optional, Set, Tuple)
+
+from .core import (Finding, ModuleInfo, Rule, func_root, func_simple_name,
+                   iter_py_files, relpath_for)
+
+# the traced-collective shim (distributed/collective.py) mapped onto the
+# comm ledger's canonical op kinds (observability/commledger.py OPS)
+COLLECTIVE_SHIMS = {
+    "t_psum": "psum", "t_pmean": "psum", "t_pmax": "pmax",
+    "t_pmin": "pmin", "t_all_gather": "all_gather",
+    "t_psum_scatter": "reduce_scatter", "t_all_to_all": "all_to_all",
+    "t_ppermute": "ppermute",
+}
+
+# raw lax collectives the shim wraps — using these directly anywhere
+# else silently undercounts the comm ledger
+RAW_COLLECTIVES = {
+    "psum": "psum", "pmean": "psum", "pmax": "pmax", "pmin": "pmin",
+    "all_gather": "all_gather", "psum_scatter": "reduce_scatter",
+    "all_to_all": "all_to_all", "ppermute": "ppermute",
+}
+
+LOCK_CTORS = {"Lock", "RLock", "Condition"}
+# attrs holding these never need an extra lock (internally synchronized
+# or thread-local by construction)
+THREADSAFE_CTORS = {"Queue", "LifoQueue", "PriorityQueue", "SimpleQueue",
+                    "Event", "Semaphore", "BoundedSemaphore", "Barrier",
+                    "local"}
+# container-method calls that mutate the receiver in place
+MUTATING_METHODS = {"append", "appendleft", "extend", "extendleft",
+                    "insert", "pop", "popleft", "popitem", "remove",
+                    "clear", "discard", "setdefault"}
+# names too generic for the name-based method fallback (they would
+# resolve dict.get / file.write / Thread.start onto project classes)
+_FALLBACK_BLOCKLIST = {
+    "get", "set", "put", "add", "update", "pop", "append", "extend",
+    "remove", "clear", "items", "keys", "values", "join", "start",
+    "run", "close", "open", "wait", "check", "read", "write", "flush",
+    "send",
+    "recv", "acquire", "release", "notify", "notify_all", "copy",
+    "sort", "split", "strip", "format", "encode", "decode", "match",
+    "search", "group", "count", "index", "insert", "reshape", "astype",
+}
+
+FuncKey = Tuple[str, int]          # (module relpath, id(function node))
+
+
+def _flatten_chain(expr: ast.expr) -> Optional[List[str]]:
+    """``a.b.c`` -> ["a", "b", "c"]; None for anything with calls or
+    subscripts in the chain."""
+    parts: List[str] = []
+    while isinstance(expr, ast.Attribute):
+        parts.append(expr.attr)
+        expr = expr.value
+    if not isinstance(expr, ast.Name):
+        return None
+    parts.append(expr.id)
+    return list(reversed(parts))
+
+
+def module_name_for(relpath: str) -> str:
+    """Dotted module name of a project-relative path
+    (``pkg/sub/__init__.py`` -> ``pkg.sub``)."""
+    parts = relpath[:-3].split("/") if relpath.endswith(".py") \
+        else relpath.split("/")
+    if parts and parts[-1] == "__init__":
+        parts = parts[:-1]
+    return ".".join(parts)
+
+
+class ClassInfo:
+    """Concurrency-relevant facts of one class definition."""
+
+    def __init__(self, mod: ModuleInfo, node: ast.ClassDef):
+        self.mod = mod
+        self.node = node
+        self.name = node.name
+        self.methods: Dict[str, ast.AST] = {}
+        for child in node.body:
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.methods[child.name] = child
+        self.is_threadlocal = any(
+            (_flatten_chain(b) or [""])[-1] == "local"
+            for b in node.bases)
+        self.lock_attrs: Set[str] = set()
+        self.threadsafe_attrs: Set[str] = set()
+        # attr -> [(node, method, is_mutation)]
+        self.accesses: Dict[str, List[Tuple[ast.AST, ast.AST, bool]]] = {}
+        self._entry_held: Optional[Dict[int, FrozenSet[str]]] = None
+        self._init_only: Optional[Set[int]] = None
+        self._collect()
+
+    # -- fact collection -------------------------------------------------
+    def _self_attr(self, expr: ast.expr) -> Optional[str]:
+        if isinstance(expr, ast.Attribute) and \
+                isinstance(expr.value, ast.Name) and \
+                expr.value.id in ("self", "cls"):
+            return expr.attr
+        return None
+
+    def _note(self, attr: str, node: ast.AST, meth: ast.AST,
+              mutation: bool) -> None:
+        self.accesses.setdefault(attr, []).append((node, meth, mutation))
+
+    def _collect(self) -> None:
+        for meth in self.methods.values():
+            for node in ast.walk(meth):
+                # with self.X: => X is a lock-like attr
+                if isinstance(node, (ast.With, ast.AsyncWith)):
+                    for item in node.items:
+                        a = self._self_attr(item.context_expr)
+                        if a is not None:
+                            self.lock_attrs.add(a)
+                if isinstance(node, (ast.Assign, ast.AugAssign,
+                                     ast.AnnAssign)):
+                    targets = node.targets if isinstance(node, ast.Assign) \
+                        else [node.target]
+                    value = node.value
+                    for tgt in targets:
+                        for leaf in self._target_leaves(tgt):
+                            a = self._self_attr(leaf)
+                            sub = None
+                            if a is None and isinstance(leaf, ast.Subscript):
+                                sub = self._self_attr(leaf.value)
+                            if a is not None:
+                                self._note(a, node, meth, True)
+                                self._classify_assign(a, value)
+                            elif sub is not None:
+                                self._note(sub, node, meth, True)
+                elif isinstance(node, ast.Call):
+                    # self.X.append(...) and friends mutate X in place
+                    if isinstance(node.func, ast.Attribute) and \
+                            node.func.attr in MUTATING_METHODS:
+                        a = self._self_attr(node.func.value)
+                        if a is not None:
+                            self._note(a, node, meth, True)
+                elif isinstance(node, ast.Attribute) and \
+                        isinstance(node.ctx, ast.Load):
+                    a = self._self_attr(node)
+                    if a is not None:
+                        self._note(a, node, meth, False)
+
+    def _target_leaves(self, tgt: ast.expr) -> Iterator[ast.expr]:
+        if isinstance(tgt, (ast.Tuple, ast.List)):
+            for el in tgt.elts:
+                yield from self._target_leaves(el)
+        else:
+            yield tgt
+
+    def _classify_assign(self, attr: str, value: Optional[ast.expr]):
+        if not isinstance(value, ast.Call):
+            return
+        name = func_simple_name(value.func)
+        if name in LOCK_CTORS:
+            self.lock_attrs.add(attr)
+        elif name in THREADSAFE_CTORS:
+            self.threadsafe_attrs.add(attr)
+
+    # -- lock analysis ---------------------------------------------------
+    def locks_held_at(self, node: ast.AST) -> FrozenSet[str]:
+        """Lock attrs lexically held (enclosing ``with self.X:``)."""
+        held: Set[str] = set()
+        cur = self.mod.parent(node)
+        while cur is not None and cur is not self.node:
+            if isinstance(cur, (ast.With, ast.AsyncWith)):
+                for item in cur.items:
+                    a = self._self_attr(item.context_expr)
+                    if a is not None and a in self.lock_attrs:
+                        held.add(a)
+            cur = self.mod.parent(cur)
+        return frozenset(held)
+
+    def _in_class_call_sites(self) -> Dict[str, List[Tuple[ast.AST,
+                                                           ast.AST]]]:
+        """method name -> [(call node, calling method)] for
+        self.m(...)/cls.m(...) calls inside this class."""
+        out: Dict[str, List[Tuple[ast.AST, ast.AST]]] = {}
+        for meth in self.methods.values():
+            for node in ast.walk(meth):
+                if isinstance(node, ast.Call):
+                    a = self._self_attr(node.func)
+                    if a is not None and a in self.methods:
+                        out.setdefault(a, []).append((node, meth))
+        return out
+
+    def init_only_methods(self) -> Set[int]:
+        """ids of methods only ever called (in-class) from __init__ —
+        they run before any thread this class starts exists."""
+        if self._init_only is not None:
+            return self._init_only
+        sites = self._in_class_call_sites()
+        init = self.methods.get("__init__")
+        init_only: Set[int] = set()
+        changed = True
+        while changed:
+            changed = False
+            for name, meth in self.methods.items():
+                if name == "__init__" or id(meth) in init_only:
+                    continue
+                calls = sites.get(name)
+                if not calls:
+                    continue
+                if all(c is init or id(c) in init_only
+                       for _, c in calls):
+                    init_only.add(id(meth))
+                    changed = True
+        self._init_only = init_only
+        return init_only
+
+    def entry_held(self) -> Dict[int, FrozenSet[str]]:
+        """Fixpoint: locks guaranteed held whenever each method runs —
+        the intersection over its non-__init__ in-class call sites of
+        (locks lexically held at the site + the caller's own entry
+        set). Methods with no in-class callers are entry points (no
+        guarantee). This is what keeps a private helper like
+        ``_close_interval`` (only ever called under ``self._lock``)
+        from being a false positive."""
+        if self._entry_held is not None:
+            return self._entry_held
+        sites = self._in_class_call_sites()
+        init = self.methods.get("__init__")
+        top = frozenset(self.lock_attrs)
+        held = {id(m): (top if sites.get(name) else frozenset())
+                for name, m in self.methods.items()}
+        changed = True
+        while changed:
+            changed = False
+            for name, meth in self.methods.items():
+                calls = [(n, c) for n, c in sites.get(name, ())
+                         if c is not init]
+                if not calls:
+                    new = frozenset()
+                else:
+                    new = top
+                    for node, caller in calls:
+                        new &= (self.locks_held_at(node)
+                                | held.get(id(caller), frozenset()))
+                if new != held[id(meth)]:
+                    held[id(meth)] = new
+                    changed = True
+        self._entry_held = held
+        return held
+
+
+class Project:
+    """Whole-tree analysis context shared by every project rule."""
+
+    def __init__(self, modules: List[ModuleInfo],
+                 root: Optional[Path] = None,
+                 resources: Optional[Dict[str, Any]] = None):
+        self.modules = modules
+        self.root = root
+        self.by_relpath: Dict[str, ModuleInfo] = {
+            m.relpath: m for m in modules}
+        self.by_modname: Dict[str, ModuleInfo] = {
+            module_name_for(m.relpath): m for m in modules}
+        self._resources = dict(resources or {})
+        self._imports: Dict[str, Dict[str, Tuple]] = {}
+        self._classes: Dict[str, List[ClassInfo]] = {}
+        self._class_of_fn: Dict[FuncKey, ClassInfo] = {}
+        self._method_index: Optional[Dict[str, List[Tuple[ModuleInfo,
+                                                          ClassInfo,
+                                                          ast.AST]]]] = None
+        self._thread_reachable: Optional[Set[FuncKey]] = None
+        self._thread_entries: Dict[FuncKey, str] = {}
+        self._coll_cache: Dict[FuncKey, Set[str]] = {}
+
+    # -- construction ----------------------------------------------------
+    @classmethod
+    def from_sources(cls, sources: Dict[str, str],
+                     resources: Optional[Dict[str, Any]] = None
+                     ) -> "Project":
+        """In-memory project (tests): {relpath: source}."""
+        mods = [ModuleInfo(src, rel) for rel, src in sorted(
+            sources.items())]
+        return cls(mods, resources=resources)
+
+    @classmethod
+    def from_paths(cls, paths: Iterable[Path], root: Path
+                   ) -> Tuple["Project", List[Finding]]:
+        """Parse every .py under ``paths``; unparsable files become
+        parse-error findings instead of members."""
+        modules: List[ModuleInfo] = []
+        errors: List[Finding] = []
+        seen: Set[str] = set()
+        for path in iter_py_files(paths):
+            rel = relpath_for(path, root)
+            if rel in seen:
+                continue
+            seen.add(rel)
+            try:
+                modules.append(ModuleInfo(
+                    path.read_text(encoding="utf-8"), rel))
+            except SyntaxError as e:
+                errors.append(Finding(
+                    rule="parse-error", path=rel, line=e.lineno or 1,
+                    col=e.offset or 0, symbol="<module>",
+                    message=str(e)))
+        return cls(modules, root=root), errors
+
+    # -- resources -------------------------------------------------------
+    def resource(self, name: str) -> Optional[Any]:
+        """Project-level data a rule needs beyond python sources.
+        ``metric_schema``: the parsed observability schema.json, found
+        next to any module named ``*/observability/catalog.py``."""
+        if name in self._resources:
+            return self._resources[name]
+        value = None
+        if name == "metric_schema" and self.root is not None:
+            for rel in self.by_relpath:
+                if rel.endswith("observability/catalog.py"):
+                    p = Path(self.root) / rel.rsplit("/", 1)[0] / \
+                        "schema.json"
+                    if p.is_file():
+                        try:
+                            value = json.loads(
+                                p.read_text(encoding="utf-8"))
+                        except ValueError:
+                            value = None
+                        break
+        self._resources[name] = value
+        return value
+
+    # -- imports ---------------------------------------------------------
+    def imports(self, mod: ModuleInfo) -> Dict[str, Tuple]:
+        """{bound name: ("module", dotted) | ("symbol", dotted, name)}
+        restricted to targets that exist in this project."""
+        cached = self._imports.get(mod.relpath)
+        if cached is not None:
+            return cached
+        out: Dict[str, Tuple] = {}
+        modname = module_name_for(mod.relpath)
+        is_pkg = mod.relpath.endswith("__init__.py")
+        pkg_parts = modname.split(".") if is_pkg \
+            else modname.split(".")[:-1]
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    bound = alias.asname or alias.name.split(".")[0]
+                    target = alias.name if alias.asname else \
+                        alias.name.split(".")[0]
+                    if any(k == target or k.startswith(target + ".")
+                           for k in self.by_modname):
+                        out[bound] = ("module", target)
+            elif isinstance(node, ast.ImportFrom):
+                if node.level:
+                    base = pkg_parts[:len(pkg_parts) - (node.level - 1)] \
+                        if node.level <= len(pkg_parts) + 1 else []
+                else:
+                    base = []
+                base = base + (node.module.split(".")
+                               if node.module else [])
+                base_name = ".".join(base)
+                for alias in node.names:
+                    bound = alias.asname or alias.name
+                    child = f"{base_name}.{alias.name}" if base_name \
+                        else alias.name
+                    if child in self.by_modname:
+                        out[bound] = ("module", child)
+                    elif base_name in self.by_modname:
+                        out[bound] = ("symbol", base_name, alias.name)
+        self._imports[mod.relpath] = out
+        return out
+
+    # -- function / class indexes ---------------------------------------
+    def classes(self, mod: ModuleInfo) -> List[ClassInfo]:
+        cached = self._classes.get(mod.relpath)
+        if cached is not None:
+            return cached
+        out = [ClassInfo(mod, n) for n in ast.walk(mod.tree)
+               if isinstance(n, ast.ClassDef)]
+        for ci in out:
+            for meth in ci.methods.values():
+                self._class_of_fn[(mod.relpath, id(meth))] = ci
+        self._classes[mod.relpath] = out
+        return out
+
+    def class_of(self, mod: ModuleInfo, fn: ast.AST) -> Optional[ClassInfo]:
+        self.classes(mod)
+        return self._class_of_fn.get((mod.relpath, id(fn)))
+
+    def module_level_function(self, mod: ModuleInfo,
+                              name: str) -> Optional[ast.AST]:
+        for fn in mod.functions():
+            if fn.name == name and isinstance(mod.parent(fn), ast.Module):
+                return fn
+        return None
+
+    def _method_fallback(self, name: str):
+        if self._method_index is None:
+            idx: Dict[str, List] = {}
+            for mod in self.modules:
+                for ci in self.classes(mod):
+                    for mname, meth in ci.methods.items():
+                        idx.setdefault(mname, []).append((mod, ci, meth))
+            self._method_index = idx
+        if name.startswith("__") or name in _FALLBACK_BLOCKLIST:
+            return []
+        return self._method_index.get(name, [])
+
+    # -- call resolution -------------------------------------------------
+    def resolve_callable(self, mod: ModuleInfo, scope: Optional[ast.AST],
+                         expr: ast.expr, name_fallback: bool = False
+                         ) -> List[Tuple[ModuleInfo, ast.AST]]:
+        """Project function defs a call/reference expression may hit.
+        ``scope`` is the enclosing function (for nested defs / self).
+        ``name_fallback`` additionally resolves ``<anything>.m(...)``
+        to every project method named ``m`` (used by the thread-
+        reachability closure only — coarse on purpose)."""
+        chain = _flatten_chain(expr)
+        if chain is None:
+            return []
+        root, rest = chain[0], chain[1:]
+        # self.m / cls.m -> enclosing class method
+        if root in ("self", "cls") and len(rest) == 1 and \
+                scope is not None:
+            ci = self.class_of(mod, scope)
+            if ci is None:
+                cur = mod.enclosing_function(scope)
+                while cur is not None and ci is None:
+                    ci = self.class_of(mod, cur)
+                    cur = mod.enclosing_function(cur)
+            if ci is not None and rest[0] in ci.methods:
+                return [(mod, ci.methods[rest[0]])]
+            return self._name_fallback_hits(rest[0]) if name_fallback \
+                else []
+        if not rest:
+            # plain name: nested defs visible from scope, module level,
+            # then imported symbol
+            hits: List[Tuple[ModuleInfo, ast.AST]] = []
+            cur = scope
+            while cur is not None:
+                for sub in ast.walk(cur):
+                    if isinstance(sub, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)) \
+                            and sub.name == root and sub is not cur:
+                        hits.append((mod, sub))
+                cur = mod.enclosing_function(cur)
+            if hits:
+                return hits[:1]
+            fn = self.module_level_function(mod, root)
+            if fn is not None:
+                return [(mod, fn)]
+            imp = self.imports(mod).get(root)
+            if imp is not None and imp[0] == "symbol":
+                m2 = self.by_modname.get(imp[1])
+                if m2 is not None:
+                    fn = self.module_level_function(m2, imp[2])
+                    if fn is not None:
+                        return [(m2, fn)]
+            return []
+        # dotted chain off an imported module alias
+        imp = self.imports(mod).get(root)
+        if imp is not None and imp[0] == "module":
+            modname = imp[1]
+            attrs = list(rest)
+            while len(attrs) > 1 and f"{modname}.{attrs[0]}" \
+                    in self.by_modname:
+                modname = f"{modname}.{attrs[0]}"
+                attrs = attrs[1:]
+            if len(attrs) == 1:
+                m2 = self.by_modname.get(modname)
+                if m2 is not None:
+                    fn = self.module_level_function(m2, attrs[0])
+                    if fn is not None:
+                        return [(m2, fn)]
+            return []
+        if name_fallback and len(rest) >= 1:
+            return self._name_fallback_hits(rest[-1])
+        return []
+
+    def _name_fallback_hits(self, name: str):
+        return [(mod, meth) for mod, _ci, meth
+                in self._method_fallback(name)]
+
+    # -- thread reachability ---------------------------------------------
+    def thread_reachable(self) -> Set[FuncKey]:
+        """ids of functions reachable from a Thread(target=...) —
+        transitively, across modules, with the name-based method
+        fallback for attribute calls on objects of unknown type."""
+        if self._thread_reachable is not None:
+            return self._thread_reachable
+        work: List[Tuple[ModuleInfo, ast.AST]] = []
+        reach: Set[FuncKey] = set()
+
+        def push(mod, fn, entry):
+            key = (mod.relpath, id(fn))
+            if key not in reach:
+                reach.add(key)
+                self._thread_entries.setdefault(key, entry)
+                work.append((mod, fn))
+
+        for mod in self.modules:
+            for call in ast.walk(mod.tree):
+                if not isinstance(call, ast.Call) or \
+                        func_simple_name(call.func) != "Thread":
+                    continue
+                targets = [kw.value for kw in call.keywords
+                           if kw.arg == "target"]
+                if not targets and len(call.args) >= 2:
+                    targets = [call.args[1]]
+                scope = mod.enclosing_function(call)
+                for tgt in targets:
+                    for m2, fn in self.resolve_callable(
+                            mod, scope, tgt, name_fallback=True):
+                        entry = f"{m2.relpath}:{m2.qualname_of(fn)}"
+                        push(m2, fn, entry)
+        while work:
+            mod, fn = work.pop()
+            entry = self._thread_entries[(mod.relpath, id(fn))]
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                scope = mod.enclosing_function(node) or fn
+                hits = self.resolve_callable(mod, scope, node.func)
+                if not hits and isinstance(node.func, ast.Attribute):
+                    hits = self.resolve_callable(
+                        mod, scope, node.func, name_fallback=True)
+                for m2, f2 in hits:
+                    push(m2, f2, entry)
+        self._thread_reachable = reach
+        return reach
+
+    def thread_entry_of(self, mod: ModuleInfo, fn: ast.AST
+                        ) -> Optional[str]:
+        """The Thread target this function is reachable from (its
+        relpath:qualname), or None."""
+        self.thread_reachable()
+        return self._thread_entries.get((mod.relpath, id(fn)))
+
+    def is_thread_reachable(self, mod: ModuleInfo, fn: ast.AST) -> bool:
+        return (mod.relpath, id(fn)) in self.thread_reachable()
+
+    # -- collective taint ------------------------------------------------
+    def collective_kinds(self, mod: ModuleInfo, fn: ast.AST
+                         ) -> Set[str]:
+        """Canonical ledger op kinds ``fn`` transitively issues through
+        the t_* shim (cross-module; cycles truncate)."""
+        visiting: Set[FuncKey] = set()
+
+        def dfs(m: ModuleInfo, f: ast.AST) -> Set[str]:
+            key = (m.relpath, id(f))
+            cached = self._coll_cache.get(key)
+            if cached is not None:
+                return cached
+            if key in visiting:
+                return set()
+            visiting.add(key)
+            kinds: Set[str] = set()
+            for node in ast.walk(f):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = func_simple_name(node.func)
+                if name in COLLECTIVE_SHIMS:
+                    kinds.add(COLLECTIVE_SHIMS[name])
+                    continue
+                scope = m.enclosing_function(node) or \
+                    (f if isinstance(f, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)) else None)
+                for m2, f2 in self.resolve_callable(m, scope, node.func):
+                    kinds |= dfs(m2, f2)
+            visiting.discard(key)
+            self._coll_cache[key] = kinds
+            return kinds
+
+        return dfs(mod, fn)
+
+
+class ProjectRule(Rule):
+    """A rule that needs the whole-tree Project, not one module."""
+
+    project = True
+
+    def check_project(self, project: Project) -> Iterator[Finding]:
+        raise NotImplementedError
+
+    def check(self, mod: ModuleInfo) -> Iterator[Finding]:
+        # project rules are driven via check_project
+        return iter(())
+
+
+def lint_project(project: Project, rules,
+                 stats: Optional[Dict[str, Dict[str, int]]] = None
+                 ) -> List[Finding]:
+    """Run module rules over every member and project rules once;
+    suppression pragmas applied per finding's home module. ``stats``
+    (rule id -> counters) picks up per-rule suppression counts."""
+    out: List[Finding] = []
+    for rule in rules:
+        if getattr(rule, "project", False):
+            found = list(rule.check_project(project))
+        else:
+            found = [f for mod in project.modules
+                     for f in rule.check(mod)]
+        for f in found:
+            mod = project.by_relpath.get(f.path)
+            if mod is not None and mod.is_suppressed(f):
+                if stats is not None:
+                    stats.setdefault(rule.id, {}).setdefault(
+                        "suppressed", 0)
+                    stats[rule.id]["suppressed"] += 1
+                continue
+            out.append(f)
+    out.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return out

@@ -1,17 +1,24 @@
-"""tpulint rule registry. A rule is a ``core.Rule`` subclass; adding a
-module here (and instantiating it in ALL_RULES) is the whole plugin
-surface — the CLI, baseline, suppression and JSON layers are generic.
+"""tpulint rule registry. A rule is a ``core.Rule`` subclass (or a
+``project.ProjectRule`` when it needs the whole-tree interprocedural
+pass); adding a module here (and instantiating it in ALL_RULES) is the
+whole plugin surface — the CLI, baseline, suppression and JSON layers
+are generic.
 """
 from __future__ import annotations
 
 from typing import Dict, List
 
 from ..core import Rule
+from .donation_reuse import DonationReuseRule
 from .host_sync import HostSyncInJitRule
 from .nonhashable_static import NonhashableStaticRule
+from .raw_collective import RawCollectiveRule
 from .recompile_hazard import RecompileHazardRule
+from .shared_mutation import SharedMutationRule
 from .traced_bool import TracedBoolRule
+from .unregistered_metric import UnregisteredMetricRule
 from .unused_knob import UnusedKnobRule
+from .vjp_symmetry import VjpSymmetryRule
 
 ALL_RULES: List[Rule] = [
     UnusedKnobRule(),
@@ -19,6 +26,12 @@ ALL_RULES: List[Rule] = [
     TracedBoolRule(),
     NonhashableStaticRule(),
     RecompileHazardRule(),
+    # the interprocedural contract rules (tools/tpulint/project.py)
+    RawCollectiveRule(),
+    UnregisteredMetricRule(),
+    VjpSymmetryRule(),
+    DonationReuseRule(),
+    SharedMutationRule(),
 ]
 
 RULES_BY_ID: Dict[str, Rule] = {r.id: r for r in ALL_RULES}

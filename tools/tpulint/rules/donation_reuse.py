@@ -1,0 +1,267 @@
+"""donation-reuse: reading a buffer after donating it to a dispatch.
+
+``jax.jit(..., donate_argnums=...)`` hands the argument's buffer to
+XLA: after the call the python array is deleted (errors on CPU/GPU) or
+— worse on TPU serving — silently aliases the output, so a read
+observes torn data. The serving KV-cache contract this encodes: the
+caller must REBIND the donated name from the call's results (``toks,
+caches = fn(..., caches, ...)``) and never touch the old reference
+again; the memledger already has to lower programs BEFORE the call for
+the same reason.
+
+Donation facts are interprocedural within a class/module:
+
+- direct bindings: ``fn = jax.jit(f, donate_argnums=(2,))``;
+- donating stores: ``self._step_fns[key] = jax.jit(...)`` marks the
+  attribute, so ``fn = self._step_fns[key]; fn(...)`` is a donating
+  call;
+- factory methods: a method whose returns are jit-donating calls or
+  reads of a donating store (``def _prefill_fn(...): ...; return
+  self._prefill_fns[key]``) donates at its call sites;
+- forwarder wrappers: ``def _run(self, site, fn, *args)`` whose body
+  calls ``fn(*args)`` shifts the donated position by the payload
+  offset (``self._run(site, fn, a, b, cache)``).
+
+The finding lands on the first read of the donated name after the
+dispatch (before any rebinding).
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from ..core import Finding, ModuleInfo, func_simple_name
+from ..project import Project, ProjectRule
+
+_JIT_NAMES = {"jit", "pjit"}
+
+
+def _donate_argnums(call: ast.Call) -> Optional[Tuple[int, ...]]:
+    """The donate_argnums of a jax.jit/pjit call, or None. A
+    conditional ``(0, 1) if donate else ()`` counts with the donating
+    branch (conservative)."""
+    if func_simple_name(call.func) not in _JIT_NAMES:
+        return None
+    for kw in call.keywords:
+        if kw.arg not in ("donate_argnums", "donate_argnames"):
+            continue
+        out = _int_tuple(kw.value)
+        if out:
+            return out
+        if isinstance(kw.value, ast.IfExp):
+            for branch in (kw.value.body, kw.value.orelse):
+                out = _int_tuple(branch)
+                if out:
+                    return out
+    return None
+
+
+def _int_tuple(expr: ast.expr) -> Optional[Tuple[int, ...]]:
+    if isinstance(expr, (ast.Tuple, ast.List)):
+        vals = []
+        for el in expr.elts:
+            if isinstance(el, ast.Constant) and isinstance(el.value, int):
+                vals.append(el.value)
+            else:
+                return None
+        return tuple(vals) or None
+    if isinstance(expr, ast.Constant) and isinstance(expr.value, int):
+        return (expr.value,)
+    return None
+
+
+def _self_attr_of(expr: ast.expr) -> Optional[str]:
+    if isinstance(expr, ast.Attribute) and \
+            isinstance(expr.value, ast.Name) and \
+            expr.value.id in ("self", "cls"):
+        return expr.attr
+    return None
+
+
+class _ModuleFacts:
+    """Donation facts of one module (classes + module level)."""
+
+    def __init__(self, mod: ModuleInfo):
+        self.mod = mod
+        # attr name -> argnums (self._fns = jit(...) / self._fns[k] = ...)
+        self.stores: Dict[str, Tuple[int, ...]] = {}
+        # function name -> argnums (factory methods / functions)
+        self.factories: Dict[str, Tuple[int, ...]] = {}
+        # function name -> index of the forwarded-callable parameter
+        # (positional, self excluded at call sites via naming)
+        self.forwarders: Dict[str, int] = {}
+        for _ in range(3):          # tiny fixpoint: store <-> factory
+            before = (dict(self.stores), dict(self.factories))
+            self._scan()
+            if (self.stores, self.factories) == before:
+                break
+        self._find_forwarders()
+
+    def _scan(self) -> None:
+        for node in ast.walk(self.mod.tree):
+            if isinstance(node, ast.Assign):
+                nums = self._donating_value(node.value)
+                if nums is None:
+                    continue
+                for tgt in node.targets:
+                    attr = _self_attr_of(tgt)
+                    if attr is None and isinstance(tgt, ast.Subscript):
+                        attr = _self_attr_of(tgt.value)
+                    if attr is not None:
+                        self.stores[attr] = nums
+            elif isinstance(node, ast.Return) and node.value is not None:
+                nums = self._donating_value(node.value)
+                if nums is not None:
+                    fn = self.mod.enclosing_function(node)
+                    if fn is not None:
+                        self.factories[fn.name] = nums
+
+    def _donating_value(self, expr: ast.expr) -> Optional[Tuple[int, ...]]:
+        if isinstance(expr, ast.Call):
+            nums = _donate_argnums(expr)
+            if nums is not None:
+                return nums
+            # self._factory(...) returning a donating callable
+            attr = _self_attr_of(expr.func)
+            if attr is not None and attr in self.factories:
+                return self.factories[attr]
+            name = func_simple_name(expr.func)
+            if name in self.factories:
+                return self.factories[name]
+            return None
+        if isinstance(expr, ast.Subscript):
+            attr = _self_attr_of(expr.value)
+            if attr is not None and attr in self.stores:
+                return self.stores[attr]
+        attr = _self_attr_of(expr)
+        if attr is not None and attr in self.stores:
+            return self.stores[attr]
+        return None
+
+    def _find_forwarders(self) -> None:
+        """``def w(self, a, f, *rest): ... f(*rest)`` — calling through
+        ``w`` applies f's donation to the payload after f's position."""
+        for fn in self.mod.functions():
+            vararg = fn.args.vararg
+            if vararg is None:
+                continue
+            pos = [a.arg for a in fn.args.posonlyargs + fn.args.args]
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Call) and \
+                        isinstance(node.func, ast.Name) and \
+                        node.func.id in pos and \
+                        any(isinstance(a, ast.Starred) and
+                            isinstance(a.value, ast.Name) and
+                            a.value.id == vararg.arg
+                            for a in node.args):
+                    idx = pos.index(node.func.id)
+                    if pos and pos[0] in ("self", "cls"):
+                        idx -= 1
+                    self.forwarders[fn.name] = idx
+
+
+class DonationReuseRule(ProjectRule):
+    id = "donation-reuse"
+    description = ("value read after being donated (donate_argnums) "
+                   "to a compiled dispatch — deleted/aliased buffer")
+
+    def check_project(self, project: Project) -> Iterator[Finding]:
+        for mod in project.modules:
+            facts = _ModuleFacts(mod)
+            for fn in mod.functions():
+                yield from self._check_fn(mod, facts, fn)
+
+    def _check_fn(self, mod: ModuleInfo, facts: _ModuleFacts,
+                  fn: ast.AST) -> Iterator[Finding]:
+        # names bound to donating callables inside this function
+        bound: Dict[str, Tuple[int, ...]] = {}
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign):
+                nums = facts._donating_value(node.value)
+                if nums is not None:
+                    for tgt in node.targets:
+                        if isinstance(tgt, ast.Name):
+                            bound[tgt.id] = nums
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            donated = self._donated_args(mod, facts, bound, node)
+            for pos, arg in donated:
+                if not isinstance(arg, ast.Name):
+                    continue
+                read = self._read_after(mod, fn, node, arg.id)
+                if read is not None:
+                    yield self.finding(
+                        mod, read,
+                        f"'{arg.id}' is read after being donated "
+                        f"(donate_argnums position {pos}) to a "
+                        f"compiled dispatch — the buffer is deleted "
+                        f"or aliases the output; rebind it from the "
+                        f"call's results instead")
+
+    def _donated_args(self, mod: ModuleInfo, facts: _ModuleFacts,
+                      bound: Dict[str, Tuple[int, ...]],
+                      call: ast.Call) -> List[Tuple[int, ast.expr]]:
+        """(donated position, argument expr) pairs of one call."""
+        func = call.func
+        nums: Optional[Tuple[int, ...]] = None
+        offset = 0
+        # fn(...) with fn bound to a donating callable
+        if isinstance(func, ast.Name) and func.id in bound:
+            nums = bound[func.id]
+        # self._fns[key](...) / self._factory(...)(...)
+        if nums is None and isinstance(func, ast.Subscript):
+            attr = _self_attr_of(func.value)
+            if attr is not None:
+                nums = facts.stores.get(attr)
+        if nums is None and isinstance(func, ast.Call):
+            nums = facts._donating_value(func)
+        # forwarder: self._run(site, fn, *payload)
+        if nums is None:
+            fname = func_simple_name(func)
+            if fname in facts.forwarders and call.args:
+                fpos = facts.forwarders[fname]
+                if fpos < len(call.args):
+                    inner = call.args[fpos]
+                    inner_nums = None
+                    if isinstance(inner, ast.Name):
+                        inner_nums = bound.get(inner.id)
+                    if inner_nums is None:
+                        inner_nums = facts._donating_value(inner)
+                    if inner_nums is not None:
+                        nums = inner_nums
+                        offset = fpos + 1
+        if nums is None:
+            return []
+        out = []
+        for k in nums:
+            idx = k + offset
+            if idx < len(call.args):
+                out.append((k, call.args[idx]))
+        return out
+
+    def _read_after(self, mod: ModuleInfo, fn: ast.AST, call: ast.Call,
+                    name: str) -> Optional[ast.AST]:
+        """First Load of ``name`` after the donating call's line, unless
+        a Store to it happens first (rebinding — including the call's
+        own assignment targets, which share its line)."""
+        call_line = getattr(call, "lineno", 0)
+        events: List[Tuple[int, int, str, ast.AST]] = []
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Name) and node.id == name:
+                line = getattr(node, "lineno", 0)
+                if line < call_line:
+                    continue
+                kind = "store" if isinstance(
+                    node.ctx, (ast.Store, ast.Del)) else "load"
+                if kind == "load" and line == call_line:
+                    continue        # the donating call's own argument
+                events.append((line, getattr(node, "col_offset", 0),
+                               kind, node))
+        for line, _col, kind, node in sorted(
+                events, key=lambda e: (e[0], 0 if e[2] == "store"
+                                       else 1, e[1])):
+            if kind == "store":
+                return None
+            return node
+        return None

@@ -1,0 +1,57 @@
+"""raw-collective: in-graph collectives bypassing the ledger shim.
+
+Every in-graph collective must route through the ``t_*`` traced-
+collective shim in ``distributed/collective.py`` so the comm ledger
+(observability/commledger.py) sees it at trace time. A direct
+``lax.psum`` / ``lax.all_gather`` / ``lax.psum_scatter`` /
+``lax.all_to_all`` / ``lax.ppermute`` (or pmean/pmax/pmin — wire-
+identical reduces) anywhere else moves bytes the ledger never counts:
+``paddle_tpu_comm_bytes_total`` silently undercounts, and the exposed-
+comm ablation replays the wrong program. This is the PR-7
+``_ledger_a2a`` bug class (jax's default a2a transpose called lax
+directly, leaving the MoE backward exchanges out of the ledger) turned
+into a machine-checked contract.
+
+Allowlisted: the shim module itself and the comm ledger's ablation /
+replay lowering (``observability/commledger.py``) — the two places
+that must touch lax by design.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from ..core import Finding, ModuleInfo, Rule, func_root, func_simple_name
+from ..project import RAW_COLLECTIVES
+
+ALLOWED_PATHS = ("distributed/collective.py",
+                 "observability/commledger.py")
+
+# call-target roots that mean "the jax collective, not some local fn"
+_JAX_ROOTS = {"lax", "jax"}
+
+
+class RawCollectiveRule(Rule):
+    id = "raw-collective"
+    description = ("raw lax collective outside distributed/collective.py"
+                   " — bypasses the t_* shim, comm ledger undercounts")
+
+    def check(self, mod: ModuleInfo) -> Iterator[Finding]:
+        if mod.relpath.endswith(ALLOWED_PATHS):
+            return
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = func_simple_name(node.func)
+            if name not in RAW_COLLECTIVES:
+                continue
+            root = func_root(node.func)
+            if root not in _JAX_ROOTS:
+                continue
+            shim = f"t_{name}"
+            yield self.finding(
+                mod, node,
+                f"raw {root}.{name} outside the traced-collective shim "
+                f"— the comm ledger never sees it (wire bytes "
+                f"undercount, ablation replays diverge); route through "
+                f"distributed.collective.{shim}")

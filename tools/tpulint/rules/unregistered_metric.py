@@ -1,0 +1,96 @@
+"""unregistered-metric: metric names vs the schema, both directions.
+
+Metric names are API: dashboards and the scrape config key on them,
+``observability/catalog.py`` declares them, ``schema.json`` pins them,
+and the tier-1 schema gate compares a LIVE registry against the file.
+That gate only sees metrics that were actually registered during the
+test run — a registration on a path the tests never execute drifts
+silently. This rule closes the gap statically:
+
+- direction 1: every ``<registry>.counter("name", ...)`` / ``gauge`` /
+  ``histogram`` call whose name is a string literal, anywhere in the
+  tree, must name a metric present in ``schema.json``;
+- direction 2: every ``schema.json`` entry must be registered by SOME
+  call in the tree — an unpublished catalog entry is stale and gets
+  flagged (anchored at the catalog module).
+
+``jnp.histogram`` and friends never match: only calls whose first
+argument is a string literal and whose receiver is not a jax-family
+alias count as registrations.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Set, Tuple
+
+from ..core import (JAX_ROOT_RE, Finding, ModuleInfo, Rule, func_root,
+                    func_simple_name)
+from ..project import Project, ProjectRule
+
+_REGISTER_METHODS = {"counter", "gauge", "histogram"}
+
+
+def collect_registrations(project: Project
+                          ) -> List[Tuple[ModuleInfo, ast.Call, str]]:
+    """Every (module, call, metric-name) registration site with a
+    string-literal name in the project."""
+    out: List[Tuple[ModuleInfo, ast.Call, str]] = []
+    for mod in project.modules:
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call) or \
+                    not isinstance(node.func, ast.Attribute) or \
+                    node.func.attr not in _REGISTER_METHODS:
+                continue
+            if not node.args or \
+                    not isinstance(node.args[0], ast.Constant) or \
+                    not isinstance(node.args[0].value, str):
+                continue
+            root = func_root(node.func)
+            if root is not None and JAX_ROOT_RE.match(root):
+                continue            # jnp.histogram(x, ...) etc.
+            out.append((mod, node, node.args[0].value))
+    return out
+
+
+def registered_names(project: Project) -> Set[str]:
+    """The full statically-visible metric set (the single source of
+    truth the hardened schema gate compares schema.json against)."""
+    return {name for _, _, name in collect_registrations(project)}
+
+
+class UnregisteredMetricRule(ProjectRule):
+    id = "unregistered-metric"
+    description = ("metric registered outside schema.json, or a "
+                   "schema.json entry no code registers (stale)")
+
+    def check_project(self, project: Project) -> Iterator[Finding]:
+        schema = project.resource("metric_schema")
+        if not isinstance(schema, dict) or not schema:
+            return                  # no schema in this tree: nothing to pin
+        regs = collect_registrations(project)
+        seen: Set[str] = set()
+        catalog_mod = None
+        counts: Dict[str, int] = {}
+        for mod, node, name in regs:
+            seen.add(name)
+            counts[mod.relpath] = counts.get(mod.relpath, 0) + 1
+            if name not in schema:
+                yield self.finding(
+                    mod, node,
+                    f"metric {name!r} is registered here but missing "
+                    f"from schema.json — dashboards/scrape configs key "
+                    f"on the schema; declare it in observability/"
+                    f"catalog.py and regenerate schema.json")
+        if counts:
+            catalog_mod = project.by_relpath[
+                max(counts, key=lambda k: counts[k])]
+        if catalog_mod is None:
+            return
+        for name in sorted(set(schema) - seen):
+            yield Finding(
+                rule=self.id, path=catalog_mod.relpath, line=1, col=0,
+                symbol="<schema>",
+                message=(f"schema.json declares {name!r} but no code "
+                         f"registers it — stale catalog entry; drop it "
+                         f"from the schema or restore the registration"),
+                line_text=f"<schema:{name}>")

@@ -1,0 +1,147 @@
+"""vjp-ledger-symmetry: custom_vjp fwd/bwd collective pairing.
+
+A ``jax.custom_vjp`` whose forward issues ledger-shimmed (``t_*``)
+collectives owns its backward's communication too: jax's default
+transposes call ``lax`` directly (the PR-7 ``_ledger_a2a`` bug class),
+so a bwd that issues NO ``t_*`` collective usually means the backward
+exchanges run outside the comm ledger — or do not run at all.
+
+Accepted pairings (the ones the tree documents):
+
+- *mirrored ring* (collective_matmul.py): each non-reduce op kind the
+  fwd issues has its transpose kind in the bwd — ``all_gather`` ↔
+  ``reduce_scatter``, ``all_to_all`` ↔ ``all_to_all``, ``ppermute`` ↔
+  ``ppermute``;
+- *psum/identity* (Megatron mp_ops pairing): a fwd issuing only
+  reduce-family ops (psum/pmean/pmax/pmin) pairs with an identity bwd
+  — the cotangent is replicated, no backward comm is correct;
+- *gather/slice* (the _c_concat pairing): a fwd issuing only
+  ``all_gather`` pairs with a bwd that takes a local slice
+  (``dynamic_slice_in_dim`` et al.) of the replicated cotangent.
+
+Anything else — fwd collectives with an empty bwd, or a bwd whose op
+kinds are not the mirrors — is flagged at the ``defvjp`` call.
+Collective facts are transitive and cross-module (the ring impl
+helpers live behind two layers of delegation).
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List, Optional, Set, Tuple
+
+from ..core import Finding, ModuleInfo, func_simple_name
+from ..project import Project, ProjectRule
+
+REDUCE_KINDS = {"psum", "pmax", "pmin"}
+MIRROR = {
+    "all_gather": {"reduce_scatter"},
+    "reduce_scatter": {"all_gather"},
+    "all_to_all": {"all_to_all"},
+    "ppermute": {"ppermute"},
+}
+_SLICE_CALLS = {"dynamic_slice_in_dim", "slice_in_dim", "dynamic_slice",
+                "slice", "take_along_axis"}
+
+
+def _is_custom_vjp_def(fn: ast.AST) -> bool:
+    for dec in fn.decorator_list:
+        target = dec.func if isinstance(dec, ast.Call) else dec
+        name = func_simple_name(target)
+        if name == "custom_vjp":
+            return True
+        if name == "partial" and isinstance(dec, ast.Call) and dec.args \
+                and func_simple_name(dec.args[0]) == "custom_vjp":
+            return True
+    return False
+
+
+class VjpSymmetryRule(ProjectRule):
+    id = "vjp-ledger-symmetry"
+    description = ("custom_vjp fwd issues t_* collectives but bwd is "
+                   "not the mirrored/documented pairing")
+
+    def check_project(self, project: Project) -> Iterator[Finding]:
+        for mod in project.modules:
+            for node in ast.walk(mod.tree):
+                if not isinstance(node, ast.Call) or \
+                        not isinstance(node.func, ast.Attribute) or \
+                        node.func.attr != "defvjp" or \
+                        len(node.args) < 2:
+                    continue
+                primal = self._resolve_primal(project, mod, node.func.value)
+                if primal is None or not _is_custom_vjp_def(primal):
+                    continue
+                fwd_kinds = self._kinds_of(project, mod, node, node.args[0])
+                bwd_kinds = self._kinds_of(project, mod, node, node.args[1])
+                if fwd_kinds is None or bwd_kinds is None or \
+                        not fwd_kinds:
+                    continue
+                msg = self._verdict(project, mod, node, primal,
+                                    fwd_kinds, bwd_kinds)
+                if msg:
+                    yield self.finding(mod, node, msg)
+
+    # -- resolution ------------------------------------------------------
+    def _resolve_primal(self, project: Project, mod: ModuleInfo,
+                        expr: ast.expr) -> Optional[ast.AST]:
+        scope = mod.enclosing_function(expr)
+        hits = project.resolve_callable(mod, scope, expr)
+        return hits[0][1] if hits else None
+
+    def _fn_nodes(self, project: Project, mod: ModuleInfo,
+                  at: ast.AST, expr: ast.expr
+                  ) -> Optional[List[Tuple[ModuleInfo, ast.AST]]]:
+        """The function bodies an fwd/bwd argument denotes: a lambda is
+        itself; a name resolves through the project. None = opaque."""
+        if isinstance(expr, ast.Lambda):
+            return [(mod, expr)]
+        scope = mod.enclosing_function(at)
+        hits = project.resolve_callable(mod, scope, expr)
+        return hits or None
+
+    def _kinds_of(self, project: Project, mod: ModuleInfo, at: ast.AST,
+                  expr: ast.expr) -> Optional[Set[str]]:
+        fns = self._fn_nodes(project, mod, at, expr)
+        if fns is None:
+            return None
+        kinds: Set[str] = set()
+        for m, fn in fns:
+            kinds |= project.collective_kinds(m, fn)
+        return kinds
+
+    def _bwd_has_slice(self, project: Project, mod: ModuleInfo,
+                       at: ast.AST, expr: ast.expr) -> bool:
+        fns = self._fn_nodes(project, mod, at, expr) or []
+        for m, fn in fns:
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Call) and \
+                        func_simple_name(node.func) in _SLICE_CALLS:
+                    return True
+        return False
+
+    # -- the pairing table -----------------------------------------------
+    def _verdict(self, project, mod, node, primal, fwd_kinds,
+                 bwd_kinds) -> Optional[str]:
+        name = getattr(primal, "name", "<custom_vjp>")
+        ring = sorted(fwd_kinds - REDUCE_KINDS)
+        if not bwd_kinds:
+            if not ring:
+                return None           # psum/identity (Megatron) pairing
+            if set(ring) == {"all_gather"} and self._bwd_has_slice(
+                    project, mod, node, node.args[1]):
+                return None           # gather/slice (_c_concat) pairing
+            return (f"custom_vjp '{name}': fwd issues ledger-shimmed "
+                    f"{sorted(fwd_kinds)} but bwd issues no t_* "
+                    f"collective — the backward exchange either runs "
+                    f"outside the comm ledger (raw lax transpose) or "
+                    f"is missing; mirror the ring in the bwd "
+                    f"(collective_matmul.py pairing table)")
+        missing = [k for k in ring
+                   if not (MIRROR.get(k, {k}) & bwd_kinds)]
+        if missing:
+            return (f"custom_vjp '{name}': bwd {sorted(bwd_kinds)} is "
+                    f"not the mirrored pairing of fwd "
+                    f"{sorted(fwd_kinds)} — missing the transpose of "
+                    f"{missing} (all_gather↔reduce_scatter, "
+                    f"a2a↔a2a, ppermute↔ppermute)")
+        return None
