@@ -691,16 +691,21 @@ def bench_gpt13b_hybrid(on_tpu, dev):
         B, S, steps, state_dtype = 2 * shard_deg * 2, 16, 2, None
         buf_mb = 0.001        # tiny target -> several buckets at toy size
 
-    # three lines, one knob apart each: vpp=1 (GPipe-family rotation),
-    # vpp=2 (circular interleave), and vpp=1 + comm_overlap (T3-style
+    # four lines, one knob apart each: vpp=1 (GPipe-family rotation),
+    # vpp=2 (circular interleave), vpp=1 + comm_overlap (T3-style
     # bucketed backward: per-bucket grad reduce-scatter inside the
-    # backward seam, distributed/grad_buckets.py). base vs overlap is
-    # the same program shape, so the loss-parity and
-    # profile_exposed_comm("sharding") comparison is one flag apart.
+    # backward seam, distributed/grad_buckets.py), and overlap +
+    # quant_comm (int8 error-feedback quantized collectives,
+    # distributed/quant_comm.py — the quant-vs-overlap pair isolates
+    # the wire compression). base vs overlap is the same program
+    # shape, so the loss-parity and profile_exposed_comm("sharding")
+    # comparison is one flag apart.
+    quant_chunk = 256 if on_tpu else 64
     gp_base = tempfile.mkdtemp(prefix="goodput_gpt13b_")
     results = {}
-    for tag, vpp, overlap in (("base", 1, False), ("vpp2", 2, False),
-                              ("overlap", 1, True)):
+    for tag, vpp, overlap, quant in (
+            ("base", 1, False, False), ("vpp2", 2, False, False),
+            ("overlap", 1, True, False), ("quant", 1, True, True)):
         # one goodput journal per tag (run-level wall attribution:
         # compile vs step_compute vs idle; observability/goodput.py)
         gp_led = _gp.attach_dir(os.path.join(gp_base, tag))
@@ -716,7 +721,13 @@ def bench_gpt13b_hybrid(on_tpu, dev):
             "pp_configs": {"num_virtual_pipeline_stages": vpp},
             # T3-style bucketed grad sync (grad_buckets.py)
             "sharding_configs": {"comm_overlap": overlap,
-                                 "comm_buffer_size_MB": buf_mb}}
+                                 "comm_buffer_size_MB": buf_mb},
+            # int8 quantized collectives with error feedback
+            # (quant_comm.py): grad reduce-scatter buckets, TP rings +
+            # activation allreduces, and the ZeRO param gather
+            "quant_comm": {"dtype": "int8" if quant else "none",
+                           "chunk": quant_chunk,
+                           "error_feedback": True}}
         strategy.sharding_configs = {"stage": 2}
         strategy.pipeline_configs = {
             "accumulate_steps": 2,
@@ -794,6 +805,9 @@ def bench_gpt13b_hybrid(on_tpu, dev):
             "mp_async_allreduce": True,
             "pp_vpp": vpp,
             "comm_overlap": overlap,
+            "quant_comm": quant,
+            "comm_bytes_total": round(led.bytes_for(), 1) if led
+            else 0.0,
             # engine compile-cache counters: steady state must be
             # recompile-free (overlap regressions keyed on traced shapes
             # would show here)
@@ -826,6 +840,12 @@ def bench_gpt13b_hybrid(on_tpu, dev):
                 _flops.comm_seconds_lower_bound(
                     led.bytes_for(axis="sharding"), dev), 6) if led \
                 else 0.0
+        if quant and led is not None:
+            # realized per-axis wire compression (int8 payload + bf16
+            # scale sidecars vs the uncompressed-equivalent bytes)
+            line["quant_ratios"] = {a: round(v, 4) for a, v
+                                    in led.quant_ratios().items()}
+            line["quant_residual_buffers"] = len(eng._quant_residuals)
         _emit(line)
 
     # the T3 acceptance pair: knob-on vs knob-off on the same program —
@@ -847,6 +867,32 @@ def bench_gpt13b_hybrid(on_tpu, dev):
            "exposed_lower_than_knob_off": bool(exp_on < exp_off),
            "note": "CPU smoke proves parity + compile stability; the "
                    "realized overlap win is an on-TPU ROADMAP item"})
+    # the quant_comm acceptance pair: quant vs overlap on the same
+    # program — total comm-ledger wire bytes must drop to <= 0.30x
+    # (int8 payload + bf16 scales closed forms; lower-better in
+    # tools/bench_compare.py) and the deterministic-horizon loss gap
+    # stays loose-bounded (the REAL convergence gate is the 200-step
+    # parity test in tests/test_quant_comm.py — this line just tracks
+    # drift on the flagship config)
+    q_r = results["quant"]
+    q_bytes = q_r["led"].bytes_for() if q_r["led"] else 0.0
+    o_bytes = ov_r["led"].bytes_for() if ov_r["led"] else 0.0
+    wire_ratio = (q_bytes / o_bytes) if o_bytes else 0.0
+    _emit({"metric": "gpt13b_hybrid_quant_wire_ratio",
+           "value": round(wire_ratio, 4), "unit": "x",
+           "vs_baseline": 0.0,
+           "quant_bytes_per_step": round(q_bytes, 1),
+           "fp32_bytes_per_step": round(o_bytes, 1),
+           "quant_ratios": {a: round(v, 4) for a, v in
+                            (q_r["led"].quant_ratios().items()
+                             if q_r["led"] else ())},
+           "le_030": bool(wire_ratio <= 0.30)})
+    q_gap = max(abs(a - b) for a, b in zip(ov_r["losses"],
+                                           q_r["losses"]))
+    _emit({"metric": "gpt13b_hybrid_quant_loss_gap",
+           "value": round(q_gap, 6), "unit": "abs", "vs_baseline": 0.0,
+           "losses_quant": [round(v, 5) for v in q_r["losses"]],
+           "losses_fp32": [round(v, 5) for v in ov_r["losses"]]})
     # memory-ledger exact gate: the measured state accounting (shard_
     # shape path) must equal the closed form (global shape / sharding
     # degree path) byte-for-byte — incl. ZeRO stage-2 scattered state

@@ -39,9 +39,17 @@ def _factorizations(n: int, dims: int):
 
 def default_candidates(num_devices: int, model: Dict,
                        global_batch: int,
-                       tune_sharding: bool = True) -> List[Dict]:
+                       tune_sharding: bool = True,
+                       tune_quant_comm: bool = False) -> List[Dict]:
     """Valid (dp, mp, pp, sharding, micro) configs for the device count,
-    pruned by divisibility (reference prune.py rules)."""
+    pruned by divisibility (reference prune.py rules).
+
+    ``tune_quant_comm``: additionally emit each comm-bearing config
+    with the int8 quantized-collective knob on
+    (``quant_comm={"dtype": "int8", ...}`` — distributed/quant_comm.py;
+    the cost model prices both the ~0.26x wire bytes and the f32
+    error-feedback residual HBM, so quantized configs rank/prune on
+    their real trade)."""
     heads = model.get("num_heads", 1)
     layers = model["num_layers"]
     vocab = model.get("vocab_size", 0)
@@ -68,6 +76,12 @@ def default_candidates(num_devices: int, model: Dict,
                    "sharding_degree": sh, "micro_batch_size": micro,
                    "accumulate_steps": per_rank // micro}
             out.append(cfg)
+            # quantized variant only where there is comm to compress
+            if tune_quant_comm and (dp * sh > 1 or mp > 1):
+                out.append(dict(cfg, quant_comm={
+                    "dtype": "int8", "grad_sync": True,
+                    "mp_rings": True, "error_feedback": True,
+                    "chunk": 256}))
     return out
 
 
@@ -86,7 +100,7 @@ class AutoTuner:
                  seq_len: int, hbm_gb: float = 95.0,
                  peak_flops: float = 459e12, recompute: bool = False,
                  candidates: Optional[List[Dict]] = None,
-                 max_trials: int = 16):
+                 max_trials: int = 16, tune_quant_comm: bool = False):
         self.model = model
         self.num_devices = num_devices
         self.global_batch = global_batch
@@ -95,6 +109,7 @@ class AutoTuner:
         self.peak_flops = peak_flops
         self.recompute = recompute
         self.max_trials = max_trials
+        self.tune_quant_comm = tune_quant_comm
         self.history: List[Dict] = []
         self._candidates = candidates
 
@@ -102,7 +117,8 @@ class AutoTuner:
     def candidates(self) -> List[Dict]:
         if self._candidates is None:
             self._candidates = default_candidates(
-                self.num_devices, self.model, self.global_batch)
+                self.num_devices, self.model, self.global_batch,
+                tune_quant_comm=self.tune_quant_comm)
         return self._candidates
 
     def pruned(self) -> List[Dict]:

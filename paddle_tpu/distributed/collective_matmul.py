@@ -36,6 +36,23 @@ Fallback policy (``overlap_available``): the ring needs one concrete
 mesh axis (a single-name mp group) inside an SPMD region, and the
 chunked dim must divide the ring size; anything else runs the unfused
 layer path unchanged.
+
+Quantized ring ticks (``strategy.hybrid_configs["quant_comm"]`` with
+``mp_rings`` on — distributed/quant_comm.py): every ppermute/
+all_gather payload of the ag_matmul / matmul_rs / matmul_allreduce
+rings (and their mirrored backward rings) ships as int8/fp8 + bf16
+per-chunk scales instead of the activation dtype. Travelling shards
+(ag ring, the weight-grad ring) quantize ONCE at ring entry and
+dequantize per tick for the partial GEMM — multi-hop shards see
+exactly one quantization; the matmul_rs accumulator re-quantizes per
+shift because its value changes each tick (one quantization step of
+error per hop, the EQuARX trade — stateless, activations carry no
+error-feedback state across steps). The custom VJPs reuse the same
+(maybe-quantized) ring bodies, so forward/backward stay mirrored and
+tpulint's vjp-ledger-symmetry pairing is unchanged. matmul_gather's
+output gather stays full precision (its payload is the layer OUTPUT
+feature gather — quantizing it would compress activations handed to
+arbitrary downstream math, not a ring-internal partial).
 """
 from __future__ import annotations
 
@@ -111,6 +128,18 @@ def _ring_info(axes):
     return name, C.axis_size(name), lax.axis_index(name)
 
 
+def _ring_qcfg(p: int):
+    """The active ring quantization config (or None): the quant_comm
+    knob's mp_rings half, read live from the fleet strategy at trace
+    time exactly like overlap_enabled(). p == 1 rings move no bytes —
+    nothing to compress."""
+    if p <= 1:
+        return None
+    from . import quant_comm as _qc
+
+    return _qc.ring_config()
+
+
 def _perms(p):
     up = [(i, (i + 1) % p) for i in range(p)]    # recv from idx - t
     dn = [(i, (i - 1) % p) for i in range(p)]    # recv from idx + t
@@ -173,6 +202,27 @@ def _ag_matmul_body(x, w, axes, axis):
     if p == 1:
         return out
     up_perm, dn_perm = _perms(p)
+    qc = _ring_qcfg(p)
+    if qc is not None:
+        # quantize the resident shard ONCE; the (payload, scales) pair
+        # travels the ring and each tick dequantizes for its GEMM
+        from . import quant_comm as _qc
+
+        ratio = _qc.block_ratio(x.shape, x.dtype, qc)
+        uq, us = _qc.pack_block(x, qc)
+        dq, ds = uq, us
+        for t in range(1, (p - 1) // 2 + 1):
+            uq, us = _qc.permute_packed(uq, us, name, up_perm, ratio)
+            dq, ds = _qc.permute_packed(dq, ds, name, dn_perm, ratio)
+            out = place(out, _mm(_qc.unpack_block(
+                uq, us, x.shape, x.dtype, qc), w), (idx - t) % p)
+            out = place(out, _mm(_qc.unpack_block(
+                dq, ds, x.shape, x.dtype, qc), w), (idx + t) % p)
+        if p % 2 == 0:
+            uq, us = _qc.permute_packed(uq, us, name, up_perm, ratio)
+            out = place(out, _mm(_qc.unpack_block(
+                uq, us, x.shape, x.dtype, qc), w), (idx - p // 2) % p)
+        return out
     up = dn = x
     for t in range(1, (p - 1) // 2 + 1):
         up = C.t_ppermute(up, name, up_perm)
@@ -203,6 +253,21 @@ def _matmul_rs_body(x, w, axes, axis):
     if p == 1:
         return acc
     perm = [(i, (i - 1) % p) for i in range(p)]
+    qc = _ring_qcfg(p)
+    if qc is not None:
+        # the accumulator CHANGES each tick (partial sums), so it
+        # re-quantizes before every shift — one quantization step of
+        # error per hop, dequantized back to the working dtype so the
+        # adds themselves stay full precision
+        from . import quant_comm as _qc
+
+        ratio = _qc.block_ratio(acc.shape, acc.dtype, qc)
+        for t in range(1, p):
+            q, s = _qc.pack_block(acc, qc)
+            q, s = _qc.permute_packed(q, s, name, perm, ratio)
+            nxt = _qc.unpack_block(q, s, acc.shape, acc.dtype, qc)
+            acc = nxt + _mm(chunk((idx + 1 + t) % p), w)
+        return acc
     for t in range(1, p):
         nxt = C.t_ppermute(acc, name, perm)
         acc = nxt + _mm(chunk((idx + 1 + t) % p), w)
@@ -225,6 +290,31 @@ def _grad_w_ring(shard, full, axes, axis):
     if p == 1:
         return dw
     up_perm, dn_perm = _perms(p)
+    qc = _ring_qcfg(p)
+    if qc is not None:
+        # travelling shard: quantize once, dequantize per tick (the
+        # same discipline as the ag ring — this IS ag_matmul's bwd)
+        from . import quant_comm as _qc
+
+        ratio = _qc.block_ratio(shard.shape, shard.dtype, qc)
+        uq, us = _qc.pack_block(shard, qc)
+        dq, ds = uq, us
+        for t in range(1, (p - 1) // 2 + 1):
+            uq, us = _qc.permute_packed(uq, us, name, up_perm, ratio)
+            dq, ds = _qc.permute_packed(dq, ds, name, dn_perm, ratio)
+            dw = dw \
+                + _tdot(_qc.unpack_block(uq, us, shard.shape,
+                                         shard.dtype, qc),
+                        sl((idx - t) % p)) \
+                + _tdot(_qc.unpack_block(dq, ds, shard.shape,
+                                         shard.dtype, qc),
+                        sl((idx + t) % p))
+        if p % 2 == 0:
+            uq, us = _qc.permute_packed(uq, us, name, up_perm, ratio)
+            dw = dw + _tdot(_qc.unpack_block(uq, us, shard.shape,
+                                             shard.dtype, qc),
+                            sl((idx - p // 2) % p))
+        return dw
     up = dn = shard
     for t in range(1, (p - 1) // 2 + 1):
         up = C.t_ppermute(up, name, up_perm)
@@ -283,6 +373,20 @@ matmul_rs.defvjp(_matmul_rs_fwd, _matmul_rs_bwd)
 
 def _matmul_allreduce_body(x, w, axes, axis):
     out = _matmul_rs_body(x, w, axes, axis)
+    name, p, _ = _ring_info(axes)
+    qc = _ring_qcfg(p)
+    if qc is not None:
+        # the gather half of the allreduce ships quantized too: pack
+        # the summed shard once, all_gather payload + scales, and
+        # reassemble the rank blocks along the scattered dim
+        from . import quant_comm as _qc
+
+        ratio = _qc.block_ratio(out.shape, out.dtype, qc)
+        q, s = _qc.pack_block(out, qc)
+        qg, sg = _qc.gather_packed(q, s, axes, ratio)
+        blocks = [_qc.unpack_block(qg[j], sg[j], out.shape, out.dtype,
+                                   qc) for j in range(p)]
+        return jnp.concatenate(blocks, axis=axis)
     return C.t_all_gather(out, axes, axis=axis, tiled=True)
 
 

@@ -104,9 +104,19 @@ class _ZeroPlan:
     seam (distributed/grad_buckets.py), so the reduce-scatter dim never
     collides with the layer-row axis the bucket scan chunks over. Only
     WHERE states shard moves; the update math is unchanged.
+
+    ``store_sharded``: store EVERY plan entry's param sharded and
+    all-gather at step entry (the stage-3 storage discipline) even at
+    stage 1/2. Set when quant_comm's ``param_gather`` compresses the
+    gather wire: the authoritative state must be the exact per-rank
+    shard — a quantized post-update gather would otherwise either bake
+    compression noise into the weights or leave device-divergent
+    "replicated" copies that can't checkpoint (quant_comm.py
+    quantized_param_gather docstring).
     """
 
-    def __init__(self, mesh: Mesh, trainable, optimizer, row_dims=None):
+    def __init__(self, mesh: Mesh, trainable, optimizer, row_dims=None,
+                 store_sharded: bool = False):
         axis = getattr(optimizer, "state_partition_axis", None) \
             if optimizer is not None else None
         stage3 = any(getattr(p, "_zero3", False) for p in trainable)
@@ -136,7 +146,8 @@ class _ZeroPlan:
                 used = spec[d] if d < len(spec) else None
                 if used is None and shape[d] % self.n == 0 \
                         and shape[d] >= self.n:
-                    self.entries[id(p)] = (d, getattr(p, "_zero3", False))
+                    self.entries[id(p)] = (
+                        d, getattr(p, "_zero3", False) or store_sharded)
                     break
 
     def entry(self, p):
@@ -298,10 +309,12 @@ class ParallelEngine:
     def __init__(self, model, optimizer=None, mesh: Optional[Mesh] = None,
                  comm_overlap: Optional[bool] = None,
                  comm_buffer_size_mb: Optional[float] = None,
-                 mem_ledger: Optional[bool] = None):
+                 mem_ledger: Optional[bool] = None,
+                 quant_comm=None):
         import os
 
         from . import grad_buckets as _gb
+        from . import quant_comm as _qc
 
         self.model = model
         self.optimizer = optimizer
@@ -389,9 +402,26 @@ class ParallelEngine:
         if self._overlap_on and callable(seam_fn):
             self._seam_row_dims = {id(p): int(k) for p, k in seam_fn()}
         self._bucket_plan = None
-        self._zero = _ZeroPlan(mesh, self.trainable, optimizer,
-                               row_dims=self._seam_row_dims
-                               if self._overlap_on else None)
+        # quantized collectives (distributed/quant_comm.py): the
+        # strategy.hybrid_configs["quant_comm"] sub-config, or the
+        # explicit constructor override (a dict or QuantConfig). The
+        # grad_sync half rides the comm_overlap bucket plan; the
+        # mp_rings half is read by collective_matmul from the fleet
+        # strategy directly.
+        self._quant_cfg = (_qc.strategy_config() if quant_comm is None
+                           else _qc.make_config(quant_comm))
+        # per-bucket error-feedback residuals: f32 global arrays,
+        # rank-distinct (dim 0 sharded over every mesh axis), created
+        # lazily by _ensure_quant_state once the bucket plan exists and
+        # carried through the compiled step as donated train state
+        self._quant_residuals: Dict[str, Any] = {}
+        self._quant_specs: Dict[str, P] = {}
+        self._pending_qnorm = None
+        self._zero = _ZeroPlan(
+            mesh, self.trainable, optimizer,
+            row_dims=self._seam_row_dims if self._overlap_on else None,
+            store_sharded=bool(self._quant_cfg.enabled
+                               and self._quant_cfg.param_gather))
         # LazyGuard-built params materialize straight into their (zero3-
         # aware) storage sharding: O(shard) bytes per process, no full-
         # size init anywhere
@@ -418,6 +448,92 @@ class ParallelEngine:
                 opt._master_weights[id(p)] = global_put(mw, self.mesh, spec)
         return states
 
+    # -- sync-signature helpers (shared by train_step + quant state) -----
+    def _sync_axes_env(self):
+        mesh = self.mesh
+        data_axes = _mesh_data_axes(mesh)
+        sep_axes = tuple(a for a in ("sep",) if a in mesh.axis_names
+                         and mesh.shape[a] > 1)
+        pp_axes = tuple(a for a in ("pp",)
+                        if getattr(self.model, "_pp_ownership", False)
+                        and a in mesh.axis_names and mesh.shape[a] > 1)
+        return data_axes, data_axes + sep_axes, pp_axes
+
+    def _param_spec_axes(self, p):
+        spec_axes = set()
+        for ax in param_spec(p):
+            if isinstance(ax, (tuple, list)):
+                spec_axes.update(ax)
+            elif ax is not None:
+                spec_axes.add(ax)
+        return spec_axes
+
+    def _param_grad_axes(self, p, pp_axes):
+        spec_axes = self._param_spec_axes(p)
+        extra = tuple(a for a in pp_axes if a not in spec_axes)
+        # sequence-parallel replicated params (LayerNorm etc.) see only
+        # a seq shard per mp rank: their grads must psum over mp
+        # (reference sequence_parallel_utils.py:156 allreduce hooks)
+        if getattr(p, "sequence_parallel", False):
+            extra += tuple(
+                a for a in ("mp",) if a in self.mesh.axis_names
+                and self.mesh.shape[a] > 1 and a not in spec_axes)
+        return extra
+
+    def _build_bucket_plan(self):
+        """The deterministic comm_overlap bucket plan (None when the
+        knob is off or nothing buckets) — same construction train_step
+        performs, callable standalone so restore_checkpoint can size
+        the quantization residual buffers before any step traced."""
+        if not self._overlap_on:
+            return None
+        from . import grad_buckets as _gb
+
+        data_axes, gmean_axes, pp_axes = self._sync_axes_env()
+        return _gb.build_plan(
+            self.trainable, self.mesh, self._zero, gmean_axes,
+            data_axes, self._param_spec_axes,
+            lambda p: self._param_grad_axes(p, pp_axes), param_spec,
+            seam_row_dims=self._seam_row_dims,
+            buffer_mb=self._overlap_mb)
+
+    def _quant_grad_cfg(self):
+        """The active grad-sync quantization config, or None. Rides
+        the comm_overlap bucket plan: quantizing an unbucketed tail
+        sync is not supported (the bucket is the chunk-lattice grain —
+        ISSUE/EQuARX), so knob-on without comm_overlap is full
+        precision."""
+        cfg = self._quant_cfg
+        return cfg if (cfg is not None and cfg.enabled
+                       and cfg.grad_sync and self._overlap_on) else None
+
+    def _ensure_quant_state(self):
+        """Create (once) the per-bucket error-feedback residual
+        buffers: f32 zeros at the bucket payload size, dim 0 sharded
+        over EVERY >1 mesh axis so each rank owns exactly its local
+        residual (compression error is rank-local state — it
+        checkpoints shard-exact and never reshards meaningfully, like
+        the per-process RNG streams)."""
+        qcfg = self._quant_grad_cfg()
+        if qcfg is None or not qcfg.error_feedback:
+            return
+        plan = self._build_bucket_plan()
+        if plan is None:
+            return
+        axes = tuple(a for a in self.mesh.axis_names
+                     if self.mesh.shape[a] > 1)
+        prod = 1
+        for a in axes:
+            prod *= int(self.mesh.shape[a])
+        spec = P(axes) if axes else P()
+        for name, lshape in plan.residual_shapes().items():
+            self._quant_specs[name] = spec
+            if name in self._quant_residuals:
+                continue
+            gshape = (int(lshape[0]) * prod,) + tuple(lshape[1:])
+            self._quant_residuals[name] = global_put(
+                np.zeros(gshape, np.float32), self.mesh, spec)
+
     # -- the compiled step ----------------------------------------------
     def train_step(self, fn: Callable, batch_specs=None,
                    donate: bool = True, scaler=None):
@@ -433,14 +549,11 @@ class ParallelEngine:
         where-guarded so an overflow step is a true no-op).
         """
         mesh = self.mesh
-        data_axes = _mesh_data_axes(mesh)
         # 'sep' (context parallel) splits the *sequence*: grads of
         # replicated params are per-block partials, so they average over
         # sep exactly like a batch split (but batch dims are NOT sharded
         # over sep — the model slices seq itself)
-        sep_axes = tuple(a for a in ("sep",) if a in mesh.axis_names
-                         and mesh.shape[a] > 1)
-        gmean_axes = data_axes + sep_axes
+        data_axes, gmean_axes, pp_axes = self._sync_axes_env()
         opt = self.optimizer
         params, trainable = self.params, self.trainable
         t_index = [i for i, p in enumerate(params) if p.trainable]
@@ -455,7 +568,8 @@ class ParallelEngine:
 
         use_scaler = scaler is not None and scaler.is_enable()
 
-        def _step(pvals, svals, mvals, batch, lr, stepc, seed, amp_in):
+        def _step(pvals, svals, mvals, qvals, batch, lr, stepc, seed,
+                  amp_in):
             with C.spmd_region():
                 if gmean_axes:
                     # distinct RNG stream per data-parallel/sep rank (mp/pp
@@ -466,37 +580,16 @@ class ParallelEngine:
                 ctx = _rng.fork_traced(seed)
                 ctx.__enter__()
                 try:
-                    return _step_inner(pvals, svals, mvals, batch, lr,
-                                       stepc, amp_in)
+                    return _step_inner(pvals, svals, mvals, qvals,
+                                       batch, lr, stepc, amp_in)
                 finally:
                     ctx.__exit__(None, None, None)
 
-        # pipelined models mask grad ownership per pp stage; replicated
-        # params must then psum their grads over 'pp' (pp_layers docstring)
-        pp_axes = tuple(a for a in ("pp",)
-                        if getattr(self.model, "_pp_ownership", False)
-                        and a in mesh.axis_names and mesh.shape[a] > 1)
-
         def _spec_axes(p):
-            spec_axes = set()
-            for ax in param_spec(p):
-                if isinstance(ax, (tuple, list)):
-                    spec_axes.update(ax)
-                elif ax is not None:
-                    spec_axes.add(ax)
-            return spec_axes
+            return self._param_spec_axes(p)
 
         def _grad_axes(p):
-            spec_axes = _spec_axes(p)
-            extra = tuple(a for a in pp_axes if a not in spec_axes)
-            # sequence-parallel replicated params (LayerNorm etc.) see only
-            # a seq shard per mp rank: their grads must psum over mp
-            # (reference sequence_parallel_utils.py:156 allreduce hooks)
-            if getattr(p, "sequence_parallel", False):
-                extra += tuple(
-                    a for a in ("mp",) if a in mesh.axis_names
-                    and mesh.shape[a] > 1 and a not in spec_axes)
-            return extra
+            return self._param_grad_axes(p, pp_axes)
 
         def _shard_of(p, v, dim):
             idx = lax.axis_index(zero.axis)
@@ -508,18 +601,32 @@ class ParallelEngine:
         # params seam as a lax.scan) built HERE from shapes/specs only —
         # nothing shape-derived reaches a compile key, and knob-off
         # leaves the unbucketed path byte-for-byte untouched
-        bucket_plan = None
-        if self._overlap_on:
-            from . import grad_buckets as _gb
-
-            bucket_plan = _gb.build_plan(
-                trainable, mesh, zero, gmean_axes, data_axes,
-                _spec_axes, _grad_axes, param_spec,
-                seam_row_dims=self._seam_row_dims,
-                buffer_mb=self._overlap_mb)
+        bucket_plan = self._build_bucket_plan()
         self._bucket_plan = bucket_plan
+        # quantized grad sync (quant_comm): rides the bucket plan; the
+        # error-feedback residuals are per-bucket donated train state
+        # (created once — zeros — then carried step to step)
+        qcfg = self._quant_grad_cfg() if bucket_plan is not None \
+            else None
+        self._ensure_quant_state()
+        qspecs = dict(self._quant_specs)
+        # quantized ZeRO param all-gather (stage 2 post-update, stage 3
+        # entry): int8 wire with each rank's own exact shard spliced
+        # back, so the authoritative shard path never sees noise
+        pg_cfg = (self._quant_cfg
+                  if self._quant_cfg.enabled
+                  and self._quant_cfg.param_gather else None)
 
-        def _step_inner(pvals, svals, mvals, batch, lr, stepc, amp_in):
+        def _zero_gather(v, dim):
+            if pg_cfg is not None:
+                from . import quant_comm as _qc
+
+                return _qc.quantized_param_gather(v, (zero.axis,), dim,
+                                                  pg_cfg)
+            return C.t_all_gather(v, zero.axis, axis=dim, tiled=True)
+
+        def _step_inner(pvals, svals, mvals, qvals, batch, lr, stepc,
+                        amp_in):
             # ZeRO-3 params arrive as shards: all-gather for the forward,
             # but keep the stored shard for the optimizer update
             pshards = pvals
@@ -527,8 +634,7 @@ class ParallelEngine:
             for i, p in enumerate(params):
                 e = zero.entry(p)
                 if e is not None and e[1]:
-                    pvals[i] = C.t_all_gather(pvals[i], zero.axis,
-                                              axis=e[0], tiled=True)
+                    pvals[i] = _zero_gather(pvals[i], e[0])
             pvals = tuple(pvals)
             # MoE routing telemetry: collect the traced expert-load /
             # drop stats each MoELayer records during the forward, to be
@@ -585,12 +691,15 @@ class ParallelEngine:
                     for p in trainable}
                 # comm_overlap: issue the per-bucket collectives (the
                 # seam scan + the eager flat buckets) — bit-exact vs
-                # the per-parameter path below, with the grad-norm
-                # sum-of-squares folded into the bucket scan
+                # the per-parameter path below (when quant_comm is off),
+                # with the grad-norm sum-of-squares folded into the
+                # bucket scan and the quantization error-feedback
+                # residuals threaded through as train state
                 if bucket_plan is not None:
-                    bsync, bgsq = bucket_plan.sync(raw_grads)
+                    bsync, bgsq, new_qr = bucket_plan.sync(
+                        raw_grads, qcfg=qcfg, residuals=qvals)
                 else:
-                    bsync, bgsq = {}, None
+                    bsync, bgsq, new_qr = {}, None, {}
                 upd_in, grads = [], []
                 for i, p in zip(t_index, trainable):
                     g = raw_grads[id(p)]
@@ -670,6 +779,13 @@ class ParallelEngine:
                     # bias-correction step count advances only on applied
                     # steps (the reference skips optimizer.step entirely)
                     stepc = tstep_v + (1 - found.astype(jnp.int32))
+                    # a skipped step must be a true no-op for the EF
+                    # residuals too: they were updated from the scaled
+                    # (possibly overflowed → NaN-decoding) grads, so
+                    # roll them back exactly like params/moments
+                    if new_qr:
+                        new_qr = {k: jnp.where(found_b, qvals[k], v)
+                                  for k, v in new_qr.items()}
                 # global grad-norm (telemetry): local sum-of-squares,
                 # psum'd over exactly the axes each grad is sharded on
                 # (spec axes, + the ZeRO axis for scattered shards) so
@@ -737,9 +853,10 @@ class ParallelEngine:
                     if e is not None and not e[1]:
                         # stage 1/2: params stay replicated — gather the
                         # updated shards (the reference's param broadcast,
-                        # dygraph_sharding_optimizer.py:317)
-                        nv_p = C.t_all_gather(nv, zero.axis, axis=e[0],
-                                              tiled=True)
+                        # dygraph_sharding_optimizer.py:317; quantized
+                        # wire + own-shard splice behind quant_comm's
+                        # param_gather knob)
+                        nv_p = _zero_gather(nv, e[0])
                     else:
                         nv_p = nv
                     if out_m and i in out_m:
@@ -752,28 +869,42 @@ class ParallelEngine:
                                  if mesh.shape[a] > 1)
                 if all_axes:
                     lv = C.t_pmean(lv, all_axes)
-            return (lv, gnorm, tuple(out_p), tuple(new_s), out_m, amp_out,
-                    moe_tel)
+                # quantization telemetry: global L2 of the carried EF
+                # residuals (how much gradient signal is in flight in
+                # the compensation state) — one scalar psum, only in
+                # the quantized program
+                qnorm = jnp.float32(0.0)
+                if new_qr:
+                    qsq = jnp.float32(0.0)
+                    for v in new_qr.values():
+                        qsq = qsq + jnp.sum(jnp.square(
+                            v.astype(jnp.float32)))
+                    if all_axes:
+                        qsq = C.t_psum(qsq, all_axes)
+                    qnorm = jnp.sqrt(qsq)
+            return (lv, gnorm, qnorm, tuple(out_p), tuple(new_s), out_m,
+                    new_qr, amp_out, moe_tel)
 
         def make(batch_treedef, b_specs, mspecs):
-            def flat_step(pvals, svals, mvals, batch_leaves, lr, stepc,
-                          seed, amp_in):
+            def flat_step(pvals, svals, mvals, qvals, batch_leaves, lr,
+                          stepc, seed, amp_in):
                 batch = jax.tree_util.tree_unflatten(batch_treedef,
                                                      batch_leaves)
-                return _step(pvals, svals, mvals, batch, lr, stepc, seed,
-                             amp_in)
+                return _step(pvals, svals, mvals, qvals, batch, lr,
+                             stepc, seed, amp_in)
 
             amp_ispec = (P(),) * 4 if use_scaler else ()
             amp_ospec = (P(),) * 5 if use_scaler else ()
-            in_specs = (pspecs, sspecs, mspecs, tuple(b_specs), P(), P(),
-                        P(), amp_ispec)
+            in_specs = (pspecs, sspecs, mspecs, qspecs, tuple(b_specs),
+                        P(), P(), P(), amp_ispec)
             # the trailing P() is a pytree-prefix spec for the MoE
             # telemetry dict: every entry is replicated (psum'd over the
             # batch axes inside the step)
-            out_specs = (P(), P(), pspecs, sspecs, mspecs, amp_ospec, P())
+            out_specs = (P(), P(), P(), pspecs, sspecs, mspecs, qspecs,
+                         amp_ospec, P())
             sharded = _shard_map(flat_step, mesh, in_specs, out_specs)
             return jax.jit(sharded,
-                           donate_argnums=(0, 1, 2) if donate else ())
+                           donate_argnums=(0, 1, 2, 3) if donate else ())
 
         def step(batch):
             t_entry = time.perf_counter()
@@ -849,6 +980,7 @@ class ParallelEngine:
                 self._compiled[key] = make(treedef, b_specs, mspecs)
             pvals = tuple(p._value for p in params)
             svals = tuple(opt._states[id(p)] for p in trainable)
+            qvals = dict(self._quant_residuals)
             opt._step_count += 1
             self._seed += 1
             lr = jnp.asarray(opt.get_lr(), jnp.float32)
@@ -875,11 +1007,15 @@ class ParallelEngine:
             # (first execution of the program); cached executions note
             # nothing and reuse the stored ledger
             with _cl.capture() as cap:
-                lv, gnorm, new_p, new_s, new_m, amp_out, moe_tel = \
-                    self._compiled[key](pvals, svals, mvals, leaf_vals,
-                                        lr, stepc, seed, amp_in)
+                (lv, gnorm, qnorm, new_p, new_s, new_m, new_qr, amp_out,
+                 moe_tel) = \
+                    self._compiled[key](pvals, svals, mvals, qvals,
+                                        leaf_vals, lr, stepc, seed,
+                                        amp_in)
             if len(cap):
                 self._ledgers[key] = cap
+            for k, v in new_qr.items():
+                self._quant_residuals[k] = v
             if not self._profiling:
                 self._last_key = key
                 # example args for on-demand AOT memory analysis of
@@ -906,8 +1042,15 @@ class ParallelEngine:
                 if led is not None:
                     led.publish(self._metrics["comm_bytes"],
                                 self._metrics["comm_ops"])
+                    # realized per-axis wire compression of this
+                    # program (quant_comm payload_ratio stamps); empty
+                    # when nothing on the wire is quantized
+                    for ax, rv in led.quant_ratios().items():
+                        self._metrics["comm_quant_ratio"].set(
+                            rv, axis=ax)
                 self._note_step(t_entry, n_tok, lv, gnorm,
-                                found=amp_out[4] if amp_out else None)
+                                found=amp_out[4] if amp_out else None,
+                                qnorm=qnorm if new_qr else None)
                 self._pending_moe = moe_tel
             return Tensor(lv, stop_gradient=True)
 
@@ -932,6 +1075,8 @@ class ParallelEngine:
         self._pending_scalars = None
         found = self._pending_found
         self._pending_found = None
+        qn = self._pending_qnorm
+        self._pending_qnorm = None
         lv, gnorm = pend
         try:
             m = self._metrics
@@ -939,6 +1084,8 @@ class ParallelEngine:
             gnf = float(np.asarray(gnorm))
             m["loss"].set(lvf)
             m["grad_norm"].set(gnf)
+            if qn is not None:
+                m["quant_residual_norm"].set(float(np.asarray(qn)))
             # health monitor: robust spike/nonfinite detection on the
             # SAME fetched scalars (one-step lag — still off the hot
             # path; events ring + health_* gauges + goodput journal).
@@ -954,11 +1101,12 @@ class ParallelEngine:
             pass        # a dead device must not take telemetry down
 
     def _note_step(self, t_entry: float, n_tok: int, lv, gnorm,
-                   found=None):
+                   found=None, qnorm=None):
         """Host-side per-step instrumentation on fetched/host values
         only (never called under tracing). ``found``: the traced AMP
         found_inf flag of THIS step (device scalar; fetched with the
-        same one-step lag as the loss)."""
+        same one-step lag as the loss). ``qnorm``: the quantization
+        error-feedback residual norm device scalar (same lag)."""
         now = time.perf_counter()
         m = self._metrics
         m["step_seconds"].observe(now - t_entry)
@@ -993,6 +1141,7 @@ class ParallelEngine:
         self._prev_step_entry = t_entry
         self._pending_scalars = (lv, gnorm)
         self._pending_found = found
+        self._pending_qnorm = qnorm
         # gradient-sync bucketing: how many per-bucket collectives the
         # compiled step issues (0 = the unbucketed tail sync, i.e.
         # sharding_configs["comm_overlap"] off or nothing bucketable)
@@ -1127,12 +1276,14 @@ class ParallelEngine:
         opt = self.optimizer
         pvals = tuple(p._value for p in self.params)
         svals = tuple(opt._states[id(p)] for p in self.trainable)
+        qvals = dict(self._quant_residuals)
         # key[3] pins which params carried master weights at trace time
         mvals = {i: opt._master_weights[id(self.params[i])]
                  for i in key[3]}
         led = _ml.analyze(
             self._compiled[key],
-            (pvals, svals, mvals, leaf_vals, lr, stepc, seed, amp_in),
+            (pvals, svals, mvals, qvals, leaf_vals, lr, stepc, seed,
+             amp_in),
             program="train")
         self._mem_ledgers[key] = led
         return led
@@ -1198,10 +1349,13 @@ class ParallelEngine:
                        for p in self.trainable if id(p) in opt._states},
             "masters": {k: jnp.copy(v)
                         for k, v in opt._master_weights.items()},
+            "qresid": {k: jnp.copy(v)
+                       for k, v in self._quant_residuals.items()},
             "step_count": opt._step_count,
             "seed": self._seed,
             "pending": self._pending_scalars,
             "pending_found": self._pending_found,
+            "pending_qnorm": self._pending_qnorm,
             "pending_moe": self._pending_moe,
         }
         from ..optimizer.lr import LRScheduler
@@ -1217,10 +1371,12 @@ class ParallelEngine:
         for pid, st in snap["states"].items():
             opt._states[pid] = st
         opt._master_weights = dict(snap["masters"])
+        self._quant_residuals = dict(snap["qresid"])
         opt._step_count = snap["step_count"]
         self._seed = snap["seed"]
         self._pending_scalars = snap["pending"]
         self._pending_found = snap["pending_found"]
+        self._pending_qnorm = snap["pending_qnorm"]
         self._pending_moe = snap["pending_moe"]
         if "lr_state" in snap:
             opt._lr.__dict__.update(snap["lr_state"])
@@ -1257,6 +1413,15 @@ class ParallelEngine:
                 meta["lr"] = float(opt.get_lr())
         if scaler is not None:
             meta["scaler"] = scaler.state_dict()
+        # quantized-collective error-feedback residuals (quant_comm):
+        # per-bucket rank-local compression error carried as training
+        # state — a resume that silently dropped it would re-inject the
+        # lost gradient mass as a one-step bias, so it commits in the
+        # SAME unit as params/moments (shard-exact: dim 0 is sharded
+        # over every mesh axis, each process writes its own windows)
+        if self._quant_residuals:
+            state["quant_residual"] = dict(self._quant_residuals)
+            meta["quant_residual_keys"] = sorted(self._quant_residuals)
         # per-process RNG streams: the host key + every named tracker
         # stream, keyed by process index so each relaunched rank gets
         # ITS stream back (the in-step per-rank forking derives from
@@ -1362,6 +1527,26 @@ class ParallelEngine:
         self._seed = int(meta.get("engine_seed", self._seed))
         if scaler is not None and "scaler" in meta:
             scaler.load_state_dict(meta["scaler"])
+        # quantization error-feedback residuals: materialize the (zero)
+        # buffers from the deterministic bucket plan, then overwrite
+        # with the checkpointed bytes at the live sharding. Checkpoints
+        # written without quant_comm (or restored into an engine with
+        # the knob off) skip this — the buffers stay zeros / absent.
+        qkeys = meta.get("quant_residual_keys") or []
+        if qkeys:
+            self._ensure_quant_state()
+            targets = {k: self._quant_residuals[k] for k in qkeys
+                       if k in self._quant_residuals}
+            if targets:
+                loaded = {"quant_residual": dict(targets)}
+                load_state_dict(loaded, resolved)
+                for k, arr in loaded["quant_residual"].items():
+                    # the loader hands raw (non-Tensor) leaves back as
+                    # host arrays — re-place at the live sharding
+                    if not isinstance(arr, jax.Array):
+                        self._quant_residuals[k] = global_put(
+                            np.asarray(arr, dtype=np.float32),
+                            self.mesh, self._quant_specs[k])
         # per-process RNG streams (missing entries — e.g. resuming on
         # MORE hosts than saved — keep their current stream)
         pi = jax.process_index()

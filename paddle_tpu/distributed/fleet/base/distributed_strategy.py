@@ -47,6 +47,21 @@ _HYBRID_DEFAULTS = {
     # the unbucketed path.
     "sharding_configs": {"comm_overlap": False,
                          "comm_buffer_size_MB": 25.0},
+    # quant_comm: int8 (or fp8 e4m3) wire compression for the grad
+    # reduce-scatter/pmean buckets (grad_sync — rides comm_overlap's
+    # bucket plan, with a per-bucket error-feedback residual carried as
+    # training state) and the collective-matmul ring ticks (mp_rings).
+    # Per-chunk symmetric scales over a fixed `chunk` lattice with a
+    # bf16 scale sidecar; dtype "none" = full-precision wire
+    # (bit-identical to the pre-knob behavior). See
+    # distributed/quant_comm.py.
+    # param_gather additionally ships the ZeRO stage-2/3 param
+    # all-gather quantized with each rank's OWN shard spliced back
+    # exactly (no error accumulation in the authoritative state).
+    "quant_comm": {"dtype": "none", "grad_sync": True, "mp_rings": True,
+                   "param_gather": True, "chunk": 256,
+                   "error_feedback": True,
+                   "stochastic_rounding": False},
 }
 
 
@@ -62,7 +77,7 @@ class DistributedStrategy:
         self._hybrid_configs: Dict[str, Any] = dict(_HYBRID_DEFAULTS)
         # nested sub-configs must not alias the class-level defaults
         for k in ("mp_configs", "pp_configs", "moe_configs",
-                  "sharding_configs"):
+                  "sharding_configs", "quant_comm"):
             self._hybrid_configs[k] = _SubConfig(_HYBRID_DEFAULTS[k])
         self.pipeline_configs: Dict[str, Any] = {
             "micro_batch_size": 1, "accumulate_steps": 1}
@@ -90,7 +105,7 @@ class DistributedStrategy:
     def hybrid_configs(self, configs: Dict[str, Any]):
         for k, v in configs.items():
             if k in ("mp_configs", "pp_configs", "moe_configs",
-                     "sharding_configs") \
+                     "sharding_configs", "quant_comm") \
                     and isinstance(v, dict):
                 merged = _SubConfig(self._hybrid_configs.get(k, {}))
                 merged.update(v)

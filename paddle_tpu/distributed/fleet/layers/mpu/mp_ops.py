@@ -55,6 +55,16 @@ def mp_active(group: Optional[C.Group] = None) -> bool:
 
 # -- value-level primitives with Megatron custom-vjp pairing -------------
 
+def _act_psum(x, axes):
+    """The TP activation allreduce both Megatron pairings issue:
+    int8/fp8 wire when the quant_comm mp_rings knob is on (stateless —
+    quant_comm.maybe_quantized_psum), the plain ledger shim
+    otherwise."""
+    from .... import quant_comm as _qc
+
+    return _qc.maybe_quantized_psum(x, axes)
+
+
 @partial(jax.custom_vjp, nondiff_argnums=(1,))
 def identity_psum_bwd(x, axes):
     """Forward identity; backward psum over ``axes`` (f in Megatron)."""
@@ -62,16 +72,16 @@ def identity_psum_bwd(x, axes):
 
 
 identity_psum_bwd.defvjp(lambda x, axes: (x, None),
-                         lambda axes, _, g: (C.t_psum(g, axes),))
+                         lambda axes, _, g: (_act_psum(g, axes),))
 
 
 @partial(jax.custom_vjp, nondiff_argnums=(1,))
 def psum_identity_bwd(x, axes):
     """Forward psum over ``axes``; backward identity (g in Megatron)."""
-    return C.t_psum(x, axes)
+    return _act_psum(x, axes)
 
 
-psum_identity_bwd.defvjp(lambda x, axes: (C.t_psum(x, axes), None),
+psum_identity_bwd.defvjp(lambda x, axes: (_act_psum(x, axes), None),
                          lambda axes, _, g: (g,))
 
 
@@ -163,7 +173,7 @@ def _c_identity(x: Tensor, group: Optional[C.Group] = None) -> Tensor:
     axes = mp_axes(group)
 
     def bwd(g):
-        return (C.t_psum(g, axes),)
+        return (_act_psum(g, axes),)
 
     return _custom("c_identity", identity_psum_bwd(x._value, axes), bwd, x)
 

@@ -48,6 +48,7 @@ from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
+import jax
 import jax.numpy as jnp
 from jax import lax
 
@@ -177,42 +178,96 @@ class BucketPlan:
                 "bucket_payload_bytes": per_bucket,
                 "groups": len(self.groups)}
 
+    # -- quantized-wire support (distributed/quant_comm.py) -------------
+    @staticmethod
+    def _group_quantizes(g: "BucketGroup") -> bool:
+        """A group quantizes when it puts a payload-sized collective on
+        the wire: the ZeRO reduce-scatter ("rs") or a grad pmean.
+        Groups whose only work is a dup rescale carry no residual."""
+        return g.kind == "rs" or bool(g.pm)
+
+    def residual_shapes(self) -> Dict[str, Tuple[int, ...]]:
+        """LOCAL (per-rank) f32 error-feedback residual buffer shapes,
+        keyed by the stable bucket name the engine checkpoints under:
+        ``g<i>`` for a seam group ([nb ticks, tick payload elems] —
+        the residual rides the bucket scan), ``g<i>b<j>`` for a flat
+        bucket ([payload elems])."""
+        out: Dict[str, Tuple[int, ...]] = {}
+        for gi, g in enumerate(self.groups):
+            if not self._group_quantizes(g):
+                continue
+            if g.seam:
+                total = sum(int(np.prod(e.shape)) for e in g.entries)
+                out[f"g{gi}"] = (g.nb, total // max(g.nb, 1))
+            else:
+                for bi, b in enumerate(g.buckets):
+                    out[f"g{gi}b{bi}"] = (
+                        sum(int(np.prod(e.shape)) for e in b),)
+        return out
+
     # -- trace-time execution (inside the compiled step) ----------------
-    def sync(self, grads: Dict[int, Any]):
+    def sync(self, grads: Dict[int, Any], qcfg=None,
+             residuals: Optional[Dict[str, Any]] = None):
         """Issue every group's bucketed collectives on the raw grads.
 
-        Returns ``(synced, gsq)``: the per-parameter synced grads (the
-        ZeRO shard for "rs" entries — exactly what the unbucketed path
-        produces) and the folded global grad-norm sum-of-squares
-        (f32 scalar, group psums already applied).
+        Returns ``(synced, gsq, new_residuals)``: the per-parameter
+        synced grads (the ZeRO shard for "rs" entries — exactly what
+        the unbucketed path produces), the folded global grad-norm
+        sum-of-squares (f32 scalar, group psums already applied), and
+        the updated per-bucket error-feedback residuals (empty unless
+        ``qcfg`` quantizes and ``residuals`` carries state — keys
+        match ``residual_shapes``).
+
+        ``qcfg``: a quant_comm.QuantConfig (or None = today's
+        full-precision wire, byte-for-byte untouched). When set, each
+        bucket's payload quantizes to int8/fp8 + bf16 scales before
+        the reduce-scatter / pmean / extra psum, and the dequantized
+        local image's error feeds back through ``residuals``.
         """
+        residuals = residuals or {}
         synced: Dict[int, Any] = {}
+        new_res: Dict[str, Any] = {}
         gsq = jnp.float32(0.0)
-        for g in self.groups:
+        for gi, g in enumerate(self.groups):
+            q = qcfg if (qcfg is not None
+                         and self._group_quantizes(g)) else None
             if g.seam:
-                out, sq = _sync_seam_group(g, grads)
+                rkey = f"g{gi}"
+                resid = residuals.get(rkey) if q is not None else None
+                out, sq, nr = _sync_seam_group(g, grads, qcfg=q,
+                                               resid=resid, site=gi)
+                if nr is not None:
+                    new_res[rkey] = nr
                 synced.update(out)
             else:
                 sq = jnp.float32(0.0)
-                for bucket in g.buckets:
+                for bi, bucket in enumerate(g.buckets):
+                    rkey = f"g{gi}b{bi}"
+                    resid = residuals.get(rkey) if q is not None \
+                        else None
+                    site = gi * 4096 + bi
                     if g.kind == "rs":
-                        outs, bsq = _sync_rs_bucket(
+                        outs, bsq, nr = _sync_rs_bucket(
                             [(grads[e.pid], e.shard_dim) for e in bucket],
-                            g.n, g.axis, g.pm, g.extra)
+                            g.n, g.axis, g.pm, g.extra, qcfg=q,
+                            resid=resid, site=site)
                     else:
-                        outs, bsq = _sync_pmean_bucket(
+                        outs, bsq, nr = _sync_pmean_bucket(
                             [grads[e.pid] for e in bucket],
                             [e.shape for e in bucket],
-                            g.pm, g.dup, g.extra)
+                            g.pm, g.dup, g.extra, qcfg=q,
+                            resid=resid, site=site)
                     for e, o in zip(bucket, outs):
                         synced[e.pid] = o
+                    if nr is not None:
+                        new_res[rkey] = nr
                     sq = sq + bsq
             if g.gnorm_axes:
                 from . import collective as C
 
                 sq = C.t_psum(sq, g.gnorm_axes)
             gsq = gsq + sq
-        return synced, gsq
+        return synced, gsq, new_res
 
 
 # ---------------------------------------------------------------------------
@@ -376,56 +431,148 @@ def _rank_major(g, d: int, n: int):
     return gr.reshape(n, -1)
 
 
-def _sync_rs_bucket(vals_dims, n: int, axis: str, pm, extra):
+def _sync_rs_bucket(vals_dims, n: int, axis: str, pm, extra,
+                    qcfg=None, resid=None, site: int = 0, key=None):
     """One bucket of the ZeRO path: coalesced dp-mean + extra psum +
     rank-major flat reduce-scatter. Returns (per-param shards, local
-    sum-of-squares of the shard in f32)."""
+    sum-of-squares of the shard in f32, new EF residual or None).
+
+    Quantized wire (``qcfg``): the error-feedback residual joins at
+    the FIRST quantized collective in the chain (dp pmean, else the
+    extra psum, else the reduce-scatter) — that is where the raw-grad
+    compression error is born; downstream re-quantizations act on
+    values already near the chunk lattice, so their error is second-
+    order and carried statelessly (quant_comm module docstring).
+    Reduction arithmetic stays f32; the synced shard casts back to the
+    grad dtype at the end.
+    """
     from . import collective as C
 
     flat = jnp.concatenate(
         [_rank_major(g, d, n) for g, d in vals_dims], axis=1).reshape(-1)
-    if pm:
-        flat = C.t_pmean(flat, pm)
-    if extra:
-        flat = C.t_psum(flat, extra)
-    shard = C.t_psum_scatter(flat, axis, scatter_dimension=0,
-                             tiled=True) / n
+    if qcfg is None:
+        if pm:
+            flat = C.t_pmean(flat, pm)
+        if extra:
+            flat = C.t_psum(flat, extra)
+        shard = C.t_psum_scatter(flat, axis, scatter_dimension=0,
+                                 tiled=True) / n
+        new_resid = None
+        sq = jnp.sum(jnp.square(shard.astype(jnp.float32)))
+    else:
+        from . import quant_comm as _qc
+
+        item = np.dtype(flat.dtype).itemsize
+        if key is None:
+            key = _qc.site_key(qcfg, site)
+
+        def _k(i):
+            return None if key is None else jax.random.fold_in(key, i)
+
+        v = flat.astype(jnp.float32)
+        new_resid = None
+        ef_open = resid is not None
+        if pm:
+            if ef_open:
+                v = v + resid
+            out, deq = _qc.quantized_allreduce(
+                v, pm, qcfg, mean=True, key=_k(0),
+                logical_itemsize=item)
+            if ef_open:
+                new_resid = v - deq
+                ef_open = False
+            v = out
+        if extra:
+            if ef_open:
+                v = v + resid
+            out, deq = _qc.quantized_allreduce(
+                v, extra, qcfg, mean=False, key=_k(1),
+                logical_itemsize=item)
+            if ef_open:
+                new_resid = v - deq
+                ef_open = False
+            v = out
+        if ef_open:
+            v = v + resid
+        shard32, deq = _qc.quantized_reduce_scatter(
+            v, (axis,), qcfg, key=_k(2), logical_itemsize=item)
+        if ef_open:
+            new_resid = v - deq
+        shard32 = shard32 / n
+        sq = jnp.sum(jnp.square(shard32))
+        shard = shard32.astype(flat.dtype)
     outs, off = [], 0
     for g, d in vals_dims:
         ss = _shard_shape(tuple(g.shape), d, n)
         m = int(np.prod(ss))
         outs.append(shard[off:off + m].reshape(ss))
         off += m
-    return outs, jnp.sum(jnp.square(shard.astype(jnp.float32)))
+    return outs, sq, new_resid
 
 
-def _sync_pmean_bucket(vals, shapes, pm, dup: int, extra):
+def _sync_pmean_bucket(vals, shapes, pm, dup: int, extra,
+                       qcfg=None, resid=None, site: int = 0, key=None):
     """One bucket of the replicated-grad path: coalesced pmean (+
     duplication rescale + extra psum). Returns (per-param grads, local
-    sum-of-squares in f32)."""
+    sum-of-squares in f32, new EF residual or None).
+
+    Quantized wire: the residual joins before the quantized pmean
+    (EQuARX two-phase allreduce — int8 + bf16 scales both phases);
+    the extra psum quantizes statelessly after."""
     from . import collective as C
 
     flat = jnp.concatenate([g.reshape(-1) for g in vals])
-    if pm:
-        flat = C.t_pmean(flat, pm)
-    if dup > 1:
-        flat = flat / dup
-    if extra:
-        flat = C.t_psum(flat, extra)
+    new_resid = None
+    if qcfg is None or not pm:
+        if pm:
+            flat = C.t_pmean(flat, pm)
+        if dup > 1:
+            flat = flat / dup
+        if extra:
+            flat = C.t_psum(flat, extra)
+    else:
+        from . import quant_comm as _qc
+
+        item = np.dtype(flat.dtype).itemsize
+        if key is None:
+            key = _qc.site_key(qcfg, site)
+        v = flat.astype(jnp.float32)
+        if resid is not None:
+            v = v + resid
+        full, deq = _qc.quantized_allreduce(
+            v, pm, qcfg, mean=True,
+            key=None if key is None else jax.random.fold_in(key, 0),
+            logical_itemsize=item)
+        new_resid = (v - deq) if resid is not None else None
+        if dup > 1:
+            full = full / dup
+        if extra:
+            full, _ = _qc.quantized_allreduce(
+                full, extra, qcfg, mean=False,
+                key=None if key is None else jax.random.fold_in(key, 1),
+                logical_itemsize=item)
+        flat = full.astype(flat.dtype)
     outs, off = [], 0
     for s in shapes:
         m = int(np.prod(s))
         outs.append(flat[off:off + m].reshape(tuple(s)))
         off += m
-    return outs, jnp.sum(jnp.square(flat.astype(jnp.float32)))
+    return outs, jnp.sum(jnp.square(flat.astype(jnp.float32))), new_resid
 
 
-def _sync_seam_group(g: BucketGroup, grads: Dict[int, Any]):
+def _sync_seam_group(g: BucketGroup, grads: Dict[int, Any], qcfg=None,
+                     resid=None, site: int = 0):
     """The layer-grained bucket scan over the stacked-params seam: nb
     ticks of R rows, the bucket collective issued INSIDE the tick, the
     grad-norm sum-of-squares folded into the carry. Ledger records are
     noted once with trips=nb (commledger.scan_trips) so accounting
-    stays exact."""
+    stays exact.
+
+    Quantized wire (``qcfg``): the per-tick error-feedback residual
+    slice rides the scan alongside the grads ([nb, tick elems] — one
+    slot per tick, updated in place through the scan outputs) and the
+    stochastic-rounding key (when on) folds the tick index so every
+    tick rounds independently."""
     nb, R = g.nb, g.R
     xs = []
     tails: List[Tuple[int, ...]] = []
@@ -434,29 +581,48 @@ def _sync_seam_group(g: BucketGroup, grads: Dict[int, Any]):
         tail = tuple(arr.shape[e.row_dims:])
         tails.append(tail)
         xs.append(arr.reshape((nb, R) + tail))
+    use_ef = qcfg is not None and resid is not None
+    base_key = None
+    if qcfg is not None:
+        from . import quant_comm as _qc
+
+        base_key = _qc.site_key(qcfg, site)
+    scan_xs: Dict[str, Any] = {"g": tuple(xs)}
+    if use_ef:
+        scan_xs["r"] = resid
+    if base_key is not None:
+        scan_xs["i"] = jnp.arange(nb, dtype=jnp.uint32)
+
+    def _tick_key(xt):
+        if base_key is None:
+            return None
+        return jax.random.fold_in(base_key, xt["i"])
+
     if g.kind == "rs":
         # scatter dim in tick coords: row dims collapse to one leading
         # R axis (the ZeRO plan keeps seam entries off the row dims)
         dims = [e.shard_dim - e.row_dims + 1 for e in g.entries]
 
-        def tick(carry, xs_t):
-            outs, sq = _sync_rs_bucket(list(zip(xs_t, dims)), g.n,
-                                       g.axis, g.pm, g.extra)
-            return carry + sq, tuple(outs)
+        def tick(carry, xt):
+            outs, sq, nr = _sync_rs_bucket(
+                list(zip(xt["g"], dims)), g.n, g.axis, g.pm, g.extra,
+                qcfg=qcfg, resid=xt.get("r"), key=_tick_key(xt))
+            return carry + sq, (tuple(outs), nr)
     else:
         tick_shapes = [(R,) + t for t in tails]
 
-        def tick(carry, xs_t):
-            outs, sq = _sync_pmean_bucket(list(xs_t), tick_shapes,
-                                          g.pm, g.dup, g.extra)
-            return carry + sq, tuple(outs)
+        def tick(carry, xt):
+            outs, sq, nr = _sync_pmean_bucket(
+                list(xt["g"]), tick_shapes, g.pm, g.dup, g.extra,
+                qcfg=qcfg, resid=xt.get("r"), key=_tick_key(xt))
+            return carry + sq, (tuple(outs), nr)
 
     with _cl.scan_trips(nb):
-        gsq, ys = lax.scan(tick, jnp.float32(0.0), tuple(xs))
+        gsq, (ys, new_resid) = lax.scan(tick, jnp.float32(0.0), scan_xs)
     synced: Dict[int, Any] = {}
     for e, y in zip(g.entries, ys):
         rows_shape = e.shape[:e.row_dims]
         out = y.reshape((nb * R,) + tuple(y.shape[2:]))
         synced[e.pid] = out.reshape(tuple(rows_shape)
                                     + tuple(y.shape[2:]))
-    return synced, gsq
+    return synced, gsq, (new_resid if use_ef else None)

@@ -1,0 +1,499 @@
+"""Quantized collectives: int8/fp8 wire compression with error feedback.
+
+Every training step moves full-precision bytes over ICI: the bucketed
+grad reduce-scatter / DP pmean (distributed/grad_buckets.py) and the
+collective-matmul ring ticks (distributed/collective_matmul.py) all
+ship fp32/bf16 payloads. EQuARX (PAPERS.md) shows a quantized
+all-reduce inside XLA recovers most of that bandwidth with negligible
+quality loss; this module is the compile-stable codec + quantized
+collective set both call sites plug into, and the comm ledger's
+closed-form wire-byte counters make the win measurable even on the
+CPU smoke mesh.
+
+**Codec** (``encode``/``decode``): per-chunk symmetric scales over a
+fixed chunk lattice. A flat payload of N elements pads with zeros to
+``Np = ceil(N/chunk)*chunk``, each chunk gets one scale
+``s = max|x| / qmax`` stored as a **bf16 sidecar** (``Np/chunk``
+scales), and elements quantize to ``round(x/s)`` in int8 (qmax=127) or
+cast to fp8 e4m3 (qmax=448) behind the same interface. Wire bytes for
+one payload are therefore exactly::
+
+    Np * 1  +  (Np/chunk) * 2        # int8/fp8 payload + bf16 scales
+
+Decoding multiplies by the SAME bf16-rounded scale the encoder used,
+so encode→decode is a pure function of (x, chunk) — identical on every
+rank, which the error-feedback algebra below relies on. A chunk of
+zeros encodes/decodes to exact zeros (scale 0 → treated as 1); a chunk
+holding an inf has scale inf, decoding the whole chunk to NaN so AMP's
+found_inf sees the overflow it must see; a NaN amax propagates NaN.
+Optional **stochastic rounding** (int8 only): ``floor(x/s + u)`` with
+u ~ U[0,1) from an explicit jax PRNG key — unbiased per element, used
+by the grad path when the knob asks for it (keys derive from the
+step's traced seed + a static site index, so the program is
+compile-stable and per-step masks differ).
+
+**Quantized collectives** (the wire movers — every byte goes through
+the traced-collective shim so the comm ledger stays exact):
+
+- ``quantized_reduce_scatter(v, axes)``: psum_scatter(v) with int8
+  wire. Each rank quantizes its local buffer per DESTINATION row,
+  block-exchanges the quantized rows + scales (one all_to_all each),
+  dequantizes the p received rows and sums locally — the standard
+  reduce-scatter decomposition, same (p-1)/p ring factor, with the
+  reduction arithmetic in f32 so quantization error never compounds
+  across hops. Also returns the local dequantize(quantize(v)) image
+  for the caller's error-feedback residual.
+- ``quantized_allreduce(v, axes)``: the EQuARX two-phase form —
+  quantized reduce-scatter, then the summed shard re-quantizes and
+  all-gathers (int8 + scales again). ``mean=True`` divides by the
+  group size at the end (pmean).
+- ring-tick helpers (``pack_block``/``unpack_block``/
+  ``permute_packed``/``gather_packed``): collective_matmul quantizes a
+  block ONCE at ring entry and ships the (payload, scales) pair around
+  the ring, dequantizing per tick for the partial GEMM — a payload in
+  flight is never re-quantized, so multi-hop shards see exactly one
+  quantization. (matmul_rs re-quantizes its accumulator per shift
+  because the values change each tick; that error is bounded by one
+  quantization step per hop and is the EQuARX trade.)
+
+**Error feedback**: the residual ``e`` is carried per grad bucket as
+training state (f32, rank-local). Each step the bucket sync computes
+``v = g + e``, puts ``quantize(v)`` on the wire, and stores
+``e' = v - decode(encode(v))`` — the compression error re-enters the
+next step's gradient instead of being lost, which is what keeps
+convergence at fp32 parity (pinned by the deterministic-horizon test).
+The residual is REAL state: it joins the engine checkpoint as one
+commit unit and a resume that dropped it would be a correctness bug.
+
+Knob: ``strategy.hybrid_configs["quant_comm"]`` —
+``{"dtype": "int8"|"fp8"|"none", "grad_sync": bool, "mp_rings": bool,
+"param_gather": bool, "chunk": int, "error_feedback": bool,
+"stochastic_rounding": bool}`` (defaults off via dtype="none");
+``grad_sync`` rides the comm_overlap bucket plan, ``mp_rings`` covers
+the collective-matmul rings plus the Megatron TP activation
+allreduces, and ``param_gather`` the ZeRO stage-2/3 param all-gather
+(own-shard splice — see ``quantized_param_gather``).
+"""
+from __future__ import annotations
+
+import contextlib
+from dataclasses import dataclass
+from typing import Any, Dict, Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from . import collective as C
+from ..observability import commledger as _cl
+
+__all__ = [
+    "QuantConfig", "make_config", "strategy_config", "grad_sync_config",
+    "ring_config", "override", "encode", "decode", "padded_len",
+    "payload_wire_bytes", "reduce_scatter_wire_bytes",
+    "allreduce_wire_bytes", "quantized_reduce_scatter",
+    "quantized_allreduce", "quantized_param_gather",
+    "maybe_quantized_psum", "pack_block", "unpack_block",
+    "block_ratio", "permute_packed", "gather_packed", "site_key",
+    "DEFAULTS", "SCALE_BYTES",
+]
+
+# the reference knob surface (merged into DistributedStrategy's
+# hybrid_configs defaults); dtype "none" = everything off
+DEFAULTS: Dict[str, Any] = {
+    "dtype": "none",
+    "grad_sync": True,
+    "mp_rings": True,
+    "param_gather": True,
+    "chunk": 256,
+    "error_feedback": True,
+    "stochastic_rounding": False,
+}
+
+_QMAX = {"int8": 127.0, "fp8": 448.0}
+SCALE_DTYPE = jnp.bfloat16
+SCALE_BYTES = 2     # bf16 sidecar
+WIRE_ITEMSIZE = 1   # int8 and fp8 e4m3 are both one byte
+
+
+@dataclass(frozen=True)
+class QuantConfig:
+    """One resolved quant_comm knob set (hashable, trace-static)."""
+
+    dtype: str = "none"
+    grad_sync: bool = True
+    mp_rings: bool = True
+    param_gather: bool = True
+    chunk: int = 256
+    error_feedback: bool = True
+    stochastic_rounding: bool = False
+
+    @property
+    def enabled(self) -> bool:
+        return self.dtype in _QMAX
+
+    @property
+    def wire_dtype(self):
+        return jnp.int8 if self.dtype == "int8" else jnp.float8_e4m3fn
+
+    @property
+    def qmax(self) -> float:
+        return _QMAX[self.dtype]
+
+
+def make_config(cfg) -> QuantConfig:
+    """Validate + freeze a knob dict (or pass a QuantConfig through)."""
+    if cfg is None:
+        return QuantConfig()
+    if isinstance(cfg, QuantConfig):
+        return cfg
+    from ..core.enforce import enforce
+
+    unknown = set(cfg) - set(DEFAULTS)
+    enforce(not unknown,
+            f"quant_comm: unknown keys {sorted(unknown)} "
+            f"(valid: {sorted(DEFAULTS)})")
+    merged = dict(DEFAULTS)
+    merged.update(cfg)
+    enforce(merged["dtype"] in ("none", "int8", "fp8"),
+            f"quant_comm dtype must be 'int8', 'fp8' or 'none', got "
+            f"{merged['dtype']!r}")
+    enforce(int(merged["chunk"]) > 0,
+            f"quant_comm chunk must be positive, got {merged['chunk']}")
+    return QuantConfig(
+        dtype=str(merged["dtype"]),
+        grad_sync=bool(merged["grad_sync"]),
+        mp_rings=bool(merged["mp_rings"]),
+        param_gather=bool(merged["param_gather"]),
+        chunk=int(merged["chunk"]),
+        error_feedback=bool(merged["error_feedback"]),
+        stochastic_rounding=bool(merged["stochastic_rounding"]))
+
+
+# test/bench hook: force a config without a fleet strategy (the engine
+# constructor override serves the grad path; this one serves the rings)
+_override: list = []
+
+
+@contextlib.contextmanager
+def override(cfg):
+    """Force ``strategy_config()`` to return ``cfg`` inside the block
+    (tests / engines built without fleet.init)."""
+    _override.append(make_config(cfg))
+    try:
+        yield
+    finally:
+        _override.pop()
+
+
+def strategy_config(strategy=None) -> QuantConfig:
+    """The active quant_comm knob set, from the fleet strategy's
+    ``hybrid_configs["quant_comm"]`` (the reference knob surface), or
+    the all-off defaults when no strategy is active."""
+    if _override:
+        return _override[-1]
+    if strategy is None:
+        from . import fleet as _fleet
+
+        strategy = _fleet.get_strategy()
+    if strategy is None:
+        return QuantConfig()
+    return make_config(strategy.hybrid_configs.get("quant_comm") or {})
+
+
+def grad_sync_config(strategy=None) -> Optional[QuantConfig]:
+    cfg = strategy_config(strategy)
+    return cfg if (cfg.enabled and cfg.grad_sync) else None
+
+
+def ring_config(strategy=None) -> Optional[QuantConfig]:
+    cfg = strategy_config(strategy)
+    return cfg if (cfg.enabled and cfg.mp_rings) else None
+
+
+# ---------------------------------------------------------------------------
+# codec: per-chunk symmetric scales over the fixed chunk lattice
+# ---------------------------------------------------------------------------
+
+
+def padded_len(n: int, chunk: int) -> int:
+    """The chunk-lattice length a flat payload of ``n`` pads to."""
+    return -(-int(n) // int(chunk)) * int(chunk)
+
+
+def payload_wire_bytes(n: int, cfg: QuantConfig) -> int:
+    """Exact wire bytes of one encoded payload of ``n`` elements:
+    ceil-padded 1-byte lattice + the bf16 scale sidecar."""
+    np_ = padded_len(n, cfg.chunk)
+    return np_ * WIRE_ITEMSIZE + (np_ // cfg.chunk) * SCALE_BYTES
+
+
+def _scale32(s):
+    """The f32 scale decode (and encode) divide/multiply by, derived
+    from the stored bf16 sidecar: 0 → 1 (all-zero chunk), NaN/inf
+    propagate so nonfinite inputs stay visible to AMP's found_inf."""
+    s32 = s.astype(jnp.float32)
+    return jnp.where(s32 == 0.0, jnp.float32(1.0), s32)
+
+
+def encode(x, cfg: QuantConfig, key=None):
+    """Quantize ``x`` ([..., L] with L % chunk == 0) on the chunk
+    lattice. Returns ``(payload, scales)``: payload in the wire dtype
+    with x's shape, scales bf16 [..., L/chunk]."""
+    chunk = cfg.chunk
+    xs = x.astype(jnp.float32)
+    g = xs.reshape(xs.shape[:-1] + (xs.shape[-1] // chunk, chunk))
+    amax = jnp.max(jnp.abs(g), axis=-1)
+    s = (amax / cfg.qmax).astype(SCALE_DTYPE)
+    scaled = g / _scale32(s)[..., None]
+    if cfg.dtype == "int8":
+        if cfg.stochastic_rounding and key is not None:
+            u = jax.random.uniform(key, scaled.shape,
+                                   dtype=jnp.float32)
+            qv = jnp.floor(scaled + u)
+        else:
+            qv = jnp.round(scaled)
+        q = jnp.clip(qv, -cfg.qmax, cfg.qmax).astype(jnp.int8)
+    else:
+        q = jnp.clip(scaled, -cfg.qmax,
+                     cfg.qmax).astype(jnp.float8_e4m3fn)
+    return q.reshape(x.shape), s
+
+
+def decode(q, s, cfg: QuantConfig, dtype=jnp.float32):
+    """Dequantize an ``encode`` pair back to ``dtype`` (x's shape)."""
+    chunk = cfg.chunk
+    g = q.astype(jnp.float32).reshape(
+        q.shape[:-1] + (q.shape[-1] // chunk, chunk))
+    out = g * _scale32(s)[..., None]
+    return out.reshape(q.shape).astype(dtype)
+
+
+# ---------------------------------------------------------------------------
+# quantized collectives (all wire movement through the ledger shim)
+# ---------------------------------------------------------------------------
+
+
+def _group_size(axes) -> int:
+    p = 1
+    for a in axes:
+        p *= int(C.axis_size(a))
+    return p
+
+
+def _pad_rows(rows, L: int, Lp: int):
+    return rows if Lp == L else jnp.pad(rows, ((0, 0), (0, Lp - L)))
+
+
+def reduce_scatter_wire_bytes(n: int, p: int, cfg: QuantConfig,
+                              trips: int = 1) -> float:
+    """Closed-form per-participant wire bytes of ONE quantized
+    reduce-scatter of an ``n``-element payload over a group of ``p``:
+    the (p-1)/p-factored all_to_all of the int8 rows plus the bf16
+    scale sidecar (see quantized_reduce_scatter)."""
+    L = n // p
+    Lp = padded_len(L, cfg.chunk)
+    nc = Lp // cfg.chunk
+    return float((p - 1) * (Lp * WIRE_ITEMSIZE + nc * SCALE_BYTES)
+                 * trips)
+
+
+def allreduce_wire_bytes(n: int, p: int, cfg: QuantConfig,
+                         trips: int = 1) -> float:
+    """Closed-form per-participant wire bytes of ONE quantized
+    allreduce (reduce-scatter phase + all-gather phase, both int8 +
+    bf16 scales)."""
+    L = -(-int(n) // p)
+    Lp = padded_len(L, cfg.chunk)
+    nc = Lp // cfg.chunk
+    per_phase = (p - 1) * (Lp * WIRE_ITEMSIZE + nc * SCALE_BYTES)
+    return float(2 * per_phase * trips)
+
+
+def quantized_reduce_scatter(v, axes, cfg: QuantConfig, key=None,
+                             logical_itemsize: int = 4):
+    """``psum_scatter(v, axes, scatter_dimension=0, tiled=True)`` with
+    int8/fp8 wire. ``v``: f32 flat [N], N % p == 0.
+
+    Returns ``(shard, local_dequant)``: the f32 summed shard [N/p] and
+    the local decode(encode(v)) image [N] — ``v - local_dequant`` is
+    the caller's error-feedback residual. ``logical_itemsize`` is the
+    itemsize the UNQUANTIZED path would have put on the wire (the grad
+    dtype) — it prices the ledger's payload_ratio stamp.
+    """
+    axes = tuple(axes)
+    p = _group_size(axes)
+    if p <= 1:
+        return v, v
+    N = int(v.shape[0])
+    L = N // p
+    Lp = padded_len(L, cfg.chunk)
+    rows = _pad_rows(v.reshape(p, L), L, Lp)
+    q, s = encode(rows, cfg, key)                # [p, Lp], [p, nc]
+    deq = decode(q, s, cfg)[:, :L].reshape(N)
+    nc = Lp // cfg.chunk
+    ratio = (p * (Lp * WIRE_ITEMSIZE + nc * SCALE_BYTES)) \
+        / float(N * logical_itemsize)
+    with _cl.quant_wire(ratio):
+        qq = C.t_all_to_all(q, axes, split_axis=0, concat_axis=0,
+                            tiled=True)
+        ss = C.t_all_to_all(s, axes, split_axis=0, concat_axis=0,
+                            tiled=True)
+    shard = jnp.sum(decode(qq, ss, cfg)[:, :L], axis=0)
+    return shard, deq
+
+
+def quantized_allreduce(v, axes, cfg: QuantConfig, mean: bool = False,
+                        key=None, logical_itemsize: int = 4):
+    """``psum(v, axes)`` (or pmean with ``mean=True``) with int8/fp8
+    wire: quantized reduce-scatter + re-quantized all-gather (the
+    EQuARX two-phase form). ``v``: f32 flat [N], any N.
+
+    Returns ``(full, local_dequant)`` with ``full`` f32 [N] and
+    ``local_dequant`` the phase-1 decode(encode(v)) image for error
+    feedback (the phase-2 re-quantization of the already-summed shard
+    is stateless — its error is not locally attributable).
+    """
+    axes = tuple(axes)
+    p = _group_size(axes)
+    if p <= 1:
+        return v, v
+    N = int(v.shape[0])
+    L = -(-N // p)
+    Lp = padded_len(L, cfg.chunk)
+    Np = p * Lp
+    vp = jnp.pad(v, (0, Np - N)) if Np != N else v
+    nc = Lp // cfg.chunk
+    # phase-1 ratio prices the rs phase against HALF the fp psum wire
+    # ((p-1)/p * N * itemsize); phase 2 against the other half — the
+    # expression is the same, so one stamp covers all four records
+    ratio = (p * (Lp * WIRE_ITEMSIZE + nc * SCALE_BYTES)) \
+        / float(N * logical_itemsize)
+    rows = vp.reshape(p, Lp)
+    q, s = encode(rows, cfg, key)
+    deq = decode(q, s, cfg).reshape(Np)[:N]
+    with _cl.quant_wire(ratio):
+        qq = C.t_all_to_all(q, axes, split_axis=0, concat_axis=0,
+                            tiled=True)
+        ss = C.t_all_to_all(s, axes, split_axis=0, concat_axis=0,
+                            tiled=True)
+    shard = jnp.sum(decode(qq, ss, cfg), axis=0)     # [Lp] f32
+    if key is not None:
+        key = jax.random.fold_in(key, 1)
+    q2, s2 = encode(shard, cfg, key)
+    with _cl.quant_wire(ratio):
+        qg = C.t_all_gather(q2[None], axes, axis=0, tiled=True)
+        sg = C.t_all_gather(s2[None], axes, axis=0, tiled=True)
+    full = decode(qg, sg, cfg).reshape(Np)[:N]
+    if mean:
+        full = full / p
+    return full, deq
+
+
+# ---------------------------------------------------------------------------
+# ring-tick helpers (collective_matmul's per-block quantize/dequantize)
+# ---------------------------------------------------------------------------
+
+
+def pack_block(x, cfg: QuantConfig, key=None):
+    """Quantize one ring block (any shape): flatten, pad to the chunk
+    lattice, encode. Returns ``(payload [Np], scales [Np/chunk])``."""
+    n = int(np.prod(x.shape)) if x.ndim else 1
+    Lp = padded_len(n, cfg.chunk)
+    flat = x.reshape(-1).astype(jnp.float32)
+    if Lp != n:
+        flat = jnp.pad(flat, (0, Lp - n))
+    return encode(flat, cfg, key)
+
+
+def unpack_block(q, s, shape, dtype, cfg: QuantConfig):
+    """Dequantize a packed ring block back to ``(shape, dtype)``."""
+    n = int(np.prod(shape)) if shape else 1
+    v = decode(q, s, cfg)
+    return v[:n].reshape(shape).astype(dtype)
+
+
+def block_ratio(shape, dtype, cfg: QuantConfig) -> float:
+    """Compressed / uncompressed wire-byte ratio of one packed block —
+    the quant_wire stamp for its ppermute/all_gather records."""
+    n = int(np.prod(shape)) if shape else 1
+    Lp = padded_len(n, cfg.chunk)
+    nc = Lp // cfg.chunk
+    return (Lp * WIRE_ITEMSIZE + nc * SCALE_BYTES) \
+        / float(n * np.dtype(dtype).itemsize)
+
+
+def permute_packed(q, s, name, perm, ratio: float):
+    """ppermute a packed (payload, scales) pair — both records stamped
+    with the block's compression ratio."""
+    with _cl.quant_wire(ratio):
+        return (C.t_ppermute(q, name, perm),
+                C.t_ppermute(s, name, perm))
+
+
+def gather_packed(q, s, axes, ratio: float):
+    """all_gather a packed pair along a new leading rank dim:
+    [Np] → [p, Np] (+ scales). The caller dequantizes per row and
+    reassembles along its own concat axis."""
+    with _cl.quant_wire(ratio):
+        return (C.t_all_gather(q[None], axes, axis=0, tiled=True),
+                C.t_all_gather(s[None], axes, axis=0, tiled=True))
+
+
+def quantized_param_gather(shard, axes, dim: int, cfg: QuantConfig):
+    """The ZeRO stage-2/3 param all-gather with int8/fp8 wire: pack the
+    updated shard once, all_gather payload + scales, reassemble rank
+    blocks along ``dim`` — then splice this rank's OWN exact shard back
+    over its block. The authoritative state path (each rank re-slices
+    its own shard for the next update) therefore stays bit-exact and
+    quantization error never accumulates in the weights; only the
+    OTHER ranks' working copies carry one quantization of noise,
+    regenerated fresh from exact shards every step (the MS-AMP/FSDP
+    low-precision param all-gather discipline)."""
+    from jax import lax
+
+    axes = tuple(axes) if not isinstance(axes, str) else (axes,)
+    p = _group_size(axes)
+    if p <= 1:
+        return shard
+    ratio = block_ratio(shard.shape, shard.dtype, cfg)
+    q, s = pack_block(shard, cfg)
+    qg, sg = gather_packed(q, s, axes, ratio)
+    blocks = [unpack_block(qg[j], sg[j], shard.shape, shard.dtype, cfg)
+              for j in range(p)]
+    full = jnp.concatenate(blocks, axis=dim)
+    idx = C.axis_index(axes)
+    return lax.dynamic_update_slice_in_dim(
+        full, shard, idx * shard.shape[dim], axis=dim)
+
+
+def maybe_quantized_psum(x, axes):
+    """``t_psum(x, axes)`` with int8/fp8 wire when the quant_comm
+    mp_rings knob is on (the TP activation allreduces: the Megatron
+    psum/identity primitives the embedding and fallback linear paths
+    issue). Stateless — activations carry no error-feedback state
+    across steps; full-precision shim call otherwise."""
+    axes_t = (axes,) if isinstance(axes, str) else tuple(axes)
+    cfg = ring_config()
+    if cfg is None or _group_size(axes_t) <= 1:
+        return C.t_psum(x, axes)
+    n = int(np.prod(x.shape)) if x.ndim else 1
+    full, _ = quantized_allreduce(
+        x.reshape(-1).astype(jnp.float32), axes_t, cfg, mean=False,
+        logical_itemsize=int(np.dtype(x.dtype).itemsize))
+    return full.reshape(x.shape).astype(x.dtype)
+
+
+def site_key(cfg: Optional[QuantConfig], site: int):
+    """A compile-stable stochastic-rounding key for a static call
+    site: derived from the step's traced seed (core/rng fork_traced)
+    folded with ``site`` — a pure function of the program position,
+    never of host trace count. None when stochastic rounding is off
+    (the codec then rounds to nearest)."""
+    if cfg is None or not cfg.stochastic_rounding:
+        return None
+    from ..core import rng as _rng
+
+    seed = _rng.traced_seed()
+    base = jax.random.key(seed if seed is not None else jnp.uint32(0))
+    return jax.random.fold_in(base, int(site))

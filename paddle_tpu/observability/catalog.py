@@ -68,6 +68,15 @@ def comm_metrics(reg: MetricsRegistry = None) -> Dict[str, object]:
             "(dp/sharding) — the T3-overlap headline: how much of "
             "gradient synchronization the step fails to hide",
             unit="s"),
+        "comm_quant_ratio": r.gauge(
+            "paddle_tpu_comm_quant_ratio",
+            "realized wire compression per axis of the last compiled "
+            "program: quantized bytes-on-wire (int8/fp8 payload + "
+            "bf16 scale sidecars) / the uncompressed-equivalent bytes "
+            "— ~0.25-0.27 for int8 over fp32 at practical chunk "
+            "sizes; only published for axes carrying quantized "
+            "collectives (distributed/quant_comm.py)",
+            labelnames=("axis",)),
     }
 
 
@@ -282,6 +291,13 @@ def train_metrics(reg: MetricsRegistry = None) -> Dict[str, object]:
             "DP/sharding collectives over (T3-style overlap, "
             "sharding_configs['comm_overlap']; 0 = the unbucketed "
             "end-of-backward tail sync — distributed/grad_buckets.py)"),
+        "quant_residual_norm": r.gauge(
+            "paddle_tpu_train_quant_residual_norm",
+            "global L2 norm of the quantized-collective error-feedback "
+            "residuals after the last step (gradient mass carried in "
+            "the compensation state; fetched with the loss's one-step "
+            "lag — only published when quant_comm grad_sync runs with "
+            "error_feedback on; distributed/quant_comm.py)"),
         "mfu": r.gauge(
             "paddle_tpu_train_mfu",
             "model-FLOPs utilization estimate (6N convention; 0 on "

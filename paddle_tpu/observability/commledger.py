@@ -63,7 +63,7 @@ import numpy as np
 __all__ = [
     "CommRecord", "CommLedger", "capture", "note", "wire_bytes",
     "active", "ablate", "ablating", "ablation_token", "scan_trips",
-    "OPS",
+    "quant_wire", "OPS",
 ]
 
 # canonical op kinds the ledger aggregates under (the {op} label of
@@ -113,6 +113,13 @@ class CommRecord:
     #                              flat/unrolled call site; the scan
     #                              length for sites noted under
     #                              scan_trips() (bucketed grad sync)
+    wire_dtype: str = ""         # dtype actually on the wire (== dtype;
+    #                              int8/bfloat16 for quantized payloads)
+    payload_ratio: float = 1.0   # wire bytes / the uncompressed-
+    #                              equivalent wire bytes of the logical
+    #                              collective this record implements
+    #                              (quant_comm stamps < 1 via
+    #                              quant_wire(); 1.0 = uncompressed)
 
 
 class CommLedger:
@@ -162,6 +169,31 @@ class CommLedger:
             bytes_counter.inc(t["bytes"], axis=axis, op=op)
             ops_counter.inc(t["ops"], axis=axis, op=op)
 
+    def quant_ratios(self) -> Dict[str, float]:
+        """Per-axis compressed / uncompressed-equivalent wire-byte
+        ratio, for axes carrying at least one quantized record
+        (payload_ratio < 1 stamped by quant_comm via quant_wire()).
+        The logical denominator folds every record back to its
+        uncompressed bytes, so mixed axes (some collectives quantized,
+        some not) report the blended ratio. Empty when nothing on this
+        program's wire is compressed — the engines only publish the
+        paddle_tpu_comm_quant_ratio gauge then."""
+        axes = {r.axis for r in self.records
+                if getattr(r, "payload_ratio", 1.0) != 1.0}
+        out: Dict[str, float] = {}
+        for axis in axes:
+            wire = logical = 0.0
+            for r in self.records:
+                if r.axis != axis:
+                    continue
+                w = r.wire_bytes * r.trips
+                wire += w
+                logical += w / max(getattr(r, "payload_ratio", 1.0),
+                                   1e-12)
+            if logical > 0:
+                out[axis] = wire / logical
+        return out
+
     def summary(self) -> Dict[str, Any]:
         return {
             "records": len(self.records),
@@ -176,6 +208,7 @@ class _State(threading.local):
         self.captures: List[CommLedger] = []
         self.ablated: frozenset = frozenset()
         self.trips: int = 1
+        self.qratio: float = 1.0
 
 
 _state = _State()
@@ -219,7 +252,9 @@ def note(op: str, axes: Iterable[str], shape, dtype, p: int,
                      dtype=str(dtype), p=int(p),
                      payload_bytes=payload,
                      wire_bytes=wire_bytes(op, payload, int(p)),
-                     args=tuple(args), trips=int(_state.trips))
+                     args=tuple(args), trips=int(_state.trips),
+                     wire_dtype=str(dtype),
+                     payload_ratio=float(_state.qratio))
     for led in _state.captures:
         led.add(rec)
 
@@ -245,6 +280,32 @@ def scan_trips(length: int) -> _ScanTrips:
     byte/op totals and the exposed-comm replay account the scan exactly
     instead of the once-traced lower bound."""
     return _ScanTrips(length)
+
+
+class _QuantWire:
+    def __init__(self, ratio: float):
+        self.ratio = float(ratio)
+
+    def __enter__(self):
+        self.prev = _state.qratio
+        _state.qratio = self.ratio
+        return self
+
+    def __exit__(self, *exc):
+        _state.qratio = self.prev
+        return False
+
+
+def quant_wire(ratio: float) -> _QuantWire:
+    """While active, records noted on this thread carry
+    ``payload_ratio=ratio`` — the wire bytes of the compressed
+    collective divided by the uncompressed-equivalent wire bytes of
+    the logical collective it implements. quant_comm wraps the shim
+    calls that move its int8/fp8 payloads and bf16 scale sidecars in
+    this, so ``CommLedger.quant_ratios()`` (and the
+    paddle_tpu_comm_quant_ratio gauge) can report the realized
+    compression per axis without guessing from dtypes."""
+    return _QuantWire(ratio)
 
 
 # -- ablation (the exposed-comm replay mode) ------------------------------

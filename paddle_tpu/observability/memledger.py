@@ -300,6 +300,14 @@ def account_engine(engine, batch_tokens: int = 0,
     opt = engine.optimizer
     comp = {"params": 0, "grads": 0, "optimizer_state": 0,
             "master_weights": 0, "activation_ckpt": 0}
+    # quant_comm error-feedback residuals are REAL HBM: one f32
+    # bucket-payload-sized buffer per quantizing bucket (engine
+    # _quant_residuals; the analytic model's quant_comm term mirrors
+    # this so paddle_tpu_mem_analytic_drift stays honest)
+    qres = getattr(engine, "_quant_residuals", None) or {}
+    if qres:
+        comp["quant_residual"] = sum(shard_bytes(v)
+                                     for v in qres.values())
     groups: Dict[str, Dict[str, int]] = {}
     named = {}
     try:
@@ -367,6 +375,10 @@ def account_engine(engine, batch_tokens: int = 0,
                  "sharding_degree": sh_deg,
                  "sharding_stage": 3 if stage3 else 2,
                  "micro_batch_size": 1}
+        qcfg = getattr(engine, "_quant_cfg", None)
+        if qres and qcfg is not None and qcfg.enabled:
+            cfg_d["quant_comm"] = {"dtype": qcfg.dtype,
+                                   "error_feedback": True}
         try:
             analytic = estimate_memory_gb(
                 model_d, cfg_d,

@@ -603,6 +603,69 @@ sq.defvjp(lambda x: (x * x, x), lambda x, g: (2 * x * g,))
         assert run_project("vjp-ledger-symmetry",
                            {"pkg/op.py": src}) == []
 
+    def test_quantized_allreduce_keeps_psum_identity_pairing(self):
+        # the quant_comm wrappers map to their LOGICAL collective kind
+        # in the shim table (an int8 allreduce lowers to a2a+all_gather
+        # internally, but the contract is a psum) — so the Megatron
+        # psum/identity pairing stays recognizable through a quantized
+        # forward. Without the mapping this fwd would read as
+        # {all_to_all, all_gather} vs an identity bwd and flag.
+        src = """
+import jax
+from functools import partial
+from . import quant_comm as _qc
+
+@partial(jax.custom_vjp, nondiff_argnums=(1,))
+def mp_allreduce(x, axes):
+    out, _ = _qc.quantized_allreduce(x, axes, None)
+    return out
+
+mp_allreduce.defvjp(
+    lambda x, axes: (mp_allreduce(x, axes), None),
+    lambda axes, res, g: (g,))
+"""
+        helper = """
+def quantized_allreduce(v, axes, cfg):
+    q = v
+    qq = t_all_to_all(q, axes, 0, 0, tiled=True)
+    full = t_all_gather(qq, axes, axis=0, tiled=True)
+    return full, v
+"""
+        assert run_project("vjp-ledger-symmetry",
+                           {"pkg/quant_comm.py": helper,
+                            "pkg/op.py": src}) == []
+
+    def test_quantized_ring_mirrored_pairing_accepted(self):
+        # quantized rings ship (payload, scales) pairs through
+        # permute_packed -> t_ppermute: the ppermute<->ppermute mirror
+        # must resolve through the packing helper
+        helper = """
+def permute_packed(q, s, name, perm, ratio):
+    return t_ppermute(q, name, perm), t_ppermute(s, name, perm)
+"""
+        op = """
+import jax
+from functools import partial
+from .quant_comm import permute_packed
+
+@partial(jax.custom_vjp, nondiff_argnums=(1,))
+def qring(x, axes):
+    q, s = permute_packed(x, x, axes, [(0, 1)], 0.25)
+    return q
+
+def _fwd(x, axes):
+    return qring(x, axes), None
+
+def _bwd(axes, res, g):
+    q, s = permute_packed(g, g, axes, [(1, 0)], 0.25)
+    return (q,)
+
+qring.defvjp(_fwd, _bwd)
+"""
+        assert run_project("vjp-ledger-symmetry",
+                           {"pkg/quant_comm.py": helper,
+                            "pkg/op.py": op}) == []
+
 
 class TestDonationReuse:
     STORE = """

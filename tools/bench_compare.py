@@ -40,9 +40,13 @@ _LOWER_BETTER_UNITS = {"ms"}
 # metrics where a SMALLER value is the improvement regardless of unit
 # (exposed-comm seconds: the T3 bucketed-backward overlap exists to
 # shrink this number; checkpoint stall: the async save path exists to
-# shrink it)
+# shrink it; quant wire ratio: compressed/uncompressed bytes-on-wire —
+# quant_comm exists to shrink it; quant loss gap: int8+error-feedback
+# final-loss drift vs the fp32 sync on the same deterministic horizon)
 _LOWER_BETTER_METRICS = {"gpt13b_hybrid_grad_sync_exposed_seconds",
-                         "ckpt_save_overlap_stall_seconds"}
+                         "ckpt_save_overlap_stall_seconds",
+                         "gpt13b_hybrid_quant_wire_ratio",
+                         "gpt13b_hybrid_quant_loss_gap"}
 # metrics that must stay exactly at their expected value
 _EXACT = {"pallas_kernel_parity_interpret": 1.0,
           "pallas_kernel_parity_onchip": 1.0,
@@ -74,6 +78,15 @@ _EXACT = {"pallas_kernel_parity_interpret": 1.0,
 # lines are noisy; recompile counts are exact)
 _THRESHOLDS = {
     "recompiles_after_warmup": 0.0,
+    # quantized wire ratio is a closed form of static shapes — it only
+    # moves when the bucket plan / quantized site set changes, so even
+    # a small drift is a real structural change worth flagging
+    "gpt13b_hybrid_quant_wire_ratio": 0.05,
+    # int8+EF loss drift vs fp32 on a 6-step horizon is noise-scale
+    # (~1e-4 abs on the smoke); the hard convergence gate (200-step
+    # parity + EF-off divergence detection) lives in
+    # tests/test_quant_comm.py — only a blow-up should flag here
+    "gpt13b_hybrid_quant_loss_gap": 10.0,
     # the MoE hybrid smoke line runs a 3-way (dp x ep x mp) 8-vdev CPU
     # mesh — wall-clock noise is higher than single-axis smokes, so
     # only flag large tokens/s moves; on chip the default applies

@@ -50,6 +50,16 @@ COLLECTIVE_SHIMS = {
     "t_pmin": "pmin", "t_all_gather": "all_gather",
     "t_psum_scatter": "reduce_scatter", "t_all_to_all": "all_to_all",
     "t_ppermute": "ppermute",
+    # quant_comm wrappers (distributed/quant_comm.py): their int8
+    # internals lower to shimmed a2a/all_gather pairs, but the
+    # CONTRACT — and therefore the vjp-ledger-symmetry pairing — is
+    # the logical reduce/gather op they implement. Mapping them here
+    # (and stopping descent, like any shim) keeps psum/identity and
+    # mirrored-ring pairings recognizable through the quantized
+    # wrappers.
+    "quantized_allreduce": "psum",
+    "quantized_reduce_scatter": "reduce_scatter",
+    "quantized_param_gather": "all_gather",
 }
 
 # raw lax collectives the shim wraps — using these directly anywhere
