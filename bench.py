@@ -691,21 +691,26 @@ def bench_gpt13b_hybrid(on_tpu, dev):
         B, S, steps, state_dtype = 2 * shard_deg * 2, 16, 2, None
         buf_mb = 0.001        # tiny target -> several buckets at toy size
 
-    # four lines, one knob apart each: vpp=1 (GPipe-family rotation),
+    # five lines, one knob apart each: vpp=1 (GPipe-family rotation),
     # vpp=2 (circular interleave), vpp=1 + comm_overlap (T3-style
     # bucketed backward: per-bucket grad reduce-scatter inside the
-    # backward seam, distributed/grad_buckets.py), and overlap +
+    # backward seam, distributed/grad_buckets.py), overlap +
     # quant_comm (int8 error-feedback quantized collectives,
     # distributed/quant_comm.py — the quant-vs-overlap pair isolates
-    # the wire compression). base vs overlap is the same program
-    # shape, so the loss-parity and profile_exposed_comm("sharding")
-    # comparison is one flag apart.
+    # the wire compression), and overlap + sharding_stage=3 (ZeRO-3
+    # shard-only parameter storage with the bucketed just-in-time
+    # gather — the stage3-vs-overlap pair isolates the storage
+    # discipline: same grads, params stored at 1/sharding_degree and
+    # re-gathered per signature bucket at forward entry). base vs
+    # overlap is the same program shape, so the loss-parity and
+    # profile_exposed_comm("sharding") comparison is one flag apart.
     quant_chunk = 256 if on_tpu else 64
     gp_base = tempfile.mkdtemp(prefix="goodput_gpt13b_")
     results = {}
-    for tag, vpp, overlap, quant in (
-            ("base", 1, False, False), ("vpp2", 2, False, False),
-            ("overlap", 1, True, False), ("quant", 1, True, True)):
+    for tag, vpp, overlap, quant, stage in (
+            ("base", 1, False, False, 2), ("vpp2", 2, False, False, 2),
+            ("overlap", 1, True, False, 2), ("quant", 1, True, True, 2),
+            ("stage3", 1, True, False, 3)):
         # one goodput journal per tag (run-level wall attribution:
         # compile vs step_compute vs idle; observability/goodput.py)
         gp_led = _gp.attach_dir(os.path.join(gp_base, tag))
@@ -719,16 +724,18 @@ def bench_gpt13b_hybrid(on_tpu, dev):
             # path (distributed/collective_matmul.py)
             "mp_configs": {"mp_async_allreduce": True},
             "pp_configs": {"num_virtual_pipeline_stages": vpp},
-            # T3-style bucketed grad sync (grad_buckets.py)
+            # T3-style bucketed grad sync (grad_buckets.py) + the ZeRO
+            # stage knob (3 = shard-only params, just-in-time gather)
             "sharding_configs": {"comm_overlap": overlap,
-                                 "comm_buffer_size_MB": buf_mb},
+                                 "comm_buffer_size_MB": buf_mb,
+                                 "sharding_stage": stage},
             # int8 quantized collectives with error feedback
             # (quant_comm.py): grad reduce-scatter buckets, TP rings +
             # activation allreduces, and the ZeRO param gather
             "quant_comm": {"dtype": "int8" if quant else "none",
                            "chunk": quant_chunk,
                            "error_feedback": True}}
-        strategy.sharding_configs = {"stage": 2}
+        strategy.sharding_configs = {"stage": stage}
         strategy.pipeline_configs = {
             "accumulate_steps": 2,
             "micro_batch_size": B // (2 * shard_deg)}
@@ -806,6 +813,7 @@ def bench_gpt13b_hybrid(on_tpu, dev):
             "pp_vpp": vpp,
             "comm_overlap": overlap,
             "quant_comm": quant,
+            "sharding_stage": stage,
             "comm_bytes_total": round(led.bytes_for(), 1) if led
             else 0.0,
             # engine compile-cache counters: steady state must be
@@ -893,6 +901,58 @@ def bench_gpt13b_hybrid(on_tpu, dev):
            "value": round(q_gap, 6), "unit": "abs", "vs_baseline": 0.0,
            "losses_quant": [round(v, 5) for v in q_r["losses"]],
            "losses_fp32": [round(v, 5) for v in ov_r["losses"]]})
+    # the ZeRO stage-3 acceptance pair: stage3 vs overlap on the same
+    # program shape, one knob apart — loss parity (exact-gated in
+    # tools/bench_compare.py: the gather is pure data movement, so
+    # stage 3 must land bit-on the stage-2 trajectory) plus the
+    # just-in-time gather's wire bytes pinned to the (p-1) x shard
+    # closed form (scan_trips-exact on the stacked seam)
+    s3_r = results["stage3"]
+    s3_parity = max(abs(a - b) for a, b in zip(ov_r["losses"],
+                                               s3_r["losses"]))
+    s3_eng = s3_r["eng"]
+    covered_shard_bytes = sum(
+        _ml.shard_bytes(p._value) for p in s3_eng.trainable
+        if s3_eng._zero.entry(p) is not None
+        and s3_eng._zero.entry(p)[1])
+    gather_closed = (shard_deg - 1) * covered_shard_bytes
+    gather_bytes = (s3_r["led"].bytes_for(axis="sharding",
+                                          op="all_gather")
+                    if s3_r["led"] else 0.0)
+    _emit({"metric": "gpt13b_hybrid_stage3_loss_parity",
+           "value": 1.0 if (s3_parity <= 1e-5
+                            and gather_bytes == gather_closed) else 0.0,
+           "unit": "pass", "vs_baseline": 1.0,
+           "max_abs_loss_diff": s3_parity,
+           "gather_bytes_per_step": round(gather_bytes, 1),
+           "gather_bytes_closed_form": round(float(gather_closed), 1),
+           "gather_ops_per_step": (s3_r["led"].ops_for(
+               axis="sharding", op="all_gather") if s3_r["led"] else 0)})
+    # stage-3 memory exact gate: measured state accounting == closed
+    # form byte-for-byte AND the params component sits at exactly
+    # 1/sharding_degree of the stage-2 (replicated-storage) image —
+    # the unlock that lets models outgrow one chip's HBM
+    s3_acct = s3_r["acct"]
+    s3_closed = _ml.closed_form_state_bytes(s3_eng)
+    ov_params = results["overlap"]["acct"].components.get("params", 0)
+    s3_params = s3_acct.components.get("params", 0)
+    uncovered = sum(
+        _ml.shard_bytes(p._value) for p in s3_eng.params
+        if not (s3_eng._zero.entry(p) is not None
+                and s3_eng._zero.entry(p)[1]))
+    s3_ok = (all(s3_acct.components.get(k) == v
+                 for k, v in s3_closed.items())
+             and (s3_params - uncovered) * shard_deg
+             == ov_params - uncovered)
+    _emit({"metric": "gpt13b_hybrid_stage3_mem_state_parity",
+           "value": 1.0 if s3_ok else 0.0, "unit": "pass",
+           "vs_baseline": 1.0 if s3_ok else 0.0,
+           "measured": {k: s3_acct.components.get(k) for k in s3_closed},
+           "closed_form": s3_closed,
+           "params_bytes_stage3": s3_params,
+           "params_bytes_stage2": ov_params,
+           "sharding_degree": shard_deg,
+           "analytic_drift": round(s3_acct.drift, 4)})
     # memory-ledger exact gate: the measured state accounting (shard_
     # shape path) must equal the closed form (global shape / sharding
     # degree path) byte-for-byte — incl. ZeRO stage-2 scattered state

@@ -96,7 +96,20 @@ def estimate_step_time(model: Dict, cfg: Dict, global_batch: int,
     comm_dp = 0.0 if dp * sh == 1 else \
         2 * (P / (mp * pp)) * 2 * (dp * sh - 1) / (dp * sh) / ici_bw \
         * r_dp
+    # stage-3 just-in-time param all-gather at forward entry: per
+    # participant (sh-1) x the stored shard bytes
+    # (distributed/grad_buckets.py BucketPlan.gather — the comm ledger
+    # pins the same closed form). Priced against the quantized
+    # param_gather wire when the knob compresses it. Stage 1/2's
+    # post-update shard gather moves the same bytes but overlaps the
+    # next step's forward on the donated path, so only stage 3 carries
+    # the term here — the ORDERING between stages is what pruning needs.
+    r_pg = q_ratio if (q_on and quant.get("param_gather", True)) else 1.0
+    comm_gather = 0.0
+    if cfg.get("sharding_stage", 1) >= 3 and sh > 1:
+        comm_gather = (P / (mp * pp)) * 2 * (sh - 1) / sh / ici_bw * r_pg
     # pp: bubble fraction
     acc = cfg.get("accumulate_steps", max(1, 2 * pp))
     bubble = (pp - 1) / max(1, acc + pp - 1)
-    return (compute + comm_mp + comm_dp) / max(1e-9, 1 - bubble)
+    return (compute + comm_mp + comm_dp + comm_gather) \
+        / max(1e-9, 1 - bubble)

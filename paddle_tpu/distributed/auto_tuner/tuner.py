@@ -40,7 +40,8 @@ def _factorizations(n: int, dims: int):
 def default_candidates(num_devices: int, model: Dict,
                        global_batch: int,
                        tune_sharding: bool = True,
-                       tune_quant_comm: bool = False) -> List[Dict]:
+                       tune_quant_comm: bool = False,
+                       tune_sharding_stage: bool = True) -> List[Dict]:
     """Valid (dp, mp, pp, sharding, micro) configs for the device count,
     pruned by divisibility (reference prune.py rules).
 
@@ -49,7 +50,15 @@ def default_candidates(num_devices: int, model: Dict,
     (``quant_comm={"dtype": "int8", ...}`` — distributed/quant_comm.py;
     the cost model prices both the ~0.26x wire bytes and the f32
     error-feedback residual HBM, so quantized configs rank/prune on
-    their real trade)."""
+    their real trade).
+
+    ``tune_sharding_stage``: additionally emit each sharding-bearing
+    config with ``sharding_stage=3`` (ZeRO-3 shard-only parameter
+    storage + just-in-time gather, engine._ZeroPlan store_sharded):
+    the memory model divides param+grad bytes by the sharding degree
+    and the cost model prices the per-step (sh-1)/sh param all-gather,
+    so stage 3 surfaces exactly when the stage-2 image doesn't fit —
+    the real scale axis the search must be able to reach."""
     heads = model.get("num_heads", 1)
     layers = model["num_layers"]
     vocab = model.get("vocab_size", 0)
@@ -76,6 +85,10 @@ def default_candidates(num_devices: int, model: Dict,
                    "sharding_degree": sh, "micro_batch_size": micro,
                    "accumulate_steps": per_rank // micro}
             out.append(cfg)
+            # stage-3 variant only where a sharding group exists to
+            # scatter the parameter image over
+            if tune_sharding_stage and sh > 1:
+                out.append(dict(cfg, sharding_stage=3))
             # quantized variant only where there is comm to compress
             if tune_quant_comm and (dp * sh > 1 or mp > 1):
                 out.append(dict(cfg, quant_comm={
@@ -100,7 +113,8 @@ class AutoTuner:
                  seq_len: int, hbm_gb: float = 95.0,
                  peak_flops: float = 459e12, recompute: bool = False,
                  candidates: Optional[List[Dict]] = None,
-                 max_trials: int = 16, tune_quant_comm: bool = False):
+                 max_trials: int = 16, tune_quant_comm: bool = False,
+                 tune_sharding_stage: bool = True):
         self.model = model
         self.num_devices = num_devices
         self.global_batch = global_batch
@@ -110,6 +124,7 @@ class AutoTuner:
         self.recompute = recompute
         self.max_trials = max_trials
         self.tune_quant_comm = tune_quant_comm
+        self.tune_sharding_stage = tune_sharding_stage
         self.history: List[Dict] = []
         self._candidates = candidates
 
@@ -118,7 +133,8 @@ class AutoTuner:
         if self._candidates is None:
             self._candidates = default_candidates(
                 self.num_devices, self.model, self.global_batch,
-                tune_quant_comm=self.tune_quant_comm)
+                tune_quant_comm=self.tune_quant_comm,
+                tune_sharding_stage=self.tune_sharding_stage)
         return self._candidates
 
     def pruned(self) -> List[Dict]:

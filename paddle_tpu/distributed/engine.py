@@ -96,7 +96,18 @@ class _ZeroPlan:
     Stage 1/2 ("os"/"os_g"): optimizer states (and the update math) are
     sharded; params stay replicated across 'sharding'.
     Stage 3 ("p_g_os"):   params are *stored* sharded and all-gathered
-    at step entry (donated buffers keep peak memory at shard size).
+    just-in-time at forward entry (donated buffers keep persistent
+    memory at shard size — per-device model-state bytes land at
+    1/sharding_degree exactly, the memledger closed form). Selected by
+    ``sharding_configs["sharding_stage"] = 3`` (the strategy surface),
+    the per-param ``_zero3`` marker (group_sharded_parallel "p_g_os"),
+    or quant_comm's param_gather (see ``store_sharded`` below). The
+    gather runs through the comm_overlap bucket plan when one exists
+    (grad_buckets.BucketPlan.gather — coalesced per signature bucket,
+    the stacked-params seam as a scan_trips-exact lax.scan), else per
+    parameter; the grads keep flowing through EXACTLY the stage-2
+    reduce-scatter path, which is what makes stage-3 loss/params
+    bit-match stage-2 (pinned by tests/bench).
 
     ``row_dims`` (the per-bucket ZeRO plan): {id(param): k} marking k
     leading stacked-layer dims the shard-dim search must skip — set
@@ -120,7 +131,7 @@ class _ZeroPlan:
         axis = getattr(optimizer, "state_partition_axis", None) \
             if optimizer is not None else None
         stage3 = any(getattr(p, "_zero3", False) for p in trainable)
-        if stage3 and axis is None:
+        if (stage3 or store_sharded) and axis is None:
             axis = "sharding"
         self.axis = axis
         self.n = (mesh.shape[axis]
@@ -310,7 +321,8 @@ class ParallelEngine:
                  comm_overlap: Optional[bool] = None,
                  comm_buffer_size_mb: Optional[float] = None,
                  mem_ledger: Optional[bool] = None,
-                 quant_comm=None):
+                 quant_comm=None, sharding_stage: Optional[int] = None,
+                 stage3_release_after_forward: Optional[bool] = None):
         import os
 
         from . import grad_buckets as _gb
@@ -417,11 +429,26 @@ class ParallelEngine:
         self._quant_residuals: Dict[str, Any] = {}
         self._quant_specs: Dict[str, P] = {}
         self._pending_qnorm = None
+        # ZeRO sharding stage (distributed_strategy sharding_configs,
+        # or the explicit constructor override): stage 3 stores every
+        # plan entry's param shard-only and gathers just-in-time at
+        # forward entry; stage3_release_after_forward picks the gather
+        # grain (True = per signature bucket / seam scan through the
+        # comm_overlap plan, False = per-parameter entry wave). Both
+        # are exact data movement — same bytes on the wire, same
+        # values, different node granularity.
+        cfg_stage, cfg_rel = _gb.stage_config()
+        self._sharding_stage = int(cfg_stage if sharding_stage is None
+                                   else sharding_stage)
+        self._stage3_release = bool(
+            cfg_rel if stage3_release_after_forward is None
+            else stage3_release_after_forward)
         self._zero = _ZeroPlan(
             mesh, self.trainable, optimizer,
             row_dims=self._seam_row_dims if self._overlap_on else None,
             store_sharded=bool(self._quant_cfg.enabled
-                               and self._quant_cfg.param_gather))
+                               and self._quant_cfg.param_gather)
+            or self._sharding_stage >= 3)
         # LazyGuard-built params materialize straight into their (zero3-
         # aware) storage sharding: O(shard) bytes per process, no full-
         # size init anywhere
@@ -625,16 +652,37 @@ class ParallelEngine:
                                                   pg_cfg)
             return C.t_all_gather(v, zero.axis, axis=dim, tiled=True)
 
+        # stage-3 stored-sharded params (store_sharded plan entries):
+        # gathered just-in-time at forward entry. With a bucket plan
+        # and the release knob on, the gather goes through the SAME
+        # signature buckets the backward scatters grads through
+        # (grad_buckets.BucketPlan.gather — coalesced flat all_gather
+        # per bucket, the stacked seam as a scan_trips-exact lax.scan,
+        # quantized wire + own-shard splice under quant_comm's
+        # param_gather); otherwise one per-parameter gather wave. Both
+        # are exact data movement, so the wire bytes and the resulting
+        # values are identical — only the node granularity differs.
+        s3_gather = [(i, zero.entry(p)[0]) for i, p in enumerate(params)
+                     if zero.entry(p) is not None and zero.entry(p)[1]]
+        s3_bucketed = bool(s3_gather) and bucket_plan is not None \
+            and self._stage3_release
+
         def _step_inner(pvals, svals, mvals, qvals, batch, lr, stepc,
                         amp_in):
             # ZeRO-3 params arrive as shards: all-gather for the forward,
             # but keep the stored shard for the optimizer update
             pshards = pvals
             pvals = list(pvals)
-            for i, p in enumerate(params):
-                e = zero.entry(p)
-                if e is not None and e[1]:
-                    pvals[i] = _zero_gather(pvals[i], e[0])
+            if s3_gather:
+                gathered = {}
+                if s3_bucketed:
+                    gathered = bucket_plan.gather(
+                        {id(params[i]): pvals[i] for i, _ in s3_gather},
+                        qcfg=pg_cfg)
+                for i, d in s3_gather:
+                    pid = id(params[i])
+                    pvals[i] = gathered[pid] if pid in gathered \
+                        else _zero_gather(pvals[i], d)
             pvals = tuple(pvals)
             # MoE routing telemetry: collect the traced expert-load /
             # drop stats each MoELayer records during the forward, to be

@@ -45,8 +45,25 @@ _HYBRID_DEFAULTS = {
     # comm_buffer_size_MB targets the per-bucket payload
     # (distributed/grad_buckets.py). Bit-exact loss/param parity vs
     # the unbucketed path.
+    # sharding_stage (reference group_sharded levels os/os_g/p_g_os):
+    # 1/2 shard optimizer state (and scatter grads) over 'sharding';
+    # 3 additionally stores PARAMETERS shard-only (dim-0 scattered over
+    # the sharding group, engine._ZeroPlan store_sharded) and
+    # all-gathers them just-in-time at forward entry — per signature
+    # bucket when comm_overlap's plan exists (the T3 mirror of the
+    # backward reduce-scatter; the pp stacked-params seam gathers as a
+    # lax.scan with scan_trips-exact ledger bytes), per parameter
+    # otherwise. stage3_release_after_forward picks the gather grain:
+    # True (default) = the bucketed just-in-time schedule, each
+    # bucket's full image an independent XLA temp released after its
+    # last (backward) use; False = one per-parameter gather wave at
+    # step entry, the whole image alive across the step (the stage-2
+    # style schedule, fewer/larger nodes). Both are bit-exact data
+    # movement — loss/params match stage 2 and each other.
     "sharding_configs": {"comm_overlap": False,
-                         "comm_buffer_size_MB": 25.0},
+                         "comm_buffer_size_MB": 25.0,
+                         "sharding_stage": 2,
+                         "stage3_release_after_forward": True},
     # quant_comm: int8 (or fp8 e4m3) wire compression for the grad
     # reduce-scatter/pmean buckets (grad_sync — rides comm_overlap's
     # bucket plan, with a per-bucket error-feedback residual carried as

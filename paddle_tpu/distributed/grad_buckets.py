@@ -1,4 +1,5 @@
-"""Bucketed gradient sync: T3-style eager per-bucket DP/sharding comm.
+"""Bucketed bidirectional collectives: T3-style eager per-bucket grad
+scatter in backward AND just-in-time ZeRO-3 param gather in forward.
 
 The engine's unbucketed step computes the ENTIRE backward and only then
 issues one collective per parameter — so every step ends with a fully
@@ -36,15 +37,38 @@ synced values are identical to the per-parameter collectives
 regardless of grouping (tests pin loss/param parity and exact wire
 bytes: sum over buckets == the unbucketed closed form).
 
+**Stage-3 just-in-time gather** (the bidirectional half): under
+``sharding_configs["sharding_stage"] = 3`` parameters are STORED
+shard-only (engine._ZeroPlan ``store_sharded``) and
+:meth:`BucketPlan.gather` re-materializes them at forward entry through
+the SAME signature buckets the backward scatters grads through — one
+coalesced flat ``all_gather`` per flat bucket (rank-major inverse
+unpack, bit-exact vs the per-parameter tiled gather), and a
+``lax.scan`` over the seam group's nb row ticks for the pp
+stacked-params chunks, noted under ``commledger.scan_trips(nb)`` so
+the gather's wire bytes stay trips-exact like the grad scan's. The
+collective itself is the :func:`stage3_gather` ``jax.custom_vjp``
+whose backward is the mirrored ledger-shimmed reduce-scatter
+(all_gather ↔ reduce_scatter — the tpulint vjp-ledger-symmetry
+pairing), so anything that differentiates through a gathered value
+scatters its cotangent inside the ledger. quant_comm's ``param_gather``
+composes per bucket: the packed int8 payload + bf16 scales go on the
+wire once and each rank splices its OWN exact flat shard back over its
+block, so the authoritative shard state never sees compression noise
+(quant_comm.quantized_param_gather discipline, at bucket grain).
+
 Knob (reference surface: sharding comm_overlap / comm_buffer_size_MB,
 dygraph_sharding_optimizer buffer fusion):
 ``strategy.hybrid_configs["sharding_configs"]["comm_overlap"]`` with
 ``comm_buffer_size_MB`` sizing the per-bucket payload; default off.
+``sharding_stage`` / ``stage3_release_after_forward`` (read via
+``stage_config``) drive the stage-3 storage + gather grain.
 """
 from __future__ import annotations
 
 import hashlib
 from dataclasses import dataclass, field
+from functools import partial
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -54,8 +78,8 @@ from jax import lax
 
 from ..observability import commledger as _cl
 
-__all__ = ["BucketPlan", "build_plan", "strategy_config",
-           "DEFAULT_BUFFER_MB"]
+__all__ = ["BucketPlan", "build_plan", "strategy_config", "stage_config",
+           "stage3_gather", "DEFAULT_BUFFER_MB"]
 
 # same default as the eager DataParallel reducer (parallel.py): the
 # reference's fuse-buffer size
@@ -77,6 +101,22 @@ def strategy_config(strategy=None) -> Tuple[bool, float]:
             float(sc.get("comm_buffer_size_MB", DEFAULT_BUFFER_MB)))
 
 
+def stage_config(strategy=None) -> Tuple[int, bool]:
+    """(sharding_stage, stage3_release_after_forward) from the active
+    fleet strategy's ``hybrid_configs["sharding_configs"]`` (the
+    reference group-sharded level surface: 1/2 = os/os_g, 3 = p_g_os
+    shard-only parameter storage); (2, True) with no strategy."""
+    if strategy is None:
+        from . import fleet as _fleet
+
+        strategy = _fleet.get_strategy()
+    if strategy is None:
+        return 2, True
+    sc = strategy.hybrid_configs.get("sharding_configs") or {}
+    return (int(sc.get("sharding_stage", 2)),
+            bool(sc.get("stage3_release_after_forward", True)))
+
+
 # ---------------------------------------------------------------------------
 # the static plan
 # ---------------------------------------------------------------------------
@@ -92,10 +132,12 @@ class BucketEntry:
     dtype: str
     shard_dim: Optional[int]     # ZeRO scatter dim (local coords)
     row_dims: int                # leading stacked-layer dims (seam)
+    stored: bool = False         # stage-3: param STORED shard-only, the
+    #                              forward gathers it through this bucket
 
     def describe(self) -> Tuple:
         return (self.index, self.shape, self.dtype, self.shard_dim,
-                self.row_dims)
+                self.row_dims, self.stored)
 
 
 @dataclass
@@ -269,6 +311,55 @@ class BucketPlan:
             gsq = gsq + sq
         return synced, gsq, new_res
 
+    # -- stage-3 just-in-time param gather (the T3 mirror) ---------------
+    def gather(self, shards: Dict[int, Any], qcfg=None) -> Dict[int, Any]:
+        """All-gather stage-3 stored-sharded params through the same
+        signature buckets the backward scatters their grads through.
+
+        ``shards`` maps pid -> the STORED (dim-``shard_dim`` scattered)
+        param value for every covered stage-3 entry; returns pid -> the
+        gathered FULL value, bit-exact vs a per-parameter tiled
+        ``all_gather`` on the same dim (the coalesced wire is pure data
+        movement — rank-major pack, inverse unpack). Flat buckets issue
+        one coalesced flat gather each (an independent dataflow node,
+        so XLA's latency-hiding scheduler overlaps it with neighboring
+        buckets' forward compute); seam groups run the gather as a
+        ``lax.scan`` over their nb row ticks under
+        ``commledger.scan_trips(nb)``, so ledger gather bytes stay
+        EXACT — (p-1) x shard bytes per step, trips included.
+
+        ``qcfg``: quant_comm's param_gather config (or None = full
+        precision). Quantized, each bucket packs its flat shard once
+        (int8/fp8 + bf16 scales), gathers the pair, and splices this
+        rank's OWN exact shard back over its block — other ranks'
+        working copies carry one quantization of noise, regenerated
+        from exact shards every step; the authoritative state never
+        does."""
+        out: Dict[int, Any] = {}
+        for g in self.groups:
+            if g.kind != "rs":
+                continue
+            entries = [e for e in g.entries
+                       if e.stored and e.pid in shards]
+            if not entries:
+                continue
+            if g.seam:
+                if len(entries) != len(g.entries):
+                    continue    # engine falls back per-param
+                out.update(_gather_seam_group(g, shards, qcfg=qcfg))
+            else:
+                for bucket in g.buckets:
+                    bt = [e for e in bucket
+                          if e.stored and e.pid in shards]
+                    if not bt:
+                        continue
+                    outs = _gather_bucket(
+                        [(shards[e.pid], e.shard_dim) for e in bt],
+                        g.n, g.axis, qcfg=qcfg)
+                    for e, o in zip(bt, outs):
+                        out[e.pid] = o
+        return out
+
 
 # ---------------------------------------------------------------------------
 # plan construction (host-side, static shapes only)
@@ -345,11 +436,13 @@ def build_plan(trainable: Sequence, mesh, zero, gmean_axes, data_axes,
         row_dims = int(seam_row_dims.get(id(p), 0))
         lshape = _local_shape(p._value.shape, param_spec_fn(p), mesh)
         dtype = str(p._value.dtype)
+        stored = False
         if e is not None and zero.axis in data_axes:
             kind = "rs"
             pm = tuple(a for a in gmean_axes if a != zero.axis)
             dup = 1
             shard_dim: Optional[int] = int(e[0])
+            stored = bool(e[1])
             gnorm = _mesh_axes(spec_axes | {zero.axis})
         elif e is not None:
             continue     # legacy local-slice fallback stays unbucketed
@@ -365,7 +458,10 @@ def build_plan(trainable: Sequence, mesh, zero, gmean_axes, data_axes,
                 continue  # nothing to sync — leave alone
             gnorm = _mesh_axes(spec_axes)
         seam = row_dims > 0
-        key = (kind, seam, pm, extra, dup, dtype, gnorm,
+        # `stored` joins the signature so every bucket is homogeneous:
+        # a bucket either gathers its params at forward entry (stage-3
+        # storage) or holds replicated ones — never a mix
+        key = (kind, seam, pm, extra, dup, dtype, gnorm, stored,
                row_dims if seam else 0,
                lshape[:row_dims] if seam else ())
         if key not in sigs:
@@ -376,7 +472,7 @@ def build_plan(trainable: Sequence, mesh, zero, gmean_axes, data_axes,
             order.append(key)
         sigs[key].entries.append(BucketEntry(
             pid=id(p), index=index, shape=lshape, dtype=dtype,
-            shard_dim=shard_dim, row_dims=row_dims))
+            shard_dim=shard_dim, row_dims=row_dims, stored=stored))
 
     groups: List[BucketGroup] = []
     for key in order:
@@ -626,3 +722,130 @@ def _sync_seam_group(g: BucketGroup, grads: Dict[int, Any], qcfg=None,
         synced[e.pid] = out.reshape(tuple(rows_shape)
                                     + tuple(y.shape[2:]))
     return synced, gsq, (new_resid if use_ef else None)
+
+
+# ---------------------------------------------------------------------------
+# trace-time stage-3 bucket gather kernels (the forward mirror)
+# ---------------------------------------------------------------------------
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(1,))
+def stage3_gather(flat, axis: str):
+    """The stage-3 bucket all-gather: flat shard [L] -> rank-major
+    [p*L] (rank r's block at r*L). A ``jax.custom_vjp`` so the
+    backward exchange is the mirrored ledger-shimmed reduce-scatter
+    (all_gather ↔ reduce_scatter, the vjp-ledger-symmetry pairing) —
+    jax's default all_gather transpose would call raw ``lax`` and run
+    outside the comm ledger."""
+    from . import collective as C
+
+    return C.t_all_gather(flat, axis, axis=0, tiled=True)
+
+
+def _stage3_gather_fwd(flat, axis: str):
+    return stage3_gather(flat, axis), None
+
+
+def _stage3_gather_bwd(axis: str, _res, g):
+    from . import collective as C
+
+    return (C.t_psum_scatter(g, axis, scatter_dimension=0, tiled=True),)
+
+
+stage3_gather.defvjp(_stage3_gather_fwd, _stage3_gather_bwd)
+
+
+def _unpack_gathered(rows, vals_dims, n: int):
+    """Inverse of ``_rank_major`` on a gathered ``[n, L]`` view: slice
+    each param's per-rank chunks and fold the rank axis back into its
+    scatter dim — bit-exact vs the per-parameter tiled all_gather
+    (rank r's block lands at full[d] rows r*loc:(r+1)*loc)."""
+    outs, off = [], 0
+    for shard, d in vals_dims:
+        s = tuple(shard.shape)
+        m = int(np.prod(s))
+        blk = rows[:, off:off + m].reshape((n,) + s)
+        off += m
+        gr = jnp.moveaxis(blk, 0, d)
+        outs.append(gr.reshape(s[:d] + (s[d] * n,) + s[d + 1:]))
+    return outs
+
+
+def _gather_bucket(vals_dims, n: int, axis: str, qcfg=None):
+    """One stage-3 bucket's just-in-time param gather: the bucket's
+    stored shards coalesce into one flat buffer, all_gather once
+    (``stage3_gather``), unpack per param. ``vals_dims``:
+    [(stored shard value, scatter dim in the shard's coords)].
+
+    Quantized wire (``qcfg`` = quant_comm param_gather): each param's
+    flat shard packs on its OWN chunk lattice (pack_block — the exact
+    per-parameter codec, so quantization values match the
+    quantized_param_gather path bit-for-bit), the packed payloads and
+    bf16 scale sidecars concatenate into ONE gathered pair per bucket,
+    and this rank's exact flat shard splices back over its block — the
+    authoritative path never sees compression noise and the bucket
+    still ships as a single pair of collectives."""
+    flat = jnp.concatenate([v.reshape(-1) for v, _ in vals_dims])
+    L = int(flat.shape[0])
+    if qcfg is None:
+        rows = stage3_gather(flat, axis).reshape(n, L)
+    else:
+        from . import collective as C
+        from . import quant_comm as _qc
+
+        packs = [_qc.pack_block(v, qcfg) for v, _ in vals_dims]
+        qcat = jnp.concatenate([q.reshape(-1) for q, _ in packs])
+        scat = jnp.concatenate([s.reshape(-1) for _, s in packs])
+        ratio = (int(qcat.shape[0]) * _qc.WIRE_ITEMSIZE
+                 + int(scat.shape[0]) * _qc.SCALE_BYTES) \
+            / float(L * np.dtype(flat.dtype).itemsize)
+        qg, sg = _qc.gather_packed(qcat, scat, (axis,), ratio)
+
+        def _deq(j):
+            outs, qo, so = [], 0, 0
+            for (q, s), (v, _) in zip(packs, vals_dims):
+                m, nc = int(q.shape[0]), int(s.shape[0])
+                outs.append(_qc.unpack_block(
+                    qg[j, qo:qo + m], sg[j, so:so + nc],
+                    (int(np.prod(v.shape)),), flat.dtype, qcfg))
+                qo += m
+                so += nc
+            return jnp.concatenate(outs)
+
+        rows = jnp.stack([_deq(j) for j in range(n)])
+        idx = C.axis_index((axis,))
+        rows = lax.dynamic_update_slice_in_dim(rows, flat[None], idx,
+                                               axis=0)
+    return _unpack_gathered(rows, vals_dims, n)
+
+
+def _gather_seam_group(g: BucketGroup, shards: Dict[int, Any],
+                       qcfg=None) -> Dict[int, Any]:
+    """The seam group's param gather as a scan over the SAME nb ticks
+    of R rows the grad sync scatters through: tick i gathers rows
+    [i*R, (i+1)*R) of every stacked param's shard, so the gather rides
+    the pipeline chunk seam and the ledger records carry trips=nb
+    (commledger.scan_trips) — byte accounting stays exact, mirroring
+    ``_sync_seam_group``."""
+    nb, R = g.nb, g.R
+    xs, dims = [], []
+    for e in g.entries:
+        arr = shards[e.pid]
+        tail = tuple(arr.shape[e.row_dims:])
+        # scatter dim in tick coords: row dims collapse to one leading
+        # R axis (same geometry as the grad scan)
+        dims.append(e.shard_dim - e.row_dims + 1)
+        xs.append(arr.reshape((nb, R) + tail))
+
+    def tick(carry, xt):
+        outs = _gather_bucket(list(zip(xt, dims)), g.n, g.axis,
+                              qcfg=qcfg)
+        return carry, tuple(outs)
+
+    with _cl.scan_trips(nb):
+        _, ys = lax.scan(tick, jnp.float32(0.0), tuple(xs))
+    full: Dict[int, Any] = {}
+    for e, y in zip(g.entries, ys):
+        rows_shape = e.shape[:e.row_dims]
+        full[e.pid] = y.reshape(tuple(rows_shape) + tuple(y.shape[2:]))
+    return full

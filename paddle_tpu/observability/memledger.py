@@ -35,7 +35,10 @@ per-program fact; this module does the same for HBM, in three layers:
    gauge that finally validates the tuner's ``hbm_gb`` pruning against
    reality. ``closed_form_state_bytes`` recomputes params/state from
    GLOBAL shapes divided by sharding degrees (an independent
-   derivation) for the exact parity gates.
+   derivation) for the exact parity gates — including ZeRO stage-3
+   shard-only parameter storage, whose params component must land at
+   exactly 1/sharding_degree of the replicated image (the
+   ``gpt13b_hybrid_stage3_mem_state_parity`` bench gate).
 
 3. **Roofline verdict** (``roofline`` -> ``RooflineReport``): joins
    the flop accountant (flops.py peak tables), the comm ledger (wire
@@ -326,7 +329,12 @@ def account_engine(engine, batch_tokens: int = 0,
         g["params"] += pb
         if getattr(p, "trainable", True):
             # transient backward grads live at the param's spec shard
-            # (before any ZeRO scatter); dtype follows the param
+            # (before any ZeRO scatter); dtype follows the param. For
+            # stage-3 stored-sharded params pb is already the 1/sh
+            # scatter shard — matching the cost model's grad_bytes/sh
+            # (the eager per-bucket scatter keeps full grads transient
+            # at bucket grain), so the analytic drift stays flat when
+            # the stage knob flips
             comp["grads"] += pb
         st = states.get(id(p))
         if st:

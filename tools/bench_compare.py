@@ -56,6 +56,16 @@ _EXACT = {"pallas_kernel_parity_interpret": 1.0,
           # comm_overlap (bucketed grad sync) vs unbucketed on the same
           # program: bit-exact coalescing, <= 1e-5 gated — never drifts
           "gpt13b_hybrid_overlap_loss_parity": 1.0,
+          # ZeRO stage-3 (shard-only params + bucketed just-in-time
+          # gather) vs the stage-2 overlap line: the gather is pure
+          # data movement, so the trajectory must match bit-on AND the
+          # ledger's gather bytes must equal the (p-1) x shard closed
+          # form (scan_trips-exact on the stacked seam) — never drifts
+          "gpt13b_hybrid_stage3_loss_parity": 1.0,
+          # stage-3 memory: measured state accounting == closed form
+          # byte-for-byte, with the params component at exactly
+          # 1/sharding_degree of the stage-2 replicated image
+          "gpt13b_hybrid_stage3_mem_state_parity": 1.0,
           # memory ledger: measured state accounting (shard_shape path)
           # == closed form (global shape / sharding degree), byte-for-
           # byte incl. ZeRO-2 scattered state + pp x vpp chunks
