@@ -635,6 +635,187 @@ def bench_serving_chunked(on_tpu, dev):
 
 
 # ---------------------------------------------------------------------------
+# 5e. Prefix-cache sharing + speculative decoding on the multi-tenant
+# trace (the PR-16 serving lines): MANY users share a FEW long system
+# prompts, so most arrivals' leading pages are already resident in the
+# paged pool. The SAME Poisson trace is served three times — prefix
+# cache ON, prefix cache OFF (the TTFT baseline), and prefix+spec ON
+# (greedy draft-verify riding the unified [B, Sc] lattice) — and the
+# JSON lines carry cache hit rate (ledger-exact fed+skipped
+# accounting), TTFT p50/p99 on vs off, committed tokens per verify
+# step, the exact three-way output-parity gate, and recompiles pinned
+# at 0 for every mode (neither feature adds a program shape).
+# ---------------------------------------------------------------------------
+def bench_serving_prefix_spec(on_tpu, dev):
+    import paddle_tpu as paddle
+    from paddle_tpu.inference import Config, ServingEngine, \
+        create_predictor
+    from paddle_tpu.models.llama import (LlamaConfig, LlamaForCausalLM,
+                                         llama_7b)
+
+    old_dtype = paddle.get_default_dtype()
+    if on_tpu:
+        paddle.set_default_dtype("bfloat16")
+        cfg = llama_7b(max_position_embeddings=1024, dtype="bfloat16")
+        page, B, Sc, k = 128, 8, 256, 4
+        n_sys, sys_pages = 3, 4          # 3 system prompts x 512 tok
+        n_users, tail_lo, tail_hi, n_new = 24, 32, 96, 32
+        rate = 1.0
+    else:
+        cfg = LlamaConfig(vocab_size=256, hidden_size=64, num_layers=2,
+                          num_heads=4, num_kv_heads=2,
+                          intermediate_size=128,
+                          max_position_embeddings=256)
+        page, B, Sc, k = 8, 4, 16, 3
+        n_sys, sys_pages = 3, 4          # 3 system prompts x 32 tok
+        n_users, tail_lo, tail_hi, n_new = 18, 4, 12, 8
+        rate = 0.8
+    try:
+        paddle.seed(0)
+        model = LlamaForCausalLM(cfg)
+        conf = Config().set_model(model).enable_paged_kv(page_size=page)
+        if on_tpu:
+            conf.enable_weight_only("weight_only_int8")
+        pred = create_predictor(conf)
+        # self-speculation draft (draft == target): the acceptance
+        # CEILING, so tokens/step approaches k+1 while the propose /
+        # verify / commit machinery (and its latency) stays realistic;
+        # a distilled draft plugs into the same knob on chip
+        dpred = create_predictor(
+            Config().set_model(model).enable_paged_kv(page_size=page))
+        r = np.random.RandomState(16)
+
+        # multi-tenant trace: every request = one of n_sys shared
+        # system prompts + a short unique user tail, Poisson arrivals
+        sys_prompts = [r.randint(1, cfg.vocab_size,
+                                 (sys_pages * page,))
+                       for _ in range(n_sys)]
+        gaps = r.exponential(1.0 / rate, n_users)
+        trace = []
+        for t in np.cumsum(gaps):
+            sysp = sys_prompts[r.randint(n_sys)]
+            tail = r.randint(1, cfg.vocab_size,
+                             (r.randint(tail_lo, tail_hi),))
+            trace.append((float(t), np.concatenate([sysp, tail])))
+        total_prompt_tok = sum(len(p) for _, p in trace)
+
+        def serve(prefix, spec):
+            eng = ServingEngine(
+                pred, max_batch=B, prefill_chunk=Sc,
+                prefix_cache=prefix,
+                draft_predictor=dpred if spec else None,
+                spec_tokens=k if spec else 0)
+            # warmup: one multi-chunk + one sub-chunk prompt through
+            # every program shape (chunk feed, decode verify, propose)
+            for L in (sys_pages * page + tail_lo, page - 2):
+                eng.submit(r.randint(1, cfg.vocab_size, (L,)),
+                           max_new_tokens=3)
+            eng.run()
+            warm = eng.stats.compiles
+            rids, i, rnd = [], 0, 0
+            t0 = time.perf_counter()
+            while i < len(trace) or eng.queue or eng.num_active:
+                while i < len(trace) and trace[i][0] <= rnd:
+                    rids.append(eng.submit(trace[i][1],
+                                           max_new_tokens=n_new))
+                    i += 1
+                eng.step()
+                rnd += 1
+            dt = max(time.perf_counter() - t0, 1e-4)
+            fin = [eng.finished[rid] for rid in rids]
+            ttfts = [q.t_first_token - q.t_submit for q in fin
+                     if q.t_first_token]
+            n_tok = sum(len(q.new_tokens) for q in fin)
+            return eng, [tuple(q.new_tokens) for q in fin], {
+                "ttft_p50_ms": round(float(np.percentile(ttfts, 50))
+                                     * 1e3, 3),
+                "ttft_p99_ms": round(float(np.percentile(ttfts, 99))
+                                     * 1e3, 3),
+                "tokens_per_sec": round(n_tok / dt, 2),
+                "recompiles_after_warmup": eng.stats.compiles - warm,
+                "rounds": rnd,
+            }
+
+        eng_on, out_on, on = serve(prefix=True, spec=False)
+        eng_off, out_off, off = serve(prefix=False, spec=False)
+        eng_sp, out_sp, sp = serve(prefix=True, spec=True)
+        # the compile gate: neither the cache (block-table surgery on
+        # the host) nor spec decode (fixed propose/verify shapes) may
+        # add a post-warmup program in ANY mode
+        assert on["recompiles_after_warmup"] == 0, on
+        assert off["recompiles_after_warmup"] == 0, off
+        assert sp["recompiles_after_warmup"] == 0, sp
+
+        pfx = eng_on.prefix_cache_stats()
+        hit_rate = pfx["hits"] / max(pfx["lookups"], 1)
+        # ledger-exact accounting: every prompt token was either FED
+        # through a prefill chunk or SKIPPED via a cache hit — the two
+        # ledgers must partition the trace exactly (warmup excluded:
+        # stats are read before the measured phase only for fed/skip
+        # deltas; here both ledgers include warmup's fed tokens, so
+        # add them to the closed form)
+        warm_tok = (sys_pages * page + tail_lo) + (page - 2)
+        ledger_exact = (pfx["fed_tokens"] + pfx["skipped_tokens"]
+                        == total_prompt_tok + warm_tok)
+        _emit({
+            "metric": "serving_prefix_ttft_p50_ms",
+            "value": on["ttft_p50_ms"],
+            "unit": "ms",
+            # the gate: mapping cached pages must cut time-to-first-
+            # token vs re-prefilling the shared prefix every arrival
+            "vs_baseline": round(off["ttft_p50_ms"]
+                                 / max(on["ttft_p50_ms"], 1e-9), 4),
+            "prefix_on": on, "prefix_off": off,
+            "cache_hit_rate": round(hit_rate, 4),
+            "skipped_tokens": pfx["skipped_tokens"],
+            "fed_tokens": pfx["fed_tokens"],
+            "ledger_exact": bool(ledger_exact),
+            "cow_copies": pfx["cow"], "pages_reclaimed": pfx["reclaimed"],
+            "system_prompts": n_sys, "users": n_users,
+            "prefix_pages": sys_pages, "page_size": page,
+            "prefill_chunk": Sc, "batch": B,
+            "telemetry": _telemetry_section(),
+            "device": str(getattr(dev, "device_kind", dev.platform)),
+        })
+        _emit({
+            "metric": "serving_prefix_cache_hit_rate",
+            "value": round(hit_rate, 4), "unit": "ratio",
+            # acceptance floor from the trace construction: with 3
+            # system prompts over 18+ users, most lookups must hit
+            "vs_baseline": round(hit_rate / 0.5, 4),
+            "hits": pfx["hits"], "lookups": pfx["lookups"],
+            "ledger_exact": bool(ledger_exact)})
+
+        spec = eng_sp.spec_stats()
+        _emit({
+            "metric": "serving_spec_tokens_per_step",
+            "value": round(spec["tokens_per_step"], 4),
+            "unit": "tokens/step",
+            # plain decode commits exactly 1 token per row-step; the
+            # draft-verify lattice must beat that at its acceptance
+            "vs_baseline": round(spec["tokens_per_step"], 4),
+            "accept_rate": round(spec["accept_rate"], 4),
+            "proposed": spec["proposed"], "accepted": spec["accepted"],
+            "spec_tokens": k, "draft": "self (acceptance ceiling)",
+            "spec_run": sp})
+
+        # the exactness gate (bench_compare _EXACT): greedy spec decode
+        # and prefix-cache sharing are both REORDERINGS of the same
+        # computation, so all three serves of the same trace must emit
+        # identical token streams, with the fed+skipped ledger closed
+        ok = (out_on == out_off == out_sp) and ledger_exact \
+            and hit_rate > 0.5
+        _emit({"metric": "serving_prefix_spec_parity",
+               "value": 1.0 if ok else 0.0, "unit": "pass",
+               "vs_baseline": 1.0 if ok else 0.0,
+               "outputs_equal": bool(out_on == out_off == out_sp),
+               "ledger_exact": bool(ledger_exact),
+               "hit_rate_gt_half": bool(hit_rate > 0.5)})
+    finally:
+        paddle.set_default_dtype(old_dtype)
+
+
+# ---------------------------------------------------------------------------
 # 3. GPT-13B hybrid TP x PP x DP + GroupSharded stage2 (BASELINE row 3).
 # Needs >= 8 chips; on one chip it reports the requirement cleanly, and
 # on the CPU harness it runs the FULL hybrid code path on tiny shapes
@@ -1554,12 +1735,14 @@ _BENCHES = {}
 # each + headline printed last = one hang, zero lines).
 _TIMEOUTS = {"gpt": 900, "llama_decode": 420, "llama_decode_int8": 420,
              "llama_decode_ragged": 420, "serving": 420,
-             "serving_chunked": 600, "resnet": 300,
+             "serving_chunked": 600, "serving_prefix_spec": 600,
+             "resnet": 300,
              "moe": 300, "gpt_moe_hybrid": 420, "gpt13b_hybrid": 900,
              "tp_overlap": 240, "kernel_parity": 240,
              "ckpt_overlap": 420}
 _ORDER = ("gpt", "llama_decode", "llama_decode_int8",
-          "llama_decode_ragged", "serving", "serving_chunked", "resnet",
+          "llama_decode_ragged", "serving", "serving_chunked",
+          "serving_prefix_spec", "resnet",
           "moe", "gpt_moe_hybrid", "gpt13b_hybrid", "ckpt_overlap",
           "tp_overlap", "kernel_parity")
 # benches that need a virtual multi-device mesh on the CPU fallback
@@ -1687,6 +1870,7 @@ def main(argv):
                     llama_decode_ragged=bench_llama_decode_ragged,
                     serving=bench_serving_mixed,
                     serving_chunked=bench_serving_chunked,
+                    serving_prefix_spec=bench_serving_prefix_spec,
                     gpt_moe_hybrid=bench_gpt_moe_hybrid,
                     gpt13b_hybrid=bench_gpt13b_hybrid,
                     ckpt_overlap=bench_ckpt_overlap,

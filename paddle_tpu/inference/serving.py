@@ -49,6 +49,39 @@ hoards pages it cannot use yet; a page-starved engine preempts the
 youngest mid-prefill row (no tokens sampled yet — restart is exact)
 back to the queue head rather than deadlock.
 
+PREFIX CACHE (``prefix_cache=True``, chunked mode): chunk frontiers
+land exactly on page boundaries (Sc is a multiple of the page size),
+so a completed page holds the KV of one page-aligned prompt chunk and
+nothing else. Physical pages become ref-counted and content-addressed:
+a rolling hash per page-aligned prompt chunk keys completed pages, and
+admission maps the longest cached prefix straight into the new slot's
+block table (refcount++, ZERO copies, zero FLOPs — the Ragged Paged
+Attention indirection makes a shared page addressable from any row).
+``_plan_chunks`` then starts prefill at the first cold chunk, so
+fleets sharing a system prompt skip its prefill entirely. Registered
+pages are IMMUTABLE; page-aligned frontiers mean the only write that
+can ever land in a hit page is the full-prompt-hit refeed of the last
+prompt token, which copy-on-writes that one page first (one compiled
+dynamic-slice copy program, traced src/dst — no recompiles). Release
+paths (_finish / _preempt_youngest) decrement refcounts; idle cached
+pages park on an LRU the allocator reclaims under pool pressure, so
+the cache yields memory before anything stalls.
+
+SPECULATIVE DECODING (``draft_predictor`` + ``spec_tokens=k``, greedy
+chunked mode): a small draft model proposes k greedy tokens per decode
+row (one fused [B, 1]-step scan against draft KV pools that SHARE the
+engine's page tables and allocator — prefix hits and CoW cover the
+draft for free), and ONE verify dispatch on the existing unified
+[B, Sc] lattice scores all k+1 positions per row (argmax at every
+slot instead of the last — same shapes, zero new program geometries).
+The host accepts the longest proposal prefix that matches the
+target's own greedy argmax chain and commits accepted+1 tokens per
+round, so decode needs ~1/(accepted+1) of the device rounds while the
+committed ids stay BIT-IDENTICAL to plain greedy decode (each
+committed token equals the target argmax given exactly the committed
+history — acceptance only reorders when positions are scored, never
+what they are conditioned on).
+
 Compile stability: every program is keyed on the small fixed lattice
 (batch B, seq bucket Sb, pool bucket P). After one warmup mix, a stream
 with arbitrary length mixes triggers ZERO additional XLA compiles —
@@ -56,13 +89,14 @@ asserted via the shared ``CompileStats`` counters (``engine.stats``),
 and statically by ``tools/tpulint`` (host-sync-in-jit +
 recompile-hazard): every int reaching a ``*_fn`` factory here is either
 ``_bucket``-quantized (Sb, P) or an engine-lifetime constant (B, M,
-chunk), and the host syncs (first-token sample, chunk readback) sit
-outside the compiled scan.
+chunk, k), and the host syncs (first-token sample, chunk readback,
+accept loop) sit outside the compiled scan.
 """
 from __future__ import annotations
 
+import threading
 import time
-from collections import deque
+from collections import Counter, OrderedDict, deque
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
 
@@ -114,7 +148,8 @@ class ServingRequest:
 class _Slot:
     """Host-side state of one in-flight batch row."""
 
-    __slots__ = ("req", "pages", "pos", "state", "fed", "chunks", "seq")
+    __slots__ = ("req", "pages", "pos", "state", "fed", "chunks", "seq",
+                 "hashes", "registered", "hit_pages")
 
     def __init__(self, req: ServingRequest, pages: List[int],
                  state: str = "decode", seq: int = 0):
@@ -129,6 +164,13 @@ class _Slot:
         self.fed = 0            # prompt tokens already written
         self.chunks = 0         # chunks fed (span/telemetry index)
         self.seq = seq          # admission order (scheduler fairness)
+        # prefix-cache bookkeeping: rolling hash per page-aligned
+        # prompt chunk (None with the cache off), how many leading
+        # pages are already registered/shared, and how many arrived
+        # as cache hits at admission
+        self.hashes: Optional[List[int]] = None
+        self.registered = 0
+        self.hit_pages = 0
 
 
 class ServingEngine:
@@ -151,7 +193,11 @@ class ServingEngine:
                  admission_deadline_s: Optional[float] = None,
                  degraded_window_s: float = 30.0,
                  prefill_chunk: Optional[int] = None,
-                 prefill_token_budget: Optional[int] = None):
+                 prefill_token_budget: Optional[int] = None,
+                 prefix_cache: bool = False,
+                 draft_predictor=None, spec_tokens: int = 0,
+                 debug_invariants: bool = False):
+        import inspect
         import os
 
         from . import _bucket
@@ -180,8 +226,6 @@ class ServingEngine:
             enforce(int(prefill_chunk) >= 1, "prefill_chunk must be >= 1")
             self.Sc = min(_bucket(int(prefill_chunk), lo=self.page),
                           _bucket(self.M, lo=self.page))
-            import inspect
-
             enforce("valid" in inspect.signature(
                 predictor._model.forward).parameters,
                 "prefill_chunk needs a model whose forward accepts the "
@@ -224,6 +268,35 @@ class ServingEngine:
         self.P = _bucket(int(want), lo=8)
         self.trash = self.P - 1
         self._free_pages = list(range(self.P - 1))
+        # prefix cache: pages become ref-counted and content-
+        # addressable. _hash_page maps the rolling prompt-prefix hash
+        # of a COMPLETED page-aligned chunk to the physical page that
+        # holds its KV; _page_hash is the inverse; _lru keeps
+        # registered pages whose refcount dropped to 0 (still
+        # hit-able, reclaimed oldest-first under pool pressure). All
+        # allocator state moves under ONE re-entrant lock so the
+        # accounting stays coherent if a serving loop ever drives the
+        # engine from a thread next to the metrics exporter.
+        self.prefix = bool(prefix_cache)
+        if self.prefix:
+            enforce(self.chunked,
+                    "prefix_cache needs chunked prefill "
+                    "(prefill_chunk): cache hits are whole "
+                    "page-aligned chunks the chunk planner skips")
+        self._lock = threading.RLock()
+        self._refcount = [0] * self.P
+        self._hash_page: Dict[int, int] = {}
+        self._page_hash: Dict[int, int] = {}
+        self._lru: "OrderedDict[int, None]" = OrderedDict()
+        self._pfx = {"lookups": 0, "hits": 0, "cow": 0, "reclaimed": 0,
+                     "registered": 0, "skipped_tokens": 0,
+                     "fed_tokens": 0}
+        # debug-mode pool-accounting invariant (free + idle + live
+        # partition the pool; refcounts == slot membership) checked
+        # after every admit/finish/preempt — the free-list hardening
+        # gate for the refcount migration
+        self.debug = bool(debug_invariants) or bool(int(os.environ.get(
+            "PADDLE_TPU_SERVING_DEBUG", "0") or 0))
         shape = (self.P, mcfg.num_kv_heads, self.page, mcfg.head_dim)
         self.pools = [(jnp.zeros(shape, self._dtype),
                        jnp.zeros(shape, self._dtype))
@@ -263,6 +336,51 @@ class ServingEngine:
         self._rng = jax.random.PRNGKey(self.gen.seed)
         self._step_fns: Dict[Any, Any] = {}
         self._next_rid = 0
+        # speculative decoding: a draft model proposes spec_tokens
+        # greedy tokens per decode row; ONE verify dispatch on the
+        # SAME unified [B, Sc] lattice scores all k+1 positions per
+        # row. The draft's KV pools share the engine's page tables and
+        # allocator (same page ids, draft geometry), so prefix hits
+        # and copy-on-write cover the draft for free.
+        self.spec = int(spec_tokens or 0)
+        enforce((draft_predictor is None) == (self.spec == 0),
+                "speculative decoding needs BOTH draft_predictor and "
+                "spec_tokens >= 1 (or neither)")
+        self._draft = draft_predictor
+        if draft_predictor is not None:
+            enforce(self.chunked,
+                    "speculative decoding rides the unified chunked "
+                    "step; set prefill_chunk")
+            enforce(not self.gen.temperature,
+                    "speculative decoding is greedy-verify only "
+                    "(temperature=0): acceptance compares the draft "
+                    "against the target argmax chain")
+            enforce(self.spec >= 1 and self.spec + 1 <= self.Sc,
+                    f"spec_tokens must satisfy 1 <= k <= Sc-1 (k+1 "
+                    f"verify positions ride one [B, {self.Sc}] row)")
+            dcfg = draft_predictor._model.config
+            enforce("valid" in inspect.signature(
+                draft_predictor._model.forward).parameters,
+                "the draft model's forward must accept the unified "
+                "ragged metadata kwarg `valid` (see models/llama.py)")
+            enforce(dcfg.vocab_size == mcfg.vocab_size,
+                    "draft and target models must share a vocabulary")
+            enforce(int(dcfg.max_position_embeddings) >= self.M,
+                    "draft max_position_embeddings must cover the "
+                    "engine's max_length")
+            self._draft_dtype = draft_predictor._params[0]._value.dtype
+            dshape = (self.P, dcfg.num_kv_heads, self.page,
+                      dcfg.head_dim)
+            self.draft_pools = [(jnp.zeros(dshape, self._draft_dtype),
+                                 jnp.zeros(dshape, self._draft_dtype))
+                                for _ in range(dcfg.num_layers)]
+        self._spec = {"proposed": 0, "accepted": 0, "rounds": 0,
+                      "committed": 0}
+        if self.prefix:
+            # pre-compile the page-copy program(s) with a trash-page
+            # self-copy (a no-op write) so the first real
+            # copy-on-write after warmup costs zero compiles
+            self._copy_page(self.trash, self.trash)
         # graceful degradation: a bounded admission queue sheds at
         # submit (reason "queue_full"); a per-request admission deadline
         # sheds queued requests whose wait already blew their budget
@@ -381,15 +499,179 @@ class ServingEngine:
     def _pages_for(self, tokens: int) -> int:
         return -(-tokens // self.page)
 
-    def _admit_need(self, req: ServingRequest) -> int:
-        """Pages admission must secure. Legacy: the request's whole
-        len+new footprint (held admission→eviction). Chunked: only the
-        FIRST chunk's pages — the rest are reserved incrementally as
-        chunks feed (_plan_chunks), so a long prompt no longer blocks
-        admission of short requests the free list could serve today."""
-        if self.chunked:
-            return self._pages_for(min(len(req.prompt), self.Sc))
-        return self._pages_needed(len(req.prompt), req.max_new_tokens)
+    # -- page accounting (ref-counted pool + prefix cache) ----------------
+    def _avail_pages(self) -> int:
+        """Pages the allocator can produce right now: the free list
+        plus idle registered pages the LRU would yield."""
+        with self._lock:
+            return len(self._free_pages) + len(self._lru)
+
+    def _alloc_pages(self, n: int) -> List[int]:
+        """Pop n pages at refcount 1 — free list first, then reclaim
+        idle cached pages oldest-first. Callers check _avail_pages."""
+        with self._lock:
+            out = []
+            for _ in range(n):
+                if not self._free_pages:
+                    self._cache_reclaim()
+                pg = self._free_pages.pop()
+                self._refcount[pg] = 1
+                out.append(pg)
+            return out
+
+    def _cache_reclaim(self):
+        """Evict the oldest idle cached page: unregister its hash and
+        return it to the free list (the cache yields under pressure)."""
+        with self._lock:
+            enforce(self._lru, "page pool exhausted: allocator asked "
+                    "to reclaim with no idle cached pages")
+            pg, _ = self._lru.popitem(last=False)
+            del self._hash_page[self._page_hash.pop(pg)]
+            self._pfx["reclaimed"] += 1
+            self._metrics["prefix_events"].inc(event="reclaimed")
+            self._free_pages.append(pg)
+
+    def _ref_page(self, pg: int):
+        """Take one reference on a cached page (admission hit); an
+        idle page leaves the LRU — it is live again."""
+        with self._lock:
+            self._refcount[pg] += 1
+            if self._refcount[pg] == 1:
+                self._lru.pop(pg, None)
+
+    def _release_pages(self, pages: List[int]):
+        """Drop one reference per page. A registered page that idles
+        parks on the LRU tail (still hit-able); an unregistered one
+        goes straight back to the free list."""
+        with self._lock:
+            for pg in pages:
+                self._refcount[pg] -= 1
+                if self._refcount[pg] > 0:
+                    continue
+                if pg in self._page_hash:
+                    self._lru[pg] = None
+                else:
+                    self._free_pages.append(pg)
+
+    def _register_page(self, h: int, pg: int):
+        """Publish a completed page under its prefix hash. First
+        writer wins: a page already registered (or a hash already
+        mapped) stays as-is, so the maps remain a bijection."""
+        with self._lock:
+            if h in self._hash_page or pg in self._page_hash:
+                return
+            self._hash_page[h] = pg
+            self._page_hash[pg] = h
+            self._pfx["registered"] += 1
+            self._metrics["prefix_events"].inc(event="registered")
+
+    def _prefix_hashes(self, prompt: np.ndarray) -> List[int]:
+        """Rolling hash per FULL page-aligned prompt chunk: h_j covers
+        prompt[:(j+1)*page], so equal hashes mean equal whole
+        prefixes — a hit run is always a shared prefix, never a
+        shared interior."""
+        page = self.page
+        arr = np.ascontiguousarray(np.asarray(prompt, np.int64))
+        out: List[int] = []
+        h = hash(("paddle_tpu_prefix", page))
+        for j in range(len(arr) // page):
+            h = hash((h, arr[j * page:(j + 1) * page].tobytes()))
+            out.append(h)
+        return out
+
+    def check_invariants(self):
+        """Pool-accounting invariant (the free-list hardening gate):
+        free list, idle (LRU) pages, and refcounted live pages
+        partition the usable pool exactly; every page's refcount
+        equals the number of slots holding it; the hash<->page maps
+        stay bijective. Raises on any violation — double free, leak,
+        or refcount drift."""
+        with self._lock:
+            bad: List[str] = []
+            usable = self.P - 1
+            free, lru = list(self._free_pages), list(self._lru)
+            fs, ls = set(free), set(lru)
+            held = Counter(pg for s in self.slots if s is not None
+                           for pg in s.pages)
+            live = {pg for pg in range(usable) if self._refcount[pg] > 0}
+            if len(fs) != len(free):
+                bad.append("duplicate pages on the free list")
+            if self.trash in fs | ls | live:
+                bad.append("trash page entered circulation")
+            if fs & ls or fs & live or ls & live:
+                bad.append("free/idle/live page sets overlap")
+            if len(free) + len(lru) + len(live) != usable:
+                bad.append(f"free({len(free)}) + idle({len(lru)}) + "
+                           f"live({len(live)}) != pool({usable})")
+            if set(held) != live:
+                bad.append("refcounted pages != pages held by slots")
+            drift = {pg: (int(c), self._refcount[pg])
+                     for pg, c in held.items()
+                     if self._refcount[pg] != c}
+            if drift:
+                bad.append(f"refcount drift (held, rc): {drift}")
+            if len(self._hash_page) != len(self._page_hash) or \
+                    set(self._page_hash) != set(self._hash_page.values()):
+                bad.append("prefix hash maps out of sync")
+            if not ls <= set(self._page_hash):
+                bad.append("LRU page not registered in the cache")
+            enforce(not bad,
+                    "serving pool invariant violated: " + "; ".join(bad))
+
+    def prefix_cache_stats(self) -> Dict[str, Any]:
+        """Host-side prefix-cache counters: page lookups/hits at
+        admission, prompt tokens skipped vs fed, copy-on-writes, LRU
+        reclaims, plus the current registered/idle page counts."""
+        with self._lock:
+            out = dict(self._pfx)
+            out["hit_rate"] = (out["hits"] / out["lookups"]
+                               if out["lookups"] else 0.0)
+            out["registered_pages"] = len(self._page_hash)
+            out["idle_pages"] = len(self._lru)
+            return out
+
+    def spec_stats(self) -> Dict[str, Any]:
+        """Speculative-decoding counters: drafts proposed/accepted,
+        decode rounds, tokens committed — accept_rate and
+        tokens_per_step are the two headline ratios."""
+        out = dict(self._spec)
+        out["accept_rate"] = (out["accepted"] / out["proposed"]
+                              if out["proposed"] else 0.0)
+        out["tokens_per_step"] = (out["committed"] / out["rounds"]
+                                  if out["rounds"] else 0.0)
+        return out
+
+    def _admit_plan(self, req: ServingRequest):
+        """Admission plan, pure (no allocation): returns (cold pages
+        to allocate now, reserve pages the availability check must
+        also cover, cache-hit pages, prefix hashes, fed0 = prompt
+        tokens the cache already holds). Legacy: the whole len+new
+        footprint. Chunked: only the first chunk's pages past the hit
+        run — the rest are reserved incrementally (_plan_chunks). A
+        FULL-prompt hit refeeds the last prompt token (fed0 = L-1) so
+        the unified step still samples token 0; its copy-on-write
+        page is the reserve."""
+        L = len(req.prompt)
+        if not self.chunked:
+            return (self._pages_needed(L, req.max_new_tokens), 0,
+                    [], None, 0)
+        if not self.prefix:
+            return (self._pages_for(min(L, self.Sc)), 0, [], None, 0)
+        hashes = self._prefix_hashes(req.prompt)
+        hits: List[int] = []
+        with self._lock:
+            for h in hashes:
+                pg = self._hash_page.get(h)
+                if pg is None:
+                    break
+                hits.append(pg)
+        k = len(hits)
+        fed0 = k * self.page
+        if fed0 >= L:
+            fed0 = L - 1
+        cold = self._pages_for(min(L, fed0 + self.Sc)) - k
+        reserve = 1 if fed0 < k * self.page else 0
+        return max(cold, 0), reserve, hits, hashes, fed0
 
     def _pvals(self):
         return tuple(p._value for p in self.pred._params)
@@ -412,23 +694,43 @@ class ServingEngine:
             free = [b for b in range(self.B) if self.slots[b] is None]
             if not free:
                 return
-            need = self._admit_need(req)
-            if need > len(self._free_pages):
+            cold, reserve, hits, hashes, fed0 = self._admit_plan(req)
+            with self._lock:
+                # idle hit pages count toward _avail_pages but are
+                # about to be pinned by the hit itself — charge them
+                idle_hits = sum(1 for pg in hits
+                                if self._refcount[pg] == 0)
+            if cold + reserve + idle_hits > self._avail_pages():
                 return                    # head-of-line waits for evictions
             self.queue.popleft()
             b = free[0]
             # a backfill is an admission that joins rows mid-decode
             # (the continuous-batching event; a cold admit is not one)
             backfill = self.num_active > 0
-            pages = [self._free_pages.pop() for _ in range(need)]
+            for pg in hits:
+                self._ref_page(pg)        # pin BEFORE any reclaim can run
+            pages = list(hits) + self._alloc_pages(cold)
             self.tables[b, :] = self.trash
-            self.tables[b, :need] = pages
-            self.slots[b] = _Slot(
+            self.tables[b, :len(pages)] = pages
+            slot = _Slot(
                 req, pages, state="prefill" if self.chunked else "decode",
                 seq=self._admit_seq)
+            slot.hashes = hashes
+            slot.hit_pages = len(hits)
+            slot.registered = len(hits)
+            slot.fed = fed0
+            self.slots[b] = slot
             self._admit_seq += 1
             m = self._metrics
             m["requests"].inc(event="admitted")
+            if hashes is not None:
+                self._pfx["lookups"] += len(hashes)
+                self._pfx["hits"] += len(hits)
+                self._pfx["skipped_tokens"] += fed0
+                if hits:
+                    m["prefix_events"].inc(len(hits), event="hit")
+            if self.debug:
+                self.check_invariants()
             if backfill:
                 m["requests"].inc(event="backfilled")
             m["queue_depth"].set(len(self.queue))
@@ -558,6 +860,156 @@ class ServingEngine:
         self._step_fns[key] = jax.jit(step, donate_argnums=(2,))
         return self._step_fns[key]
 
+    # -- speculative decoding --------------------------------------------
+    def _unified_spec_step_fn(self):
+        """The spec-mode unified step: IDENTICAL forward on the same
+        [B, Sc] lattice, but greedy argmax at EVERY position instead
+        of a sample at the last — a decode row feeding its last token
+        plus k draft tokens gets all k+1 verify logits from the one
+        dispatch. Keyed only on lattice constants."""
+        key = ("unified_spec", self.B, self.Sc, self.M)
+        if key in self._step_fns:
+            return self._step_fns[key]
+        model, params = self.pred._model, self.pred._params
+        from ..autograd import no_grad
+        from ..distributed.engine import bind_params
+
+        def step(pvals, ids, caches, starts, nvalid):
+            with no_grad(), bind_params(params, pvals):
+                logits, caches = model.forward(
+                    Tensor(ids, stop_gradient=True), caches=caches,
+                    offset=starts, valid=nvalid)
+            lv = (logits._value if isinstance(logits, Tensor)
+                  else logits)
+            toks = jnp.argmax(lv.astype(jnp.float32), axis=-1)
+            return toks.astype(jnp.int32), caches
+
+        self._step_fns[key] = jax.jit(step, donate_argnums=(2,))
+        return self._step_fns[key]
+
+    def _propose_fn(self):
+        """The draft proposal program: k greedy [B, 1] draft steps
+        fused in one lax.scan against the draft pools (same page
+        tables). Rows not proposing ride along at valid 0 — their
+        writes land in the trash column."""
+        key = ("propose", self.B, self.spec, self.M)
+        if key in self._step_fns:
+            return self._step_fns[key]
+        model, params = self._draft._model, self._draft._params
+        k, M = self.spec, self.M
+        from ..autograd import no_grad
+        from ..distributed.engine import bind_params
+
+        def propose(pvals, tok0, caches, pos0, nv):
+            def body(carry, _):
+                tok, caches, pos = carry
+                with no_grad(), bind_params(params, pvals):
+                    logits, caches = model.forward(
+                        Tensor(tok[:, None], stop_gradient=True),
+                        caches=caches, offset=pos, valid=nv)
+                lv = (logits._value if isinstance(logits, Tensor)
+                      else logits)
+                nxt = jnp.argmax(lv[:, -1].astype(jnp.float32),
+                                 axis=-1).astype(jnp.int32)
+                # rows near their token budget draft past their own
+                # horizon; clamp keeps positions in the cache (the
+                # overdraft lanes are never read — the host caps
+                # acceptance at k_use = remaining - 1)
+                pos = jnp.minimum(pos + 1, M - 1)
+                return (nxt, caches, pos), nxt
+
+            # k+1 steps for k proposals: the extra step writes the
+            # k-th proposal's KV, so a fully-accepted run leaves no
+            # gap in the draft cache (its emission is discarded)
+            (_, caches, _), toks = lax.scan(
+                body, (tok0, caches, pos0), None, length=k + 1)
+            return jnp.swapaxes(toks, 0, 1), caches      # [B, k+1]
+
+        self._step_fns[key] = jax.jit(propose, donate_argnums=(2,))
+        return self._step_fns[key]
+
+    def _draft_chunk_fn(self):
+        """Prompt chunks mirrored into the DRAFT pools (same [B, Sc]
+        ragged metadata, logits discarded) so the draft proposes from
+        full prompt context once a row reaches decode."""
+        key = ("draft_chunk", self.B, self.Sc, self.M)
+        if key in self._step_fns:
+            return self._step_fns[key]
+        model, params = self._draft._model, self._draft._params
+        from ..autograd import no_grad
+        from ..distributed.engine import bind_params
+
+        def feed(pvals, ids, caches, starts, nvalid):
+            with no_grad(), bind_params(params, pvals):
+                _logits, caches = model.forward(
+                    Tensor(ids, stop_gradient=True), caches=caches,
+                    offset=starts, valid=nvalid)
+            return caches
+
+        self._step_fns[key] = jax.jit(feed, donate_argnums=(2,))
+        return self._step_fns[key]
+
+    def _draft_pvals(self):
+        return tuple(p._value for p in self._draft._params)
+
+    def _extended_tables(self) -> np.ndarray:
+        """The model's `valid` contract: one extra trailing table
+        column that ALWAYS maps to the trash page (dead-slot and
+        overdraft writes land there; attention slices it back off)."""
+        return np.concatenate(
+            [self.tables,
+             np.full((self.B, 1), self.trash, np.int32)], axis=1)
+
+    def _propose(self, k_use: Dict[int, int]) -> np.ndarray:
+        """Run the draft proposal scan for this round's decode rows;
+        returns the [B, k] proposed ids. Also writes the rows' last
+        committed token into the draft KV (keeping the draft cache
+        exactly one committed token behind the target's)."""
+        B = self.B
+        tok = np.zeros((B,), np.int32)
+        pos = np.zeros((B,), np.int32)
+        nv = np.zeros((B,), np.int32)
+        for b, ku in k_use.items():
+            if ku <= 0:
+                continue
+            s = self.slots[b]
+            tok[b] = s.req.new_tokens[-1]
+            pos[b] = s.pos + len(s.req.new_tokens) - 1
+            nv[b] = 1
+        caches = [(kp, vp, jnp.asarray(self._extended_tables()))
+                  for kp, vp in self.draft_pools]
+        fn = self._propose_fn()
+        self.stats.note("draft_propose",
+                        (B, self.spec, self.M, self.page, self.P,
+                         str(self._draft_dtype)))
+        toks, caches = self._run_captured(
+            ("draft_propose",), fn, self._draft_pvals(),
+            jnp.asarray(tok), caches, jnp.asarray(pos),
+            jnp.asarray(nv))
+        self.draft_pools = [(c[0], c[1]) for c in caches]
+        return np.asarray(toks)
+
+    def _draft_feed(self, feeders, ids: np.ndarray, starts: np.ndarray):
+        """Mirror this round's prompt chunks into the draft pools
+        (decode rows masked to valid 0 — their draft KV advances in
+        _propose). Cache-hit pages already hold draft KV from the
+        request that first fed them: the pools share page ids."""
+        B = self.B
+        nvf = np.zeros((B,), np.int32)
+        for b, n, _last in feeders:
+            nvf[b] = n
+        caches = [(kp, vp, jnp.asarray(self._extended_tables()))
+                  for kp, vp in self.draft_pools]
+        fn = self._draft_chunk_fn()
+        self.stats.note("draft_chunk",
+                        (B, self.Sc, self.M, self.page, self.P,
+                         str(self._draft_dtype)))
+        caches = self._run_captured(
+            ("draft_chunk", self.Sc), fn, self._draft_pvals(),
+            jnp.asarray(ids), caches, jnp.asarray(starts),
+            jnp.asarray(nvf))
+        self.draft_pools = [(c[0], c[1]) for c in caches]
+
     def _plan_chunks(self):
         """Pick this round's prefill feeders (admission order) under
         the token budget, reserving pages incrementally: a chunk needs
@@ -586,38 +1038,128 @@ class ServingEngine:
             want_tokens = (L + s.req.max_new_tokens) if last \
                 else (s.fed + n)
             extra = self._pages_for(want_tokens) - len(s.pages)
-            if extra > len(self._free_pages):
+            # copy-on-write: shared/registered pages are immutable, so
+            # any page this chunk writes into that another slot (or
+            # the cache) can still see is copied to a private page
+            # first. Page-aligned frontiers make this rare: it only
+            # fires on the full-prefix-hit refeed (position L-1 lands
+            # in the final hit page).
+            cow = self._cow_plan(s, n)
+            if max(extra, 0) + len(cow) > self._avail_pages():
                 stalled = True
                 self._metrics["prefill_stall"].inc()
                 continue
             if extra > 0:
-                newp = [self._free_pages.pop() for _ in range(extra)]
+                newp = self._alloc_pages(extra)
                 self.tables[b, len(s.pages):len(s.pages) + extra] = newp
                 s.pages.extend(newp)
+            for j in cow:
+                self._cow_page(b, j)
             feeders.append((b, n, last))
             budget -= n
         self._page_stalled = stalled
         return feeders, stalled
 
+    # -- copy-on-write ---------------------------------------------------
+    def _cow_plan(self, s: _Slot, n: int) -> List[int]:
+        """Table columns of ``s`` whose pages the next n-token chunk
+        writes into while shared (refcount > 1) or registered in the
+        prefix cache — those must be copied before the write."""
+        if not self.prefix:
+            return []
+        jlo = s.fed // self.page
+        jhi = (s.fed + n - 1) // self.page
+        out: List[int] = []
+        with self._lock:
+            for j in range(jlo, min(jhi, len(s.pages) - 1) + 1):
+                pg = s.pages[j]
+                if self._refcount[pg] > 1 or pg in self._page_hash:
+                    out.append(j)
+        return out
+
+    def _cow_page(self, b: int, j: int):
+        """Replace table column j of row b with a private copy of its
+        page (device-side copy into a freshly allocated page), then
+        drop the reference on the shared original."""
+        s = self.slots[b]
+        old = s.pages[j]
+        [new] = self._alloc_pages(1)
+        self._copy_page(old, new)
+        s.pages[j] = new
+        self.tables[b, j] = new
+        self._release_pages([old])
+        self._pfx["cow"] += 1
+        self._metrics["prefix_events"].inc(event="cow")
+
+    def _page_copy_fn(self):
+        """ONE compiled page-copy program per pool geometry: src/dst
+        page ids are TRACED scalars (dynamic slice in/out), so every
+        (src, dst) pair reuses the same executable — a Python-side
+        ``.at[dst].set(pool[src])`` would recompile per pair."""
+        key = ("page_copy",)
+        if key in self._step_fns:
+            return self._step_fns[key]
+
+        def copy(pools, src, dst):
+            def one(a):
+                row = lax.dynamic_index_in_dim(a, src, axis=0,
+                                               keepdims=True)
+                return lax.dynamic_update_slice_in_dim(a, row, dst,
+                                                       axis=0)
+
+            return jax.tree_util.tree_map(one, pools)
+
+        self._step_fns[key] = jax.jit(copy, donate_argnums=(0,))
+        return self._step_fns[key]
+
+    def _copy_page(self, src: int, dst: int):
+        """Copy one physical page in every pool (and the draft pools
+        when speculative decoding is on — they share page ids)."""
+        fn = self._page_copy_fn()
+        s = jnp.asarray(src, jnp.int32)
+        d = jnp.asarray(dst, jnp.int32)
+        self.stats.note("page_copy",
+                        ("target", len(self.pools), str(self._dtype)))
+        self.pools = self._run_captured(("page_copy",), fn,
+                                        self.pools, s, d)
+        if self._draft is not None:
+            self.stats.note("page_copy",
+                            ("draft", len(self.draft_pools),
+                             str(self._draft_dtype)))
+            self.draft_pools = self._run_captured(
+                ("page_copy_draft",), fn, self.draft_pools, s, d)
+
     def _unified_round(self, feeders):
         """One unified dispatch: every feeder writes its next prompt
-        chunk, every decode row advances one token, dead rows ride
-        along at seq_len 0 — ONE compiled program, fixed shape."""
+        chunk, every decode row advances — one token (plain), or up
+        to spec_tokens+1 (speculative: last token + k draft proposals
+        verified in the same dispatch), dead rows ride along at
+        seq_len 0 — ONE compiled program, fixed shape."""
         t0 = time.perf_counter()
         B = self.B
+        spec = self._draft is not None
         ids = np.zeros((B, self.Sc), np.int32)
         starts = np.zeros((B,), np.int32)
         nvalid = np.zeros((B,), np.int32)
         feed = {b: (n, last) for b, n, last in feeders}
         decode_rows = []
+        k_use: Dict[int, int] = {}
         for b in range(B):
             s = self.slots[b]
             if s is None:
                 continue
             if s.state == "decode":
+                # spec: draft up to k tokens but never past the row's
+                # remaining budget (the last token is never an input,
+                # so remaining-1 verify inputs suffice)
+                ku = 0
+                if spec:
+                    ku = max(0, min(self.spec, s.req.max_new_tokens
+                                    - len(s.req.new_tokens) - 1))
+                k_use[b] = ku
                 ids[b, 0] = s.req.new_tokens[-1]
                 starts[b] = s.pos + len(s.req.new_tokens) - 1
-                nvalid[b] = 1
+                nvalid[b] = 1 + ku
                 decode_rows.append(b)
             elif b in feed:
                 n, _last = feed[b]
@@ -627,23 +1169,41 @@ class ServingEngine:
             # stalled/out-of-budget prefill rows and free slots stay
             # at seq_len 0: no writes (redirected to the trash
             # column), no attention, output ignored
-        # the model's `valid` contract: one extra trailing table
-        # column that ALWAYS maps to the trash page (dead-slot writes
-        # land there; attention slices it back off)
-        tbl = np.concatenate(
-            [self.tables, np.full((B, 1), self.trash, np.int32)], axis=1)
+        if spec and any(k_use[b] > 0 for b in decode_rows):
+            drafts = self._propose(k_use)
+            for b in decode_rows:
+                ku = k_use[b]
+                if ku > 0:
+                    ids[b, 1:1 + ku] = drafts[b, :ku]
+        tbl = self._extended_tables()
         caches = [(kp, vp, jnp.asarray(tbl)) for kp, vp in self.pools]
-        fn = self._unified_step_fn()
-        self.stats.note("unified",
-                        (B, self.Sc, self.M, self.page, self.P,
-                         self.gen.temperature, self.gen.top_k,
-                         self.gen.top_p, str(self._dtype)))
-        self._rng, sub = jax.random.split(self._rng)
-        toks, caches = self._run_captured(
-            ("unified", self.Sc), fn, self._pvals(), jnp.asarray(ids),
-            caches, jnp.asarray(starts), jnp.asarray(nvalid), sub)
+        if spec:
+            fn = self._unified_spec_step_fn()
+            self.stats.note("unified_spec",
+                            (B, self.Sc, self.M, self.page, self.P,
+                             str(self._dtype)))
+            toks, caches = self._run_captured(
+                ("unified_spec", self.Sc), fn, self._pvals(),
+                jnp.asarray(ids), caches, jnp.asarray(starts),
+                jnp.asarray(nvalid))
+        else:
+            fn = self._unified_step_fn()
+            self.stats.note("unified",
+                            (B, self.Sc, self.M, self.page, self.P,
+                             self.gen.temperature, self.gen.top_k,
+                             self.gen.top_p, str(self._dtype)))
+            self._rng, sub = jax.random.split(self._rng)
+            toks, caches = self._run_captured(
+                ("unified", self.Sc), fn, self._pvals(),
+                jnp.asarray(ids), caches, jnp.asarray(starts),
+                jnp.asarray(nvalid), sub)
         self.pools = [(c[0], c[1]) for c in caches]
-        toks = np.asarray(toks)
+        # mirror the chunks into the draft pools BEFORE commits can
+        # retire a feeder (a finished row's table goes all-trash, and
+        # its registered pages must carry draft KV into the cache)
+        if spec and feeders:
+            self._draft_feed(feeders, ids, starts)
+        toks = np.asarray(toks)     # plain: [B]; spec: [B, Sc]
         now = time.perf_counter()
         m = self._metrics
         fed_tokens = 0
@@ -660,8 +1220,16 @@ class ServingEngine:
             s.chunks += 1
             fed_tokens += n
             m["prefill_chunks"].inc()
+            if self.prefix and s.hashes:
+                # pages fully behind the fed frontier now hold final
+                # immutable KV: publish them under their prefix hash
+                full = s.fed // self.page
+                for j in range(s.registered, full):
+                    self._register_page(s.hashes[j], s.pages[j])
+                s.registered = max(s.registered, full)
             if last:
-                tok0 = int(toks[b])
+                tok0 = int(toks[b, nvalid[b] - 1]) if spec \
+                    else int(toks[b])
                 req.new_tokens.append(tok0)
                 req.t_first_token = now
                 m["ttft"].observe(now - req.t_submit)
@@ -678,22 +1246,51 @@ class ServingEngine:
                         (req.eos_token_id is not None
                          and tok0 == req.eos_token_id):
                     self._finish(b)
+        if self.prefix:
+            self._pfx["fed_tokens"] += fed_tokens
         emitted = 0
         for b in decode_rows:
             req = self.slots[b].req
-            t = int(toks[b])
+            acc = 0
+            if spec:
+                # greedy verify: toks[b, i] is the target argmax AFTER
+                # consuming input i, so draft i is accepted iff it
+                # equals the previous committed token's argmax —
+                # commit the accepted run plus the one bonus token
+                ku = k_use[b]
+                seq = [int(toks[b, 0])]
+                for i in range(1, ku + 1):
+                    if int(ids[b, i]) != seq[-1]:
+                        break
+                    seq.append(int(toks[b, i]))
+                acc = len(seq) - 1
+                self._spec["proposed"] += ku
+                self._spec["accepted"] += acc
+            else:
+                seq = [int(toks[b])]
             tr = self._live_traces.get(req.rid)
             if tr is not None:
-                tr.add("decode_round", t0, now,
-                       {"round": self._round, "unified": True})
-            req.new_tokens.append(t)
-            emitted += 1
-            if len(req.new_tokens) >= req.max_new_tokens or \
-                    (req.eos_token_id is not None
-                     and t == req.eos_token_id):
-                self._finish(b)
-        self.stats.count_tokens(("unified", self.Sc, self.P),
-                                fed_tokens + emitted)
+                meta = {"round": self._round, "unified": True}
+                if spec:
+                    meta.update(proposed=k_use[b], accepted=acc)
+                tr.add("decode_round", t0, now, meta)
+            for t in seq:
+                req.new_tokens.append(t)
+                emitted += 1
+                if len(req.new_tokens) >= req.max_new_tokens or \
+                        (req.eos_token_id is not None
+                         and t == req.eos_token_id):
+                    self._finish(b)
+                    break           # rest of the run is discarded
+        if spec and decode_rows:
+            # rounds counts decode-ROW verify steps (one per row per
+            # dispatch), so committed/rounds is the per-row
+            # tokens-per-step ratio: 1.0 at zero acceptance
+            self._spec["rounds"] += len(decode_rows)
+            self._spec["committed"] += emitted
+        self.stats.count_tokens(
+            (("unified_spec" if spec else "unified"), self.Sc, self.P),
+            fed_tokens + emitted)
         m["unified_round_seconds"].observe(now - t0)
         if emitted:
             m["tokens"].inc(emitted, phase="decode")
@@ -714,7 +1311,10 @@ class ServingEngine:
         b = max(rows, key=lambda b: self.slots[b].seq)
         s = self.slots[b]
         now = time.perf_counter()
-        self._free_pages.extend(s.pages)
+        # refcount-aware release: pages shared with elder slots (or
+        # registered in the prefix cache) survive the preemption —
+        # the sharers keep decoding against them untouched
+        self._release_pages(s.pages)
         self.tables[b, :] = self.trash
         self.slots[b] = None
         self.queue.appendleft(s.req)
@@ -727,16 +1327,21 @@ class ServingEngine:
             tr.add("preempt", now, now,
                    {"reason": "pages", "fed": s.fed})
             tr.begin("queued", now)
+        if self.debug:
+            self.check_invariants()
 
     def _chunked_round(self):
         """One chunked-mode tick: feed chunks through the unified step
         when any are ready (decode rows ride along); otherwise run the
-        cheap fused decode scan; preempt only when nothing can move."""
+        cheap fused decode scan — except in spec mode, where decode
+        rows always take the unified verify path (draft proposals need
+        the [B, Sc] lattice); preempt only when nothing can move."""
         feeders, stalled = self._plan_chunks()
-        if feeders:
+        has_decode = any(s is not None and s.state == "decode"
+                         for s in self.slots)
+        if feeders or (self._draft is not None and has_decode):
             self._unified_round(feeders)
-        elif any(s is not None and s.state == "decode"
-                 for s in self.slots):
+        elif has_decode:
             self._decode_round()
         elif stalled:
             self._preempt_youngest()
@@ -810,10 +1415,12 @@ class ServingEngine:
         self._round += 1
 
     def _finish(self, b: int):
-        """Evict a finished row: pages back on the free list, table row
-        to all-trash, slot open for backfill."""
+        """Evict a finished row: one reference dropped per page
+        (registered pages park on the cache LRU, the rest return to
+        the free list), table row to all-trash, slot open for
+        backfill."""
         slot = self.slots[b]
-        self._free_pages.extend(slot.pages)
+        self._release_pages(slot.pages)
         self.tables[b, :] = self.trash
         self.slots[b] = None
         self.finished[slot.req.rid] = slot.req
@@ -834,6 +1441,8 @@ class ServingEngine:
             m["stage_seconds"].observe(req.t_finish - req.t_submit,
                                        stage="e2e")
             self.traces.add(tr)
+        if self.debug:
+            self.check_invariants()
 
     # -- driving ---------------------------------------------------------
     @property
@@ -858,10 +1467,28 @@ class ServingEngine:
         m = self._metrics
         m["queue_depth"].set(len(self.queue))
         m["active_slots"].set(self.num_active)
-        m["free_pages"].set(len(self._free_pages))
+        with self._lock:
+            n_free, n_idle = len(self._free_pages), len(self._lru)
+            n_reg = len(self._page_hash)
+        m["free_pages"].set(n_free)
         usable = self.P - 1              # trash page is never allocable
+        # idle cached pages are reclaimable on demand: occupancy
+        # reports pages slots actually hold, not cache residue
         m["page_occupancy"].set(
-            (usable - len(self._free_pages)) / usable if usable else 0.0)
+            (usable - n_free - n_idle) / usable if usable else 0.0)
+        if self.prefix:
+            lk = self._pfx["lookups"]
+            m["prefix_hit_rate"].set(
+                self._pfx["hits"] / lk if lk else 0.0)
+            m["prefix_pages"].set(n_reg - n_idle, state="active")
+            m["prefix_pages"].set(n_idle, state="idle")
+        if self._draft is not None:
+            pr = self._spec["proposed"]
+            m["spec_accept_rate"].set(
+                self._spec["accepted"] / pr if pr else 0.0)
+            rd = self._spec["rounds"]
+            m["spec_tokens_per_step"].set(
+                self._spec["committed"] / rd if rd else 0.0)
         rc, rh = self._stats_reported
         if self.stats.compiles > rc:
             m["compiles"].inc(self.stats.compiles - rc, site="serving")
@@ -907,8 +1534,11 @@ class ServingEngine:
 
     def comm_ledger(self, site) -> Optional[Any]:
         """Static comm ledger of a compiled serving program: site is
-        ("decode",), ("prefill", seq_bucket), or ("unified",
-        chunk_bucket) in chunked mode."""
+        ("decode",), ("prefill", seq_bucket), ("unified", chunk_bucket)
+        in chunked mode, ("unified_spec", chunk_bucket) /
+        ("draft_propose",) / ("draft_chunk", chunk_bucket) with
+        speculative decoding, or ("page_copy",) with the prefix
+        cache."""
         return self._ledgers.get(site)
 
     # -- memory accounting (observability/memledger) ---------------------

@@ -5,11 +5,11 @@ from .gpt import (GPTConfig, GPTForCausalLM, GPTForCausalLMPipe, GPTModel,
                   gpt_13b, gpt_1p3b, gpt_350m, gpt_moe_tiny, gpt_tiny)
 from .llama import (LlamaConfig, LlamaForCausalLM, LlamaModel,
                     LlamaPretrainingCriterion, llama_13b, llama_7b,
-                    llama_tiny)
+                    llama_tiny, llama_tiny_draft)
 
 __all__ = ["GPTConfig", "GPTModel", "GPTForCausalLM", "GPTForCausalLMPipe",
            "GPTPretrainingCriterion", "gpt_tiny", "gpt_125m", "gpt_350m",
            "gpt_1p3b", "gpt_13b", "gpt_moe_tiny", "ernie_moe_base",
            "LlamaConfig", "LlamaModel", "LlamaForCausalLM",
-           "LlamaPretrainingCriterion", "llama_tiny", "llama_7b",
-           "llama_13b"]
+           "LlamaPretrainingCriterion", "llama_tiny", "llama_tiny_draft",
+           "llama_7b", "llama_13b"]

@@ -563,6 +563,17 @@ def llama_tiny(**kw) -> LlamaConfig:
                        max_position_embeddings=128, **kw)
 
 
+def llama_tiny_draft(**kw) -> LlamaConfig:
+    """Draft-sized companion to ``llama_tiny`` for speculative
+    decoding: same vocabulary and position range (the serving engine
+    requires both), roughly a quarter of the compute — one layer,
+    half the width."""
+    kw.setdefault("vocab_size", 256)
+    kw.setdefault("max_position_embeddings", 128)
+    return LlamaConfig(hidden_size=32, num_layers=1, num_heads=2,
+                       num_kv_heads=1, intermediate_size=64, **kw)
+
+
 def llama_7b(**kw) -> LlamaConfig:
     return LlamaConfig(**kw)
 

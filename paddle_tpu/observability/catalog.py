@@ -420,6 +420,34 @@ def serving_metrics(reg: MetricsRegistry = None) -> Dict[str, object]:
             "paddle_tpu_compile_cache_hits_total",
             "compiled-program cache hits at instrumented launch sites",
             labelnames=("site",)),
+        "prefix_hit_rate": r.gauge(
+            "paddle_tpu_serving_prefix_cache_hit_rate",
+            "cumulative prefix-cache hit rate: page-aligned prompt "
+            "chunks served from cached KV pages over chunks looked "
+            "up at admission (inference/serving.py prefix_cache)"),
+        "prefix_pages": r.gauge(
+            "paddle_tpu_serving_prefix_cache_pages",
+            "registered prefix-cache pages by state: active (held by "
+            "at least one slot) / idle (refcount 0, parked on the "
+            "reclaim LRU)", labelnames=("state",)),
+        "prefix_events": r.counter(
+            "paddle_tpu_serving_prefix_cache_events_total",
+            "prefix-cache lifecycle events: hit (page mapped into an "
+            "admitted slot, zero copy) / registered (completed page "
+            "published under its prefix hash) / cow (copy-on-write of "
+            "a shared page before a divergent write) / reclaimed "
+            "(idle page evicted to the free list under pool "
+            "pressure)", labelnames=("event",)),
+        "spec_accept_rate": r.gauge(
+            "paddle_tpu_serving_spec_accept_rate",
+            "cumulative speculative-decoding acceptance: draft tokens "
+            "matching the target's greedy argmax chain over draft "
+            "tokens proposed"),
+        "spec_tokens_per_step": r.gauge(
+            "paddle_tpu_serving_spec_tokens_per_step",
+            "decode tokens committed per decode-row verify step with "
+            "speculative decoding (accepted run + the bonus token; "
+            "1.0 means no speculation win)"),
         "stage_seconds": r.histogram(
             "paddle_tpu_serving_request_stage_seconds",
             "per-request lifecycle stage latency (spans): queued = "

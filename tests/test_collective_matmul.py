@@ -32,6 +32,7 @@ def _sm(f, mesh, in_specs, out_specs):
 
 # -- raw ring ops vs the unfused reference on 8 devices -------------------
 
+@pytest.mark.slow  # ~17s 8-vdev ring fwd+bwd compile; 1-cpu tier-1 budget
 def test_ag_matmul_fwd_bwd_parity():
     mesh = _mesh()
     r = np.random.RandomState(0)
@@ -59,6 +60,7 @@ def test_ag_matmul_fwd_bwd_parity():
     np.testing.assert_allclose(np.asarray(dwf), np.asarray(dwr), **TOL)
 
 
+@pytest.mark.slow  # ~19s 8-vdev ring fwd+bwd compile; 1-cpu tier-1 budget
 def test_matmul_rs_fwd_bwd_parity():
     mesh = _mesh()
     r = np.random.RandomState(1)

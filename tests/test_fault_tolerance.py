@@ -345,6 +345,7 @@ def _hbatch(step, cfg, B=8, S=16):
 
 
 class TestExactResumeHybrid:
+    @pytest.mark.slow  # ~30s 10-step x2 hybrid horizon; 1-cpu tier-1 budget
     def test_five_crash_five_equals_ten_straight(self, tmp_path):
         """10 straight steps vs 5 + 'crash' (fresh model/opt/engine,
         i.e. a restarted process) + restore + 5: losses AND params

@@ -393,6 +393,7 @@ class TestConvergenceGate:
         losses = [float(step(batch)) for _ in range(steps)]
         return float(np.mean(losses[-20:]))
 
+    @pytest.mark.slow  # ~25s 300-step convergence horizon; 1-cpu tier-1 budget
     def test_int8_ef_matches_fp32_and_no_ef_diverges(self):
         ref = self._run("none")
         ef = self._run("int8", True)

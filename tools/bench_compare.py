@@ -78,6 +78,12 @@ _EXACT = {"pallas_kernel_parity_interpret": 1.0,
           # fallback on a mixed prefill-chunk/decode batch (chunk
           # straddling page boundaries) — pass/fail, never drifts
           "serving_ragged_kernel_parity": 1.0,
+          # prefix-cache + greedy spec decode on the multi-tenant
+          # trace: all three serves (prefix on / off / prefix+spec)
+          # emit identical token streams, the fed+skipped token
+          # ledgers partition the trace exactly, and the cache hit
+          # rate clears its floor — pass/fail, never drifts
+          "serving_prefix_spec_parity": 1.0,
           # health monitor event counts on the DETERMINISTIC bench
           # lines: robust spike detection must stay silent on a clean
           # fixed-seed run — any event is a regression (either a real
@@ -115,6 +121,19 @@ _THRESHOLDS = {
     # on chip the chunked-on vs chunked-off ratio on the line itself
     # (vs_baseline > 1) carries the acceptance
     "serving_mixed_traffic_tpot_p99_ms": 1.0,
+    # TTFT p50 under the multi-tenant prefix trace ("ms" unit:
+    # lower-better): ms-scale on the CPU smoke, so host-scheduling
+    # noise dominates — the prefix-on vs prefix-off ratio on the line
+    # itself (vs_baseline > 1) carries the acceptance
+    "serving_prefix_ttft_p50_ms": 1.0,
+    # cache hit rate is a closed form of the fixed-seed trace (system
+    # prompt mix x page alignment) — it only moves when the admission
+    # planner or eviction policy changes, so even small drift flags
+    "serving_prefix_cache_hit_rate": 0.1,
+    # committed tokens per verify step at the self-speculation
+    # acceptance ceiling: a drop means the verify lattice is
+    # rejecting drafts it should accept (or booking phantom rounds)
+    "serving_spec_tokens_per_step": 0.1,
     # roofline HBM headroom (direction-aware: HIGHER is better — the
     # default direction — falling headroom means the config is walking
     # into the memory wall). 0 on CPU where peaks are unknown; on chip
