@@ -111,6 +111,7 @@ class CheckpointManager:
 
         self._queue: "queue.Queue" = queue.Queue()
         self._writer: Optional[threading.Thread] = None
+        self._stop = threading.Event()
         self._cv = threading.Condition()
         self._pending = 0
         self._errors: list = []
@@ -156,6 +157,7 @@ class CheckpointManager:
                 self._write(*job)
             return
         if self._writer is None or not self._writer.is_alive():
+            self._stop.clear()
             self._writer = threading.Thread(
                 target=self._writer_loop, daemon=True, name="ckpt-writer")
             self._writer.start()
@@ -169,9 +171,20 @@ class CheckpointManager:
         import time as _time
 
         while True:
-            job = self._queue.get()
+            # bounded get: a get() with no timeout can never observe
+            # _stop, and close() would hang behind it forever
+            try:
+                job = self._queue.get(timeout=0.5)
+            except queue.Empty:
+                if self._stop.is_set():
+                    return
+                continue
             if job is None:
-                return
+                if self._stop.is_set():
+                    return
+                # stale wake-up sentinel from an earlier close() whose
+                # writer had already exited — not a job, not a stop
+                continue
             t0 = _time.time()
             try:
                 self._write(*job)
@@ -257,7 +270,8 @@ class CheckpointManager:
         if self._writer is not None and self._writer.is_alive():
             with self._cv:
                 self._cv.wait_for(lambda: self._pending == 0, 30)
-            self._queue.put(None)
+            self._stop.set()
+            self._queue.put(None)    # wake the bounded get immediately
             self._writer.join(timeout=30)
         self._writer = None
 

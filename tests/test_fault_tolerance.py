@@ -308,6 +308,57 @@ class TestCheckpointManager:
         fp.clear()
         mgr.close()
 
+    # -- teardown liveness (ISSUE 17 blocking-under-lock conviction) ----
+    def test_close_terminates_writer_promptly(self, tmp_path):
+        """Regression: the writer loop used a timeout-less
+        Queue.get(), so it could only ever exit via the None sentinel
+        — a writer wedged on anything else made close() hang its full
+        30s join. The loop now polls with a bounded get and a stop
+        Event; close() must return fast and leave the thread dead."""
+        import threading
+
+        m = _mlp()
+        mgr = CheckpointManager(str(tmp_path), async_save=True)
+        mgr.save(m.state_dict(), step=1)
+        mgr.wait()
+        writer = mgr._writer
+        assert writer is not None and writer.is_alive()
+        t0 = time.monotonic()
+        mgr.close()
+        assert time.monotonic() - t0 < 5.0
+        writer.join(timeout=5)
+        assert not writer.is_alive()
+        assert mgr._writer is None
+
+    def test_close_idempotent_and_save_restarts_writer(self, tmp_path):
+        m = _mlp()
+        mgr = CheckpointManager(str(tmp_path), async_save=True)
+        mgr.save(m.state_dict(), step=1)
+        mgr.close()
+        mgr.close()                       # second close is a no-op
+        # a save after close restarts a fresh writer (stop cleared)
+        mgr.save(m.state_dict(), step=2)
+        mgr.wait()
+        assert mgr.latest_step() == 2
+        assert mgr._writer is not None and mgr._writer.is_alive()
+        mgr.close()
+
+    def test_stale_sentinel_does_not_kill_live_writer(self, tmp_path):
+        """A close() racing a save() used to leave a None sentinel in
+        the queue that the NEXT writer consumed as its own shutdown
+        order, silently dropping every queued checkpoint behind it.
+        A sentinel with the stop Event clear is now ignored."""
+        m = _mlp()
+        mgr = CheckpointManager(str(tmp_path), async_save=True)
+        mgr.save(m.state_dict(), step=1)
+        mgr.wait()
+        mgr._queue.put(None)              # stale sentinel, stop NOT set
+        mgr.save(m.state_dict(), step=2)
+        mgr.wait(timeout=30)
+        assert mgr.latest_step() == 2
+        assert mgr._writer is not None and mgr._writer.is_alive()
+        mgr.close()
+
 
 # ---------------------------------------------------------------------------
 # engine exact resume: the headline parity property on the gpt13b smoke

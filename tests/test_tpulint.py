@@ -917,6 +917,474 @@ class Pump:
 
 
 # ---------------------------------------------------------------------------
+# lock-order-cycle (ISSUE 17): the lock-graph deadlock prover
+# ---------------------------------------------------------------------------
+class TestLockOrderCycle:
+    # two module-level locks, two Thread entrypoints, opposite
+    # acquisition order across modules: the classic AB/BA deadlock
+    A_THEN_B = """
+import threading
+from pkg import beta
+
+_lock_a = threading.Lock()
+
+def start():
+    threading.Thread(target=loop_a, daemon=True).start()
+
+def loop_a():
+    with _lock_a:
+        with beta._lock_b:
+            pass
+"""
+    B_THEN_A = """
+import threading
+from pkg import alpha
+
+_lock_b = threading.Lock()
+
+def start():
+    threading.Thread(target=loop_b, daemon=True).start()
+
+def loop_b():
+    with _lock_b:
+        with alpha._lock_a:
+            pass
+"""
+
+    def test_two_thread_ab_ba_cycle_across_modules(self):
+        fs = run_project("lock-order-cycle",
+                         {"pkg/alpha.py": self.A_THEN_B,
+                          "pkg/beta.py": self.B_THEN_A})
+        assert rule_ids(fs) == ["lock-order-cycle"]
+        msg = fs[0].message
+        assert "pkg/alpha.py:_lock_a" in msg
+        assert "pkg/beta.py:_lock_b" in msg
+        # both thread entrypoints named as the interleaving witnesses
+        assert "loop_a" in msg and "loop_b" in msg
+
+    def test_acyclic_nested_locks_clean(self):
+        # same two threads, same two locks, CONSISTENT A-then-B order
+        b_same_order = """
+import threading
+from pkg import alpha
+
+_lock_b = threading.Lock()
+
+def start():
+    threading.Thread(target=loop_b, daemon=True).start()
+
+def loop_b():
+    with alpha._lock_a:
+        with _lock_b:
+            pass
+"""
+        assert run_project("lock-order-cycle",
+                           {"pkg/alpha.py": self.A_THEN_B,
+                            "pkg/beta.py": b_same_order}) == []
+
+    def test_single_thread_cycle_not_flagged(self):
+        # both orders exercised, but from ONE entrypoint — a single
+        # thread acquires sequentially and cannot deadlock itself
+        src = """
+import threading
+
+_a = threading.Lock()
+_b = threading.Lock()
+
+def start():
+    threading.Thread(target=loop, daemon=True).start()
+
+def loop():
+    with _a:
+        with _b:
+            pass
+    with _b:
+        with _a:
+            pass
+"""
+        assert run_project("lock-order-cycle", {"pkg/m.py": src}) == []
+
+    def test_cycle_through_entry_held_helper(self):
+        # three locks, three contexts: Pump._loop holds self._lock and
+        # calls a helper that takes beta._lock_b (interprocedural
+        # edge); beta's watch thread orders _lock_b -> _lock_c; the
+        # main-thread flush() closes the cycle _lock_c -> Pump._lock
+        src_a = """
+import threading
+from pkg import beta
+
+class Pump:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def start(self):
+        threading.Thread(target=self._loop, daemon=True).start()
+
+    def _loop(self):
+        with self._lock:
+            self._drain()
+
+    def _drain(self):
+        with beta._lock_b:
+            pass
+
+    def flush(self):
+        with beta._lock_c:
+            with self._lock:
+                pass
+"""
+        src_b = """
+import threading
+
+_lock_b = threading.Lock()
+_lock_c = threading.Lock()
+
+def start():
+    threading.Thread(target=watch, daemon=True).start()
+
+def watch():
+    with _lock_b:
+        with _lock_c:
+            pass
+"""
+        fs = run_project("lock-order-cycle",
+                         {"pkg/alpha.py": src_a, "pkg/beta.py": src_b})
+        assert rule_ids(fs) == ["lock-order-cycle"]
+        assert "Pump._lock" in fs[0].message
+
+
+# ---------------------------------------------------------------------------
+# blocking-under-lock (ISSUE 17)
+# ---------------------------------------------------------------------------
+class TestBlockingUnderLock:
+    def test_jit_dispatch_under_lock(self):
+        src = """
+import jax
+import threading
+
+class Engine:
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._step = jax.jit(lambda x: x)
+
+    def run(self, x):
+        with self._lock:
+            return self._step(x)
+"""
+        fs = run_project("blocking-under-lock",
+                         {"pkg/inference/serving.py": src})
+        assert rule_ids(fs) == ["blocking-under-lock"]
+        assert "jitted dispatch" in fs[0].message
+        assert fs[0].symbol == "Engine.run"
+
+    def test_rebind_under_lock_dispatch_after_release_clean(self):
+        # the sanctioned pattern: grab the callable reference under
+        # the lock, pay compile + device time outside it
+        src = """
+import jax
+import threading
+
+class Engine:
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._step = jax.jit(lambda x: x)
+
+    def run(self, x):
+        with self._lock:
+            fn = self._step
+        return fn(x)
+"""
+        assert run_project("blocking-under-lock",
+                           {"pkg/inference/serving.py": src}) == []
+
+    def test_local_jit_alias_under_lock_still_flagged(self):
+        src = """
+import jax
+import threading
+
+class Engine:
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._step = jax.jit(lambda x: x)
+
+    def run(self, x):
+        fn = self._step
+        with self._lock:
+            return fn(x)
+"""
+        fs = run_project("blocking-under-lock",
+                         {"pkg/inference/serving.py": src})
+        assert rule_ids(fs) == ["blocking-under-lock"]
+
+    def test_cv_wait_outside_predicate_loop(self):
+        src = """
+import threading
+
+class Box:
+    def __init__(self):
+        self._cv = threading.Condition()
+        self.ready = False
+
+    def take(self):
+        with self._cv:
+            if not self.ready:
+                self._cv.wait()
+"""
+        fs = run_project("blocking-under-lock",
+                         {"pkg/observability/box.py": src})
+        assert rule_ids(fs) == ["blocking-under-lock"]
+        assert "predicate loop" in fs[0].message
+
+    def test_cv_wait_in_predicate_loop_clean(self):
+        src = """
+import threading
+
+class Box:
+    def __init__(self):
+        self._cv = threading.Condition()
+        self.ready = False
+
+    def take(self):
+        with self._cv:
+            while not self.ready:
+                self._cv.wait()
+
+    def put(self):
+        with self._cv:
+            self.ready = True
+            self._cv.notify_all()
+"""
+        assert run_project("blocking-under-lock",
+                           {"pkg/observability/box.py": src}) == []
+
+    def test_notify_without_cv_held(self):
+        src = """
+import threading
+
+class Box:
+    def __init__(self):
+        self._cv = threading.Condition()
+        self.ready = False
+
+    def put(self):
+        self.ready = True
+        self._cv.notify_all()
+"""
+        fs = run_project("blocking-under-lock",
+                         {"pkg/observability/box.py": src})
+        assert rule_ids(fs) == ["blocking-under-lock"]
+        assert "without holding" in fs[0].message
+
+    def test_timeoutless_queue_get_under_lock(self):
+        src = """
+import queue
+import threading
+
+class Pump:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._q = queue.Queue()
+
+    def drain(self):
+        with self._lock:
+            return self._q.get()
+"""
+        fs = run_project("blocking-under-lock",
+                         {"pkg/observability/pump.py": src})
+        assert rule_ids(fs) == ["blocking-under-lock"]
+        assert "timeout-less" in fs[0].message
+
+    def test_bounded_queue_get_clean(self):
+        src = """
+import queue
+import threading
+
+class Pump:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._q = queue.Queue()
+
+    def drain(self):
+        with self._lock:
+            return self._q.get(timeout=0.5)
+"""
+        assert run_project("blocking-under-lock",
+                           {"pkg/observability/pump.py": src}) == []
+
+    def test_thread_reachable_timeoutless_get_no_lock(self):
+        # the CheckpointManager._writer_loop shape: no lock held, but
+        # the loop can never observe shutdown -> close() hangs
+        src = """
+import queue
+import threading
+
+class Writer:
+    def __init__(self):
+        self._q = queue.Queue()
+        threading.Thread(target=self._loop, daemon=True).start()
+
+    def _loop(self):
+        while True:
+            job = self._q.get()
+            if job is None:
+                return
+"""
+        fs = run_project("blocking-under-lock",
+                         {"pkg/distributed/checkpoint/w.py": src})
+        assert rule_ids(fs) == ["blocking-under-lock"]
+        assert "Thread-reachable" in fs[0].message
+
+    def test_file_io_under_lock(self):
+        src = """
+import threading
+
+class Dump:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def write(self, path, rows):
+        with self._lock:
+            with open(path, "w") as fh:
+                fh.write(str(rows))
+"""
+        fs = run_project("blocking-under-lock",
+                         {"pkg/observability/dump.py": src})
+        assert rule_ids(fs) == ["blocking-under-lock"]
+        assert "file I/O" in fs[0].message
+
+    def test_out_of_scope_module_not_reported(self):
+        src = """
+import jax
+import threading
+
+class Engine:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._step = jax.jit(lambda x: x)
+
+    def run(self, x):
+        with self._lock:
+            return self._step(x)
+"""
+        assert run_project("blocking-under-lock",
+                           {"pkg/nn/functional.py": src}) == []
+
+
+# ---------------------------------------------------------------------------
+# mesh-axis-contract (ISSUE 17)
+# ---------------------------------------------------------------------------
+class TestMeshAxisContract:
+    def test_unknown_axis_literal_in_collective(self):
+        src = """
+from paddle_tpu.distributed.collective import t_psum
+
+def allreduce(x):
+    return t_psum(x, "model")
+"""
+        fs = run_project("mesh-axis-contract", {"pkg/layers.py": src})
+        assert rule_ids(fs) == ["mesh-axis-contract"]
+        assert "'model'" in fs[0].message
+
+    def test_canonical_axis_clean(self):
+        src = """
+from paddle_tpu.distributed.collective import t_psum, t_all_gather
+
+def allreduce(x):
+    x = t_psum(x, "dp")
+    return t_all_gather(x, ("sharding",), axis=0, tiled=True)
+"""
+        assert run_project("mesh-axis-contract",
+                           {"pkg/layers.py": src}) == []
+
+    def test_shard_map_scoped_axis_clean(self):
+        # an axis declared by an in-tree Mesh is in scope everywhere,
+        # including a shard_map body that names it in specs
+        src = """
+import jax
+from jax.sharding import Mesh, PartitionSpec as P
+from jax.experimental.shard_map import shard_map
+
+mesh = Mesh(jax.devices(), ("x", "y"))
+
+def f(v):
+    return shard_map(lambda a: a, mesh=mesh,
+                     in_specs=P("x", None), out_specs=P("x", None))(v)
+"""
+        assert run_project("mesh-axis-contract",
+                           {"pkg/maps.py": src}) == []
+
+    def test_unknown_axis_in_partition_spec(self):
+        src = """
+from jax.sharding import PartitionSpec as P
+
+def spec():
+    return P("modle", None)
+"""
+        fs = run_project("mesh-axis-contract", {"pkg/specs.py": src})
+        assert rule_ids(fs) == ["mesh-axis-contract"]
+        assert "'modle'" in fs[0].message
+
+    def test_nested_tuple_spec_entry_checked(self):
+        src = """
+from jax.sharding import PartitionSpec as P
+
+def spec():
+    return P(("dp", "zz"), None)
+"""
+        fs = run_project("mesh-axis-contract", {"pkg/specs.py": src})
+        assert rule_ids(fs) == ["mesh-axis-contract"]
+        assert "'zz'" in fs[0].message
+
+    def test_dynamic_axis_skipped(self):
+        src = """
+from paddle_tpu.distributed.collective import t_psum
+
+def allreduce(x, axis_name):
+    return t_psum(x, axis_name)
+"""
+        assert run_project("mesh-axis-contract",
+                           {"pkg/layers.py": src}) == []
+
+    def test_order_constant_extends_vocabulary(self):
+        topo = 'CUSTOM_AXIS_ORDER = ("rowwise", "colwise")\n'
+        use = """
+from paddle_tpu.distributed.collective import t_psum
+
+def allreduce(x):
+    return t_psum(x, "rowwise")
+"""
+        assert run_project("mesh-axis-contract",
+                           {"pkg/topo.py": topo, "pkg/use.py": use}) == []
+
+    def test_scatter_dim_contradicts_spec(self):
+        src = """
+from jax.sharding import PartitionSpec as P
+from paddle_tpu.distributed.collective import t_psum_scatter
+
+def shard(g):
+    spec = P(None, "sharding")
+    return t_psum_scatter(g, "sharding", scatter_dimension=0,
+                          tiled=True)
+"""
+        fs = run_project("mesh-axis-contract", {"pkg/zero.py": src})
+        assert rule_ids(fs) == ["mesh-axis-contract"]
+        assert "scatter_dimension=0" in fs[0].message
+
+    def test_scatter_dim_matches_spec_clean(self):
+        src = """
+from jax.sharding import PartitionSpec as P
+from paddle_tpu.distributed.collective import t_psum_scatter
+
+def shard(g):
+    spec = P(None, "sharding")
+    return t_psum_scatter(g, "sharding", scatter_dimension=1,
+                          tiled=True)
+"""
+        assert run_project("mesh-axis-contract",
+                           {"pkg/zero.py": src}) == []
+
+
+# ---------------------------------------------------------------------------
 # suppressions
 # ---------------------------------------------------------------------------
 class TestSuppression:
@@ -1010,13 +1478,16 @@ class TestWholeTreeGate:
 
     def test_rule_catalog_complete(self):
         # five per-module trace-safety rules (ISSUE 2) + five
-        # interprocedural contract rules (ISSUE 13 acceptance)
+        # interprocedural contract rules (ISSUE 13) + the lock-graph
+        # and mesh-axis contract rules (ISSUE 17 acceptance)
         assert set(RULES_BY_ID) == {
             "unused-knob", "host-sync-in-jit", "traced-bool",
             "nonhashable-static", "recompile-hazard",
             "raw-collective", "unregistered-metric",
             "vjp-ledger-symmetry", "donation-reuse",
-            "unguarded-shared-mutation"}
+            "unguarded-shared-mutation",
+            "lock-order-cycle", "blocking-under-lock",
+            "mesh-axis-contract"}
 
 
 # ---------------------------------------------------------------------------
@@ -1025,6 +1496,8 @@ class TestWholeTreeGate:
 NEW_RULES = {"raw-collective", "unregistered-metric",
              "vjp-ledger-symmetry", "donation-reuse",
              "unguarded-shared-mutation"}
+LOCK_MESH_RULES = {"lock-order-cycle", "blocking-under-lock",
+                   "mesh-axis-contract"}
 PINNED_ZERO_PREFIXES = ("paddle_tpu/observability/",
                         "paddle_tpu/distributed/checkpoint/",
                         "paddle_tpu/inference/serving.py",
@@ -1047,6 +1520,33 @@ class TestContractRulePins:
                if e["rule"] in NEW_RULES
                and e["path"].startswith(PINNED_ZERO_PREFIXES)]
         assert bad == [], f"contract-rule debt in pinned dirs: {bad}"
+
+    def test_lock_mesh_rules_have_zero_baseline_in_pinned_dirs(self):
+        """ISSUE 17 pin: serving.py, distributed/checkpoint/ and
+        observability/ carry ZERO baseline entries for the lock-graph
+        and mesh-axis rules — a deadlock edge, a blocking call under
+        the admission lock, or a bad axis literal there is fixed in
+        the PR that introduces it, never grandfathered."""
+        baseline = load_baseline(REPO / "tools/tpulint/baseline.json")
+        bad = [e for e in baseline
+               if e["rule"] in LOCK_MESH_RULES
+               and e["path"].startswith(
+                   ("paddle_tpu/inference/serving.py",
+                    "paddle_tpu/distributed/checkpoint/",
+                    "paddle_tpu/observability/"))]
+        assert bad == [], f"lock/mesh-rule debt in pinned dirs: {bad}"
+
+    def test_lock_mesh_rules_whole_tree_clean(self):
+        """Stronger than the pin: the three ISSUE 17 rules currently
+        hold tree-wide with an EMPTY baseline (no grandfathered
+        entries anywhere)."""
+        baseline = load_baseline(REPO / "tools/tpulint/baseline.json")
+        assert [e for e in baseline if e["rule"] in LOCK_MESH_RULES] == []
+        findings = lint_paths([REPO / "paddle_tpu"],
+                              select_rules(sorted(LOCK_MESH_RULES)),
+                              root=REPO)
+        assert findings == [], "\n".join(
+            f"{f.path}:{f.line}: {f.rule}: {f.message}" for f in findings)
 
     def test_every_baseline_entry_is_justified(self):
         baseline = load_baseline(REPO / "tools/tpulint/baseline.json")
@@ -1092,6 +1592,36 @@ class TestCLI:
         r = _cli(str(bad))
         assert r.returncode == 1
         assert "unused-knob" in r.stdout
+
+    def test_sarif_format(self, tmp_path):
+        """--format sarif: valid SARIF 2.1.0 with the rule catalog as
+        reportingDescriptors, new findings at warning level, and the
+        same exit-code contract as text/json."""
+        bad = tmp_path / "seeded.py"
+        bad.write_text("def api(x, knob=False):\n    return x\n")
+        r = _cli(str(bad), "--format", "sarif")
+        assert r.returncode == 1
+        doc = json.loads(r.stdout)
+        assert doc["version"] == "2.1.0"
+        run = doc["runs"][0]
+        assert run["tool"]["driver"]["name"] == "tpulint"
+        assert {d["id"] for d in run["tool"]["driver"]["rules"]} \
+            == set(RULES_BY_ID)
+        res = [x for x in run["results"] if x["level"] == "warning"]
+        assert res and res[0]["ruleId"] == "unused-knob"
+        loc = res[0]["locations"][0]["physicalLocation"]
+        assert loc["artifactLocation"]["uri"].endswith("seeded.py")
+        assert loc["region"]["startLine"] == 1
+
+    def test_sarif_clean_tree_exits_zero_with_notes(self):
+        r = _cli("paddle_tpu/", "--format", "sarif")
+        assert r.returncode == 0, r.stdout[-2000:] + r.stderr
+        doc = json.loads(r.stdout)
+        results = doc["runs"][0]["results"]
+        # every result is a baselined note, none a new warning
+        assert all(x["level"] == "note"
+                   and x["baselineState"] == "unchanged"
+                   for x in results)
 
     def test_select_and_list_rules(self, tmp_path):
         bad = tmp_path / "seeded.py"

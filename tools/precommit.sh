@@ -1,0 +1,18 @@
+#!/usr/bin/env bash
+# Pre-commit lint: tpulint over the files your diff touches.
+#
+# Findings are reported only for changed files, but the
+# interprocedural facts (call graph, thread reachability, the lock
+# graph, collective/donation taint) are always built from the whole
+# tree — a changed caller is judged against unchanged callees.
+#
+# Install as a git hook:
+#     ln -s ../../tools/precommit.sh .git/hooks/pre-commit
+#
+# Exit codes follow tpulint: 0 clean-vs-baseline, 1 new findings,
+# 2 usage error. CI runs the same invocation with
+# `--format sarif > tpulint.sarif` for inline PR annotations.
+set -u
+cd "$(dirname "$0")/.."
+REF="${TPULINT_REF:-HEAD}"
+exec python -m tools.tpulint paddle_tpu --changed "$REF" "$@"

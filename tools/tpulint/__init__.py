@@ -3,19 +3,21 @@ analyzer for paddle_tpu.
 
 Run it:
 
-    python -m tools.tpulint paddle_tpu/            # human output
-    python -m tools.tpulint paddle_tpu/ --json     # machine-readable
-    python -m tools.tpulint --changed origin/main  # incremental
+    python -m tools.tpulint paddle_tpu/             # human output
+    python -m tools.tpulint paddle_tpu/ --json      # machine-readable
+    python -m tools.tpulint --format sarif          # CI annotations
+    python -m tools.tpulint --changed origin/main   # incremental
     python -m tools.tpulint --list-rules
 
-Ten rules ship (see README "Static analysis" for the catalog with
-examples). Five are per-module trace-safety rules: unused-knob,
+Thirteen rules ship (see README "Static analysis" for the catalog
+with examples). Five are per-module trace-safety rules: unused-knob,
 host-sync-in-jit, traced-bool, nonhashable-static, recompile-hazard.
-Five are package-wide interprocedural contract rules riding the
+Eight are package-wide interprocedural contract rules riding the
 ``Project`` pass (cross-module import/call graph, Thread-target
-reachability, collective/donation taint): raw-collective,
-unregistered-metric, vjp-ledger-symmetry, donation-reuse,
-unguarded-shared-mutation.
+reachability, collective/donation taint, and the lock graph):
+raw-collective, unregistered-metric, vjp-ledger-symmetry,
+donation-reuse, unguarded-shared-mutation, lock-order-cycle,
+blocking-under-lock, mesh-axis-contract.
 
 Suppress a single site with ``# tpulint: disable=<rule>`` on (or on a
 comment line directly above) the reported line; grandfathered

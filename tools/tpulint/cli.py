@@ -1,9 +1,11 @@
 """tpulint CLI: ``python -m tools.tpulint [paths] [options]``.
 
 Exit codes: 0 = clean (every finding baselined or none), 1 = new
-violations, 2 = usage error. ``--json`` emits one machine-readable
-report on stdout (bench/verdict rounds track ``baseline_size`` /
-``new`` from it).
+violations, 2 = usage error. ``--format json`` (alias ``--json``)
+emits one machine-readable report on stdout (bench/verdict rounds
+track ``baseline_size`` / ``new`` from it); ``--format sarif`` emits a
+SARIF 2.1.0 log so CI renders findings as inline annotations (new
+findings at ``warning``, baselined ones as ``note``/``unchanged``).
 
 Incremental mode: ``--changed <git-ref>`` lints only the files changed
 vs the ref (plus untracked files), but the interprocedural facts —
@@ -43,7 +45,12 @@ def build_parser() -> argparse.ArgumentParser:
                     help="files or directories to lint "
                          "(default: paddle_tpu)")
     ap.add_argument("--json", action="store_true", dest="as_json",
-                    help="emit a machine-readable JSON report")
+                    help="emit a machine-readable JSON report "
+                         "(alias for --format json)")
+    ap.add_argument("--format", choices=("text", "json", "sarif"),
+                    default=None,
+                    help="output format (default: text; sarif emits a "
+                         "SARIF 2.1.0 log for CI annotations)")
     ap.add_argument("--baseline", type=Path, default=None,
                     help=f"baseline file (default: {DEFAULT_BASELINE})")
     ap.add_argument("--no-baseline", action="store_true",
@@ -194,7 +201,12 @@ def main(argv: Optional[List[str]] = None) -> int:
         if f.rule in stats:
             stats[f.rule]["baselined"] += 1
 
-    if args.as_json:
+    fmt = args.format or ("json" if args.as_json else "text")
+    if fmt == "sarif":
+        print(json.dumps(_sarif_report(rules, new, matched), indent=1))
+        return 1 if new else 0
+
+    if fmt == "json":
         counts = {}
         for f in new:
             counts[f.rule] = counts.get(f.rule, 0) + 1
@@ -243,6 +255,48 @@ def main(argv: Optional[List[str]] = None) -> int:
           f"{len(matched)} baselined"
           + (f", {len(stale)} stale baseline" if stale else ""))
     return 1 if new else 0
+
+
+def _sarif_report(rules, new: List[Finding],
+                  matched: List[Finding]) -> Dict:
+    """SARIF 2.1.0: one run, the rule catalog as reportingDescriptors,
+    new findings at ``warning`` level, baselined ones downgraded to
+    ``note`` with ``baselineState: unchanged`` so CI only annotates
+    regressions."""
+    results = []
+    for f, baselined in [(f, False) for f in new] \
+            + [(f, True) for f in matched]:
+        results.append({
+            "ruleId": f.rule,
+            "level": "note" if baselined else "warning",
+            "baselineState": "unchanged" if baselined else "new",
+            "message": {"text": f.message},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {"uri": f.path,
+                                         "uriBaseId": "SRCROOT"},
+                    "region": {"startLine": f.line,
+                               "startColumn": f.col + 1},
+                },
+                "logicalLocations": [{"fullyQualifiedName": f.symbol}],
+            }],
+            "partialFingerprints": {
+                "tpulint/v1": "|".join(f.fingerprint())},
+        })
+    return {
+        "$schema": "https://json.schemastore.org/sarif-2.1.0.json",
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "tpulint",
+                "rules": [{"id": r.id,
+                           "shortDescription": {"text": r.description}}
+                          for r in rules],
+            }},
+            "originalUriBaseIds": {"SRCROOT": {"uri": "file:///./"}},
+            "results": results,
+        }],
+    }
 
 
 def _write_baseline(args, baseline_path: Path,

@@ -27,7 +27,21 @@ facts the contract rules need:
 - *donation facts*: attributes/stores/factory methods bound to
   ``jax.jit(..., donate_argnums=...)`` results, and forwarder wrappers
   (``def _run(self, site, fn, *args): ... fn(*args)``) so a donated
-  buffer read after the dispatch is visible through one indirection.
+  buffer read after the dispatch is visible through one indirection;
+- *lock facts* (:class:`LockFacts`): a whole-tree lock-ordering graph
+  — every lock the concurrency facts know (class lock attrs, module-
+  level ``threading.Lock()``/``Condition()`` globals) becomes a node,
+  and an acquired-while-held edge is recorded whenever a lock is taken
+  with another one held: directly (``with self.A: ... with self.B:``),
+  through the entry-held fixpoint (a helper only ever called under the
+  lock acquiring a second one), or through cross-module call
+  resolution (a method holding ``A`` calling a function that
+  transitively acquires ``B``). Each edge carries the thread
+  entrypoint whose code exercises it (``<main>`` for code no Thread
+  target reaches), which is what lets the lock-order-cycle rule demand
+  two distinct entrypoints before calling a cycle a deadlock. The same
+  pass records every call made with at least one lock held — the
+  blocking-under-lock rule's input.
 
 Everything is a heuristic tuned to this repo's idiom, like the core
 taint pass: pragmas and the justified baseline absorb the residue.
@@ -76,6 +90,10 @@ LOCK_CTORS = {"Lock", "RLock", "Condition"}
 THREADSAFE_CTORS = {"Queue", "LifoQueue", "PriorityQueue", "SimpleQueue",
                     "Event", "Semaphore", "BoundedSemaphore", "Barrier",
                     "local"}
+# finer classification the lock rules need: queues block on .get(),
+# events/conditions block on .wait(), threads block on .join()
+QUEUE_CTORS = {"Queue", "LifoQueue", "PriorityQueue", "SimpleQueue"}
+EVENT_CTORS = {"Event"}
 # container-method calls that mutate the receiver in place
 MUTATING_METHODS = {"append", "appendleft", "extend", "extendleft",
                     "insert", "pop", "popleft", "popitem", "remove",
@@ -134,6 +152,13 @@ class ClassInfo:
             for b in node.bases)
         self.lock_attrs: Set[str] = set()
         self.threadsafe_attrs: Set[str] = set()
+        # sub-classifications of the above (ctor-based, so an attr
+        # only ever inferred from `with self.X:` lands in lock_attrs
+        # but not cond_attrs — treated as a plain mutex)
+        self.cond_attrs: Set[str] = set()
+        self.queue_attrs: Set[str] = set()
+        self.event_attrs: Set[str] = set()
+        self.thread_attrs: Set[str] = set()
         # attr -> [(node, method, is_mutation)]
         self.accesses: Dict[str, List[Tuple[ast.AST, ast.AST, bool]]] = {}
         self._entry_held: Optional[Dict[int, FrozenSet[str]]] = None
@@ -203,8 +228,16 @@ class ClassInfo:
         name = func_simple_name(value.func)
         if name in LOCK_CTORS:
             self.lock_attrs.add(attr)
+            if name == "Condition":
+                self.cond_attrs.add(attr)
         elif name in THREADSAFE_CTORS:
             self.threadsafe_attrs.add(attr)
+            if name in QUEUE_CTORS:
+                self.queue_attrs.add(attr)
+            elif name in EVENT_CTORS:
+                self.event_attrs.add(attr)
+        elif name == "Thread":
+            self.thread_attrs.add(attr)
 
     # -- lock analysis ---------------------------------------------------
     def locks_held_at(self, node: ast.AST) -> FrozenSet[str]:
@@ -314,6 +347,7 @@ class Project:
         self._thread_reachable: Optional[Set[FuncKey]] = None
         self._thread_entries: Dict[FuncKey, str] = {}
         self._coll_cache: Dict[FuncKey, Set[str]] = {}
+        self._lock_facts: Optional["LockFacts"] = None
 
     # -- construction ----------------------------------------------------
     @classmethod
@@ -581,6 +615,15 @@ class Project:
     def is_thread_reachable(self, mod: ModuleInfo, fn: ast.AST) -> bool:
         return (mod.relpath, id(fn)) in self.thread_reachable()
 
+    # -- lock facts ------------------------------------------------------
+    def lock_facts(self) -> "LockFacts":
+        """The whole-tree lock graph + under-lock call sites (built
+        once, shared by the lock-order-cycle and blocking-under-lock
+        rules)."""
+        if self._lock_facts is None:
+            self._lock_facts = LockFacts(self)
+        return self._lock_facts
+
     # -- collective taint ------------------------------------------------
     def collective_kinds(self, mod: ModuleInfo, fn: ast.AST
                          ) -> Set[str]:
@@ -614,6 +657,224 @@ class Project:
             return kinds
 
         return dfs(mod, fn)
+
+
+class LockFacts:
+    """Whole-tree lock graph + under-lock call sites (see module
+    docstring). Lock identity is conservative: one node per *declared*
+    lock — ``relpath:Class.attr`` for instance locks (every instance of
+    a class maps to the same node) and ``relpath:name`` for module-
+    level lock globals. ``kinds`` remembers which nodes are Condition
+    variables (their ``wait`` is protocol, not blocking-under-lock).
+
+    ``edges``: ``(held, acquired) -> [(relpath, lineno, context,
+    detail)]`` — every site where ``acquired`` is taken with ``held``
+    already held. ``context`` is the Thread entrypoint whose code runs
+    the site (``<main>`` when no Thread target reaches it).
+
+    ``held_calls``: ``[(mod, fn, call, held_ids)]`` for every Call
+    executed with at least one lock held (lexical ``with`` nesting plus
+    the class entry-held fixpoint; nested defs/lambdas do not inherit).
+    """
+
+    def __init__(self, project: "Project"):
+        self.project = project
+        self.kinds: Dict[str, str] = {}     # lock id -> "lock" | "cond"
+        self.edges: Dict[Tuple[str, str],
+                         List[Tuple[str, int, str, str]]] = {}
+        self.held_calls: List[Tuple[ModuleInfo, ast.AST, ast.Call,
+                                    Tuple[str, ...]]] = []
+        self._module_locks: Dict[str, Dict[str, str]] = {}
+        self._acq_cache: Dict[FuncKey, FrozenSet[str]] = {}
+        self._acq_visiting: Set[FuncKey] = set()
+        self._build()
+
+    # -- lock identity ---------------------------------------------------
+    def module_locks(self, mod: ModuleInfo) -> Dict[str, str]:
+        """Module-level lock globals: {bound name: "lock" | "cond"}."""
+        cached = self._module_locks.get(mod.relpath)
+        if cached is not None:
+            return cached
+        out: Dict[str, str] = {}
+        for node in mod.tree.body:
+            if not isinstance(node, ast.Assign) or \
+                    not isinstance(node.value, ast.Call):
+                continue
+            name = func_simple_name(node.value.func)
+            if name not in LOCK_CTORS:
+                continue
+            kind = "cond" if name == "Condition" else "lock"
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    out[tgt.id] = kind
+        self._module_locks[mod.relpath] = out
+        return out
+
+    def resolve_lock(self, mod: ModuleInfo, scope: Optional[ast.AST],
+                     expr: ast.expr) -> Optional[str]:
+        """Lock node id of an acquisition expression (``self.X``, a
+        module-level lock name, or ``alias.X`` through an import), or
+        None for anything unresolvable."""
+        # self.X / cls.X on the enclosing class
+        if isinstance(expr, ast.Attribute) and \
+                isinstance(expr.value, ast.Name) and \
+                expr.value.id in ("self", "cls") and scope is not None:
+            ci = self.project.class_of(mod, scope)
+            cur = scope
+            while ci is None and cur is not None:
+                cur = mod.enclosing_function(cur)
+                if cur is not None:
+                    ci = self.project.class_of(mod, cur)
+            if ci is not None and expr.attr in ci.lock_attrs:
+                lid = f"{mod.relpath}:{ci.name}.{expr.attr}"
+                self.kinds.setdefault(
+                    lid, "cond" if expr.attr in ci.cond_attrs
+                    else "lock")
+                return lid
+            return None
+        if isinstance(expr, ast.Name):
+            kind = self.module_locks(mod).get(expr.id)
+            if kind is not None:
+                lid = f"{mod.relpath}:{expr.id}"
+                self.kinds.setdefault(lid, kind)
+                return lid
+            return None
+        # alias.X where alias imports a project module
+        chain = _flatten_chain(expr)
+        if chain is not None and len(chain) == 2:
+            imp = self.project.imports(mod).get(chain[0])
+            if imp is not None and imp[0] == "module":
+                m2 = self.project.by_modname.get(imp[1])
+                if m2 is not None:
+                    kind = self.module_locks(m2).get(chain[1])
+                    if kind is not None:
+                        lid = f"{m2.relpath}:{chain[1]}"
+                        self.kinds.setdefault(lid, kind)
+                        return lid
+        return None
+
+    # -- transitive "locks this function acquires" ----------------------
+    def acquires(self, mod: ModuleInfo, fn: ast.AST) -> FrozenSet[str]:
+        """Lock ids ``fn`` (or anything it calls, cross-module)
+        acquires; cycles truncate, unresolvable calls contribute
+        nothing (conservative toward silence)."""
+        key = (mod.relpath, id(fn))
+        cached = self._acq_cache.get(key)
+        if cached is not None:
+            return cached
+        if key in self._acq_visiting:
+            return frozenset()
+        self._acq_visiting.add(key)
+        out: Set[str] = set()
+        for node in self._own_nodes(fn):
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    lid = self.resolve_lock(mod, fn, item.context_expr)
+                    if lid is not None:
+                        out.add(lid)
+            elif isinstance(node, ast.Call):
+                if isinstance(node.func, ast.Attribute) and \
+                        node.func.attr == "acquire":
+                    lid = self.resolve_lock(mod, fn, node.func.value)
+                    if lid is not None:
+                        out.add(lid)
+                else:
+                    for m2, f2 in self.project.resolve_callable(
+                            mod, fn, node.func):
+                        out |= self.acquires(m2, f2)
+        self._acq_visiting.discard(key)
+        result = frozenset(out)
+        self._acq_cache[key] = result
+        return result
+
+    @staticmethod
+    def _own_nodes(fn: ast.AST) -> Iterator[ast.AST]:
+        """ast.walk(fn) minus the bodies of nested defs/lambdas (they
+        run later, under whatever locks their CALLER holds)."""
+        stack = list(ast.iter_child_nodes(fn))
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                continue
+            yield node
+            stack.extend(ast.iter_child_nodes(node))
+
+    # -- the walk --------------------------------------------------------
+    def _build(self) -> None:
+        for mod in self.project.modules:
+            for fn in mod.functions():
+                self._walk_fn(mod, fn)
+
+    def _entry_held_ids(self, mod: ModuleInfo, fn: ast.AST
+                        ) -> Tuple[str, ...]:
+        ci = self.project.class_of(mod, fn)
+        if ci is None:
+            return ()
+        held = ci.entry_held().get(id(fn), frozenset())
+        out = []
+        for attr in sorted(held):
+            lid = f"{mod.relpath}:{ci.name}.{attr}"
+            self.kinds.setdefault(
+                lid, "cond" if attr in ci.cond_attrs else "lock")
+            out.append(lid)
+        return tuple(out)
+
+    def _walk_fn(self, mod: ModuleInfo, fn: ast.AST) -> None:
+        context = self.project.thread_entry_of(mod, fn) or "<main>"
+        self._visit(mod, fn, fn.body, self._entry_held_ids(mod, fn),
+                    context)
+
+    def _edge(self, held: Tuple[str, ...], acquired: str,
+              mod: ModuleInfo, node: ast.AST, context: str,
+              detail: str) -> None:
+        for h in held:
+            if h == acquired:
+                continue            # re-entry, not an ordering edge
+            self.edges.setdefault((h, acquired), []).append(
+                (mod.relpath, getattr(node, "lineno", 0), context,
+                 detail))
+
+    def _visit(self, mod: ModuleInfo, fn: ast.AST, body,
+               held: Tuple[str, ...], context: str) -> None:
+        for node in body if isinstance(body, list) else [body]:
+            self._visit_node(mod, fn, node, held, context)
+
+    def _visit_node(self, mod: ModuleInfo, fn: ast.AST, node: ast.AST,
+                    held: Tuple[str, ...], context: str) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            return                  # walked as its own entry
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            inner = list(held)
+            for item in node.items:
+                self._visit_node(mod, fn, item.context_expr,
+                                 tuple(inner), context)
+                lid = self.resolve_lock(mod, fn, item.context_expr)
+                if lid is not None:
+                    self._edge(tuple(inner), lid, mod, item.context_expr,
+                               context, "with")
+                    if lid not in inner:
+                        inner.append(lid)
+            self._visit(mod, fn, node.body, tuple(inner), context)
+            return
+        if isinstance(node, ast.Call):
+            if held:
+                self.held_calls.append((mod, fn, node, held))
+            if isinstance(node.func, ast.Attribute) and \
+                    node.func.attr == "acquire":
+                lid = self.resolve_lock(mod, fn, node.func.value)
+                if lid is not None:
+                    self._edge(held, lid, mod, node, context, "acquire")
+            elif held:
+                for m2, f2 in self.project.resolve_callable(
+                        mod, fn, node.func):
+                    for lid in sorted(self.acquires(m2, f2)):
+                        if lid not in held:
+                            self._edge(held, lid, mod, node, context,
+                                       f"call {func_simple_name(node.func)}")
+        for child in ast.iter_child_nodes(node):
+            self._visit_node(mod, fn, child, held, context)
 
 
 class ProjectRule(Rule):

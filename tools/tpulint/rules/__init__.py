@@ -9,8 +9,11 @@ from __future__ import annotations
 from typing import Dict, List
 
 from ..core import Rule
+from .blocking_under_lock import BlockingUnderLockRule
 from .donation_reuse import DonationReuseRule
 from .host_sync import HostSyncInJitRule
+from .lock_order import LockOrderCycleRule
+from .mesh_axis import MeshAxisContractRule
 from .nonhashable_static import NonhashableStaticRule
 from .raw_collective import RawCollectiveRule
 from .recompile_hazard import RecompileHazardRule
@@ -32,6 +35,10 @@ ALL_RULES: List[Rule] = [
     VjpSymmetryRule(),
     DonationReuseRule(),
     SharedMutationRule(),
+    # the lock-graph rules (Project.lock_facts) + the mesh-axis contract
+    LockOrderCycleRule(),
+    BlockingUnderLockRule(),
+    MeshAxisContractRule(),
 ]
 
 RULES_BY_ID: Dict[str, Rule] = {r.id: r for r in ALL_RULES}

@@ -1,0 +1,354 @@
+"""blocking-under-lock: no slow or unbounded work while a lock is held.
+
+The serving admission RLock and the checkpoint condition variable sit
+on every hot path; a jitted dispatch (compile + device execute), a
+``block_until_ready``/``device_get`` sync, a timeout-less
+``Queue.get``/``Thread.join``/``Event.wait``, file I/O, HTTP, or a
+bare ``time.sleep`` executed inside one of those critical sections
+serializes every other thread behind device or kernel time. The rule
+consumes the Project lock graph's under-lock call sites (lexical
+``with`` nesting plus the class entry-held fixpoint, so a private
+helper only ever called under the lock is still "under the lock") and
+convicts the blocking categories above.
+
+Condition variables get protocol treatment instead of a blanket ban:
+``cv.wait()`` with the *same* cv held is the correct idiom and is
+exempt — unless a *different* lock is also held across the wait (that
+lock is then pinned for an unbounded sleep). Two protocol sub-checks
+ride along: ``cv.wait()`` outside a predicate loop (spurious wakeups
+make the bare ``if``/``wait`` form wrong; ``wait_for`` encodes the
+loop) and ``notify``/``notify_all`` without the condition held.
+
+A liveness sub-check covers teardown: a timeout-less ``Queue.get()``
+or ``Thread.join()`` in Thread-target-reachable code can never
+observe shutdown — ``close()`` hangs behind it even with no lock held,
+so those are flagged lock or no lock.
+
+Scope mirrors unguarded-shared-mutation: the concurrent host-side
+surfaces (serving, checkpointing, observability, elastic, watchdog).
+Rebinding a jitted callable under the lock and dispatching after
+release is the sanctioned pattern and is not flagged.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, Optional, Set, Tuple
+
+from ..core import Finding, ModuleInfo, func_simple_name
+from ..project import Project, ProjectRule, _flatten_chain
+from .shared_mutation import _in_scope
+
+# os.* entry points that hit the filesystem (os.path.* is pure string
+# manipulation and stays exempt via the chain-length check).
+OS_IO = {"listdir", "makedirs", "mkdir", "rename", "replace", "remove",
+         "unlink", "rmdir", "stat", "scandir", "walk", "fsync", "open"}
+SYNC_NAMES = {"block_until_ready", "device_get"}
+
+
+def _self_attr(expr: ast.expr) -> Optional[str]:
+    if isinstance(expr, ast.Attribute) and \
+            isinstance(expr.value, ast.Name) and \
+            expr.value.id in ("self", "cls"):
+        return expr.attr
+    return None
+
+
+def _is_jit_value(value: ast.AST) -> bool:
+    return isinstance(value, ast.Call) and \
+        func_simple_name(value.func) in ("jit", "pjit")
+
+
+def _timeoutless(call: ast.Call) -> bool:
+    """No positional timeout and no timeout=/block= kwarg: the call
+    can block forever."""
+    if call.args:
+        return False
+    return not any(kw.arg in ("timeout", "block") for kw in call.keywords)
+
+
+class BlockingUnderLockRule(ProjectRule):
+    id = "blocking-under-lock"
+    description = ("jitted dispatch, device sync, unbounded wait, or "
+                   "I/O while a lock is held (or in teardown-critical "
+                   "thread code)")
+
+    def check_project(self, project: Project) -> Iterator[Finding]:
+        facts = project.lock_facts()
+        self._jit_cache: Dict[str, Tuple[Set[str], Dict[str, Set[str]]]] = {}
+        self._local_cache: Dict[Tuple[str, int], Set[str]] = {}
+        held_map: Dict[int, Tuple[str, ...]] = {
+            id(call): held for _m, _f, call, held in facts.held_calls}
+        seen: Set[Tuple[str, int, str]] = set()
+
+        def emit(mod: ModuleInfo, node: ast.AST, kind: str,
+                 message: str) -> Iterator[Finding]:
+            key = (mod.relpath, getattr(node, "lineno", 0), kind)
+            if key not in seen:
+                seen.add(key)
+                yield self.finding(mod, node, message)
+
+        for mod, fn, call, held in facts.held_calls:
+            if not _in_scope(mod.relpath):
+                continue
+            yield from self._check_held_call(
+                project, facts, mod, fn, call, held, emit)
+        for mod in project.modules:
+            if not _in_scope(mod.relpath):
+                continue
+            yield from self._check_cv_protocol(
+                project, facts, mod, held_map, emit)
+            yield from self._check_teardown_liveness(
+                project, facts, mod, held_map, emit)
+
+    # -- under-lock categories -------------------------------------------
+    def _check_held_call(self, project: Project, facts, mod: ModuleInfo,
+                         fn: ast.AST, call: ast.Call,
+                         held: Tuple[str, ...], emit) -> Iterator[Finding]:
+        func = call.func
+        locks = ", ".join(held)
+        ci = project.class_of(mod, fn)
+
+        if self._is_jit_dispatch(project, mod, fn, func):
+            yield from emit(
+                mod, call, "jit",
+                f"jitted dispatch while holding {locks} — compile + "
+                f"device execution serialize every other thread on the "
+                f"lock; bind the callable under the lock, dispatch "
+                f"after release")
+            return
+        name = func_simple_name(func)
+        if name in SYNC_NAMES:
+            yield from emit(
+                mod, call, "sync",
+                f"device sync ({name}) while holding {locks} — blocks "
+                f"for full device latency; copy out after releasing")
+            return
+
+        attr = _self_attr(func.value) if isinstance(func, ast.Attribute) \
+            else None
+        if ci is not None and attr is not None:
+            if name == "get" and attr in ci.queue_attrs and \
+                    _timeoutless(call):
+                yield from emit(
+                    mod, call, "queue-get",
+                    f"timeout-less self.{attr}.get() while holding "
+                    f"{locks} — unbounded block with the lock pinned; "
+                    f"use get(timeout=...) or move the get outside")
+                return
+            if name == "join" and attr in ci.thread_attrs and \
+                    _timeoutless(call):
+                yield from emit(
+                    mod, call, "join",
+                    f"timeout-less self.{attr}.join() while holding "
+                    f"{locks} — the joined thread may need that very "
+                    f"lock to exit; join(timeout=...) outside the lock")
+                return
+            if name == "wait" and attr in ci.event_attrs and \
+                    _timeoutless(call):
+                yield from emit(
+                    mod, call, "event-wait",
+                    f"timeout-less self.{attr}.wait() while holding "
+                    f"{locks} — the setter may need the lock; wait "
+                    f"with a timeout outside the critical section")
+                return
+        if isinstance(func, ast.Attribute) and \
+                name in ("wait", "wait_for"):
+            lid = facts.resolve_lock(mod, fn, func.value)
+            if lid is not None and facts.kinds.get(lid) == "cond":
+                others = [h for h in held if h != lid]
+                if lid in held and others:
+                    yield from emit(
+                        mod, call, "cv-cross-lock",
+                        f"condition wait on {lid} while ALSO holding "
+                        f"{', '.join(others)} — the extra lock stays "
+                        f"pinned for the whole (unbounded) wait; "
+                        f"release it before waiting")
+                elif lid not in held:
+                    yield from emit(
+                        mod, call, "cv-unheld",
+                        f"condition wait on {lid} without holding it "
+                        f"(while holding {locks}) — wait() requires "
+                        f"the condition's own lock")
+                return
+
+        chain = _flatten_chain(func)
+        if isinstance(func, ast.Name) and func.id == "open":
+            yield from emit(
+                mod, call, "io",
+                f"file I/O (open) while holding {locks} — disk "
+                f"latency serializes the lock; stage data out first")
+        elif chain is not None and len(chain) >= 2:
+            if chain[0] == "os" and len(chain) == 2 and chain[1] in OS_IO:
+                yield from emit(
+                    mod, call, "io",
+                    f"file I/O (os.{chain[1]}) while holding {locks} — "
+                    f"move filesystem work outside the critical section")
+            elif chain[0] == "shutil":
+                yield from emit(
+                    mod, call, "io",
+                    f"file I/O (shutil.{chain[1]}) while holding "
+                    f"{locks} — move filesystem work outside the "
+                    f"critical section")
+            elif chain[0] == "requests" or chain[-1] == "urlopen":
+                yield from emit(
+                    mod, call, "http",
+                    f"HTTP call while holding {locks} — network "
+                    f"latency is unbounded; never under a lock")
+            elif chain == ["time", "sleep"]:
+                yield from emit(
+                    mod, call, "sleep",
+                    f"time.sleep while holding {locks} — sleeping "
+                    f"with a lock held starves every waiter")
+        elif name == "urlopen":
+            yield from emit(
+                mod, call, "http",
+                f"HTTP call (urlopen) while holding {locks} — network "
+                f"latency is unbounded; never under a lock")
+
+    # -- jit-binding facts -----------------------------------------------
+    def _jit_bindings(self, project: Project, mod: ModuleInfo
+                      ) -> Tuple[Set[str], Dict[str, Set[str]]]:
+        cached = self._jit_cache.get(mod.relpath)
+        if cached is not None:
+            return cached
+        globs: Set[str] = set()
+        attrs: Dict[str, Set[str]] = {}
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Assign) or \
+                    not _is_jit_value(node.value):
+                continue
+            encl = mod.enclosing_function(node)
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name) and encl is None:
+                    globs.add(tgt.id)
+                    continue
+                base = tgt.value if isinstance(tgt, ast.Subscript) \
+                    else tgt
+                attr = _self_attr(base)
+                if attr is not None and encl is not None:
+                    ci = project.class_of(mod, encl)
+                    if ci is not None:
+                        attrs.setdefault(ci.name, set()).add(attr)
+        result = (globs, attrs)
+        self._jit_cache[mod.relpath] = result
+        return result
+
+    def _local_jit_names(self, project: Project, mod: ModuleInfo,
+                         fn: ast.AST) -> Set[str]:
+        key = (mod.relpath, id(fn))
+        cached = self._local_cache.get(key)
+        if cached is not None:
+            return cached
+        _globs, attrs = self._jit_bindings(project, mod)
+        ci = project.class_of(mod, fn)
+        cls_attrs = attrs.get(ci.name, set()) if ci is not None else set()
+        out: Set[str] = set()
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Assign):
+                continue
+            value = node.value
+            is_jit = _is_jit_value(value) or \
+                (_self_attr(value) in cls_attrs
+                 if isinstance(value, ast.Attribute) else False)
+            if is_jit:
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        out.add(tgt.id)
+        self._local_cache[key] = out
+        return out
+
+    def _is_jit_dispatch(self, project: Project, mod: ModuleInfo,
+                         fn: ast.AST, func: ast.expr) -> bool:
+        if isinstance(func, ast.Call):
+            return _is_jit_value(func)        # jax.jit(f)(x) inline
+        globs, attrs = self._jit_bindings(project, mod)
+        if isinstance(func, ast.Name):
+            return func.id in globs or \
+                func.id in self._local_jit_names(project, mod, fn)
+        base = func.value if isinstance(func, ast.Subscript) else func
+        attr = _self_attr(base)
+        if attr is not None:
+            ci = project.class_of(mod, fn)
+            if ci is not None and attr in attrs.get(ci.name, set()):
+                return True
+        return False
+
+    # -- CV protocol ------------------------------------------------------
+    def _check_cv_protocol(self, project: Project, facts,
+                           mod: ModuleInfo,
+                           held_map: Dict[int, Tuple[str, ...]],
+                           emit) -> Iterator[Finding]:
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call) or \
+                    not isinstance(node.func, ast.Attribute):
+                continue
+            name = node.func.attr
+            if name not in ("wait", "notify", "notify_all"):
+                continue
+            fn = mod.enclosing_function(node)
+            lid = facts.resolve_lock(mod, fn, node.func.value)
+            if lid is None or facts.kinds.get(lid) != "cond":
+                continue
+            if name == "wait":
+                if not self._in_predicate_loop(mod, node):
+                    yield from emit(
+                        mod, node, "cv-no-loop",
+                        f"condition wait on {lid} outside a predicate "
+                        f"loop — spurious wakeups and stolen wakeups "
+                        f"make bare wait() wrong; use `while not "
+                        f"pred: cv.wait()` or cv.wait_for(pred)")
+            else:
+                held = held_map.get(id(node), ())
+                if lid not in held:
+                    yield from emit(
+                        mod, node, "cv-notify-unheld",
+                        f"{name}() on {lid} without holding it — "
+                        f"notify outside the condition's lock races "
+                        f"the waiter's predicate check")
+
+    @staticmethod
+    def _in_predicate_loop(mod: ModuleInfo, node: ast.AST) -> bool:
+        cur = mod.parent(node)
+        while cur is not None and not isinstance(
+                cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if isinstance(cur, ast.While):
+                return True
+            cur = mod.parent(cur)
+        return False
+
+    # -- teardown liveness ------------------------------------------------
+    def _check_teardown_liveness(self, project: Project, facts,
+                                 mod: ModuleInfo,
+                                 held_map: Dict[int, Tuple[str, ...]],
+                                 emit) -> Iterator[Finding]:
+        for fn in mod.functions():
+            if not project.is_thread_reachable(mod, fn):
+                continue
+            ci = project.class_of(mod, fn)
+            if ci is None:
+                continue
+            for node in facts._own_nodes(fn):
+                if not isinstance(node, ast.Call) or \
+                        not isinstance(node.func, ast.Attribute):
+                    continue
+                if id(node) in held_map:
+                    continue        # the under-lock pass already owns it
+                attr = _self_attr(node.func.value)
+                if attr is None or not _timeoutless(node):
+                    continue
+                name = node.func.attr
+                if name == "get" and attr in ci.queue_attrs:
+                    yield from emit(
+                        mod, node, "teardown-get",
+                        f"timeout-less self.{attr}.get() in Thread-"
+                        f"reachable code — the loop can never observe "
+                        f"shutdown and close()/join() hangs behind "
+                        f"it; use get(timeout=...) and poll a stop "
+                        f"Event")
+                elif name == "join" and attr in ci.thread_attrs:
+                    yield from emit(
+                        mod, node, "teardown-join",
+                        f"timeout-less self.{attr}.join() in Thread-"
+                        f"reachable code — a wedged peer blocks this "
+                        f"thread forever; join(timeout=...) and "
+                        f"escalate")

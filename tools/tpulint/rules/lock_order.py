@@ -1,0 +1,135 @@
+"""lock-order-cycle: a static deadlock prover over the lock graph.
+
+The Project's :class:`~tools.tpulint.project.LockFacts` pass records an
+acquired-while-held edge every time one lock is taken with another
+held — directly nested ``with`` blocks, helpers whose entry-held
+fixpoint says a lock is always held when they run, and cross-module
+calls that transitively acquire a lock. Each edge carries the Thread
+entrypoint whose code exercises it (``<main>`` for the main thread).
+
+The deadlock condition this rule proves: a CYCLE in that graph whose
+edges are exercised from at least TWO distinct entrypoints. Two
+threads walking the cycle from different edges can each hold one lock
+of the cycle while waiting for the next — the classic AB/BA hang. A
+cycle driven by a single entrypoint cannot interleave with itself (one
+thread acquires sequentially), so it is not reported; neither is any
+acyclic nesting, however deep — a consistent global order is exactly
+what acyclicity certifies.
+
+One finding per strongly connected component, anchored at the
+earliest edge site, naming the locks on the cycle, a witness edge in
+each direction, and the entrypoints that can interleave.
+"""
+from __future__ import annotations
+
+from typing import Dict, Iterator, List, Set, Tuple
+
+from ..core import Finding
+from ..project import Project, ProjectRule
+
+
+def _sccs(nodes: List[str],
+          succ: Dict[str, Set[str]]) -> List[List[str]]:
+    """Tarjan SCCs (iterative), deterministic over sorted nodes."""
+    index: Dict[str, int] = {}
+    low: Dict[str, int] = {}
+    on_stack: Set[str] = set()
+    stack: List[str] = []
+    out: List[List[str]] = []
+    counter = [0]
+
+    for root in sorted(nodes):
+        if root in index:
+            continue
+        work = [(root, iter(sorted(succ.get(root, ()))))]
+        index[root] = low[root] = counter[0]
+        counter[0] += 1
+        stack.append(root)
+        on_stack.add(root)
+        while work:
+            v, it = work[-1]
+            advanced = False
+            for w in it:
+                if w not in index:
+                    index[w] = low[w] = counter[0]
+                    counter[0] += 1
+                    stack.append(w)
+                    on_stack.add(w)
+                    work.append((w, iter(sorted(succ.get(w, ())))))
+                    advanced = True
+                    break
+                if w in on_stack:
+                    low[v] = min(low[v], index[w])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                pv = work[-1][0]
+                low[pv] = min(low[pv], low[v])
+            if low[v] == index[v]:
+                comp = []
+                while True:
+                    w = stack.pop()
+                    on_stack.discard(w)
+                    comp.append(w)
+                    if w == v:
+                        break
+                out.append(sorted(comp))
+    return out
+
+
+class LockOrderCycleRule(ProjectRule):
+    id = "lock-order-cycle"
+    description = ("cycle in the acquired-while-held lock graph "
+                   "reachable from two thread entrypoints — deadlock")
+
+    def check_project(self, project: Project) -> Iterator[Finding]:
+        facts = project.lock_facts()
+        succ: Dict[str, Set[str]] = {}
+        nodes: Set[str] = set()
+        for (a, b) in facts.edges:
+            nodes.add(a)
+            nodes.add(b)
+            succ.setdefault(a, set()).add(b)
+        for comp in _sccs(sorted(nodes), succ):
+            if len(comp) < 2:
+                continue            # self-edges are never recorded
+            comp_set = set(comp)
+            sites: List[Tuple[str, int, str, str, str, str]] = []
+            contexts: Set[str] = set()
+            for (a, b), elist in sorted(facts.edges.items()):
+                if a in comp_set and b in comp_set:
+                    for rel, line, ctx, detail in elist:
+                        sites.append((rel, line, ctx, a, b, detail))
+                        contexts.add(ctx)
+            if len(contexts) < 2 or not sites:
+                continue
+            sites.sort(key=lambda s: (s[0], s[1]))
+            rel, line, _ctx, a, b, _detail = sites[0]
+            witness = {}
+            for s in sites:
+                witness.setdefault((s[3], s[4]), s)
+            ways = "; ".join(
+                f"{sa} -> {sb} at {srel}:{sline} [{sctx}]"
+                for (srel, sline, sctx, sa, sb, _d)
+                in list(witness.values())[:4])
+            mod = project.by_relpath.get(rel)
+            if mod is None:
+                continue
+            anchor = _Anchor(line)
+            yield self.finding(
+                mod, anchor,
+                f"lock-order cycle over {{{', '.join(comp)}}} "
+                f"exercised from entrypoints "
+                f"{{{', '.join(sorted(contexts))}}} — two threads can "
+                f"each hold one lock while waiting for the other "
+                f"(deadlock); pick one global acquisition order "
+                f"({ways})")
+
+
+class _Anchor:
+    """Minimal lineno/col carrier for Rule.finding anchoring."""
+
+    def __init__(self, lineno: int, col_offset: int = 0):
+        self.lineno = lineno
+        self.col_offset = col_offset

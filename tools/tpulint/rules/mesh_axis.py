@@ -1,0 +1,236 @@
+"""mesh-axis-contract: axis literals must name a real mesh axis.
+
+A wrong axis string in a ``t_*`` collective or a ``PartitionSpec``
+compiles clean — jax resolves axes at trace time against whatever mesh
+is current, and an unknown name either errors deep inside a jit trace
+or, worse, silently reshards. The rule resolves the project's mesh-axis
+vocabulary statically and flags any literal that falls outside it.
+
+Vocabulary = the canonical fleet axes (``dp``/``pp``/``sharding``/
+``sep``/``ep``/``mp`` from the topology order plus the flat ``world``
+mesh) unioned with every axis the tree *declares*: string literals in
+``Mesh(devices, (...))`` second-positional or ``axis_names=`` tuples
+(``shard_map``/``new_group`` sites included), and module-level
+``*_ORDER``/``*_AXES`` string-tuple constants. Declaring an axis
+anywhere puts it in scope everywhere — the checker proves "this name
+exists on some mesh", not placement, which keeps it zero-false-positive
+on multi-mesh trees.
+
+Checked sites: the ``t_*`` collective shims and their quantized
+wrappers (axes is the second positional or the ``axes=`` kwarg;
+literal strings and tuples/lists of strings resolve, anything dynamic
+is skipped), and ``P(...)``/``PartitionSpec(...)`` entries including
+nested tuple entries, in modules that import PartitionSpec.
+
+One extra contract where it is statically resolvable: within a
+function, literal ``P`` specs pin which dims an axis shards; a
+``t_psum_scatter(..., axes, scatter_dimension=k)`` with a literal axis
+that those specs place on *different* dims is flagged — the classic
+``_ZeroPlan`` drift where the spec moves and the scatter dim does not.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from ..core import Finding, ModuleInfo, func_simple_name
+from ..project import Project, ProjectRule
+
+CANONICAL_AXES = {"dp", "pp", "sharding", "sep", "ep", "mp", "world"}
+
+# shims whose signature is (value, axes, ...)
+AXES_ARG1 = {"t_psum", "t_all_gather", "t_psum_scatter", "t_ppermute",
+             "t_all_to_all", "maybe_quantized_psum",
+             "quantized_reduce_scatter", "quantized_allreduce",
+             "quantized_param_gather"}
+_VOCAB_NAME_RE = re.compile(r"(axis|axes|order)", re.IGNORECASE)
+
+
+def _str_const(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def _str_seq(node: ast.AST) -> Optional[List[str]]:
+    """All-string literal tuple/list, else None."""
+    if not isinstance(node, (ast.Tuple, ast.List)):
+        return None
+    out = []
+    for elt in node.elts:
+        s = _str_const(elt)
+        if s is None:
+            return None
+        out.append(s)
+    return out
+
+
+def _axis_literals(node: ast.AST) -> Optional[List[Tuple[str, ast.AST]]]:
+    """(axis, anchor) pairs for a literal axes argument; None when the
+    argument is dynamic (a variable, an attribute, ...)."""
+    s = _str_const(node)
+    if s is not None:
+        return [(s, node)]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for elt in node.elts:
+            s = _str_const(elt)
+            if s is None:
+                return None
+            out.append((s, elt))
+        return out
+    return None
+
+
+class MeshAxisContractRule(ProjectRule):
+    id = "mesh-axis-contract"
+    description = ("collective axis literal or PartitionSpec entry "
+                   "naming an axis no mesh declares, or a scatter dim "
+                   "contradicting the specs")
+
+    def check_project(self, project: Project) -> Iterator[Finding]:
+        vocab = self._vocabulary(project)
+        for mod in project.modules:
+            p_names = self._partition_spec_names(mod)
+            for node in ast.walk(mod.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = func_simple_name(node.func)
+                if name in AXES_ARG1:
+                    yield from self._check_collective(
+                        mod, node, vocab)
+                elif name in p_names:
+                    yield from self._check_spec(mod, node, vocab)
+            yield from self._check_scatter_dims(mod, p_names)
+
+    # -- vocabulary -------------------------------------------------------
+    def _vocabulary(self, project: Project) -> Set[str]:
+        vocab = set(CANONICAL_AXES)
+        for mod in project.modules:
+            for node in ast.walk(mod.tree):
+                if isinstance(node, ast.Call):
+                    if func_simple_name(node.func) == "Mesh" and \
+                            len(node.args) >= 2:
+                        vocab |= set(_str_seq(node.args[1]) or ())
+                    for kw in node.keywords:
+                        if kw.arg == "axis_names":
+                            vocab |= set(_str_seq(kw.value) or ())
+                            s = _str_const(kw.value)
+                            if s is not None:
+                                vocab.add(s)
+                elif isinstance(node, ast.Assign) and \
+                        mod.enclosing_function(node) is None:
+                    seq = _str_seq(node.value)
+                    if seq:
+                        for tgt in node.targets:
+                            if isinstance(tgt, ast.Name) and \
+                                    _VOCAB_NAME_RE.search(tgt.id):
+                                vocab |= set(seq)
+        return vocab
+
+    @staticmethod
+    def _partition_spec_names(mod: ModuleInfo) -> Set[str]:
+        """Local names PartitionSpec is bound to (P, PartitionSpec, an
+        as-alias); empty when the module never imports it."""
+        out: Set[str] = set()
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.ImportFrom):
+                for alias in node.names:
+                    if alias.name == "PartitionSpec":
+                        out.add(alias.asname or alias.name)
+        return out
+
+    # -- checks -----------------------------------------------------------
+    @staticmethod
+    def _axes_arg(node: ast.Call) -> Optional[ast.AST]:
+        for kw in node.keywords:
+            if kw.arg == "axes":
+                return kw.value
+        if len(node.args) >= 2:
+            return node.args[1]
+        return None
+
+    def _check_collective(self, mod: ModuleInfo, node: ast.Call,
+                          vocab: Set[str]) -> Iterator[Finding]:
+        arg = self._axes_arg(node)
+        if arg is None:
+            return
+        lits = _axis_literals(arg)
+        if lits is None:
+            return
+        fname = func_simple_name(node.func)
+        for axis, anchor in lits:
+            if axis not in vocab:
+                yield self.finding(
+                    mod, anchor,
+                    f"{fname} over unknown mesh axis '{axis}' — no "
+                    f"Mesh/shard_map in the tree declares it "
+                    f"(known: {', '.join(sorted(vocab))}); a typo "
+                    f"here errors at trace time or silently reshards")
+
+    def _check_spec(self, mod: ModuleInfo, node: ast.Call,
+                    vocab: Set[str]) -> Iterator[Finding]:
+        for arg in node.args:
+            entries = [arg]
+            if isinstance(arg, (ast.Tuple, ast.List)):
+                entries = list(arg.elts)
+            for entry in entries:
+                s = _str_const(entry)
+                if s is not None and s not in vocab:
+                    yield self.finding(
+                        mod, entry,
+                        f"PartitionSpec names unknown mesh axis "
+                        f"'{s}' — no Mesh/shard_map in the tree "
+                        f"declares it (known: "
+                        f"{', '.join(sorted(vocab))})")
+
+    def _check_scatter_dims(self, mod: ModuleInfo,
+                            p_names: Set[str]) -> Iterator[Finding]:
+        if not p_names:
+            return
+        for fn in mod.functions():
+            spec_dims: Dict[str, Set[int]] = {}
+            scatters: List[Tuple[ast.Call, str, int]] = []
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = func_simple_name(node.func)
+                if name in p_names:
+                    for dim, arg in enumerate(node.args):
+                        entries = list(arg.elts) if isinstance(
+                            arg, (ast.Tuple, ast.List)) else [arg]
+                        for entry in entries:
+                            s = _str_const(entry)
+                            if s is not None:
+                                spec_dims.setdefault(s, set()).add(dim)
+                elif name == "t_psum_scatter":
+                    arg = self._axes_arg(node)
+                    axis = _str_const(arg) if arg is not None else None
+                    if axis is None:
+                        continue
+                    dim_node = None
+                    for kw in node.keywords:
+                        if kw.arg == "scatter_dimension":
+                            dim_node = kw.value
+                    if dim_node is None and len(node.args) >= 3:
+                        dim_node = node.args[2]
+                    if dim_node is None:
+                        dim = 0
+                    elif isinstance(dim_node, ast.Constant) and \
+                            isinstance(dim_node.value, int):
+                        dim = dim_node.value
+                    else:
+                        continue    # dynamic dim: not resolvable
+                    scatters.append((node, axis, dim))
+            for node, axis, dim in scatters:
+                dims = spec_dims.get(axis)
+                if dims and dim not in dims:
+                    yield self.finding(
+                        mod, node,
+                        f"t_psum_scatter over '{axis}' with "
+                        f"scatter_dimension={dim}, but the specs in "
+                        f"this function shard '{axis}' on dim"
+                        f"{'s' if len(dims) > 1 else ''} "
+                        f"{sorted(dims)} — the scatter dim and the "
+                        f"spec drifted apart")
