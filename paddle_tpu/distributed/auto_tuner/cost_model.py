@@ -63,13 +63,28 @@ def estimate_memory_gb(model: Dict, cfg: Dict, global_batch: int,
     if quant.get("dtype", "none") in ("int8", "fp8") and \
             quant.get("error_feedback", True):
         quant_bytes = _num_params(model) / (mp * pp) * 4
+    # host offload tier (distributed/host_offload.py): offloaded slots
+    # live in host memory BETWEEN steps, so their steady-state bytes
+    # leave the HBM image. optimizer: the moment/master shard plus the
+    # quant EF residual; params: the stored parameter image (the shard
+    # under stage 3). The measured counterpart is memledger's
+    # host_state component, which account_engine subtracts from the
+    # device total before the drift comparison — the same subtraction
+    # keeps the analytic drift flat when the knob turns on.
+    off = cfg.get("offload") or {}
+    if off.get("optimizer", False):
+        opt_bytes = 0.0
+        quant_bytes = 0.0
+    if off.get("params", False):
+        param_bytes = 0.0
     return (param_bytes + grad_bytes + opt_bytes + act_bytes
             + quant_bytes) / 1e9
 
 
 def estimate_step_time(model: Dict, cfg: Dict, global_batch: int,
                        seq_len: int, peak_flops: float = 459e12,
-                       ici_bw: float = 9e10) -> float:
+                       ici_bw: float = 9e10,
+                       host_dma_bw: float = 5e10) -> float:
     """Relative step-time: MXU compute + mp/pp/dp comm terms."""
     dp = cfg.get("dp_degree", 1)
     mp = cfg.get("mp_degree", 1)
@@ -108,8 +123,33 @@ def estimate_step_time(model: Dict, cfg: Dict, global_batch: int,
     comm_gather = 0.0
     if cfg.get("sharding_stage", 1) >= 3 and sh > 1:
         comm_gather = (P / (mp * pp)) * 2 * (sh - 1) / sh / ici_bw * r_pg
+    # host offload tier (distributed/host_offload.py): each step moves
+    # the offloaded state over the host DMA path twice (h2d prefetch +
+    # d2h page-out). With prefetch_buckets > 0 the h2d leg overlaps the
+    # previous step's tail (goodput books it as overlapped_seconds), so
+    # only the page-out leg stays on the critical path — the tuner must
+    # see offload as CHEAPER-memory-for-DMA-time, never free.
+    off = cfg.get("offload") or {}
+    comm_host = 0.0
+    if off.get("optimizer", False) or off.get("params", False):
+        host_bytes = 0.0
+        P_local = P / (mp * pp)
+        if off.get("optimizer", False):
+            host_bytes += P_local * 12.0 / sh      # fp32 moments+masters
+            quant = cfg.get("quant_comm") or {}
+            if quant.get("dtype", "none") in ("int8", "fp8") and \
+                    quant.get("error_feedback", True):
+                host_bytes += P_local * 4.0        # EF residual
+        if off.get("params", False):
+            stored = P_local * 2.0
+            if cfg.get("sharding_stage", 1) >= 3:
+                stored /= sh
+            host_bytes += stored
+        legs = 1.0 if int(off.get("prefetch_buckets", 0) or 0) > 0 \
+            else 2.0
+        comm_host = legs * host_bytes / host_dma_bw
     # pp: bubble fraction
     acc = cfg.get("accumulate_steps", max(1, 2 * pp))
     bubble = (pp - 1) / max(1, acc + pp - 1)
-    return (compute + comm_mp + comm_dp + comm_gather) \
+    return (compute + comm_mp + comm_dp + comm_gather + comm_host) \
         / max(1e-9, 1 - bubble)

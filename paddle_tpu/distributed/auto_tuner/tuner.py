@@ -41,7 +41,8 @@ def default_candidates(num_devices: int, model: Dict,
                        global_batch: int,
                        tune_sharding: bool = True,
                        tune_quant_comm: bool = False,
-                       tune_sharding_stage: bool = True) -> List[Dict]:
+                       tune_sharding_stage: bool = True,
+                       tune_offload: bool = False) -> List[Dict]:
     """Valid (dp, mp, pp, sharding, micro) configs for the device count,
     pruned by divisibility (reference prune.py rules).
 
@@ -58,7 +59,15 @@ def default_candidates(num_devices: int, model: Dict,
     the memory model divides param+grad bytes by the sharding degree
     and the cost model prices the per-step (sh-1)/sh param all-gather,
     so stage 3 surfaces exactly when the stage-2 image doesn't fit —
-    the real scale axis the search must be able to reach."""
+    the real scale axis the search must be able to reach.
+
+    ``tune_offload``: additionally emit each stage-3 config with the
+    host memory tier on (``offload={"optimizer": True, ...}`` —
+    distributed/host_offload.py): the memory model drops the offloaded
+    optimizer/EF bytes from the HBM image and the cost model charges
+    the host DMA page-out leg, so the offload variant surfaces exactly
+    when the stage-3 image itself doesn't fit ``hbm_gb`` — the tier
+    beyond the last on-chip scale axis."""
     heads = model.get("num_heads", 1)
     layers = model["num_layers"]
     vocab = model.get("vocab_size", 0)
@@ -89,6 +98,11 @@ def default_candidates(num_devices: int, model: Dict,
             # scatter the parameter image over
             if tune_sharding_stage and sh > 1:
                 out.append(dict(cfg, sharding_stage=3))
+                # host tier rides the stage-3 variant: offload is the
+                # axis past stage 3, never a substitute for it
+                if tune_offload:
+                    out.append(dict(cfg, sharding_stage=3, offload={
+                        "optimizer": True, "prefetch_buckets": 2}))
             # quantized variant only where there is comm to compress
             if tune_quant_comm and (dp * sh > 1 or mp > 1):
                 out.append(dict(cfg, quant_comm={
@@ -114,7 +128,8 @@ class AutoTuner:
                  peak_flops: float = 459e12, recompute: bool = False,
                  candidates: Optional[List[Dict]] = None,
                  max_trials: int = 16, tune_quant_comm: bool = False,
-                 tune_sharding_stage: bool = True):
+                 tune_sharding_stage: bool = True,
+                 tune_offload: bool = False):
         self.model = model
         self.num_devices = num_devices
         self.global_batch = global_batch
@@ -125,6 +140,7 @@ class AutoTuner:
         self.max_trials = max_trials
         self.tune_quant_comm = tune_quant_comm
         self.tune_sharding_stage = tune_sharding_stage
+        self.tune_offload = tune_offload
         self.history: List[Dict] = []
         self._candidates = candidates
 
@@ -134,7 +150,8 @@ class AutoTuner:
             self._candidates = default_candidates(
                 self.num_devices, self.model, self.global_batch,
                 tune_quant_comm=self.tune_quant_comm,
-                tune_sharding_stage=self.tune_sharding_stage)
+                tune_sharding_stage=self.tune_sharding_stage,
+                tune_offload=self.tune_offload)
         return self._candidates
 
     def pruned(self) -> List[Dict]:

@@ -322,10 +322,12 @@ class ParallelEngine:
                  comm_buffer_size_mb: Optional[float] = None,
                  mem_ledger: Optional[bool] = None,
                  quant_comm=None, sharding_stage: Optional[int] = None,
-                 stage3_release_after_forward: Optional[bool] = None):
+                 stage3_release_after_forward: Optional[bool] = None,
+                 offload=None):
         import os
 
         from . import grad_buckets as _gb
+        from . import host_offload as _ho
         from . import quant_comm as _qc
 
         self.model = model
@@ -449,6 +451,15 @@ class ParallelEngine:
             store_sharded=bool(self._quant_cfg.enabled
                                and self._quant_cfg.param_gather)
             or self._sharding_stage >= 3)
+        # host-memory offload tier (distributed/host_offload.py): the
+        # strategy sharding_configs["offload"] sub-config, or the
+        # explicit constructor override. When active, optimizer moments
+        # / AMP masters / EF residuals (optionally stored param shards)
+        # live on the host between steps and are prefetched per
+        # signature bucket at dispatch — bit-exact, ledger-booked.
+        self._offload = _ho.make_tier(
+            offload if offload is not None else _ho.offload_config(),
+            mesh)
         # LazyGuard-built params materialize straight into their (zero3-
         # aware) storage sharding: O(shard) bytes per process, no full-
         # size init anywhere
@@ -459,19 +470,25 @@ class ParallelEngine:
 
     # -- optimizer state management -------------------------------------
     def _ensure_opt_states(self):
+        from . import host_offload as _ho
+
         opt = self.optimizer
         shapes = opt._state_shapes()
         states = []
         for p in self.trainable:
             st = opt._param_state(p, shapes)
             spec = self._zero.state_spec(p)
+            # host-tier entries (HostState) already carry their live
+            # sharding and re-place through the offload tier, never a
+            # fresh global_put
             st = {k: global_put(v, self.mesh, spec)
-                  if v.shape == tuple(p._value.shape)
+                  if not _ho.is_host(v)
+                  and v.shape == tuple(p._value.shape)
                   else v for k, v in st.items()}
             opt._states[id(p)] = st
             states.append(st)
             mw = opt._master_weights.get(id(p))
-            if mw is not None:
+            if mw is not None and not _ho.is_host(mw):
                 opt._master_weights[id(p)] = global_put(mw, self.mesh, spec)
         return states
 
@@ -636,6 +653,11 @@ class ParallelEngine:
         qcfg = self._quant_grad_cfg() if bucket_plan is not None \
             else None
         self._ensure_quant_state()
+        # offload adoption: page the freshly-ensured state classes out
+        # to the host tier before the first dispatch (the first
+        # prefetch_step brings them back bucket-by-bucket)
+        if self._offload is not None:
+            self._offload.page_out_step(self, spawn=False)
         qspecs = dict(self._quant_specs)
         # quantized ZeRO param all-gather (stage 2 post-update, stage 3
         # entry): int8 wire with each rank's own exact shard spliced
@@ -965,6 +987,12 @@ class ParallelEngine:
             # a sync on the critical path
             self._flush_pending_scalars()
             self._check_mesh_epoch()
+            # host-offload prefetch: every offloaded slot re-placed at
+            # its live sharding, bucket by bucket, BEFORE the mvals /
+            # pvals assembly below reads them. Same shapes, dtypes and
+            # shardings every step — the compile key never notices.
+            if self._offload is not None:
+                self._offload.prefetch_step(self)
             leaves, treedef = jax.tree_util.tree_flatten(
                 batch, is_leaf=lambda x: isinstance(x, Tensor))
             leaf_vals = tuple(v._value if isinstance(v, Tensor) else
@@ -1081,6 +1109,12 @@ class ParallelEngine:
                 opt._master_weights[id(params[i])] = nv
             if use_scaler:
                 scaler._store_traced(amp_out)
+            # host-offload page-out: the step's FRESH output state (the
+            # donated inputs are already dead buffers) moves to the
+            # host tier, then the leading buckets start warming on the
+            # background thread for the next dispatch
+            if self._offload is not None:
+                self._offload.page_out_step(self)
             from ..optimizer.lr import LRScheduler
 
             if isinstance(opt._lr, LRScheduler):
@@ -1322,17 +1356,24 @@ class ParallelEngine:
             return None
         leaf_vals, lr, stepc, seed, amp_in = stored
         opt = self.optimizer
-        pvals = tuple(p._value for p in self.params)
-        svals = tuple(opt._states[id(p)] for p in self.trainable)
-        qvals = dict(self._quant_residuals)
-        # key[3] pins which params carried master weights at trace time
-        mvals = {i: opt._master_weights[id(self.params[i])]
-                 for i in key[3]}
-        led = _ml.analyze(
-            self._compiled[key],
-            (pvals, svals, mvals, qvals, leaf_vals, lr, stepc, seed,
-             amp_in),
-            program="train")
+        import contextlib
+
+        with contextlib.ExitStack() as stack:
+            # AOT analysis needs live jax.Arrays: page the host tier in
+            # for the analysis window, back out after
+            if self._offload is not None:
+                stack.enter_context(self._offload.resident(self))
+            pvals = tuple(p._value for p in self.params)
+            svals = tuple(opt._states[id(p)] for p in self.trainable)
+            qvals = dict(self._quant_residuals)
+            # key[3] pins which params carried masters at trace time
+            mvals = {i: opt._master_weights[id(self.params[i])]
+                     for i in key[3]}
+            led = _ml.analyze(
+                self._compiled[key],
+                (pvals, svals, mvals, qvals, leaf_vals, lr, stepc, seed,
+                 amp_in),
+                program="train")
         self._mem_ledgers[key] = led
         return led
 
@@ -1387,17 +1428,24 @@ class ParallelEngine:
 
     def _state_snapshot(self):
         """Device-copy of everything a step mutates (jnp.copy keeps
-        each array's sharding), so offline replays can be undone."""
+        each array's sharding; immutable host-tier entries pass
+        through by reference), so offline replays can be undone."""
+        from . import host_offload as _ho
+
+        def _copy(v):
+            if _ho.is_host(v) or not hasattr(v, "shape"):
+                return v
+            return jnp.copy(v)
+
         opt = self.optimizer
         snap = {
-            "params": [jnp.copy(p._value) for p in self.params],
-            "states": {id(p): {k: (jnp.copy(v) if hasattr(v, "shape")
-                                   else v)
+            "params": [_copy(p._value) for p in self.params],
+            "states": {id(p): {k: _copy(v)
                                for k, v in opt._states[id(p)].items()}
                        for p in self.trainable if id(p) in opt._states},
-            "masters": {k: jnp.copy(v)
+            "masters": {k: _copy(v)
                         for k, v in opt._master_weights.items()},
-            "qresid": {k: jnp.copy(v)
+            "qresid": {k: _copy(v)
                        for k, v in self._quant_residuals.items()},
             "step_count": opt._step_count,
             "seed": self._seed,
@@ -1498,23 +1546,35 @@ class ParallelEngine:
         background (``checkpoint.wait_async_saves()`` /
         ``manager.wait()`` to join). ``step`` defaults to the
         optimizer's applied-step count."""
+        import contextlib
+
         from ..core.enforce import enforce
 
-        state, meta = self._checkpoint_state(scaler)
-        if step is None:
-            step = meta.get("opt_step_count", 0)
-        meta["step"] = int(step)
-        if extra_meta:
-            meta.update(extra_meta)
-        if manager is not None:
-            manager.save(state, step=int(step), extra_meta=meta)
-            return
-        enforce(path is not None,
-                "save_checkpoint needs a path or a CheckpointManager")
-        from .checkpoint import save_state_dict
+        with contextlib.ExitStack() as stack:
+            # host-offloaded state pages in for the save window: the
+            # checkpoint format (and its resharding metadata) is
+            # IDENTICAL with the knob on or off, so restores cross the
+            # offload boundary freely. The device->host snapshot
+            # happens inside manager.save()/save_state_dict before the
+            # exit pages everything back out.
+            if self._offload is not None:
+                stack.enter_context(self._offload.resident(self))
+            state, meta = self._checkpoint_state(scaler)
+            if step is None:
+                step = meta.get("opt_step_count", 0)
+            meta["step"] = int(step)
+            if extra_meta:
+                meta.update(extra_meta)
+            if manager is not None:
+                manager.save(state, step=int(step), extra_meta=meta)
+            else:
+                enforce(path is not None,
+                        "save_checkpoint needs a path or a "
+                        "CheckpointManager")
+                from .checkpoint import save_state_dict
 
-        save_state_dict(state, path, async_save=async_save,
-                        extra_meta=meta)
+                save_state_dict(state, path, async_save=async_save,
+                                extra_meta=meta)
 
     def restore_checkpoint(self, path: str, scaler=None) -> Dict[str, Any]:
         """Restore the engine (in place) from a committed checkpoint:
@@ -1531,8 +1591,18 @@ class ParallelEngine:
         restore into an already-compiled engine books nothing (pinned
         by tests against the registry counters too). Wall time spent
         here is journaled as the goodput ``restore`` segment."""
+        import contextlib
+
         with _gp.segment("restore"):
-            meta = self._restore_checkpoint_inner(path, scaler)
+            with contextlib.ExitStack() as stack:
+                # the load targets are built from the live state dicts,
+                # so the host tier pages in first; the exit pages the
+                # LOADED arrays back out — the host-tier buffers are
+                # rebuilt from the checkpoint bytes deterministically
+                # (pinned by the SIGKILL-mid-prefetch crash matrix)
+                if self._offload is not None:
+                    stack.enter_context(self._offload.resident(self))
+                meta = self._restore_checkpoint_inner(path, scaler)
         self._post_restore_warmup = True
         return meta
 
@@ -1743,6 +1813,11 @@ class ParallelEngine:
 
         def step(batch, out_spec=None):
             self._check_mesh_epoch()
+            # host-offloaded param shards must be live before the
+            # p._value assembly below (they page out again at the next
+            # train step)
+            if self._offload is not None:
+                self._offload.restore_params(self)
             leaves, treedef = jax.tree_util.tree_flatten(
                 batch, is_leaf=lambda x: isinstance(x, Tensor))
             leaf_vals = tuple(v._value if isinstance(v, Tensor) else

@@ -63,6 +63,8 @@ KNOWN_SITES = (
     "store.set",            # TCPStore.set
     "store.get",            # TCPStore.get
     "engine.step_dispatch",  # ParallelEngine step entry
+    "offload.prefetch",     # host-offload per-bucket prefetch (one hit
+                            # per bucket per dispatch; host_offload.py)
     # telemetry-only loss perturbation: arm with action "corrupt"
     # (e.g. "health.loss_spike=corrupt@12") to make the health
     # monitor's N-th OBSERVED loss a spike — training state is

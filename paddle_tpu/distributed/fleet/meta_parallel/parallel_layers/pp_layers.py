@@ -82,6 +82,7 @@ from .....core import rng as _rng
 from .....core.enforce import enforce
 from .....nn.container import LayerList
 from .....nn.layer import Layer
+from .....observability import commledger as _cl
 from .....tensor import Parameter, Tensor
 from .... import collective as C
 
@@ -575,8 +576,15 @@ class PipelineLayer(Layer):
                 carry = C.t_ppermute(y, axis, perm)
                 return (carry, out_buf), None
 
-            (carry, out_buf), _ = lax.scan(
-                body, (carry, out_buf), jnp.arange(E + S - 1))
+            # the ring ppermute in `body` is traced ONCE but executes
+            # E + S - 1 times per forward; noting it under scan_trips
+            # makes the comm ledger trips-exact for the pipeline axis
+            # (observability/commledger.py — AD synthesizes the reverse
+            # ring as the ppermute transpose without re-entering the
+            # noting shim, so only the forward schedule is recorded)
+            with _cl.scan_trips(E + S - 1):
+                (carry, out_buf), _ = lax.scan(
+                    body, (carry, out_buf), jnp.arange(E + S - 1))
             return out_buf.reshape(x_val.shape)
 
         return fn

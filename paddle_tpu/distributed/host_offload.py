@@ -1,0 +1,517 @@
+"""Host-memory offload tier: model state out of HBM, prefetched back
+just-in-time.
+
+ZeRO stage 3 (grad_buckets.py) cut per-device model-state bytes to
+1/sharding_degree; this module buys the next order of magnitude by
+moving whole state classes across the HBM->host boundary between
+steps. Optimizer moments, AMP master weights, and quant-comm
+error-feedback residuals (optionally the stored param shards too) live
+in host memory while the device computes, and are re-placed at their
+exact live sharding right before the next optimizer step:
+
+- **What lives where.** Between steps an offloaded array exists only
+  as a :class:`HostState`: one host ``np`` buffer per addressable
+  shard plus the ``jax`` sharding needed to rebuild the global array.
+  On backends with a pinned-host memory space the buffers ride a
+  ``device_put`` with the sharding's ``pinned_host`` memory kind
+  instead (same API, zero-copy DMA on real chips); CPU smoke uses the
+  ``np`` path. The round trip is bit-exact by construction — bytes are
+  copied, never re-derived — which is what makes offload-on vs
+  offload-off loss curves identical (pinned by tests/bench).
+
+- **Bucketed just-in-time prefetch.** Slots are grouped by the SAME
+  signature buckets the grad reduce-scatter / stage-3 gather use
+  (``BucketPlan``; seam groups keep their ``g<i>`` name, flat buckets
+  ``g<i>b<j>``, plan-less engines one ``flat`` bucket), and the
+  prefetch walks buckets in plan order at step dispatch — the
+  ``offload.prefetch`` failpoint fires once per bucket, so crash tests
+  can SIGKILL mid-prefetch deterministically. ``prefetch_buckets`` > 0
+  warms that many leading buckets on a background thread right after
+  the previous step's page-out, overlapping the host DMA with the
+  inter-step host work (the thread only fills a lock-guarded staging
+  dict; the dispatcher joins it before consuming — no donation-reuse,
+  no blocking call under the lock).
+
+- **First-class accounting.** Every transfer is booked at its closed
+  form — the per-device addressable-shard bytes
+  (``memledger.shard_bytes``) per slot, summed per bucket — into the
+  ``paddle_tpu_offload_*`` gauges; prefetch wall seconds are journaled
+  as an OVERLAPPED goodput segment (like the async checkpoint writer);
+  ``memledger.account_engine`` books host-resident bytes under a
+  ``host_state`` component that the analytic
+  ``closed_form_state_bytes`` cross-checks byte-for-byte.
+
+Knob surface (the reference ``sharding_configs`` dict)::
+
+    strategy.hybrid_configs["sharding_configs"]["offload"] = {
+        "optimizer": True,        # moments + masters + EF residuals
+        "params": False,          # stored param shards too (stage 3)
+        "prefetch_buckets": 2,    # background-warmed leading buckets
+    }
+
+The serving engine reuses the same tier shape for cold KV pages
+(inference/serving.py): LRU-idle prefix-cache pages spill their
+payload to host on eviction and fault back through the normal page
+allocation on a prefix hit, charged to the same transfer gauges with
+``component="kv_page"``.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from dataclasses import dataclass
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+import jax
+import numpy as np
+
+from . import failpoints as _fp
+
+__all__ = ["OffloadConfig", "offload_config", "make_config", "make_tier",
+           "HostState", "is_host", "page_out", "place", "OffloadTier",
+           "host_shard_bytes"]
+
+
+# ---------------------------------------------------------------------------
+# knob surface
+# ---------------------------------------------------------------------------
+@dataclass(frozen=True)
+class OffloadConfig:
+    """Parsed ``sharding_configs["offload"]`` sub-config."""
+
+    optimizer: bool = True       # moments + AMP masters + EF residuals
+    params: bool = False         # stored param shards (stage-3 style)
+    prefetch_buckets: int = 0    # buckets warmed on the background thread
+
+    @property
+    def enabled(self) -> bool:
+        return self.optimizer or self.params
+
+
+def make_config(off) -> Optional[OffloadConfig]:
+    """Normalize a knob value (dict / True / OffloadConfig / falsy)."""
+    if not off:
+        return None
+    if isinstance(off, OffloadConfig):
+        return off if off.enabled else None
+    if off is True:
+        off = {}
+    cfg = OffloadConfig(
+        optimizer=bool(off.get("optimizer", True)),
+        params=bool(off.get("params", False)),
+        prefetch_buckets=int(off.get("prefetch_buckets", 0)))
+    return cfg if cfg.enabled else None
+
+
+def offload_config(strategy=None) -> Optional[OffloadConfig]:
+    """The active fleet strategy's ``sharding_configs["offload"]``
+    sub-config (None when absent) — same knob-parser shape as
+    ``grad_buckets.strategy_config`` / ``stage_config``."""
+    if strategy is None:
+        from . import fleet as _fleet
+
+        strategy = _fleet.get_strategy()
+    if strategy is None:
+        return None
+    sc = strategy.hybrid_configs.get("sharding_configs") or {}
+    return make_config(sc.get("offload"))
+
+
+def make_tier(off, mesh=None) -> Optional["OffloadTier"]:
+    cfg = make_config(off)
+    return OffloadTier(cfg, mesh) if cfg is not None else None
+
+
+# ---------------------------------------------------------------------------
+# the host-resident form of one array
+# ---------------------------------------------------------------------------
+def _pinned_host_sharding(sharding):
+    """The same sharding placed in the pinned-host memory space, or
+    None when the backend has no such space (CPU smoke)."""
+    try:
+        dev = next(iter(sharding.device_set))
+        kinds = {m.kind for m in dev.addressable_memories()}
+        if "pinned_host" not in kinds:
+            return None
+        return sharding.with_memory_kind("pinned_host")
+    except Exception:
+        return None
+
+
+class HostState:
+    """One offloaded array: this process's addressable shards as host
+    buffers plus the sharding needed to rebuild the global ``jax.Array``
+    bit-exactly. Treated as an immutable value everywhere (snapshots
+    share it; ``place`` builds fresh device arrays)."""
+
+    __slots__ = ("shape", "dtype", "_sharding", "_shards", "_harr")
+
+    def __init__(self, shape, dtype, sharding, shards, harr=None):
+        self.shape = tuple(int(s) for s in shape)
+        self.dtype = np.dtype(dtype)
+        self._sharding = sharding
+        self._shards = shards    # tuple of (device, np.ndarray) or None
+        self._harr = harr        # pinned-host jax.Array (TPU path) or None
+
+    @property
+    def sharding(self):
+        # exposed so memledger.shard_bytes computes the per-device
+        # shard size of a HostState exactly like a live jax.Array
+        return self._sharding
+
+    @property
+    def ndim(self) -> int:
+        return len(self.shape)
+
+    @property
+    def nbytes(self) -> int:
+        """Total host bytes this process holds (every addressable
+        shard, replication included — the actual RAM cost)."""
+        if self._shards is not None:
+            return int(sum(b.nbytes for _, b in self._shards))
+        return int(np.prod(self.shape) if self.shape else 1) \
+            * int(self.dtype.itemsize)
+
+    def __repr__(self):  # pragma: no cover - debugging aid
+        return (f"HostState(shape={self.shape}, dtype={self.dtype}, "
+                f"shards={len(self._shards or ())})")
+
+
+def is_host(v) -> bool:
+    return isinstance(v, HostState)
+
+
+def page_out(arr) -> HostState:
+    """Move ``arr`` to the host tier: per-addressable-shard host
+    copies (or one pinned-host ``device_put`` where the backend has
+    that memory space), preserving the sharding for an exact
+    round-trip. The device buffers are released with the last
+    reference to ``arr``."""
+    sharding = arr.sharding
+    hshard = _pinned_host_sharding(sharding)
+    if hshard is not None:
+        harr = jax.device_put(arr, hshard)
+        return HostState(arr.shape, arr.dtype, sharding, None, harr)
+    shards = tuple((s.device, np.asarray(s.data))
+                   for s in arr.addressable_shards)
+    return HostState(arr.shape, arr.dtype, sharding, shards)
+
+
+def place(hs: HostState) -> jax.Array:
+    """Rebuild the global device array from a :class:`HostState` at
+    its original sharding — the bit-exact inverse of ``page_out``."""
+    if hs._harr is not None:
+        return jax.device_put(hs._harr, hs._sharding)
+    if len(hs._shards) == 1 and hs._shards[0][1].shape == hs.shape:
+        # single-shard fast path (also covers plan-less 1-device runs)
+        return jax.device_put(hs._shards[0][1], hs._sharding)
+    bufs = [jax.device_put(b, d) for d, b in hs._shards]
+    return jax.make_array_from_single_device_arrays(
+        hs.shape, hs._sharding, bufs)
+
+
+def host_shard_bytes(v) -> int:
+    """Closed-form per-device shard bytes of one slot (live array or
+    HostState) — the unit every transfer-ledger entry is booked at."""
+    from ..observability.memledger import shard_bytes
+
+    return shard_bytes(v)
+
+
+# ---------------------------------------------------------------------------
+# the engine-side tier
+# ---------------------------------------------------------------------------
+class OffloadTier:
+    """Owns the host tier of one ``ParallelEngine``: which state slots
+    offload, their bucket grouping, the background prefetch thread,
+    and the transfer ledger / gauges. All mutation happens on the
+    train-loop thread except the staging dict the prefetch worker
+    fills, which is guarded by ``_lock``."""
+
+    def __init__(self, cfg: OffloadConfig, mesh=None):
+        from ..observability.catalog import offload_metrics
+
+        self.cfg = cfg
+        self.mesh = mesh
+        self._metrics = offload_metrics()
+        self._plan = None
+        self._plan_built = False
+        self._bucket_of: Dict[int, str] = {}   # trainable index -> name
+        self._bucket_order: Dict[str, int] = {}
+        # cumulative closed-form transfer ledger, (component, direction)
+        self._bytes: Dict[Tuple[str, str], int] = {}
+        self._ops: Dict[Tuple[str, str], int] = {}
+        self._host_bytes: Dict[str, int] = {}  # per-device shard bytes
+        self._last_prefetch_s = 0.0
+        self._lock = threading.Lock()
+        self._thread: Optional[threading.Thread] = None
+        self._staged: Dict[Any, Any] = {}      # slot key -> device array
+
+    # -- bucket naming (the BucketPlan discipline) -----------------------
+    def _ensure_plan(self, engine) -> None:
+        if self._plan_built:
+            return
+        self._plan_built = True
+        plan = engine._build_bucket_plan()
+        self._plan = plan
+        order: List[str] = []
+        if plan is not None:
+            for gi, g in enumerate(plan.groups):
+                if g.seam:
+                    name = f"g{gi}"
+                    order.append(name)
+                    for e in g.entries:
+                        self._bucket_of[e.index] = name
+                else:
+                    for bi, bucket in enumerate(g.buckets):
+                        name = f"g{gi}b{bi}"
+                        order.append(name)
+                        for e in bucket:
+                            self._bucket_of[e.index] = name
+        order.append("flat")     # plan-less slots / uncovered tail
+        self._bucket_order = {n: i for i, n in enumerate(order)}
+
+    def _bucket_name(self, t_index: Optional[int]) -> str:
+        if t_index is None:
+            return "flat"
+        return self._bucket_of.get(t_index, "flat")
+
+    # -- slot enumeration ------------------------------------------------
+    def _iter_slots(self, engine) -> Iterator[Tuple[Any, str, str]]:
+        """Every offloadable slot as (key, component, bucket). Keys are
+        stable across steps/restores: trainable index + state leaf name
+        (never ``id()`` — params rebind on donation writeback)."""
+        opt = engine.optimizer
+        if self.cfg.optimizer and opt is not None:
+            for ti, p in enumerate(engine.trainable):
+                bucket = self._bucket_name(ti)
+                st = opt._states.get(id(p))
+                for k in (st or {}):
+                    yield ("s", ti, k), "optimizer_state", bucket
+                if id(p) in opt._master_weights:
+                    yield ("m", ti), "master_weights", bucket
+            for name in getattr(engine, "_quant_residuals", {}):
+                bucket = name if name in self._bucket_order else "flat"
+                yield ("q", name), "quant_residual", bucket
+        if self.cfg.params:
+            t_of = {id(p): i for i, p in enumerate(engine.trainable)}
+            for pi, p in enumerate(engine.params):
+                bucket = self._bucket_name(t_of.get(id(p)))
+                yield ("p", pi), "params", bucket
+
+    @staticmethod
+    def _get(engine, key):
+        kind = key[0]
+        if kind == "s":
+            p = engine.trainable[key[1]]
+            return engine.optimizer._states[id(p)].get(key[2])
+        if kind == "m":
+            p = engine.trainable[key[1]]
+            return engine.optimizer._master_weights.get(id(p))
+        if kind == "q":
+            return engine._quant_residuals.get(key[1])
+        return engine.params[key[1]]._value
+
+    @staticmethod
+    def _set(engine, key, val) -> None:
+        kind = key[0]
+        if kind == "s":
+            p = engine.trainable[key[1]]
+            engine.optimizer._states[id(p)][key[2]] = val
+        elif kind == "m":
+            p = engine.trainable[key[1]]
+            engine.optimizer._master_weights[id(p)] = val
+        elif kind == "q":
+            engine._quant_residuals[key[1]] = val
+        else:
+            engine.params[key[1]]._value = val
+
+    # -- transfer ledger -------------------------------------------------
+    def _note(self, component: str, direction: str, nbytes: int) -> None:
+        k = (component, direction)
+        self._bytes[k] = self._bytes.get(k, 0) + int(nbytes)
+        self._ops[k] = self._ops.get(k, 0) + 1
+
+    def transfer_bytes(self, component: Optional[str] = None,
+                       direction: Optional[str] = None) -> int:
+        """Cumulative closed-form transfer bytes, optionally filtered —
+        what the bench lines pin against the analytic form."""
+        return sum(v for (c, d), v in self._bytes.items()
+                   if (component is None or c == component)
+                   and (direction is None or d == direction))
+
+    def host_resident_bytes(self, component: Optional[str] = None) -> int:
+        return sum(v for c, v in self._host_bytes.items()
+                   if component is None or c == component)
+
+    def publish(self) -> None:
+        m = self._metrics
+        for (c, d), v in self._bytes.items():
+            m["bytes"].set(float(v), component=c, direction=d)
+        for (c, d), v in self._ops.items():
+            m["ops"].set(float(v), component=c, direction=d)
+        for c, v in self._host_bytes.items():
+            m["host"].set(float(v), component=c)
+        m["prefetch_seconds"].set(self._last_prefetch_s)
+
+    # -- page-out / prefetch ---------------------------------------------
+    def page_out_step(self, engine, spawn: bool = True) -> None:
+        """Move every offloadable slot that is device-resident to the
+        host tier (after the step's writeback — the fresh output
+        arrays, never the donated inputs), then optionally warm the
+        first ``prefetch_buckets`` buckets on the background thread."""
+        self._ensure_plan(engine)
+        self._drain_thread()
+        book = not getattr(engine, "_profiling", False)
+        for key, comp, _bucket in self._iter_slots(engine):
+            v = self._get(engine, key)
+            if v is None or is_host(v) or not isinstance(v, jax.Array):
+                continue
+            b = host_shard_bytes(v)
+            self._set(engine, key, page_out(v))
+            self._host_bytes[comp] = self._host_bytes.get(comp, 0) + b
+            if book:
+                self._note(comp, "d2h", b)
+        if book:
+            self.publish()
+        if spawn and self.cfg.prefetch_buckets > 0:
+            self._spawn_prefetch(engine)
+
+    def _bucketed_host_slots(self, engine):
+        """Host-resident slots grouped by bucket in plan order."""
+        grouped: Dict[str, List[Tuple[Any, str]]] = {}
+        for key, comp, bucket in self._iter_slots(engine):
+            v = self._get(engine, key)
+            if is_host(v):
+                grouped.setdefault(bucket, []).append((key, comp))
+        last = len(self._bucket_order)
+        return sorted(grouped.items(),
+                      key=lambda kv: self._bucket_order.get(kv[0], last))
+
+    def _spawn_prefetch(self, engine) -> None:
+        buckets = self._bucketed_host_slots(engine)
+        items: List[Tuple[Any, HostState]] = []
+        for _name, entries in buckets[:self.cfg.prefetch_buckets]:
+            for key, _comp in entries:
+                items.append((key, self._get(engine, key)))
+        if not items:
+            return
+
+        def worker(items=items):
+            for key, hs in items:
+                arr = place(hs)
+                with self._lock:
+                    self._staged[key] = arr
+
+        # non-daemon: a daemon thread mid-device_put at interpreter exit
+        # aborts the XLA runtime teardown; the worker is one short
+        # device_put pass, so letting exit wait for it is cheap
+        self._thread = threading.Thread(
+            target=worker, daemon=False, name="offload-prefetch")
+        self._thread.start()
+
+    def _drain_thread(self) -> Dict[Any, Any]:
+        t = self._thread
+        if t is not None:
+            t.join()
+            self._thread = None
+        with self._lock:
+            staged, self._staged = self._staged, {}
+        return staged
+
+    def prefetch_step(self, engine) -> None:
+        """Materialize every host-resident slot at its live sharding,
+        bucket by bucket in plan order, right before the compiled step
+        dispatch. Fires the ``offload.prefetch`` failpoint once per
+        bucket (crash tests SIGKILL here); the wall window is journaled
+        as an OVERLAPPED goodput segment like the async checkpoint
+        writer's commits."""
+        from ..observability import goodput as _gp
+
+        self._ensure_plan(engine)
+        t0 = time.perf_counter()
+        w0 = time.time()
+        staged = self._drain_thread()
+        book = not getattr(engine, "_profiling", False)
+        for _name, entries in self._bucketed_host_slots(engine):
+            _fp.hit("offload.prefetch")
+            for key, comp in entries:
+                hs = self._get(engine, key)
+                b = host_shard_bytes(hs)
+                arr = staged.pop(key, None)
+                if arr is None:
+                    arr = place(hs)
+                self._set(engine, key, arr)
+                self._host_bytes[comp] = \
+                    self._host_bytes.get(comp, 0) - b
+                if book:
+                    self._note(comp, "h2d", b)
+        self._last_prefetch_s = time.perf_counter() - t0
+        if book:
+            led = _gp.current()
+            if led is not None:
+                led.record_overlapped("offload_prefetch", w0,
+                                      time.time())
+            self.publish()
+
+    # -- whole-tier residency (checkpoint / eval / analysis) -------------
+    def restore_device(self, engine) -> None:
+        """Materialize EVERY host slot (no failpoint, no overlap
+        booking — callers stall on purpose: checkpoint snapshots, state
+        loads, eval gathers, AOT memory analysis)."""
+        self._ensure_plan(engine)
+        staged = self._drain_thread()
+        book = not getattr(engine, "_profiling", False)
+        for _name, entries in self._bucketed_host_slots(engine):
+            for key, comp in entries:
+                hs = self._get(engine, key)
+                b = host_shard_bytes(hs)
+                arr = staged.pop(key, None)
+                if arr is None:
+                    arr = place(hs)
+                self._set(engine, key, arr)
+                self._host_bytes[comp] = \
+                    self._host_bytes.get(comp, 0) - b
+                if book:
+                    self._note(comp, "h2d", b)
+        if book:
+            self.publish()
+
+    def restore_params(self, engine) -> None:
+        """Materialize host-resident PARAM slots only — eval paths read
+        ``p._value`` directly; the params page back out at the next
+        train step's page-out."""
+        if not self.cfg.params:
+            return
+        self._ensure_plan(engine)
+        staged = self._drain_thread()
+        for key, comp, _bucket in self._iter_slots(engine):
+            if key[0] != "p":
+                continue
+            hs = self._get(engine, key)
+            if not is_host(hs):
+                continue
+            b = host_shard_bytes(hs)
+            arr = staged.pop(key, None)
+            if arr is None:
+                arr = place(hs)
+            self._set(engine, key, arr)
+            self._note(comp, "h2d", b)
+            self._host_bytes[comp] = self._host_bytes.get(comp, 0) - b
+        with self._lock:
+            # warmed non-param slots stay staged for the next prefetch
+            for key, arr in staged.items():
+                self._staged.setdefault(key, arr)
+        self.publish()
+
+    @contextlib.contextmanager
+    def resident(self, engine):
+        """Device-resident window: everything paged in on entry, back
+        out on exit (no background warm — the caller decides when the
+        next step's prefetch starts)."""
+        self.restore_device(engine)
+        try:
+            yield
+        finally:
+            self.page_out_step(engine, spawn=False)

@@ -98,7 +98,7 @@ import threading
 import time
 from collections import Counter, OrderedDict, deque
 from dataclasses import dataclass, field
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import numpy as np
@@ -196,6 +196,7 @@ class ServingEngine:
                  prefill_token_budget: Optional[int] = None,
                  prefix_cache: bool = False,
                  draft_predictor=None, spec_tokens: int = 0,
+                 host_spill_pages: int = 0,
                  debug_invariants: bool = False):
         import inspect
         import os
@@ -291,6 +292,26 @@ class ServingEngine:
         self._pfx = {"lookups": 0, "hits": 0, "cow": 0, "reclaimed": 0,
                      "registered": 0, "skipped_tokens": 0,
                      "fed_tokens": 0}
+        # host memory tier for the KV cache (distributed/host_offload.py
+        # is the training-side twin): up to host_spill_pages reclaimed
+        # prefix-cache pages keep their payload in host memory, keyed
+        # by the SAME rolling prefix hash, and fault back through the
+        # normal admission path (one page allocation + one page write,
+        # then registered + idle so the hit run pins it like any cached
+        # page). A hash's KV lives device-side OR host-side, never
+        # both. Reclaim only STAGES (page, hash) under the lock; the
+        # device read that captures the payload runs in _alloc_pages
+        # AFTER the lock is released and BEFORE the allocated pages are
+        # handed out — the page cannot be rewritten in between, and no
+        # jitted dispatch ever runs under self._lock.
+        self.spill_pages = int(host_spill_pages or 0)
+        enforce(self.spill_pages == 0 or self.prefix,
+                "host_spill_pages rides the prefix cache (pages are "
+                "keyed by prefix hash); set prefix_cache=True")
+        self._spilled: "OrderedDict[int, Any]" = OrderedDict()
+        self._spill_pending: List[Tuple[int, int]] = []
+        self._spill_ledger: Dict[Tuple[str, str], int] = {}
+        self._spill_counts = {"spilled": 0, "faulted": 0, "dropped": 0}
         # debug-mode pool-accounting invariant (free + idle + live
         # partition the pool; refcounts == slot membership) checked
         # after every admit/finish/preempt — the free-list hardening
@@ -508,7 +529,12 @@ class ServingEngine:
 
     def _alloc_pages(self, n: int) -> List[int]:
         """Pop n pages at refcount 1 — free list first, then reclaim
-        idle cached pages oldest-first. Callers check _avail_pages."""
+        idle cached pages oldest-first. Callers check _avail_pages.
+        Reclaims staged for host spill are drained here AFTER the lock
+        is released and BEFORE the pages are handed out: the payload is
+        still intact (nothing writes a page between reclaim and its
+        next prefill dispatch) and the device read never holds the
+        lock."""
         with self._lock:
             out = []
             for _ in range(n):
@@ -517,18 +543,25 @@ class ServingEngine:
                 pg = self._free_pages.pop()
                 self._refcount[pg] = 1
                 out.append(pg)
-            return out
+        if self._spill_pending:
+            self._drain_spills()
+        return out
 
     def _cache_reclaim(self):
         """Evict the oldest idle cached page: unregister its hash and
-        return it to the free list (the cache yields under pressure)."""
+        return it to the free list (the cache yields under pressure).
+        With the host tier on, the (page, hash) pair is staged so
+        _alloc_pages captures the payload host-side after release."""
         with self._lock:
             enforce(self._lru, "page pool exhausted: allocator asked "
                     "to reclaim with no idle cached pages")
             pg, _ = self._lru.popitem(last=False)
-            del self._hash_page[self._page_hash.pop(pg)]
+            h = self._page_hash.pop(pg)
+            del self._hash_page[h]
             self._pfx["reclaimed"] += 1
             self._metrics["prefix_events"].inc(event="reclaimed")
+            if self.spill_pages:
+                self._spill_pending.append((pg, h))
             self._free_pages.append(pg)
 
     def _ref_page(self, pg: int):
@@ -564,6 +597,158 @@ class ServingEngine:
             self._page_hash[pg] = h
             self._pfx["registered"] += 1
             self._metrics["prefix_events"].inc(event="registered")
+
+    # -- host spill tier (the serving face of distributed/host_offload) --
+    def _note_spill(self, direction: str, nbytes: int):
+        """Book one ledger entry and republish the offload gauges.
+        Cumulative totals as GAUGES (set, not inc) — the same contract
+        as the training tier, so the closed-form cross-check reads one
+        number per (component, direction)."""
+        with self._lock:
+            k = ("kv_page", direction)
+            self._spill_ledger[k] = self._spill_ledger.get(k, 0) + nbytes
+            host = sum(self._payload_nbytes(p)
+                       for p in self._spilled.values())
+            vals = dict(self._spill_ledger)
+            npages = len(self._spilled)
+        m = self._metrics
+        for (comp, d), v in vals.items():
+            m["offload_bytes"].set(v, component=comp, direction=d)
+        m["offload_host"].set(host, component="kv_page")
+        m["offload_spilled_pages"].set(npages)
+
+    @staticmethod
+    def _payload_nbytes(payload) -> int:
+        return sum(int(a.nbytes) for pools in payload if pools
+                   for kv in pools for a in kv)
+
+    def _page_read_fn(self):
+        """ONE compiled page-read program per pool geometry (traced
+        src index — the page-copy discipline): returns the page row of
+        every pool, to be copied host-side by the caller."""
+        key = ("page_read",)
+        if key in self._step_fns:
+            return self._step_fns[key]
+
+        def read(pools, src):
+            return jax.tree_util.tree_map(
+                lambda a: lax.dynamic_index_in_dim(a, src, axis=0,
+                                                   keepdims=False),
+                pools)
+
+        self._step_fns[key] = jax.jit(read)
+        return self._step_fns[key]
+
+    def _page_write_fn(self):
+        """ONE compiled page-write program per pool geometry (traced
+        dst index, donated pools): the fault-back inverse of
+        _page_read_fn."""
+        key = ("page_write",)
+        if key in self._step_fns:
+            return self._step_fns[key]
+
+        def write(pools, rows, dst):
+            return jax.tree_util.tree_map(
+                lambda a, r: lax.dynamic_update_slice_in_dim(
+                    a, r[None], dst, axis=0),
+                pools, rows)
+
+        self._step_fns[key] = jax.jit(write, donate_argnums=(0,))
+        return self._step_fns[key]
+
+    def _drain_spills(self):
+        """Capture staged reclaim payloads host-side (d2h). Runs with
+        the lock RELEASED; the staged pages sit on the free list or in
+        the caller's fresh allocation, unwritten until the next
+        compiled dispatch, so the read is race-free."""
+        with self._lock:
+            pending, self._spill_pending = self._spill_pending, []
+        fn = self._page_read_fn()
+        for pg, h in pending:
+            src = jnp.asarray(pg, jnp.int32)
+            self.stats.note("page_read",
+                            ("target", len(self.pools),
+                             str(self._dtype)))
+            rows = self._run_captured(("page_read",), fn,
+                                      self.pools, src)
+            target = [tuple(np.asarray(r) for r in kv) for kv in rows]
+            draft = None
+            if self._draft is not None:
+                self.stats.note("page_read",
+                                ("draft", len(self.draft_pools),
+                                 str(self._draft_dtype)))
+                drows = self._run_captured(("page_read_draft",), fn,
+                                           self.draft_pools, src)
+                draft = [tuple(np.asarray(r) for r in kv)
+                         for kv in drows]
+            payload = (target, draft)
+            with self._lock:
+                self._spilled[h] = payload
+                self._spill_counts["spilled"] += 1
+                dropped = []
+                while len(self._spilled) > self.spill_pages:
+                    dropped.append(self._spilled.popitem(last=False))
+                self._spill_counts["dropped"] += len(dropped)
+            self._note_spill("d2h", self._payload_nbytes(payload))
+
+    def _fault_spilled(self, req: ServingRequest):
+        """Fault host-spilled prefix pages back onto the device ahead
+        of admission: extend the DEVICE hit run with spilled hashes by
+        allocating one page each (normal admission accounting — the
+        allocation may itself reclaim/spill colder pages), writing the
+        payload back, and registering the page idle so _admit_plan
+        pins it like any cached hit."""
+        if not self.spill_pages or not self._spilled:
+            return
+        floor = self._pages_for(min(len(req.prompt), self.Sc)) + 1
+        for h in self._prefix_hashes(req.prompt):
+            with self._lock:
+                if h in self._hash_page:
+                    continue          # device run keeps extending
+                payload = self._spilled.pop(h, None)
+            if payload is None:
+                return                # run over: neither cached nor spilled
+            if self._avail_pages() <= floor:
+                with self._lock:      # keep it host-side for next time
+                    self._spilled[h] = payload
+                    self._spilled.move_to_end(h, last=False)
+                return
+            [pg] = self._alloc_pages(1)
+            target, draft = payload
+            dst = jnp.asarray(pg, jnp.int32)
+            fn = self._page_write_fn()
+            rows = [tuple(jnp.asarray(a) for a in kv) for kv in target]
+            self.stats.note("page_write",
+                            ("target", len(self.pools),
+                             str(self._dtype)))
+            self.pools = self._run_captured(("page_write",), fn,
+                                            self.pools, rows, dst)
+            if self._draft is not None and draft is not None:
+                drows = [tuple(jnp.asarray(a) for a in kv)
+                         for kv in draft]
+                self.stats.note("page_write",
+                                ("draft", len(self.draft_pools),
+                                 str(self._draft_dtype)))
+                self.draft_pools = self._run_captured(
+                    ("page_write_draft",), fn, self.draft_pools,
+                    drows, dst)
+            self._register_page(h, pg)
+            self._release_pages([pg])     # idle + registered: hit-able
+            with self._lock:
+                self._spill_counts["faulted"] += 1
+            self._note_spill("h2d", self._payload_nbytes(payload))
+
+    def spill_stats(self) -> Dict[str, Any]:
+        """Host-tier counters: pages spilled/faulted/dropped, resident
+        host bytes, and the cumulative transfer ledger per direction."""
+        with self._lock:
+            out = dict(self._spill_counts)
+            out["host_pages"] = len(self._spilled)
+            out["host_bytes"] = sum(self._payload_nbytes(p)
+                                    for p in self._spilled.values())
+            out["transfer_bytes"] = {d: v for (_c, d), v
+                                     in self._spill_ledger.items()}
+            return out
 
     def _prefix_hashes(self, prompt: np.ndarray) -> List[int]:
         """Rolling hash per FULL page-aligned prompt chunk: h_j covers
@@ -615,6 +800,12 @@ class ServingEngine:
                 bad.append("prefix hash maps out of sync")
             if not ls <= set(self._page_hash):
                 bad.append("LRU page not registered in the cache")
+            if set(self._spilled) & set(self._hash_page):
+                bad.append("hash both device-registered and host-"
+                           "spilled (the tier owns a hash exclusively)")
+            if len(self._spilled) > max(self.spill_pages, 0):
+                bad.append(f"host tier over its cap: "
+                           f"{len(self._spilled)} > {self.spill_pages}")
             enforce(not bad,
                     "serving pool invariant violated: " + "; ".join(bad))
 
@@ -694,6 +885,9 @@ class ServingEngine:
             free = [b for b in range(self.B) if self.slots[b] is None]
             if not free:
                 return
+            # host tier: fault spilled prefix pages back first, so the
+            # plan below sees them as ordinary idle cached hits
+            self._fault_spilled(req)
             cold, reserve, hits, hashes, fed0 = self._admit_plan(req)
             with self._lock:
                 # idle hit pages count toward _avail_pages but are

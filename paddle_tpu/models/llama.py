@@ -32,6 +32,7 @@ from jax import lax
 
 from .. import ops
 from ..autograd import no_grad
+from ..core.dispatch import def_op
 from ..nn import functional as F
 from ..nn.layer import Layer
 from ..nn.container import LayerList
@@ -47,8 +48,8 @@ from ..observability import annotate as _annotate
 from ..tensor import Tensor
 
 __all__ = ["LlamaConfig", "LlamaModel", "LlamaForCausalLM",
-           "LlamaPretrainingCriterion", "llama_tiny", "llama_7b",
-           "llama_13b"]
+           "LlamaPretrainingCriterion", "LlamaRMSNorm", "llama_tiny",
+           "llama_7b", "llama_13b"]
 
 
 @dataclass
@@ -147,6 +148,30 @@ def _dispatch_kernel(name, supported, kernel, fallback):
                               "XLA fallback")
     with _annotate(name):
         return fallback()
+
+
+@def_op("llama_rms_norm")
+def _rms_norm_dispatch(x, weight, epsilon=1e-5):
+    from ..ops.pallas.rms_norm import (rms_norm_dense, rms_norm_fused,
+                                       rms_norm_supported)
+
+    return _dispatch_kernel(
+        "rms_norm",
+        lambda: rms_norm_supported(x.shape),
+        lambda: rms_norm_fused(x, weight, float(epsilon)),
+        lambda: rms_norm_dense(x, weight, float(epsilon)))
+
+
+class LlamaRMSNorm(RMSNorm):
+    """RMSNorm routed through the shared Pallas dispatch policy: the
+    fused one-VMEM-pass kernel (ops/pallas/rms_norm.py) when the Mosaic
+    shape gate admits the geometry on TPU, the numerically identical
+    dense XLA path otherwise — the swap only changes the lowering,
+    never the results (both accumulate in f32 with the same formula)."""
+
+    def forward(self, x):
+        return _rms_norm_dispatch(x, self.weight,
+                                  epsilon=float(self._epsilon))
 
 
 def _cache_attention(q, k_cache, v_cache, offset, S):
@@ -367,11 +392,11 @@ class LlamaMLP(Layer):
 class LlamaDecoderLayer(Layer):
     def __init__(self, config: LlamaConfig):
         super().__init__()
-        self.input_layernorm = RMSNorm(config.hidden_size,
-                                       epsilon=config.rms_norm_eps)
+        self.input_layernorm = LlamaRMSNorm(config.hidden_size,
+                                            epsilon=config.rms_norm_eps)
         self.self_attn = LlamaAttention(config)
-        self.post_attention_layernorm = RMSNorm(config.hidden_size,
-                                                epsilon=config.rms_norm_eps)
+        self.post_attention_layernorm = LlamaRMSNorm(
+            config.hidden_size, epsilon=config.rms_norm_eps)
         self.mlp = LlamaMLP(config)
 
     def forward(self, x, cache=None, offset=0, valid=None):
@@ -400,7 +425,8 @@ class LlamaModel(Layer):
             weight_attr=_init_attr(config.initializer_range))
         self.layers = LayerList([LlamaDecoderLayer(config)
                                  for _ in range(config.num_layers)])
-        self.norm = RMSNorm(config.hidden_size, epsilon=config.rms_norm_eps)
+        self.norm = LlamaRMSNorm(config.hidden_size,
+                                 epsilon=config.rms_norm_eps)
 
     def forward(self, input_ids, caches=None, offset=0, valid=None):
         # named scopes per layer: XLA metadata (and thus the Perfetto /

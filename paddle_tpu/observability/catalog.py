@@ -18,7 +18,7 @@ from .metrics import (DEFAULT_LATENCY_BUCKETS, MetricsRegistry,
 
 __all__ = ["train_metrics", "serving_metrics", "comm_metrics",
            "mem_metrics", "ckpt_metrics", "goodput_metrics",
-           "health_metrics", "SCHEMA_PATH"]
+           "health_metrics", "offload_metrics", "SCHEMA_PATH"]
 
 SCHEMA_PATH = __file__.rsplit("/", 1)[0] + "/schema.json"
 
@@ -116,8 +116,9 @@ def mem_metrics(reg: MetricsRegistry = None) -> Dict[str, object]:
             "paddle_tpu_mem_state_bytes",
             "measured per-device model-state footprint by component "
             "(params / grads / optimizer_state / master_weights / "
-            "activation_ckpt), addressable-shard bytes — ZeRO scatter "
-            "and pp x vpp chunk ownership included "
+            "activation_ckpt / host_state), addressable-shard bytes — "
+            "ZeRO scatter, pp x vpp chunk ownership, and the host-"
+            "offload tier's host-resident split included "
             "(memledger.account_engine)", labelnames=("component",),
             unit="bytes"),
         "mem_drift": r.gauge(
@@ -135,6 +136,51 @@ def mem_metrics(reg: MetricsRegistry = None) -> Dict[str, object]:
             "high-water mark of paddle_tpu_mem_live_bytes over the "
             "engine's lifetime, sampled at step boundaries",
             unit="bytes"),
+    }
+
+
+def offload_metrics(reg: MetricsRegistry = None) -> Dict[str, object]:
+    """Register (get-or-create) the host-memory offload tier's
+    instrument set — shared by the train engine (optimizer moments /
+    AMP masters / EF residuals / stored param shards,
+    distributed/host_offload.py) and the serving engine (cold KV page
+    spill, ``component="kv_page"``). Transfer gauges are CUMULATIVE
+    closed-form byte/op totals (per-device addressable-shard bytes per
+    slot; page_bytes per spilled page) — bench lines pin them against
+    the analytic form exactly."""
+    r = reg or get_registry()
+    return {
+        "bytes": r.gauge(
+            "paddle_tpu_offload_transfer_bytes",
+            "cumulative host<->device transfer bytes of the offload "
+            "tier by state component and direction (d2h = page-out / "
+            "spill, h2d = prefetch / fault-back), booked at the "
+            "closed form: per-device addressable-shard bytes per slot "
+            "(memledger.shard_bytes), page_bytes per KV page",
+            labelnames=("component", "direction"), unit="bytes"),
+        "ops": r.gauge(
+            "paddle_tpu_offload_transfer_ops",
+            "cumulative offload-tier transfers by component and "
+            "direction (one op per slot / per KV page)",
+            labelnames=("component", "direction")),
+        "host": r.gauge(
+            "paddle_tpu_offload_host_bytes",
+            "per-device state bytes currently resident on the host "
+            "tier by component — what HBM is NOT holding between "
+            "steps (mirrors memledger's host_state accounting "
+            "component)", labelnames=("component",), unit="bytes"),
+        "prefetch_seconds": r.gauge(
+            "paddle_tpu_offload_prefetch_seconds",
+            "wall seconds the last dispatch spent re-placing host-"
+            "tier state on device (also journaled as an OVERLAPPED "
+            "goodput segment, like the async checkpoint writer)",
+            unit="s"),
+        "spilled_pages": r.gauge(
+            "paddle_tpu_offload_spilled_pages",
+            "cold KV-cache pages currently resident on the host tier "
+            "(spilled out of the fixed device page pool by LRU "
+            "eviction; they fault back through the normal page "
+            "allocation on a prefix hit)"),
     }
 
 
@@ -254,6 +300,7 @@ def train_metrics(reg: MetricsRegistry = None) -> Dict[str, object]:
     r = reg or get_registry()
     out = comm_metrics(r)
     out.update(mem_metrics(r))
+    out.update({f"offload_{k}": v for k, v in offload_metrics(r).items()})
     out.update({f"ckpt_{k}": v for k, v in ckpt_metrics(r).items()})
     out.update(goodput_metrics(r))
     out.update({f"health_{k}": v for k, v in health_metrics(r).items()})
@@ -347,6 +394,7 @@ def serving_metrics(reg: MetricsRegistry = None) -> Dict[str, object]:
     r = reg or get_registry()
     out = comm_metrics(r)
     out.update(mem_metrics(r))
+    out.update({f"offload_{k}": v for k, v in offload_metrics(r).items()})
     out.update({
         "ttft": r.histogram(
             "paddle_tpu_serving_ttft_seconds",

@@ -32,16 +32,21 @@ ledger stores both ``payload_bytes`` and the derived ``wire_bytes``.
 
 Caveats (documented, asserted nowhere): a collective inside a
 ``lax.scan`` body is traced ONCE and therefore counted once, not
-``length`` times — the pipeline ring's per-tick ppermute is a lower
-bound. Unrolled Python rings (collective_matmul) and the flat
-grad-sync collectives are exact. Scan bodies whose trip count is
-statically known can opt into exact accounting by wrapping the
+``length`` times. Unrolled Python rings (collective_matmul) and the
+flat grad-sync collectives are exact. Scan bodies whose trip count is
+statically known opt into exact accounting by wrapping the
 ``lax.scan`` call in ``scan_trips(length)``: records noted inside
 carry ``trips=length`` and every byte/op total (and the exposed-comm
-replay) scales by it — the bucketed grad-sync scan
-(distributed/grad_buckets.py) does this, so
-``comm_exposed_fraction{axis=sharding}`` is never overstated by a
-once-counted ledger.
+replay) scales by it. Both in-tree comm-bearing scans do this — the
+bucketed grad-sync scan (distributed/grad_buckets.py, trips=nb) and
+the pipeline ring (fleet/.../pp_layers.py ``_pipe_fn``,
+trips=E+S-1 forward ticks), so the forward pp ppermute bytes are
+EXACT, not a lower bound. The remaining blind spot is the pipeline's
+BACKWARD ring: AD synthesizes the reverse-tick ppermute as the
+transpose of the forward one without ever passing through the noting
+shim, so it is not recorded at all — the ``{axis=pp}`` totals are
+exact for the forward schedule and understate a full train step by
+exactly the reverse ring.
 
 The second half of this module is the **exposed-comm attribution**
 support: ``ablate(labels)`` switches the shim into a mode where the

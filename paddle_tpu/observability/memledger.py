@@ -265,11 +265,22 @@ class StateAccounting:
     def measured_bytes(self) -> int:
         return int(sum(self.components.values()))
 
+    @property
+    def device_bytes(self) -> int:
+        """HBM-resident bytes only: the measured total minus the
+        ``host_state`` component (state the offload tier holds in host
+        memory between steps). What the analytic ``hbm_gb`` pruning —
+        and the bench's stage-3-minus-offloaded parity line — compare
+        against."""
+        return self.measured_bytes - int(
+            self.components.get("host_state", 0))
+
     def to_dict(self) -> Dict[str, Any]:
         return {
             "components": dict(self.components),
             "groups": {g: dict(v) for g, v in sorted(self.groups.items())},
             "measured_bytes": self.measured_bytes,
+            "device_bytes": self.device_bytes,
             "analytic_bytes": round(self.analytic_bytes, 1),
             "analytic_drift": round(self.drift, 4),
         }
@@ -299,18 +310,31 @@ def account_engine(engine, batch_tokens: int = 0,
     dtype_bytes`` (one saved residual per transformer block, the
     remat-boundary convention; reported 0 when the model carries no
     layer-geometry config)."""
+    from ..distributed.host_offload import is_host
+
     mesh = engine.mesh
     opt = engine.optimizer
     comp = {"params": 0, "grads": 0, "optimizer_state": 0,
             "master_weights": 0, "activation_ckpt": 0}
+    # host-offloaded state (distributed/host_offload.py): slots the
+    # tier holds as HostState between steps book under ONE host_state
+    # component at the SAME per-device shard size (HostState exposes
+    # the live sharding, so shard_bytes prices it identically) — the
+    # device components shrink by exactly what host_state gains,
+    # byte-for-byte (the bench offload parity line gates on it)
+    host = 0
     # quant_comm error-feedback residuals are REAL HBM: one f32
     # bucket-payload-sized buffer per quantizing bucket (engine
     # _quant_residuals; the analytic model's quant_comm term mirrors
     # this so paddle_tpu_mem_analytic_drift stays honest)
     qres = getattr(engine, "_quant_residuals", None) or {}
     if qres:
-        comp["quant_residual"] = sum(shard_bytes(v)
-                                     for v in qres.values())
+        dev_q = sum(shard_bytes(v) for v in qres.values()
+                    if not is_host(v))
+        host += sum(shard_bytes(v) for v in qres.values()
+                    if is_host(v))
+        if dev_q:
+            comp["quant_residual"] = dev_q
     groups: Dict[str, Dict[str, int]] = {}
     named = {}
     try:
@@ -322,7 +346,10 @@ def account_engine(engine, batch_tokens: int = 0,
         else {}
     for p in engine.params:
         pb = shard_bytes(p._value)
-        comp["params"] += pb
+        if is_host(p._value):
+            host += pb
+        else:
+            comp["params"] += pb
         g = groups.setdefault(_group_name(named.get(id(p), "param")),
                               {"params": 0, "optimizer_state": 0,
                                "master_weights": 0})
@@ -334,19 +361,28 @@ def account_engine(engine, batch_tokens: int = 0,
             # scatter shard — matching the cost model's grad_bytes/sh
             # (the eager per-bucket scatter keeps full grads transient
             # at bucket grain), so the analytic drift stays flat when
-            # the stage knob flips
+            # the stage knob flips. Grads are device-transient even
+            # when the param shard itself is host-offloaded.
             comp["grads"] += pb
         st = states.get(id(p))
         if st:
             sb = sum(shard_bytes(v) for v in st.values()
-                     if hasattr(v, "shape"))
+                     if hasattr(v, "shape") and not is_host(v))
+            hb = sum(shard_bytes(v) for v in st.values()
+                     if is_host(v))
             comp["optimizer_state"] += sb
-            g["optimizer_state"] += sb
+            host += hb
+            g["optimizer_state"] += sb + hb
         mw = masters.get(id(p))
         if mw is not None:
             mb = shard_bytes(mw)
-            comp["master_weights"] += mb
+            if is_host(mw):
+                host += mb
+            else:
+                comp["master_weights"] += mb
             g["master_weights"] += mb
+    if host:
+        comp["host_state"] = host
 
     cfg = getattr(engine.model, "config", None)
     hidden = getattr(cfg, "hidden_size", None)
@@ -387,6 +423,14 @@ def account_engine(engine, batch_tokens: int = 0,
         if qres and qcfg is not None and qcfg.enabled:
             cfg_d["quant_comm"] = {"dtype": qcfg.dtype,
                                    "error_feedback": True}
+        # the offload knob flows into the cost model so the analytic
+        # estimate prices the same HBM image the engine actually holds
+        # (estimate_memory_gb subtracts the host-tier classes) and the
+        # drift gauge stays flat when the knob flips
+        tier = getattr(engine, "_offload", None)
+        if tier is not None:
+            cfg_d["offload"] = {"optimizer": tier.cfg.optimizer,
+                                "params": tier.cfg.params}
         try:
             analytic = estimate_memory_gb(
                 model_d, cfg_d,
@@ -395,7 +439,9 @@ def account_engine(engine, batch_tokens: int = 0,
                 dtype_bytes=dtype_bytes) * 1e9
         except Exception:
             analytic = 0.0
-    measured = sum(comp.values())
+    # drift compares DEVICE-resident bytes: the analytic model prices
+    # HBM, and host_state is precisely what HBM no longer holds
+    measured = sum(comp.values()) - comp.get("host_state", 0)
     drift = ((analytic - measured) / measured) if measured and analytic \
         else 0.0
     return StateAccounting(components=comp, groups=groups,
@@ -407,18 +453,37 @@ def closed_form_state_bytes(engine) -> Dict[str, int]:
     GLOBAL shapes divided by the sharding degrees the specs + ZeRO plan
     declare — an independent derivation from ``account_engine`` (which
     reads ``sharding.shard_shape``); the two must agree exactly, which
-    the bench parity lines and tests/test_memledger.py gate on."""
+    the bench parity lines and tests/test_memledger.py gate on.
+
+    With the host-offload tier active, bytes the tier holds on host
+    (per the knob: optimizer moments + masters, optionally param
+    shards) move into a ``host_state`` key — still derived purely from
+    GLOBAL shapes and degrees, so the byte-for-byte cross-check covers
+    the offloaded split too."""
+    from ..distributed.host_offload import is_host
+
     mesh = engine.mesh
     opt = engine.optimizer
     zero = getattr(engine, "_zero", None)
+    tier = getattr(engine, "_offload", None)
+    off_opt = tier is not None and tier.cfg.optimizer
+    off_par = tier is not None and tier.cfg.params
     out = {"params": 0, "optimizer_state": 0, "master_weights": 0}
+    host = 0
     for p in engine.params:
         nbytes = int(np.prod(p._value.shape) if p._value.ndim else 1) \
             * int(np.dtype(p._value.dtype).itemsize)
         e = zero.entry(p) if zero is not None else None
         # stage-3 params are STORED scattered; stage 1/2 replicated
         store_extra = (zero.axis,) if e is not None and e[1] else ()
-        out["params"] += nbytes // _spec_degree(p, mesh, store_extra)
+        pb = nbytes // _spec_degree(p, mesh, store_extra)
+        # the tier only moves a slot it actually adopted (a live
+        # HostState) — a freshly-built engine before the first
+        # page-out still accounts fully on device
+        if off_par and is_host(p._value):
+            host += pb
+        else:
+            out["params"] += pb
         if not getattr(p, "trainable", True) or opt is None:
             continue
         state_extra = (zero.axis,) if e is not None else ()
@@ -430,13 +495,34 @@ def closed_form_state_bytes(engine) -> Dict[str, int]:
                 * int(np.dtype(v.dtype).itemsize)
             if tuple(v.shape) == tuple(p._value.shape):
                 vb //= _spec_degree(p, mesh, state_extra)
-            out["optimizer_state"] += vb
+            if off_opt and is_host(v):
+                host += vb
+            else:
+                out["optimizer_state"] += vb
         mw = getattr(opt, "_master_weights", {}).get(id(p))
         if mw is not None:
             mb = int(np.prod(mw.shape) if mw.ndim else 1) \
                 * int(np.dtype(mw.dtype).itemsize)
-            out["master_weights"] += mb // _spec_degree(p, mesh,
-                                                        state_extra)
+            mb //= _spec_degree(p, mesh, state_extra)
+            if off_opt and is_host(mw):
+                host += mb
+            else:
+                out["master_weights"] += mb
+    if off_opt:
+        # quant-comm EF residuals ride the optimizer class: dim 0 is
+        # sharded over EVERY >1 mesh axis, so the per-device closed
+        # form is the global size over the full mesh product
+        prod = 1
+        for a in mesh.axis_names:
+            if int(mesh.shape[a]) > 1:
+                prod *= int(mesh.shape[a])
+        for v in getattr(engine, "_quant_residuals", {}).values():
+            if is_host(v):
+                vb = int(np.prod(v.shape) if v.ndim else 1) \
+                    * int(np.dtype(v.dtype).itemsize)
+                host += vb // prod
+    if host:
+        out["host_state"] = host
     return out
 
 

@@ -27,7 +27,7 @@ except Exception:  # pragma: no cover
 
 from . import is_tpu_platform, pick_block
 
-__all__ = ["rms_norm_fused"]
+__all__ = ["rms_norm_fused", "rms_norm_supported", "rms_norm_dense"]
 
 
 def _kernel(x_ref, w_ref, o_ref, *, eps):
@@ -50,6 +50,25 @@ def _rms_ref(x2, w, eps):
 
 def _interpret_default() -> bool:
     return not is_tpu_platform()
+
+
+def rms_norm_supported(shape) -> bool:
+    """Mosaic gate for kernel-dispatch sites: True when the flattened
+    row count and the hidden dim of ``shape`` tile cleanly on real TPU
+    (see _mosaic_tileable).  Callers fall back to rms_norm_dense when
+    this returns False."""
+    H = int(shape[-1])
+    T = 1
+    for d in shape[:-1]:
+        T *= int(d)
+    return _mosaic_tileable(T, _pick_block(T), H)
+
+
+def rms_norm_dense(x, weight, eps=1e-6):
+    """XLA reference path — identical f32 math to the kernel, so the
+    fused and dense paths are numerically interchangeable."""
+    H = x.shape[-1]
+    return _rms_ref(x.reshape(-1, H), weight, eps).reshape(x.shape)
 
 
 @partial(jax.custom_vjp, nondiff_argnums=(2, 3))

@@ -441,6 +441,68 @@ class TestBucketedGradSyncLedger:
 
 
 # ---------------------------------------------------------------------------
+# pipeline ring trips-exact accounting
+# ---------------------------------------------------------------------------
+class TestPipelineRingLedger:
+    """The pp ring's per-tick ppermute rides _pipe_fn's lax.scan under
+    ``scan_trips(E + S - 1)``: the ledger is trips-EXACT on the pp
+    axis, pinned to the closed form trips x carry bytes. AD synthesizes
+    the reverse ring outside the noting shim, so the forward schedule
+    is the entire pp record set (the docstring caveat, asserted here)."""
+
+    def test_ring_bytes_match_closed_form(self):
+        from paddle_tpu.distributed import fleet
+        from paddle_tpu.models import GPTForCausalLMPipe
+        from paddle_tpu.models.gpt import GPTConfig
+
+        S, V, M, sh = 2, 2, 2, 2        # pp, vpp, microbatches, sharding
+        cfg = GPTConfig(vocab_size=128, hidden_size=32, num_layers=4,
+                        num_heads=4, max_position_embeddings=32)
+        strategy = fleet.DistributedStrategy()
+        strategy.hybrid_configs = {
+            "dp_degree": 1, "mp_degree": 2, "pp_degree": S,
+            "sharding_degree": sh,
+            "pp_configs": {"num_virtual_pipeline_stages": V}}
+        strategy.pipeline_configs = {"accumulate_steps": M,
+                                     "micro_batch_size": 2}
+        fleet._fleet_state.update(initialized=False, hcg=None,
+                                  strategy=None)
+        fleet.init(is_collective=True, strategy=strategy)
+        paddle.seed(0)
+        model = GPTForCausalLMPipe(cfg)
+        dm = fleet.distributed_model(model)
+        opt = fleet.distributed_optimizer(
+            paddle.optimizer.AdamW(learning_rate=1e-3,
+                                   parameters=model.parameters()))
+        r = np.random.RandomState(0)
+        ids = r.randint(0, cfg.vocab_size, (8, 17))
+        x, y = paddle.to_tensor(ids[:, :-1]), paddle.to_tensor(ids[:, 1:])
+        float(dm.train_batch([x, y], opt))
+        led = dm._engine.comm_ledger()
+        pp_recs = [q for q in led.records
+                   if q.op == "ppermute" and "pp" in q.axes]
+        # ONE traced site, trips == E + S - 1 forward ticks
+        assert len(pp_recs) == 1
+        trips = V * M + S - 1
+        assert pp_recs[0].trips == trips
+        # carry payload: one microbatch of stage-boundary activations,
+        # [B_local/M, seq, hidden] f32 (dp x sharding splits the batch)
+        seq = ids.shape[1] - 1
+        mb = ids.shape[0] // (1 * sh) // M
+        payload = mb * seq * cfg.hidden_size * F32
+        assert pp_recs[0].payload_bytes == payload
+        # trips-exact totals: bytes == trips x payload (ppermute wire
+        # == payload), ops counted once per tick
+        assert led.bytes_for(axis="pp", op="ppermute") == trips * payload
+        assert led.ops_for(axis="pp", op="ppermute") == trips
+        # no reverse-ring record exists: the backward ppermute never
+        # re-enters the noting shim (grad-norm psums etc. still cross
+        # pp as part of wider axis groups — only the ring is pinned)
+        assert [q.op for q in led.records if q.op == "ppermute"] \
+            == ["ppermute"]
+
+
+# ---------------------------------------------------------------------------
 # ablation stand-ins
 # ---------------------------------------------------------------------------
 class TestAblation:

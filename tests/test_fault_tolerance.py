@@ -882,3 +882,36 @@ class TestSigkillMatrix:
         assert start in (2, 4), (site, start)
         resumed = self._losses(base + "/p2.log")
         assert resumed == gold[start:], (site, resumed, gold[start:])
+
+    def test_sigkill_mid_prefetch_resume_bit_exact(self, tmp_path,
+                                                   golden):
+        """SIGKILL inside the ``offload.prefetch`` failpoint — between
+        one step's host page-out and the next dispatch, the window
+        where ALL optimizer state exists only as host buffers of a dead
+        process. The relaunch rebuilds the host tier from the committed
+        checkpoint and continues the OFFLOAD-OFF golden bit-exactly:
+        crash safety and the offload on/off parity property in one run.
+        The worker's single flat bucket makes hit N fire right before
+        step N-1's dispatch (states page out at train_step build), so
+        kill@3 dies entering step 2: steps 0-1 ran, the step-2 save
+        committed."""
+        total, save_every = self.TOTAL, self.SAVE_EVERY
+        base = str(tmp_path / "run_offload_prefetch")
+        rc, log = self._run({
+            "CKPT_BASE": base + "/ck", "TOTAL_STEPS": total,
+            "SAVE_EVERY": save_every, "TEST_OUT": base + "/p1",
+            "OFFLOAD": 1,
+            "PADDLE_TPU_FAILPOINTS": "offload.prefetch=kill@3"})
+        assert rc == -9, (rc, log)
+        assert self._losses(base + "/p1.log") == golden[:2]
+
+        rc, log = self._run({"CKPT_BASE": base + "/ck",
+                             "TOTAL_STEPS": total,
+                             "SAVE_EVERY": save_every,
+                             "TEST_OUT": base + "/p2",
+                             "OFFLOAD": 1})
+        assert rc == 0, log
+        with open(base + "/p2.json") as f:
+            start = json.load(f)["start"]
+        assert start == 2, (start, log)
+        assert self._losses(base + "/p2.log") == golden[start:]

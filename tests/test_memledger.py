@@ -258,7 +258,10 @@ class TestStateAccounting:
         assert np.isfinite(acct.drift)
         d = acct.to_dict()
         assert set(d) == {"components", "groups", "measured_bytes",
-                          "analytic_bytes", "analytic_drift"}
+                          "device_bytes", "analytic_bytes",
+                          "analytic_drift"}
+        # no offload on this engine: nothing host-resident
+        assert d["device_bytes"] == d["measured_bytes"]
         json.dumps(d)     # bench lines must serialize
 
     def test_autotuner_crosscheck_matches_gauge_math(self):

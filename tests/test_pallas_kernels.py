@@ -118,6 +118,57 @@ def test_rms_norm_fused():
                                atol=1e-5)
 
 
+def test_rms_norm_kernel_vs_dense_parity():
+    """The two lowerings the llama dispatch switches between — fused
+    kernel (interpret) vs rms_norm_dense — must agree in value AND
+    grad, and the Mosaic gate must admit/reject the right shapes."""
+    from paddle_tpu.ops.pallas.rms_norm import (rms_norm_dense,
+                                                rms_norm_supported)
+
+    rng = np.random.RandomState(7)
+    x = jnp.asarray(rng.randn(4, 8, 128).astype("float32"))
+    w = jnp.asarray(rng.rand(128).astype("float32") + 0.5)
+    assert rms_norm_supported(x.shape)          # 32 rows × H=128 tiles
+    assert not rms_norm_supported((3, 5, 96))   # sub-lane H → dense path
+    fused = rms_norm_fused(x, w, 1e-6, True)
+    dense = rms_norm_dense(x, w, 1e-6)
+    np.testing.assert_allclose(np.asarray(fused), np.asarray(dense),
+                               rtol=1e-6, atol=1e-6)
+
+    gk = jax.grad(lambda a, b: jnp.sum(rms_norm_fused(a, b, 1e-6,
+                                                      True) ** 2),
+                  argnums=(0, 1))(x, w)
+    gd = jax.grad(lambda a, b: jnp.sum(rms_norm_dense(a, b, 1e-6) ** 2),
+                  argnums=(0, 1))(x, w)
+    for a, b in zip(gk, gd):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_llama_rms_norm_module_parity():
+    """LlamaRMSNorm (Pallas-dispatch wiring) vs plain nn.RMSNorm on the
+    same weights: identical forward and weight grads — the wiring only
+    changes the lowering, never the math."""
+    from paddle_tpu import nn
+    from paddle_tpu.models.llama import LlamaRMSNorm
+
+    rng = np.random.RandomState(9)
+    w = rng.rand(32).astype("float32") + 0.5
+    xv = rng.randn(2, 6, 32).astype("float32")
+
+    outs, grads = [], []
+    for cls in (LlamaRMSNorm, nn.RMSNorm):
+        m = cls(32, epsilon=1e-5)
+        m.weight.set_value(paddle.to_tensor(w))
+        x = paddle.to_tensor(xv)
+        out = m(x)
+        (out ** 2).sum().backward()
+        outs.append(np.asarray(out._value))
+        grads.append(np.asarray(m.weight.grad._value))
+    np.testing.assert_allclose(outs[0], outs[1], rtol=1e-6, atol=1e-6)
+    np.testing.assert_allclose(grads[0], grads[1], rtol=1e-5, atol=1e-6)
+
+
 def test_incubate_fused_functional():
     """Reference-name fused surface: rms_norm/rope/bias_act/swiglu."""
     import paddle_tpu.incubate.nn.functional as FF

@@ -5,7 +5,10 @@ auto-resume from the newest COMMITTED checkpoint.
 The failpoint table arms itself from PADDLE_TPU_FAILPOINTS in the
 environment (e.g. "ckpt.commit=kill@2" SIGKILLs this process during the
 second save), so the driving test only sets env vars:
-CKPT_BASE, TOTAL_STEPS, SAVE_EVERY, TEST_OUT, SAVE_ASYNC, KEEP_LAST_K.
+CKPT_BASE, TOTAL_STEPS, SAVE_EVERY, TEST_OUT, SAVE_ASYNC, KEEP_LAST_K,
+OFFLOAD (=1 runs the engine with the host-memory offload tier on —
+"offload.prefetch=kill@N" then SIGKILLs mid-prefetch, between the
+page-out of one step and the dispatch of the next).
 
 Losses stream to <TEST_OUT>.log one per line (flushed per step) so
 progress is readable after a SIGKILL; on clean completion
@@ -57,7 +60,12 @@ def main():
     crit = GPTPretrainingCriterion(cfg)
     opt = paddle.optimizer.AdamW(learning_rate=5e-3,
                                  parameters=model.parameters())
-    eng = ParallelEngine(model, opt)
+    offload = None
+    if os.environ.get("OFFLOAD", "") == "1":
+        # single bucket on the plan-less engine -> prefetch hit N is
+        # exactly step N's prefetch (deterministic kill placement)
+        offload = {"optimizer": True, "prefetch_buckets": 1}
+    eng = ParallelEngine(model, opt, offload=offload)
     step_fn = eng.train_step(lambda m, b: crit(m(b["x"]), b["y"]))
 
     start = 0

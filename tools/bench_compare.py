@@ -66,6 +66,19 @@ _EXACT = {"pallas_kernel_parity_interpret": 1.0,
           # byte-for-byte, with the params component at exactly
           # 1/sharding_degree of the stage-2 replicated image
           "gpt13b_hybrid_stage3_mem_state_parity": 1.0,
+          # host-offload tier vs the stage-3 line one knob apart: the
+          # tier copies bytes (never re-derives), so the trajectory is
+          # BIT-exact (max_abs_loss_diff == 0), the transfer ledger
+          # pins to the per-slot shard-bytes closed form with d2h-h2d
+          # conservation, and warm steps never recompile
+          "gpt13b_hybrid_offload_loss_parity": 1.0,
+          # offload memory: host_state component == closed form and
+          # the device-resident image == stage3 minus host_state
+          "gpt13b_hybrid_offload_mem_state_parity": 1.0,
+          # the capability the tier buys: the 13B flagship geometry
+          # over a 16 GB chip is trainable ONLY with the optimizer
+          # tier offloaded (auto_tuner cost-model pricing)
+          "gpt13b_hybrid_offload_overhbm_trainable": 1.0,
           # memory ledger: measured state accounting (shard_shape path)
           # == closed form (global shape / sharding degree), byte-for-
           # byte incl. ZeRO-2 scattered state + pp x vpp chunks
