@@ -91,8 +91,10 @@ class CheckpointManager:
     """
 
     def __init__(self, base: str, keep_last_k: int = 3,
-                 async_save: bool = False, coordinator_rank: int = 0):
+                 async_save: bool = False, coordinator_rank: int = 0,
+                 metrics_sample_s: Optional[float] = None):
         from ...observability import goodput as _gp
+        from ...observability import timeseries as _ts
         from ...observability.catalog import ckpt_metrics
 
         self.base = base
@@ -108,6 +110,16 @@ class CheckpointManager:
             self._goodput = _gp.attach_dir(base)
         except OSError:
             self._goodput = None     # unwritable base: saves will fail
+        # optional durable metrics journal next to the goodput ledger
+        # (metrics.jsonl, same flush-first crash discipline): sampled
+        # every metrics_sample_s seconds when the knob is set
+        self._sampler = None
+        if metrics_sample_s is not None:
+            try:
+                self._sampler = _ts.attach_dir(
+                    base, interval_s=float(metrics_sample_s))
+            except (OSError, ValueError):
+                self._sampler = None
 
         self._queue: "queue.Queue" = queue.Queue()
         self._writer: Optional[threading.Thread] = None

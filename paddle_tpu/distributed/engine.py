@@ -385,6 +385,20 @@ class ParallelEngine:
             if mem_ledger is None else bool(mem_ledger))
         self._mem_ledgers: Dict[Any, Any] = {}
         self._mem_args: Dict[Any, Any] = {}
+        # durable metrics time-series journal (observability/timeseries):
+        # a background sampler snapshots the registry into
+        # <dir>/metrics.jsonl every PADDLE_TPU_TIMESERIES_S seconds.
+        # Pure host-side file IO on an existing snapshot — adds zero ops
+        # to compiled programs, so compile caches stay flat.
+        self.sampler = None
+        ts_dir = os.environ.get("PADDLE_TPU_TIMESERIES_DIR")
+        if ts_dir:
+            from ..observability import timeseries as _ts
+            try:
+                self.sampler = _ts.attach_dir(ts_dir, interval_s=float(
+                    os.environ.get("PADDLE_TPU_TIMESERIES_S", "5.0")))
+            except (OSError, ValueError):
+                self.sampler = None
         self._state_acct = None          # cached StateAccounting
         self._live_peak = 0              # live-bytes high-water mark
         self._last_tokens = 0

@@ -109,7 +109,11 @@ from ..core.enforce import enforce
 from ..observability import commledger as _cl
 from ..observability import memledger as _ml
 from ..observability.catalog import serving_metrics as _serving_metrics
-from ..observability.spans import RequestTrace, SpanRing
+from ..observability.spans import (RequestTrace, SpanRing,
+                                   format_traceparent as
+                                   _format_traceparent,
+                                   parse_traceparent as
+                                   _parse_traceparent)
 from ..tensor import Tensor
 
 __all__ = ["ServingEngine", "ServingRequest"]
@@ -133,10 +137,25 @@ class ServingRequest:
     # served normally); admission deadline in the t_submit clock domain
     shed_reason: Optional[str] = None
     deadline: Optional[float] = None
+    # W3C trace identity (observability/spans.py): trace_id spans
+    # processes, span_id is this request's root span in THIS engine,
+    # parent_span_id the submitting caller's span elsewhere
+    trace_id: Optional[str] = None
+    span_id: Optional[str] = None
+    parent_span_id: Optional[str] = None
 
     @property
     def shed(self) -> bool:
         return self.shed_reason is not None
+
+    @property
+    def traceparent(self) -> Optional[str]:
+        """The ``00-<trace_id>-<span_id>-01`` header downstream work
+        on this request should carry (None before submit stamps the
+        identity)."""
+        if self.trace_id is None or self.span_id is None:
+            return None
+        return _format_traceparent(self.trace_id, self.span_id)
 
     @property
     def output_ids(self) -> np.ndarray:
@@ -428,10 +447,28 @@ class ServingEngine:
         self._health_provider = _health_provider
         _exporter.add_health_provider(_health_provider)
 
+        # durable metrics history: PADDLE_TPU_TIMESERIES_DIR attaches
+        # the background registry sampler (observability/timeseries.py;
+        # PADDLE_TPU_TIMESERIES_S sets the interval) — host-side only,
+        # so serving programs and their compile caches are untouched
+        self.sampler = None
+        ts_dir = os.environ.get("PADDLE_TPU_TIMESERIES_DIR")
+        if ts_dir:
+            from ..observability import timeseries as _ts
+
+            try:
+                self.sampler = _ts.attach_dir(
+                    ts_dir, interval_s=float(os.environ.get(
+                        "PADDLE_TPU_TIMESERIES_S", "5.0")))
+            except (OSError, ValueError):
+                self.sampler = None    # unwritable dir: serve anyway
+
     # -- admission -------------------------------------------------------
     def submit(self, prompt, max_new_tokens: Optional[int] = None,
                eos_token_id: Optional[int] = None,
-               deadline_s: Optional[float] = None) -> int:
+               deadline_s: Optional[float] = None,
+               trace_id: Optional[str] = None,
+               parent_span_id: Optional[str] = None) -> int:
         """Queue one request; returns its rid (admission happens inside
         step()/run(), when a slot and enough free pages exist).
 
@@ -439,7 +476,15 @@ class ServingEngine:
         the request immediately (it lands in ``finished`` with
         ``shed_reason="queue_full"`` and zero tokens). ``deadline_s``
         (default: the engine's ``admission_deadline_s``) bounds how long
-        the request may wait for admission before being shed."""
+        the request may wait for admission before being shed.
+
+        Cross-process tracing: ``trace_id`` is either a 32-hex W3C
+        trace id or a full ``traceparent`` header (in which case the
+        caller's span id is taken from it); ``parent_span_id``
+        overrides/supplies the caller's 16-hex span id. Missing pieces
+        are generated, so every request ALWAYS carries a valid trace
+        identity — read it back from ``ServingRequest.traceparent`` or
+        ``trace_context(rid)`` to stitch a multi-replica trace."""
         ids = np.asarray(prompt._value if isinstance(prompt, Tensor)
                          else prompt).reshape(-1).astype(np.int64)
         n_new = int(max_new_tokens if max_new_tokens is not None
@@ -454,6 +499,13 @@ class ServingEngine:
         enforce(self._pages_needed(L, n_new) <= self.P - 1,
                 f"request needs {self._pages_needed(L, n_new)} pages but "
                 f"the pool only has {self.P - 1}; raise pool_pages")
+        if trace_id is not None and "-" in trace_id:
+            # a full traceparent header: the caller's span becomes
+            # this trace's parent unless explicitly overridden
+            tid, parent = _parse_traceparent(trace_id)
+            trace_id = tid
+            if parent_span_id is None:
+                parent_span_id = parent
         rid = self._next_rid
         self._next_rid += 1
         now = time.perf_counter()
@@ -463,7 +515,12 @@ class ServingEngine:
                              deadline=(now + dls) if dls is not None
                              else None)
         tr = RequestTrace(rid, meta={"prompt_len": L,
-                                     "max_new_tokens": n_new})
+                                     "max_new_tokens": n_new},
+                          trace_id=trace_id,
+                          parent_span_id=parent_span_id)
+        req.trace_id = tr.trace_id
+        req.span_id = tr.span_id
+        req.parent_span_id = tr.parent_span_id
         tr.begin("queued", now)
         self._live_traces[rid] = tr
         self._metrics["requests"].inc(event="submitted")
@@ -1809,9 +1866,32 @@ class ServingEngine:
                               ) -> Dict[str, Any]:
         """Chrome-trace JSON (chrome://tracing / Perfetto) of the
         finished request traces plus any still in flight; writes to
-        ``path`` when given and returns the trace dict."""
+        ``path`` when given and returns the trace dict. Every event's
+        args carry the request's ``trace_id``/``span_id`` (and
+        ``parent_span_id`` when the caller supplied one), so traces
+        exported by different replicas stitch on ``trace_id``."""
         return self.traces.to_chrome_trace(
             path, extra=list(self._live_traces.values()))
+
+    def trace_context(self, rid: int) -> Optional[Dict[str, Any]]:
+        """The W3C trace identity of one request — live or finished —
+        or None for an unknown rid. ``traceparent`` is the header a
+        router propagates to the NEXT hop (it names this request's
+        root span as the parent)::
+
+            {"trace_id", "span_id", "parent_span_id", "traceparent"}
+        """
+        tr = self._live_traces.get(rid)
+        if tr is not None:
+            return {"trace_id": tr.trace_id, "span_id": tr.span_id,
+                    "parent_span_id": tr.parent_span_id,
+                    "traceparent": tr.traceparent}
+        req = self.finished.get(rid)
+        if req is not None and req.trace_id is not None:
+            return {"trace_id": req.trace_id, "span_id": req.span_id,
+                    "parent_span_id": req.parent_span_id,
+                    "traceparent": req.traceparent}
+        return None
 
     def metrics_snapshot(self):
         """Current registry snapshot (TTFT/TPOT histograms, occupancy,

@@ -38,7 +38,18 @@ subsystems instrument into:
 - **health**   — rolling robust (median + MAD) anomaly events over
   loss / grad-norm / step time (``healthmon``): spike events + flight
   records + a degraded ``/healthz`` component + cross-host straggler
-  gauges.
+  gauges,
+- **timeseries** — a crash-durable sampled metrics journal
+  (``timeseries``): a background sampler snapshots the registry every
+  N seconds into ``metrics.jsonl`` (flush-first, lenient tail reader,
+  bounded by compaction) with a label-filtered range-query +
+  resampling API (``tools/fleet_report.py`` reads these per host),
+- **fleet**    — a stdlib-HTTP cross-host collector (``fleet``):
+  scrapes or receives per-host expositions, re-labels series with
+  ``host``, serves a merged fleet ``/metrics`` (counters summed,
+  gauges min/max/mean, fixed-bucket histograms merged bucket-exactly)
+  and a fleet ``/healthz`` rollup (degraded / unreachable / stale
+  members).
 
 Exports: Prometheus text exposition + JSONL sink + in-process
 snapshots (metrics.py), plus an optional stdlib HTTP ``/metrics``
@@ -58,16 +69,21 @@ from .flight import FlightRecorder, dump as dump_flight_record, \
     get_recorder  # noqa: F401
 from . import flops  # noqa: F401
 from . import commledger  # noqa: F401
+from . import fleet  # noqa: F401
 from . import goodput  # noqa: F401
 from . import healthmon  # noqa: F401
 from . import memledger  # noqa: F401
 from . import moestats  # noqa: F401
 from . import spans  # noqa: F401
+from . import timeseries  # noqa: F401
 from .commledger import CommLedger  # noqa: F401
+from .fleet import FleetCollector  # noqa: F401
 from .goodput import GoodputLedger  # noqa: F401
 from .healthmon import HealthMonitor  # noqa: F401
 from .memledger import MemLedger, RooflineReport, StateAccounting  # noqa: F401,E501
-from .spans import RequestTrace, SpanRing  # noqa: F401
+from .spans import (RequestTrace, SpanRing, format_traceparent,  # noqa: F401
+                    make_span_id, make_trace_id, parse_traceparent)
+from .timeseries import MetricsSampler  # noqa: F401
 from .exporter import MetricsServer, serve_metrics  # noqa: F401
 
 __all__ = [
@@ -75,11 +91,13 @@ __all__ = [
     "DEFAULT_LATENCY_BUCKETS", "get_registry", "reset_registry",
     "parse_prometheus_text", "annotate", "current_regions",
     "FlightRecorder", "dump_flight_record", "get_recorder", "flops",
-    "cross_host_sum", "commledger", "CommLedger", "goodput",
-    "GoodputLedger", "healthmon", "HealthMonitor", "memledger",
-    "MemLedger", "RooflineReport", "StateAccounting", "moestats",
-    "spans", "RequestTrace", "SpanRing", "MetricsServer",
-    "serve_metrics",
+    "cross_host_sum", "commledger", "CommLedger", "fleet",
+    "FleetCollector", "goodput", "GoodputLedger", "healthmon",
+    "HealthMonitor", "memledger", "MemLedger", "RooflineReport",
+    "StateAccounting", "moestats", "spans", "RequestTrace", "SpanRing",
+    "make_trace_id", "make_span_id", "format_traceparent",
+    "parse_traceparent", "timeseries", "MetricsSampler",
+    "MetricsServer", "serve_metrics",
 ]
 
 

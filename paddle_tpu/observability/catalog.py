@@ -18,7 +18,8 @@ from .metrics import (DEFAULT_LATENCY_BUCKETS, MetricsRegistry,
 
 __all__ = ["train_metrics", "serving_metrics", "comm_metrics",
            "mem_metrics", "ckpt_metrics", "goodput_metrics",
-           "health_metrics", "offload_metrics", "SCHEMA_PATH"]
+           "health_metrics", "offload_metrics", "timeseries_metrics",
+           "fleet_metrics", "SCHEMA_PATH"]
 
 SCHEMA_PATH = __file__.rsplit("/", 1)[0] + "/schema.json"
 
@@ -295,6 +296,62 @@ def health_metrics(reg: MetricsRegistry = None) -> Dict[str, object]:
     }
 
 
+def timeseries_metrics(reg: MetricsRegistry = None) -> Dict[str, object]:
+    """Register (get-or-create) the metrics-journal sampler's own
+    instrument set (observability/timeseries.py): the sampler's cost
+    is itself a metric — and therefore itself journaled — so the
+    bounded-overhead contract is observable from the journal alone."""
+    r = reg or get_registry()
+    return {
+        "ts_samples": r.counter(
+            "paddle_tpu_timeseries_samples_total",
+            "registry samples journaled to metrics.jsonl by this "
+            "process's background sampler"),
+        "ts_journal_bytes": r.gauge(
+            "paddle_tpu_timeseries_journal_bytes",
+            "current on-disk size of the metrics.jsonl journal "
+            "(bounded by retention_samples + compaction)",
+            unit="bytes"),
+        "ts_sample_seconds": r.gauge(
+            "paddle_tpu_timeseries_sample_seconds",
+            "cumulative wall seconds the sampler thread spent taking "
+            "and journaling samples (the per-sample overhead bound "
+            "bench gates = this / samples_total)", unit="s"),
+        "ts_compactions": r.counter(
+            "paddle_tpu_timeseries_compactions_total",
+            "journal compactions run (atomic rewrite keeping the "
+            "newest half of the retention budget behind a 'c' "
+            "marker)"),
+    }
+
+
+def fleet_metrics(reg: MetricsRegistry = None) -> Dict[str, object]:
+    """Register (get-or-create) the fleet collector's self-accounting
+    set (observability/fleet.py — the collector process's OWN
+    registry; member metrics pass through merged, not re-registered)."""
+    r = reg or get_registry()
+    return {
+        "members": r.gauge(
+            "paddle_tpu_fleet_members",
+            "fleet members by rollup verdict at the last /healthz "
+            "evaluation (degraded covers member-reported degradation, "
+            "unreachable scrape targets, and stale liveness ages)",
+            labelnames=("state",)),
+        "scrapes": r.counter(
+            "paddle_tpu_fleet_scrapes_total",
+            "member scrape attempts by result",
+            labelnames=("result",)),
+        "series": r.gauge(
+            "paddle_tpu_fleet_merged_series",
+            "per-host series feeding the merged fleet exposition at "
+            "the last merge"),
+        "collect_seconds": r.gauge(
+            "paddle_tpu_fleet_collect_seconds",
+            "wall seconds of the last scrape sweep over all "
+            "url-bearing members", unit="s"),
+    }
+
+
 def train_metrics(reg: MetricsRegistry = None) -> Dict[str, object]:
     """Register (get-or-create) the training instrument set."""
     r = reg or get_registry()
@@ -304,6 +361,7 @@ def train_metrics(reg: MetricsRegistry = None) -> Dict[str, object]:
     out.update({f"ckpt_{k}": v for k, v in ckpt_metrics(r).items()})
     out.update(goodput_metrics(r))
     out.update({f"health_{k}": v for k, v in health_metrics(r).items()})
+    out.update(timeseries_metrics(r))
     out.update({
         "step_seconds": r.histogram(
             "paddle_tpu_train_step_seconds",
@@ -395,6 +453,7 @@ def serving_metrics(reg: MetricsRegistry = None) -> Dict[str, object]:
     out = comm_metrics(r)
     out.update(mem_metrics(r))
     out.update({f"offload_{k}": v for k, v in offload_metrics(r).items()})
+    out.update(timeseries_metrics(r))
     out.update({
         "ttft": r.histogram(
             "paddle_tpu_serving_ttft_seconds",

@@ -7,7 +7,10 @@ serving the registry's Prometheus text exposition at ``/metrics``
 training or serving process without the JSONL sink. Stdlib only — no
 new dependencies — and entirely off the hot path: a scrape calls
 ``registry.prometheus_text()`` exactly like ``metrics_snapshot()``
-does.
+does. ``/metrics?names=<prefix>[,<prefix>...]`` narrows the
+exposition to metric names under the given prefixes (what a fleet
+collector scrapes when it only wants one subsystem's series); both
+endpoints declare ``charset=utf-8`` explicitly.
 
 ``/healthz`` answers 200 with a tiny JSON liveness payload::
 
@@ -44,6 +47,7 @@ import os
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
+from urllib.parse import parse_qs
 
 from .metrics import MetricsRegistry, get_registry
 
@@ -125,7 +129,7 @@ def serve_metrics(port: int = 0, registry: Optional[MetricsRegistry] = None,
 
     class _Handler(BaseHTTPRequestHandler):
         def do_GET(self):
-            path = self.path.split("?", 1)[0]
+            path, _, query = self.path.partition("?")
             if path == "/healthz":
                 age = reg.snapshot_age_seconds()
                 health = health_status()
@@ -138,9 +142,17 @@ def serve_metrics(port: int = 0, registry: Optional[MetricsRegistry] = None,
                 if health["components"]:
                     doc["components"] = health["components"]
                 body = json.dumps(doc).encode("utf-8")
-                ctype = "application/json"
+                ctype = "application/json; charset=utf-8"
             elif path in ("/", "/metrics"):
-                body = reg.prometheus_text().encode("utf-8")
+                # ?names=<prefix>[,<prefix>...] filters the exposition
+                # by metric-name prefix (a fleet collector scraping
+                # only paddle_tpu_serving_* pays for just that); the
+                # filtered read is still snapshot(touch=False) inside
+                # prometheus_text, so scrapes never mask a hung engine
+                prefixes = [p for n in parse_qs(query).get("names", [])
+                            for p in n.split(",") if p] or None
+                body = reg.prometheus_text(
+                    prefixes=prefixes).encode("utf-8")
                 ctype = CONTENT_TYPE
             else:
                 self.send_error(404, "only /metrics and /healthz are "

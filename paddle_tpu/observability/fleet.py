@@ -1,0 +1,539 @@
+"""Fleet metrics collector: one pane of glass over N host expositions.
+
+Every engine process exposes its own registry (``exporter.py``); a
+multi-host gpt13b run or a multi-replica serving fleet therefore has
+N scrape targets and no merged view. ``FleetCollector`` closes that
+gap with stdlib HTTP only: it *scrapes* member ``/metrics`` +
+``/healthz`` endpoints (pull) or *receives* pushed exposition text
+(``POST /push``), re-labels every member series with ``host=<name>``,
+and serves a merged fleet ``/metrics`` plus a fleet ``/healthz``
+rollup.
+
+Merge semantics (per metric family, per label combination):
+
+- **counters** — summed across members (``host="fleet"`` row),
+- **gauges**   — min / max / mean across members (``host="fleet"``
+  rows carrying a ``stat`` label),
+- **histograms** — merged bucket-exactly: the fixed bucket lattice is
+  shared by construction (metrics.py), so per-bucket counts, sum,
+  count, min and max add/combine without approximation, and merged
+  percentiles are IDENTICAL to a single registry fed the union of
+  observations (``merged_percentile`` mirrors
+  ``Histogram.percentile`` including its min/max clamp — the
+  ``_min``/``_max`` exposition rows exist for exactly this).
+
+The ``/healthz`` rollup reports ``degraded`` when any member is
+degraded, unreachable, or stale — a member whose reported
+``snapshot_age_seconds`` (or, push mode, time since its last push)
+exceeds ``stale_after_s`` has a hung or dead engine even if its port
+still answers.
+
+Collector self-accounting registers ``paddle_tpu_fleet_*`` metrics
+(catalog.fleet_metrics) in its own process registry. All state is
+guarded by one lock; scrapes (urlopen) always run OUTSIDE it, so a
+slow member can never pin the collector.
+"""
+from __future__ import annotations
+
+import json
+import math
+import threading
+import time
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, List, Optional, Tuple
+from urllib.parse import parse_qs, urlparse
+
+from .metrics import MetricsRegistry, get_registry
+from .exporter import CONTENT_TYPE
+
+__all__ = ["FleetCollector", "FleetServer", "parse_exposition",
+           "merged_percentile", "DEFAULT_STALE_AFTER_S"]
+
+DEFAULT_STALE_AFTER_S = 30.0
+
+# exposition row suffixes that belong to a histogram family
+_HIST_PARTS = ("bucket", "sum", "count", "min", "max")
+
+
+# ---------------------------------------------------------------------------
+# exposition parsing (type-aware: histograms reassembled whole)
+# ---------------------------------------------------------------------------
+def parse_exposition(text: str) -> Dict[str, Dict[str, Any]]:
+    """Parse Prometheus text exposition into typed families::
+
+        {name: {"type": "counter"|"gauge",
+                "series": {labelkey: float}}}
+        {name: {"type": "histogram",
+                "series": {labelkey: {"count", "sum", "min", "max",
+                                      "buckets": {le_str: count}}}}}
+
+    ``labelkey`` is the sorted ``((k, v), ...)`` tuple
+    ``parse_prometheus_text`` uses. Histogram bucket counts come back
+    NON-cumulative (de-accumulated in ``le`` order) so families merge
+    by plain addition. ``_min``/``_max`` rows (this framework's
+    exposition extension) restore the clamp state exact percentile
+    merging needs; expositions without them fall back to bucket
+    edges."""
+    types: Dict[str, str] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if line.startswith("# TYPE "):
+            parts = line.split()
+            if len(parts) >= 4:
+                types[parts[2]] = parts[3]
+    from .metrics import parse_prometheus_text
+
+    rows = parse_prometheus_text(text)
+    out: Dict[str, Dict[str, Any]] = {}
+    hists = {n for n, t in types.items() if t == "histogram"}
+
+    def _hist_of(row_name: str) -> Optional[Tuple[str, str]]:
+        for part in _HIST_PARTS:
+            suffix = "_" + part
+            if row_name.endswith(suffix) and \
+                    row_name[:-len(suffix)] in hists:
+                return row_name[:-len(suffix)], part
+        return None
+
+    cum: Dict[str, Dict[Tuple, List[Tuple[float, str, float]]]] = {}
+    for row_name, series in rows.items():
+        hp = _hist_of(row_name)
+        if hp is None:
+            out.setdefault(row_name, {
+                "type": types.get(row_name, "gauge"), "series": {}})
+            out[row_name]["series"].update(series)
+            continue
+        base, part = hp
+        fam = out.setdefault(base, {"type": "histogram", "series": {}})
+        for key, val in series.items():
+            if part == "bucket":
+                le = dict(key).get("le", "+Inf")
+                bare = tuple(kv for kv in key if kv[0] != "le")
+                ub = math.inf if le == "+Inf" else float(le)
+                cum.setdefault(base, {}).setdefault(bare, []).append(
+                    (ub, le, val))
+            else:
+                s = fam["series"].setdefault(
+                    key, {"count": 0, "sum": 0.0, "min": 0.0,
+                          "max": 0.0, "buckets": {}})
+                s[part] = val
+    for base, by_key in cum.items():
+        for bare, entries in by_key.items():
+            s = out[base]["series"].setdefault(
+                bare, {"count": 0, "sum": 0.0, "min": 0.0,
+                       "max": 0.0, "buckets": {}})
+            prev = 0.0
+            for ub, le, val in sorted(entries, key=lambda e: e[0]):
+                s["buckets"][le] = val - prev
+                prev = val
+    return out
+
+
+def merged_percentile(series: Dict[str, Any], q: float) -> float:
+    """The q-th percentile of a merged histogram series — the same
+    interpolation ``Histogram.percentile`` runs on a live series
+    (rank over per-bucket counts, linear within the winning bucket,
+    clamped to observed min/max, observed max as the +Inf bucket's
+    upper edge), so a fleet merge reproduces the union registry's
+    percentiles exactly."""
+    count = int(series.get("count", 0))
+    if not count:
+        return 0.0
+    items = sorted(series["buckets"].items(),
+                   key=lambda kv: math.inf if kv[0] == "+Inf"
+                   else float(kv[0]))
+    edges = [math.inf if le == "+Inf" else float(le)
+             for le, _c in items]
+    counts = [c for _le, c in items]
+    smin = float(series.get("min", 0.0))
+    smax = float(series.get("max", 0.0))
+    rank = q / 100.0 * count
+    cum = 0.0
+    for i, c in enumerate(counts):
+        if not c:
+            continue
+        if cum + c >= rank:
+            lo = 0.0 if i == 0 else edges[i - 1]
+            hi = edges[i] if edges[i] != math.inf else smax
+            frac = (rank - cum) / c
+            v = lo + (hi - lo) * min(max(frac, 0.0), 1.0)
+            return min(max(v, smin), smax)
+        cum += c
+    return smax
+
+
+# ---------------------------------------------------------------------------
+# the collector
+# ---------------------------------------------------------------------------
+class FleetCollector:
+    """Scrape-or-push collector over N member expositions.
+
+    >>> col = FleetCollector()
+    >>> col.add_member("host0", "http://127.0.0.1:9100")   # pull
+    >>> col.ingest("host1", exposition_text, healthz=doc)  # push
+    >>> col.scrape()
+    >>> print(col.fleet_prometheus_text())
+    >>> col.fleet_healthz()["status"]
+    'ok'
+    """
+
+    def __init__(self, stale_after_s: float = DEFAULT_STALE_AFTER_S,
+                 scrape_timeout_s: float = 2.0,
+                 registry: Optional[MetricsRegistry] = None):
+        from .catalog import fleet_metrics
+
+        self.stale_after_s = float(stale_after_s)
+        self.scrape_timeout_s = float(scrape_timeout_s)
+        self._metrics = fleet_metrics(registry or get_registry())
+        self._lock = threading.Lock()
+        # name -> base url (None = push-only member)
+        self._members: Dict[str, Optional[str]] = {}
+        # name -> {"text", "healthz", "ts", "error"}
+        self._state: Dict[str, Dict[str, Any]] = {}
+
+    # -- membership ------------------------------------------------------
+    def add_member(self, name: str, url: Optional[str] = None) -> None:
+        """Register a member: ``url`` = scrape target base (its
+        ``/metrics`` and ``/healthz`` are fetched by ``scrape()``);
+        None = push-only (``ingest`` / ``POST /push`` feeds it)."""
+        with self._lock:
+            self._members[str(name)] = \
+                url.rstrip("/") if url is not None else None
+
+    def remove_member(self, name: str) -> None:
+        with self._lock:
+            self._members.pop(name, None)
+            self._state.pop(name, None)
+
+    def members(self) -> List[str]:
+        with self._lock:
+            return sorted(self._members)
+
+    # -- ingestion -------------------------------------------------------
+    def ingest(self, name: str, text: str,
+               healthz: Optional[Dict[str, Any]] = None) -> None:
+        """Push-mode ingestion of one member's exposition text (and
+        optionally its /healthz doc). Unknown members are auto-added
+        as push-only."""
+        now = time.time()
+        with self._lock:
+            self._members.setdefault(str(name), None)
+            self._state[str(name)] = {"text": str(text),
+                                      "healthz": healthz,
+                                      "ts": now, "error": None}
+
+    def _fetch(self, url: str) -> str:
+        with urllib.request.urlopen(
+                url, timeout=self.scrape_timeout_s) as resp:
+            return resp.read().decode("utf-8")
+
+    def scrape(self) -> Dict[str, Optional[str]]:
+        """One scrape sweep over every url-bearing member (push-only
+        members keep their last ingested text). Network I/O runs with
+        NO lock held; results land atomically per member. Returns
+        {member: error-or-None}."""
+        t0 = time.perf_counter()
+        with self._lock:
+            targets = [(n, u) for n, u in self._members.items()
+                       if u is not None]
+        results: Dict[str, Optional[str]] = {}
+        m = self._metrics
+        for name, url in targets:
+            err: Optional[str] = None
+            text, hz = "", None
+            try:
+                text = self._fetch(url + "/metrics")
+                try:
+                    hz = json.loads(self._fetch(url + "/healthz"))
+                except (OSError, ValueError):
+                    hz = None       # metrics up, healthz missing: ok
+            except OSError as e:
+                err = str(e)
+            now = time.time()
+            with self._lock:
+                if err is None:
+                    self._state[name] = {"text": text, "healthz": hz,
+                                         "ts": now, "error": None}
+                else:
+                    st = self._state.setdefault(
+                        name, {"text": "", "healthz": None,
+                               "ts": None, "error": None})
+                    st["error"] = err
+            m["scrapes"].inc(result="error" if err else "ok")
+            results[name] = err
+        m["collect_seconds"].set(time.perf_counter() - t0)
+        return results
+
+    # -- merged views ----------------------------------------------------
+    def _snapshot_state(self) -> Dict[str, Dict[str, Any]]:
+        with self._lock:
+            return {n: dict(st) for n, st in self._state.items()}
+
+    def merged(self) -> Dict[str, Dict[str, Any]]:
+        """The structured fleet merge::
+
+            {name: {"type": t,
+                    "hosts": {host: {labelkey: value-or-hist}},
+                    "fleet": {labelkey: merged-value}}}
+
+        Counters: ``fleet`` holds the sum. Gauges: ``fleet`` holds
+        ``{"min", "max", "mean"}``. Histograms: ``fleet`` holds the
+        bucket-exact merged state (``merged_percentile`` applies)."""
+        state = self._snapshot_state()
+        out: Dict[str, Dict[str, Any]] = {}
+        n_series = 0
+        for host in sorted(state):
+            st = state[host]
+            if not st.get("text"):
+                continue
+            for name, fam in parse_exposition(st["text"]).items():
+                dst = out.setdefault(
+                    name, {"type": fam["type"], "hosts": {},
+                           "fleet": {}})
+                dst["hosts"][host] = fam["series"]
+                n_series += len(fam["series"])
+        for name, dst in out.items():
+            agg: Dict[Tuple, Any] = dst["fleet"]
+            for host, series in dst["hosts"].items():
+                for key, val in series.items():
+                    if dst["type"] == "histogram":
+                        cur = agg.setdefault(
+                            key, {"count": 0, "sum": 0.0,
+                                  "min": math.inf, "max": -math.inf,
+                                  "buckets": {}})
+                        cur["count"] += int(val["count"])
+                        cur["sum"] += float(val["sum"])
+                        if val["count"]:
+                            cur["min"] = min(cur["min"], val["min"])
+                            cur["max"] = max(cur["max"], val["max"])
+                        for le, c in val["buckets"].items():
+                            cur["buckets"][le] = \
+                                cur["buckets"].get(le, 0.0) + c
+                    elif dst["type"] == "counter":
+                        agg[key] = agg.get(key, 0.0) + float(val)
+                    else:
+                        cur = agg.setdefault(
+                            key, {"min": math.inf, "max": -math.inf,
+                                  "_sum": 0.0, "_n": 0})
+                        cur["min"] = min(cur["min"], float(val))
+                        cur["max"] = max(cur["max"], float(val))
+                        cur["_sum"] += float(val)
+                        cur["_n"] += 1
+            if dst["type"] == "histogram":
+                for cur in agg.values():
+                    if not cur["count"]:
+                        cur["min"] = cur["max"] = 0.0
+            elif dst["type"] == "gauge":
+                for key, cur in agg.items():
+                    agg[key] = {"min": cur["min"], "max": cur["max"],
+                                "mean": cur["_sum"] / cur["_n"]}
+        self._metrics["series"].set(n_series)
+        return out
+
+    def fleet_prometheus_text(self) -> str:
+        """Merged exposition: every member series re-labeled with
+        ``host=<member>``, plus aggregate rows labeled
+        ``host="fleet"`` (counters: the sum; gauges: one row per
+        ``stat`` in min/max/mean; histograms: the bucket-exact merged
+        family with cumulative ``_bucket`` rows and ``_min``/``_max``
+        extension rows)."""
+        from .metrics import _fmt_labels
+
+        merged = self.merged()
+        lines: List[str] = []
+        for name in sorted(merged):
+            fam = merged[name]
+            lines.append(f"# TYPE {name} {fam['type']}")
+            rows: List[Tuple[Dict[str, str], Any]] = []
+            for host in sorted(fam["hosts"]):
+                for key, val in sorted(fam["hosts"][host].items()):
+                    rows.append(({**dict(key), "host": host}, val))
+            if fam["type"] == "histogram":
+                for key, val in sorted(fam["fleet"].items()):
+                    rows.append(({**dict(key), "host": "fleet"}, val))
+                for labels, s in rows:
+                    items = sorted(
+                        s["buckets"].items(),
+                        key=lambda kv: math.inf if kv[0] == "+Inf"
+                        else float(kv[0]))
+                    cum = 0.0
+                    for le, c in items:
+                        cum += c
+                        lbl = _fmt_labels({**labels, "le": le})
+                        lines.append(f"{name}_bucket{lbl} {cum:.9g}")
+                    lbl = _fmt_labels(labels)
+                    lines.append(f"{name}_sum{lbl} {s['sum']:.9g}")
+                    lines.append(
+                        f"{name}_count{lbl} {s['count']:.9g}")
+                    if s["count"]:
+                        # repr keeps the extrema round-trip exact
+                        # through chained collectors
+                        lines.append(
+                            f"{name}_min{lbl} {float(s['min'])!r}")
+                        lines.append(
+                            f"{name}_max{lbl} {float(s['max'])!r}")
+            elif fam["type"] == "counter":
+                for key, val in sorted(fam["fleet"].items()):
+                    rows.append(({**dict(key), "host": "fleet"}, val))
+                for labels, val in rows:
+                    lines.append(
+                        f"{name}{_fmt_labels(labels)} {val:.9g}")
+            else:
+                for labels, val in rows:
+                    lines.append(
+                        f"{name}{_fmt_labels(labels)} {val:.9g}")
+                for key, stats in sorted(fam["fleet"].items()):
+                    for stat in ("min", "max", "mean"):
+                        lbl = _fmt_labels({**dict(key),
+                                           "host": "fleet",
+                                           "stat": stat})
+                        lines.append(f"{name}{lbl} {stats[stat]:.9g}")
+        return "\n".join(lines) + "\n"
+
+    # -- health rollup ---------------------------------------------------
+    def member_health(self, name: str) -> Dict[str, Any]:
+        """One member's verdict: ``ok``, or ``degraded`` with a
+        reason (member-reported degradation / unreachable / stale
+        liveness age)."""
+        now = time.time()
+        with self._lock:
+            st = self._state.get(name)
+            known = name in self._members
+        if not known and st is None:
+            return {"status": "degraded", "reason": "unknown member"}
+        if st is None or (st.get("error") and st.get("ts") is None):
+            return {"status": "degraded", "reason": "unreachable",
+                    "error": None if st is None else st["error"]}
+        doc = st.get("healthz") or {}
+        out: Dict[str, Any] = {"status": "ok"}
+        age = doc.get("snapshot_age_seconds")
+        if age is None and st.get("ts") is not None:
+            # push mode (or healthz-less member): staleness = time
+            # since the collector last heard from it
+            age = now - st["ts"]
+        if age is not None:
+            out["snapshot_age_seconds"] = round(float(age), 3)
+        if st.get("error"):
+            out.update(status="degraded", reason="unreachable",
+                       error=st["error"])
+        elif doc.get("status", "ok") != "ok":
+            out.update(status="degraded", reason="member degraded")
+            if doc.get("components"):
+                out["components"] = doc["components"]
+        elif age is not None and age > self.stale_after_s:
+            out.update(status="degraded", reason="stale")
+        return out
+
+    def fleet_healthz(self) -> Dict[str, Any]:
+        """The fleet rollup: degraded when ANY member is degraded,
+        unreachable, or stale (one sick host names the fleet sick —
+        a router must know before it routes)."""
+        with self._lock:
+            names = sorted(set(self._members) | set(self._state))
+        members = {n: self.member_health(n) for n in names}
+        n_bad = sum(1 for v in members.values()
+                    if v["status"] != "ok")
+        m = self._metrics
+        m["members"].set(len(members) - n_bad, state="ok")
+        m["members"].set(n_bad, state="degraded")
+        return {"status": "degraded" if n_bad else "ok",
+                "members": members}
+
+    # -- HTTP front door -------------------------------------------------
+    def serve(self, port: int = 0, host: str = "127.0.0.1",
+              scrape_on_get: bool = True) -> "FleetServer":
+        """Serve the merged fleet view: ``GET /metrics`` (merged
+        exposition; triggers a scrape sweep first unless
+        ``scrape_on_get=False``), ``GET /healthz`` (the rollup),
+        ``POST /push?host=<name>`` (push-mode exposition body;
+        JSON ``{"host", "metrics", "healthz"}`` also accepted)."""
+        return FleetServer(self, port=port, host=host,
+                           scrape_on_get=scrape_on_get)
+
+
+class FleetServer:
+    """Handle on a running fleet collector endpoint (``port`` is the
+    bound port; ``close()`` shuts the listener down)."""
+
+    def __init__(self, collector: FleetCollector, port: int = 0,
+                 host: str = "127.0.0.1", scrape_on_get: bool = True):
+        col = collector
+
+        class _Handler(BaseHTTPRequestHandler):
+            def _reply(self, body: bytes, ctype: str,
+                       code: int = 200):
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                path = self.path.split("?", 1)[0]
+                if path == "/healthz":
+                    if scrape_on_get:
+                        col.scrape()
+                    body = json.dumps(col.fleet_healthz()) \
+                        .encode("utf-8")
+                    self._reply(body,
+                                "application/json; charset=utf-8")
+                elif path in ("/", "/metrics"):
+                    if scrape_on_get:
+                        col.scrape()
+                    self._reply(
+                        col.fleet_prometheus_text().encode("utf-8"),
+                        CONTENT_TYPE)
+                else:
+                    self.send_error(
+                        404, "only /metrics, /healthz and POST "
+                             "/push are served")
+
+            def do_POST(self):
+                parsed = urlparse(self.path)
+                if parsed.path != "/push":
+                    self.send_error(404, "POST /push only")
+                    return
+                n = int(self.headers.get("Content-Length", 0))
+                raw = self.rfile.read(n).decode("utf-8")
+                ctype = self.headers.get("Content-Type", "")
+                if ctype.startswith("application/json"):
+                    try:
+                        doc = json.loads(raw)
+                        col.ingest(doc["host"],
+                                   doc.get("metrics", ""),
+                                   healthz=doc.get("healthz"))
+                    except (ValueError, KeyError, TypeError):
+                        self.send_error(400, "bad push JSON")
+                        return
+                else:
+                    hosts = parse_qs(parsed.query).get("host")
+                    if not hosts:
+                        self.send_error(400, "?host=<name> required")
+                        return
+                    col.ingest(hosts[0], raw)
+                self._reply(b'{"ok": true}',
+                            "application/json; charset=utf-8")
+
+            def log_message(self, fmt, *args):
+                pass            # scrapes must not spam the log
+
+        self._httpd = ThreadingHTTPServer((host, port), _Handler)
+        self._httpd.daemon_threads = True
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="fleet-collector",
+            daemon=True)
+        self._thread.start()
+        self.port = int(self._httpd.server_address[1])
+
+    def close(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=5)
+
+    def __enter__(self) -> "FleetServer":
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False

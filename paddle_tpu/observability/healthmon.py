@@ -61,29 +61,35 @@ _MAD_SIGMA = 1.4826
 
 class RollingRobust:
     """Bounded window with median + MAD (both O(W log W) on demand —
-    W is small; one evaluation per step is noise)."""
+    W is small; one evaluation per step is noise). Window reads copy
+    under a lock: the train loop pushes while sampler/monitor threads
+    may evaluate."""
 
     def __init__(self, window: int = 32):
+        self._lock = threading.Lock()
         self._buf: deque = deque(maxlen=int(window))
 
     def __len__(self):
-        return len(self._buf)
+        with self._lock:
+            return len(self._buf)
 
     def push(self, v: float) -> None:
-        self._buf.append(float(v))
+        with self._lock:
+            self._buf.append(float(v))
 
     def median_mad(self):
         """(median, MAD) of the current window; (0, 0) when empty."""
-        if not self._buf:
+        with self._lock:
+            xs = sorted(self._buf)
+        if not xs:
             return 0.0, 0.0
-        xs = sorted(self._buf)
         med = _median(xs)
         mad = _median(sorted(abs(x - med) for x in xs))
         return med, mad
 
     def zscore(self, v: float) -> float:
         """Robust z of ``v`` against the window (0 when unarmed)."""
-        if not self._buf:
+        if not len(self):
             return 0.0
         med, mad = self.median_mad()
         sigma = _MAD_SIGMA * mad

@@ -300,14 +300,16 @@ class MetricsRegistry:
         if self._flight is not None:
             self._flight.push(out)
         if touch:
-            self._last_snapshot_ts = out["ts"]
+            with self._lock:
+                self._last_snapshot_ts = out["ts"]
         return out
 
     def snapshot_age_seconds(self) -> Optional[float]:
         """Seconds since the last snapshot() on this registry, or None
         before the first one — the /healthz liveness signal (an engine
         ticking keeps this fresh; a hung step lets it grow)."""
-        ts = self._last_snapshot_ts
+        with self._lock:
+            ts = self._last_snapshot_ts
         return None if ts is None else max(time.time() - ts, 0.0)
 
     def schema(self) -> Dict[str, Any]:
@@ -317,11 +319,24 @@ class MetricsRegistry:
             return {name: m.spec()
                     for name, m in sorted(self._metrics.items())}
 
-    def prometheus_text(self) -> str:
-        """Prometheus text exposition of the current state."""
+    def prometheus_text(self, prefixes: Optional[Sequence[str]] = None
+                        ) -> str:
+        """Prometheus text exposition of the current state.
+
+        ``prefixes`` filters the exposition to metric names starting
+        with any of the given prefixes (the exporter's ``?names=``
+        query) — still a ``snapshot(touch=False)`` read, so a
+        filtered scrape never masks a hung engine. Histogram series
+        additionally expose ``<name>_min``/``<name>_max`` rows (an
+        extension beyond standard exposition): together with the
+        fixed bucket lattice they make cross-host merges percentile-
+        exact (observability/fleet.py)."""
         snap = self.snapshot(touch=False)
         lines: List[str] = []
         for name, entry in sorted(snap["metrics"].items()):
+            if prefixes is not None and \
+                    not any(name.startswith(p) for p in prefixes):
+                continue
             if entry["help"]:
                 lines.append(f"# HELP {name} {entry['help']}")
             lines.append(f"# TYPE {name} {entry['type']}")
@@ -335,6 +350,13 @@ class MetricsRegistry:
                         lines.append(f"{name}_bucket{le} {cum}")
                     lines.append(f"{name}_sum{lbl} {row['sum']:.9g}")
                     lines.append(f"{name}_count{lbl} {row['count']}")
+                    if row["count"]:
+                        # repr: shortest round-trip form — the merge
+                        # clamp must see the EXACT observed extrema
+                        lines.append(
+                            f"{name}_min{lbl} {row['min']!r}")
+                        lines.append(
+                            f"{name}_max{lbl} {row['max']!r}")
                 else:
                     lines.append(f"{name}{lbl} {row['value']:.9g}")
         return "\n".join(lines) + "\n"

@@ -23,35 +23,98 @@ Exports:
   (observed by the engine as each span closes) — the bench telemetry
   section carries them per line.
 
+Every trace carries W3C-traceparent-style identity so a request can be
+stitched across process boundaries (the multi-replica router / the
+disaggregated prefill-decode split the ROADMAP plans): a 32-hex
+``trace_id`` shared by every span of the request, a 16-hex root
+``span_id`` per trace, and an optional ``parent_span_id`` naming the
+caller's span in another process. ``format_traceparent`` /
+``parse_traceparent`` round-trip the ``00-<trace>-<span>-01`` header
+form; ``ServingEngine.submit`` accepts either piece and generates
+what is missing.
+
 Host-side python on perf_counter floats only; nothing here touches
 traced code.
 """
 from __future__ import annotations
 
 import json
+import os
+import re
 import threading
 from collections import deque
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
-__all__ = ["Span", "RequestTrace", "SpanRing"]
+__all__ = ["Span", "RequestTrace", "SpanRing", "make_trace_id",
+           "make_span_id", "format_traceparent", "parse_traceparent"]
 
 # the per-request lifecycle stages, in order (the stage histogram's
 # label values; "decode_round" additionally marks shared-round spans)
 STAGES = ("queued", "prefill", "decode", "e2e")
 
+# W3C trace-context identity: trace_id is 32 lowercase hex chars,
+# span_id 16; the traceparent header is version 00 with the sampled
+# flag set (we always record).
+_TRACE_ID_RE = re.compile(r"^[0-9a-f]{32}$")
+_SPAN_ID_RE = re.compile(r"^[0-9a-f]{16}$")
+_TRACEPARENT_RE = re.compile(
+    r"^([0-9a-f]{2})-([0-9a-f]{32})-([0-9a-f]{16})-([0-9a-f]{2})$")
+
+
+def make_trace_id() -> str:
+    """A fresh 32-hex W3C trace id (crypto-random, never all-zero)."""
+    tid = os.urandom(16).hex()
+    return tid if int(tid, 16) else make_trace_id()
+
+
+def make_span_id() -> str:
+    """A fresh 16-hex W3C span id."""
+    sid = os.urandom(8).hex()
+    return sid if int(sid, 16) else make_span_id()
+
+
+def format_traceparent(trace_id: str, span_id: str) -> str:
+    """``00-<trace_id>-<span_id>-01`` (version 00, sampled)."""
+    if not _TRACE_ID_RE.match(trace_id):
+        raise ValueError(f"invalid trace_id {trace_id!r} (want 32 hex)")
+    if not _SPAN_ID_RE.match(span_id):
+        raise ValueError(f"invalid span_id {span_id!r} (want 16 hex)")
+    return f"00-{trace_id}-{span_id}-01"
+
+
+def parse_traceparent(header: str) -> Tuple[str, str]:
+    """``(trace_id, span_id)`` out of a traceparent header; raises
+    ValueError on a malformed header or an all-zero id (the spec's
+    invalid sentinel)."""
+    m = _TRACEPARENT_RE.match(str(header).strip().lower())
+    if not m:
+        raise ValueError(f"malformed traceparent {header!r}")
+    _ver, trace_id, span_id, _flags = m.groups()
+    if not int(trace_id, 16) or not int(span_id, 16):
+        raise ValueError(f"all-zero id in traceparent {header!r}")
+    return trace_id, span_id
+
 
 class Span:
-    """One named interval; ``end`` stays None while open."""
+    """One named interval; ``end`` stays None while open. Every span
+    carries its own 16-hex ``span_id`` and its parent's (the trace
+    root for engine-created stage spans) so exported traces stitch
+    across processes."""
 
-    __slots__ = ("name", "t0", "t1", "meta")
+    __slots__ = ("name", "t0", "t1", "meta", "span_id",
+                 "parent_span_id")
 
     def __init__(self, name: str, t0: float,
                  t1: Optional[float] = None,
-                 meta: Optional[Dict[str, Any]] = None):
+                 meta: Optional[Dict[str, Any]] = None,
+                 span_id: Optional[str] = None,
+                 parent_span_id: Optional[str] = None):
         self.name = name
         self.t0 = float(t0)
         self.t1 = None if t1 is None else float(t1)
         self.meta = meta or {}
+        self.span_id = span_id or make_span_id()
+        self.parent_span_id = parent_span_id
 
     @property
     def seconds(self) -> float:
@@ -59,25 +122,54 @@ class Span:
 
     def to_dict(self) -> Dict[str, Any]:
         d = {"name": self.name, "t0": self.t0, "t1": self.t1,
-             "seconds": self.seconds}
+             "seconds": self.seconds, "span_id": self.span_id}
+        if self.parent_span_id is not None:
+            d["parent_span_id"] = self.parent_span_id
         if self.meta:
             d["meta"] = dict(self.meta)
         return d
 
 
 class RequestTrace:
-    """The span set of one serving request (rid keys the trace)."""
+    """The span set of one serving request (rid keys the trace).
 
-    __slots__ = ("rid", "spans", "meta")
+    ``trace_id`` (32 hex, auto-generated when the caller brings none)
+    names the request across processes; ``span_id`` is the trace's
+    root span (every stage span created here is its child) and
+    ``parent_span_id`` the submitting caller's span in ANOTHER
+    process, straight off an incoming traceparent header.
+    """
 
-    def __init__(self, rid: int, meta: Optional[Dict[str, Any]] = None):
+    __slots__ = ("rid", "spans", "meta", "trace_id", "span_id",
+                 "parent_span_id")
+
+    def __init__(self, rid: int, meta: Optional[Dict[str, Any]] = None,
+                 trace_id: Optional[str] = None,
+                 parent_span_id: Optional[str] = None):
         self.rid = rid
         self.spans: List[Span] = []
         self.meta = meta or {}
+        if trace_id is not None and not _TRACE_ID_RE.match(trace_id):
+            raise ValueError(
+                f"invalid trace_id {trace_id!r} (want 32 hex)")
+        if parent_span_id is not None and \
+                not _SPAN_ID_RE.match(parent_span_id):
+            raise ValueError(
+                f"invalid parent_span_id {parent_span_id!r} "
+                f"(want 16 hex)")
+        self.trace_id = trace_id or make_trace_id()
+        self.span_id = make_span_id()
+        self.parent_span_id = parent_span_id
+
+    @property
+    def traceparent(self) -> str:
+        """The header to propagate DOWNSTREAM of this request (names
+        this trace's root span as the parent)."""
+        return format_traceparent(self.trace_id, self.span_id)
 
     def begin(self, name: str, t0: float,
               meta: Optional[Dict[str, Any]] = None) -> Span:
-        sp = Span(name, t0, meta=meta)
+        sp = Span(name, t0, meta=meta, parent_span_id=self.span_id)
         self.spans.append(sp)
         return sp
 
@@ -93,7 +185,7 @@ class RequestTrace:
 
     def add(self, name: str, t0: float, t1: float,
             meta: Optional[Dict[str, Any]] = None) -> Span:
-        sp = Span(name, t0, t1, meta)
+        sp = Span(name, t0, t1, meta, parent_span_id=self.span_id)
         self.spans.append(sp)
         return sp
 
@@ -104,8 +196,13 @@ class RequestTrace:
         return None
 
     def to_dict(self) -> Dict[str, Any]:
-        return {"rid": self.rid, "meta": dict(self.meta),
-                "spans": [s.to_dict() for s in self.spans]}
+        d = {"rid": self.rid, "meta": dict(self.meta),
+             "trace_id": self.trace_id, "span_id": self.span_id,
+             "traceparent": self.traceparent,
+             "spans": [s.to_dict() for s in self.spans]}
+        if self.parent_span_id is not None:
+            d["parent_span_id"] = self.parent_span_id
+        return d
 
 
 class SpanRing:
@@ -145,6 +242,9 @@ class SpanRing:
             events.append({"ph": "M", "name": "thread_name", "pid": 0,
                            "tid": tr.rid,
                            "args": {"name": f"req{tr.rid}"}})
+            ident = {"trace_id": tr.trace_id, "span_id": tr.span_id}
+            if tr.parent_span_id is not None:
+                ident["parent_span_id"] = tr.parent_span_id
             for sp in tr.spans:
                 if sp.t1 is None:
                     continue
@@ -157,7 +257,7 @@ class SpanRing:
                         "ph": "i", "cat": "serving", "name": sp.name,
                         "pid": 0, "tid": tr.rid, "s": "t",
                         "ts": (sp.t0 - t_base) * 1e6,
-                        "args": {**tr.meta, **sp.meta},
+                        "args": {**tr.meta, **sp.meta, **ident},
                     })
                     continue
                 events.append({
@@ -165,7 +265,7 @@ class SpanRing:
                     "pid": 0, "tid": tr.rid,
                     "ts": (sp.t0 - t_base) * 1e6,
                     "dur": sp.seconds * 1e6,
-                    "args": {**tr.meta, **sp.meta},
+                    "args": {**tr.meta, **sp.meta, **ident},
                 })
         doc = {"traceEvents": events, "displayTimeUnit": "ms"}
         if path is not None:

@@ -398,6 +398,7 @@ class TestSchemaGate:
         r = MetricsRegistry()
         catalog.train_metrics(r)
         catalog.serving_metrics(r)
+        catalog.fleet_metrics(r)
         with open(catalog.SCHEMA_PATH) as f:
             checked_in = json.load(f)
         assert r.schema() == checked_in

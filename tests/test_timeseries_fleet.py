@@ -1,0 +1,883 @@
+"""Fleet telemetry plane (observability/timeseries.py + fleet.py).
+
+Under test:
+- the durable metrics journal: sample round-trip, SIGKILL-truncated
+  tail recovery (every COMPLETED sample survives), resumed-run
+  headers, background sampler thread with bounded overhead,
+  retention/compaction, range queries + aligned resampling
+- the fleet collector: exposition parsing, counter-sum / gauge-stats /
+  bucket-exact histogram merges — merged percentiles EXACTLY equal to
+  a single registry fed the union of observations (property-style
+  over random shards), the /healthz rollup (degraded / unreachable /
+  stale members), and the stdlib HTTP front door (scrape + push)
+- trace identity: W3C traceparent helpers, ServingEngine.submit
+  accepting/creating trace ids, spans + chrome export + trace_context
+  carrying them end to end
+- exporter satellites: ?names= prefix filtering, charset, and the
+  filtered scrape never refreshing the liveness age
+- engine wiring: PADDLE_TPU_TIMESERIES_DIR attaches a sampler with
+  bit-identical losses and zero extra compiles
+- reports: tools/fleet_report.py and tools/run_report.py --merge over
+  per-host journals
+- tpulint: the new tool lints clean with ZERO baseline entries
+"""
+import json
+import math
+import os
+import sys
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import observability as obs
+from paddle_tpu.observability import fleet as fl
+from paddle_tpu.observability import goodput as _gp
+from paddle_tpu.observability import spans as sp
+from paddle_tpu.observability import timeseries as ts
+from paddle_tpu.observability.metrics import MetricsRegistry
+
+
+@pytest.fixture()
+def reg():
+    return MetricsRegistry()
+
+
+def _journal(tmp_path, name="metrics.jsonl"):
+    return str(tmp_path / name)
+
+
+# ---------------------------------------------------------------------------
+# the durable journal
+# ---------------------------------------------------------------------------
+class TestJournal:
+    def test_sample_round_trip(self, reg, tmp_path):
+        c = reg.counter("steps_total", "steps")
+        g = reg.gauge("depth", "queue depth")
+        h = reg.histogram("lat", buckets=(0.5, 2.0))
+        c.inc(3)
+        g.set(7)
+        h.observe(0.25)
+        h.observe(5.0)
+        with ts.MetricsSampler(_journal(tmp_path), registry=reg,
+                               interval_s=60) as smp:
+            smp.sample_now()
+            c.inc()
+            smp.sample_now()
+        recs = ts.read_journal(_journal(tmp_path))
+        assert recs[0]["ev"] == "run" and not recs[0]["resumed"]
+        samp = ts.samples(recs)
+        assert [r["seq"] for r in samp] == [0, 1]
+        assert samp[0]["m"]["steps_total"]["s"] == [[{}, 3.0]]
+        assert samp[1]["m"]["steps_total"]["s"] == [[{}, 4.0]]
+        assert samp[0]["m"]["depth"]["s"] == [[{}, 7.0]]
+        hist = samp[0]["m"]["lat"]["s"][0][1]
+        assert hist["count"] == 2 and hist["sum"] == 5.25
+        assert hist["min"] == 0.25 and hist["max"] == 5.0
+        assert hist["buckets"] == {"0.5": 1, "2.0": 0, "+Inf": 1}
+
+    def test_truncated_tail_recovers_completed_samples(self, reg,
+                                                       tmp_path):
+        """The SIGKILL acceptance: a torn final line is skipped, every
+        completed sample before it is recovered."""
+        path = _journal(tmp_path)
+        g = reg.gauge("v")
+        with ts.MetricsSampler(path, registry=reg,
+                               interval_s=60) as smp:
+            for i in range(5):
+                g.set(i)
+                smp.sample_now()
+        with open(path, "a") as f:       # a kill mid-write
+            f.write('{"ev": "s", "ts": 1.0, "seq": 99, "m": {"v"')
+        recs = ts.read_journal(path)
+        samp = ts.samples(recs)
+        assert [r["seq"] for r in samp] == [0, 1, 2, 3, 4]
+        assert [r["m"]["v"]["s"][0][1] for r in samp] == \
+            [0.0, 1.0, 2.0, 3.0, 4.0]
+
+    def test_resumed_run_header_continues_seq(self, reg, tmp_path):
+        path = _journal(tmp_path)
+        reg.gauge("v").set(1)
+        with ts.MetricsSampler(path, registry=reg,
+                               interval_s=60) as smp:
+            smp.sample_now()
+            smp.sample_now()
+        # a "new process" re-opens the same journal
+        with ts.MetricsSampler(path, registry=reg,
+                               interval_s=60) as smp2:
+            smp2.sample_now()
+        recs = ts.read_journal(path)
+        runs = [r for r in recs if r["ev"] == "run"]
+        assert [r["resumed"] for r in runs] == [False, True]
+        assert [r["seq"] for r in ts.samples(recs)] == [0, 1, 2]
+
+    def test_background_thread_bounded_overhead(self, reg, tmp_path):
+        reg.gauge("v").set(1)
+        smp = ts.MetricsSampler(_journal(tmp_path), registry=reg,
+                                interval_s=0.02).start()
+        deadline = time.time() + 5.0
+        while smp.stats()["samples"] < 3 and time.time() < deadline:
+            time.sleep(0.01)
+        smp.close()
+        st = smp.stats()
+        assert st["samples"] >= 3
+        # bounded per-sample cost: one snapshot + one flushed line
+        assert st["overhead_seconds"] <= 0.25 * st["samples"]
+        assert st["journal_bytes"] == \
+            os.path.getsize(_journal(tmp_path))
+        # close() stopped the thread: no further samples land
+        n = st["samples"]
+        time.sleep(0.06)
+        assert smp.stats()["samples"] == n
+
+    def test_sampler_publishes_its_own_metrics(self, reg, tmp_path):
+        reg.gauge("v").set(1)
+        with ts.MetricsSampler(_journal(tmp_path), registry=reg,
+                               interval_s=60) as smp:
+            smp.sample_now()
+        snap = reg.snapshot()["metrics"]
+        assert snap["paddle_tpu_timeseries_samples_total"][
+            "series"][0]["value"] == 1
+        assert snap["paddle_tpu_timeseries_journal_bytes"][
+            "series"][0]["value"] > 0
+
+    def test_retention_compaction(self, reg, tmp_path):
+        path = _journal(tmp_path)
+        g = reg.gauge("v")
+        with ts.MetricsSampler(path, registry=reg, interval_s=60,
+                               retention_samples=16) as smp:
+            for i in range(40):
+                g.set(i)
+                smp.sample_now()
+            st = smp.stats()
+        assert st["compactions"] >= 1
+        recs = ts.read_journal(path)
+        marks = [r for r in recs if r["ev"] == "c"]
+        assert marks and all(m["dropped"] > 0 for m in marks)
+        samp = ts.samples(recs)
+        # bounded in-file history, newest samples kept verbatim
+        assert len(samp) <= 17
+        assert samp[-1]["seq"] == 39
+        assert samp[-1]["m"]["v"]["s"][0][1] == 39.0
+        seqs = [r["seq"] for r in samp]
+        assert seqs == sorted(seqs)
+
+    def test_compaction_is_atomic_rewrite(self, reg, tmp_path):
+        """After compaction the journal stays appendable and lenient-
+        readable (the handle swap kept writes flowing)."""
+        path = _journal(tmp_path)
+        g = reg.gauge("v")
+        with ts.MetricsSampler(path, registry=reg, interval_s=60,
+                               retention_samples=16) as smp:
+            for i in range(20):
+                g.set(i)
+                smp.sample_now()
+            assert smp.stats()["compactions"] == 1
+            g.set(123)
+            smp.sample_now()
+        samp = ts.samples(ts.read_journal(path))
+        assert samp[-1]["m"]["v"]["s"][0][1] == 123.0
+        assert not os.path.exists(path + ".compact.tmp")
+
+    def test_query_label_filter_and_sum(self, reg, tmp_path):
+        c = reg.counter("bytes_total", labelnames=("axis", "op"))
+        c.inc(10, axis="mp", op="psum")
+        c.inc(5, axis="mp", op="all_gather")
+        c.inc(2, axis="dp", op="psum")
+        with ts.MetricsSampler(_journal(tmp_path), registry=reg,
+                               interval_s=60) as smp:
+            smp.sample_now()
+            recs = ts.read_journal(_journal(tmp_path))
+        assert ts.query(recs, "bytes_total")[0][1] == 17.0
+        assert ts.query(recs, "bytes_total",
+                        labels={"axis": "mp"})[0][1] == 15.0
+        assert ts.query(recs, "bytes_total",
+                        labels={"axis": "mp", "op": "psum"}
+                        )[0][1] == 10.0
+        assert ts.query(recs, "bytes_total",
+                        labels={"axis": "nope"}) == []
+        assert ts.query(recs, "unknown_metric") == []
+
+    def test_query_histogram_fields_and_range(self, reg, tmp_path):
+        h = reg.histogram("lat", buckets=(1.0,))
+        path = _journal(tmp_path)
+        with ts.MetricsSampler(path, registry=reg,
+                               interval_s=60) as smp:
+            h.observe(0.5)
+            smp.sample_now()
+            h.observe(3.0)
+            smp.sample_now()
+        recs = ts.read_journal(path)
+        counts = ts.query(recs, "lat", field="count")
+        assert [v for _, v in counts] == [1.0, 2.0]
+        sums = ts.query(recs, "lat", field="sum")
+        assert [v for _, v in sums] == [0.5, 3.5]
+        # "value" defaults to count for histograms
+        assert [v for _, v in ts.query(recs, "lat")] == [1.0, 2.0]
+        t_mid = counts[0][0]
+        assert ts.query(recs, "lat", t0=t_mid + 1e-6) == [counts[1]] \
+            or len(ts.query(recs, "lat", t0=t_mid + 1e-6)) <= 1
+
+    def test_resample_grid(self):
+        pts = [(10.2, 1.0), (10.7, 3.0), (11.4, 5.0), (13.1, 7.0)]
+        out = ts.resample(pts, step=1.0)
+        assert out == [(10.0, 3.0), (11.0, 5.0), (12.0, None),
+                       (13.0, 7.0)]
+        out = ts.resample(pts, step=1.0, how="mean", ffill=True)
+        assert out == [(10.0, 2.0), (11.0, 5.0), (12.0, 5.0),
+                       (13.0, 7.0)]
+        assert ts.resample(pts, step=1.0, how="sum")[0][1] == 4.0
+        assert ts.resample([], step=1.0) == []
+        with pytest.raises(ValueError):
+            ts.resample(pts, step=0.0)
+        with pytest.raises(ValueError):
+            ts.resample(pts, step=1.0, how="median")
+
+    def test_attach_dir_get_or_create(self, reg, tmp_path):
+        base = str(tmp_path / "run")
+        smp = ts.attach_dir(base, interval_s=60, registry=reg)
+        try:
+            assert ts.attach_dir(base, interval_s=60) is smp
+            assert ts.current() is smp
+            other = ts.attach_dir(str(tmp_path / "other"),
+                                  interval_s=60, registry=reg)
+            assert other is not smp
+            assert ts.current() is other
+            other.close()
+        finally:
+            smp.close()
+            ts.detach()
+        assert ts.current() is None
+
+
+# ---------------------------------------------------------------------------
+# fleet merge semantics
+# ---------------------------------------------------------------------------
+BUCKETS = (0.5, 1.0, 2.5, 5.0, 7.5)
+
+
+def _norm_buckets(b):
+    return {math.inf if k == "+Inf" else float(k): int(v)
+            for k, v in b.items()}
+
+
+class TestFleetMerge:
+    def test_parse_exposition_histogram_deaccumulates(self, reg):
+        h = reg.histogram("lat", buckets=(1.0, 2.0))
+        for v in (0.5, 1.5, 1.7, 9.0):
+            h.observe(v)
+        fam = fl.parse_exposition(reg.prometheus_text())["lat"]
+        assert fam["type"] == "histogram"
+        s = fam["series"][()]
+        assert _norm_buckets(s["buckets"]) == \
+            {1.0: 1, 2.0: 2, math.inf: 1}
+        assert s["count"] == 4 and s["min"] == 0.5 and s["max"] == 9.0
+
+    def test_counter_totals_sum_of_members(self):
+        col = fl.FleetCollector(registry=MetricsRegistry())
+        per_host = {"h0": 3, "h1": 11, "h2": 7}
+        for host, n in per_host.items():
+            r = MetricsRegistry()
+            r.counter("steps_total").inc(n)
+            col.ingest(host, r.prometheus_text())
+        fam = col.merged()["steps_total"]
+        assert fam["type"] == "counter"
+        assert fam["fleet"][()] == sum(per_host.values())
+        assert {h: s[()] for h, s in fam["hosts"].items()} == \
+            {h: float(n) for h, n in per_host.items()}
+
+    def test_gauge_min_max_mean(self):
+        col = fl.FleetCollector(registry=MetricsRegistry())
+        for host, v in (("h0", 2.0), ("h1", 8.0), ("h2", 5.0)):
+            r = MetricsRegistry()
+            r.gauge("depth").set(v)
+            col.ingest(host, r.prometheus_text())
+        agg = col.merged()["depth"]["fleet"][()]
+        assert agg == {"min": 2.0, "max": 8.0, "mean": 5.0}
+
+    @pytest.mark.parametrize("seed", [0, 1, 2])
+    def test_histogram_merge_exactness_property(self, seed):
+        """The tentpole acceptance: fleet-merged fixed-bucket
+        histograms reproduce the EXACT per-bucket counts AND the
+        EXACT interpolated percentiles of one registry fed the union
+        of every host's observations — over random shards."""
+        rng = np.random.RandomState(seed)
+        n_hosts = 2 + seed % 3
+        union_reg = MetricsRegistry()
+        union = union_reg.histogram("lat", buckets=BUCKETS,
+                                    labelnames=("stage",))
+        col = fl.FleetCollector(registry=MetricsRegistry())
+        for host in range(n_hosts):
+            r = MetricsRegistry()
+            h = r.histogram("lat", buckets=BUCKETS,
+                            labelnames=("stage",))
+            for stage in ("prefill", "decode"):
+                # binary-fraction grid: exact through text exposition
+                for _ in range(int(rng.randint(5, 60))):
+                    v = float(rng.randint(0, 81)) / 8.0
+                    h.observe(v, stage=stage)
+                    union.observe(v, stage=stage)
+            col.ingest(f"host{host}", r.prometheus_text())
+        fam = col.merged()["lat"]
+        usnap = union_reg.snapshot()["metrics"]["lat"]["series"]
+        for row in usnap:
+            stage = row["labels"]["stage"]
+            key = (("stage", stage),)
+            merged = fam["fleet"][key]
+            # bucket-for-bucket exact
+            assert _norm_buckets(merged["buckets"]) == \
+                _norm_buckets(row["buckets"]), stage
+            assert merged["count"] == row["count"]
+            assert merged["min"] == row["min"]
+            assert merged["max"] == row["max"]
+            # percentiles exactly equal to the union registry's
+            for q in (50, 90, 99, 100):
+                assert fl.merged_percentile(merged, q) == \
+                    union.percentile(q, stage=stage), (stage, q)
+
+    def test_merge_survives_chained_exposition(self):
+        """Collector-of-collectors: re-parsing the fleet exposition's
+        host rows keeps histogram state exact (repr extrema)."""
+        col = fl.FleetCollector(registry=MetricsRegistry())
+        r = MetricsRegistry()
+        h = r.histogram("lat", buckets=BUCKETS)
+        for v in (0.1234567890123, 3.3, 6.6, 9.9):
+            h.observe(v)
+        col.ingest("h0", r.prometheus_text())
+        text = col.fleet_prometheus_text()
+        refam = fl.parse_exposition(text)["lat"]
+        key = (("host", "fleet"),)
+        s = refam["series"][key]
+        assert s["min"] == 0.1234567890123
+        assert s["max"] == 9.9
+        for q in (50, 99):
+            assert fl.merged_percentile(s, q) == h.percentile(q)
+
+
+# ---------------------------------------------------------------------------
+# fleet health rollup
+# ---------------------------------------------------------------------------
+class TestFleetHealth:
+    def _col(self, **kw):
+        kw.setdefault("registry", MetricsRegistry())
+        return fl.FleetCollector(**kw)
+
+    def test_ok_member(self):
+        col = self._col()
+        col.ingest("h0", "x 1\n",
+                   healthz={"status": "ok",
+                            "snapshot_age_seconds": 0.5})
+        assert col.member_health("h0")["status"] == "ok"
+        assert col.fleet_healthz()["status"] == "ok"
+
+    def test_degraded_member_degrades_fleet(self):
+        col = self._col()
+        col.ingest("h0", "x 1\n", healthz={"status": "ok",
+                                           "snapshot_age_seconds": 0.1})
+        col.ingest("h1", "x 1\n", healthz={
+            "status": "degraded", "snapshot_age_seconds": 0.1,
+            "components": [{"component": "serving_admission",
+                            "status": "degraded"}]})
+        doc = col.fleet_healthz()
+        assert doc["status"] == "degraded"
+        assert doc["members"]["h1"]["reason"] == "member degraded"
+        assert doc["members"]["h0"]["status"] == "ok"
+
+    def test_stale_snapshot_age_degrades(self):
+        col = self._col(stale_after_s=1.0)
+        # port answers, but the engine's snapshots froze long ago
+        col.ingest("h0", "x 1\n",
+                   healthz={"status": "ok",
+                            "snapshot_age_seconds": 99.0})
+        m = col.member_health("h0")
+        assert m["status"] == "degraded" and m["reason"] == "stale"
+        assert col.fleet_healthz()["status"] == "degraded"
+
+    def test_push_mode_staleness_uses_last_heard(self):
+        col = self._col(stale_after_s=1000.0)
+        col.ingest("h0", "x 1\n")            # no healthz doc at all
+        m = col.member_health("h0")
+        assert m["status"] == "ok"
+        assert 0 <= m["snapshot_age_seconds"] < 1000.0
+        col.stale_after_s = 0.0
+        time.sleep(0.01)
+        assert col.member_health("h0")["reason"] == "stale"
+
+    def test_unreachable_and_unknown_members(self):
+        col = self._col()
+        col.add_member("gone")               # registered, never heard
+        assert col.member_health("gone")["reason"] == "unreachable"
+        assert col.member_health("never")["reason"] == "unknown member"
+        assert col.fleet_healthz()["status"] == "degraded"
+
+    def test_members_gauge_by_state(self):
+        r = MetricsRegistry()
+        col = fl.FleetCollector(registry=r)
+        col.ingest("h0", "x 1\n", healthz={"status": "ok",
+                                           "snapshot_age_seconds": 0.1})
+        col.add_member("gone")
+        col.fleet_healthz()
+        m = r.snapshot()["metrics"]["paddle_tpu_fleet_members"]
+        vals = {s["labels"]["state"]: s["value"]
+                for s in m["series"]}
+        assert vals == {"ok": 1, "degraded": 1}
+
+
+# ---------------------------------------------------------------------------
+# the HTTP front door (scrape + push, end to end)
+# ---------------------------------------------------------------------------
+def _get(url):
+    with urllib.request.urlopen(url, timeout=5) as resp:
+        return (resp.headers.get("Content-Type"),
+                resp.read().decode("utf-8"))
+
+
+class TestFleetHTTP:
+    def test_scrape_merge_serve(self):
+        regs, srvs = [], []
+        try:
+            for i, n in enumerate((4, 9)):
+                r = MetricsRegistry()
+                r.counter("steps_total").inc(n)
+                r.snapshot()                 # arm the liveness age
+                regs.append(r)
+                srvs.append(obs.serve_metrics(0, registry=r))
+            col = fl.FleetCollector(registry=MetricsRegistry())
+            for i, srv in enumerate(srvs):
+                col.add_member(f"host{i}",
+                               f"http://127.0.0.1:{srv.port}")
+            errs = col.scrape()
+            assert errs == {"host0": None, "host1": None}
+            assert col.merged()["steps_total"]["fleet"][()] == 13.0
+            assert col.fleet_healthz()["status"] == "ok"
+            with col.serve(0, scrape_on_get=True) as fsrv:
+                ctype, text = _get(
+                    f"http://127.0.0.1:{fsrv.port}/metrics")
+                assert "charset=utf-8" in ctype
+                rows = obs.parse_prometheus_text(text)["steps_total"]
+                assert rows[(("host", "fleet"),)] == 13.0
+                assert rows[(("host", "host0"),)] == 4.0
+                assert rows[(("host", "host1"),)] == 9.0
+                _, hz = _get(f"http://127.0.0.1:{fsrv.port}/healthz")
+                assert json.loads(hz)["status"] == "ok"
+        finally:
+            for srv in srvs:
+                srv.close()
+
+    def test_scrape_error_marks_unreachable(self):
+        col = fl.FleetCollector(registry=MetricsRegistry(),
+                                scrape_timeout_s=0.2)
+        col.add_member("dead", "http://127.0.0.1:9")   # discard port
+        errs = col.scrape()
+        assert errs["dead"] is not None
+        assert col.member_health("dead")["reason"] == "unreachable"
+        assert col.fleet_healthz()["status"] == "degraded"
+
+    def test_push_endpoints(self):
+        col = fl.FleetCollector(registry=MetricsRegistry())
+        with col.serve(0, scrape_on_get=False) as fsrv:
+            url = f"http://127.0.0.1:{fsrv.port}"
+            r = MetricsRegistry()
+            r.counter("steps_total").inc(5)
+            req = urllib.request.Request(
+                f"{url}/push?host=pushed", method="POST",
+                data=r.prometheus_text().encode("utf-8"))
+            with urllib.request.urlopen(req, timeout=5) as resp:
+                assert json.loads(resp.read())["ok"] is True
+            doc = {"host": "jsonhost", "metrics": "x 1\n",
+                   "healthz": {"status": "ok",
+                               "snapshot_age_seconds": 0.1}}
+            req = urllib.request.Request(
+                f"{url}/push", method="POST",
+                data=json.dumps(doc).encode("utf-8"),
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=5):
+                pass
+            merged = col.merged()
+            assert merged["steps_total"]["hosts"]["pushed"][()] == 5.0
+            assert merged["x"]["hosts"]["jsonhost"][()] == 1.0
+            assert col.member_health("jsonhost")["status"] == "ok"
+
+
+# ---------------------------------------------------------------------------
+# exporter satellites: ?names= filtering + charset + touch=False
+# ---------------------------------------------------------------------------
+class TestExporterFilter:
+    def test_names_prefix_filter_and_charset(self):
+        r = MetricsRegistry()
+        r.counter("alpha_total").inc(1)
+        r.counter("beta_total").inc(2)
+        r.gauge("alpha_depth").set(3)
+        with obs.serve_metrics(0, registry=r) as srv:
+            url = f"http://127.0.0.1:{srv.port}"
+            ctype, text = _get(f"{url}/metrics?names=alpha")
+            assert "charset=utf-8" in ctype
+            rows = obs.parse_prometheus_text(text)
+            assert set(rows) == {"alpha_total", "alpha_depth"}
+            # comma-separated prefixes widen the filter
+            _, text = _get(f"{url}/metrics?names=alpha_total,beta")
+            assert set(obs.parse_prometheus_text(text)) == \
+                {"alpha_total", "beta_total"}
+            # no filter: everything
+            _, text = _get(f"{url}/metrics")
+            assert set(obs.parse_prometheus_text(text)) >= \
+                {"alpha_total", "beta_total", "alpha_depth"}
+
+    def test_filtered_scrape_does_not_touch_liveness(self):
+        r = MetricsRegistry()
+        r.counter("alpha_total").inc(1)
+        r.snapshot()                         # arm the age clock
+        time.sleep(0.05)
+        with obs.serve_metrics(0, registry=r) as srv:
+            _get(f"http://127.0.0.1:{srv.port}/metrics?names=alpha")
+            _get(f"http://127.0.0.1:{srv.port}/metrics")
+        # scrapes (filtered or not) never reset the in-process age
+        assert r.snapshot_age_seconds() >= 0.05
+
+
+# ---------------------------------------------------------------------------
+# W3C trace identity
+# ---------------------------------------------------------------------------
+class TestTraceIdentity:
+    def test_make_format_parse_round_trip(self):
+        tid, sid = sp.make_trace_id(), sp.make_span_id()
+        assert len(tid) == 32 and len(sid) == 16
+        assert tid != "0" * 32 and sid != "0" * 16
+        hdr = sp.format_traceparent(tid, sid)
+        assert hdr == f"00-{tid}-{sid}-01"
+        assert sp.parse_traceparent(hdr) == (tid, sid)
+
+    @pytest.mark.parametrize("bad", [
+        "", "00-zz-xx-01", "00-" + "0" * 32 + "-" + "1" * 16 + "-01",
+        "00-" + "a" * 32 + "-" + "0" * 16 + "-01",
+        "01-" + "a" * 32 + "-" + "b" * 16,
+        "00-" + "a" * 31 + "-" + "b" * 16 + "-01"])
+    def test_parse_rejects_malformed(self, bad):
+        with pytest.raises(ValueError):
+            sp.parse_traceparent(bad)
+
+    def test_request_trace_carries_identity(self):
+        tr = sp.RequestTrace(7)
+        assert len(tr.trace_id) == 32 and len(tr.span_id) == 16
+        assert tr.traceparent == \
+            sp.format_traceparent(tr.trace_id, tr.span_id)
+        tr.begin("prefill", 1.0)
+        tr.end("prefill", 2.0)
+        d = tr.to_dict()
+        assert d["trace_id"] == tr.trace_id
+        assert d["spans"][0]["parent_span_id"] == tr.span_id
+        assert d["spans"][0]["span_id"] != tr.span_id
+
+    def test_request_trace_joins_inbound_context(self):
+        tid, psid = sp.make_trace_id(), sp.make_span_id()
+        tr = sp.RequestTrace(1, trace_id=tid, parent_span_id=psid)
+        assert tr.trace_id == tid
+        assert tr.parent_span_id == psid
+        assert tr.span_id not in (psid, "0" * 16)
+        with pytest.raises(ValueError):
+            sp.RequestTrace(2, trace_id="nothex")
+
+
+class TestServingTracePropagation:
+    @pytest.fixture(scope="class")
+    def served(self):
+        from paddle_tpu.distributed import fleet as _fleet
+        from paddle_tpu.inference import (Config, ServingEngine,
+                                          create_predictor)
+        from paddle_tpu.models.llama import LlamaForCausalLM, llama_tiny
+
+        _fleet._fleet_state.update(initialized=False, hcg=None,
+                                   strategy=None)
+        obs.reset_registry()
+        paddle.seed(3)
+        model = LlamaForCausalLM(llama_tiny())
+        pred = create_predictor(
+            Config().set_model(model).enable_paged_kv(page_size=8))
+        eng = ServingEngine(pred, max_batch=2, decode_chunk=2)
+        V = model.config.vocab_size
+        r = np.random.RandomState(0)
+        inbound = sp.format_traceparent(sp.make_trace_id(),
+                                        sp.make_span_id())
+        rid_hdr = eng.submit(r.randint(1, V, (6,)), max_new_tokens=3,
+                             trace_id=inbound)
+        rid_auto = eng.submit(r.randint(1, V, (9,)), max_new_tokens=3)
+        eng.run()
+        return eng, inbound, rid_hdr, rid_auto
+
+    def test_submit_accepts_traceparent_header(self, served):
+        eng, inbound, rid_hdr, _ = served
+        tid, psid = sp.parse_traceparent(inbound)
+        ctx = eng.trace_context(rid_hdr)
+        assert ctx["trace_id"] == tid
+        assert ctx["parent_span_id"] == psid
+        assert ctx["span_id"] not in (psid, None)
+        assert ctx["traceparent"] == \
+            sp.format_traceparent(tid, ctx["span_id"])
+
+    def test_submit_mints_fresh_identity(self, served):
+        eng, inbound, rid_hdr, rid_auto = served
+        ctx = eng.trace_context(rid_auto)
+        assert len(ctx["trace_id"]) == 32
+        assert ctx["trace_id"] != sp.parse_traceparent(inbound)[0]
+        assert ctx["parent_span_id"] is None
+        assert eng.trace_context(rid_hdr)["trace_id"] != \
+            ctx["trace_id"]
+        assert eng.trace_context(10_000) is None
+
+    def test_every_exported_span_carries_trace_id(self, served,
+                                                  tmp_path):
+        eng, inbound, rid_hdr, rid_auto = served
+        tid = sp.parse_traceparent(inbound)[0]
+        by_rid = {t["rid"]: t for t in eng.request_traces()}
+        for rid in (rid_hdr, rid_auto):
+            tr = by_rid[rid]
+            assert len(tr["spans"]) > 0
+            assert all(s["parent_span_id"] == tr["span_id"]
+                       for s in tr["spans"])
+        assert by_rid[rid_hdr]["trace_id"] == tid
+        assert by_rid[rid_hdr]["traceparent"].startswith(f"00-{tid}-")
+        doc = eng.export_request_traces(str(tmp_path / "t.json"))
+        evs = [e for e in doc["traceEvents"]
+               if e["tid"] == rid_hdr and e["ph"] != "M"]
+        assert evs
+        assert all(e["args"]["trace_id"] == tid for e in evs)
+        assert all("span_id" in e["args"] for e in evs)
+
+    def test_serving_request_traceparent_property(self, served):
+        eng, _, rid_hdr, _ = served
+        req = eng.finished[rid_hdr]
+        assert req.traceparent == \
+            eng.trace_context(rid_hdr)["traceparent"]
+
+
+# ---------------------------------------------------------------------------
+# engine wiring: env-knob sampler, bit-identical losses, flat compiles
+# ---------------------------------------------------------------------------
+def _tiny_train_run(steps=3):
+    from paddle_tpu.core.rng import get_rng_tracker
+    from paddle_tpu.distributed import fleet
+    from paddle_tpu.distributed.engine import ParallelEngine
+    from paddle_tpu.models import (GPTConfig, GPTForCausalLM,
+                                   GPTPretrainingCriterion)
+
+    fleet._fleet_state.update(initialized=False, hcg=None,
+                              strategy=None)
+    get_rng_tracker().reset()
+    obs.reset_registry()
+    paddle.seed(0)
+    cfg = GPTConfig(vocab_size=64, hidden_size=16, num_layers=1,
+                    num_heads=2, max_position_embeddings=16)
+    model = GPTForCausalLM(cfg)
+    crit = GPTPretrainingCriterion(cfg)
+    opt = paddle.optimizer.AdamW(learning_rate=1e-4,
+                                 parameters=model.parameters())
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 2, "mp_degree": 2}
+    hcg = fleet.init(is_collective=True, strategy=strategy)
+    eng = ParallelEngine(model, opt, hcg.mesh)
+    step = eng.train_step(lambda m, b: crit(m(b["x"]), b["y"]))
+    r = np.random.RandomState(0)
+    ids = r.randint(0, 64, (4, 9))
+    batch = {"x": paddle.to_tensor(ids[:, :-1]),
+             "y": paddle.to_tensor(ids[:, 1:])}
+    losses = [float(step(batch)) for _ in range(steps)]
+    return eng, losses
+
+
+class TestEngineWiring:
+    def test_env_knob_sampler_parity(self, tmp_path, monkeypatch):
+        """The acceptance gate: the sampler attached via
+        PADDLE_TPU_TIMESERIES_DIR changes NOTHING about the run —
+        bit-identical losses, equal compile counts — while the journal
+        fills."""
+        monkeypatch.delenv("PADDLE_TPU_TIMESERIES_DIR", raising=False)
+        eng_off, losses_off = _tiny_train_run()
+        assert eng_off.sampler is None
+
+        ts_dir = str(tmp_path / "tsdir")
+        monkeypatch.setenv("PADDLE_TPU_TIMESERIES_DIR", ts_dir)
+        monkeypatch.setenv("PADDLE_TPU_TIMESERIES_S", "60")
+        eng_on, losses_on = _tiny_train_run()
+        try:
+            assert eng_on.sampler is not None
+            eng_on.sampler.sample_now()
+            assert losses_on == losses_off          # bit-identical
+            assert eng_on.stats.compiles == eng_off.stats.compiles
+            recs = ts.read_journal(os.path.join(ts_dir,
+                                                ts.JOURNAL_NAME))
+            samp = ts.samples(recs)
+            assert samp
+            pts = ts.query(recs, "paddle_tpu_train_steps_total")
+            assert pts and pts[-1][1] == 3.0
+            hist = ts.query(recs, "paddle_tpu_train_step_seconds",
+                            field="count")
+            assert hist[-1][1] == 3.0
+        finally:
+            eng_on.sampler.close()
+            ts.detach()
+
+    def test_checkpoint_manager_metrics_sample_knob(self, tmp_path):
+        from paddle_tpu.distributed.checkpoint.manager import \
+            CheckpointManager
+
+        obs.reset_registry()
+        base = str(tmp_path / "ckpt")
+        mgr = CheckpointManager(base, metrics_sample_s=60)
+        try:
+            assert mgr._sampler is not None
+            assert mgr._sampler is ts.attach_dir(base, interval_s=60)
+            mgr._sampler.sample_now()
+            assert ts.samples(ts.read_journal(
+                os.path.join(base, ts.JOURNAL_NAME)))
+            # the goodput journal lives right beside it
+            assert os.path.exists(os.path.join(base, _gp.JOURNAL_NAME))
+        finally:
+            if mgr._sampler is not None:
+                mgr._sampler.close()
+            ts.detach()
+            _gp.detach()
+
+    def test_checkpoint_manager_default_no_sampler(self, tmp_path):
+        from paddle_tpu.distributed.checkpoint.manager import \
+            CheckpointManager
+
+        mgr = CheckpointManager(str(tmp_path / "ckpt2"))
+        assert mgr._sampler is None
+        _gp.detach()
+
+
+# ---------------------------------------------------------------------------
+# reports: fleet_report + run_report --merge
+# ---------------------------------------------------------------------------
+def _write_goodput(path, t0, steps, restart=False):
+    """A synthetic goodput journal: run header + compile +
+    step_compute segments (+ an optional restart)."""
+    recs = [{"ev": "run", "ts": t0, "pid": 1, "resumed": False},
+            {"ev": "e", "seg": "compile", "t0": t0, "t1": t0 + 2.0},
+            {"ev": "e", "seg": "step_compute", "t0": t0 + 2.0,
+             "t1": t0 + 2.0 + steps}]
+    if restart:
+        recs += [{"ev": "e", "seg": "recovery_restart",
+                  "t0": t0 + 2.0 + steps, "t1": t0 + 4.0 + steps},
+                 {"ev": "run", "ts": t0 + 4.0 + steps, "pid": 2,
+                  "resumed": True},
+                 {"ev": "e", "seg": "step_compute",
+                  "t0": t0 + 4.0 + steps, "t1": t0 + 6.0 + steps}]
+    with open(path, "w") as f:
+        for r in recs:
+            f.write(json.dumps(r) + "\n")
+
+
+def _write_host_dir(tmp_path, name, t0, steps, step_mean,
+                    comm_bytes, restart=False):
+    d = tmp_path / name
+    d.mkdir()
+    _write_goodput(str(d / _gp.JOURNAL_NAME), t0, steps,
+                   restart=restart)
+    reg = MetricsRegistry()
+    from paddle_tpu.observability.catalog import (comm_metrics,
+                                                  train_metrics)
+    m = train_metrics(reg)
+    for _ in range(4):
+        m["step_seconds"].observe(step_mean)
+    comm_metrics(reg)["comm_bytes"].inc(comm_bytes, axis="mp",
+                                        op="psum")
+    with ts.MetricsSampler(str(d / ts.JOURNAL_NAME), registry=reg,
+                           interval_s=60) as smp:
+        smp.sample_now()
+    return str(d)
+
+
+class TestReports:
+    def test_fleet_report_structure(self, tmp_path):
+        from tools.fleet_report import fleet_report
+
+        d0 = _write_host_dir(tmp_path, "host0", 1000.0, 10.0, 0.5,
+                             1024.0)
+        d1 = _write_host_dir(tmp_path, "host1", 1001.0, 10.0, 0.7,
+                             2048.0, restart=True)
+        rep = fleet_report([d0, d1])
+        assert rep["fleet"]["members"] == 2
+        lanes = {h["host"]: h for h in rep["hosts"]}
+        assert lanes["host0"]["goodput"]["goodput_pct"] > 0
+        assert lanes["host1"]["goodput"]["restarts"] == 1
+        assert lanes["host0"]["step_time"]["mean_s"] == 0.5
+        assert lanes["host1"]["step_time"]["mean_s"] == 0.7
+        sk = rep["fleet"]["step_time_skew"]
+        assert sk["slowest_host"] == "host1"
+        assert sk["median_s"] == 0.6 and sk["max_s"] == 0.7
+        assert sk["skew_pct"] == round(100 * (0.7 - 0.6) / 0.6, 2)
+        assert rep["fleet"]["bytes"][
+            "paddle_tpu_comm_bytes_total"] == 3072.0
+        # combined timeline on one clock, tagged by host
+        assert rep["timeline"][0]["t"] == 0.0
+        whats = [(e["host"], e["what"]) for e in rep["timeline"]]
+        assert ("host1", "recovery_restart") in whats
+        assert ("host0", "start") in whats
+
+    def test_fleet_report_cli(self, tmp_path, capsys):
+        from tools.fleet_report import main
+
+        d0 = _write_host_dir(tmp_path, "host0", 1000.0, 10.0, 0.5,
+                             64.0)
+        assert main([d0, "--json"]) == 0
+        doc = json.loads(capsys.readouterr().out)
+        assert doc["fleet"]["members"] == 1
+        assert main([d0]) == 0
+        out = capsys.readouterr().out
+        assert "goodput lanes" in out and "host0" in out
+        empty = tmp_path / "empty"
+        empty.mkdir()
+        assert main([str(empty)]) == 2
+
+    def test_run_report_merge(self, tmp_path, capsys):
+        from tools.run_report import main, merge_report
+
+        d0 = _write_host_dir(tmp_path, "host0", 1000.0, 10.0, 0.5,
+                             64.0)
+        d1 = _write_host_dir(tmp_path, "host1", 1002.0, 6.0, 0.6,
+                             64.0, restart=True)
+        rep = merge_report([d0, d1])
+        lanes = {h["host"]: h for h in rep["hosts"]}
+        assert lanes["host0"]["summary"]["goodput_pct"] > 0
+        assert lanes["host1"]["summary"]["restarts"] == 1
+        g = rep["fleet_goodput_pct"]
+        assert g["min"] <= g["mean"] <= g["max"]
+        whats = [(e["host"], e["what"]) for e in rep["timeline"]]
+        assert ("host1", "recovery_restart") in whats
+        assert ("host1", "resume") in whats
+        ts0 = [e["t"] for e in rep["timeline"]]
+        assert ts0 == sorted(ts0) and ts0[0] == 0.0
+        # CLI: json + text + empty-dir exit code
+        assert main(["--merge", d0, d1, "--json"]) == 0
+        doc = json.loads(capsys.readouterr().out)
+        assert len(doc["hosts"]) == 2
+        assert main(["--merge", d0, d1]) == 0
+        out = capsys.readouterr().out
+        assert "host lane" in out and "restart timeline" in out
+        empty = tmp_path / "empty"
+        empty.mkdir()
+        assert main(["--merge", str(empty)]) == 2
+
+
+# ---------------------------------------------------------------------------
+# tpulint gate: the new tool lints clean with ZERO baseline entries
+# (timeseries.py / fleet.py ride the observability-package gate in
+# test_observability.py)
+# ---------------------------------------------------------------------------
+def test_tpulint_fleet_report_zero_baseline():
+    from pathlib import Path
+
+    repo = Path(__file__).resolve().parents[1]
+    sys.path.insert(0, str(repo))
+    try:
+        from tools.tpulint import ALL_RULES, lint_paths
+
+        findings = lint_paths([repo / "tools" / "fleet_report.py",
+                               repo / "tools" / "run_report.py"],
+                              ALL_RULES, root=repo)
+    finally:
+        sys.path.remove(str(repo))
+    assert findings == [], [str(f) for f in findings]

@@ -1,0 +1,245 @@
+"""fleet_report: cross-host run view from per-host durable journals.
+
+Each host of a fleet run writes two crash-durable journals under its
+own run dir (typically the checkpoint base): ``goodput.jsonl`` (the
+wall-clock attribution ledger, observability/goodput.py) and
+``metrics.jsonl`` (the sampled metrics time-series,
+observability/timeseries.py). This tool reads one directory per host
+and renders the fleet-level picture no single host can see:
+
+- **goodput lanes**: one lane per host — wall seconds, goodput_pct,
+  restarts, per-segment split — plus the fleet min/max/mean goodput,
+- **combined event timeline**: every host's health events, process
+  (re)starts and recovery_restart segments merged onto one clock
+  (t = seconds since the earliest run start across the fleet), each
+  entry tagged with its host,
+- **step-time skew**: per-host mean step seconds from the newest
+  ``paddle_tpu_train_step_seconds`` journal sample; the headline skew
+  is ``(slowest - median) / median`` — the straggler tax the
+  synchronous step pays every iteration,
+- **comm / offload byte totals**: per-host and fleet-summed
+  ``paddle_tpu_comm_bytes_total`` and
+  ``paddle_tpu_offload_transfer_bytes`` from the newest sample.
+
+Usage::
+
+    python -m tools.fleet_report <host-dir> [<host-dir> ...] [--json]
+
+Host names are the directory basenames. Exit codes: 0 on success, 2
+when no directory held any journal. Read-only, like run_report.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional
+
+from paddle_tpu.observability import goodput as _gp
+from paddle_tpu.observability import timeseries as _ts
+
+__all__ = ["host_report", "fleet_report", "step_time_skew", "main"]
+
+STEP_METRIC = "paddle_tpu_train_step_seconds"
+BYTE_METRICS = ("paddle_tpu_comm_bytes_total",
+                "paddle_tpu_offload_transfer_bytes")
+
+
+def _host_name(d: str) -> str:
+    return os.path.basename(os.path.normpath(d)) or d
+
+
+def _last_sample(path: str) -> Optional[Dict[str, Any]]:
+    if not os.path.isfile(path):
+        return None
+    samp = _ts.samples(_ts.read_journal(path))
+    return samp[-1] if samp else None
+
+
+def _series_total(sample: Dict[str, Any], name: str) -> Optional[float]:
+    """Sum of every labelled series' value (counters/gauges) in one
+    journal sample; None when the metric never appeared."""
+    ent = (sample.get("m") or {}).get(name)
+    if not ent:
+        return None
+    total = 0.0
+    for _labels, val in ent.get("s", []):
+        if isinstance(val, dict):       # histogram state: use sum
+            total += float(val.get("sum", 0.0))
+        else:
+            total += float(val)
+    return total
+
+
+def _step_stats(sample: Dict[str, Any]) -> Optional[Dict[str, float]]:
+    """Mean step seconds from the histogram state of the newest
+    sample (all label series pooled)."""
+    ent = (sample.get("m") or {}).get(STEP_METRIC)
+    if not ent:
+        return None
+    count = 0
+    total = 0.0
+    for _labels, st in ent.get("s", []):
+        if isinstance(st, dict):
+            count += int(st.get("count", 0))
+            total += float(st.get("sum", 0.0))
+    if not count:
+        return None
+    return {"count": count, "sum": round(total, 6),
+            "mean_s": round(total / count, 6)}
+
+
+def host_report(d: str) -> Dict[str, Any]:
+    """Everything one host dir's journals yield (missing pieces are
+    None — a dir with neither journal reports both as None)."""
+    out: Dict[str, Any] = {"dir": d, "host": _host_name(d),
+                           "goodput": None, "timeline": [],
+                           "step_time": None, "bytes": {}}
+    gp_path = os.path.join(d, _gp.JOURNAL_NAME)
+    if os.path.isfile(gp_path):
+        records = _gp.read_journal(gp_path)
+        if records:
+            out["goodput"] = _gp.summarize(records)
+            for r in records:
+                if r.get("ev") == "run":
+                    out["timeline"].append({
+                        "ts": float(r["ts"]),
+                        "what": "resume" if r.get("resumed")
+                        else "start", "pid": r.get("pid")})
+                elif r.get("ev") == "h":
+                    e = {"ts": float(r.get("ts", 0.0)),
+                         "what": r.get("kind", "event")}
+                    for k in ("step", "value", "z", "reason"):
+                        if k in r:
+                            e[k] = r[k]
+                    out["timeline"].append(e)
+                elif (r.get("ev") == "e"
+                        and r.get("seg") == "recovery_restart"):
+                    out["timeline"].append({
+                        "ts": float(r["t0"]),
+                        "what": "recovery_restart",
+                        "seconds": round(float(r["t1"])
+                                         - float(r["t0"]), 3)})
+    sample = _last_sample(os.path.join(d, _ts.JOURNAL_NAME))
+    if sample is not None:
+        out["step_time"] = _step_stats(sample)
+        for name in BYTE_METRICS:
+            total = _series_total(sample, name)
+            if total is not None:
+                out["bytes"][name] = round(total, 3)
+    return out
+
+
+def step_time_skew(hosts: List[Dict[str, Any]]) -> Optional[Dict[str, Any]]:
+    """``(slowest - median) / median`` over per-host mean step seconds
+    — what the synchronous step loses to its slowest member."""
+    means = sorted((h["step_time"]["mean_s"], h["host"])
+                   for h in hosts if h.get("step_time"))
+    if not means:
+        return None
+    vals = [m for m, _ in means]
+    n = len(vals)
+    median = (vals[n // 2] if n % 2
+              else 0.5 * (vals[n // 2 - 1] + vals[n // 2]))
+    worst, worst_host = means[-1]
+    return {"median_s": round(median, 6), "max_s": round(worst, 6),
+            "slowest_host": worst_host,
+            "skew_pct": round(100.0 * (worst - median) / median, 2)
+            if median else 0.0}
+
+
+def fleet_report(dirs: List[str]) -> Dict[str, Any]:
+    hosts = [host_report(d) for d in dirs]
+    gp = [h["goodput"]["goodput_pct"] for h in hosts if h["goodput"]]
+    t0 = min((e["ts"] for h in hosts for e in h["timeline"]),
+             default=None)
+    timeline: List[Dict[str, Any]] = []
+    for h in hosts:
+        for e in h["timeline"]:
+            timeline.append({**e, "host": h["host"],
+                             "t": round(e["ts"] - (t0 or 0.0), 3)})
+    timeline.sort(key=lambda e: e["t"])
+    for e in timeline:
+        e.pop("ts", None)
+    byte_totals: Dict[str, float] = {}
+    for h in hosts:
+        for name, v in h["bytes"].items():
+            byte_totals[name] = round(byte_totals.get(name, 0.0) + v, 3)
+    return {
+        "hosts": hosts,
+        "fleet": {
+            "members": len(hosts),
+            "goodput_pct": {
+                "min": round(min(gp), 2), "max": round(max(gp), 2),
+                "mean": round(sum(gp) / len(gp), 2)} if gp else None,
+            "step_time_skew": step_time_skew(hosts),
+            "bytes": byte_totals,
+        },
+        "timeline": timeline,
+    }
+
+
+def _print_report(rep: Dict[str, Any]) -> None:
+    print(f"fleet_report: {rep['fleet']['members']} host(s)")
+    width = max((len(h["host"]) for h in rep["hosts"]), default=4)
+    print("\ngoodput lanes")
+    for h in rep["hosts"]:
+        s = h["goodput"]
+        if s is None:
+            print(f"  {h['host']:<{width}} (no goodput journal)")
+            continue
+        bar = "#" * int(round(0.4 * min(max(s["goodput_pct"], 0.0),
+                                        100.0)))
+        print(f"  {h['host']:<{width}} wall {s['wall_seconds']:>9.3f}s"
+              f"  goodput {s['goodput_pct']:>6.2f}%  restarts "
+              f"{s['restarts']}  {bar}")
+    fl = rep["fleet"]
+    if fl["goodput_pct"]:
+        g = fl["goodput_pct"]
+        print(f"  fleet goodput min {g['min']:.2f}%  max {g['max']:.2f}%"
+              f"  mean {g['mean']:.2f}%")
+    if fl["step_time_skew"]:
+        sk = fl["step_time_skew"]
+        print(f"\nstep-time skew: median {sk['median_s']:.6f}s  "
+              f"max {sk['max_s']:.6f}s ({sk['slowest_host']})  "
+              f"skew {sk['skew_pct']:.2f}%")
+    if fl["bytes"]:
+        print("\nfleet byte totals (newest sample per host, summed)")
+        for name, v in sorted(fl["bytes"].items()):
+            print(f"  {name:<42} {v:>16.0f}")
+    if rep["timeline"]:
+        print("\ncombined timeline (t = seconds since earliest start)")
+        for e in rep["timeline"]:
+            extra = " ".join(f"{k}={e[k]}" for k in
+                             ("pid", "step", "value", "z", "seconds",
+                              "reason") if k in e)
+            print(f"  t+{e['t']:>10.3f}  {e['host']:<{width}} "
+                  f"{e['what']:<18} {extra}")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="fleet_report",
+                                 description=__doc__.splitlines()[0])
+    ap.add_argument("dirs", nargs="+", metavar="host-dir",
+                    help="one run dir per host (goodput.jsonl and/or "
+                         "metrics.jsonl inside)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit the report as one JSON doc")
+    args = ap.parse_args(argv)
+
+    rep = fleet_report(args.dirs)
+    if (all(h["goodput"] is None and not h["bytes"]
+            and h["step_time"] is None for h in rep["hosts"])):
+        print("fleet_report: no goodput.jsonl or metrics.jsonl under "
+              + ", ".join(repr(d) for d in args.dirs), file=sys.stderr)
+        return 2
+    if args.as_json:
+        print(json.dumps(rep, indent=1))
+        return 0
+    _print_report(rep)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
