@@ -853,6 +853,173 @@ def bench_serving_prefix_spec(on_tpu, dev):
 
 
 # ---------------------------------------------------------------------------
+# Disaggregated prefill/decode serving (ISSUE 20): a phase-split fleet
+# (1 prefill replica streaming KV pages to 1 decode replica through
+# inference/disagg.py, fronted by the inference/router.py front door)
+# vs a unified 2-replica fleet on the SAME bursty Poisson trace.
+# Same chip count on both sides, so goodput-per-chip is the headline;
+# the exactness gates (bench_compare _EXACT): bit-identical token
+# streams, migration wire bytes pinned to the pages x page_bytes +
+# block-table-row closed form, zero post-warmup recompiles on BOTH
+# replica kinds.
+# ---------------------------------------------------------------------------
+def bench_serving_disagg(on_tpu, dev):
+    import paddle_tpu as paddle
+    from paddle_tpu.inference import Config, Router, ServingEngine, \
+        create_predictor
+    from paddle_tpu.models.llama import (LlamaConfig, LlamaForCausalLM,
+                                         llama_7b)
+
+    old_dtype = paddle.get_default_dtype()
+    if on_tpu:
+        paddle.set_default_dtype("bfloat16")
+        cfg = llama_7b(max_position_embeddings=1024, dtype="bfloat16")
+        page, B, Sc = 128, 8, 256
+        n_req, len_lo, len_hi, n_new, rate = 24, 128, 448, 48, 1.0
+        pool = None                  # geometric default
+    else:
+        cfg = LlamaConfig(vocab_size=256, hidden_size=64, num_layers=2,
+                          num_heads=4, num_kv_heads=2,
+                          intermediate_size=128,
+                          max_position_embeddings=256)
+        page, B, Sc = 8, 4, 16
+        n_req, len_lo, len_hi, n_new, rate = 14, 5, 30, 8, 0.8
+        pool = 32
+    try:
+        paddle.seed(0)
+        model = LlamaForCausalLM(cfg)
+        conf = Config().set_model(model).enable_paged_kv(page_size=page)
+        if on_tpu:
+            conf.enable_weight_only("weight_only_int8")
+        r = np.random.RandomState(20)
+        # bursty Poisson arrivals on the router's step clock
+        gaps = r.exponential(1.0 / rate, n_req)
+        trace = [(float(t),
+                  r.randint(1, cfg.vocab_size,
+                            (int(r.randint(len_lo, len_hi)),)))
+                 for t in np.cumsum(gaps)]
+
+        def mk(phase=None):
+            return ServingEngine(create_predictor(conf), max_batch=B,
+                                 prefill_chunk=Sc, pool_pages=pool,
+                                 phase=phase)
+
+        def serve(disagg):
+            if disagg:
+                rt = Router([("prefill0", mk("prefill")),
+                             ("decode0", mk("decode"))])
+            else:
+                rt = Router([("u0", mk()), ("u1", mk())])
+            engs = [rep.engine for rep in rt.replicas]
+            # warmup: one request PER FRONTDOOR REPLICA through every
+            # program shape (prefill chunks, fused decode, page
+            # read/write on the migration path) — least-loaded
+            # placement spreads sequential submissions across the pool
+            for _ in range(len(rt.frontdoor)):
+                rt.submit(r.randint(1, cfg.vocab_size, (len_hi,)),
+                          max_new_tokens=3)
+            rt.run()
+            warm = sum(e.stats.compiles for e in engs)
+            gids, i, rnd = [], 0, 0
+            t0 = time.perf_counter()
+            while i < len(trace) or rt.pending:
+                while i < len(trace) and trace[i][0] <= rnd:
+                    gids.append(rt.submit(trace[i][1],
+                                          max_new_tokens=n_new))
+                    i += 1
+                rt.step()
+                rnd += 1
+            dt = max(time.perf_counter() - t0, 1e-4)
+            fin = [rt.result(g) for g in gids]
+            ttfts = [q.t_first_token - q.t_submit for q in fin
+                     if q.t_first_token]
+            tpots = [(q.t_finish - q.t_first_token)
+                     / (len(q.new_tokens) - 1) for q in fin
+                     if q.t_first_token and len(q.new_tokens) > 1]
+            n_tok = sum(len(q.new_tokens) for q in fin)
+            return rt, [tuple(q.new_tokens) for q in fin], {
+                "ttft_p99_ms": round(float(np.percentile(ttfts, 99))
+                                     * 1e3, 3),
+                "tpot_p99_ms": round(float(np.percentile(tpots, 99))
+                                     * 1e3, 3),
+                "goodput_tokens_per_sec_per_chip":
+                    round(n_tok / dt / len(engs), 2),
+                "recompiles_after_warmup":
+                    sum(e.stats.compiles for e in engs) - warm,
+                "rounds": rnd,
+            }
+
+        rt_d, out_d, dis = serve(disagg=True)
+        rt_u, out_u, uni = serve(disagg=False)
+        # the compile gate: a warmed fleet must serve the whole trace
+        # (migrations included) without a single new XLA program
+        assert dis["recompiles_after_warmup"] == 0, dis
+        assert uni["recompiles_after_warmup"] == 0, uni
+
+        # migration byte accounting: measured wire bytes (also booked
+        # on the comm ledger's migrate axis and the migration_bytes
+        # counter) == the closed form over the served requests,
+        # warmup included
+        peng = rt_d.replicas[0].engine
+        mcfg = model.config
+        page_bytes = (2 * mcfg.num_layers * mcfg.num_kv_heads * page
+                      * mcfg.head_dim * np.dtype(peng._dtype).itemsize)
+        lens = [len(p) for _, p in trace] \
+            + [len_hi] * len(rt_d.frontdoor)
+        closed = sum((-(-L // page)) * page_bytes + peng.npages * 4
+                     for L in lens)
+        bytes_exact = rt_d.migrator.wire_bytes == closed
+        parity = out_d == out_u
+
+        _emit({
+            "metric": "serving_disagg_ttft_p99_ms",
+            "value": dis["ttft_p99_ms"], "unit": "ms",
+            # chunked prefill at full MFU with decode offloaded: the
+            # tail TTFT must not regress vs the co-located fleet
+            "vs_baseline": round(uni["ttft_p99_ms"]
+                                 / max(dis["ttft_p99_ms"], 1e-9), 4),
+            "disagg": dis, "unified": uni,
+            "requests": n_req, "page_size": page, "prefill_chunk": Sc,
+            "batch": B,
+            "telemetry": _telemetry_section(),
+            "device": str(getattr(dev, "device_kind", dev.platform)),
+        })
+        _emit({
+            "metric": "serving_disagg_tpot_p99_ms",
+            "value": dis["tpot_p99_ms"], "unit": "ms",
+            # the disagg pitch: decode rows never stall behind prefill
+            # chunks, so the inter-token tail tightens
+            "vs_baseline": round(uni["tpot_p99_ms"]
+                                 / max(dis["tpot_p99_ms"], 1e-9), 4),
+            "disagg": dis, "unified": uni})
+        _emit({
+            "metric": "serving_disagg_goodput_per_chip",
+            "value": dis["goodput_tokens_per_sec_per_chip"],
+            "unit": "tokens/s/chip",
+            "vs_baseline": round(
+                dis["goodput_tokens_per_sec_per_chip"]
+                / max(uni["goodput_tokens_per_sec_per_chip"], 1e-9),
+                4),
+            "disagg": dis, "unified": uni})
+        _emit({
+            "metric": "serving_disagg_parity",
+            "value": 1.0 if parity else 0.0, "unit": "pass",
+            "vs_baseline": 1.0 if parity else 0.0,
+            "outputs_equal": bool(parity),
+            "migrated": rt_d.migrator.migrated})
+        _emit({
+            "metric": "serving_disagg_migration_bytes",
+            "value": 1.0 if bytes_exact else 0.0, "unit": "pass",
+            "vs_baseline": 1.0 if bytes_exact else 0.0,
+            "wire_bytes": int(rt_d.migrator.wire_bytes),
+            "closed_form": int(closed),
+            "page_bytes": int(page_bytes),
+            "block_table_row_bytes": int(peng.npages * 4)})
+    finally:
+        paddle.set_default_dtype(old_dtype)
+
+
+# ---------------------------------------------------------------------------
 # 3. GPT-13B hybrid TP x PP x DP + GroupSharded stage2 (BASELINE row 3).
 # Needs >= 8 chips; on one chip it reports the requirement cleanly, and
 # on the CPU harness it runs the FULL hybrid code path on tiny shapes
@@ -1919,13 +2086,14 @@ _BENCHES = {}
 _TIMEOUTS = {"gpt": 900, "llama_decode": 420, "llama_decode_int8": 420,
              "llama_decode_ragged": 420, "serving": 420,
              "serving_chunked": 600, "serving_prefix_spec": 600,
+             "serving_disagg": 600,
              "resnet": 300,
              "moe": 300, "gpt_moe_hybrid": 420, "gpt13b_hybrid": 900,
              "tp_overlap": 240, "kernel_parity": 240,
              "ckpt_overlap": 420}
 _ORDER = ("gpt", "llama_decode", "llama_decode_int8",
           "llama_decode_ragged", "serving", "serving_chunked",
-          "serving_prefix_spec", "resnet",
+          "serving_prefix_spec", "serving_disagg", "resnet",
           "moe", "gpt_moe_hybrid", "gpt13b_hybrid", "ckpt_overlap",
           "tp_overlap", "kernel_parity")
 # benches that need a virtual multi-device mesh on the CPU fallback
@@ -2054,6 +2222,7 @@ def main(argv):
                     serving=bench_serving_mixed,
                     serving_chunked=bench_serving_chunked,
                     serving_prefix_spec=bench_serving_prefix_spec,
+                    serving_disagg=bench_serving_disagg,
                     gpt_moe_hybrid=bench_gpt_moe_hybrid,
                     gpt13b_hybrid=bench_gpt13b_hybrid,
                     ckpt_overlap=bench_ckpt_overlap,

@@ -13,7 +13,7 @@ fallback (`latest_committed`) — see save_state_dict.py / manager.py.
 """
 from .metadata import LocalTensorIndex, LocalTensorMetadata, Metadata  # noqa: F401
 from .save_state_dict import (save_state_dict, wait_async_saves,  # noqa: F401
-                              COMMIT_MARKER)
+                              COMMIT_MARKER, array_crc32)
 from .load_state_dict import (load_state_dict, is_committed,  # noqa: F401
                               resolve_committed, CheckpointCorruptError)
 from .manager import (CheckpointManager, latest_committed,  # noqa: F401
@@ -23,4 +23,4 @@ __all__ = ["save_state_dict", "load_state_dict", "Metadata",
            "LocalTensorMetadata", "LocalTensorIndex", "CheckpointManager",
            "latest_committed", "read_extra_meta", "is_committed",
            "resolve_committed", "CheckpointCorruptError",
-           "wait_async_saves", "COMMIT_MARKER"]
+           "wait_async_saves", "COMMIT_MARKER", "array_crc32"]

@@ -26,7 +26,6 @@ from __future__ import annotations
 import glob
 import json
 import os
-import zlib
 from typing import Dict, Optional
 
 import jax
@@ -36,7 +35,8 @@ import jax.numpy as jnp
 from ...core.enforce import enforce
 from ...tensor import Tensor
 from .metadata import Metadata
-from .save_state_dict import COMMIT_MARKER, OLD_SUFFIX, TMP_SUFFIX
+from .save_state_dict import (COMMIT_MARKER, OLD_SUFFIX, TMP_SUFFIX,
+                              array_crc32)
 
 __all__ = ["load_state_dict", "is_committed", "resolve_committed",
            "CheckpointCorruptError"]
@@ -112,7 +112,7 @@ class _LazyStorages:
                 want = sums.get(sk)
                 if want is None:
                     continue        # pre-checksum writer
-                got = zlib.crc32(np.ascontiguousarray(arr).tobytes())
+                got = array_crc32(arr)
                 if got != want:
                     raise CheckpointCorruptError(
                         f"checksum mismatch for shard {sk!r} in "

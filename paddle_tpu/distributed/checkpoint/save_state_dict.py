@@ -51,8 +51,8 @@ from .. import failpoints as _fp
 from .metadata import LocalTensorIndex, LocalTensorMetadata, Metadata
 
 __all__ = ["save_state_dict", "wait_async_saves", "collect_shards",
-           "write_committed", "COMMIT_MARKER", "TMP_SUFFIX",
-           "OLD_SUFFIX", "EXTRA_META_FILE"]
+           "write_committed", "array_crc32", "COMMIT_MARKER",
+           "TMP_SUFFIX", "OLD_SUFFIX", "EXTRA_META_FILE"]
 
 COMMIT_MARKER = "COMMIT"
 TMP_SUFFIX = ".tmp"
@@ -70,6 +70,14 @@ def _flatten(state: Dict, prefix=""):
         else:
             out[key] = v
     return out
+
+
+def array_crc32(arr) -> int:
+    """The shard checksum codec: crc32 over the C-contiguous byte
+    image of one array. Shared by the checkpoint writer/loader and the
+    serving KV page-migration wire format (inference/disagg.py), so a
+    page payload is checked exactly like a checkpoint shard."""
+    return zlib.crc32(np.ascontiguousarray(arr).tobytes())
 
 
 def _slices_to_offset(index, shape):
@@ -114,8 +122,7 @@ def collect_shards(state_dict: Dict) -> Tuple[Metadata, Dict[str,
             sk = idx.storage_key()
             md.storage_metadata[sk] = fname
             md.global_shape[key] = list(v.shape)
-            md.checksums[sk] = zlib.crc32(
-                np.ascontiguousarray(v).tobytes())
+            md.checksums[sk] = array_crc32(v)
             shards_out[sk] = v
             continue
         md.global_shape[key] = list(v.shape)
@@ -131,8 +138,7 @@ def collect_shards(state_dict: Dict) -> Tuple[Metadata, Dict[str,
             idx = LocalTensorIndex(key, off)
             sk = idx.storage_key()
             md.storage_metadata[sk] = fname
-            md.checksums[sk] = zlib.crc32(
-                np.ascontiguousarray(data).tobytes())
+            md.checksums[sk] = array_crc32(data)
             shards_out[sk] = data
         md.state_dict_metadata[key] = metas
     return md, shards_out, fname

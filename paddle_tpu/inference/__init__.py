@@ -51,7 +51,9 @@ from jax import lax
 from ..tensor import Tensor
 
 __all__ = ["Config", "Predictor", "create_predictor", "GenerationConfig",
-           "CompileStats", "ServingEngine", "ServingRequest"]
+           "CompileStats", "ServingEngine", "ServingRequest",
+           "Router", "RouterServer", "Replica", "KVMigrator",
+           "MigrationCorruptError"]
 
 
 # one lattice definition for the whole tree (serving S/P buckets, MoE
@@ -448,3 +450,5 @@ class Predictor:
 
 
 from .serving import ServingEngine, ServingRequest  # noqa: E402
+from .disagg import KVMigrator, MigrationCorruptError  # noqa: E402
+from .router import Replica, Router, RouterServer  # noqa: E402

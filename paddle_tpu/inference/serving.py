@@ -216,6 +216,7 @@ class ServingEngine:
                  prefix_cache: bool = False,
                  draft_predictor=None, spec_tokens: int = 0,
                  host_spill_pages: int = 0,
+                 phase: Optional[str] = None,
                  debug_invariants: bool = False):
         import inspect
         import os
@@ -257,6 +258,24 @@ class ServingEngine:
         else:
             self.Sc = 0
             self.prefill_budget = 0
+        # disaggregated serving (inference/disagg.py drives the
+        # migration): a "prefill" replica parks each row the moment its
+        # first token samples, holding the committed KV pages for
+        # export; a "decode" replica only adopts migrated rows (submit
+        # is refused). None = unified, both phases on one replica.
+        enforce(phase in (None, "prefill", "decode"),
+                'ServingEngine phase must be None, "prefill", or '
+                '"decode"')
+        self.phase = phase
+        if phase == "prefill":
+            enforce(self.chunked,
+                    'phase="prefill" runs the chunked unified step at '
+                    "full MFU; set prefill_chunk")
+        if phase is not None:
+            enforce(draft_predictor is None,
+                    "disaggregated phases do not carry the draft "
+                    "pools; run speculative decoding on unified "
+                    "replicas")
         self._admit_seq = 0
         # chunked-mode admission backpressure: while an active row is
         # page-stalled, new admissions pause so the freed/free pages
@@ -485,6 +504,10 @@ class ServingEngine:
         are generated, so every request ALWAYS carries a valid trace
         identity — read it back from ``ServingRequest.traceparent`` or
         ``trace_context(rid)`` to stitch a multi-replica trace."""
+        enforce(self.phase != "decode",
+                'a phase="decode" replica only adopts migrated '
+                "requests (import_request); route submissions to a "
+                "prefill or unified replica")
         ids = np.asarray(prompt._value if isinstance(prompt, Tensor)
                          else prompt).reshape(-1).astype(np.int64)
         n_new = int(max_new_tokens if max_new_tokens is not None
@@ -501,11 +524,20 @@ class ServingEngine:
                 f"the pool only has {self.P - 1}; raise pool_pages")
         if trace_id is not None and "-" in trace_id:
             # a full traceparent header: the caller's span becomes
-            # this trace's parent unless explicitly overridden
-            tid, parent = _parse_traceparent(trace_id)
-            trace_id = tid
-            if parent_span_id is None:
-                parent_span_id = parent
+            # this trace's parent unless explicitly overridden. A
+            # malformed or all-zero header (routers inject these) must
+            # not fail the request: mint a fresh trace id and book the
+            # reject reason instead.
+            try:
+                tid, parent = _parse_traceparent(trace_id)
+            except ValueError:
+                self._metrics["trace_parse_errors"].inc(
+                    reason="malformed_traceparent")
+                trace_id = None
+            else:
+                trace_id = tid
+                if parent_span_id is None:
+                    parent_span_id = parent
         rid = self._next_rid
         self._next_rid += 1
         now = time.perf_counter()
@@ -514,10 +546,16 @@ class ServingEngine:
         req = ServingRequest(rid, ids, n_new, eos, t_submit=now,
                              deadline=(now + dls) if dls is not None
                              else None)
-        tr = RequestTrace(rid, meta={"prompt_len": L,
-                                     "max_new_tokens": n_new},
-                          trace_id=trace_id,
-                          parent_span_id=parent_span_id)
+        meta = {"prompt_len": L, "max_new_tokens": n_new}
+        try:
+            tr = RequestTrace(rid, meta=meta, trace_id=trace_id,
+                              parent_span_id=parent_span_id)
+        except ValueError:
+            # bare ids that fail W3C validation get the same
+            # treatment: fresh identity, reason on the counter
+            self._metrics["trace_parse_errors"].inc(
+                reason="invalid_trace_id")
+            tr = RequestTrace(rid, meta=meta)
         req.trace_id = tr.trace_id
         req.span_id = tr.span_id
         req.parent_span_id = tr.parent_span_id
@@ -1497,6 +1535,12 @@ class ServingEngine:
                         (req.eos_token_id is not None
                          and tok0 == req.eos_token_id):
                     self._finish(b)
+                elif self.phase == "prefill":
+                    # disaggregated: the committed KV pages are ready
+                    # to stream out — park the row for export
+                    # (migratable/export_request) instead of decoding
+                    # it on this replica
+                    s.state = "migrate"
         if self.prefix:
             self._pfx["fed_tokens"] += fed_tokens
         emitted = 0
@@ -1695,6 +1739,144 @@ class ServingEngine:
         if self.debug:
             self.check_invariants()
 
+    # -- disaggregated prefill/decode hooks (inference/disagg.py) --------
+    def prefix_match(self, hashes: List[int]) -> int:
+        """Leading page-aligned prompt chunks whose KV this replica's
+        prefix cache already holds — the router's affinity signal
+        (computed over the SAME rolling hashes _prefix_hashes
+        registers under)."""
+        n = 0
+        with self._lock:
+            for h in hashes:
+                if h not in self._hash_page:
+                    break
+                n += 1
+        return n
+
+    def migratable(self) -> List[int]:
+        """rids parked for migration on a prefill replica: prompt
+        fully prefilled, first token committed, KV pages held for
+        export to a decode replica."""
+        return [s.req.rid for s in self.slots
+                if s is not None and s.state == "migrate"]
+
+    def can_import(self, prompt_len: int, max_new_tokens: int) -> bool:
+        """Whether import_request would accept a request of this
+        geometry RIGHT NOW (a free slot plus its full page footprint).
+        False is the backpressure signal the disagg layer acts on."""
+        if any(s is None for s in self.slots):
+            return self._pages_needed(prompt_len, max_new_tokens) \
+                <= self._avail_pages()
+        return False
+
+    def export_request(self, rid: int) -> Dict[str, Any]:
+        """Export one migratable row: the committed KV page payloads
+        (read through the compiled page-read program — traced src
+        index, so exports never recompile), its block-table row, and
+        the host request state; the row is then evicted (pages
+        released, slot open for backfill). Each page payload is one
+        [2*layers, kv_heads, page, head_dim] array (k/v interleaved
+        per layer). Delivery framing — crc32 per page, wire-byte
+        booking — lives in inference/disagg.py."""
+        b = next((i for i, s in enumerate(self.slots)
+                  if s is not None and s.state == "migrate"
+                  and s.req.rid == rid), None)
+        enforce(b is not None,
+                f"rid {rid} is not parked for migration")
+        s = self.slots[b]
+        req = s.req
+        k = self._pages_for(len(req.prompt))  # pages with committed KV
+        fn = self._page_read_fn()
+        payloads: List[np.ndarray] = []
+        for j in range(k):
+            src = jnp.asarray(s.pages[j], jnp.int32)
+            self.stats.note("page_read",
+                            ("target", len(self.pools),
+                             str(self._dtype)))
+            rows = self._run_captured(("page_read",), fn, self.pools,
+                                      src)
+            payloads.append(np.stack([np.asarray(a)
+                                      for kv in rows for a in kv]))
+        now = time.perf_counter()
+        tr = self._live_traces.pop(rid, None)
+        if tr is not None:
+            tr.end("decode", now)
+            tr.add("migrate_out", now, now, {"pages": k})
+            self.traces.add(tr)
+        pkg = {"rid": rid, "prompt": req.prompt,
+               "max_new_tokens": req.max_new_tokens,
+               "eos_token_id": req.eos_token_id,
+               "new_tokens": list(req.new_tokens),
+               "t_submit": req.t_submit,
+               "t_first_token": req.t_first_token,
+               "trace_id": req.trace_id, "parent_span_id": req.span_id,
+               "pages": payloads, "table_row": self.tables[b].copy()}
+        self._release_pages(s.pages)
+        self.tables[b, :] = self.trash
+        self.slots[b] = None
+        self._metrics["requests"].inc(event="migrated_out")
+        if self.debug:
+            self.check_invariants()
+        return pkg
+
+    def import_request(self, pkg: Dict[str, Any]) -> Optional[int]:
+        """Adopt a migrated request on a decode replica: allocate its
+        full page footprint, write the committed page payloads through
+        the compiled page-write program (traced dst index — imports
+        never recompile), and park the row mid-decode exactly where
+        the prefill replica stopped. Returns the local rid, or None
+        when this replica refuses (no free slot / not enough pages) —
+        the disagg layer's backpressure signal. crc verification
+        happens in inference/disagg.py BEFORE this call."""
+        enforce(self.phase != "prefill",
+                "a prefill replica cannot adopt migrated rows")
+        prompt = np.asarray(pkg["prompt"], np.int64)
+        L, n_new = len(prompt), int(pkg["max_new_tokens"])
+        free = [b for b in range(self.B) if self.slots[b] is None]
+        if not free or self._pages_needed(L, n_new) > \
+                self._avail_pages():
+            return None
+        b = free[0]
+        pages = self._alloc_pages(self._pages_needed(L, n_new))
+        fn = self._page_write_fn()
+        nl = len(self.pools)
+        for j, arr in enumerate(pkg["pages"]):
+            rows = [(jnp.asarray(arr[2 * l]),
+                     jnp.asarray(arr[2 * l + 1])) for l in range(nl)]
+            dst = jnp.asarray(pages[j], jnp.int32)
+            self.stats.note("page_write",
+                            ("target", nl, str(self._dtype)))
+            self.pools = self._run_captured(("page_write",), fn,
+                                            self.pools, rows, dst)
+        self.tables[b, :] = self.trash
+        self.tables[b, :len(pages)] = pages
+        rid = self._next_rid
+        self._next_rid += 1
+        req = ServingRequest(rid, prompt, n_new, pkg["eos_token_id"],
+                             new_tokens=list(pkg["new_tokens"]),
+                             t_submit=pkg["t_submit"],
+                             t_first_token=pkg["t_first_token"])
+        slot = _Slot(req, pages, state="decode", seq=self._admit_seq)
+        slot.fed = L
+        self._admit_seq += 1
+        self.slots[b] = slot
+        tr = RequestTrace(rid, meta={"prompt_len": L,
+                                     "max_new_tokens": n_new,
+                                     "migrated": True},
+                          trace_id=pkg.get("trace_id"),
+                          parent_span_id=pkg.get("parent_span_id"))
+        req.trace_id = tr.trace_id
+        req.span_id = tr.span_id
+        req.parent_span_id = tr.parent_span_id
+        now = time.perf_counter()
+        tr.add("migrate_in", now, now, {"pages": len(pkg["pages"])})
+        tr.begin("decode", now)    # closed at eviction
+        self._live_traces[rid] = tr
+        self._metrics["requests"].inc(event="migrated_in")
+        if self.debug:
+            self.check_invariants()
+        return rid
+
     # -- driving ---------------------------------------------------------
     @property
     def num_active(self) -> int:
@@ -1733,6 +1915,9 @@ class ServingEngine:
                 self._pfx["hits"] / lk if lk else 0.0)
             m["prefix_pages"].set(n_reg - n_idle, state="active")
             m["prefix_pages"].set(n_idle, state="idle")
+            # the hash-table size router prefix-affinity steering
+            # reads (idle-list length rides prefix_pages{state=idle})
+            m["prefix_hash_entries"].set(n_reg)
         if self._draft is not None:
             pr = self._spec["proposed"]
             m["spec_accept_rate"].set(

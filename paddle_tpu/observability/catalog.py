@@ -564,5 +564,51 @@ def serving_metrics(reg: MetricsRegistry = None) -> Dict[str, object]:
             "ServingEngine.export_request_traces)",
             unit="s", labelnames=("stage",),
             buckets=DEFAULT_LATENCY_BUCKETS),
+        "trace_parse_errors": r.counter(
+            "paddle_tpu_serving_trace_parse_errors_total",
+            "trace identities rejected at submit(), by reason: "
+            "malformed_traceparent (header failed the W3C grammar or "
+            "carried an all-zero id) / invalid_trace_id (bare trace "
+            "id not 32 hex). The request is served under a freshly "
+            "minted trace id either way — this counter is how router-"
+            "injected headers stay debuggable",
+            labelnames=("reason",)),
+        "prefix_hash_entries": r.gauge(
+            "paddle_tpu_serving_prefix_hash_entries",
+            "entries in the prefix-cache page hash table (content-"
+            "addressed registered pages; the idle-list length rides "
+            "paddle_tpu_serving_prefix_cache_pages{state=\"idle\"}) — "
+            "the state router prefix-affinity steering reads"),
+        "migrations": r.counter(
+            "paddle_tpu_serving_migrations_total",
+            "KV page migrations between disaggregated replicas, by "
+            "result: ok (imported by a decode replica) / refused "
+            "(decode replica had no free slot or pages — backpressure) "
+            "/ crc_error (a transferred page payload failed its crc32 "
+            "and the request was retried on a fresh replica)",
+            labelnames=("result",)),
+        "migration_bytes": r.counter(
+            "paddle_tpu_serving_migration_bytes_total",
+            "bytes moved by KV page migration, ledger-exact at the "
+            "closed form pages x page_bytes + the block-table row "
+            "(inference/disagg.py; also booked on the comm ledger "
+            "under axis \"migrate\")"),
+        "migration_seconds": r.histogram(
+            "paddle_tpu_serving_migration_seconds",
+            "one request's KV page migration: export on the prefill "
+            "replica through crc-verified import on the decode "
+            "replica", unit="s", buckets=DEFAULT_LATENCY_BUCKETS),
+        "router_requests": r.counter(
+            "paddle_tpu_router_requests_total",
+            "front-door placements per replica, by decision: affinity "
+            "(prefix-affinity steering matched registered pages) / "
+            "least_loaded (fallback placement) / retry (resubmitted "
+            "after a migration crc failure)",
+            labelnames=("replica", "decision")),
+        "phase_slots": r.gauge(
+            "paddle_tpu_router_phase_slots",
+            "fleet phase occupancy: in-flight batch rows summed over "
+            "the replicas of each phase (prefill / decode / unified)",
+            labelnames=("phase",)),
     })
     return out

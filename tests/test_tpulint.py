@@ -1501,6 +1501,13 @@ LOCK_MESH_RULES = {"lock-order-cycle", "blocking-under-lock",
 PINNED_ZERO_PREFIXES = ("paddle_tpu/observability/",
                         "paddle_tpu/distributed/checkpoint/",
                         "paddle_tpu/inference/serving.py",
+                        # the disaggregated-serving data plane (ISSUE
+                        # 20): the migration wire and the front door
+                        # mutate shared engine state across replica
+                        # boundaries — races or ledger bypasses here
+                        # are fixed, never baselined
+                        "paddle_tpu/inference/router.py",
+                        "paddle_tpu/inference/disagg.py",
                         # the bidirectional bucketed-collective engine
                         # + the stage-3 gather paths in the train step:
                         # ledger bypasses / races here corrupt the

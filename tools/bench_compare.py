@@ -101,6 +101,16 @@ _EXACT = {"pallas_kernel_parity_interpret": 1.0,
           # ledgers partition the trace exactly, and the cache hit
           # rate clears its floor — pass/fail, never drifts
           "serving_prefix_spec_parity": 1.0,
+          # disaggregated serving (ISSUE 20): the phase-split fleet
+          # (prefill replicas streaming KV pages to decode replicas)
+          # must emit token streams bit-identical to the unified
+          # fleet on the same arrival trace — migration is pure data
+          # movement, so any drift is corruption, never noise
+          "serving_disagg_parity": 1.0,
+          # migration wire bytes == pages x page_bytes + block-table
+          # row, booked through the comm ledger's migrate axis — a
+          # closed form of the served trace, exact everywhere
+          "serving_disagg_migration_bytes": 1.0,
           # health monitor event counts on the DETERMINISTIC bench
           # lines: robust spike detection must stay silent on a clean
           # fixed-seed run — any event is a regression (either a real
@@ -138,6 +148,15 @@ _THRESHOLDS = {
     # on chip the chunked-on vs chunked-off ratio on the line itself
     # (vs_baseline > 1) carries the acceptance
     "serving_mixed_traffic_tpot_p99_ms": 1.0,
+    # disagg fleet tail latencies + per-chip goodput ("ms" metrics are
+    # lower-better): ms-scale rounds on the CPU smoke are host-
+    # scheduling noise, and toy-scale migration overhead dominates the
+    # goodput split — the unified-vs-disagg ratios on the lines
+    # themselves carry the on-chip acceptance; the hard gates
+    # (bit-parity, exact migration bytes) are the _EXACT rows above
+    "serving_disagg_ttft_p99_ms": 1.0,
+    "serving_disagg_tpot_p99_ms": 1.0,
+    "serving_disagg_goodput_per_chip": 1.0,
     # TTFT p50 under the multi-tenant prefix trace ("ms" unit:
     # lower-better): ms-scale on the CPU smoke, so host-scheduling
     # noise dominates — the prefix-on vs prefix-off ratio on the line
